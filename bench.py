@@ -1,0 +1,54 @@
+"""Benchmark entry point. Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Headline metric (BASELINE.json): row<->columnar conversion GB/s on TPU.
+vs_baseline is the ratio against a single-thread numpy host conversion of the
+same table (the CPU reference the Spark plugin would otherwise use), since the
+reference publishes no GPU numbers (BASELINE.md).
+"""
+
+import json
+import time
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def _bench_placeholder():
+    # Placeholder until ops.row_conversion lands: device elementwise pipeline
+    # throughput on one chip.
+    n = 1 << 22
+    x = jnp.arange(n, dtype=jnp.int64)
+
+    @jax.jit
+    def f(v):
+        return (v * 2654435761 + 12345) ^ (v >> 16)
+
+    f(x).block_until_ready()
+    t0 = time.perf_counter()
+    iters = 20
+    for _ in range(iters):
+        out = f(x)
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+    gbps = (n * 8 * 2) / dt / 1e9
+    return {"metric": "placeholder_elementwise_int64", "value": round(gbps, 3),
+            "unit": "GB/s", "vs_baseline": 1.0}
+
+
+def main():
+    import importlib.util
+    if importlib.util.find_spec("bench_impl") is not None:
+        from bench_impl import run  # real benchmark, added as ops land
+        result = run()
+    else:
+        result = _bench_placeholder()
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""spark_rapids_tpu — TPU-native columnar acceleration library for Apache Spark.
+
+A from-scratch, TPU-first counterpart to NVIDIA/spark-rapids-jni: the same
+Table/ColumnVector op surface (Spark-exact casts/hashes, row<->columnar JCUDF
+conversion, JSON/URI/string kernels, join & aggregation primitives, sketches,
+datetime/timezone handling, the Kudo shuffle wire format, and the RmmSpark
+OOM-retry state machine) built on JAX/XLA/Pallas over Arrow-layout device
+columns instead of libcudf/RMM/CUDA.
+
+Layer map (mirrors reference SURVEY.md §1, re-architected for TPU):
+
+  ops.*        stateless columnar kernels (jax.numpy / Pallas), every op takes
+               Column/Table values and returns new ones — the L3 equivalent.
+  columns.*    Arrow-backed device Column/Table: data buffer + validity +
+               int32 offsets as jax arrays — replaces the libcudf slice used.
+  memory.*     HBM reservation tracking + the RmmSpark OOM retry/split/BUFN
+               thread state machine (reference SparkResourceAdaptorJni.cpp).
+  shuffle.*    Kudo wire format (host) and device shuffle split/assemble.
+  parallel.*   jax.sharding Mesh / shard_map distribution of ops over ICI.
+  models.*     composed query pipelines (TPC-DS style) used as end-to-end
+               flagship workloads and benchmarks.
+"""
+
+from spark_rapids_tpu.columns.dtypes import (  # noqa: F401
+    DType,
+    BOOL8,
+    INT8,
+    INT16,
+    INT32,
+    INT64,
+    FLOAT32,
+    FLOAT64,
+    STRING,
+    TIMESTAMP_DAYS,
+    TIMESTAMP_MICROS,
+)
+from spark_rapids_tpu.columns.column import Column  # noqa: F401
+from spark_rapids_tpu.columns.table import Table  # noqa: F401
+
+__version__ = "0.1.0"

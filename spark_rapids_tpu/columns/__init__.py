@@ -1,0 +1,3 @@
+from spark_rapids_tpu.columns.dtypes import DType  # noqa: F401
+from spark_rapids_tpu.columns.column import Column  # noqa: F401
+from spark_rapids_tpu.columns.table import Table  # noqa: F401

@@ -1,0 +1,69 @@
+"""Table: an ordered collection of equal-length Columns (cudf::table_view
+equivalent).  Registered as a pytree so tables flow through jit/shard_map."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+
+from spark_rapids_tpu.columns.column import Column
+
+
+class Table:
+    __slots__ = ("columns", "names")
+
+    def __init__(self, columns: Sequence[Column],
+                 names: Optional[Sequence[str]] = None):
+        cols = list(columns)
+        if cols:
+            n = cols[0].length
+            for c in cols:
+                if c.length != n:
+                    raise ValueError(
+                        f"column lengths differ: {c.length} vs {n}")
+        self.columns: List[Column] = cols
+        self.names = list(names) if names is not None else None
+
+    @property
+    def num_rows(self) -> int:
+        return self.columns[0].length if self.columns else 0
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    def column(self, i) -> Column:
+        if isinstance(i, str):
+            if self.names is None:
+                raise KeyError("table has no column names")
+            i = self.names.index(i)
+        return self.columns[i]
+
+    def __getitem__(self, i) -> Column:
+        return self.column(i)
+
+    def __len__(self) -> int:
+        return self.num_rows
+
+    def __repr__(self) -> str:
+        return (f"Table(rows={self.num_rows}, "
+                f"cols=[{', '.join(c.dtype.kind for c in self.columns)}])")
+
+    def to_pylist(self) -> list:
+        cols = [c.to_pylist() for c in self.columns]
+        return [tuple(c[i] for c in cols) for i in range(self.num_rows)]
+
+
+def _tbl_flatten(t: Table):
+    names = tuple(t.names) if t.names is not None else None
+    return (tuple(t.columns),), (names,)
+
+
+def _tbl_unflatten(aux, dyn):
+    (names,) = aux
+    (columns,) = dyn
+    return Table(list(columns), list(names) if names is not None else None)
+
+
+jax.tree_util.register_pytree_node(Table, _tbl_flatten, _tbl_unflatten)

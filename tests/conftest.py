@@ -1,0 +1,20 @@
+"""Test harness: force an 8-device virtual CPU mesh so sharding/distribution
+tests run anywhere (the driver's multichip dryrun uses the same mechanism).
+
+Note: this image's sitecustomize imports jax at interpreter startup (axon TPU
+plugin), so env vars are too late here — we must go through jax.config.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_enable_x64", True)

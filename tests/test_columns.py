@@ -1,0 +1,116 @@
+"""Column/Table core tests (reference analog: cudf column_view basics used by
+src/main/cpp/tests fixtures)."""
+
+import jax
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import columns
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.columns.table import Table
+
+
+def test_fixed_width_roundtrip():
+    c = Column.from_pylist([1, None, 3, -4], dtypes.INT64)
+    assert c.length == 4
+    assert c.null_count() == 1
+    assert c.to_pylist() == [1, None, 3, -4]
+
+
+def test_bool_and_float():
+    c = Column.from_pylist([True, False, None], dtypes.BOOL8)
+    assert c.to_pylist() == [True, False, None]
+    f = Column.from_pylist([1.5, None, -0.0], dtypes.FLOAT64)
+    out = f.to_pylist()
+    assert out[0] == 1.5 and out[1] is None and out[2] == 0.0
+
+
+def test_string_roundtrip():
+    vals = ["hello", "", None, "wörld", "日本語"]
+    c = Column.from_strings(vals)
+    assert c.to_pylist() == vals
+    assert c.null_count() == 1
+    np.testing.assert_array_equal(
+        np.asarray(c.string_lengths()),
+        [5, 0, 0, 6, 9],
+    )
+
+
+def test_padded_chars():
+    c = Column.from_strings(["abc", "", "defgh"])
+    chars, lens = c.to_padded_chars()
+    assert chars.shape == (3, 5)
+    assert bytes(np.asarray(chars[0, :3])) == b"abc"
+    assert bytes(np.asarray(chars[2])) == b"defgh"
+    np.testing.assert_array_equal(np.asarray(lens), [3, 0, 5])
+
+
+def test_list_and_struct():
+    child = Column.from_pylist([1, 2, 3, 4, 5], dtypes.INT32)
+    lst = Column.make_list(np.array([0, 2, 2, 5]), child,
+                           validity=np.array([1, 0, 1]))
+    assert lst.to_pylist() == [[1, 2], None, [3, 4, 5]]
+    st = Column.make_struct(3, [
+        Column.from_pylist([1, 2, 3], dtypes.INT32),
+        Column.from_strings(["a", "b", "c"]),
+    ])
+    assert st.to_pylist() == [(1, "a"), (2, "b"), (3, "c")]
+
+
+def test_table_pytree_through_jit():
+    t = Table([
+        Column.from_pylist([1, 2, 3], dtypes.INT64),
+        Column.from_strings(["x", "yy", None]),
+    ], names=["a", "b"])
+
+    @jax.jit
+    def bump(table):
+        c0 = table.column(0)
+        new0 = Column(c0.dtype, c0.length, data=c0.data + 1,
+                      validity=c0.validity)
+        return Table([new0, table.column(1)], table.names)
+
+    out = bump(t)
+    assert out.column("a").to_pylist() == [2, 3, 4]
+    assert out.column("b").to_pylist() == ["x", "yy", None]
+
+
+def test_table_length_mismatch():
+    with pytest.raises(ValueError):
+        Table([
+            Column.from_pylist([1], dtypes.INT32),
+            Column.from_pylist([1, 2], dtypes.INT32),
+        ])
+
+
+def test_eight_virtual_devices():
+    assert jax.device_count() >= 8
+
+
+def test_from_numpy_uint8_not_bool():
+    c = Column.from_numpy(np.arange(5, dtype=np.uint8))
+    assert c.dtype.kind == "uint8"
+    assert c.to_pylist() == [0, 1, 2, 3, 4]
+
+
+def test_decimal128_limbs():
+    vals = [10**18, None, -1, 0]
+    c = Column.from_pylist(vals, dtypes.decimal128(-2))
+    assert c.data.shape == (4, 4)
+    limbs = np.asarray(c.data).astype(np.uint32).astype(object)
+    recon = []
+    mask = np.asarray(c.validity).astype(bool)
+    for i in range(4):
+        u = sum(int(limbs[i, j]) << (32 * j) for j in range(4))
+        if u >= 1 << 127:
+            u -= 1 << 128
+        recon.append(u if mask[i] else None)
+    assert recon == vals
+
+
+def test_empty_names_table_jit_roundtrip():
+    t = Table([], names=[])
+    leaves, treedef = jax.tree_util.tree_flatten(t)
+    t2 = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert t2.names == []
