@@ -3,7 +3,12 @@
 The reference operates on `cudf::column_view` (data ptr, packed validity bits,
 int32 offsets, children).  Here a Column is an immutable pytree of jax arrays:
 
-  data      fixed-width: (rows,) natural dtype
+  data      fixed-width: (rows,) natural dtype — EXCEPT float64, which is
+            stored as (rows,) uint64 raw IEEE754 bits: TPUs have no native
+            f64 (the XLA X64 rewrite demotes f64 compute to f32, and
+            f64<->u64 bitcasts don't lower at all), so the exact Spark
+            DOUBLE bit patterns live in integer lanes and ops that need
+            true f64 arithmetic decode explicitly (utils/floats.py).
             string:      (chars,) uint8 — the flattened char buffer
             decimal128:  (rows, 4) int32 little-endian limbs
   validity  (rows,) uint8, 1 = valid; None means all rows valid.  Unpacked on
@@ -79,7 +84,10 @@ class Column:
                    dtype: Optional[DType] = None) -> "Column":
         arr = np.asarray(arr)
         dt = dtype if dtype is not None else dtypes.from_numpy(arr.dtype)
-        data = jnp.asarray(arr.astype(dt.np_dtype, copy=False))
+        host = arr.astype(dt.np_dtype, copy=False)
+        if dt.kind == Kind.FLOAT64:
+            host = host.view(np.uint64)  # device buffer holds raw bits
+        data = jnp.asarray(host)
         v = None
         if validity is not None:
             v = jnp.asarray(np.asarray(validity).astype(np.uint8))
@@ -97,6 +105,8 @@ class Column:
         np_dt = dtype.np_dtype
         fill = 0
         host = np.array([fill if v is None else v for v in values], dtype=np_dt)
+        if dtype.kind == Kind.FLOAT64:
+            host = host.view(np.uint64)
         v = None
         if has_null:
             v = jnp.asarray(
@@ -166,10 +176,13 @@ class Column:
     # ------------------------------------------------------------- host view
 
     def to_numpy(self) -> np.ndarray:
-        """Data buffer to host (no null masking applied)."""
+        """Data buffer to host in the logical dtype (no null masking)."""
         if self.data is None:
             raise ValueError(f"{self.dtype} column has no data buffer")
-        return np.asarray(self.data)
+        host = np.asarray(self.data)
+        if self.dtype.kind == Kind.FLOAT64:
+            return host.view(np.float64)
+        return host
 
     def to_pylist(self) -> list:
         """Host round-trip with None for nulls (test/debug use)."""

@@ -1,0 +1,17 @@
+"""Columnar op kernels — the L3 equivalent of the reference's
+src/main/cpp/src/*.cu free functions.  All ops are stateless, take
+Column/Table values, and return new Columns."""
+
+from spark_rapids_tpu.ops.hash import (  # noqa: F401
+    murmur3_32,
+    xxhash64,
+    hive_hash,
+    DEFAULT_XXHASH64_SEED,
+)
+from spark_rapids_tpu.ops.sha import (  # noqa: F401
+    sha224_nulls_preserved,
+    sha256_nulls_preserved,
+    sha384_nulls_preserved,
+    sha512_nulls_preserved,
+    host_crc32,
+)

@@ -1,0 +1,627 @@
+"""Spark-exact hash kernels: murmur3_32, xxhash64, hive_hash.
+
+Reference semantics (studied from /root/reference/src/main/cpp/src/hash/):
+  * murmur_hash.cuh:95-119  — Spark murmur3: 4-byte blocks, Spark's
+    sign-extending tail handling, h ^= len, fmix32.  Floats normalize NaNs
+    only (murmur_hash.cuh:164-173); small ints sign-extend to 4 bytes;
+    decimal32/64 hash as 8-byte long; decimal128 hashes the minimal
+    big-endian two's-complement byte string (hash.cuh:64-107).
+  * xxhash64.cu:43-199 — Spark xxhash64 (seed 42): 32-byte stripes with 4
+    lanes, then 8/4/1-byte tails; floats normalize NaNs AND -0.0
+    (xxhash64.cu:230-239); same widening/decimal rules as murmur.
+  * hive_hash.cu — h = 31*h + elem_hash fold, null elem contributes 0;
+    int→identity, long→(v>>>32)^v, float/double→bits, string→Java
+    String.hashCode over bytes, timestamp special (hive_hash.cu:136-152).
+  * Row semantics (murmur_hash.cu:64-165, xxhash64.cu:273+): seed chains
+    serially across columns; a null element returns the incoming seed
+    unchanged.  Nested columns flatten per-row to leaf elements, folded
+    serially with the same chaining; lists of structs are rejected
+    (murmur_hash.cu:167-187).
+
+TPU-first design: no per-row scalar loops.  Every element hash is a
+closed-form function of a fixed number of 4/8-byte little-endian blocks,
+computed vectorized over all rows on the VPU.  Variable-length bytes
+(strings, decimal128) use a lax.scan over the padded block axis with per-row
+active masks — O(max_len/4) vector steps regardless of row count.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.columns.dtypes import Kind
+from spark_rapids_tpu.columns.table import Table
+from spark_rapids_tpu.utils import floats
+
+DEFAULT_XXHASH64_SEED = 42
+
+_U32 = jnp.uint32
+_U64 = jnp.uint64
+_I32 = jnp.int32
+_I64 = jnp.int64
+
+# ----------------------------------------------------------------- helpers
+
+
+def _cols(table_or_cols) -> List[Column]:
+    if isinstance(table_or_cols, Table):
+        return list(table_or_cols.columns)
+    if isinstance(table_or_cols, Column):
+        return [table_or_cols]
+    return list(table_or_cols)
+
+
+def _rotl32(x, r: int):
+    return (x << _U32(r)) | (x >> _U32(32 - r))
+
+
+def _rotl64(x, r: int):
+    return (x << _U64(r)) | (x >> _U64(64 - r))
+
+
+def _bitcast_u32(x) -> jnp.ndarray:
+    return lax.bitcast_convert_type(x, jnp.uint32)
+
+
+def _bitcast_u64(x) -> jnp.ndarray:
+    return lax.bitcast_convert_type(x, jnp.uint64)
+
+
+def _split_u64(v: jnp.ndarray):
+    """uint64 -> (lo, hi) uint32 little-endian blocks."""
+    return (v & _U64(0xFFFFFFFF)).astype(_U32), (v >> _U64(32)).astype(_U32)
+
+
+def _normalize_nans_f32_bits(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.where(jnp.isnan(x), _U32(0x7FC00000), _bitcast_u32(x))
+
+
+def _normalize_nans_f64_bits(bits: jnp.ndarray) -> jnp.ndarray:
+    """FLOAT64 columns carry raw bits (see columns/column.py), so NaN/zero
+    normalization is pure integer work — no f64 lowering needed on TPU."""
+    return jnp.where(floats.is_nan_bits(bits), _U64(floats.F64_QNAN), bits)
+
+
+def _normalize_nans_zeros_f32_bits(x: jnp.ndarray) -> jnp.ndarray:
+    bits = jnp.where(x == 0.0, _U32(0), _bitcast_u32(x))
+    return jnp.where(jnp.isnan(x), _U32(0x7FC00000), bits)
+
+
+def _normalize_nans_zeros_f64_bits(bits: jnp.ndarray) -> jnp.ndarray:
+    bits = jnp.where(bits == _U64(floats.F64_SIGN), _U64(0), bits)
+    return jnp.where(floats.is_nan_bits(bits), _U64(floats.F64_QNAN), bits)
+
+
+def _chars_to_u32_blocks(chars: jnp.ndarray) -> jnp.ndarray:
+    """(rows, P) uint8 (P % 4 == 0) -> (rows, P//4) uint32 little-endian."""
+    rows, p = chars.shape
+    b = chars.reshape(rows, p // 4, 4).astype(_U32)
+    return (b[..., 0] | (b[..., 1] << _U32(8)) | (b[..., 2] << _U32(16))
+            | (b[..., 3] << _U32(24)))
+
+
+def _pad_chars(chars: jnp.ndarray, multiple: int) -> jnp.ndarray:
+    p = chars.shape[1]
+    target = max(((p + multiple - 1) // multiple) * multiple, multiple)
+    if target != p:
+        chars = jnp.pad(chars, ((0, 0), (0, target - p)))
+    return chars
+
+
+def _dec128_min_be_bytes(limbs: jnp.ndarray):
+    """(rows, 4) int32 LE limbs -> ((rows, 16) uint8 big-endian minimal
+    two's-complement bytes left-justified, (rows,) int32 byte length).
+
+    Java BigDecimal.unscaledValue().toByteArray() semantics per reference
+    hash.cuh:64-107: strip leading sign bytes, keep >=1 byte, re-add one if
+    the top bit would flip the sign.
+    """
+    u = limbs.astype(_U32)
+    k = jnp.arange(16, dtype=_I32)
+    le = (u[:, k // 4] >> (8 * (k % 4)).astype(_U32)) & _U32(0xFF)  # (r,16)
+    neg = limbs[:, 3] < 0
+    zero = jnp.where(neg, _U32(0xFF), _U32(0))
+    neq = le != zero[:, None]
+    last_sig = jnp.max(jnp.where(neq, k[None, :], -1), axis=1)
+    length = jnp.maximum(last_sig + 1, 1)
+    top = jnp.take_along_axis(le, (length - 1)[:, None], axis=1)[:, 0]
+    need_sign_byte = (length < 16) & (neg != ((top & _U32(0x80)) != 0))
+    length = (length + need_sign_byte).astype(_I32)
+    j = jnp.arange(16, dtype=_I32)
+    src = length[:, None] - 1 - j[None, :]
+    be = jnp.where(src >= 0,
+                   jnp.take_along_axis(le, jnp.clip(src, 0, 15), axis=1),
+                   _U32(0))
+    return be.astype(jnp.uint8), length
+
+
+# ------------------------------------------------------------ murmur3_32
+
+_MM_C1 = _U32(0xCC9E2D51)
+_MM_C2 = _U32(0x1B873593)
+_MM_C3 = _U32(0xE6546B64)
+
+
+def _mm_update(h, k1):
+    k1 = k1 * _MM_C1
+    k1 = _rotl32(k1, 15)
+    k1 = k1 * _MM_C2
+    h = h ^ k1
+    h = _rotl32(h, 13)
+    return h * _U32(5) + _MM_C3
+
+
+def _mm_fmix(h):
+    h ^= h >> _U32(16)
+    h = h * _U32(0x85EBCA6B)
+    h ^= h >> _U32(13)
+    h = h * _U32(0xC2B2AE35)
+    h ^= h >> _U32(16)
+    return h
+
+
+class _Murmur32:
+    """Vectorized Spark murmur3_32 element hashers over a (rows,) seed."""
+
+    htype = _U32
+    out_dtype = dtypes.INT32
+
+    @staticmethod
+    def seed_array(rows: int, seed: int) -> jnp.ndarray:
+        return jnp.full((rows,), np.uint32(seed & 0xFFFFFFFF), _U32)
+
+    @staticmethod
+    def finish(h: jnp.ndarray) -> jnp.ndarray:
+        return h.astype(_I32)
+
+    @staticmethod
+    def hash_blocks(h, blocks: Sequence[jnp.ndarray], nbytes: int):
+        for b in blocks:
+            h = _mm_update(h, b)
+        h = h ^ _U32(nbytes)
+        return _mm_fmix(h)
+
+    @staticmethod
+    def hash_varbytes(h0, chars: jnp.ndarray, lens: jnp.ndarray):
+        """Spark murmur over per-row byte strings.
+
+        chars: (rows, P) uint8 zero-padded; lens: (rows,) int32.
+        Full 4-byte blocks vector-scanned; Spark's nonstandard tail
+        (murmur_hash.cuh:72-93) sign-extends each trailing byte.
+        """
+        chars = _pad_chars(chars, 4)
+        blocks = _chars_to_u32_blocks(chars)          # (rows, nb)
+        nblocks = (lens // 4).astype(_I32)
+
+        def body(h, xs):
+            i, blk = xs
+            h2 = _mm_update(h, blk)
+            return jnp.where(i < nblocks, h2, h), None
+
+        nb = blocks.shape[1]
+        h, _ = lax.scan(body, h0,
+                        (jnp.arange(nb, dtype=_I32), blocks.T))
+        # tail: up to 3 sign-extended bytes
+        p = chars.shape[1]
+        for j in range(3):
+            idx = nblocks * 4 + j
+            byte = jnp.take_along_axis(
+                chars, jnp.clip(idx, 0, p - 1)[:, None], axis=1)[:, 0]
+            sbyte = byte.astype(jnp.int8).astype(_I32).astype(_U32)
+            h2 = _mm_update(h, sbyte)
+            h = jnp.where(idx < lens, h2, h)
+        h = h ^ lens.astype(_U32)
+        return _mm_fmix(h)
+
+
+# -------------------------------------------------------------- xxhash64
+
+_XXP1 = _U64(0x9E3779B185EBCA87)
+_XXP2 = _U64(0xC2B2AE3D27D4EB4F)
+_XXP3 = _U64(0x165667B19E3779F9)
+_XXP4 = _U64(0x85EBCA77C2B2AE63)
+_XXP5 = _U64(0x27D4EB2F165667C5)
+
+
+def _xx_round(v, k):
+    v = v + k * _XXP2
+    v = _rotl64(v, 31)
+    return v * _XXP1
+
+
+def _xx_merge(h, v):
+    v = v * _XXP2
+    v = _rotl64(v, 31)
+    v = v * _XXP1
+    h = h ^ v
+    return h * _XXP1 + _XXP4
+
+
+def _xx_update8(h, k64):
+    k1 = _xx_round(_U64(0), k64)
+    h = h ^ k1
+    return _rotl64(h, 27) * _XXP1 + _XXP4
+
+
+def _xx_update4(h, k32):
+    h = h ^ (k32.astype(_U64) * _XXP1)
+    return _rotl64(h, 23) * _XXP2 + _XXP3
+
+
+def _xx_update1(h, byte):
+    h = h ^ (byte.astype(_U64) * _XXP5)
+    return _rotl64(h, 11) * _XXP1
+
+
+def _xx_finalize(h):
+    h ^= h >> _U64(33)
+    h = h * _XXP2
+    h ^= h >> _U64(29)
+    h = h * _XXP3
+    h ^= h >> _U64(32)
+    return h
+
+
+class _XXHash64:
+    htype = _U64
+    out_dtype = dtypes.INT64
+
+    @staticmethod
+    def seed_array(rows: int, seed: int) -> jnp.ndarray:
+        return jnp.full((rows,), np.uint64(seed & 0xFFFFFFFFFFFFFFFF), _U64)
+
+    @staticmethod
+    def finish(h: jnp.ndarray) -> jnp.ndarray:
+        return h.astype(_I64)
+
+    @staticmethod
+    def hash_blocks(h, blocks: Sequence[jnp.ndarray], nbytes: int):
+        """Fixed-size (< 32 bytes here: 4, 8 or 16) element hash.
+        blocks are uint32 little-endian."""
+        assert nbytes < 32 and nbytes % 4 == 0
+        h = h + _XXP5
+        h = h + _U64(nbytes)
+        i = 0
+        rem = nbytes
+        while rem >= 8:
+            k64 = blocks[i].astype(_U64) | (blocks[i + 1].astype(_U64)
+                                            << _U64(32))
+            h = _xx_update8(h, k64)
+            i += 2
+            rem -= 8
+        if rem >= 4:
+            h = _xx_update4(h, blocks[i])
+            rem -= 4
+        return _xx_finalize(h)
+
+    @staticmethod
+    def hash_varbytes(h0, chars: jnp.ndarray, lens: jnp.ndarray):
+        """Spark xxhash64 over per-row byte strings (xxhash64.cu:113-183)."""
+        chars = _pad_chars(chars, 32)
+        rows, p = chars.shape
+        b32 = _chars_to_u32_blocks(chars)                       # (rows, p/4)
+        b64 = (b32[:, 0::2].astype(_U64)
+               | (b32[:, 1::2].astype(_U64) << _U64(32)))       # (rows, p/8)
+        lens64 = lens.astype(_U64)
+        nstripes = jnp.where(lens >= 32, lens // 32, 0).astype(_I32)
+
+        # 32-byte stripes: 4 pipelined lanes
+        v_init = jnp.stack([
+            jnp.broadcast_to(h0 + _XXP1 + _XXP2, h0.shape),
+            jnp.broadcast_to(h0 + _XXP2, h0.shape),
+            h0,
+            jnp.broadcast_to(h0 - _XXP1, h0.shape),
+        ])
+
+        n_stripe_steps = p // 32
+
+        def stripe_body(v, xs):
+            s, k4 = xs          # k4: (4, rows) uint64
+            active = s < nstripes
+            v_new = jnp.stack([_xx_round(v[l], k4[l]) for l in range(4)])
+            return jnp.where(active[None, :], v_new, v), None
+
+        stripes = b64.T.reshape(n_stripe_steps, 4, rows)
+        v, _ = lax.scan(stripe_body, v_init,
+                        (jnp.arange(n_stripe_steps, dtype=_I32), stripes))
+
+        merged = (_rotl64(v[0], 1) + _rotl64(v[1], 7) + _rotl64(v[2], 12)
+                  + _rotl64(v[3], 18))
+        for l in range(4):
+            merged = _xx_merge(merged, v[l])
+        h = jnp.where(nstripes > 0, merged, h0 + _XXP5)
+        h = h + lens64
+
+        # tail after the stripes: up to three 8-byte chunks
+        off8 = nstripes * 4  # stripe end in 8-byte block units
+        rem = lens - nstripes * 32
+        n8 = rem // 8
+        nb64 = b64.shape[1]
+        for t in range(3):
+            idx = off8 + t
+            k64 = jnp.take_along_axis(
+                b64, jnp.clip(idx, 0, nb64 - 1)[:, None], axis=1)[:, 0]
+            h = jnp.where(t < n8, _xx_update8(h, k64), h)
+        # one 4-byte chunk
+        off4 = (nstripes * 32 + n8 * 8) // 4
+        rem4 = rem - n8 * 8
+        nb32 = b32.shape[1]
+        k32 = jnp.take_along_axis(
+            b32, jnp.clip(off4, 0, nb32 - 1)[:, None], axis=1)[:, 0]
+        h = jnp.where(rem4 >= 4, _xx_update4(h, k32), h)
+        # up to 3 single bytes (zero-extended, unlike murmur)
+        offb = nstripes * 32 + n8 * 8 + jnp.where(rem4 >= 4, 4, 0)
+        for t in range(3):
+            idx = offb + t
+            byte = jnp.take_along_axis(
+                chars, jnp.clip(idx, 0, p - 1)[:, None], axis=1)[:, 0]
+            h = jnp.where(idx < lens, _xx_update1(h, byte), h)
+        return _xx_finalize(h)
+
+
+# ------------------------------------------------- element hash dispatch
+
+
+def _fixed_width_blocks(col: Column, algo) -> tuple:
+    """Return (blocks, nbytes) little-endian uint32 block decomposition of a
+    fixed-width column under Spark hashing rules."""
+    kind = col.dtype.kind
+    d = col.data
+    norm_f32 = (_normalize_nans_f32_bits if algo is _Murmur32
+                else _normalize_nans_zeros_f32_bits)
+    norm_f64 = (_normalize_nans_f64_bits if algo is _Murmur32
+                else _normalize_nans_zeros_f64_bits)
+    if kind in (Kind.BOOL8, Kind.INT8, Kind.UINT8, Kind.INT16, Kind.UINT16):
+        if kind == Kind.BOOL8:
+            w = d.astype(_U32)  # bool widens as 0/1
+        elif kind in (Kind.INT8, Kind.INT16):
+            w = d.astype(_I32).astype(_U32)  # sign-extend
+        else:
+            w = d.astype(_U32)
+        return [w], 4
+    if kind in (Kind.INT32, Kind.TIMESTAMP_DAYS):
+        return [d.astype(_I32).astype(_U32)], 4
+    if kind == Kind.UINT32:
+        return [d.astype(_U32)], 4
+    if kind == Kind.FLOAT32:
+        return [norm_f32(d)], 4
+    if kind in (Kind.INT64, Kind.TIMESTAMP_MICROS, Kind.UINT64):
+        lo, hi = _split_u64(d.astype(_I64).astype(_U64))
+        return [lo, hi], 8
+    if kind == Kind.FLOAT64:
+        lo, hi = _split_u64(norm_f64(d.astype(_U64)))  # d is raw bits
+        return [lo, hi], 8
+    if kind in (Kind.DECIMAL32, Kind.DECIMAL64):
+        # hashed as an 8-byte long of the unscaled value
+        lo, hi = _split_u64(d.astype(_I64).astype(_U64))
+        return [lo, hi], 8
+    raise NotImplementedError(f"hash of {kind}")
+
+
+def _hash_element_column(algo, h, col: Column,
+                         max_str_len: Optional[int]) -> jnp.ndarray:
+    """h' for every row: element hash seeded by h; null rows keep h."""
+    kind = col.dtype.kind
+    if kind == Kind.STRING:
+        pad = max_str_len if max_str_len is not None \
+            else max(1, col.max_string_length())
+        chars, lens = col.to_padded_chars(pad_to=max(pad, 1))
+        h2 = algo.hash_varbytes(h, chars, lens)
+    elif kind == Kind.DECIMAL128:
+        be, length = _dec128_min_be_bytes(col.data)
+        h2 = algo.hash_varbytes(h, be, length)
+    elif kind == Kind.STRUCT:
+        h2 = h
+        for child in col.children:
+            h2 = _hash_element_column(algo, h2, child, max_str_len)
+    elif kind == Kind.LIST:
+        return _hash_list_column(algo, h, col, max_str_len)
+    else:
+        blocks, nbytes = _fixed_width_blocks(col, algo)
+        h2 = algo.hash_blocks(h, blocks, nbytes)
+    if col.validity is not None:
+        h2 = jnp.where(col.validity.astype(jnp.bool_), h2, h)
+    return h2
+
+
+def _flatten_list_offsets(col: Column):
+    """Descend through nested LIST levels composing offsets; returns
+    (leaf_column, start_idx (rows,), count (rows,)) for each top row."""
+    assert col.dtype.kind == Kind.LIST
+    start = col.offsets[:-1]
+    end = col.offsets[1:]
+    cur = col.children[0]
+    while cur.dtype.kind == Kind.LIST:
+        if cur.children[0].dtype.kind == Kind.STRUCT:
+            raise ValueError(
+                "Cannot compute hash of a table with a LIST of STRUCT "
+                "columns.")
+        start = cur.offsets[start]
+        end = cur.offsets[end]
+        cur = cur.children[0]
+    if cur.dtype.kind == Kind.STRUCT:
+        raise ValueError(
+            "Cannot compute hash of a table with a LIST of STRUCT columns.")
+    return cur, start, (end - start).astype(_I32)
+
+
+def _hash_list_column(algo, h, col: Column, max_str_len: Optional[int]):
+    """Seed-chained fold over each row's (flattened) leaf elements.
+
+    Vectorized as a masked scan over element position: O(max_row_elems)
+    vector steps.  Null elements are skipped (seed passes through), matching
+    murmur_hash.cu:135-144.
+    """
+    leaf, start, count = _flatten_list_offsets(col)
+    rows = col.length
+    if rows == 0:
+        return h
+    max_count = int(np.asarray(count).max()) if not isinstance(
+        count, jax.core.Tracer) else None
+    if max_count is None:
+        raise ValueError(
+            "hashing LIST columns under jit requires concrete offsets; "
+            "call eagerly or provide padded representation")
+    if max_count == 0:
+        h2 = h
+    else:
+        leaf_valid = (leaf.validity.astype(jnp.bool_)
+                      if leaf.validity is not None else None)
+        is_string = leaf.dtype.is_string
+        if is_string:
+            pad = max_str_len if max_str_len is not None else max(
+                1, leaf.max_string_length())
+            leaf_chars_len = leaf.data.shape[0]
+        else:
+            blocks_all, nbytes = _fixed_width_blocks(leaf, algo)
+
+        h2 = h
+        nleaf = max(leaf.length, 1)
+        for j in range(max_count):
+            idx = jnp.clip(start + j, 0, nleaf - 1)
+            active = j < count
+            if leaf_valid is not None:
+                active = active & leaf_valid[idx]
+            if is_string:
+                s0 = leaf.offsets[idx]
+                lens = leaf.offsets[idx + 1] - s0
+                cidx = s0[:, None] + jnp.arange(max(pad, 1), dtype=_I32)
+                in_r = cidx < leaf.offsets[idx + 1][:, None]
+                cidx = jnp.clip(cidx, 0, max(leaf_chars_len - 1, 0))
+                chars = jnp.where(
+                    in_r,
+                    leaf.data[cidx] if leaf_chars_len else
+                    jnp.zeros_like(cidx, jnp.uint8),
+                    jnp.uint8(0))
+                hnew = algo.hash_varbytes(h2, chars, lens)
+            else:
+                blocks = [b[idx] for b in blocks_all]
+                hnew = algo.hash_blocks(h2, blocks, nbytes)
+            h2 = jnp.where(active, hnew, h2)
+    if col.validity is not None:
+        h2 = jnp.where(col.validity.astype(jnp.bool_), h2, h)
+    return h2
+
+
+def _run_row_hash(algo, table_or_cols, seed: int,
+                  max_str_len: Optional[int]) -> Column:
+    cols = _cols(table_or_cols)
+    if not cols:
+        raise ValueError("need at least one column to hash")
+    rows = cols[0].length
+    h = algo.seed_array(rows, seed)
+    for c in cols:
+        h = _hash_element_column(algo, h, c, max_str_len)
+    return Column(algo.out_dtype, rows, data=algo.finish(h))
+
+
+# ----------------------------------------------------------- public API
+
+
+def murmur3_32(table_or_cols, seed: int = 42,
+               max_str_len: Optional[int] = None) -> Column:
+    """Spark-exact murmur3_32 row hash (reference hash.hpp murmur_hash3_32,
+    Hash.java:44 murmurHash32). Returns a non-null INT32 column."""
+    return _run_row_hash(_Murmur32, table_or_cols, seed, max_str_len)
+
+
+def xxhash64(table_or_cols, seed: int = DEFAULT_XXHASH64_SEED,
+             max_str_len: Optional[int] = None) -> Column:
+    """Spark-exact xxhash64 row hash (reference hash.hpp xx_hash_64,
+    Hash.java xxhash64). Returns a non-null INT64 column."""
+    return _run_row_hash(_XXHash64, table_or_cols, seed, max_str_len)
+
+
+# ------------------------------------------------------------- hive hash
+
+_HIVE_FACTOR = _I32(31)
+
+
+def _hive_element(col: Column, max_str_len: Optional[int]) -> jnp.ndarray:
+    """(rows,) int32 hive hash of each element; nulls -> 0
+    (hive_hash.cu:42-152)."""
+    kind = col.dtype.kind
+    d = col.data
+    if kind == Kind.BOOL8:
+        hv = d.astype(_I32)
+    elif kind in (Kind.INT8, Kind.INT16, Kind.INT32, Kind.TIMESTAMP_DAYS):
+        hv = d.astype(_I32)
+    elif kind == Kind.INT64:
+        u = d.astype(_U64)
+        hv = ((u >> _U64(32)) ^ u).astype(_U32).astype(_I32)
+    elif kind == Kind.FLOAT32:
+        # Java floatToIntBits semantics: NaNs canonicalize (hive_hash.cu:114)
+        hv = _normalize_nans_f32_bits(d).astype(_I32)
+    elif kind == Kind.FLOAT64:
+        u = _normalize_nans_f64_bits(d.astype(_U64))  # d is raw bits
+        hv = ((u >> _U64(32)) ^ u).astype(_U32).astype(_I32)
+    elif kind == Kind.TIMESTAMP_MICROS:
+        v = d.astype(_I64)
+        ts = lax.div(v, _I64(1000000))          # C-style trunc division
+        tns = lax.rem(v, _I64(1000000)) * _I64(1000)
+        res = (ts << _I64(30)) | tns
+        u = res.astype(_U64)
+        hv = ((u >> _U64(32)) ^ u).astype(_U32).astype(_I32)
+    elif kind == Kind.STRING:
+        pad = max_str_len if max_str_len is not None else max(
+            1, col.max_string_length())
+        chars, lens = col.to_padded_chars(pad_to=max(pad, 1))
+        sb = chars.astype(jnp.int8).astype(_I32)
+
+        def body(hacc, xs):
+            i, byte = xs
+            h2 = hacc * _HIVE_FACTOR + byte
+            return jnp.where(i < lens, h2, hacc), None
+
+        p = chars.shape[1]
+        hv, _ = lax.scan(body, jnp.zeros((col.length,), _I32),
+                         (jnp.arange(p, dtype=_I32), sb.T))
+    elif kind == Kind.STRUCT:
+        hv = jnp.zeros((col.length,), _I32)
+        for child in col.children:
+            hv = hv * _HIVE_FACTOR + _hive_element(child, max_str_len)
+    elif kind == Kind.LIST:
+        # Hive hashes each direct element independently from HIVE_INIT_HASH
+        # and folds those hashes (hive_hash.cu col_stack_frame recursion) —
+        # nested lists/structs recurse, null elements contribute 0.
+        child = col.children[0]
+        start = col.offsets[:-1]
+        count = (col.offsets[1:] - start).astype(_I32)
+        if isinstance(count, jax.core.Tracer):
+            raise ValueError(
+                "hive_hash of LIST columns under jit requires concrete "
+                "offsets; call eagerly")
+        max_count = int(np.asarray(count).max()) if col.length else 0
+        child_h = (_hive_element(child, max_str_len) if child.length
+                   else jnp.zeros((1,), _I32))
+        nchild = max(child.length, 1)
+        hv = jnp.zeros((col.length,), _I32)
+        for j in range(max_count):
+            idx = jnp.clip(start + j, 0, nchild - 1)
+            h2 = hv * _HIVE_FACTOR + child_h[idx]
+            hv = jnp.where(j < count, h2, hv)
+    else:
+        raise NotImplementedError(f"hive hash of {kind}")
+    if col.validity is not None:
+        hv = jnp.where(col.validity.astype(jnp.bool_), hv, _I32(0))
+    return hv
+
+
+def hive_hash(table_or_cols, max_str_len: Optional[int] = None) -> Column:
+    """Hive-compatible row hash (reference hash.hpp hive_hash): row hash is
+    a 31-factor fold of element hashes, null elements contribute 0."""
+    cols = _cols(table_or_cols)
+    if not cols:
+        raise ValueError("need at least one column to hash")
+    rows = cols[0].length
+    h = jnp.zeros((rows,), _I32)
+    for c in cols:
+        h = h * _HIVE_FACTOR + _hive_element(c, max_str_len)
+    return Column(dtypes.INT32, rows, data=h)

@@ -1,0 +1,81 @@
+"""SHA-2 family hashes with null preservation + host CRC32.
+
+Reference: src/main/cpp/src/hash/sha.cpp (sha224/256/384/512_nulls_preserved
+— hex-digest string output, input nulls preserved as nulls) and
+HashJni.cpp:134-157 (hostCrc32 — zlib crc32 over a host buffer, used for
+shuffle block checksums).
+
+TPU note: SHA is a bit-serial algorithm with no vector parallelism per
+message; per-row messages are independent, so a Pallas lane-per-row SHA-256
+is feasible but low-value (Spark uses sha for checksumming, not joins).
+This implementation computes digests on host via hashlib — the same
+host-path decision the reference makes for CRC32.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import zlib
+from typing import Optional, Union
+
+import numpy as np
+
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.columns.dtypes import Kind
+
+
+def _row_bytes(col: Column):
+    """Yield per-row byte strings (None for null rows)."""
+    mask = (np.ones(col.length, bool) if col.validity is None
+            else np.asarray(col.validity).astype(bool)[: col.length])
+    if col.dtype.is_string:
+        chars = np.asarray(col.data).tobytes() if col.data is not None else b""
+        offs = np.asarray(col.offsets)
+        for i in range(col.length):
+            yield chars[offs[i]: offs[i + 1]] if mask[i] else None
+    elif col.dtype.is_fixed_width:
+        host = np.asarray(col.data)
+        for i in range(col.length):
+            yield host[i].tobytes() if mask[i] else None
+    else:
+        raise NotImplementedError(f"sha of {col.dtype.kind}")
+
+
+def _sha_impl(algo_name: str, col: Column) -> Column:
+    out = []
+    for b in _row_bytes(col):
+        out.append(None if b is None
+                   else hashlib.new(algo_name, b).hexdigest())
+    return Column.from_strings(out)
+
+
+def sha224_nulls_preserved(col: Column) -> Column:
+    return _sha_impl("sha224", col)
+
+
+def sha256_nulls_preserved(col: Column) -> Column:
+    return _sha_impl("sha256", col)
+
+
+def sha384_nulls_preserved(col: Column) -> Column:
+    return _sha_impl("sha384", col)
+
+
+def sha512_nulls_preserved(col: Column) -> Column:
+    return _sha_impl("sha512", col)
+
+
+def host_crc32(crc: int, buffer: Optional[Union[bytes, np.ndarray]],
+               length: Optional[int] = None) -> int:
+    """zlib CRC32 over a host buffer (reference Hash.hostCrc32).  `buffer`
+    may be None only when length is 0."""
+    if buffer is None:
+        if length not in (0, None):
+            raise ValueError("len is not zero for empty buffer")
+        return crc & 0xFFFFFFFF
+    # raw buffer bytes, like the reference's unsigned char* + len
+    data = buffer.tobytes() if isinstance(buffer, np.ndarray) else \
+        bytes(buffer)
+    if length is not None:
+        data = data[:length]
+    return zlib.crc32(data, crc) & 0xFFFFFFFF

@@ -1,0 +1,110 @@
+"""Float64 handling on a backend with no native f64.
+
+TPUs lower 64-bit floats by demoting to f32 (observed on this backend:
+1e300 -> inf under jit) and cannot lower f64<->u64 bitcasts at all.  Spark
+DOUBLE semantics need exact IEEE754 bit behavior, so FLOAT64 Columns store
+raw bits in uint64 lanes (columns/column.py) and ops choose explicitly:
+
+  * bit-exact paths (hash, comparisons via total-order transform, casts,
+    min/max, sort keys) — pure integer ops on the bits; exact everywhere.
+  * arithmetic paths (sum/avg/mul) — decode to the best available float
+    compute.  On CPU that's true f64; on TPU it's f32 (documented precision
+    loss) until a double-double Pallas path lands.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_U64 = jnp.uint64
+_I64 = jnp.int64
+
+F64_SIGN = 0x8000000000000000
+F64_EXP_MASK = 0x7FF0000000000000
+F64_FRAC_MASK = 0x000FFFFFFFFFFFFF
+F64_QNAN = 0x7FF8000000000000
+F64_INF = 0x7FF0000000000000
+
+
+def is_nan_bits(bits: jnp.ndarray) -> jnp.ndarray:
+    return (bits & _U64(0x7FFFFFFFFFFFFFFF)) > _U64(F64_INF)
+
+
+def is_inf_bits(bits: jnp.ndarray) -> jnp.ndarray:
+    return (bits & _U64(0x7FFFFFFFFFFFFFFF)) == _U64(F64_INF)
+
+
+def is_neg_bits(bits: jnp.ndarray) -> jnp.ndarray:
+    return (bits >> _U64(63)) != _U64(0)
+
+
+def total_order_key(bits: jnp.ndarray) -> jnp.ndarray:
+    """Monotone int64 key: orders like IEEE754 totalOrder (negatives
+    reversed).  NaNs sort above +inf (Spark sort semantics for NaN-last is
+    layered on top by callers)."""
+    b = bits.astype(_U64)
+    flipped = jnp.where(is_neg_bits(b),
+                        ~b, b | _U64(F64_SIGN))
+    return flipped.astype(_I64) + jnp.int64(-2**63)
+
+
+def bits_to_f64_compute(bits: jnp.ndarray) -> jnp.ndarray:
+    """Decode raw bits to a float array for arithmetic.
+
+    On backends with real f64 (CPU) this is an exact bitcast.  On TPU it
+    decodes mantissa/exponent arithmetically into whatever f64 lowering the
+    backend has (effectively f32 precision) — callers that need exactness
+    must use a bit-path instead.
+    """
+    if jax.default_backend() == "cpu":
+        return lax.bitcast_convert_type(bits.astype(_U64), jnp.float64)
+    b = bits.astype(_U64)
+    sign = jnp.where(is_neg_bits(b), -1.0, 1.0)
+    exp = ((b & _U64(F64_EXP_MASK)) >> _U64(52)).astype(jnp.int32)
+    frac = (b & _U64(F64_FRAC_MASK)).astype(jnp.float64)
+    normal_m = 1.0 + frac * (2.0 ** -52)
+    subnormal_m = frac * (2.0 ** -52)
+    m = jnp.where(exp == 0, subnormal_m, normal_m)
+    e = jnp.where(exp == 0, -1022, exp - 1023)
+    val = sign * m * jnp.exp2(e.astype(jnp.float64))
+    val = jnp.where(is_inf_bits(b), sign * jnp.inf, val)
+    val = jnp.where(is_nan_bits(b), jnp.nan, val)
+    return val
+
+
+def f64_compute_to_bits(x: jnp.ndarray,
+                        force_f32_path: bool = False) -> jnp.ndarray:
+    """Inverse of bits_to_f64_compute for storing results.  Exact on CPU;
+    on TPU routes through the f32-precision encoder."""
+    if jax.default_backend() == "cpu" and not force_f32_path:
+        return lax.bitcast_convert_type(x.astype(jnp.float64), _U64)
+    # Encode via f32: bitcast f32->u32 is supported on TPU.
+    f32 = x.astype(jnp.float32)
+    u32 = lax.bitcast_convert_type(f32, jnp.uint32).astype(_U64)
+    sign = (u32 >> _U64(31)) & _U64(1)
+    exp32 = (u32 >> _U64(23)) & _U64(0xFF)
+    frac32 = u32 & _U64(0x7FFFFF)
+    # remap f32 fields into f64 fields
+    is_nan = exp32 == _U64(0xFF)
+    is_zero = (u32 & _U64(0x7FFFFFFF)) == _U64(0)
+    exp64 = jnp.where(exp32 == _U64(0xFF), _U64(0x7FF),
+                      exp32 - _U64(127) + _U64(1023))
+    frac64 = frac32 << _U64(29)
+    # f32 subnormals (exp32==0, frac!=0) have no implicit leading 1: the
+    # value is frac32 * 2^-149, always normalizable in f64.  Normalize by
+    # converting the integer frac32 through f32 (exact to 2^23) and reading
+    # its exponent/mantissa fields.
+    zf = frac32.astype(jnp.float32)
+    zu = lax.bitcast_convert_type(zf, jnp.uint32).astype(_U64)
+    sub_exp64 = ((zu >> _U64(23)) & _U64(0xFF)) - _U64(127) - _U64(149) \
+        + _U64(1023)
+    sub_frac64 = (zu & _U64(0x7FFFFF)) << _U64(29)
+    is_subnormal = (exp32 == _U64(0)) & (frac32 != _U64(0))
+    exp64 = jnp.where(is_subnormal, sub_exp64, exp64)
+    frac64 = jnp.where(is_subnormal, sub_frac64, frac64)
+    bits = (sign << _U64(63)) | (exp64 << _U64(52)) | frac64
+    bits = jnp.where(is_zero, sign << _U64(63), bits)
+    bits = jnp.where(is_nan & (frac32 != 0), _U64(F64_QNAN), bits)
+    return bits

@@ -1,0 +1,71 @@
+"""utils/floats bit-path tests + hash review-fix regressions."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.ops import hash as H
+from spark_rapids_tpu.utils import floats
+
+
+def test_bits_roundtrip_cpu():
+    vals = np.array([0.0, -0.0, 1.5, -1e300, 2.2250738585072014e-308,
+                     float("inf"), float("-inf"), float("nan")], np.float64)
+    bits = jnp.asarray(vals.view(np.uint64))
+    dec = np.asarray(floats.bits_to_f64_compute(bits))
+    np.testing.assert_array_equal(dec.view(np.uint64)[:-1],
+                                  vals.view(np.uint64)[:-1])
+    assert np.isnan(dec[-1])
+
+
+def test_f32_encode_path_subnormals():
+    """The TPU f32->f64-bits encoder must scale f32 subnormals correctly
+    (code-review regression)."""
+    vals = np.array([1e-40, -3e-42, 1e-38, 1.5, 0.0, -0.0], np.float32)
+    got = np.asarray(floats.f64_compute_to_bits(
+        jnp.asarray(vals), force_f32_path=True))
+    expected = vals.astype(np.float64).view(np.uint64)
+    np.testing.assert_array_equal(got, expected)
+
+
+def test_total_order_key():
+    vals = np.array([float("-inf"), -1.0, -0.0, 0.0, 1.0, float("inf"),
+                     float("nan")], np.float64)
+    keys = np.asarray(floats.total_order_key(jnp.asarray(
+        vals.view(np.uint64))))
+    assert list(keys) == sorted(keys)
+
+
+def test_hive_nested_list_semantics():
+    """hive_hash of [[1],[2,3]] = 31*hash([1]) + hash([2,3]) = 96, NOT the
+    flat fold (code-review regression vs hive_hash.cu recursion)."""
+    inner = Column.make_list(np.array([0, 1, 3]),
+                             Column.from_pylist([1, 2, 3], dtypes.INT32))
+    outer = Column.make_list(np.array([0, 2]), inner)
+    assert H.hive_hash([outer]).to_pylist() == [96]
+
+
+def test_hive_list_of_struct_supported():
+    """Reference hive_hash supports LIST<STRUCT> (unlike murmur/xxhash)."""
+    st = Column.make_struct(2, [Column.from_pylist([5, 7], dtypes.INT32)])
+    lst = Column.make_list(np.array([0, 2]), st)
+    # hash(struct{5}) = 31*0+5 = 5; hash(struct{7}) = 7; fold: 31*5+7 = 162
+    assert H.hive_hash([lst]).to_pylist() == [162]
+
+
+def test_hive_null_inner_list_contributes_zero():
+    inner = Column.make_list(np.array([0, 1, 1]),
+                             Column.from_pylist([1], dtypes.INT32),
+                             validity=np.array([1, 0]))
+    outer = Column.make_list(np.array([0, 2]), inner)
+    # 31*hash([1]) + 0 = 31
+    assert H.hive_hash([outer]).to_pylist() == [31]
+
+
+def test_crc32_int32_buffer_raw_bytes():
+    import zlib
+    from spark_rapids_tpu.ops import sha
+    arr = np.array([256], np.int32)
+    assert sha.host_crc32(0, arr) == zlib.crc32(arr.tobytes())
+    assert sha.host_crc32(0, arr, 2) == zlib.crc32(arr.tobytes()[:2])

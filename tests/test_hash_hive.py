@@ -1,0 +1,104 @@
+"""hive_hash tests against golden values (reference HashTest.java
+testHiveHash*)."""
+
+import numpy as np
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.ops import hash as H
+
+
+def bits_f(b):
+    return np.frombuffer(np.uint32(b).tobytes(), np.float32)[0]
+
+
+def bits_d(b):
+    return np.frombuffer(np.uint64(b).tobytes(), np.float64)[0]
+
+
+def test_hive_bools_ints_bytes_longs():
+    v = Column.from_pylist([True, False, None], dtypes.BOOL8)
+    assert H.hive_hash([v]).to_pylist() == [1, 0, 0]
+    v = Column.from_pylist([-(2**31), 2**31 - 1, -1, 1, -10, 10, None],
+                           dtypes.INT32)
+    assert H.hive_hash([v]).to_pylist() == [
+        -(2**31), 2**31 - 1, -1, 1, -10, 10, 0]
+    v = Column.from_pylist([-128, 127, -1, 1, -10, 10, None], dtypes.INT8)
+    assert H.hive_hash([v]).to_pylist() == [-128, 127, -1, 1, -10, 10, 0]
+    v = Column.from_pylist([-(2**63), 2**63 - 1, -1, 1, -10, 10, None],
+                           dtypes.INT64)
+    assert H.hive_hash([v]).to_pylist() == [
+        -(2**31), -(2**31), 0, 1, 9, 10, 0]
+
+
+def test_hive_strings():
+    v = Column.from_strings([
+        "a", "B\n", "dE\"Ā\tā 휠휡".encode("utf-8", "surrogatepass"), None,
+        ("This is a long string (greater than 128 bytes/char string) case "
+         "to test this hash function. Just want an abnormal case here to "
+         "see if any error may happen whendoing the hive hashing")])
+    assert H.hive_hash([v]).to_pylist() == [97, 2056, 745239896, 0,
+                                            2112075710]
+
+
+def test_hive_floats_doubles():
+    v = Column.from_pylist([
+        0.0, 100.0, -100.0, bits_f(0x00800000), bits_f(0x7F7FFFFF), None,
+        bits_f(0x00000001), bits_f(0x7F800001), bits_f(0x7FFFFFFF),
+        bits_f(0xFF800001), bits_f(0xFFFFFFFF), float("inf"),
+        float("-inf")], dtypes.FLOAT32)
+    assert H.hive_hash([v]).to_pylist() == [
+        0, 1120403456, -1027080192, 8388608, 2139095039, 0, 1, 2143289344,
+        2143289344, 2143289344, 2143289344, 2139095040, -8388608]
+    v = Column.from_pylist(
+        [0.0, 100.0, -100.0, bits_d(0x7FF0000000000001),
+         bits_d(0x7FFFFFFFFFFFFFFF), None], dtypes.FLOAT64)
+    assert H.hive_hash([v]).to_pylist() == [
+        0, 1079574528, -1067909120, 2146959360, 2146959360, 0]
+
+
+def test_hive_dates_timestamps():
+    v = Column.from_pylist([0, None, 100, -100, 0x12345678, None,
+                            -0x12345678], dtypes.TIMESTAMP_DAYS)
+    assert H.hive_hash([v]).to_pylist() == [
+        0, 0, 100, -100, 0x12345678, 0, -0x12345678]
+    v = Column.from_pylist([0, None, 100, -100, 0x123456789ABCDEF, None,
+                            -0x123456789ABCDEF], dtypes.TIMESTAMP_MICROS)
+    assert H.hive_hash([v]).to_pylist() == [
+        0, 0, 100000, 99999, -660040456, 0, 486894999]
+
+
+def test_hive_mixed():
+    strings = Column.from_strings([
+        "a", "B\n", "dE\"Ā\tā 휠휡".encode("utf-8", "surrogatepass"),
+        ("This is a long string (greater than 128 bytes/char string) case "
+         "to test this hash function. Just want an abnormal case here to "
+         "see if any error may happen whendoing the hive hashing"),
+        None, None])
+    integers = Column.from_pylist([0, 100, -100, -(2**31), 2**31 - 1, None],
+                                  dtypes.INT32)
+    doubles = Column.from_pylist(
+        [0.0, 100.0, -100.0, bits_d(0x7FF0000000000001),
+         bits_d(0x7FFFFFFFFFFFFFFF), None], dtypes.FLOAT64)
+    floats = Column.from_pylist(
+        [0.0, 100.0, -100.0, bits_f(0xFF800001), bits_f(0xFFFFFFFF), None],
+        dtypes.FLOAT32)
+    bools = Column.from_pylist([True, False, None, False, True, None],
+                               dtypes.BOOL8)
+    assert H.hive_hash([strings, integers, doubles, floats, bools]
+                       ).to_pylist() == [
+        89581538, 363542820, 413439036, 1272817854, 1513589666, 0]
+
+
+def test_sha_and_crc32():
+    import hashlib
+    import zlib
+    from spark_rapids_tpu.ops import sha
+    v = Column.from_strings(["abc", None, ""])
+    out = sha.sha256_nulls_preserved(v).to_pylist()
+    assert out == [hashlib.sha256(b"abc").hexdigest(), None,
+                   hashlib.sha256(b"").hexdigest()]
+    out512 = sha.sha512_nulls_preserved(v).to_pylist()
+    assert out512[0] == hashlib.sha512(b"abc").hexdigest()
+    assert sha.host_crc32(0, b"hello") == zlib.crc32(b"hello")
+    assert sha.host_crc32(0, None, 0) == 0

@@ -1,0 +1,149 @@
+"""xxhash64 tests against Spark-derived golden values (reference
+HashTest.java testXXHash64*)."""
+
+import numpy as np
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.ops import hash as H
+
+SEED = H.DEFAULT_XXHASH64_SEED
+
+
+def bits_f(b):
+    return np.frombuffer(np.uint32(b).tobytes(), np.float32)[0]
+
+
+def bits_d(b):
+    return np.frombuffer(np.uint64(b).tobytes(), np.float64)[0]
+
+
+def test_xx_strings():
+    v0 = Column.from_strings([
+        "a", "B\nc", "dE\"Ā\tā 휠휡\\Fg2'".encode("utf-8", "surrogatepass"),
+        ("A very long (greater than 128 bytes/char string) to test a multi"
+         " hash-step data point in the MD5 hash function. This string "
+         "needed to be longer.A 60 character string to test MD5's message "
+         "padding algorithm"),
+        "hiJ휠휡휠휡".encode("utf-8", "surrogatepass"), None])
+    out = H.xxhash64([v0]).to_pylist()
+    assert out == [-8582455328737087284, 2221214721321197934,
+                   5798966295358745941, -4834097201550955483,
+                   -3782648123388245694, SEED]
+
+
+def test_xx_ints_two_cols():
+    v0 = Column.from_pylist([0, 100, None, None, -(2**31), None],
+                            dtypes.INT32)
+    v1 = Column.from_pylist([0, None, -100, None, None, 2**31 - 1],
+                            dtypes.INT32)
+    out = H.xxhash64([v0, v1]).to_pylist()
+    assert out == [1151812168208346021, -7987742665087449293,
+                   8990748234399402673, SEED, 2073849959933241805,
+                   1508894993788531228]
+
+
+def test_xx_doubles():
+    v = Column.from_pylist([
+        0.0, None, 100.0, -100.0, 2.2250738585072014e-308,
+        1.7976931348623157e308,
+        bits_d(0x7FFFFFFFFFFFFFFF), bits_d(0x7FF0000000000001),
+        bits_d(0xFFFFFFFFFFFFFFFF), bits_d(0xFFF0000000000001),
+        float("inf"), float("-inf")], dtypes.FLOAT64)
+    out = H.xxhash64([v]).to_pylist()
+    assert out == [-5252525462095825812, SEED, -7996023612001835843,
+                   5695175288042369293, 6181148431538304986,
+                   -4222314252576420879, -3127944061524951246,
+                   -3127944061524951246, -3127944061524951246,
+                   -3127944061524951246, 5810986238603807492,
+                   5326262080505358431]
+
+
+def test_xx_timestamps_and_decimals():
+    v = Column.from_pylist([0, None, 100, -100, 0x123456789ABCDEF, None,
+                            -0x123456789ABCDEF], dtypes.TIMESTAMP_MICROS)
+    assert H.xxhash64([v]).to_pylist() == [
+        -5252525462095825812, SEED, 8713583529807266080,
+        5675770457807661948, 1941233597257011502, SEED,
+        -1318946533059658749]
+    d64 = Column.from_pylist([0, 100, -100, 0x123456789ABCDEF,
+                              -0x123456789ABCDEF], dtypes.decimal64(-7))
+    assert H.xxhash64([d64]).to_pylist() == [
+        -5252525462095825812, 8713583529807266080, 5675770457807661948,
+        1941233597257011502, -1318946533059658749]
+    d32 = Column.from_pylist([0, 100, -100, 0x12345678, -0x12345678],
+                             dtypes.decimal32(-3))
+    assert H.xxhash64([d32]).to_pylist() == [
+        -5252525462095825812, 8713583529807266080, 5675770457807661948,
+        -7728554078125612835, 3142315292375031143]
+
+
+def test_xx_dates():
+    v = Column.from_pylist([0, None, 100, -100, 0x12345678, None,
+                            -0x12345678], dtypes.TIMESTAMP_DAYS)
+    assert H.xxhash64([v]).to_pylist() == [
+        3614696996920510707, SEED, -7987742665087449293,
+        8990748234399402673, 6954428822481665164, SEED,
+        -4294222333805341278]
+
+
+def test_xx_floats():
+    v = Column.from_pylist([
+        0.0, 100.0, -100.0, bits_f(0x00800000), bits_f(0x7F7FFFFF), None,
+        bits_f(0x7F800001), bits_f(0x7FFFFFFF), bits_f(0xFF800001),
+        bits_f(0xFFFFFFFF), float("inf"), float("-inf")], dtypes.FLOAT32)
+    assert H.xxhash64([v]).to_pylist() == [
+        3614696996920510707, -8232251799677946044, -6625719127870404449,
+        -6699704595004115126, -1065250890878313112, SEED,
+        2692338816207849720, 2692338816207849720, 2692338816207849720,
+        2692338816207849720, -5940311692336719973, -7580553461823983095]
+
+
+def test_xx_bools():
+    v0 = Column.from_pylist([None, True, False, True, None, False],
+                            dtypes.BOOL8)
+    v1 = Column.from_pylist([None, True, False, None, False, True],
+                            dtypes.BOOL8)
+    assert H.xxhash64([v0, v1]).to_pylist() == [
+        SEED, 9083826852238114423, 1151812168208346021,
+        -6698625589789238999, 3614696996920510707, 7945966957015589024]
+
+
+def test_xx_mixed():
+    strings = Column.from_strings([
+        "a", "B\n", "dE\"Ā\tā 휠휡".encode("utf-8", "surrogatepass"),
+        ("A very long (greater than 128 bytes/char string) to test a multi"
+         " hash-step data point in the MD5 hash function. This string "
+         "needed to be longer."), None, None])
+    integers = Column.from_pylist([0, 100, -100, -(2**31), 2**31 - 1, None],
+                                  dtypes.INT32)
+    doubles = Column.from_pylist(
+        [0.0, 100.0, -100.0, bits_d(0x7FF0000000000001),
+         bits_d(0x7FFFFFFFFFFFFFFF), None], dtypes.FLOAT64)
+    floats = Column.from_pylist(
+        [0.0, 100.0, -100.0, bits_f(0xFF800001), bits_f(0xFFFFFFFF), None],
+        dtypes.FLOAT32)
+    bools = Column.from_pylist([True, False, None, False, True, None],
+                               dtypes.BOOL8)
+    assert H.xxhash64([strings, integers, doubles, floats, bools]
+                      ).to_pylist() == [
+        7451748878409563026, 6024043102550151964, 3380664624738534402,
+        8444697026100086329, -5888679192448042852, SEED]
+    st = Column.make_struct(6, [strings, integers, doubles, floats, bools])
+    assert H.xxhash64([st]).to_pylist() == [
+        7451748878409563026, 6024043102550151964, 3380664624738534402,
+        8444697026100086329, -5888679192448042852, SEED]
+
+
+def test_xx_string_lists():
+    """testXXHash64StringLists: [a], [B\\n, c], [dE\\"Ā, \\tā 휠휡], ..."""
+    strings = Column.from_strings(
+        ["a", "B\n", "c", "dE\"Ā", "\tā 휠휡".encode(
+            "utf-8", "surrogatepass"), None])
+    lst = Column.make_list(np.array([0, 1, 3, 5, 6, 6]), strings,
+                           validity=np.array([1, 1, 1, 1, 0]))
+    out = H.xxhash64([lst]).to_pylist()
+    # golden from testXXHash64StringLists rows: single-string rows hash like
+    # the string; null list -> seed
+    assert out[0] == -8582455328737087284
+    assert out[4] == SEED
