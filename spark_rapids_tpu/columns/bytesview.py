@@ -1,0 +1,56 @@
+"""Packed byte buffers: device byte semantics over uint32 word storage.
+
+TPU tiling makes narrow uint8 shapes catastrophically expensive: a
+bitcast_convert_type(u32) -> u8[N,4] output is laid out with the 4-wide
+minor dim padded to 128 lanes (observed: 32x HBM expansion, OOM at 512MB
+logical).  So big byte buffers (JCUDF rows, kudo blobs) are carried as
+uint32 words in little-endian byte order, and byte-level access happens
+through shifts — identical memory image to the u8 buffer when viewed on
+host (np .view(np.uint8)).
+
+Convention: a Column of dtype UINT8 whose `.data.dtype` is uint32 is a
+"packed" byte column — `length` is the logical byte count and `data` has
+ceil(length/4) words (tail bytes zero).  Helpers here are the only code
+that needs to know.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+_U8 = jnp.uint8
+_U32 = jnp.uint32
+_I32 = jnp.int32
+
+
+def is_packed(data) -> bool:
+    return data is not None and data.dtype == jnp.uint32
+
+
+def byte_gather(data: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """data[idx] for byte index arrays, whether data is u8 or packed u32.
+    Out-of-range indices must be pre-clipped by the caller."""
+    if not is_packed(data):
+        return data[idx]
+    w = data[idx // 4]
+    return ((w >> ((idx % 4) * 8).astype(_U32)) & _U32(0xFF)).astype(_U8)
+
+
+def to_host_bytes(data, nbytes: int) -> bytes:
+    """Materialize the logical byte string on host."""
+    if data is None:
+        return b""
+    host = np.asarray(data)
+    if host.dtype == np.uint32:
+        return host.view("<u4").astype("<u4").tobytes()[:nbytes]
+    return host.tobytes()[:nbytes]
+
+
+def pack_u8_array(host: np.ndarray) -> np.ndarray:
+    """Host uint8 array -> host uint32 LE words (zero-padded tail)."""
+    n = host.shape[0]
+    pad = (-n) % 4
+    if pad:
+        host = np.concatenate([host, np.zeros(pad, np.uint8)])
+    return host.view("<u4").copy()

@@ -1,0 +1,139 @@
+"""JCUDF row conversion tests (reference analog:
+src/main/cpp/tests/row_conversion.cpp + RowConversion.java layout spec)."""
+
+import numpy as np
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.columns.table import Table
+from spark_rapids_tpu.ops import row_conversion as RC
+
+
+def test_layout_javadoc_example():
+    """| A BOOL8 | B INT16 | C INT32 | -> A at 0, B at 2, C at 4, V at 8,
+    row 16 bytes (RowConversion.java:79-91)."""
+    starts, voff, fixed = RC.compute_layout(
+        [dtypes.BOOL8, dtypes.INT16, dtypes.INT32])
+    assert starts == [0, 2, 4]
+    assert voff == 8
+    assert fixed == 9
+    # reordered C, B, A packs to an 8-byte row (javadoc example)
+    starts2, voff2, fixed2 = RC.compute_layout(
+        [dtypes.INT32, dtypes.INT16, dtypes.BOOL8])
+    assert starts2 == [0, 4, 6]
+    assert voff2 == 7 and fixed2 == 8
+
+
+def test_fixed_width_bytes_exact():
+    t = Table([
+        Column.from_pylist([1, -2], dtypes.INT32),
+        Column.from_pylist([True, None], dtypes.BOOL8),
+    ])
+    out = RC.convert_to_rows(t)
+    rows = out.to_pylist()
+    # layout: INT32 at 0..4, BOOL8 at 4, validity byte at 5, row = 8
+    r0 = bytes(rows[0])
+    assert r0[0:4] == (1).to_bytes(4, "little")
+    assert r0[4] == 1
+    assert r0[5] == 0b11  # both valid
+    r1 = bytes(rows[1])
+    assert r1[0:4] == (-2).to_bytes(4, "little", signed=True)
+    assert r1[5] == 0b01  # bool null
+    assert len(r0) == 8
+
+
+def test_roundtrip_fixed():
+    rng = np.random.default_rng(42)
+    n = 257
+    cols = [
+        Column.from_numpy(rng.integers(-2**62, 2**62, n, dtype=np.int64)),
+        Column.from_numpy(rng.integers(-2**30, 2**30, n).astype(np.int32),
+                          validity=rng.integers(0, 2, n)),
+        Column.from_numpy(rng.normal(size=n).astype(np.float32)),
+        Column.from_numpy(rng.normal(size=n).astype(np.float64)),
+        Column.from_numpy(rng.integers(0, 2, n).astype(np.uint8),
+                          dtype=dtypes.BOOL8),
+        Column.from_numpy(rng.integers(-128, 127, n).astype(np.int8),
+                          validity=rng.integers(0, 2, n)),
+        Column.from_numpy(rng.integers(-2**14, 2**14, n).astype(np.int16)),
+    ]
+    t = Table(cols)
+    rows_col = RC.convert_to_rows(t)
+    back = RC.convert_from_rows(rows_col, [c.dtype for c in cols])
+    for orig, got in zip(t.columns, back.columns):
+        assert orig.to_pylist() == got.to_pylist()
+
+
+def test_roundtrip_decimal128():
+    vals = [10**30, -10**30, 0, None, 12345678901234567890]
+    c = Column.from_pylist(vals, dtypes.decimal128(-2))
+    rows_col = RC.convert_to_rows(Table([c]))
+    back = RC.convert_from_rows(rows_col, [c.dtype])
+    got = back.columns[0]
+    limbs = np.asarray(got.data).astype(np.uint32).astype(object)
+    mask = np.asarray(got.validity).astype(bool)
+    recon = []
+    for i in range(5):
+        u = sum(int(limbs[i, j]) << (32 * j) for j in range(4))
+        if u >= 1 << 127:
+            u -= 1 << 128
+        recon.append(u if mask[i] else None)
+    assert recon == vals
+
+
+def test_roundtrip_strings():
+    s = Column.from_strings(["hello", "", None, "wörld", "a" * 100])
+    i = Column.from_pylist([1, 2, None, 4, 5], dtypes.INT32)
+    t = Table([s, i])
+    rows_col = RC.convert_to_rows(t)
+    # row sizes are 8-aligned and include payload
+    sizes = np.diff(np.asarray(rows_col.offsets))
+    assert all(sz % 8 == 0 for sz in sizes)
+    back = RC.convert_from_rows(rows_col, [dtypes.STRING, dtypes.INT32])
+    assert back.columns[1].to_pylist() == [1, 2, None, 4, 5]
+    got = back.columns[0].to_pylist()
+    # null string round-trips as null (empty payload)
+    assert got[0] == "hello" and got[1] == "" and got[2] is None
+    assert got[3] == "wörld" and got[4] == "a" * 100
+
+
+def test_string_offset_length_pairs():
+    """Fixed section stores (offset-in-row, length) u32 pairs starting at
+    the first byte after validity (row_conversion.cu:868-881)."""
+    s = Column.from_strings(["abcd"])
+    t = Table([s])
+    rows_col = RC.convert_to_rows(t)
+    r0 = bytes(rows_col.to_pylist()[0])
+    # layout: pair at 0..8, validity at 8, fixed=9, payload at 9
+    off = int.from_bytes(r0[0:4], "little")
+    ln = int.from_bytes(r0[4:8], "little")
+    assert ln == 4
+    assert r0[off:off + 4] == b"abcd"
+    assert off == 9
+
+
+def test_validity_many_columns():
+    cols = [Column.from_pylist([i % 3 != 0], dtypes.INT8) for i in range(20)]
+    for i, c in enumerate(cols):
+        if i % 5 == 0:
+            cols[i] = Column.from_pylist([None], dtypes.INT8)
+    t = Table(cols)
+    rows_col = RC.convert_to_rows(t)
+    back = RC.convert_from_rows(rows_col, [c.dtype for c in cols])
+    for i in range(20):
+        assert back.columns[i].to_pylist() == cols[i].to_pylist(), i
+
+
+def test_uint64_roundtrip():
+    c = Column.from_numpy(np.array([2**63 + 5, 3], np.uint64))
+    rows_col = RC.convert_to_rows(Table([c]))
+    back = RC.convert_from_rows(rows_col, [dtypes.UINT64])
+    assert back.columns[0].to_pylist() == [2**63 + 5, 3]
+
+
+def test_packed_parts_requires_nbytes():
+    import jax.numpy as jnp
+    import pytest
+    with pytest.raises(ValueError, match="nbytes"):
+        Column.make_list_from_parts(jnp.array([0, 4], jnp.int32),
+                                    jnp.zeros(1, jnp.uint32))
