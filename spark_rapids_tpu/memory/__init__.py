@@ -1,0 +1,11 @@
+"""Memory runtime: HBM reservation resources, the RmmSpark OOM retry/split
+state machine, and host-spill table movement (reference SURVEY.md §2.1)."""
+
+from spark_rapids_tpu.memory.exceptions import (  # noqa: F401
+    GpuRetryOOM, GpuSplitAndRetryOOM, CpuRetryOOM, CpuSplitAndRetryOOM,
+    GpuOOM, OffHeapOOM, CudfException, ThreadRemovedException)
+from spark_rapids_tpu.memory.resource import (  # noqa: F401
+    MemoryResource, LimitingMemoryResource, AllocationFailed)
+from spark_rapids_tpu.memory.spark_resource_adaptor import (  # noqa: F401
+    SparkResourceAdaptor)
+from spark_rapids_tpu.memory.host_table import HostTable  # noqa: F401

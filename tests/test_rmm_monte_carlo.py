@@ -1,0 +1,127 @@
+"""Monte-Carlo OOM stress (reference RmmSparkMonteCarlo.java:27-66, run by
+ci/fuzz-test.sh with skewed tasks): randomized task allocation schedules
+through the full retry framework; asserts completion without deadlock and
+zero leaked reservations."""
+
+import random
+import threading
+import time
+
+import pytest
+
+from spark_rapids_tpu.memory import exceptions as exc
+from spark_rapids_tpu.memory.resource import LimitingMemoryResource
+from spark_rapids_tpu.memory.spark_resource_adaptor import \
+    SparkResourceAdaptor
+
+
+def run_task(adaptor, task_id, seed, skewed, stats, stats_lock):
+    """One Spark task's life under the retry framework: allocate a working
+    set in chunks; on GpuRetryOOM free everything, park (BUFN), retry; on
+    GpuSplitAndRetryOOM halve the chunk size and retry."""
+    rng = random.Random(seed)
+    tid = threading.get_ident()
+    adaptor.start_dedicated_task_thread(tid, task_id)
+    retries = splits = 0
+    try:
+        n_batches = rng.randint(1, 4)
+        for _ in range(n_batches):
+            if skewed == "pressure":
+                # incremental chunks: tasks hold partial sets while blocked,
+                # forcing the all-blocked deadlock -> rollback/split path
+                target = rng.randint(400, 900)
+                chunk = max(1, target // 4)
+            else:
+                target = rng.randint(50, 600 if skewed and task_id % 5 == 0
+                                     else 250)
+                chunk = target
+            held = []
+            done = False
+            parked = False
+            while not done:
+                try:
+                    if parked:
+                        # may itself throw retry/split OOM (BUFN machinery)
+                        adaptor.block_thread_until_ready(tid)
+                        parked = False
+                    while sum(held) < target:
+                        adaptor.allocate(chunk)
+                        held.append(chunk)
+                        if rng.random() < 0.3:
+                            time.sleep(0.001)
+                    done = True
+                except exc.GpuRetryOOM:
+                    retries += 1
+                    for h in held:
+                        adaptor.deallocate(h)
+                    held = []
+                    parked = True
+                except exc.GpuSplitAndRetryOOM:
+                    splits += 1
+                    for h in held:
+                        adaptor.deallocate(h)
+                    held = []
+                    if chunk <= 1:
+                        raise
+                    chunk = max(1, chunk // 2)
+            # work done; free the batch
+            for h in held:
+                adaptor.deallocate(h)
+            if rng.random() < 0.5:
+                time.sleep(0.001)
+    finally:
+        adaptor.task_done(task_id)
+    with stats_lock:
+        stats["retries"] += retries
+        stats["splits"] += splits
+        stats["completed"] += 1
+
+
+@pytest.mark.parametrize("skewed", [False, True])
+def test_monte_carlo_no_deadlock_no_leak(skewed):
+    adaptor = SparkResourceAdaptor(LimitingMemoryResource(1000))
+    n_tasks = 24
+    stats = {"retries": 0, "splits": 0, "completed": 0}
+    stats_lock = threading.Lock()
+    threads = []
+    for task_id in range(n_tasks):
+        th = threading.Thread(
+            target=run_task,
+            args=(adaptor, task_id, 1234 + task_id, skewed, stats,
+                  stats_lock),
+            daemon=True)
+        threads.append(th)
+    for th in threads:
+        th.start()
+    deadline = time.monotonic() + 60
+    for th in threads:
+        th.join(max(0.1, deadline - time.monotonic()))
+        assert not th.is_alive(), "stress run deadlocked"
+    assert stats["completed"] == n_tasks
+    assert adaptor.resource.used == 0, "leaked reservations"
+    assert adaptor.gpu_memory_allocated_bytes == 0
+    adaptor.shutdown()
+
+
+def test_monte_carlo_high_pressure_hits_retry_path():
+    """Greedy tasks (each wanting 40-90% of the pool) must deadlock and
+    recover via rollback/split — asserts the machinery actually fired."""
+    adaptor = SparkResourceAdaptor(LimitingMemoryResource(1000))
+    n_tasks = 8
+    stats = {"retries": 0, "splits": 0, "completed": 0}
+    stats_lock = threading.Lock()
+    threads = [threading.Thread(
+        target=run_task,
+        args=(adaptor, task_id, 99 + task_id, "pressure", stats, stats_lock),
+        daemon=True) for task_id in range(n_tasks)]
+    for th in threads:
+        th.start()
+    deadline = time.monotonic() + 60
+    for th in threads:
+        th.join(max(0.1, deadline - time.monotonic()))
+        assert not th.is_alive(), "stress run deadlocked"
+    assert stats["completed"] == n_tasks
+    assert stats["retries"] + stats["splits"] > 0, \
+        "high-pressure run never hit the retry machinery"
+    assert adaptor.resource.used == 0
+    adaptor.shutdown()

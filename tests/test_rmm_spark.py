@@ -1,0 +1,332 @@
+"""OOM state machine tests — deterministic TaskThread harness modeled on the
+reference RmmSparkTest.java:72-199 (threads driven by queued ops + futures,
+asserting state transitions, blocking, BUFN, split-retry, with forced OOM
+injection)."""
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+
+import pytest
+
+from spark_rapids_tpu.memory import exceptions as exc
+from spark_rapids_tpu.memory import rmm_spark
+from spark_rapids_tpu.memory import spark_resource_adaptor as sra
+from spark_rapids_tpu.memory.resource import LimitingMemoryResource
+from spark_rapids_tpu.memory.spark_resource_adaptor import (
+    SparkResourceAdaptor, THREAD_BLOCKED, THREAD_BUFN, THREAD_RUNNING)
+
+TIMEOUT = 10
+
+
+class TaskThread:
+    """A worker executing queued ops (RmmSparkTest TaskThread analog)."""
+
+    def __init__(self, adaptor, task_id=None):
+        self.adaptor = adaptor
+        self.task_id = task_id
+        self._q = queue.Queue()
+        self.ident = None
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        self._started.wait(TIMEOUT)
+
+    def _run(self):
+        self.ident = threading.get_ident()
+        if self.task_id is not None:
+            self.adaptor.start_dedicated_task_thread(self.ident,
+                                                     self.task_id)
+        self._started.set()
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, fut = item
+            try:
+                fut.set_result(fn())
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+    def do(self, fn) -> Future:
+        fut = Future()
+        self._q.put((fn, fut))
+        return fut
+
+    def done(self):
+        self._q.put(None)
+        self._thread.join(TIMEOUT)
+
+
+def wait_state(adaptor, ident, state, timeout=TIMEOUT):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if adaptor.get_state_of(ident) == state:
+            return True
+        time.sleep(0.005)
+    return False
+
+
+@pytest.fixture
+def adaptor():
+    a = SparkResourceAdaptor(LimitingMemoryResource(1000))
+    yield a
+    a.shutdown()
+
+
+def test_basic_alloc_free(adaptor):
+    t = TaskThread(adaptor, task_id=1)
+    assert adaptor.get_state_of(t.ident) == THREAD_RUNNING
+    t.do(lambda: adaptor.allocate(500)).result(TIMEOUT)
+    assert adaptor.resource.used == 500
+    t.do(lambda: adaptor.deallocate(500)).result(TIMEOUT)
+    assert adaptor.resource.used == 0
+    adaptor.task_done(1)
+    t.done()
+
+
+def test_forced_retry_oom(adaptor):
+    t = TaskThread(adaptor, task_id=1)
+    adaptor.force_retry_oom(t.ident, 1)
+    with pytest.raises(exc.GpuRetryOOM):
+        t.do(lambda: adaptor.allocate(10)).result(TIMEOUT)
+    # next alloc works
+    t.do(lambda: adaptor.allocate(10)).result(TIMEOUT)
+    assert adaptor.get_and_reset_num_retry_throw(1) == 1
+    assert adaptor.get_and_reset_num_retry_throw(1) == 0
+    adaptor.task_done(1)
+    t.done()
+
+
+def test_forced_split_and_retry_oom(adaptor):
+    t = TaskThread(adaptor, task_id=1)
+    adaptor.force_split_and_retry_oom(t.ident, 1)
+    with pytest.raises(exc.GpuSplitAndRetryOOM):
+        t.do(lambda: adaptor.allocate(10)).result(TIMEOUT)
+    assert adaptor.get_and_reset_num_split_retry_throw(1) == 1
+    adaptor.task_done(1)
+    t.done()
+
+
+def test_forced_cudf_exception(adaptor):
+    t = TaskThread(adaptor, task_id=1)
+    adaptor.force_cudf_exception(t.ident, 1)
+    with pytest.raises(exc.CudfException):
+        t.do(lambda: adaptor.allocate(10)).result(TIMEOUT)
+    adaptor.task_done(1)
+    t.done()
+
+
+def test_skip_count_injection(adaptor):
+    t = TaskThread(adaptor, task_id=1)
+    adaptor.force_retry_oom(t.ident, 1, sra.GPU, skip_count=2)
+    t.do(lambda: adaptor.allocate(1)).result(TIMEOUT)
+    t.do(lambda: adaptor.allocate(1)).result(TIMEOUT)
+    with pytest.raises(exc.GpuRetryOOM):
+        t.do(lambda: adaptor.allocate(1)).result(TIMEOUT)
+    adaptor.task_done(1)
+    t.done()
+
+
+def test_block_until_free(adaptor):
+    """An OOM alloc blocks; a free from another task wakes and retries it
+    (reference testShuffleBlocking shape)."""
+    t1 = TaskThread(adaptor, task_id=1)
+    t2 = TaskThread(adaptor, task_id=2)
+    t1.do(lambda: adaptor.allocate(800)).result(TIMEOUT)
+    fut = t2.do(lambda: adaptor.allocate(800))  # cannot fit -> blocks
+    assert wait_state(adaptor, t2.ident, THREAD_BLOCKED)
+    assert not fut.done()
+    t1.do(lambda: adaptor.deallocate(800)).result(TIMEOUT)
+    fut.result(TIMEOUT)  # woken and retried successfully
+    assert adaptor.resource.used == 800
+    adaptor.task_done(1)
+    adaptor.task_done(2)
+    t1.done()
+    t2.done()
+
+
+def test_bufn_and_split_full_cycle(adaptor):
+    """Both tasks block -> lower-priority task rolls back (GpuRetryOOM) and
+    parks BUFN -> remaining task retries once, then rolls back -> all BUFN
+    -> highest-priority task splits (GpuSplitAndRetryOOM) and completes
+    with smaller allocations (docs/memory_management.md deadlock flow)."""
+    t1 = TaskThread(adaptor, task_id=1)
+    t2 = TaskThread(adaptor, task_id=2)
+    t1.do(lambda: adaptor.allocate(600)).result(TIMEOUT)
+
+    fut2 = t2.do(lambda: adaptor.allocate(600))  # blocks
+    assert wait_state(adaptor, t2.ident, THREAD_BLOCKED)
+
+    fut1 = t1.do(lambda: adaptor.allocate(600))  # blocks -> deadlock
+    # task2 (lowest priority) must be told to roll back
+    with pytest.raises(exc.GpuRetryOOM):
+        fut2.result(TIMEOUT)
+    # retry framework: task2 made everything spillable (nothing held) and
+    # parks BUFN
+    fut2b = t2.do(lambda: adaptor.block_thread_until_ready(t2.ident))
+    assert wait_state(adaptor, t2.ident, THREAD_BUFN)
+
+    # task1 was the last blocked thread: it retried once
+    # (is_retry_alloc_before_bufn), failed again, and must roll back too
+    with pytest.raises(exc.GpuRetryOOM):
+        fut1.result(TIMEOUT)
+    # task1 rolls back: frees its 600 and parks; all tasks now BUFN ->
+    # task1 (highest priority) is selected to split
+    t1.do(lambda: adaptor.deallocate(600)).result(TIMEOUT)
+    with pytest.raises(exc.GpuSplitAndRetryOOM):
+        t1.do(lambda: adaptor.block_thread_until_ready(t1.ident)).result(
+            TIMEOUT)
+    # split: task1 allocates half at a time
+    t1.do(lambda: adaptor.allocate(300)).result(TIMEOUT)
+    t1.do(lambda: adaptor.allocate(300)).result(TIMEOUT)
+    t1.do(lambda: adaptor.deallocate(600)).result(TIMEOUT)
+    adaptor.task_done(1)
+    # task2 wakes after task1 finishes and completes its allocation
+    fut2b.result(TIMEOUT)
+    t2.do(lambda: adaptor.allocate(600)).result(TIMEOUT)
+    assert adaptor.get_and_reset_num_split_retry_throw(1) == 1
+    assert adaptor.get_and_reset_num_retry_throw(2) == 1
+    adaptor.task_done(2)
+    t1.done()
+    t2.done()
+
+
+def test_shuffle_thread_wakes_first(adaptor):
+    """Shuffle (pool) threads have the highest priority: woken before task
+    threads when memory frees up (docs/memory_management.md:38-42)."""
+    t1 = TaskThread(adaptor, task_id=5)
+    shuf = TaskThread(adaptor)  # no dedicated task
+    adaptor.pool_thread_working_on_tasks(True, shuf.ident, [5])
+    idle = TaskThread(adaptor, task_id=6)  # stays runnable: no deadlock
+    idle.do(lambda: adaptor.allocate(900)).result(TIMEOUT)
+
+    fut_task = t1.do(lambda: adaptor.allocate(900))
+    assert wait_state(adaptor, t1.ident, THREAD_BLOCKED)
+    fut_shuf = shuf.do(lambda: adaptor.allocate(500))
+    assert wait_state(adaptor, shuf.ident, THREAD_BLOCKED)
+
+    # free: the shuffle thread must be woken first (highest priority)
+    idle.do(lambda: adaptor.deallocate(900)).result(TIMEOUT)
+    fut_shuf.result(TIMEOUT)  # shuffle thread won the freed memory first
+    assert not fut_task.done()
+    shuf.do(lambda: adaptor.deallocate(500)).result(TIMEOUT)
+    fut_task.result(TIMEOUT)  # then the task thread gets the rest
+    adaptor.task_done(5)
+    adaptor.task_done(6)
+    t1.done()
+    shuf.done()
+    idle.done()
+
+
+def test_remove_blocked_thread_throws(adaptor):
+    t1 = TaskThread(adaptor, task_id=1)
+    t2 = TaskThread(adaptor, task_id=2)
+    t1.do(lambda: adaptor.allocate(900)).result(TIMEOUT)
+    fut = t2.do(lambda: adaptor.allocate(900))
+    assert wait_state(adaptor, t2.ident, THREAD_BLOCKED)
+    adaptor.remove_thread_association(t2.ident, -1)
+    with pytest.raises(exc.ThreadRemovedException):
+        fut.result(TIMEOUT)
+    adaptor.task_done(1)
+    t1.done()
+    t2.done()
+
+
+def test_csv_log(adaptor):
+    t = TaskThread(adaptor, task_id=1)
+    t.do(lambda: adaptor.allocate(10)).result(TIMEOUT)
+    log = adaptor.get_log()
+    assert log[0].startswith("time,op,current thread")
+    assert any("TRANSITION" in r and "THREAD_ALLOC" in r for r in log)
+    adaptor.task_done(1)
+    t.done()
+
+
+def test_metrics_block_time(adaptor):
+    t1 = TaskThread(adaptor, task_id=1)
+    t2 = TaskThread(adaptor, task_id=2)
+    t1.do(lambda: adaptor.allocate(900)).result(TIMEOUT)
+    fut = t2.do(lambda: adaptor.allocate(900))
+    assert wait_state(adaptor, t2.ident, THREAD_BLOCKED)
+    time.sleep(0.05)
+    t1.do(lambda: adaptor.deallocate(900)).result(TIMEOUT)
+    fut.result(TIMEOUT)
+    assert adaptor.get_and_reset_block_time(2) > 0
+    adaptor.task_done(1)
+    adaptor.task_done(2)
+    t1.done()
+    t2.done()
+
+
+def test_rmm_spark_facade():
+    rmm_spark.set_event_handler(1000)
+    try:
+        rmm_spark.current_thread_is_dedicated_to_task(42)
+        a = rmm_spark.get_adaptor()
+        assert a.get_state_of(rmm_spark.current_thread_id()) == \
+            THREAD_RUNNING
+        a.allocate(100)
+        a.deallocate(100)
+        rmm_spark.task_done(42)
+        with pytest.raises(RuntimeError):
+            rmm_spark.set_event_handler(10)
+    finally:
+        rmm_spark.clear_event_handler()
+
+
+def test_host_table_spill_roundtrip():
+    from spark_rapids_tpu.columns import dtypes
+    from spark_rapids_tpu.columns.column import Column
+    from spark_rapids_tpu.columns.table import Table
+    from spark_rapids_tpu.memory.host_table import HostTable
+
+    t = Table([
+        Column.from_pylist([1, None, 3], dtypes.INT64),
+        Column.from_strings(["a", None, "ccc"]),
+        Column.make_list(
+            __import__("numpy").array([0, 2, 2, 3]),
+            Column.from_pylist([1.0, 2.0, 3.0], dtypes.FLOAT64)),
+    ], names=["i", "s", "l"])
+    ht = HostTable.from_table(t)
+    assert ht.size_bytes > 0
+    back = ht.to_table()
+    assert back.to_pylist() == t.to_pylist()
+    assert back.names == ["i", "s", "l"]
+
+
+def test_remove_task_metrics_prunes(adaptor):
+    t = TaskThread(adaptor, task_id=9)
+    adaptor.force_retry_oom(t.ident, 1)
+    with pytest.raises(exc.GpuRetryOOM):
+        t.do(lambda: adaptor.allocate(10)).result(TIMEOUT)
+    adaptor.task_done(9)
+    assert adaptor.get_and_reset_num_retry_throw(9) == 1
+    adaptor.remove_task_metrics(9)
+    assert 9 not in adaptor._checkpointed
+    t.done()
+
+
+def test_pool_blocked_breaks_producer_consumer_deadlock(adaptor):
+    """A dedicated thread waiting on a pool thread (pool_blocked) plus its
+    pool thread blocked on alloc must count as a deadlocked task."""
+    t1 = TaskThread(adaptor, task_id=1)
+    pool = TaskThread(adaptor)
+    adaptor.pool_thread_working_on_tasks(False, pool.ident, [1])
+    # pool thread holds most memory, then wants more -> blocks
+    pool.do(lambda: adaptor.allocate(800)).result(TIMEOUT)
+    fut = pool.do(lambda: adaptor.allocate(800))
+    assert wait_state(adaptor, pool.ident, THREAD_BLOCKED)
+    # dedicated thread reports it is waiting on the pool -> deadlock check
+    # fires and rolls back the pool thread (the only BLOCKED thread retries
+    # once via is_retry_alloc_before_bufn, then BUFNs)
+    t1.do(lambda: adaptor.thread_waiting_on_pool(t1.ident)).result(TIMEOUT)
+    with pytest.raises(exc.GpuRetryOOM):
+        fut.result(TIMEOUT)
+    t1.do(lambda: adaptor.thread_done_waiting_on_pool(t1.ident)).result(
+        TIMEOUT)
+    pool.do(lambda: adaptor.deallocate(800)).result(TIMEOUT)
+    adaptor.task_done(1)
+    t1.done()
+    pool.done()
