@@ -1,0 +1,132 @@
+"""Table/column copying primitives: slice, gather, concat — the building
+blocks shuffle split/assemble and joins compose (reference analogs:
+cudf::slice/gather/concatenate as used by shuffle_split.cu /
+shuffle_assemble.cu)."""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.columns.dtypes import Kind
+from spark_rapids_tpu.columns.table import Table
+
+
+def gather(col: Column, idx: jnp.ndarray) -> Column:
+    """New column with rows col[idx[i]].  idx must be in range; device op."""
+    n = int(idx.shape[0])
+    validity = None
+    if col.validity is not None:
+        validity = col.validity[idx]
+    kind = col.dtype.kind
+    if kind == Kind.STRUCT:
+        return Column(col.dtype, n, validity=validity,
+                      children=tuple(gather(ch, idx) for ch in col.children))
+    if kind in (Kind.STRING, Kind.LIST):
+        # variable width: rebuild offsets from gathered lengths, then move
+        # payload via a flattened gather (host-synced sizes; eager op)
+        offs = np.asarray(col.offsets)
+        hidx = np.asarray(idx)
+        lens = offs[hidx + 1] - offs[hidx]
+        new_offs = np.zeros(n + 1, np.int32)
+        np.cumsum(lens, out=new_offs[1:])
+        total = int(new_offs[-1])
+        src = np.zeros(total, np.int64)
+        for i in range(n):  # host loop over rows: acceptable for eager path
+            src[new_offs[i]:new_offs[i + 1]] = np.arange(
+                offs[hidx[i]], offs[hidx[i] + 1])
+        src_j = jnp.asarray(src)
+        if kind == Kind.STRING:
+            data = col.data[src_j] if total else jnp.zeros(0, jnp.uint8)
+            return Column(col.dtype, n, data=data, validity=validity,
+                          offsets=jnp.asarray(new_offs))
+        child = gather(col.children[0], src_j)
+        return Column(col.dtype, n, validity=validity,
+                      offsets=jnp.asarray(new_offs), children=(child,))
+    data = col.data[idx] if col.data is not None else None
+    return Column(col.dtype, n, data=data, validity=validity)
+
+
+def gather_table(table: Table, idx: jnp.ndarray) -> Table:
+    return Table([gather(c, idx) for c in table.columns], table.names)
+
+
+def slice_column(col: Column, start: int, end: int) -> Column:
+    """Zero-rebase slice [start, end) (cudf::slice semantics, materialized)."""
+    n = end - start
+    validity = col.validity[start:end] if col.validity is not None else None
+    kind = col.dtype.kind
+    if kind == Kind.STRUCT:
+        return Column(col.dtype, n, validity=validity,
+                      children=tuple(slice_column(ch, start, end)
+                                     for ch in col.children))
+    if kind in (Kind.STRING, Kind.LIST):
+        offs = np.asarray(col.offsets)
+        c0, c1 = int(offs[start]), int(offs[end])
+        new_offs = jnp.asarray((offs[start:end + 1] - c0).astype(np.int32))
+        if kind == Kind.STRING:
+            return Column(col.dtype, n, data=col.data[c0:c1],
+                          validity=validity, offsets=new_offs)
+        child = slice_column(col.children[0], c0, c1)
+        return Column(col.dtype, n, validity=validity, offsets=new_offs,
+                      children=(child,))
+    data = col.data[start:end] if col.data is not None else None
+    return Column(col.dtype, n, data=data, validity=validity)
+
+
+def slice_table(table: Table, start: int, end: int) -> Table:
+    return Table([slice_column(c, start, end) for c in table.columns],
+                 table.names)
+
+
+def split_table(table: Table, splits: Sequence[int]) -> List[Table]:
+    """Split at row indices (cudf::split): [0,s0), [s0,s1), ... [sn,rows)."""
+    bounds = [0] + list(splits) + [table.num_rows]
+    return [slice_table(table, bounds[i], bounds[i + 1])
+            for i in range(len(bounds) - 1)]
+
+
+def concat_columns(cols: Sequence[Column]) -> Column:
+    first = cols[0]
+    rows = sum(c.length for c in cols)
+    kind = first.dtype.kind
+    if any(c.validity is not None for c in cols):
+        validity = jnp.concatenate([
+            c.validity if c.validity is not None
+            else jnp.ones((c.length,), jnp.uint8) for c in cols])
+    else:
+        validity = None
+    if kind == Kind.STRUCT:
+        children = tuple(
+            concat_columns([c.children[i] for c in cols])
+            for i in range(len(first.children)))
+        return Column(first.dtype, rows, validity=validity,
+                      children=children)
+    if kind in (Kind.STRING, Kind.LIST):
+        sizes = [int(np.asarray(c.offsets[-1])) for c in cols]
+        parts = [cols[0].offsets]
+        base = sizes[0]
+        for c, sz in zip(cols[1:], sizes[1:]):
+            parts.append(c.offsets[1:] + base)
+            base += sz
+        offsets = jnp.concatenate(parts)
+        if kind == Kind.STRING:
+            data = jnp.concatenate([c.data for c in cols])
+            return Column(first.dtype, rows, data=data, validity=validity,
+                          offsets=offsets)
+        child = concat_columns([c.children[0] for c in cols])
+        return Column(first.dtype, rows, validity=validity, offsets=offsets,
+                      children=(child,))
+    data = jnp.concatenate([c.data for c in cols])
+    return Column(first.dtype, rows, data=data, validity=validity)
+
+
+def concat_tables(tables: Sequence[Table]) -> Table:
+    if not tables:
+        raise ValueError("need at least one table")
+    ncols = tables[0].num_columns
+    return Table([concat_columns([t.columns[i] for t in tables])
+                  for i in range(ncols)], tables[0].names)

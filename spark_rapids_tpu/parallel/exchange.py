@@ -1,0 +1,76 @@
+"""ICI fast-path shuffle: hash-partition exchange over a jax mesh axis.
+
+The reference's data-parallel story delegates cross-node movement to
+Spark's byte-blob shuffle (SURVEY.md §2.2 checklist).  On TPU, chips in a
+slice are directly connected (ICI), so the idiomatic exchange is NOT bytes
+through the host: columns stay arrays and move with jax.lax.all_to_all
+inside shard_map, with XLA scheduling the collective.
+
+Because XLA collectives need static shapes, partitions are exchanged in
+fixed-capacity slots: each device sends an (n_parts, capacity, ...) padded
+block per column plus true counts; receivers get (n_parts*capacity, ...)
+padded rows and a validity mask.  Capacity is the caller's budget — the
+same memory-budgeted-chunking philosophy as the reference's
+get_json_object batching (SURVEY.md §3.4).  Rows beyond capacity are
+dropped from the padded slots, but the returned send_counts carry the
+true per-destination sizes so callers MUST check
+`max(send_counts) <= capacity` (and re-run with a bigger budget or chunk
+the input when it fails) — overflow is detectable, never silent.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+_I32 = jnp.int32
+
+
+def build_padded_sends(arrays: Sequence[jnp.ndarray], part: jnp.ndarray,
+                       n_parts: int, capacity: int):
+    """Pack rows into per-destination padded slots.
+
+    arrays: per-column row-major arrays (rows, ...) sharing axis 0.
+    part:   (rows,) int32 destination partition per row.
+    Returns (sends, counts): sends[i] has shape (n_parts, capacity, ...);
+    counts is (n_parts,) true row counts (may exceed capacity — caller
+    checks)."""
+    rows = part.shape[0]
+    order = jnp.argsort(part)
+    p_sorted = part[order]
+    counts = jnp.bincount(part, length=n_parts).astype(_I32)
+    starts = jnp.concatenate(
+        [jnp.zeros(1, _I32), jnp.cumsum(counts)[:-1].astype(_I32)])
+    rank = jnp.arange(rows, dtype=_I32) - starts[p_sorted]
+    slot = jnp.where(rank < capacity, rank, capacity)  # overflow -> dropped
+    sends = []
+    for a in arrays:
+        buf = jnp.zeros((n_parts, capacity) + a.shape[1:], a.dtype)
+        sends.append(buf.at[p_sorted, slot].set(a[order], mode="drop"))
+    return sends, counts
+
+
+def exchange(arrays: Sequence[jnp.ndarray], part: jnp.ndarray,
+             axis_name: str, n_parts: int, capacity: int):
+    """All-to-all hash exchange inside shard_map.
+
+    Each device keeps rows with part == its own index after the exchange.
+    Returns (received arrays each (n_parts*capacity, ...), valid mask
+    (n_parts*capacity,), total_received (int32 scalar), send_counts
+    (n_parts,) int32 — the TRUE outbound sizes; any entry > capacity means
+    rows were dropped and the caller must retry with a larger budget)."""
+    sends, send_counts = build_padded_sends(arrays, part, n_parts, capacity)
+    recv_counts = jax.lax.all_to_all(
+        send_counts.reshape(n_parts, 1), axis_name, split_axis=0,
+        concat_axis=0).reshape(n_parts)
+    recv_counts = jnp.minimum(recv_counts, capacity)
+    received = []
+    for s in sends:
+        r = jax.lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0)
+        received.append(r.reshape((n_parts * capacity,) + s.shape[2:]))
+    slot_idx = jnp.arange(n_parts * capacity, dtype=_I32) % capacity
+    src_idx = jnp.arange(n_parts * capacity, dtype=_I32) // capacity
+    valid = slot_idx < recv_counts[src_idx]
+    return received, valid, jnp.sum(recv_counts).astype(_I32), send_counts

@@ -1,0 +1,407 @@
+"""Kudo shuffle wire format — byte-compatible with the reference
+(format spec: kudo/KudoSerializer.java:48-170 javadoc; writer:
+KudoTableHeaderCalc + SlicedBufferSerializer; merge: KudoTableMerger).
+
+Layout of one serialized table partition:
+
+  header:  "KUD0" | rowOffset | numRows | validityLen | offsetLen |
+           totalLen | numFlatCols   (all 4-byte big-endian)
+           hasValidityBuffer bitset ((numFlatCols+7)/8 bytes, LSB-first,
+           depth-first schema order, struct/list before children)
+  body:    [validity buffers][offset buffers][data buffers]
+           - validity: sloppy byte-slices of the packed null masks starting
+             at rowOffset/8 (bit offset rowOffset%8 resolved at merge);
+             section padded so header+validity is 4-byte aligned
+             (padForValidityAlignment, KudoSerializer.java:497)
+           - offsets: raw int32 offset values (NOT rebased), rowCount+1 per
+             string/list column with rows
+           - data: char/fixed-width payload slices; section padded to 4B
+
+Writes are pure memcpy of host buffers; all bit realignment and offset
+rebasing happens in merge_to_table (the read side), matching the
+reference's write-cheap/merge-once design.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.columns.dtypes import Kind
+from spark_rapids_tpu.columns.table import Table
+from spark_rapids_tpu.shuffle.schema import Field
+
+MAGIC = b"KUD0"
+
+
+def _pad4(n: int) -> int:
+    return (n + 3) // 4 * 4
+
+
+def _pad_validity(n: int, header_size: int) -> int:
+    """Pad validity section so header+validity is 4-byte aligned."""
+    return _pad4(n + header_size) - header_size
+
+
+def _validity_slice(row_offset: int, num_rows: int) -> Tuple[int, int]:
+    """(byte offset, byte length) of the sloppy validity slice."""
+    begin_byte = row_offset // 8
+    begin_bit = row_offset % 8
+    nbytes = (begin_bit + num_rows + 7) // 8 if num_rows > 0 else 0
+    return begin_byte, nbytes
+
+
+@dataclass
+class KudoTableHeader:
+    offset: int
+    num_rows: int
+    validity_len: int
+    offset_len: int
+    total_len: int
+    num_columns: int
+    has_validity: bytes
+
+    @property
+    def serialized_size(self) -> int:
+        return 4 + 6 * 4 + len(self.has_validity)
+
+    def has_validity_buffer(self, col_idx: int) -> bool:
+        return (self.has_validity[col_idx // 8] >> (col_idx % 8)) & 1 != 0
+
+    def write(self, out) -> int:
+        out.write(MAGIC)
+        out.write(struct.pack(">iiiiii", self.offset, self.num_rows,
+                              self.validity_len, self.offset_len,
+                              self.total_len, self.num_columns))
+        out.write(self.has_validity)
+        return self.serialized_size
+
+    @staticmethod
+    def read(stream) -> Optional["KudoTableHeader"]:
+        magic = stream.read(4)
+        if len(magic) == 0:
+            return None  # clean EOF
+        if magic != MAGIC:
+            raise ValueError(f"bad kudo magic {magic!r}")
+        raw = stream.read(24)
+        if len(raw) != 24:
+            raise EOFError("truncated kudo header")
+        fields = struct.unpack(">iiiiii", raw)
+        nbitset = (fields[5] + 7) // 8
+        bitset = stream.read(nbitset)
+        if len(bitset) != nbitset:
+            raise EOFError("truncated kudo header bitset")
+        return KudoTableHeader(*fields, bitset)
+
+
+@dataclass
+class KudoTable:
+    header: KudoTableHeader
+    buffer: bytes  # body, length == header.total_len
+
+
+# ------------------------------------------------------------------ write
+
+
+class _Slice:
+    __slots__ = ("offset", "row_count")
+
+    def __init__(self, offset: int, row_count: int):
+        self.offset = offset
+        self.row_count = row_count
+
+
+class HostColumnView:
+    """Host-materialized view of one column (data bytes view, packed
+    validity, offsets), built ONCE so repeated partition writes don't
+    re-sync the device buffers (shuffle_split calls the writer per
+    partition)."""
+
+    __slots__ = ("dtype", "data", "validity", "offsets", "children")
+
+    def __init__(self, col: Column):
+        self.dtype = col.dtype
+        self.children = [HostColumnView(ch) for ch in col.children]
+        self.offsets = np.asarray(col.offsets) if col.offsets is not None \
+            else None
+        if col.validity is not None:
+            bits = np.asarray(col.validity).astype(np.uint8)
+            self.validity = np.packbits(bits, bitorder="little")
+        else:
+            self.validity = None
+        kind = col.dtype.kind
+        if kind in (Kind.LIST, Kind.STRUCT):
+            self.data = None
+        elif kind == Kind.STRING:
+            self.data = np.asarray(col.data) if col.data is not None \
+                else np.zeros(0, np.uint8)
+        elif kind == Kind.DECIMAL128:
+            self.data = np.asarray(col.data).astype("<i4")
+        else:
+            self.data = col.to_numpy()
+
+
+def prepare_host_columns(columns: Sequence[Column]) -> List[HostColumnView]:
+    """One-time device->host materialization for repeated kudo writes."""
+    return [HostColumnView(c) for c in columns]
+
+
+def _flat_count(views: Sequence[HostColumnView]) -> int:
+    return sum(1 + _flat_count(v.children) for v in views)
+
+
+def _walk_columns(cols: Sequence[HostColumnView], root: _Slice, visit):
+    """Depth-first walk calling visit(view, slice) pre-order; list children
+    get the child slice derived from raw offset values."""
+    def rec(c: HostColumnView, sl: _Slice):
+        visit(c, sl)
+        if c.dtype.kind == Kind.LIST:
+            if c.offsets is not None and sl.row_count > 0:
+                start = int(c.offsets[sl.offset])
+                end = int(c.offsets[sl.offset + sl.row_count])
+                child = _Slice(start, end - start)
+            else:
+                child = _Slice(0, 0)
+            rec(c.children[0], child)
+        elif c.dtype.kind == Kind.STRUCT:
+            for ch in c.children:
+                rec(ch, sl)
+    for c in cols:
+        rec(c, root)
+
+
+def write_to_stream(columns: Sequence[Column], out, row_offset: int,
+                    num_rows: int) -> int:
+    """Serialize rows [row_offset, row_offset+num_rows) of the columns as
+    one kudo table (KudoSerializer.writeToStreamWithMetrics:249).  Returns
+    bytes written (header + body)."""
+    if num_rows < 0 or row_offset < 0:
+        raise ValueError("row_offset/num_rows must be non-negative")
+    views = list(columns)
+    if views and isinstance(views[0], Column):
+        views = prepare_host_columns(views)
+    root = _Slice(row_offset, num_rows)
+    nflat = _flat_count(views)
+    bitset = bytearray((nflat + 7) // 8)
+
+    validity_parts: List[bytes] = []
+    offset_parts: List[bytes] = []
+    data_parts: List[bytes] = []
+    col_idx = [0]
+
+    def visit(c: HostColumnView, sl: _Slice):
+        i = col_idx[0]
+        col_idx[0] += 1
+        include_validity = c.validity is not None and sl.row_count > 0
+        if include_validity:
+            bitset[i // 8] |= 1 << (i % 8)
+            bo, bl = _validity_slice(sl.offset, sl.row_count)
+            sliced = c.validity[bo:bo + bl]
+            if len(sliced) < bl:  # packed mask may be short; zero-extend
+                sliced = np.concatenate(
+                    [sliced, np.zeros(bl - len(sliced), np.uint8)])
+            validity_parts.append(sliced.tobytes())
+        kind = c.dtype.kind
+        if kind in (Kind.STRING, Kind.LIST):
+            if c.offsets is not None and sl.row_count > 0:
+                offset_parts.append(
+                    c.offsets[sl.offset: sl.offset + sl.row_count + 1]
+                    .astype("<i4").tobytes())
+                if kind == Kind.STRING:
+                    start = int(c.offsets[sl.offset])
+                    end = int(c.offsets[sl.offset + sl.row_count])
+                    if end > start:
+                        data_parts.append(c.data[start:end].tobytes())
+        elif kind == Kind.STRUCT:
+            pass
+        else:  # fixed width (incl. decimal128 as (rows, 4) LE limbs)
+            if sl.row_count > 0:
+                data_parts.append(
+                    c.data[sl.offset: sl.offset + sl.row_count].tobytes())
+
+    _walk_columns(views, root, visit)
+
+    validity = b"".join(validity_parts)
+    offsets_b = b"".join(offset_parts)
+    data_b = b"".join(data_parts)
+    header_size = 4 + 24 + len(bitset)
+    vlen = _pad_validity(len(validity), header_size)
+    olen = _pad4(len(offsets_b))
+    dlen = _pad4(len(data_b))
+    header = KudoTableHeader(row_offset, num_rows, vlen, olen,
+                             vlen + olen + dlen, nflat, bytes(bitset))
+    header.write(out)
+    out.write(validity)
+    out.write(b"\0" * (vlen - len(validity)))
+    out.write(offsets_b)
+    out.write(b"\0" * (olen - len(offsets_b)))
+    out.write(data_b)
+    out.write(b"\0" * (dlen - len(data_b)))
+    return header.serialized_size + header.total_len
+
+
+def write_row_count_only(out, num_rows: int) -> int:
+    """Degenerate zero-column table (KudoSerializer rows-only path)."""
+    header = KudoTableHeader(0, num_rows, 0, 0, 0, 0, b"")
+    return header.write(out)
+
+
+def read_one_table(stream) -> Optional[KudoTable]:
+    header = KudoTableHeader.read(stream)
+    if header is None:
+        return None
+    body = stream.read(header.total_len)
+    if len(body) != header.total_len:
+        raise EOFError("truncated kudo body")
+    return KudoTable(header, body)
+
+
+# ------------------------------------------------------------------ merge
+
+
+class _HostCol:
+    __slots__ = ("dtype", "rows", "mask", "data", "offsets", "children")
+
+    def __init__(self, dtype, rows, mask=None, data=None, offsets=None,
+                 children=()):
+        self.dtype = dtype
+        self.rows = rows
+        self.mask = mask          # np bool array or None (all valid)
+        self.data = data          # np array (values / chars / limb bytes)
+        self.offsets = offsets    # np int32, rebased to 0
+        self.children = list(children)
+
+
+def _parse_table(kt: KudoTable, fields: Sequence[Field]) -> List[_HostCol]:
+    """Decode one kudo body into logical host columns (bit offsets and raw
+    offsets resolved here, as KudoTableMerger does)."""
+    h = kt.header
+    body = kt.buffer
+    vcur = [0]
+    ocur = [h.validity_len]
+    dcur = [h.validity_len + h.offset_len]
+    col_idx = [0]
+
+    def read_validity(sl: _Slice) -> Optional[np.ndarray]:
+        i = col_idx[0]
+        has = h.has_validity_buffer(i)
+        if not has or sl.row_count <= 0:
+            return None
+        begin_bit = sl.offset % 8
+        nbytes = (begin_bit + sl.row_count + 7) // 8
+        raw = np.frombuffer(body, np.uint8, nbytes, vcur[0])
+        vcur[0] += nbytes
+        bits = np.unpackbits(raw, bitorder="little")
+        return bits[begin_bit: begin_bit + sl.row_count].astype(bool)
+
+    def rec(f: Field, sl: _Slice) -> _HostCol:
+        mask = read_validity(sl)
+        col_idx[0] += 1
+        kind = f.dtype.kind
+        if kind in (Kind.STRING, Kind.LIST):
+            if sl.row_count > 0:
+                n = sl.row_count + 1
+                raw = np.frombuffer(body, "<i4", n, ocur[0]).copy()
+                ocur[0] += 4 * n
+                child_sl = _Slice(int(raw[0]), int(raw[-1] - raw[0]))
+                offsets = raw - raw[0]
+            else:
+                child_sl = _Slice(0, 0)
+                offsets = np.zeros(1, np.int32)
+            if kind == Kind.STRING:
+                nchars = child_sl.row_count
+                data = np.frombuffer(body, np.uint8, nchars, dcur[0]).copy()
+                dcur[0] += nchars
+                return _HostCol(f.dtype, sl.row_count, mask, data, offsets)
+            child = rec(f.children[0], child_sl)
+            return _HostCol(f.dtype, sl.row_count, mask, None, offsets,
+                            [child])
+        if kind == Kind.STRUCT:
+            children = [rec(ch, sl) for ch in f.children]
+            return _HostCol(f.dtype, sl.row_count, mask, None, None,
+                            children)
+        # fixed width
+        item = 16 if kind == Kind.DECIMAL128 else f.dtype.size_bytes
+        nbytes = sl.row_count * item
+        raw = body[dcur[0]: dcur[0] + nbytes]
+        dcur[0] += nbytes
+        if kind == Kind.DECIMAL128:
+            data = np.frombuffer(raw, "<i4").reshape(sl.row_count, 4).copy()
+        else:
+            data = np.frombuffer(raw, f.dtype.np_dtype).copy()
+        return _HostCol(f.dtype, sl.row_count, mask, data, None)
+
+    root = _Slice(h.offset, h.num_rows)
+    return [rec(f, root) for f in fields]
+
+
+def _concat_host_cols(parts: List[_HostCol], f: Field) -> Column:
+    rows = sum(p.rows for p in parts)
+    if any(p.mask is not None for p in parts):
+        mask = np.concatenate([
+            p.mask if p.mask is not None else np.ones(p.rows, bool)
+            for p in parts]).astype(np.uint8)
+    else:
+        mask = None
+    kind = f.dtype.kind
+    if kind == Kind.STRING:
+        data = np.concatenate([p.data for p in parts]) if parts else \
+            np.zeros(0, np.uint8)
+        sizes = [int(p.offsets[-1]) for p in parts]
+        offs = [np.zeros(1, np.int32)]
+        base = 0
+        for p, sz in zip(parts, sizes):
+            offs.append((p.offsets[1:] + base).astype(np.int32))
+            base += sz
+        offsets = np.concatenate(offs)
+        import jax.numpy as jnp
+        return Column(f.dtype, rows, data=jnp.asarray(data),
+                      validity=None if mask is None else jnp.asarray(mask),
+                      offsets=jnp.asarray(offsets))
+    if kind == Kind.LIST:
+        child = _concat_host_cols([p.children[0] for p in parts],
+                                  f.children[0])
+        offs = [np.zeros(1, np.int32)]
+        base = 0
+        for p in parts:
+            offs.append((p.offsets[1:] + base).astype(np.int32))
+            base += int(p.offsets[-1])
+        import jax.numpy as jnp
+        return Column(f.dtype, rows,
+                      validity=None if mask is None else jnp.asarray(mask),
+                      offsets=jnp.asarray(np.concatenate(offs)),
+                      children=(child,))
+    if kind == Kind.STRUCT:
+        children = tuple(
+            _concat_host_cols([p.children[i] for p in parts], ch)
+            for i, ch in enumerate(f.children))
+        import jax.numpy as jnp
+        return Column(f.dtype, rows,
+                      validity=None if mask is None else jnp.asarray(mask),
+                      children=children)
+    if parts:
+        data = np.concatenate([p.data for p in parts])
+    elif kind == Kind.DECIMAL128:
+        data = np.zeros((0, 4), np.int32)
+    else:
+        data = np.zeros(0, f.dtype.np_dtype)
+    import jax.numpy as jnp
+    if kind == Kind.FLOAT64:
+        data = data.view(np.uint64)
+    return Column(f.dtype, rows, data=jnp.asarray(data),
+                  validity=None if mask is None else jnp.asarray(mask))
+
+
+def merge_to_table(kudo_tables: Sequence[KudoTable],
+                   fields: Sequence[Field]) -> Table:
+    """Concatenate N kudo tables into one device Table
+    (KudoSerializer.mergeToTable:407 / KudoTableMerger)."""
+    parsed = [_parse_table(kt, fields) for kt in kudo_tables]
+    cols = []
+    for i, f in enumerate(fields):
+        cols.append(_concat_host_cols([p[i] for p in parsed], f))
+    return Table(cols)

@@ -1,0 +1,33 @@
+"""Flattened-schema description + visitors (reference
+src/main/java/.../schema/SchemaVisitor.java:81 — depth-first walk where a
+struct/list column's own entry precedes its children)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.columns.dtypes import DType
+from spark_rapids_tpu.columns.table import Table
+
+
+@dataclass(frozen=True)
+class Field:
+    dtype: DType
+    children: Tuple["Field", ...] = ()
+    name: Optional[str] = None
+
+
+def schema_of_table(table: Table) -> List[Field]:
+    def of_col(c: Column) -> Field:
+        return Field(c.dtype, tuple(of_col(ch) for ch in c.children))
+    return [of_col(c) for c in table.columns]
+
+
+def flattened_count(fields) -> int:
+    """Number of columns in the flattened (depth-first) schema."""
+    n = 0
+    for f in fields:
+        n += 1 + flattened_count(f.children)
+    return n

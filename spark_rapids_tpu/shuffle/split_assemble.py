@@ -1,0 +1,57 @@
+"""Partition split/assemble behind the reference's KudoGpuSerializer API
+(shuffle_split.cu:797 / shuffle_assemble.cu; Java KudoGpuSerializer.java).
+
+The reference's device variant packs per-partition kudo-like blobs into one
+GPU buffer because its network path consumes opaque bytes from device
+memory.  On TPU the equivalents diverge by transport:
+
+  * host/Spark-network transport: partitions serialize through the byte-
+    exact Kudo writer (shuffle/kudo.py) — split_and_serialize /
+    assemble_from_blobs here.
+  * chip-to-chip (ICI) transport: no byte blobs at all — sharded columns
+    move as arrays through jax collectives (parallel/exchange.py), which
+    is the TPU-native fast path the reference's NVLink story maps to.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columns.table import Table
+from spark_rapids_tpu.shuffle import kudo
+from spark_rapids_tpu.shuffle.schema import Field
+
+
+def shuffle_split(table: Table, splits: Sequence[int]
+                  ) -> Tuple[bytes, np.ndarray]:
+    """Split at row boundaries and serialize every partition as a kudo
+    blob; returns (packed buffer, int64 offsets per partition) — the same
+    (data, offsets) pair shape as KudoGpuSerializer.splitAndSerializeToDevice
+    (KudoGpuSerializer.java:50)."""
+    bounds = [0] + list(splits) + [table.num_rows]
+    out = io.BytesIO()
+    offsets = np.zeros(len(bounds), np.int64)
+    views = kudo.prepare_host_columns(table.columns)  # one device sync
+    for i in range(len(bounds) - 1):
+        start, end = bounds[i], bounds[i + 1]
+        kudo.write_to_stream(views, out, start, end - start)
+        offsets[i + 1] = out.tell()
+    return out.getvalue(), offsets
+
+
+def shuffle_assemble(fields: Sequence[Field], buffer: bytes,
+                     offsets: np.ndarray) -> Table:
+    """Reassemble partitions into one device table
+    (shuffle_split.hpp:183 shuffle_assemble)."""
+    kts: List[kudo.KudoTable] = []
+    for i in range(len(offsets) - 1):
+        stream = io.BytesIO(buffer[offsets[i]:offsets[i + 1]])
+        while True:
+            kt = kudo.read_one_table(stream)
+            if kt is None:
+                break
+            kts.append(kt)
+    return kudo.merge_to_table(kts, fields)
