@@ -15,3 +15,38 @@ from spark_rapids_tpu.ops.sha import (  # noqa: F401
     sha512_nulls_preserved,
     host_crc32,
 )
+from spark_rapids_tpu.ops.cast_string import (  # noqa: F401
+    string_to_integer,
+    string_to_float,
+    float_to_string,
+)
+from spark_rapids_tpu.ops.arithmetic import (  # noqa: F401
+    multiply,
+    round_column,
+    HALF_UP,
+    HALF_EVEN,
+)
+from spark_rapids_tpu.ops.aggregation64 import (  # noqa: F401
+    extract_chunk32_from_64bit,
+    assemble64_from_sum,
+)
+from spark_rapids_tpu.ops.case_when import (  # noqa: F401
+    select_first_true_index,
+)
+from spark_rapids_tpu.ops.copying import (  # noqa: F401
+    gather,
+    gather_table,
+    slice_table,
+    split_table,
+    concat_tables,
+)
+from spark_rapids_tpu.ops.substring_index import substring_index  # noqa: F401
+from spark_rapids_tpu.ops.zorder import (  # noqa: F401
+    interleave_bits,
+    hilbert_index,
+)
+from spark_rapids_tpu.ops import bloom_filter  # noqa: F401
+from spark_rapids_tpu.ops.exceptions import (  # noqa: F401
+    ExceptionWithRowIndex,
+    CastException,
+)

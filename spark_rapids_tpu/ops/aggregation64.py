@@ -1,0 +1,59 @@
+"""Overflow-safe 64-bit SUM building blocks (reference
+aggregation64_utils.hpp/.cu, Aggregation64Utils.java): split int64 values
+into 32-bit chunks, sum the chunks as int64 (no overflow for < 2^32 rows),
+then reassemble with carry propagation and overflow detection."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.columns.dtypes import DType
+
+_I64 = jnp.int64
+_U64 = jnp.uint64
+
+
+def extract_chunk32_from_64bit(col: Column, dtype: DType,
+                               chunk_idx: int) -> Column:
+    """Chunk 0 = least-significant 32 bits (as UINT32-valued numbers),
+    chunk 1 = most-significant (signed).  Output in `dtype` (UINT32/INT32
+    per the reference, but any integer dtype wide enough works)."""
+    if chunk_idx not in (0, 1):
+        raise ValueError("chunk_idx must be 0 or 1")
+    v = col.data.astype(_I64)
+    if chunk_idx == 0:
+        chunk = (v.astype(_U64) & _U64(0xFFFFFFFF)).astype(_I64)
+    else:
+        chunk = v >> _I64(32)  # arithmetic: keeps sign
+    return Column(dtype, col.length,
+                  data=chunk.astype(dtype.np_dtype),
+                  validity=col.validity)
+
+
+def assemble64_from_sum(low_sums: Column, high_sums: Column,
+                        output_dtype: DType = dtypes.INT64):
+    """(overflow BOOL8 column, value column): value = low + (high << 32)
+    where low's upper bits carry into high (aggregation64_utils.hpp:52).
+    Overflow when the true sum does not fit in 64 bits signed."""
+    low = low_sums.data.astype(_I64)
+    high = high_sums.data.astype(_I64)
+    carry = low >> _I64(32)           # arithmetic shift: signed carry
+    low32 = low.astype(_U64) & _U64(0xFFFFFFFF)
+    total_high = high + carry         # sum of high chunks + carry
+    # the final value uses total_high's low 32 bits as bits 32..63
+    value = (low32 | (total_high.astype(_U64) << _U64(32))).astype(_I64)
+    # overflow iff total_high isn't a sign extension of value's bit 63:
+    # total_high must equal value >> 32 (arithmetic)
+    overflow = total_high != (value >> _I64(32))
+    validity = None
+    if low_sums.validity is not None or high_sums.validity is not None:
+        validity = (low_sums.valid_mask()
+                    & high_sums.valid_mask()).astype(jnp.uint8)
+    ovf_col = Column(dtypes.BOOL8, low_sums.length,
+                     data=overflow.astype(jnp.uint8), validity=validity)
+    val_col = Column(output_dtype, low_sums.length,
+                     data=value.astype(output_dtype.np_dtype),
+                     validity=validity)
+    return ovf_col, val_col

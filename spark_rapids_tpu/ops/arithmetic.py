@@ -1,0 +1,152 @@
+"""Arithmetic ops: ANSI/TRY multiply with overflow, Spark round()
+(reference multiply.cu/multiply.hpp, round_float.cu/round_float.hpp,
+Arithmetic.java:45-185)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.columns.dtypes import Kind
+from spark_rapids_tpu.ops.exceptions import ExceptionWithRowIndex
+from spark_rapids_tpu.utils import floats
+
+_I64 = jnp.int64
+
+HALF_UP = "HALF_UP"
+HALF_EVEN = "HALF_EVEN"
+
+
+def _combined_validity(a: Column, b: Column):
+    if a.validity is None and b.validity is None:
+        return None
+    return (a.valid_mask() & b.valid_mask()).astype(jnp.uint8)
+
+
+def multiply(lhs: Column, rhs: Column, is_ansi_mode: bool = False,
+             is_try_mode: bool = False) -> Column:
+    """Element-wise multiply with Spark overflow semantics (multiply.hpp):
+    regular mode wraps, TRY nulls overflow rows, ANSI throws
+    ExceptionWithRowIndex at the first overflow row."""
+    if is_ansi_mode and is_try_mode:
+        raise ValueError("ANSI and TRY mode cannot both be enabled")
+    if lhs.dtype != rhs.dtype:
+        raise ValueError("multiply requires matching dtypes")
+    kind = lhs.dtype.kind
+    validity = _combined_validity(lhs, rhs)
+    if kind in (Kind.FLOAT32, Kind.FLOAT64):
+        if kind == Kind.FLOAT64:
+            a = floats.bits_to_f64_compute(lhs.data)
+            b = floats.bits_to_f64_compute(rhs.data)
+            out = floats.f64_compute_to_bits(a * b)
+        else:
+            out = lhs.data * rhs.data
+        return Column(lhs.dtype, lhs.length, data=out, validity=validity)
+    # integral: compute wrapped product + overflow detection via division
+    a = lhs.data.astype(_I64)
+    b = rhs.data.astype(_I64)
+    if kind == Kind.INT64:
+        r = a * b  # wraps
+        minv = jnp.int64(-2**63)
+        ovf = ((a == -1) & (b == minv)) | ((b == -1) & (a == minv)) | \
+            ((a != 0) & (lax.div(r, jnp.where(a == 0, jnp.int64(1), a))
+                         != b))
+        out = r
+    else:
+        info = np.iinfo(lhs.dtype.np_dtype)
+        r = a * b  # exact in int64 for <=32-bit operands
+        ovf = (r < info.min) | (r > info.max)
+        out = r.astype(lhs.dtype.np_dtype)
+    base_valid = (jnp.ones(lhs.length, jnp.bool_) if validity is None
+                  else validity.astype(jnp.bool_))
+    if is_ansi_mode:
+        bad = np.asarray(base_valid & ovf)
+        if bad.any():
+            raise ExceptionWithRowIndex(int(np.argmax(bad)),
+                                        "multiplication overflow")
+        return Column(lhs.dtype, lhs.length, data=out, validity=validity)
+    if is_try_mode:
+        new_valid = (base_valid & ~ovf).astype(jnp.uint8)
+        return Column(lhs.dtype, lhs.length, data=out, validity=new_valid)
+    return Column(lhs.dtype, lhs.length, data=out, validity=validity)
+
+
+def round_column(col: Column, decimal_places: int = 0,
+                 method: str = HALF_UP) -> Column:
+    """Spark round()/bround() (round_float.hpp): integers, floats,
+    decimal32/64 (negated scale == decimal_places)."""
+    kind = col.dtype.kind
+    if kind in (Kind.INT8, Kind.INT16, Kind.INT32, Kind.INT64):
+        if decimal_places >= 0:
+            return Column(col.dtype, col.length, data=col.data,
+                          validity=col.validity)
+        if -decimal_places > 18:  # 10^19 > int64 range: everything -> 0
+            return Column(col.dtype, col.length,
+                          data=jnp.zeros(col.length, col.dtype.np_dtype),
+                          validity=col.validity)
+        f = 10 ** (-decimal_places)
+        v = col.data.astype(_I64)
+        q = lax.div(v, _I64(f))
+        rem = lax.rem(v, _I64(f))
+        half = f // 2
+        if method == HALF_UP:
+            bump = (jnp.abs(rem) >= half).astype(_I64) * \
+                jnp.where(v < 0, -1, 1)
+        else:  # HALF_EVEN
+            absr = jnp.abs(rem)
+            tie = absr * 2 == f
+            up = (absr * 2 > f) | (tie & (lax.rem(q, _I64(2)) != 0))
+            bump = up.astype(_I64) * jnp.where(v < 0, -1, 1)
+        out = ((q + bump) * f).astype(col.dtype.np_dtype)
+        return Column(col.dtype, col.length, data=out,
+                      validity=col.validity)
+    if kind in (Kind.DECIMAL32, Kind.DECIMAL64):
+        # rounding the unscaled value to the requested scale
+        cur_places = -col.dtype.scale
+        shift = cur_places - decimal_places
+        if shift <= 0:
+            return Column(col.dtype, col.length, data=col.data,
+                          validity=col.validity)
+        if shift > 18:  # beyond int64 unscaled range: everything -> 0
+            return Column(col.dtype, col.length,
+                          data=jnp.zeros(col.length, col.dtype.np_dtype),
+                          validity=col.validity)
+        f = 10 ** shift
+        v = col.data.astype(_I64)
+        q = lax.div(v, _I64(f))
+        rem = lax.rem(v, _I64(f))
+        half = f // 2
+        if method == HALF_UP:
+            up = jnp.abs(rem) >= half
+        else:
+            absr = jnp.abs(rem)
+            tie = absr * 2 == f
+            up = (absr * 2 > f) | (tie & (lax.rem(q, _I64(2)) != 0))
+        bump = up.astype(_I64) * jnp.where(v < 0, -1, 1)
+        out = ((q + bump) * f).astype(col.dtype.np_dtype)
+        return Column(col.dtype, col.length, data=out,
+                      validity=col.validity)
+    if kind in (Kind.FLOAT32, Kind.FLOAT64):
+        if kind == Kind.FLOAT64:
+            x = floats.bits_to_f64_compute(col.data)
+        else:
+            x = col.data
+        f = np.float64(10.0 ** decimal_places)
+        scaled = x * f
+        if method == HALF_UP:
+            r = jnp.trunc(scaled + jnp.where(scaled >= 0, 0.5, -0.5))
+        else:
+            r = jnp.round(scaled)  # round-half-even
+        out = r / f
+        out = jnp.where(jnp.isfinite(x), out, x)
+        if kind == Kind.FLOAT64:
+            out = floats.f64_compute_to_bits(out)
+        else:
+            out = out.astype(jnp.float32)
+        return Column(col.dtype, col.length, data=out,
+                      validity=col.validity)
+    raise NotImplementedError(f"round of {kind}")

@@ -1,0 +1,169 @@
+"""Spark BloomFilter create/put/probe/merge (reference bloom_filter.cu/
+bloom_filter.hpp, BloomFilter.java) — byte-compatible with Spark's
+serialized sketch formats:
+
+  V1: [version=1, numHashes, numLongs] big-endian + longs big-endian
+      (BloomFilterImpl hash loop: combined = h1 + i*h2, i in 1..n, int32)
+  V2: [version=2, numHashes, seed, numLongs] + longs
+      (BloomFilterImplV2: combined int64 = h1*INT32_MAX (+= h2 per probe))
+
+Internally the bitset lives as uint32 words with the reference's
+big-endian swizzle (word index ^ 1, bit index ^ 0x18,
+bloom_filter.cu gpu_bit_to_word_mask) so the word buffer's little-endian
+byte image equals Spark's big-endian long array.
+
+TPU design: a put of N rows with K hashes computes the (N, K) bit
+positions in one vectorized pass, scatters into a boolean bit array
+(duplicate-safe set-to-True), packs to words, and ORs into the filter —
+no atomics needed."""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.ops.hash import _Murmur32, _split_u64
+
+_I32 = jnp.int32
+_I64 = jnp.int64
+_U32 = jnp.uint32
+_U64 = jnp.uint64
+
+INT32_MAX = 2147483647
+
+
+@dataclass
+class BloomFilter:
+    version: int
+    num_hashes: int
+    seed: int                 # 0 for v1 (not serialized)
+    words: jnp.ndarray        # (num_longs*2,) uint32, swizzled layout
+
+    @property
+    def num_longs(self) -> int:
+        return int(self.words.shape[0]) // 2
+
+    @property
+    def num_bits(self) -> int:
+        return self.num_longs * 64
+
+
+def create(num_hashes: int, num_longs: int, version: int = 2,
+           seed: int = 0) -> BloomFilter:
+    if version not in (1, 2):
+        raise ValueError("bloom filter version must be 1 or 2")
+    return BloomFilter(version, num_hashes, seed if version == 2 else 0,
+                       jnp.zeros(num_longs * 2, _U32))
+
+
+def _hash_pair(col: Column, seed: int):
+    """(h1, h2) int32 murmur hashes of an INT64 column
+    (bloom_filter.cu:95-100)."""
+    v = col.data.astype(_I64)
+    lo, hi = _split_u64(v.astype(_U64))
+    h1u = _Murmur32.hash_blocks(
+        jnp.full(v.shape, np.uint32(seed & 0xFFFFFFFF), _U32), [lo, hi], 8)
+    h2u = _Murmur32.hash_blocks(h1u, [lo, hi], 8)
+    return h1u.astype(_I32), h2u.astype(_I32)
+
+
+def _bit_positions(bf: BloomFilter, col: Column) -> jnp.ndarray:
+    """(rows, num_hashes) int64 bit positions."""
+    h1, h2 = _hash_pair(col, bf.seed if bf.version == 2 else 0)
+    k = bf.num_hashes
+    if bf.version == 1:
+        idx = jnp.arange(1, k + 1, dtype=_I32)[None, :]
+        combined = h1[:, None] + idx * h2[:, None]       # int32 wrap
+        pos = jnp.where(combined < 0, ~combined, combined).astype(_I64)
+    else:
+        steps = jnp.arange(1, k + 1, dtype=_I64)[None, :]
+        combined = (h1.astype(_I64) * _I64(INT32_MAX))[:, None] \
+            + steps * h2.astype(_I64)[:, None]           # int64 wrap
+        pos = jnp.where(combined < 0, ~combined, combined)
+    return pos % _I64(bf.num_bits)
+
+
+def _word_and_bit(pos: jnp.ndarray):
+    """gpu_bit_to_word_mask (bloom_filter.cu): big-endian swizzle."""
+    word = (pos // 32) ^ _I64(1)
+    bit = (pos % 32).astype(_I32) ^ _I32(0x18)
+    return word, bit
+
+
+def put(bf: BloomFilter, col: Column) -> BloomFilter:
+    """Insert all valid rows of an INT64 column; returns the updated
+    filter (functional — jax arrays are immutable)."""
+    if col.length == 0:
+        return bf
+    pos = _bit_positions(bf, col)
+    word, bit = _word_and_bit(pos)
+    flat = (word * 32 + bit.astype(_I64)).reshape(-1)
+    if col.validity is not None:
+        keep = jnp.broadcast_to(col.validity.astype(jnp.bool_)[:, None],
+                                pos.shape).reshape(-1)
+        flat = jnp.where(keep, flat, jnp.int64(bf.num_bits))  # dropped
+    bits = jnp.zeros(bf.num_bits + 1, jnp.bool_).at[flat].set(
+        True, mode="drop")[: bf.num_bits]
+    packed = (bits.reshape(-1, 32).astype(_U32)
+              << jnp.arange(32, dtype=_U32)[None, :]).sum(
+        axis=1, dtype=_U32)
+    return BloomFilter(bf.version, bf.num_hashes, bf.seed,
+                       bf.words | packed)
+
+
+def probe(bf: BloomFilter, col: Column) -> Column:
+    """BOOL8 column: row possibly in the filter (bloom_filter.hpp probe)."""
+    if col.length == 0:
+        return Column(dtypes.BOOL8, 0, data=jnp.zeros(0, jnp.uint8))
+    pos = _bit_positions(bf, col)
+    word, bit = _word_and_bit(pos)
+    w = bf.words[jnp.clip(word, 0, bf.words.shape[0] - 1)]
+    hit = (w >> bit.astype(_U32)) & _U32(1)
+    found = jnp.all(hit != 0, axis=1)
+    return Column(dtypes.BOOL8, col.length,
+                  data=found.astype(jnp.uint8), validity=col.validity)
+
+
+def merge(filters: Sequence[BloomFilter]) -> BloomFilter:
+    """OR-combine filters built with identical parameters
+    (bloom_filter.hpp merge)."""
+    first = filters[0]
+    words = first.words
+    for f in filters[1:]:
+        if (f.version, f.num_hashes, f.seed, f.num_longs) != \
+                (first.version, first.num_hashes, first.seed,
+                 first.num_longs):
+            raise ValueError("incompatible bloom filters")
+        words = words | f.words
+    return BloomFilter(first.version, first.num_hashes, first.seed, words)
+
+
+def serialize(bf: BloomFilter) -> bytes:
+    """Spark sketch bytes (BE header + BE longs; the swizzled LE word
+    image IS the BE long image)."""
+    if bf.version == 1:
+        header = struct.pack(">iii", 1, bf.num_hashes, bf.num_longs)
+    else:
+        header = struct.pack(">iiii", 2, bf.num_hashes, bf.seed,
+                             bf.num_longs)
+    return header + np.asarray(bf.words).astype("<u4").tobytes()
+
+
+def deserialize(data: bytes) -> BloomFilter:
+    version = struct.unpack(">i", data[:4])[0]
+    if version == 1:
+        _, num_hashes, num_longs = struct.unpack(">iii", data[:12])
+        seed, off = 0, 12
+    elif version == 2:
+        _, num_hashes, seed, num_longs = struct.unpack(">iiii", data[:16])
+        off = 16
+    else:
+        raise ValueError(f"unsupported bloom filter version {version}")
+    words = np.frombuffer(data, "<u4", num_longs * 2, off)
+    return BloomFilter(version, num_hashes, seed, jnp.asarray(words))

@@ -1,0 +1,32 @@
+"""CASE WHEN fast path (reference case_when.cu/case_when.hpp,
+CaseWhen.java): N boolean WHEN columns -> index of the first true branch
+per row (num_columns = ELSE) for a subsequent gather."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+
+_I32 = jnp.int32
+
+
+def select_first_true_index(bool_cols: Sequence[Column]) -> Column:
+    """(rows,) INT32: index of the first WHEN column whose value is true
+    (null counts as false); len(bool_cols) if none match (the ELSE
+    branch)."""
+    if not bool_cols:
+        raise ValueError("need at least one boolean column")
+    n = len(bool_cols)
+    rows = bool_cols[0].length
+    result = jnp.full((rows,), n, _I32)
+    for i in range(n - 1, -1, -1):
+        c = bool_cols[i]
+        t = c.data.astype(jnp.bool_)
+        if c.validity is not None:
+            t = t & c.validity.astype(jnp.bool_)
+        result = jnp.where(t, _I32(i), result)
+    return Column(dtypes.INT32, rows, data=result)

@@ -1,0 +1,123 @@
+"""Spark substring_index(str, delim, count) (reference
+substring_index.cu/.hpp, GpuSubstringIndexUtils.java).
+
+count > 0: prefix up to (not including) the count-th delimiter occurrence
+from the left; count < 0: suffix after the |count|-th occurrence from the
+right; count == 0 or empty delimiter: empty string; fewer occurrences
+than |count|: whole string.
+
+TPU design: single-byte delimiter matches are a fully vectorized
+sliding-window equality over the padded char matrix with a cumulative
+match count.  Multi-byte delimiters additionally need non-overlapping
+match suppression, which currently runs as a host pass over the match
+matrix (directional: left-to-right for count>0, right-to-left for
+count<0 to match Spark's indexOf/lastIndexOf semantics)."""
+
+from __future__ import annotations
+
+from typing import Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+
+_I32 = jnp.int32
+_U8 = jnp.uint8
+
+
+def substring_index(col: Column, delimiter: Union[str, bytes],
+                    count: int) -> Column:
+    assert col.dtype.is_string
+    rows = col.length
+    delim = delimiter.encode("utf-8") if isinstance(delimiter, str) \
+        else bytes(delimiter)
+    d = len(delim)
+    mask_host = (np.ones(rows, bool) if col.validity is None
+                 else np.asarray(col.validity).astype(bool))
+    if rows == 0 or count == 0 or d == 0:
+        return Column.from_strings(
+            ["" if mask_host[i] else None for i in range(rows)])
+
+    chars, lens = col.to_padded_chars()
+    p = chars.shape[1]
+    if p < d:
+        # no row can contain the delimiter: whole strings
+        keep_len = lens
+    else:
+        # match[i, j]: delim starts at byte j (non-overlapping scan not
+        # needed — Spark counts overlapping occurrences left-to-right is
+        # moot for distinct delimiters; the reference scans forward past
+        # each full match, so suppress overlaps within d bytes)
+        m = jnp.ones((rows, p - d + 1), jnp.bool_)
+        for k, b in enumerate(delim):
+            m = m & (chars[:, k:p - d + 1 + k] == _U8(b))
+        valid_start = jnp.arange(p - d + 1, dtype=_I32)[None, :] <= \
+            (lens - d)[:, None]
+        m = m & valid_start
+        # suppress overlapping matches. Direction matters for
+        # self-overlapping delimiters: Spark scans with indexOf from the
+        # left for count>0 but lastIndexOf from the right for count<0
+        # (substring_index.cu rfind loop)
+        if d > 1:
+            mh = np.asarray(m).copy()
+            for i in range(rows):
+                row = mh[i]
+                if count > 0:
+                    j = 0
+                    while j < row.shape[0]:
+                        if row[j]:
+                            row[j + 1: j + d] = False
+                            j += d
+                        else:
+                            j += 1
+                else:
+                    j = row.shape[0] - 1
+                    while j >= 0:
+                        if row[j]:
+                            row[max(j - d + 1, 0): j] = False
+                            j -= d
+                        else:
+                            j -= 1
+            m = jnp.asarray(mh)
+        cum = jnp.cumsum(m.astype(_I32), axis=1)
+        total = cum[:, -1] if p >= d else jnp.zeros(rows, _I32)
+        if count > 0:
+            # cut before the count-th occurrence
+            hit = (m & (cum == count))
+            # position of that occurrence (or len if fewer)
+            pos = jnp.where(
+                hit.any(axis=1),
+                jnp.argmax(hit, axis=1).astype(_I32), lens)
+            keep_len = jnp.minimum(pos, lens)
+        else:
+            k = -count
+            # keep everything after the (total-k+1)-th occurrence
+            target = total - k + 1
+            hit = (m & (cum == jnp.maximum(target, 1)[:, None]))
+            start = jnp.where(
+                (total >= k) & hit.any(axis=1),
+                jnp.argmax(hit, axis=1).astype(_I32) + d, 0)
+            keep_len = lens - start
+            # gather suffix: build shifted char matrix
+            idx = start[:, None] + jnp.arange(p, dtype=_I32)[None, :]
+            in_r = idx < lens[:, None]
+            idx = jnp.clip(idx, 0, p - 1)
+            chars = jnp.where(in_r, jnp.take_along_axis(chars, idx, axis=1),
+                              _U8(0))
+
+    # rebuild string column from per-row prefixes of `chars`
+    keep_host = np.asarray(keep_len)
+    keep_host = np.where(mask_host, keep_host, 0)
+    new_offs = np.zeros(rows + 1, np.int32)
+    np.cumsum(keep_host, out=new_offs[1:])
+    total_chars = int(new_offs[-1])
+    offs_j = jnp.asarray(new_offs)
+    i_flat = jnp.arange(total_chars, dtype=_I32)
+    r = jnp.searchsorted(offs_j, i_flat, side="right").astype(_I32) - 1
+    cpos = i_flat - offs_j[r]
+    data = chars[r, cpos] if total_chars else jnp.zeros(0, jnp.uint8)
+    validity = col.validity
+    return Column(dtypes.STRING, rows, data=data, validity=validity,
+                  offsets=offs_j)

@@ -1,0 +1,134 @@
+"""Z-order and Hilbert clustering indexes for Delta/Iceberg OPTIMIZE
+(reference zorder.cu/zorder.hpp, ZOrder.java).
+
+interleave_bits: rows of N same-typed fixed-width columns -> per-row byte
+blob of bit-interleaved values, MSB of column 0 first (zorder.cu kernel
+:160-190 bit ordering).  hilbert_index: N INT32 columns -> INT64 Hilbert
+curve index via the Skilling transform (zorder.cu:92-150).
+
+TPU design: both are pure bit-shuffles — expressed as (rows, bits)
+boolean tensors reshaped/packed with static index maps, fully fused by
+XLA; the Skilling loops are static python loops of vector ops.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.columns.dtypes import Kind
+
+_U8 = jnp.uint8
+_U32 = jnp.uint32
+_U64 = jnp.uint64
+_I32 = jnp.int32
+_I64 = jnp.int64
+
+
+def _value_bits_msb(col: Column) -> jnp.ndarray:
+    """(rows, 8*size) bool bits, most significant first; null rows are 0."""
+    kind = col.dtype.kind
+    if kind == Kind.FLOAT32:
+        from jax import lax
+        u = lax.bitcast_convert_type(col.data, _U32).astype(_U64)
+        nbits = 32
+    elif kind == Kind.FLOAT64:
+        u = col.data.astype(_U64)  # raw bits representation
+        nbits = 64
+    else:
+        size = col.dtype.size_bytes
+        nbits = 8 * size
+        u = col.data.astype(jnp.int64).astype(_U64)
+        if nbits < 64:
+            u = u & _U64((1 << nbits) - 1)
+    if col.validity is not None:
+        u = jnp.where(col.validity.astype(jnp.bool_), u, _U64(0))
+    shifts = jnp.arange(nbits - 1, -1, -1, dtype=_U64)
+    return ((u[:, None] >> shifts[None, :]) & _U64(1)).astype(jnp.bool_)
+
+
+def interleave_bits(cols: Sequence[Column]) -> Column:
+    """LIST<UINT8> column: per-row interleaved bits of all columns
+    (ZOrder.interleaveBits)."""
+    if not cols:
+        raise ValueError("The input table must have at least one column.")
+    t0 = cols[0].dtype
+    if not t0.is_fixed_width:
+        raise ValueError("Only fixed width columns can be used")
+    if any(c.dtype != t0 for c in cols):
+        raise ValueError("All columns of the input table must be the same "
+                         "type.")
+    rows = cols[0].length
+    nc = len(cols)
+    bits = jnp.stack([_value_bits_msb(c) for c in cols], axis=1)
+    # (rows, nc, B) -> output bit b*nc + c = bits[:, c, b]
+    inter = jnp.transpose(bits, (0, 2, 1)).reshape(rows, -1)
+    # pack MSB-first into bytes
+    nbytes = inter.shape[1] // 8
+    grouped = inter.reshape(rows, nbytes, 8).astype(_U8)
+    weights = (_U8(1) << jnp.arange(7, -1, -1, dtype=_U8))[None, None, :]
+    packed = (grouped * weights).sum(axis=2, dtype=jnp.uint32).astype(_U8)
+    data = packed.reshape(-1)
+    offsets = jnp.arange(rows + 1, dtype=_I32) * _I32(nbytes)
+    return Column.make_list_from_parts(offsets, data)
+
+
+def hilbert_index(num_bits: int, cols: Sequence[Column]) -> Column:
+    """INT64 Hilbert index of N INT32 coordinate columns (zorder.hpp:34;
+    Skilling transform per zorder.cu)."""
+    if not cols:
+        raise ValueError("at least one column is required.")
+    if any(c.dtype.kind != Kind.INT32 for c in cols):
+        raise ValueError("All columns of the input table must be INT32.")
+    if not 0 < num_bits <= 32:
+        raise ValueError("the number of bits must be >0 and <= 32")
+    if num_bits * len(cols) > 64:
+        raise ValueError("num_bits * num_columns must be <= 64")
+    n = len(cols)
+    mask_val = _U32((1 << num_bits) - 1)
+    x: List[jnp.ndarray] = []
+    for c in cols:
+        u = c.data.astype(_U32) & mask_val
+        if c.validity is not None:
+            u = jnp.where(c.validity.astype(jnp.bool_), u, _U32(0))
+        x.append(u)
+
+    m = 1 << (num_bits - 1)
+    # Inverse undo (zorder.cu:104-115)
+    q = m
+    while q > 1:
+        p = _U32(q - 1)
+        for i in range(n):
+            cond = (x[i] & _U32(q)) != 0
+            t = (x[0] ^ x[i]) & p
+            new_x0 = jnp.where(cond, x[0] ^ p, x[0] ^ t)
+            new_xi = jnp.where(cond, x[i], x[i] ^ t)
+            x[0] = new_x0
+            x[i] = new_xi if i != 0 else x[0]
+        q >>= 1
+    # Gray encode
+    for i in range(1, n):
+        x[i] = x[i] ^ x[i - 1]
+    t = jnp.zeros_like(x[0])
+    q = m
+    while q > 1:
+        t = jnp.where((x[n - 1] & _U32(q)) != 0, t ^ _U32(q - 1), t)
+        q >>= 1
+    for i in range(n):
+        x[i] = x[i] ^ t
+
+    # interleave transposed bits (to_hilbert_index zorder.cu:58-73)
+    out = jnp.zeros(cols[0].length, _U64)
+    b_index = num_bits * n - 1
+    mask = 1 << (num_bits - 1)
+    for _ in range(num_bits):
+        for j in range(n):
+            bit = ((x[j] & _U32(mask)) != 0).astype(_U64)
+            out = out | (bit << _U64(b_index))
+            b_index -= 1
+        mask >>= 1
+    return Column(dtypes.INT64, cols[0].length, data=out.astype(_I64))

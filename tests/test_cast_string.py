@@ -1,0 +1,135 @@
+"""cast_string tests against reference CastStringsTest.java vectors."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.ops import cast_string as CS
+from spark_rapids_tpu.ops.exceptions import CastException
+
+
+def test_to_integer_strip():
+    """castToIntegerTest vectors."""
+    c1 = Column.from_strings([" 3", "9", "4", "2", "20.5", None, "7.6asd",
+                              "\x00 \x1f1\x14"])
+    assert CS.string_to_integer(c1, dtypes.INT64).to_pylist() == \
+        [3, 9, 4, 2, 20, None, None, 1]
+    c2 = Column.from_strings(["5", "1  ", "0", "2", "7.1", None, "asdf",
+                              "\x00 \x1f1\x14"])
+    assert CS.string_to_integer(c2, dtypes.INT32).to_pylist() == \
+        [5, 1, 0, 2, 7, None, None, 1]
+    c3 = Column.from_strings(["2", "3", " 4 ", "5", " 9.2 ", None, "7.8.3",
+                              "\x00 \x1f1\x14"])
+    assert CS.string_to_integer(c3, dtypes.INT8).to_pylist() == \
+        [2, 3, 4, 5, 9, None, None, 1]
+
+
+def test_to_integer_no_strip():
+    """castToIntegerNoStripTest vectors."""
+    c1 = Column.from_strings([" 3", "9", "4", "2", "20.5", None, "7.6asd"])
+    assert CS.string_to_integer(c1, dtypes.INT64, strip=False).to_pylist() \
+        == [None, 9, 4, 2, 20, None, None]
+    c2 = Column.from_strings(["5", "1 ", "0", "2", "7.1", None, "asdf"])
+    assert CS.string_to_integer(c2, dtypes.INT32, strip=False).to_pylist() \
+        == [5, None, 0, 2, 7, None, None]
+    c3 = Column.from_strings(["2", "3", " 4 ", "5.6", " 9.2 ", None,
+                              "7.8.3"])
+    assert CS.string_to_integer(c3, dtypes.INT8, strip=False).to_pylist() \
+        == [2, 3, None, 5, None, None, None]
+
+
+def test_to_integer_signs_overflow_edges():
+    c = Column.from_strings(["-128", "127", "128", "-129", "+5", "-", "+",
+                             "--1", "1-", ""])
+    assert CS.string_to_integer(c, dtypes.INT8).to_pylist() == \
+        [-128, 127, None, None, 5, None, None, None, None, None]
+    c64 = Column.from_strings(["9223372036854775807",
+                               "-9223372036854775808",
+                               "9223372036854775808",
+                               "-9223372036854775809"])
+    assert CS.string_to_integer(c64, dtypes.INT64).to_pylist() == \
+        [2**63 - 1, -2**63, None, None]
+
+
+def test_to_integer_dot_quirks():
+    """'.'-anywhere truncation semantics (cast_string.cu char loop)."""
+    c = Column.from_strings([".", ".5", "+.5", "1.", "1.2.3", ". 5"])
+    assert CS.string_to_integer(c, dtypes.INT32).to_pylist() == \
+        [0, 0, 0, 1, None, None]
+
+
+def test_to_integer_ansi_raises_with_row():
+    c = Column.from_strings(["3", "bad", "5"])
+    with pytest.raises(CastException) as ei:
+        CS.string_to_integer(c, dtypes.INT32, ansi_mode=True)
+    assert ei.value.row_index == 1
+    # nulls don't trip ANSI
+    c2 = Column.from_strings(["3", None, "5"])
+    out = CS.string_to_integer(c2, dtypes.INT32, ansi_mode=True)
+    assert out.to_pylist() == [3, None, 5]
+
+
+def test_to_float_trim():
+    """castToFloatsTrimTest vectors."""
+    c = Column.from_strings([
+        "1.1\x00", "1.2\x14", "1.3\x1f", "\x00\x001.4\x00",
+        "1.5\x00 \x00", "1.6", "1.7!"])
+    out = CS.string_to_float(c, dtypes.FLOAT64).to_pylist()
+    assert out[:5] == [1.1, 1.2, 1.3, 1.4, 1.5]
+    assert out[5] is None and out[6] is None
+
+
+def test_to_float_nan_inf():
+    """castToFloatNanTest/castToFloatsInfTest vectors."""
+    c = Column.from_strings(["nan", "nan ", " nan ", "NAN", "nAn ",
+                             " NAn ", "Nan 0", "+naN", "-nAn"])
+    out = CS.string_to_float(c, dtypes.FLOAT64).to_pylist()
+    assert all(np.isnan(v) for v in out[:6])
+    assert out[6] is None and out[7] is None and out[8] is None
+    c2 = Column.from_strings(["INFINITY ", "inf", "+inf ", " -INF  ",
+                              "INFINITY AND BEYOND", "INF"])
+    out2 = CS.string_to_float(c2, dtypes.FLOAT32).to_pylist()
+    assert out2[:4] == [np.inf, np.inf, np.inf, -np.inf]
+    assert out2[4] is None and out2[5] == np.inf
+
+
+def test_to_double_high_precision():
+    """castToDoubleHighPrecisionTest: must match Java Double.parseDouble
+    bit-for-bit (correctly-rounded path)."""
+    vals = ["1.7976931348623157", "9.9999999999999999",
+            "1.0000000000000001", "1.0000000000000002",
+            "3.1415926535897932", "1.234567890123456789",
+            "-1.7976931348623157", "9007199254740993e10",
+            "12345678901234567e7", "-9007199254740993e15"]
+    c = Column.from_strings(vals)
+    out = CS.string_to_float(c, dtypes.FLOAT64)
+    got = out.to_numpy()
+    expected = np.array([float(v) for v in vals])  # strtod == parseDouble
+    np.testing.assert_array_equal(got.view(np.uint64),
+                                  expected.view(np.uint64))
+
+
+def test_to_float_rejects_python_extensions():
+    c = Column.from_strings(["1_000", "0x10", "1e5", "1e", "  "])
+    out = CS.string_to_float(c, dtypes.FLOAT64).to_pylist()
+    assert out == [None, None, 1e5, None, None]
+
+
+def test_float_to_string_java_format():
+    c = Column.from_pylist(
+        [0.0, -0.0, 1.0, 1.5, 100.0, 1e7, 9999999.0, 0.001, 0.0001,
+         -1.23e-5, float("nan"), float("inf"), float("-inf"), None],
+        dtypes.FLOAT64)
+    out = CS.float_to_string(c).to_pylist()
+    assert out == ["0.0", "-0.0", "1.0", "1.5", "100.0", "1.0E7",
+                   "9999999.0", "0.001", "1.0E-4", "-1.23E-5", "NaN",
+                   "Infinity", "-Infinity", None]
+
+
+def test_float32_to_string():
+    c = Column.from_pylist([1.5, 0.1, 3.4028235e38], dtypes.FLOAT32)
+    out = CS.float_to_string(c).to_pylist()
+    assert out[0] == "1.5"
+    assert out[1] == "0.1"          # shortest f32 repr
+    assert out[2] == "3.4028235E38"

@@ -1,0 +1,259 @@
+"""Tests for arithmetic/aggregation64/case_when/bloom_filter ops
+(reference BloomFilterTest.java params, multiply.hpp/round_float.hpp
+examples)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.ops import aggregation64 as agg64
+from spark_rapids_tpu.ops import arithmetic as ar
+from spark_rapids_tpu.ops import bloom_filter as bf
+from spark_rapids_tpu.ops import case_when as cw
+from spark_rapids_tpu.ops.exceptions import ExceptionWithRowIndex
+
+
+# ----------------------------------------------------------- bloom filter
+
+@pytest.mark.parametrize("version", [1, 2])
+def test_bloom_build_and_probe(version):
+    """BloomFilterTest.testBuildAndProbe: 3 hashes, 4M bits."""
+    f = bf.create(3, 4 * 1024 * 1024 // 64, version=version)
+    inp = Column.from_pylist([20, 80, 100, 99, 47, -9, 234000000],
+                             dtypes.INT64)
+    f = bf.put(f, inp)
+    probe_col = Column.from_pylist(
+        [20, 80, 100, 99, 47, -9, 234000000, -10, 1, 2, 3], dtypes.INT64)
+    out = bf.probe(f, probe_col).to_pylist()
+    assert out == [True] * 7 + [False] * 4
+
+
+@pytest.mark.parametrize("version", [1, 2])
+def test_bloom_nulls(version):
+    f = bf.create(3, 4 * 1024 * 1024 // 64, version=version)
+    inp = Column.from_pylist([None, 80, 100, None, 47, -9, 234000000],
+                             dtypes.INT64)
+    f = bf.put(f, inp)
+    probe_col = Column.from_pylist(
+        [20, 80, 100, 99, 47, -9, 234000000, -10, 1, 2, 3], dtypes.INT64)
+    assert bf.probe(f, probe_col).to_pylist() == \
+        [False, True, True, False, True, True, True, False, False, False,
+         False]
+    probe_nulls = Column.from_pylist([None, 80, None, 2], dtypes.INT64)
+    assert bf.probe(f, probe_nulls).to_pylist() == [None, True, None,
+                                                    False]
+
+
+@pytest.mark.parametrize("version", [1, 2])
+def test_bloom_merge_and_serde(version):
+    f1 = bf.put(bf.create(3, 1024, version=version, seed=7),
+                Column.from_pylist([1, 2, 3], dtypes.INT64))
+    f2 = bf.put(bf.create(3, 1024, version=version, seed=7),
+                Column.from_pylist([100, 200], dtypes.INT64))
+    m = bf.merge([f1, f2])
+    probe_col = Column.from_pylist([1, 2, 3, 100, 200, 999], dtypes.INT64)
+    out = bf.probe(m, probe_col).to_pylist()
+    assert out[:5] == [True] * 5
+    raw = bf.serialize(m)
+    m2 = bf.deserialize(raw)
+    assert bf.probe(m2, probe_col).to_pylist() == out
+    assert raw[:4] == (version).to_bytes(4, "big")
+
+
+def test_bloom_incompatible_merge():
+    f1 = bf.create(3, 64, version=2, seed=1)
+    f2 = bf.create(3, 64, version=2, seed=2)
+    with pytest.raises(ValueError):
+        bf.merge([f1, f2])
+
+
+# ------------------------------------------------------------- arithmetic
+
+def test_multiply_modes():
+    a = Column.from_pylist([2**31 - 1, 3, None], dtypes.INT32)
+    b = Column.from_pylist([2, 4, 5], dtypes.INT32)
+    # regular mode wraps like Java
+    out = ar.multiply(a, b).to_pylist()
+    assert out == [-2, 12, None]
+    # try mode nulls the overflow
+    assert ar.multiply(a, b, is_try_mode=True).to_pylist() == \
+        [None, 12, None]
+    # ansi throws with row index
+    with pytest.raises(ExceptionWithRowIndex) as ei:
+        ar.multiply(a, b, is_ansi_mode=True)
+    assert ei.value.row_index == 0
+
+
+def test_multiply_int64_overflow():
+    a = Column.from_pylist([2**62, -2**63, 5], dtypes.INT64)
+    b = Column.from_pylist([2, -1, 7], dtypes.INT64)
+    out = ar.multiply(a, b, is_try_mode=True).to_pylist()
+    assert out == [None, None, 35]
+
+
+def test_round_integers_and_decimals():
+    a = Column.from_pylist([1729, 1735, -1735], dtypes.INT64)
+    assert ar.round_column(a, -1).to_pylist() == [1730, 1740, -1740]
+    assert ar.round_column(a, -1, ar.HALF_EVEN).to_pylist() == \
+        [1730, 1740, -1740]
+    b = Column.from_pylist([15, 25], dtypes.INT64)
+    assert ar.round_column(b, -1, ar.HALF_EVEN).to_pylist() == [20, 20]
+    assert ar.round_column(b, -1, ar.HALF_UP).to_pylist() == [20, 30]
+
+
+def test_round_floats():
+    """round_float.hpp examples."""
+    a = Column.from_pylist([1.729, 17.29, 172.9, 1729.0], dtypes.FLOAT64)
+    assert ar.round_column(a, 1).to_pylist() == [1.7, 17.3, 172.9, 1729.0]
+    b = Column.from_pylist([1.5, 2.5, 15.0, 25.0], dtypes.FLOAT64)
+    assert ar.round_column(b, 0, ar.HALF_EVEN).to_pylist() == \
+        [2.0, 2.0, 15.0, 25.0]
+    assert ar.round_column(b, 0, ar.HALF_UP).to_pylist() == \
+        [2.0, 3.0, 15.0, 25.0]
+    special = Column.from_pylist([float("nan"), float("inf")],
+                                 dtypes.FLOAT64)
+    out = ar.round_column(special, 2).to_pylist()
+    assert np.isnan(out[0]) and out[1] == np.inf
+
+
+# ---------------------------------------------------------- aggregation64
+
+def test_agg64_chunks_roundtrip():
+    vals = [2**62, -2**62, 123456789012345, -1, 0, None]
+    c = Column.from_pylist(vals, dtypes.INT64)
+    lo = agg64.extract_chunk32_from_64bit(c, dtypes.UINT32, 0)
+    hi = agg64.extract_chunk32_from_64bit(c, dtypes.INT32, 1)
+    # single-row "sums" reassemble to the original values
+    lo64 = Column(dtypes.INT64, c.length,
+                  data=lo.data.astype(np.int64), validity=lo.validity)
+    hi64 = Column(dtypes.INT64, c.length,
+                  data=hi.data.astype(np.int64), validity=hi.validity)
+    ovf, val = agg64.assemble64_from_sum(lo64, hi64)
+    assert val.to_pylist() == vals
+    assert ovf.to_pylist() == [False] * 5 + [None]
+
+
+def test_agg64_sum_with_overflow_detection():
+    # sum of chunks across many rows: simulate SUM(int64) that overflows
+    vals = [2**62, 2**62, 2**62]  # true sum = 3*2^62 > int64 max
+    c = Column.from_pylist(vals, dtypes.INT64)
+    lo = np.asarray(agg64.extract_chunk32_from_64bit(
+        c, dtypes.UINT32, 0).data).astype(np.int64).sum()
+    hi = np.asarray(agg64.extract_chunk32_from_64bit(
+        c, dtypes.INT32, 1).data).astype(np.int64).sum()
+    ovf, val = agg64.assemble64_from_sum(
+        Column.from_pylist([int(lo)], dtypes.INT64),
+        Column.from_pylist([int(hi)], dtypes.INT64))
+    assert ovf.to_pylist() == [True]
+    # and a non-overflowing sum reassembles exactly
+    vals2 = [2**40, -2**41, 77]
+    c2 = Column.from_pylist(vals2, dtypes.INT64)
+    lo2 = np.asarray(agg64.extract_chunk32_from_64bit(
+        c2, dtypes.UINT32, 0).data).astype(np.int64).sum()
+    hi2 = np.asarray(agg64.extract_chunk32_from_64bit(
+        c2, dtypes.INT32, 1).data).astype(np.int64).sum()
+    ovf2, val2 = agg64.assemble64_from_sum(
+        Column.from_pylist([int(lo2)], dtypes.INT64),
+        Column.from_pylist([int(hi2)], dtypes.INT64))
+    assert ovf2.to_pylist() == [False]
+    assert val2.to_pylist() == [sum(vals2)]
+
+
+# -------------------------------------------------------------- case_when
+
+def test_select_first_true_index():
+    w1 = Column.from_pylist([True, False, None, False], dtypes.BOOL8)
+    w2 = Column.from_pylist([True, True, False, False], dtypes.BOOL8)
+    out = cw.select_first_true_index([w1, w2])
+    assert out.to_pylist() == [0, 1, 2, 2]  # null counts as false; 2=ELSE
+
+
+# ---------------------------------------------------------------- zorder
+
+def test_interleave_bits_two_int32():
+    from spark_rapids_tpu.ops import zorder as Z
+    a = Column.from_pylist([0b1010, 0], dtypes.INT32)
+    b = Column.from_pylist([0b0101, None], dtypes.INT32)
+    out = Z.interleave_bits([a, b])
+    blobs = out.to_pylist()
+    assert len(blobs[0]) == 8
+    # low byte region: bits of a=1010, b=0101 interleaved (a most
+    # significant): ...a3 b3 a2 b2 a1 b1 a0 b0 = 10011001 -> 0x99
+    assert bytes(blobs[0])[-1] == 0x99
+    assert bytes(blobs[1]) == b"\x00" * 8  # null treated as 0
+
+
+def test_interleave_bits_rejects_mixed():
+    from spark_rapids_tpu.ops import zorder as Z
+    with pytest.raises(ValueError):
+        Z.interleave_bits([Column.from_pylist([1], dtypes.INT32),
+                           Column.from_pylist([1], dtypes.INT64)])
+
+
+def test_hilbert_index_basics():
+    from spark_rapids_tpu.ops import zorder as Z
+    # 2-D, 2-bit hilbert curve: (0,0)=0 (1,1)=2 visits all 16 cells once
+    xs = Column.from_pylist(list(range(4)) * 4, dtypes.INT32)
+    ys = Column.from_pylist([y for y in range(4) for _ in range(4)],
+                            dtypes.INT32)
+    out = Z.hilbert_index(2, [xs, ys]).to_pylist()
+    assert sorted(out) == list(range(16))  # a permutation: space-filling
+    assert out[0] == 0  # origin at 0
+
+
+# -------------------------------------------------------- substring_index
+
+def test_substring_index_reference_vectors():
+    """GpuSubstringIndexUtilsTest vectors."""
+    from spark_rapids_tpu.ops.substring_index import substring_index
+    cases = [
+        ("www.apache.org", ".", 3, "www.apache.org"),
+        ("www.apache.org", ".", 2, "www.apache"),
+        ("www.apache.org", ".", 1, "www"),
+        ("www.apache.org", ".", 0, ""),
+        ("www.apache.org", ".", -1, "org"),
+        ("www.apache.org", ".", -2, "apache.org"),
+        ("www.apache.org", ".", -3, "www.apache.org"),
+        ("", ".", -2, ""),
+        ("大千世界大千世界", "千", 2, "大千世界大"),
+        ("www||apache||org", "||", 2, "www||apache"),
+    ]
+    for s, delim, count, expected in cases:
+        c = Column.from_strings([s])
+        got = substring_index(c, delim, count).to_pylist()[0]
+        assert got == expected, (s, delim, count, got)
+
+
+def test_substring_index_nulls_and_batch():
+    from spark_rapids_tpu.ops.substring_index import substring_index
+    c = Column.from_strings(["a.b.c", None, "no-delim", ".leading",
+                             "trailing."])
+    out = substring_index(c, ".", 1).to_pylist()
+    assert out == ["a", None, "no-delim", "", "trailing"]
+    out2 = substring_index(c, ".", -1).to_pylist()
+    assert out2 == ["c", None, "no-delim", "leading", ""]
+
+
+def test_review_regressions():
+    from spark_rapids_tpu.ops.substring_index import substring_index
+    from spark_rapids_tpu.ops import zorder as Z
+    from spark_rapids_tpu.ops import cast_string as CS
+    # right-to-left matching for negative counts of overlapping delims
+    assert substring_index(Column.from_strings(["aaa"]), "aa",
+                           -1).to_pylist() == [""]
+    # round far beyond the type range -> 0, not a crash
+    assert ar.round_column(Column.from_pylist([12345], dtypes.INT64),
+                           -19).to_pylist() == [0]
+    assert ar.round_column(
+        Column.from_pylist([123], dtypes.decimal64(-2)),
+        -25).to_pylist() == [0]
+    # hilbert num_bits validation
+    with pytest.raises(ValueError, match="number of bits"):
+        Z.hilbert_index(33, [Column.from_pylist([1], dtypes.INT32)])
+    with pytest.raises(ValueError, match="number of bits"):
+        Z.hilbert_index(0, [Column.from_pylist([1], dtypes.INT32)])
+    # unsigned targets reject signs
+    c = Column.from_strings(["+1", "-0", "7"])
+    assert CS.string_to_integer(c, dtypes.UINT32).to_pylist() == \
+        [None, None, 7]
