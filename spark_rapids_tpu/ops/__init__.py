@@ -50,3 +50,19 @@ from spark_rapids_tpu.ops.exceptions import (  # noqa: F401
     ExceptionWithRowIndex,
     CastException,
 )
+from spark_rapids_tpu.ops.joins import (  # noqa: F401
+    sort_merge_inner_join,
+    hash_inner_join,
+    filter_join_pairs,
+    make_left_outer,
+    make_full_outer,
+    make_semi,
+    make_anti,
+    get_matched_rows,
+)
+from spark_rapids_tpu.ops.groupby import groupby_aggregate  # noqa: F401
+from spark_rapids_tpu.ops import hllpp  # noqa: F401
+from spark_rapids_tpu.ops.histogram import (  # noqa: F401
+    create_histogram_if_valid,
+    percentile_from_histogram,
+)

@@ -1,0 +1,150 @@
+"""Hash group-by aggregation (the BASELINE.json config-2 workload:
+"hash group-by aggregate on 1e7-row int64/float64 Table").
+
+TPU-first design: no device hash tables — group ids come from key
+canonicalization (shared with joins), and the aggregations run as
+jax.ops.segment_* reductions on device, which XLA lowers to efficient
+sorted-segment ops.  int64 SUM wraps on overflow (Java semantics); the
+plan layer detects overflow with ops/aggregation64.py chunk sums, exactly
+as the reference plugin orchestrates Aggregation64Utils around cudf sums.
+Float MIN/MAX run on total-order keys so NaN ordering (largest) and
+-0.0/0.0 bit patterns match Spark for both f32 and f64.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.columns.dtypes import Kind
+from spark_rapids_tpu.columns.table import Table
+from spark_rapids_tpu.ops.copying import gather_table
+from spark_rapids_tpu.ops.joins import _column_rank_host
+from spark_rapids_tpu.utils import floats
+
+SUM = "sum"
+COUNT = "count"
+MIN = "min"
+MAX = "max"
+MEAN = "mean"
+
+
+def _group_ids(keys: Table) -> Tuple[jnp.ndarray, np.ndarray, int]:
+    """(per-row group id (device), first-row index per group (host),
+    num_groups).  Nulls group together (Spark GROUP BY semantics)."""
+    cols = []
+    for c in keys.columns:
+        rank, mask = _column_rank_host(c)
+        cols.append(np.where(mask, rank + 1, np.int64(0)))  # 0 = null
+    key_mat = np.stack(cols, axis=1) if cols else \
+        np.zeros((keys.num_rows, 0), np.int64)
+    uniq, first_idx, ids = np.unique(key_mat, axis=0, return_index=True,
+                                     return_inverse=True)
+    return jnp.asarray(ids.astype(np.int32)), first_idx, len(uniq)
+
+
+def _value_f64(col: Column) -> jnp.ndarray:
+    if col.dtype.kind == Kind.FLOAT64:
+        return floats.bits_to_f64_compute(col.data)
+    return col.data
+
+
+def groupby_aggregate(keys: Table, values: Sequence[Column],
+                      aggs: Sequence[str]) -> Table:
+    """One output row per distinct key; columns = keys then one per
+    (value, agg) pair.  Null values are excluded from aggregates
+    (Spark semantics); count counts non-null values."""
+    if len(values) != len(aggs):
+        raise ValueError("values and aggs must align")
+    ids, first_idx, ngroups = _group_ids(keys)
+    out_keys = gather_table(keys, jnp.asarray(first_idx.astype(np.int32)))
+    out_cols: List[Column] = list(out_keys.columns)
+    for col, agg in zip(values, aggs):
+        out_cols.append(_aggregate_one(col, agg, ids, ngroups))
+    names = None
+    if keys.names is not None:
+        names = list(keys.names) + [f"agg{i}" for i in range(len(values))]
+    return Table(out_cols, names)
+
+
+def _aggregate_one(col: Column, agg: str, ids: jnp.ndarray,
+                   ngroups: int) -> Column:
+    kind = col.dtype.kind
+    valid = col.valid_mask()
+    counts = jax.ops.segment_sum(valid.astype(jnp.int64), ids, ngroups)
+    if agg == COUNT:
+        return Column(dtypes.INT64, ngroups, data=counts)
+    is_float = kind in (Kind.FLOAT32, Kind.FLOAT64)
+    x = _value_f64(col) if kind == Kind.FLOAT64 else col.data
+    if agg in (SUM, MEAN):
+        if is_float:
+            xz = jnp.where(valid, x, 0.0)
+            s = jax.ops.segment_sum(xz.astype(jnp.float64), ids, ngroups)
+            if agg == MEAN:
+                s = s / jnp.maximum(counts, 1).astype(jnp.float64)
+            validity = (counts > 0).astype(jnp.uint8)
+            if kind == Kind.FLOAT64 or agg == MEAN:
+                return Column(dtypes.FLOAT64, ngroups,
+                              data=floats.f64_compute_to_bits(s),
+                              validity=validity)
+            return Column(col.dtype, ngroups,
+                          data=s.astype(jnp.float32), validity=validity)
+        xz = jnp.where(valid, x.astype(jnp.int64), 0)
+        s = jax.ops.segment_sum(xz, ids, ngroups)
+        validity = (counts > 0).astype(jnp.uint8)
+        if agg == MEAN:
+            m = s.astype(jnp.float64) / jnp.maximum(counts, 1).astype(
+                jnp.float64)
+            return Column(dtypes.FLOAT64, ngroups,
+                          data=floats.f64_compute_to_bits(m),
+                          validity=validity)
+        return Column(dtypes.INT64, ngroups, data=s, validity=validity)
+    if agg in (MIN, MAX):
+        validity = (counts > 0).astype(jnp.uint8)
+        if kind == Kind.FLOAT64:
+            # bit-exact via the total-order transform: min/max on keys
+            key = floats.total_order_key(col.data)
+            fill = jnp.int64(2**63 - 1) if agg == MIN else \
+                jnp.int64(-2**63)
+            kz = jnp.where(valid, key, fill)
+            seg = jax.ops.segment_min if agg == MIN else \
+                jax.ops.segment_max
+            best = seg(kz, ids, ngroups)
+            # invert the total-order transform back to raw bits
+            shifted = (best + jnp.int64(2**63 - 1) + 1).astype(jnp.uint64)
+            neg = (shifted >> jnp.uint64(63)) == 0
+            bits = jnp.where(neg, ~shifted,
+                             shifted ^ jnp.uint64(1 << 63))
+            return Column(col.dtype, ngroups, data=bits,
+                          validity=validity)
+        if is_float:  # float32 via the 32-bit total-order transform
+            from jax import lax
+            bits = lax.bitcast_convert_type(x, jnp.uint32)
+            negb = (bits >> jnp.uint32(31)) != 0
+            flipped = jnp.where(negb, ~bits, bits | jnp.uint32(1 << 31))
+            key = flipped.astype(jnp.int64)  # 0..2^32-1, NaN sorts largest
+            fill = jnp.int64(2**32) if agg == MIN else jnp.int64(-1)
+            kz = jnp.where(valid, key, fill)
+            seg = jax.ops.segment_min if agg == MIN else \
+                jax.ops.segment_max
+            best = seg(kz, ids, ngroups)
+            bu32 = jnp.clip(best, 0, 2**32 - 1).astype(jnp.uint32)
+            neg_out = (bu32 >> jnp.uint32(31)) == 0
+            outbits = jnp.where(neg_out, ~bu32,
+                                bu32 ^ jnp.uint32(1 << 31))
+            return Column(col.dtype, ngroups,
+                          data=lax.bitcast_convert_type(outbits,
+                                                        jnp.float32),
+                          validity=validity)
+        info = np.iinfo(col.dtype.np_dtype)
+        fill = info.max if agg == MIN else info.min
+        xz = jnp.where(valid, x, jnp.array(fill, x.dtype))
+        seg = jax.ops.segment_min if agg == MIN else jax.ops.segment_max
+        return Column(col.dtype, ngroups, data=seg(xz, ids, ngroups),
+                      validity=validity)
+    raise ValueError(f"unknown aggregation {agg}")

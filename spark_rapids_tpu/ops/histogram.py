@@ -1,0 +1,118 @@
+"""Histogram build + percentile (reference histogram.cu/.hpp,
+Histogram.java): Spark percentile() over (value, frequency) histograms.
+
+create_histogram_if_valid: (values, frequencies) -> LIST<STRUCT<value,
+freq>> per input row (validating freq >= 0); percentile_from_histogram:
+for each histogram row, Spark percentile interpolation at the requested
+percentages."""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.ops.exceptions import ExceptionWithRowIndex
+from spark_rapids_tpu.utils import floats
+
+_I32 = jnp.int32
+_I64 = jnp.int64
+
+
+def create_histogram_if_valid(values: Column, frequencies: Column,
+                              output_as_lists: bool = True) -> Column:
+    """Per input row i: a one-element histogram [{value_i, freq_i}], or an
+    empty list (lists mode) / null struct row (struct mode) when the value
+    is null or freq <= 0.  Null or negative frequencies raise
+    (histogram.cu:374-440 contract)."""
+    rows = values.length
+    freqs = np.asarray(frequencies.to_numpy()).astype(np.int64)
+    fmask = (np.ones(frequencies.length, bool)
+             if frequencies.validity is None
+             else np.asarray(frequencies.validity).astype(bool))
+    if not fmask.all():
+        raise ExceptionWithRowIndex(int(np.argmax(~fmask)),
+                                    "frequency must not be null")
+    neg = freqs < 0
+    if neg.any():
+        raise ExceptionWithRowIndex(int(np.argmax(neg)),
+                                    "frequency must not be negative")
+    vmask = (np.ones(rows, bool) if values.validity is None
+             else np.asarray(values.validity).astype(bool))
+    keep = vmask & (freqs > 0)
+    if not output_as_lists:
+        freq_col = Column(dtypes.INT64, rows, data=jnp.asarray(freqs),
+                          validity=jnp.asarray(keep.astype(np.uint8)))
+        return Column.make_struct(rows, [values, freq_col],
+                                  validity=keep.astype(np.uint8))
+    # lists mode: element stream keeps only valid pairs; each input row's
+    # list holds 0 or 1 element
+    keep_idx = jnp.asarray(np.nonzero(keep)[0].astype(np.int32))
+    from spark_rapids_tpu.ops.copying import gather
+    kept_vals = gather(values, keep_idx)
+    kept_freqs = Column(dtypes.INT64, int(keep.sum()),
+                        data=jnp.asarray(freqs[keep]))
+    st = Column.make_struct(kept_vals.length, [kept_vals, kept_freqs])
+    offsets = np.zeros(rows + 1, np.int32)
+    np.cumsum(keep.astype(np.int32), out=offsets[1:])
+    return Column(dtypes.LIST, rows, offsets=jnp.asarray(offsets),
+                  children=(st,))
+
+
+def percentile_from_histogram(histogram: Column,
+                              percentages: Sequence[float],
+                              output_as_list: bool = True) -> Column:
+    """Spark percentile(): sort each histogram by value, walk cumulative
+    frequencies, linear-interpolate at p*(total-1)
+    (histogram.hpp percentile_from_histogram)."""
+    assert histogram.dtype.kind == "list"
+    st = histogram.children[0]
+    vals_col, freq_col = st.children
+    offs = np.asarray(histogram.offsets)
+    vals = np.asarray(vals_col.to_numpy()).astype(np.float64)
+    freqs = np.asarray(freq_col.to_numpy()).astype(np.int64)
+    rows = histogram.length
+    out: List = []
+    hmask = (np.ones(rows, bool) if histogram.validity is None
+             else np.asarray(histogram.validity).astype(bool))
+    for i in range(rows):
+        if not hmask[i]:
+            out.append(None)
+            continue
+        v = vals[offs[i]:offs[i + 1]]
+        f = freqs[offs[i]:offs[i + 1]]
+        if len(v) == 0:
+            out.append(None)
+            continue
+        order = np.argsort(v, kind="stable")
+        v, f = v[order], f[order]
+        cum = np.cumsum(f)
+        total = cum[-1]
+        row_out = []
+        for p in percentages:
+            pos = p * (total - 1)
+            lo = int(np.floor(pos))
+            hi = int(np.ceil(pos))
+            # index of first cumulative count > lo / > hi
+            li = int(np.searchsorted(cum, lo + 1, side="left"))
+            hi_i = int(np.searchsorted(cum, hi + 1, side="left"))
+            vlo, vhi = v[li], v[hi_i]
+            row_out.append(vlo + (pos - lo) * (vhi - vlo))
+        out.append(row_out)
+    if output_as_list:
+        flat = [x for row in out if row is not None for x in row]
+        child = Column.from_pylist(flat, dtypes.FLOAT64)
+        offsets = np.zeros(rows + 1, np.int32)
+        acc = 0
+        for i, row in enumerate(out):
+            acc += 0 if row is None else len(row)
+            offsets[i + 1] = acc
+        validity = None if all(r is not None for r in out) else \
+            jnp.asarray(np.array([r is not None for r in out], np.uint8))
+        return Column(dtypes.LIST, rows, validity=validity,
+                      offsets=jnp.asarray(offsets), children=(child,))
+    return Column.from_pylist(
+        [row[0] if row else None for row in out], dtypes.FLOAT64)

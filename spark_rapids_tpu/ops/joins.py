@@ -1,0 +1,230 @@
+"""Join primitives (reference join_primitives.hpp/.cu, JoinPrimitives.java):
+sort_merge_inner_join / hash_inner_join -> (left_indices, right_indices)
+gather maps, plus the index transforms make_left_outer / make_full_outer /
+make_semi / make_anti / get_matched_rows and conditional pair filtering.
+
+TPU-first design (SURVEY.md §7.4): sort-based equality matching — TPUs
+have no device hash tables, but argsort/segment ops vectorize well.  Keys
+are reduced to per-column total-order rank arrays (floats via the raw-bit
+total-order transform, strings via host ordinal ranking for now), combined
+lexicographically, and matched by group: both sides' rows are bucketed by
+canonical key id, and the inner join emits the per-group cross products.
+Pair expansion sizes are data-dependent, so the expansion happens at the
+eager boundary (host offsets + device gathers) — the budgeted-chunk
+device path is future work.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.columns.dtypes import Kind
+from spark_rapids_tpu.columns.table import Table
+from spark_rapids_tpu.utils import floats
+
+_I32 = jnp.int32
+
+NULL_EQUAL = "EQUAL"
+NULL_UNEQUAL = "UNEQUAL"
+
+
+def _column_rank_host(col: Column) -> Tuple[np.ndarray, np.ndarray]:
+    """(rank int64 array, null mask) — ranks order rows like the column's
+    natural ordering; nulls get rank -1."""
+    kind = col.dtype.kind
+    mask = (np.ones(col.length, bool) if col.validity is None
+            else np.asarray(col.validity).astype(bool))
+    if kind in (Kind.STRING, Kind.DECIMAL128):
+        _, inv = np.unique(_raw_values(col), return_inverse=True)
+        rank = inv.astype(np.int64)
+    elif kind == Kind.FLOAT64:
+        rank = np.asarray(floats.total_order_key(col.data))
+    elif kind == Kind.FLOAT32:
+        import jax.numpy as _j
+        from jax import lax
+        bits = np.asarray(lax.bitcast_convert_type(col.data, _j.uint32))
+        flipped = np.where(bits >> 31 != 0, ~bits,
+                           bits | np.uint32(1 << 31)).astype(np.int64)
+        rank = flipped
+    else:
+        rank = np.asarray(col.to_numpy()).astype(np.int64, copy=False)
+    rank = np.where(mask, rank, 0)
+    return rank, mask
+
+
+def _key_ids(left: Table, right: Table, compare_nulls: str):
+    """Canonical group id per row of left and right (equal keys <=> equal
+    id), plus per-row key-validity (any null key under UNEQUAL = no
+    match)."""
+    nl, nr = left.num_rows, right.num_rows
+    cols = list(zip(left.columns, right.columns))
+    ranks = []
+    valid_l = np.ones(nl, bool)
+    valid_r = np.ones(nr, bool)
+    for lc, rc in cols:
+        if lc.dtype.kind != rc.dtype.kind:
+            raise ValueError("join key dtypes must match")
+        if lc.dtype.kind in (Kind.STRING, Kind.DECIMAL128):
+            # ordinal ranks must be comparable across tables: rank jointly
+            # (single extraction pass per column)
+            lm = (np.ones(nl, bool) if lc.validity is None
+                  else np.asarray(lc.validity).astype(bool))
+            rm = (np.ones(nr, bool) if rc.validity is None
+                  else np.asarray(rc.validity).astype(bool))
+            lvals, rvals = _raw_values(lc), _raw_values(rc)
+            _, inv = np.unique(np.concatenate([lvals, rvals]),
+                               return_inverse=True)
+            lr, rr = inv[:nl].astype(np.int64), inv[nl:].astype(np.int64)
+        else:
+            lr, lm = _column_rank_host(lc)
+            rr, rm = _column_rank_host(rc)
+        # encode null as a distinct smallest value
+        lcol = np.where(lm, lr, np.int64(np.iinfo(np.int64).min))
+        rcol = np.where(rm, rr, np.int64(np.iinfo(np.int64).min))
+        ranks.append((lcol, rcol))
+        if compare_nulls == NULL_UNEQUAL:
+            valid_l &= lm
+            valid_r &= rm
+    lkey = np.stack([a for a, _ in ranks], axis=0) if ranks else \
+        np.zeros((0, nl), np.int64)
+    rkey = np.stack([b for _, b in ranks], axis=0) if ranks else \
+        np.zeros((0, nr), np.int64)
+    both = np.concatenate([lkey, rkey], axis=1)
+    _, ids = np.unique(both.T, axis=0, return_inverse=True) if \
+        both.shape[1] else (None, np.zeros(0, np.int64))
+    return ids[:nl], ids[nl:], valid_l, valid_r
+
+
+def _raw_values(col: Column) -> np.ndarray:
+    kind = col.dtype.kind
+    if kind == Kind.STRING:
+        chars = np.asarray(col.data).tobytes() if col.data is not None \
+            else b""
+        offs = np.asarray(col.offsets)
+        return np.array([chars[offs[i]:offs[i + 1]]
+                         for i in range(col.length)], dtype=object)
+    if kind == Kind.DECIMAL128:
+        limbs = np.asarray(col.data).astype(np.uint32).astype(object)
+        vals = (limbs[:, 0] + (limbs[:, 1] << 32) + (limbs[:, 2] << 64)
+                + (limbs[:, 3] << 96))
+        return np.where(vals >= (1 << 127), vals - (1 << 128), vals)
+    raise AssertionError
+
+
+def sort_merge_inner_join(left_keys: Table, right_keys: Table,
+                          compare_nulls: str = NULL_EQUAL
+                          ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(left_indices, right_indices) gather maps of matching row pairs
+    (join_primitives.hpp:64).  Pair order: grouped by key, row-order
+    within group."""
+    lid, rid, lval, rval = _key_ids(left_keys, right_keys, compare_nulls)
+    nl = left_keys.num_rows
+    # bucket right rows by id
+    order_r = np.argsort(rid, kind="stable")
+    rid_sorted = rid[order_r]
+    # for each left row, locate its id-run in the sorted right side
+    starts = np.searchsorted(rid_sorted, lid, side="left")
+    ends = np.searchsorted(rid_sorted, lid, side="right")
+    counts = ends - starts
+    lrows = np.arange(nl)
+    if compare_nulls == NULL_UNEQUAL:
+        counts = np.where(lval, counts, 0)
+    # drop right rows that are invalid under UNEQUAL: since any null key
+    # made the whole row invalid, exclude them from the runs
+    if compare_nulls == NULL_UNEQUAL and not rval.all():
+        keep = rval[order_r]
+        # recompute runs against only valid rows
+        order_r = order_r[keep]
+        rid_sorted = rid[order_r]
+        starts = np.searchsorted(rid_sorted, lid, side="left")
+        ends = np.searchsorted(rid_sorted, lid, side="right")
+        counts = np.where(lval, ends - starts, 0)
+    total = int(counts.sum())
+    left_out = np.repeat(lrows, counts)
+    offs = np.zeros(nl + 1, np.int64)
+    np.cumsum(counts, out=offs[1:])
+    pos = np.arange(total) - offs[left_out]
+    right_out = order_r[starts[left_out] + pos]
+    return (jnp.asarray(left_out.astype(np.int32)),
+            jnp.asarray(right_out.astype(np.int32)))
+
+
+def hash_inner_join(left_keys: Table, right_keys: Table,
+                    compare_nulls: str = NULL_EQUAL):
+    """Same contract as the reference hash_inner_join
+    (join_primitives.hpp:87); on TPU both strategies reduce to the
+    sort/group core (no device hash tables)."""
+    return sort_merge_inner_join(left_keys, right_keys, compare_nulls)
+
+
+def filter_join_pairs(left_indices: jnp.ndarray,
+                      right_indices: jnp.ndarray,
+                      predicate: jnp.ndarray):
+    """Keep pairs where predicate (bool per pair) holds
+    (join_primitives.hpp conditional filtering — the AST predicate is
+    evaluated by the caller over gathered pair columns)."""
+    keep = np.asarray(predicate).astype(bool)
+    li = np.asarray(left_indices)[keep]
+    ri = np.asarray(right_indices)[keep]
+    return jnp.asarray(li), jnp.asarray(ri)
+
+
+def make_left_outer(left_indices, right_indices, left_num_rows: int):
+    """Add unmatched left rows with right index -1 (null sentinel,
+    join_primitives.hpp:145)."""
+    li = np.asarray(left_indices)
+    ri = np.asarray(right_indices)
+    matched = np.zeros(left_num_rows, bool)
+    matched[li] = True
+    missing = np.nonzero(~matched)[0].astype(li.dtype)
+    out_l = np.concatenate([li, missing])
+    out_r = np.concatenate([ri, np.full(missing.shape, -1, ri.dtype)])
+    return jnp.asarray(out_l), jnp.asarray(out_r)
+
+
+def make_full_outer(left_indices, right_indices, left_num_rows: int,
+                    right_num_rows: int):
+    """Unmatched rows from both sides with -1 sentinels
+    (join_primitives.hpp:169)."""
+    li = np.asarray(left_indices)
+    ri = np.asarray(right_indices)
+    lmatched = np.zeros(left_num_rows, bool)
+    lmatched[li] = True
+    rmatched = np.zeros(right_num_rows, bool)
+    rmatched[ri] = True
+    lmiss = np.nonzero(~lmatched)[0].astype(li.dtype)
+    rmiss = np.nonzero(~rmatched)[0].astype(ri.dtype)
+    out_l = np.concatenate([li, lmiss, np.full(rmiss.shape, -1, li.dtype)])
+    out_r = np.concatenate([ri, np.full(lmiss.shape, -1, ri.dtype), rmiss])
+    return jnp.asarray(out_l), jnp.asarray(out_r)
+
+
+def make_semi(left_indices, left_num_rows: int):
+    """Distinct left rows with >=1 match (join_primitives.hpp:194)."""
+    li = np.asarray(left_indices)
+    matched = np.zeros(left_num_rows, bool)
+    matched[li] = True
+    return jnp.asarray(np.nonzero(matched)[0].astype(np.int32))
+
+
+def make_anti(left_indices, left_num_rows: int):
+    """Left rows with no match (join_primitives.hpp:213)."""
+    li = np.asarray(left_indices)
+    matched = np.zeros(left_num_rows, bool)
+    matched[li] = True
+    return jnp.asarray(np.nonzero(~matched)[0].astype(np.int32))
+
+
+def get_matched_rows(indices, num_rows: int) -> Column:
+    """BOOL8 column marking rows present in the gather map
+    (join_primitives.hpp:237)."""
+    idx = np.asarray(indices)
+    matched = np.zeros(num_rows, bool)
+    matched[idx[idx >= 0]] = True
+    return Column(dtypes.BOOL8, num_rows,
+                  data=jnp.asarray(matched.astype(np.uint8)))

@@ -1,0 +1,156 @@
+"""Join primitives + groupby tests (reference JoinPrimitivesTest.java
+contract)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.columns.table import Table
+from spark_rapids_tpu.ops import groupby as gb
+from spark_rapids_tpu.ops import joins as J
+from spark_rapids_tpu.ops.copying import gather_table
+
+
+def pairs(li, ri):
+    return sorted(zip(np.asarray(li).tolist(), np.asarray(ri).tolist()))
+
+
+def test_inner_join_basic():
+    left = Table([Column.from_pylist([1, 2, 3, 2], dtypes.INT64)])
+    right = Table([Column.from_pylist([2, 4, 1, 2], dtypes.INT64)])
+    li, ri = J.sort_merge_inner_join(left, right)
+    assert pairs(li, ri) == [(0, 2), (1, 0), (1, 3), (3, 0), (3, 3)]
+    li2, ri2 = J.hash_inner_join(left, right)
+    assert pairs(li2, ri2) == pairs(li, ri)
+
+
+def test_inner_join_multi_key_mixed_types():
+    left = Table([
+        Column.from_pylist([1, 1, 2], dtypes.INT32),
+        Column.from_strings(["a", "b", "a"]),
+    ])
+    right = Table([
+        Column.from_pylist([1, 2, 1], dtypes.INT32),
+        Column.from_strings(["b", "a", "z"]),
+    ])
+    li, ri = J.sort_merge_inner_join(left, right)
+    assert pairs(li, ri) == [(1, 0), (2, 1)]
+
+
+def test_join_null_equality():
+    left = Table([Column.from_pylist([1, None, 3], dtypes.INT64)])
+    right = Table([Column.from_pylist([None, 3], dtypes.INT64)])
+    li, ri = J.sort_merge_inner_join(left, right, J.NULL_EQUAL)
+    assert pairs(li, ri) == [(1, 0), (2, 1)]
+    li2, ri2 = J.sort_merge_inner_join(left, right, J.NULL_UNEQUAL)
+    assert pairs(li2, ri2) == [(2, 1)]
+
+
+def test_join_float_keys_bit_exact():
+    left = Table([Column.from_pylist([1.5, -0.0, float("nan")],
+                                     dtypes.FLOAT64)])
+    right = Table([Column.from_pylist([0.0, 1.5], dtypes.FLOAT64)])
+    li, ri = J.sort_merge_inner_join(left, right)
+    # -0.0 vs 0.0 have different bits: total-order keys differ
+    assert pairs(li, ri) == [(0, 1)]
+
+
+def test_outer_transforms():
+    left = Table([Column.from_pylist([1, 2, 3], dtypes.INT64)])
+    right = Table([Column.from_pylist([2, 9], dtypes.INT64)])
+    li, ri = J.sort_merge_inner_join(left, right)
+    lo_l, lo_r = J.make_left_outer(li, ri, 3)
+    assert pairs(lo_l, lo_r) == [(0, -1), (1, 0), (2, -1)]
+    fo_l, fo_r = J.make_full_outer(li, ri, 3, 2)
+    assert pairs(fo_l, fo_r) == [(-1, 1), (0, -1), (1, 0), (2, -1)]
+    assert np.asarray(J.make_semi(li, 3)).tolist() == [1]
+    assert np.asarray(J.make_anti(li, 3)).tolist() == [0, 2]
+    assert J.get_matched_rows(li, 3).to_pylist() == [False, True, False]
+
+
+def test_filter_join_pairs():
+    li = jnp.array([0, 1, 2], jnp.int32)
+    ri = jnp.array([5, 6, 7], jnp.int32)
+    fl, fr = J.filter_join_pairs(li, ri,
+                                 jnp.array([True, False, True]))
+    assert np.asarray(fl).tolist() == [0, 2]
+    assert np.asarray(fr).tolist() == [7] if False else \
+        np.asarray(fr).tolist() == [5, 7]
+
+
+def test_join_then_gather_end_to_end():
+    left = Table([Column.from_pylist([10, 20, 30], dtypes.INT64),
+                  Column.from_strings(["x", "y", "z"])])
+    right = Table([Column.from_pylist([20, 30, 20], dtypes.INT64),
+                   Column.from_pylist([1.0, 2.0, 3.0], dtypes.FLOAT64)])
+    li, ri = J.sort_merge_inner_join(Table([left.columns[0]]),
+                                     Table([right.columns[0]]))
+    lg = gather_table(left, li)
+    rg = gather_table(right, ri)
+    got = sorted(zip([r[1] for r in lg.to_pylist()],
+                     [r[1] for r in rg.to_pylist()]))
+    assert got == [("y", 1.0), ("y", 3.0), ("z", 2.0)]
+
+
+# ---------------------------------------------------------------- groupby
+
+def test_groupby_sum_count_min_max_mean():
+    keys = Table([Column.from_strings(["a", "b", "a", None, "b", "a"])])
+    vals = Column.from_pylist([1, 2, 3, 4, None, 6], dtypes.INT64)
+    out = gb.groupby_aggregate(
+        keys, [vals, vals, vals, vals, vals],
+        [gb.SUM, gb.COUNT, gb.MIN, gb.MAX, gb.MEAN])
+    rows = {r[0]: r[1:] for r in out.to_pylist()}
+    assert rows["a"] == (10, 3, 1, 6, 10 / 3)
+    assert rows["b"] == (2, 1, 2, 2, 2.0)
+    assert rows[None] == (4, 1, 4, 4, 4.0)
+
+
+def test_groupby_float64_bit_exact_minmax():
+    keys = Table([Column.from_pylist([1, 1, 2, 2], dtypes.INT32)])
+    vals = Column.from_pylist([-0.0, 0.0, 1.5, float("-inf")],
+                              dtypes.FLOAT64)
+    out = gb.groupby_aggregate(keys, [vals, vals], [gb.MIN, gb.MAX])
+    rows = {r[0]: r[1:] for r in out.to_pylist()}
+    # -0.0 < 0.0 in total order: min keeps the -0.0 bit pattern
+    assert str(rows[1][0]) == "-0.0" and rows[1][1] == 0.0
+    assert rows[2] == (float("-inf"), 1.5)
+
+
+def test_groupby_multi_key_and_all_null_group():
+    keys = Table([
+        Column.from_pylist([1, 1, 2], dtypes.INT64),
+        Column.from_pylist([1, 1, 9], dtypes.INT64),
+    ])
+    vals = Column.from_pylist([None, None, 5], dtypes.INT64)
+    out = gb.groupby_aggregate(keys, [vals], [gb.SUM])
+    rows = {(r[0], r[1]): r[2] for r in out.to_pylist()}
+    assert rows[(1, 1)] is None  # all-null group sums to null
+    assert rows[(2, 9)] == 5
+
+
+def test_groupby_1e5_consistency():
+    rng = np.random.default_rng(0)
+    n = 100_000
+    k = rng.integers(0, 500, n)
+    v = rng.integers(-1000, 1000, n)
+    keys = Table([Column.from_numpy(k.astype(np.int64))])
+    vals = Column.from_numpy(v.astype(np.int64))
+    out = gb.groupby_aggregate(keys, [vals], [gb.SUM])
+    got = {r[0]: r[1] for r in out.to_pylist()}
+    import collections
+    expected = collections.defaultdict(int)
+    for kk, vv in zip(k.tolist(), v.tolist()):
+        expected[kk] += vv
+    assert got == dict(expected)
+
+
+def test_groupby_float32_nan_minmax_review_regression():
+    keys = Table([Column.from_pylist([1, 1, 1], dtypes.INT32)])
+    vals = Column.from_pylist([float("nan"), 1.0, 5.0], dtypes.FLOAT32)
+    out = gb.groupby_aggregate(keys, [vals, vals], [gb.MIN, gb.MAX])
+    row = out.to_pylist()[0]
+    assert row[1] == 1.0          # NaN is largest: min is 1.0
+    assert np.isnan(row[2])       # max is NaN

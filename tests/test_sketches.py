@@ -1,0 +1,99 @@
+"""HLL++ and histogram/percentile tests."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.ops import hllpp
+from spark_rapids_tpu.ops import histogram as hg
+from spark_rapids_tpu.ops.exceptions import ExceptionWithRowIndex
+
+
+def test_hllpp_estimate_accuracy():
+    rng = np.random.default_rng(1)
+    n_distinct = 5000
+    vals = rng.integers(0, n_distinct, 50_000, dtype=np.int64)
+    c = Column.from_numpy(vals)
+    sk = hllpp.reduce_hllpp(c, 9)
+    est = hllpp.estimate_from_hll_sketches(sk, 9).to_pylist()[0]
+    true = len(np.unique(vals))
+    assert abs(est - true) / true < 0.1  # ~4% expected at p=9
+
+
+def test_hllpp_group_and_merge():
+    c = Column.from_pylist([1, 2, 3, 1, 2, 100, 200, 300, 400],
+                           dtypes.INT64)
+    gids = jnp.asarray(np.array([0, 0, 0, 0, 0, 1, 1, 1, 1], np.int32))
+    sk = hllpp.group_hllpp(c, gids, 2, 9)
+    est = hllpp.estimate_from_hll_sketches(sk, 9).to_pylist()
+    assert est[0] == 3 and est[1] == 4  # exact for tiny cardinalities
+    # merging the two groups gives the union estimate
+    merged = hllpp.reduce_merge_hllpp(sk, 9)
+    est_m = hllpp.estimate_from_hll_sketches(merged, 9).to_pylist()[0]
+    assert est_m == 7
+
+
+def test_hllpp_nulls_excluded():
+    c = Column.from_pylist([1, None, 2, None], dtypes.INT64)
+    sk = hllpp.reduce_hllpp(c, 9)
+    assert hllpp.estimate_from_hll_sketches(sk, 9).to_pylist()[0] == 2
+
+
+def test_hllpp_precision_validation():
+    c = Column.from_pylist([1], dtypes.INT64)
+    with pytest.raises(ValueError, match="precision"):
+        hllpp.reduce_hllpp(c, 3)
+    # struct shape check
+    sk = hllpp.reduce_hllpp(c, 9)
+    with pytest.raises(ValueError, match="long columns"):
+        hllpp.merge_sketches(sk, jnp.zeros(1, jnp.int32), 1, 10)
+
+
+def test_hllpp_sketch_format():
+    """10 registers x 6 bits per long; 2^9/10+1 = 52 long columns."""
+    c = Column.from_pylist([42], dtypes.INT64)
+    sk = hllpp.reduce_hllpp(c, 9)
+    assert len(sk.children) == 52
+    assert all(ch.dtype.kind == "int64" for ch in sk.children)
+
+
+def test_histogram_percentile():
+    """Group-level: merge per-row histograms (concat elements) into one,
+    then take percentiles (the plugin's aggregation shape)."""
+    vals = Column.from_pylist([10.0, 20.0, 30.0], dtypes.FLOAT64)
+    freqs = Column.from_pylist([1, 1, 2], dtypes.INT64)
+    h = hg.create_histogram_if_valid(vals, freqs)
+    assert h.length == 3  # one list row per input row
+    # merge all rows into one histogram row
+    st = h.children[0]
+    merged = Column(dtypes.LIST, 1,
+                    offsets=jnp.asarray(np.array([0, st.length],
+                                                 np.int32)),
+                    children=(st,))
+    out = hg.percentile_from_histogram(merged, [0.0, 0.5, 1.0])
+    got = out.to_pylist()[0]
+    # sorted stream: 10,20,30,30; p=.5 -> pos 1.5 -> 25.0
+    assert got == [10.0, 25.0, 30.0]
+
+
+def test_histogram_validation_and_filtering():
+    vals = Column.from_pylist([1.0, 2.0, None, 4.0], dtypes.FLOAT64)
+    freqs = Column.from_pylist([1, 0, 3, 2], dtypes.INT64)
+    h = hg.create_histogram_if_valid(vals, freqs)
+    # per-row lists: zero-freq and null-value rows become empty lists
+    assert h.to_pylist() == [[(1.0, 1)], [], [], [(4.0, 2)]]
+    st_mode = hg.create_histogram_if_valid(vals, freqs,
+                                           output_as_lists=False)
+    assert st_mode.length == 4
+    assert st_mode.to_pylist()[1] is None  # nullified, not dropped
+    neg = Column.from_pylist([1, -5], dtypes.INT64)
+    with pytest.raises(ExceptionWithRowIndex) as ei:
+        hg.create_histogram_if_valid(
+            Column.from_pylist([1.0, 2.0], dtypes.FLOAT64), neg)
+    assert ei.value.row_index == 1
+    with pytest.raises(ExceptionWithRowIndex, match="null"):
+        hg.create_histogram_if_valid(
+            Column.from_pylist([1.0], dtypes.FLOAT64),
+            Column.from_pylist([None], dtypes.INT64))
