@@ -236,6 +236,18 @@ class Column:
         if self.dtype.kind == Kind.BOOL8:
             return [bool(host[i]) if mask[i] else None
                     for i in range(self.length)]
+        if self.dtype.kind == Kind.DECIMAL128:
+            out = []
+            limbs = host.astype(np.uint32).astype(object)
+            for i in range(self.length):
+                if not mask[i]:
+                    out.append(None)
+                    continue
+                u = sum(int(limbs[i, j]) << (32 * j) for j in range(4))
+                if u >= 1 << 127:
+                    u -= 1 << 128
+                out.append(u)  # unscaled value
+            return out
         return [host[i].item() if mask[i] else None
                 for i in range(self.length)]
 

@@ -66,3 +66,5 @@ from spark_rapids_tpu.ops.histogram import (  # noqa: F401
     create_histogram_if_valid,
     percentile_from_histogram,
 )
+from spark_rapids_tpu.ops import decimal_utils  # noqa: F401
+from spark_rapids_tpu.ops import datetime_ops  # noqa: F401

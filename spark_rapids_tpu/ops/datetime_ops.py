@@ -1,0 +1,263 @@
+"""Datetime ops: timezone conversion, Julian<->Gregorian rebase, truncate
+(reference timezones.cu/timezones.hpp, datetime_rebase.cu,
+datetime_truncate.cu, GpuTimeZoneDB.java / DateTimeRebase.java /
+DateTimeUtils.java).
+
+All date math is vectorized civil-calendar arithmetic (Howard Hinnant
+style days<->ymd formulas) on device arrays; timezone offsets come from
+binary search over the tzdb transition table (utils/tzdb.py), matching
+the reference's device binary search over its ZoneRules-derived table.
+"""
+
+from __future__ import annotations
+
+from typing import Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.columns.dtypes import Kind
+from spark_rapids_tpu.utils import tzdb
+
+_I64 = jnp.int64
+_I32 = jnp.int32
+
+MICROS_PER_SEC = 1_000_000
+SECS_PER_DAY = 86400
+
+
+# ----------------------------------------------------- civil date helpers
+
+def _days_to_ymd(z):
+    """Vectorized proleptic-Gregorian days-since-epoch -> (y, m, d)."""
+    z = z + 719468
+    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = jnp.where(m <= 2, y + 1, y)
+    return y, m, d
+
+
+def _ymd_to_days(y, m, d):
+    y = jnp.where(m <= 2, y - 1, y)
+    era = jnp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def _julian_ymd_to_days(y, m, d):
+    """Julian-calendar Y/M/D -> days since 1970-01-01 (via JDN)."""
+    a = (14 - m) // 12
+    yy = y + 4800 - a
+    mm = m + 12 * a - 3
+    jdn = d + (153 * mm + 2) // 5 + 365 * yy + yy // 4 - 32083
+    return jdn - 2440588
+
+
+def _days_to_julian_ymd(z):
+    """days since epoch -> Julian-calendar (y, m, d)."""
+    jdn = z + 2440588
+    c = jdn + 32082
+    d_ = (4 * c + 3) // 1461
+    e = c - (1461 * d_) // 4
+    m_ = (5 * e + 2) // 153
+    day = e - (153 * m_ + 2) // 5 + 1
+    month = m_ + 3 - 12 * (m_ // 10)
+    year = d_ - 4800 + m_ // 10
+    return year, month, day
+
+
+# -------------------------------------------------------------- timezone
+
+def _offsets_at(instants_sec: jnp.ndarray, zone_id: str,
+                wall_time: bool) -> jnp.ndarray:
+    """UTC offset (seconds) at each instant.  wall_time=True treats the
+    input as local wall seconds.  Wall boundaries use the offset BEFORE
+    each transition (GpuTimeZoneDB.java:336-339 localInstant), so
+    fall-back overlaps resolve to the earlier offset and spring-forward
+    gaps to the later one — java.time ZoneRules semantics."""
+    trans, offs = tzdb.get_transitions(zone_id)
+    if wall_time:
+        offs_before = np.concatenate([offs[:1], offs[:-1]])
+        bounds = jnp.asarray(trans + offs_before)
+    else:
+        bounds = jnp.asarray(trans)
+    idx = jnp.searchsorted(bounds, instants_sec, side="right") - 1
+    idx = jnp.clip(idx, 0, len(offs) - 1)
+    return jnp.asarray(offs)[idx]
+
+
+def _floor_div(a, b):
+    return a // b  # jnp int division is floor for int64
+
+
+def convert_timestamp_to_utc(col: Column, zone_id: str) -> Column:
+    """Wall-clock micros in `zone_id` -> UTC micros
+    (timezones.hpp:2 convert_timestamp_to_utc)."""
+    assert col.dtype.kind == Kind.TIMESTAMP_MICROS
+    micros = col.data.astype(_I64)
+    secs = _floor_div(micros, MICROS_PER_SEC)
+    off = _offsets_at(secs, zone_id, wall_time=True)
+    return Column(col.dtype, col.length,
+                  data=micros - off * MICROS_PER_SEC,
+                  validity=col.validity)
+
+
+def convert_utc_timestamp_to_timezone(col: Column, zone_id: str) -> Column:
+    """UTC micros -> wall-clock micros in `zone_id`
+    (timezones.hpp convert_utc_timestamp_to_timezone)."""
+    assert col.dtype.kind == Kind.TIMESTAMP_MICROS
+    micros = col.data.astype(_I64)
+    secs = _floor_div(micros, MICROS_PER_SEC)
+    off = _offsets_at(secs, zone_id, wall_time=False)
+    return Column(col.dtype, col.length,
+                  data=micros + off * MICROS_PER_SEC,
+                  validity=col.validity)
+
+
+# ---------------------------------------------------------------- rebase
+
+_GREG_START_DAYS = -141427  # 1582-10-15
+
+
+def rebase_gregorian_to_julian(col: Column) -> Column:
+    """Proleptic-Gregorian -> hybrid Julian/Gregorian calendar
+    (datetime_rebase.cu; Spark rebaseGregorianToJulianDays/Micros).
+    Dates on/after 1582-10-15 are unchanged; earlier dates keep their
+    Y/M/D field values reinterpreted in the Julian calendar."""
+    if col.dtype.kind == Kind.TIMESTAMP_DAYS:
+        days = col.data.astype(_I64)
+        y, m, d = _days_to_ymd(days)
+        jd = _julian_ymd_to_days(y, m, d)
+        out = jnp.where(days >= _GREG_START_DAYS, days, jd)
+        return Column(col.dtype, col.length, data=out.astype(_I32),
+                      validity=col.validity)
+    if col.dtype.kind == Kind.TIMESTAMP_MICROS:
+        micros = col.data.astype(_I64)
+        days = _floor_div(micros, MICROS_PER_SEC * SECS_PER_DAY)
+        tod = micros - days * MICROS_PER_SEC * SECS_PER_DAY
+        y, m, d = _days_to_ymd(days)
+        jd = _julian_ymd_to_days(y, m, d)
+        out_days = jnp.where(days >= _GREG_START_DAYS, days, jd)
+        return Column(col.dtype, col.length,
+                      data=out_days * MICROS_PER_SEC * SECS_PER_DAY + tod,
+                      validity=col.validity)
+    raise ValueError("date or timestamp column required")
+
+
+def rebase_julian_to_gregorian(col: Column) -> Column:
+    """Inverse rebase (datetime_rebase.cu)."""
+    if col.dtype.kind == Kind.TIMESTAMP_DAYS:
+        days = col.data.astype(_I64)
+        y, m, d = _days_to_julian_ymd(days)
+        gd = _ymd_to_days(y, m, d)
+        out = jnp.where(days >= _GREG_START_DAYS, days, gd)
+        return Column(col.dtype, col.length, data=out.astype(_I32),
+                      validity=col.validity)
+    if col.dtype.kind == Kind.TIMESTAMP_MICROS:
+        micros = col.data.astype(_I64)
+        days = _floor_div(micros, MICROS_PER_SEC * SECS_PER_DAY)
+        tod = micros - days * MICROS_PER_SEC * SECS_PER_DAY
+        y, m, d = _days_to_julian_ymd(days)
+        gd = _ymd_to_days(y, m, d)
+        out_days = jnp.where(days >= _GREG_START_DAYS, days, gd)
+        return Column(col.dtype, col.length,
+                      data=out_days * MICROS_PER_SEC * SECS_PER_DAY + tod,
+                      validity=col.validity)
+    raise ValueError("date or timestamp column required")
+
+
+# -------------------------------------------------------------- truncate
+
+_COMPONENTS = {
+    "YEAR": "year", "YYYY": "year", "YY": "year",
+    "QUARTER": "quarter",
+    "MONTH": "month", "MON": "month", "MM": "month",
+    "WEEK": "week",
+    "DAY": "day", "DD": "day",
+    "HOUR": "hour",
+    "MINUTE": "minute",
+    "SECOND": "second",
+    "MILLISECOND": "millisecond",
+    "MICROSECOND": "microsecond",
+}
+
+
+def truncate(col: Column, component: Union[str, Column]) -> Column:
+    """Spark date_trunc / trunc (datetime_truncate.cu, DateTimeUtils.java:
+    truncate).  Invalid components null the row; scalar or per-row
+    component column."""
+    if isinstance(component, Column):
+        host_parts = [c if c in _COMPONENTS else None
+                      for c in (None if v is None else str(v).upper()
+                                for v in component.to_pylist())]
+        mask = np.zeros(col.length, np.uint8)
+        # one vectorized pass per distinct component
+        result = np.zeros(col.length, np.int64)
+        base_valid = np.asarray(col.valid_mask())
+        for comp in set(c for c in host_parts if c):
+            sel = np.array([c == comp for c in host_parts])
+            sub = truncate(col, comp)
+            result = np.where(sel, np.asarray(sub.data, dtype=np.int64),
+                              result)
+            mask = np.where(sel & base_valid, 1, mask).astype(np.uint8)
+        np_dt = col.dtype.np_dtype
+        return Column(col.dtype, col.length,
+                      data=jnp.asarray(result.astype(np_dt)),
+                      validity=jnp.asarray(mask))
+
+    comp = _COMPONENTS.get(component.upper())
+    if comp is None:
+        raise ValueError(f"unsupported truncation component {component}")
+    is_date = col.dtype.kind == Kind.TIMESTAMP_DAYS
+    if is_date:
+        days = col.data.astype(_I64)
+        tod = jnp.zeros_like(days)
+    else:
+        micros = col.data.astype(_I64)
+        day_us = MICROS_PER_SEC * SECS_PER_DAY
+        days = _floor_div(micros, day_us)
+        tod = micros - days * day_us
+
+    if comp in ("year", "quarter", "month", "week"):
+        y, m, d = _days_to_ymd(days)
+        if comp == "year":
+            nd = _ymd_to_days(y, jnp.ones_like(m), jnp.ones_like(m))
+        elif comp == "quarter":
+            qm = (m - 1) // 3 * 3 + 1
+            nd = _ymd_to_days(y, qm, jnp.ones_like(m))
+        elif comp == "month":
+            nd = _ymd_to_days(y, m, jnp.ones_like(m))
+        else:  # week: Monday
+            dow = (days + 3) % 7  # 1970-01-01 is a Thursday
+            nd = days - dow
+        out_days, out_tod = nd, jnp.zeros_like(tod)
+    else:
+        unit = {"day": MICROS_PER_SEC * SECS_PER_DAY,
+                "hour": MICROS_PER_SEC * 3600,
+                "minute": MICROS_PER_SEC * 60,
+                "second": MICROS_PER_SEC,
+                "millisecond": 1000,
+                "microsecond": 1}[comp]
+        if is_date:
+            out_days, out_tod = days, tod
+        else:
+            out_days = days
+            out_tod = tod // unit * unit
+
+    if is_date:
+        return Column(col.dtype, col.length,
+                      data=out_days.astype(_I32), validity=col.validity)
+    day_us = MICROS_PER_SEC * SECS_PER_DAY
+    return Column(col.dtype, col.length,
+                  data=out_days * day_us + out_tod,
+                  validity=col.validity)

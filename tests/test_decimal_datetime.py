@@ -1,0 +1,208 @@
+"""decimal128 arithmetic + datetime ops tests (reference
+DecimalUtilsTest / DateTimeRebaseTest / TimeZoneTest contracts)."""
+
+import datetime
+import zoneinfo
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.ops import datetime_ops as dt
+from spark_rapids_tpu.ops import decimal_utils as du
+
+
+def dec(vals, scale):
+    return Column.from_pylist(vals, dtypes.decimal128(scale))
+
+
+def dec_values(col):
+    return col.to_pylist()  # unscaled values (decimal128 codec)
+
+
+def test_decimal_multiply():
+    # 1.23 * 4.5 = 5.535 at scale -3
+    a = dec([123, -123, None], -2)
+    b = dec([450, 450, 1], -2)
+    ovf, res = du.multiply_decimal128(a, b, -3)
+    assert dec_values(res) == [5535, -5535, None]
+    assert ovf.to_pylist() == [False, False, None]
+
+
+def test_decimal_multiply_overflow_and_interim():
+    big = dec([10**37], -0)
+    ovf, _ = du.multiply_decimal128(big, big, 0)
+    assert ovf.to_pylist() == [True]
+    # legacy interim rounding (SPARK-40129): interim rounds to 38 digits
+    a = dec([10**19 + 1], -19)   # 1.0000000000000000001
+    ovf2, res2 = du.multiply_decimal128(a, a, -19,
+                                        cast_interim_result=True)
+    assert ovf2.to_pylist() == [False]
+    # exact square = 1.00000000000000000020...1e-38; interim cast drops
+    # the tail digit before the final rescale
+    assert dec_values(res2) == [10**19 + 2]
+
+
+def test_decimal_divide_and_remainder():
+    a = dec([100], -2)   # 1.00
+    b = dec([300], -2)   # 3.00
+    ovf, res = du.divide_decimal128(a, b, -6)
+    assert dec_values(res) == [333333]  # 0.333333
+    assert ovf.to_pylist() == [False]
+    # divide by zero -> overflow flag
+    ovf0, _ = du.divide_decimal128(a, dec([0], -2), -6)
+    assert ovf0.to_pylist() == [True]
+    ovf_r, rem = du.remainder_decimal128(dec([700], -2), dec([400], -2),
+                                         -2)
+    assert dec_values(rem) == [300]  # 7.00 % 4.00 = 3.00
+    ovf_n, rem_n = du.remainder_decimal128(dec([-700], -2),
+                                           dec([400], -2), -2)
+    assert dec_values(rem_n) == [-300]  # truncated-division remainder
+
+
+def test_decimal_add_sub():
+    a = dec([123], -2)    # 1.23
+    b = dec([4567], -3)   # 4.567
+    ovf, s = du.add_decimal128(a, b, -3)
+    assert dec_values(s) == [5797]
+    ovf2, d = du.sub_decimal128(b, a, -3)
+    assert dec_values(d) == [3337]
+    # rounding on rescale: 1.23 + 4.567 at scale -2 -> 5.80 (HALF_UP)
+    _, s2 = du.add_decimal128(a, b, -2)
+    assert dec_values(s2) == [580]
+
+
+def test_decimal_integer_divide():
+    a = dec([700], -2)
+    b = dec([300], -2)
+    ovf, q = du.integer_divide_decimal128(a, b, 0)
+    assert dec_values(q) == [2]
+    # truncation happens AT the target scale (review regression)
+    _, q2 = du.integer_divide_decimal128(a, b, -2)
+    assert dec_values(q2) == [233]  # 2.33, not 2.00
+
+
+def test_float_to_decimal_half_up_review_regression():
+    c = Column.from_pylist([0.125], dtypes.FLOAT64)
+    col, _ = du.floating_point_to_decimal(c, -2, 9)
+    assert col.to_pylist() == [13]  # HALF_UP, not banker's 12
+
+
+def test_tz_fallback_overlap_uses_earlier_offset():
+    """2023-11-05 01:30 America/Los_Angeles is ambiguous; Java ZoneRules
+    picks the offset before the transition (PDT) -> 08:30Z."""
+    wall = datetime.datetime(2023, 11, 5, 1, 30)
+    us = int(wall.replace(tzinfo=datetime.timezone.utc).timestamp() * 1e6)
+    c = Column.from_pylist([us], dtypes.TIMESTAMP_MICROS)
+    out = dt.convert_timestamp_to_utc(c, "America/Los_Angeles")
+    got = datetime.datetime.fromtimestamp(
+        out.to_pylist()[0] / 1e6, datetime.timezone.utc)
+    assert got.hour == 8 and got.minute == 30
+
+
+def test_tzdb_path_traversal_rejected():
+    from spark_rapids_tpu.utils import tzdb
+    for bad in ["/etc/passwd", "..", "../passwd", "America/../../etc"]:
+        with pytest.raises(ValueError):
+            tzdb.get_transitions(bad)
+
+
+def test_float_to_decimal():
+    c = Column.from_pylist([1.5, -2.25, float("inf"), None],
+                           dtypes.FLOAT64)
+    col, first_fail = du.floating_point_to_decimal(c, -2, 9)
+    assert dec_values(col) == [150, -225, None, None]
+    assert first_fail == 2
+
+
+# ---------------------------------------------------------------- dates
+
+def d2e(y, m, d):
+    return (datetime.date(y, m, d) - datetime.date(1970, 1, 1)).days
+
+
+def test_civil_date_roundtrip():
+    import jax.numpy as jnp
+    days = jnp.asarray(np.arange(-200000, 200000, 997, dtype=np.int64))
+    y, m, d = dt._days_to_ymd(days)
+    back = dt._ymd_to_days(y, m, d)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(days))
+
+
+def test_rebase_gregorian_julian():
+    # 1582-10-15 and later unchanged
+    c = Column.from_pylist([d2e(1582, 10, 15), d2e(2020, 1, 1)],
+                           dtypes.TIMESTAMP_DAYS)
+    out = dt.rebase_gregorian_to_julian(c)
+    assert out.to_pylist() == c.to_pylist()
+    # fields 1582-10-04 read in the Julian calendar = Gregorian
+    # 1582-10-14, i.e. +10 absolute days (Spark rebase diff table)
+    c2 = Column.from_pylist([d2e(1582, 10, 4)], dtypes.TIMESTAMP_DAYS)
+    out2 = dt.rebase_gregorian_to_julian(c2)
+    assert out2.to_pylist() == [d2e(1582, 10, 4) + 10]
+    # year 1: Julian is 2 days behind Gregorian
+    c2b = Column.from_pylist([d2e(1, 1, 1)], dtypes.TIMESTAMP_DAYS)
+    assert dt.rebase_gregorian_to_julian(c2b).to_pylist() == \
+        [d2e(1, 1, 1) - 2]
+    # roundtrip far past
+    c3 = Column.from_pylist([d2e(1, 1, 1), d2e(1000, 6, 15)],
+                            dtypes.TIMESTAMP_DAYS)
+    rt = dt.rebase_julian_to_gregorian(dt.rebase_gregorian_to_julian(c3))
+    assert rt.to_pylist() == c3.to_pylist()
+
+
+def test_truncate_timestamps():
+    base = datetime.datetime(2023, 7, 26, 14, 37, 52, 123456)
+    us = int(base.replace(tzinfo=datetime.timezone.utc).timestamp() * 1e6)
+    c = Column.from_pylist([us], dtypes.TIMESTAMP_MICROS)
+
+    def trunc_to(comp):
+        out = dt.truncate(c, comp).to_pylist()[0]
+        return datetime.datetime.fromtimestamp(
+            out / 1e6, datetime.timezone.utc).replace(tzinfo=None)
+
+    assert trunc_to("YEAR") == datetime.datetime(2023, 1, 1)
+    assert trunc_to("QUARTER") == datetime.datetime(2023, 7, 1)
+    assert trunc_to("MONTH") == datetime.datetime(2023, 7, 1)
+    assert trunc_to("WEEK") == datetime.datetime(2023, 7, 24)  # Monday
+    assert trunc_to("DAY") == datetime.datetime(2023, 7, 26)
+    assert trunc_to("HOUR") == datetime.datetime(2023, 7, 26, 14)
+    assert trunc_to("SECOND") == datetime.datetime(2023, 7, 26, 14, 37,
+                                                   52)
+    with pytest.raises(ValueError):
+        dt.truncate(c, "EON")
+
+
+def test_truncate_component_column():
+    base = datetime.datetime(2023, 7, 26, 14, 37, 52, 123456)
+    us = int(base.replace(tzinfo=datetime.timezone.utc).timestamp() * 1e6)
+    c = Column.from_pylist([us, us, us], dtypes.TIMESTAMP_MICROS)
+    comps = Column.from_strings(["YEAR", "bogus", "DAY"])
+    out = dt.truncate(c, comps).to_pylist()
+    assert out[1] is None
+    assert out[0] != out[2]
+
+
+@pytest.mark.parametrize("zone", ["America/Los_Angeles", "Asia/Shanghai"])
+def test_timezone_roundtrip_vs_zoneinfo(zone):
+    tz = zoneinfo.ZoneInfo(zone)
+    samples = [
+        datetime.datetime(2023, 1, 15, 12, 0, 0),
+        datetime.datetime(2023, 7, 15, 12, 0, 0),
+        datetime.datetime(1995, 3, 3, 3, 33, 0),
+        datetime.datetime(2030, 11, 2, 8, 0, 0),
+    ]
+    utc_us = [int(s.replace(tzinfo=datetime.timezone.utc).timestamp()
+                  * 1e6) for s in samples]
+    c = Column.from_pylist(utc_us, dtypes.TIMESTAMP_MICROS)
+    local = dt.convert_utc_timestamp_to_timezone(c, zone)
+    for s, lv in zip(samples, local.to_pylist()):
+        expected = s.replace(tzinfo=datetime.timezone.utc).astimezone(
+            tz).replace(tzinfo=None)
+        got = datetime.datetime.fromtimestamp(
+            lv / 1e6, datetime.timezone.utc).replace(tzinfo=None)
+        assert got == expected, (zone, s)
+    # and back: local wall time -> utc
+    back = dt.convert_timestamp_to_utc(local, zone)
+    assert back.to_pylist() == utc_us
