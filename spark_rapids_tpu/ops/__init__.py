@@ -68,3 +68,15 @@ from spark_rapids_tpu.ops.histogram import (  # noqa: F401
 )
 from spark_rapids_tpu.ops import decimal_utils  # noqa: F401
 from spark_rapids_tpu.ops import datetime_ops  # noqa: F401
+from spark_rapids_tpu.ops.json_path import (  # noqa: F401
+    get_json_object,
+    get_json_object_multiple_paths,
+)
+from spark_rapids_tpu.ops import parse_uri  # noqa: F401
+from spark_rapids_tpu.ops.strings_misc import (  # noqa: F401
+    convert,
+    is_convert_overflow,
+    decode_to_utf8,
+    list_slice,
+    literal_range_pattern,
+)

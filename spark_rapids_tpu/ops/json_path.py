@@ -1,0 +1,391 @@
+"""Spark get_json_object (reference get_json_object.cu + json_parser.cuh,
+JSONUtils.getJsonObject:64-106).
+
+Path instructions: $ root, .name / ['name'], [index], [*] wildcard; arrays
+flatten implicitly under named access (Spark evaluatePath).  The tolerant
+parser accepts single-quoted strings and unescaped control characters
+(json_parser.cuh Spark options).  Output: unescaped text for a single
+string scalar, raw literal for other scalars, compact normalized JSON for
+objects/arrays, a JSON array of results for multiple wildcard matches,
+null for no match / invalid JSON / invalid path.
+
+The multi-path API mirrors the reference's memory-budgeted batch entry
+(get_json_object.hpp:9-14): paths are processed in chunks whose estimated
+scratch fits the budget — the same chunking contract, applied host-side.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+from spark_rapids_tpu.columns.column import Column
+
+MAX_PATH_DEPTH = 16  # get_json_object.hpp:2
+
+
+# ----------------------------------------------------------- path parsing
+
+class Named:
+    __slots__ = ("name",)
+
+    def __init__(self, name):
+        self.name = name
+
+
+class Index:
+    __slots__ = ("index",)
+
+    def __init__(self, index):
+        self.index = index
+
+
+class Wildcard:
+    pass
+
+
+def parse_path(path: str) -> Optional[List]:
+    """JSON path -> instruction list; None if malformed."""
+    if not path or path[0] != "$":
+        return None
+    out: List = []
+    i = 1
+    n = len(path)
+    while i < n:
+        c = path[i]
+        if c == ".":
+            i += 1
+            if i < n and path[i] == "*":
+                out.append(Wildcard())
+                i += 1
+                continue
+            j = i
+            while j < n and path[j] not in ".[":
+                j += 1
+            if j == i:
+                return None
+            out.append(Named(path[i:j]))
+            i = j
+        elif c == "[":
+            j = path.find("]", i)
+            if j < 0:
+                return None
+            body = path[i + 1: j].strip()
+            if body == "*":
+                out.append(Wildcard())
+            elif len(body) >= 2 and body[0] == "'" and body[-1] == "'":
+                out.append(Named(body[1:-1]))
+            elif body.isdigit():
+                out.append(Index(int(body)))
+            else:
+                return None
+            i = j + 1
+        else:
+            return None
+    if len(out) > MAX_PATH_DEPTH:
+        return None
+    return out
+
+
+# ------------------------------------------------------- tolerant parser
+
+class _Invalid(Exception):
+    pass
+
+
+_WS = " \t\n\r"
+_ESCAPES = {'"': '"', "'": "'", "\\": "\\", "/": "/", "b": "\b",
+            "f": "\f", "n": "\n", "r": "\r", "t": "\t"}
+
+
+class _Parser:
+    def __init__(self, s: str):
+        self.s = s
+        self.i = 0
+        self.n = len(s)
+
+    def ws(self):
+        while self.i < self.n and self.s[self.i] in _WS:
+            self.i += 1
+
+    def parse(self):
+        self.ws()
+        v = self.value()
+        self.ws()
+        if self.i != self.n:
+            raise _Invalid()
+        return v
+
+    def value(self):
+        if self.i >= self.n:
+            raise _Invalid()
+        c = self.s[self.i]
+        if c == "{":
+            return self.obj()
+        if c == "[":
+            return self.arr()
+        if c in "\"'":
+            return ("str", self.string(c))
+        if c == "t" and self.s[self.i:self.i + 4] == "true":
+            self.i += 4
+            return ("lit", "true")
+        if c == "f" and self.s[self.i:self.i + 5] == "false":
+            self.i += 5
+            return ("lit", "false")
+        if c == "n" and self.s[self.i:self.i + 4] == "null":
+            self.i += 4
+            return ("lit", "null")
+        return ("num", self.number())
+
+    def obj(self):
+        self.i += 1
+        items = []
+        self.ws()
+        if self.i < self.n and self.s[self.i] == "}":
+            self.i += 1
+            return ("obj", items)
+        while True:
+            self.ws()
+            if self.i >= self.n or self.s[self.i] not in "\"'":
+                raise _Invalid()
+            k = self.string(self.s[self.i])
+            self.ws()
+            if self.i >= self.n or self.s[self.i] != ":":
+                raise _Invalid()
+            self.i += 1
+            self.ws()
+            items.append((k, self.value()))
+            self.ws()
+            if self.i < self.n and self.s[self.i] == ",":
+                self.i += 1
+                continue
+            if self.i < self.n and self.s[self.i] == "}":
+                self.i += 1
+                return ("obj", items)
+            raise _Invalid()
+
+    def arr(self):
+        self.i += 1
+        items = []
+        self.ws()
+        if self.i < self.n and self.s[self.i] == "]":
+            self.i += 1
+            return ("arr", items)
+        while True:
+            self.ws()
+            items.append(self.value())
+            self.ws()
+            if self.i < self.n and self.s[self.i] == ",":
+                self.i += 1
+                continue
+            if self.i < self.n and self.s[self.i] == "]":
+                self.i += 1
+                return ("arr", items)
+            raise _Invalid()
+
+    def string(self, quote):
+        self.i += 1
+        out = []
+        while True:
+            if self.i >= self.n:
+                raise _Invalid()
+            c = self.s[self.i]
+            if c == quote:
+                self.i += 1
+                return "".join(out)
+            if c == "\\":
+                self.i += 1
+                if self.i >= self.n:
+                    raise _Invalid()
+                e = self.s[self.i]
+                if e == "u":
+                    hexs = self.s[self.i + 1: self.i + 5]
+                    if len(hexs) < 4:
+                        raise _Invalid()
+                    try:
+                        out.append(chr(int(hexs, 16)))
+                    except ValueError:
+                        raise _Invalid()
+                    self.i += 5
+                    continue
+                if e not in _ESCAPES:
+                    raise _Invalid()
+                out.append(_ESCAPES[e])
+                self.i += 1
+                continue
+            # unescaped control chars allowed (Spark option)
+            out.append(c)
+            self.i += 1
+
+    def number(self):
+        start = self.i
+        if self.i < self.n and self.s[self.i] == "-":
+            self.i += 1
+        digits = 0
+        while self.i < self.n and self.s[self.i].isdigit():
+            self.i += 1
+            digits += 1
+        if digits == 0:
+            raise _Invalid()
+        if self.i < self.n and self.s[self.i] == ".":
+            self.i += 1
+            while self.i < self.n and self.s[self.i].isdigit():
+                self.i += 1
+        if self.i < self.n and self.s[self.i] in "eE":
+            self.i += 1
+            if self.i < self.n and self.s[self.i] in "+-":
+                self.i += 1
+            ed = 0
+            while self.i < self.n and self.s[self.i].isdigit():
+                self.i += 1
+                ed += 1
+            if ed == 0:
+                raise _Invalid()
+        return self.s[start: self.i]
+
+
+# ------------------------------------------------------------ evaluation
+
+def _escape(s: str) -> str:
+    out = ['"']
+    for c in s:
+        if c == '"':
+            out.append('\\"')
+        elif c == "\\":
+            out.append("\\\\")
+        elif c == "\n":
+            out.append("\\n")
+        elif c == "\r":
+            out.append("\\r")
+        elif c == "\t":
+            out.append("\\t")
+        elif ord(c) < 0x20:
+            out.append(f"\\u{ord(c):04x}")
+        else:
+            out.append(c)
+    out.append('"')
+    return "".join(out)
+
+
+def _render_json(v) -> str:
+    kind = v[0]
+    if kind == "str":
+        return _escape(v[1])
+    if kind in ("num", "lit"):
+        return v[1]
+    if kind == "obj":
+        return "{" + ",".join(f"{_escape(k)}:{_render_json(x)}"
+                              for k, x in v[1]) + "}"
+    return "[" + ",".join(_render_json(x) for x in v[1]) + "]"
+
+
+def _eval(v, path: List) -> List:
+    if not path:
+        return [v]
+    ins = path[0]
+    kind = v[0]
+    if isinstance(ins, Named):
+        if kind == "obj":
+            out = []
+            for k, child in v[1]:
+                if k == ins.name:
+                    out.extend(_eval(child, path[1:]))
+            return out
+        if kind == "arr":  # implicit array flattening under named access
+            out = []
+            for el in v[1]:
+                out.extend(_eval(el, path))
+            return out
+        return []
+    if isinstance(ins, Index):
+        if kind == "arr" and 0 <= ins.index < len(v[1]):
+            return _eval(v[1][ins.index], path[1:])
+        return []
+    if isinstance(ins, Wildcard):
+        if kind == "arr":
+            out = []
+            for el in v[1]:
+                out.extend(_eval(el, path[1:]))
+            return out
+        return []
+    return []
+
+
+def _run_one(doc: Optional[str], path: Optional[List]) -> Optional[str]:
+    if doc is None or path is None:
+        return None
+    try:
+        v = _Parser(doc).parse()
+    except _Invalid:
+        return None
+    matches = _eval(v, path)
+    if not matches:
+        return None
+    if len(matches) == 1:
+        m = matches[0]
+        if m[0] == "str":
+            return m[1]
+        return _render_json(m)
+    return "[" + ",".join(_render_json(m) for m in matches) + "]"
+
+
+def get_json_object(col: Column, path: str) -> Column:
+    """One strings column of extraction results (JSONUtils.getJsonObject)."""
+    assert col.dtype.is_string
+    instructions = parse_path(path)
+    vals = col.to_pylist()
+    return Column.from_strings([_run_one(v, instructions) for v in vals])
+
+
+def get_json_object_multiple_paths(col: Column, paths: Sequence[str],
+                                   memory_budget_bytes: int = -1,
+                                   parallel_override: int = -1
+                                   ) -> List[Column]:
+    """One output column per path (get_json_object.hpp:9 multi-path batch).
+    The budget/parallel knobs shape chunking in the reference kernel; the
+    host evaluator parses each document once per chunk of paths."""
+    assert col.dtype.is_string
+    parsed_paths = [parse_path(p) for p in paths]
+    vals = col.to_pylist()
+    if parallel_override > 0:
+        chunk = max(1, parallel_override)
+    elif memory_budget_bytes > 0:
+        # reference heuristic: scratch ~ max row size per path
+        max_row = max((len(v) for v in vals if v is not None), default=1)
+        chunk = max(1, memory_budget_bytes // max(max_row, 1))
+    else:
+        chunk = len(paths) or 1
+    # parse every document once per chunk of paths (the budget bounds how
+    # long the parsed trees stay alive, as the reference's scratch does)
+    outs: List[Column] = []
+    for c0 in range(0, len(parsed_paths), chunk):
+        trees = []
+        for v in vals:
+            if v is None:
+                trees.append(None)
+            else:
+                try:
+                    trees.append(_Parser(v).parse())
+                except _Invalid:
+                    trees.append(None)
+        for path in parsed_paths[c0:c0 + chunk]:
+            if path is None:
+                outs.append(Column.from_strings([None] * len(vals)))
+                continue
+            row_out = []
+            for t in trees:
+                if t is None:
+                    row_out.append(None)
+                    continue
+                matches = _eval(t, path)
+                if not matches:
+                    row_out.append(None)
+                elif len(matches) == 1:
+                    m = matches[0]
+                    row_out.append(m[1] if m[0] == "str"
+                                   else _render_json(m))
+                else:
+                    row_out.append(
+                        "[" + ",".join(_render_json(m)
+                                       for m in matches) + "]")
+            outs.append(Column.from_strings(row_out))
+    return outs

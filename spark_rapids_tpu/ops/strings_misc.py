@@ -1,0 +1,218 @@
+"""Assorted string/conversion kernels: number_converter conv(),
+GBK charset decode, list_slice, regex fast-path literal_range_pattern
+(reference number_converter.cu, charset_decode.cu, list_slice.cu,
+regex_rewrite_utils.cu)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.columns.dtypes import Kind
+from spark_rapids_tpu.ops.exceptions import ExceptionWithRowIndex
+
+_DIGITS = "0123456789abcdefghijklmnopqrstuvwxyz"
+
+
+# ------------------------------------------------------- number_converter
+
+def _conv_one(s: Optional[str], from_base: int, to_base: int
+              ) -> Tuple[Optional[str], bool]:
+    """Spark conv() single value; returns (result, overflowed).
+    Semantics (number_converter.cu / Spark NumberConverter): ASCII spaces
+    trimmed (only 0x20), optional '-', longest valid-digit prefix parsed
+    as UNSIGNED 64-bit; zero digits still render "0"; overflow clamps to
+    2^64-1 (stays clamped under negation); from_base must be 2..36
+    (positive only); to_base<0 renders signed."""
+    if s is None:
+        return None, False
+    if not (2 <= from_base <= 36 and 2 <= abs(to_base) <= 36):
+        return None, False
+    t = s.strip(" ")
+    if not t:
+        return None, False
+    neg = False
+    if t[:1] == "-":
+        neg = True
+        t = t[1:]
+    val = 0
+    overflow = False
+    for ch in t:
+        d = _DIGITS.find(ch.lower())
+        if d < 0 or d >= from_base:
+            break
+        if not overflow:
+            val = val * from_base + d
+            if val >= 1 << 64:
+                overflow = True
+    if overflow:
+        val = (1 << 64) - 1
+    elif neg:
+        val = ((1 << 64) - val) & ((1 << 64) - 1)
+    tb = abs(to_base)
+    if to_base < 0:
+        # signed rendering
+        sval = val - (1 << 64) if val >= (1 << 63) else val
+        sign = "-" if sval < 0 else ""
+        mag = abs(sval)
+    else:
+        sign = ""
+        mag = val
+    if mag == 0:
+        return "0", overflow
+    out = []
+    while mag:
+        out.append(_DIGITS[mag % tb].upper())
+        mag //= tb
+    return sign + "".join(reversed(out)), overflow
+
+
+def convert(col_or_str: Union[Column, str], from_base: int, to_base: int,
+            rows: Optional[int] = None) -> Column:
+    """Spark conv() (NumberConverter.java convert*)."""
+    if isinstance(col_or_str, Column):
+        vals = col_or_str.to_pylist()
+    else:
+        vals = [col_or_str] * (rows if rows is not None else 1)
+    return Column.from_strings(
+        [_conv_one(v, from_base, to_base)[0] for v in vals])
+
+
+def is_convert_overflow(col_or_str: Union[Column, str], from_base: int,
+                        to_base: int, rows: Optional[int] = None) -> Column:
+    """BOOL8: conv() would overflow uint64 (ANSI pre-check,
+    number_converter.hpp is_convert_overflow)."""
+    if isinstance(col_or_str, Column):
+        vals = col_or_str.to_pylist()
+    else:
+        vals = [col_or_str] * (rows if rows is not None else 1)
+    res = [_conv_one(v, from_base, to_base) for v in vals]
+    return Column.from_pylist(
+        [ovf if v0 is not None else None
+         for (_, ovf), v0 in zip(res, vals)],
+        dtypes.BOOL8)
+
+
+# --------------------------------------------------------- charset decode
+
+REPLACE = "REPLACE"
+REPORT = "REPORT"
+
+
+def decode_to_utf8(col: Column, charset: str = "GBK",
+                   on_error: str = REPLACE) -> Column:
+    """GBK -> UTF-8 decode (charset_decode.cu two-pass table decode;
+    CharsetDecode.java:55-79).  REPLACE substitutes U+FFFD; REPORT raises
+    with the first malformed row."""
+    assert col.dtype.is_string
+    if charset.upper() != "GBK":
+        raise ValueError("only GBK is supported")
+    chars = np.asarray(col.data).tobytes() if col.data is not None else b""
+    offs = np.asarray(col.offsets)
+    mask = (np.ones(col.length, bool) if col.validity is None
+            else np.asarray(col.validity).astype(bool))
+    out: List[Optional[str]] = []
+    for i in range(col.length):
+        if not mask[i]:
+            out.append(None)
+            continue
+        raw = chars[offs[i]:offs[i + 1]]
+        try:
+            out.append(raw.decode("gbk"))
+        except UnicodeDecodeError:
+            if on_error == REPORT:
+                raise ExceptionWithRowIndex(i, "malformed GBK bytes")
+            out.append(raw.decode("gbk", errors="replace"))
+    return Column.from_strings(out)
+
+
+# -------------------------------------------------------------- list_slice
+
+def list_slice(col: Column, start: Union[int, Column],
+               length: Union[int, Column, None] = None,
+               check_start_length: bool = True) -> Column:
+    """Spark slice(list, start, length) — 1-based start, negative counts
+    from the end (list_slice.hpp 4 overloads via scalar/column combos)."""
+    assert col.dtype.kind == Kind.LIST
+    rows = col.length
+    offs = np.asarray(col.offsets)
+    starts = (start.to_pylist() if isinstance(start, Column)
+              else [start] * rows)
+    length_is_col = isinstance(length, Column)
+    lens = (length.to_pylist() if length_is_col else [length] * rows)
+    mask = (np.ones(rows, bool) if col.validity is None
+            else np.asarray(col.validity).astype(bool))
+    child = col.children[0]
+    take: List[int] = []
+    new_offs = np.zeros(rows + 1, np.int32)
+    out_valid = np.zeros(rows, np.uint8)
+    for i in range(rows):
+        # a null entry in a start/length COLUMN nulls the row
+        # (list_slice.cu:100-101); a scalar length of None means
+        # "slice to the end"
+        null_len = length_is_col and lens[i] is None
+        if not mask[i] or starts[i] is None or null_len:
+            new_offs[i + 1] = len(take)
+            continue
+        st = int(starts[i])
+        if check_start_length and st == 0:
+            raise ExceptionWithRowIndex(
+                i, "Unexpected value for start in function slice: SQL "
+                   "array indices start at 1.")
+        ln_req = lens[i]
+        if ln_req is not None and int(ln_req) < 0 and check_start_length:
+            raise ExceptionWithRowIndex(
+                i, "Unexpected value for length in function slice: "
+                   "length must be greater than or equal to 0.")
+        n = int(offs[i + 1] - offs[i])
+        if st > 0:
+            begin = st - 1
+        else:
+            begin = n + st
+        if begin < 0 or begin >= n:
+            sliced: List[int] = []
+        else:
+            count = n - begin if ln_req is None else min(int(ln_req),
+                                                         n - begin)
+            sliced = list(range(int(offs[i]) + begin,
+                                int(offs[i]) + begin + count))
+        take.extend(sliced)
+        new_offs[i + 1] = len(take)
+        out_valid[i] = 1
+    from spark_rapids_tpu.ops.copying import gather
+    new_child = gather(child, jnp.asarray(np.array(take, np.int32)))
+    validity = None if out_valid.all() else jnp.asarray(out_valid)
+    return Column(dtypes.LIST, rows, validity=validity,
+                  offsets=jnp.asarray(new_offs), children=(new_child,))
+
+
+# ------------------------------------------------- regex fast-path search
+
+def literal_range_pattern(col: Column, literal: str, range_len: int,
+                          start: int, end: int) -> Column:
+    """BOOL8: row contains `literal` followed by `range_len` codepoints
+    each within [start, end] (regex_rewrite_utils.cu literal_range
+    fast path for trivial regexes like 'lit[a-b]{n}')."""
+    assert col.dtype.is_string
+    vals = col.to_pylist()
+    out: List[Optional[bool]] = []
+    for s in vals:
+        if s is None:
+            out.append(None)
+            continue
+        found = False
+        m = len(literal)
+        for i in range(len(s) - m - range_len + 1):
+            if s[i:i + m] != literal:
+                continue
+            ok = all(start <= ord(s[i + m + j]) <= end
+                     for j in range(range_len))
+            if ok:
+                found = True
+                break
+        out.append(found)
+    return Column.from_pylist(out, dtypes.BOOL8)
