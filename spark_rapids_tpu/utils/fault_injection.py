@@ -1,0 +1,145 @@
+"""Fault injection at the shim boundary (reference faultinj/faultinj.cu:
+libcufaultinj.so loaded via CUDA_INJECTION64_PATH, JSON config from
+FAULT_INJECTOR_CONFIG_PATH with hot reload, matching driver/runtime
+callbacks by function name or '*' with probability and repeat counts).
+
+TPU mapping: there is no CUPTI; the interception point is the op shim —
+ops (or the Java bindings layer) call `maybe_inject(op_name)` before
+dispatch.  Config schema mirrors the reference:
+
+    {"seed": 42,                       # optional deterministic seed
+     "faults": [
+        {"match": "murmur3_32",        # exact op name or "*"
+         "probability": 0.5,           # 0..1 (default 1.0)
+         "repeat": 3,                  # max hits, -1 = unlimited
+         "exception": "CudfException"} # or "GpuRetryOOM", ...
+     ]}
+
+The config file is watched by mtime and hot-reloaded, like the
+reference's dynamicReconfig watcher thread (faultinj.cu:88)."""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu.memory import exceptions as exc
+
+CONFIG_ENV = "FAULT_INJECTOR_CONFIG_PATH"
+
+_EXCEPTIONS = {
+    "CudfException": exc.CudfException,
+    "GpuRetryOOM": exc.GpuRetryOOM,
+    "GpuSplitAndRetryOOM": exc.GpuSplitAndRetryOOM,
+    "CpuRetryOOM": exc.CpuRetryOOM,
+    "CpuSplitAndRetryOOM": exc.CpuSplitAndRetryOOM,
+    "GpuOOM": exc.GpuOOM,
+}
+
+
+class _Rule:
+    def __init__(self, spec: dict):
+        self.match = spec.get("match", "*")
+        self.probability = float(spec.get("probability", 1.0))
+        self.remaining = int(spec.get("repeat", -1))
+        self.exception = _EXCEPTIONS.get(spec.get("exception",
+                                                  "CudfException"),
+                                         exc.CudfException)
+
+    def applies(self, op_name: str) -> bool:
+        return self.match == "*" or self.match == op_name
+
+
+class FaultInjector:
+    def __init__(self, config_path: Optional[str] = None,
+                 watch: bool = False):
+        self.config_path = config_path or os.environ.get(CONFIG_ENV)
+        self._rules: List[_Rule] = []
+        self._rng = random.Random()
+        self._lock = threading.Lock()
+        self._mtime = 0.0
+        self._watching = False
+        if self.config_path:
+            self.reload()
+            if watch:
+                self._watching = True
+                threading.Thread(target=self._watch_loop,
+                                 daemon=True).start()
+
+    def reload(self):
+        # stat BEFORE reading: a write landing between read and stat must
+        # still trigger another reload on the next watcher poll
+        try:
+            mtime = os.stat(self.config_path).st_mtime
+        except OSError:
+            mtime = self._mtime
+        with open(self.config_path) as f:
+            spec = json.load(f)
+        with self._lock:
+            if "seed" in spec:
+                self._rng = random.Random(spec["seed"])
+            self._rules = [_Rule(r) for r in spec.get("faults", [])]
+            self._mtime = mtime
+
+    def _watch_loop(self):
+        while self._watching:
+            time.sleep(0.2)
+            try:
+                m = os.stat(self.config_path).st_mtime
+            except OSError:
+                continue
+            if m != self._mtime:
+                try:
+                    self.reload()
+                except (json.JSONDecodeError, OSError):
+                    pass  # keep the old config on a bad write
+
+    def stop(self):
+        self._watching = False
+
+    def maybe_inject(self, op_name: str):
+        """Raise the configured exception for this op, honoring
+        probability and repeat count."""
+        with self._lock:
+            for rule in self._rules:
+                if not rule.applies(op_name):
+                    continue
+                if rule.remaining == 0:
+                    continue
+                if rule.probability < 1.0 and \
+                        self._rng.random() >= rule.probability:
+                    continue
+                if rule.remaining > 0:
+                    rule.remaining -= 1
+                raise rule.exception(
+                    f"injected fault in {op_name}")
+
+
+_global: Optional[FaultInjector] = None
+
+
+def install(config_path: Optional[str] = None,
+            watch: bool = True) -> FaultInjector:
+    """Process-global injector (the CUDA_INJECTION64_PATH load analog).
+    Replacing an installed injector stops its watcher first."""
+    global _global
+    if _global is not None:
+        _global.stop()
+    _global = FaultInjector(config_path, watch=watch)
+    return _global
+
+
+def uninstall():
+    global _global
+    if _global is not None:
+        _global.stop()
+    _global = None
+
+
+def maybe_inject(op_name: str):
+    if _global is not None:
+        _global.maybe_inject(op_name)

@@ -1,0 +1,171 @@
+"""In-process profiler (reference profiler/ 4.9k LoC: CUPTI activity ->
+flatbuffers -> JVM DataWriter callback, Profiler.java:36-120 control
+surface + NVTX ranges in every op).
+
+TPU mapping (SURVEY.md §5): device tracing goes through jax.profiler
+(XPlane/TensorBoard, the Nsight analog — the converter role is played by
+TensorBoard's trace viewer); the in-process activity stream (op ranges,
+allocations) is recorded here and pushed to a DataWriter callback as
+length-prefixed JSON records (the flatbuffers analog; self-describing so
+the Java shim can decode without a schema compiler)."""
+
+from __future__ import annotations
+
+import json
+import struct
+import threading
+import time
+from contextlib import contextmanager
+from typing import Callable, List, Optional
+
+import jax
+
+
+class Config:
+    """Profiler.Config.Builder analog (Profiler.java:133-145)."""
+
+    def __init__(self, write_buffer_size: int = 1 << 20,
+                 flush_period_millis: int = 0,
+                 alloc_capture: bool = False,
+                 device_trace_dir: Optional[str] = None):
+        self.write_buffer_size = write_buffer_size
+        self.flush_period_millis = flush_period_millis
+        self.alloc_capture = alloc_capture
+        self.device_trace_dir = device_trace_dir
+
+
+class Profiler:
+    """Singleton-style control surface: init/start/stop/shutdown."""
+
+    _instance: Optional["Profiler"] = None
+
+    def __init__(self, data_writer: Callable[[bytes], None],
+                 config: Config):
+        self.writer = data_writer
+        self.config = config
+        self._buffer: List[bytes] = []
+        self._buffered_bytes = 0
+        self._lock = threading.Lock()
+        self._running = False
+        self._device_tracing = False
+        self._flusher: Optional[threading.Thread] = None
+
+    # --------------------------------------------------------- lifecycle
+
+    @classmethod
+    def init(cls, data_writer, config: Optional[Config] = None
+             ) -> "Profiler":
+        if cls._instance is not None:
+            raise RuntimeError("profiler already initialized")
+        cls._instance = Profiler(data_writer, config or Config())
+        return cls._instance
+
+    @classmethod
+    def get(cls) -> Optional["Profiler"]:
+        return cls._instance
+
+    @classmethod
+    def shutdown(cls):
+        inst = cls._instance
+        if inst is not None:
+            inst.stop()
+            inst.flush()
+            cls._instance = None
+
+    def start(self):
+        if self._running:
+            return
+        self._running = True
+        if self.config.device_trace_dir:
+            try:
+                jax.profiler.start_trace(self.config.device_trace_dir)
+                self._device_tracing = True
+            except Exception:  # backend may not support tracing
+                self._device_tracing = False
+        if self.config.flush_period_millis > 0:
+            self._flusher = threading.Thread(target=self._flush_loop,
+                                             daemon=True)
+            self._flusher.start()
+        self.record("profiler_start", {})
+
+    def stop(self):
+        if not self._running:
+            return
+        self.record("profiler_stop", {})
+        self._running = False
+        if self._flusher is not None:
+            self._flusher.join(
+                self.config.flush_period_millis / 1000.0 * 4 + 1)
+            self._flusher = None
+        if self._device_tracing:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._device_tracing = False
+        self.flush()
+
+    # --------------------------------------------------------- recording
+
+    def record(self, kind: str, payload: dict):
+        """Append one activity record (KernelActivity/ApiActivity/... in
+        the reference fbs schema, profiler.fbs:136-287)."""
+        if not self._running and kind not in ("profiler_stop",):
+            return
+        rec = json.dumps({"kind": kind, "t_ns": time.monotonic_ns(),
+                          **payload}).encode()
+        framed = struct.pack("<I", len(rec)) + rec
+        blob = None
+        with self._lock:
+            self._buffer.append(framed)
+            self._buffered_bytes += len(framed)
+            if self._buffered_bytes >= self.config.write_buffer_size:
+                blob = self._take_locked()
+        if blob:
+            self.writer(blob)  # outside the lock: writer may re-enter
+
+    def flush(self):
+        with self._lock:
+            blob = self._take_locked()
+        if blob:
+            self.writer(blob)
+
+    def _take_locked(self) -> bytes:
+        blob = b"".join(self._buffer)
+        self._buffer = []
+        self._buffered_bytes = 0
+        return blob
+
+    def _flush_loop(self):
+        period = self.config.flush_period_millis / 1000.0
+        while self._running:
+            time.sleep(period)
+            self.flush()
+
+
+@contextmanager
+def op_range(name: str, **attrs):
+    """NVTX3_FUNC_RANGE analog (nvtx_ranges.hpp): wraps an op in a
+    jax.profiler annotation + emits a range record to the in-process
+    profiler when one is running."""
+    prof = Profiler.get()
+    t0 = time.monotonic_ns()
+    try:
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    finally:
+        if prof is not None:
+            prof.record("op_range", {"name": name,
+                                     "dur_ns": time.monotonic_ns() - t0,
+                                     **attrs})
+
+
+def iter_records(blob: bytes):
+    """Decode a DataWriter blob back into record dicts (the
+    spark_rapids_profile_converter role for tests/tools)."""
+    pos = 0
+    while pos < len(blob):
+        (n,) = struct.unpack_from("<I", blob, pos)
+        pos += 4
+        yield json.loads(blob[pos:pos + n])
+        pos += n

@@ -1,0 +1,91 @@
+"""Device telemetry (reference NVMLJni.cpp + nvml/*.java: device info,
+utilization, memory, periodic NVMLMonitor with callback interface).
+
+TPU mapping: per-device info from jax.devices() metadata and
+device.memory_stats() (libtpu-provided HBM counters); the periodic
+monitor mirrors NVMLMonitor.java:49's start/stop + listener shape."""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import jax
+
+
+@dataclass
+class DeviceInfo:
+    index: int
+    kind: str
+    platform: str
+    process_index: int
+    memory_stats: Dict[str, int] = field(default_factory=dict)
+
+
+def get_device_count() -> int:
+    return len(jax.devices())
+
+
+def get_device_info(index: int = 0) -> DeviceInfo:
+    d = jax.devices()[index]
+    stats: Dict[str, int] = {}
+    try:
+        raw = d.memory_stats()
+        if raw:
+            stats = {k: int(v) for k, v in raw.items()}
+    except Exception:
+        pass
+    return DeviceInfo(index=index, kind=d.device_kind,
+                      platform=d.platform,
+                      process_index=d.process_index,
+                      memory_stats=stats)
+
+
+def get_memory_info(index: int = 0) -> Dict[str, int]:
+    """{'total': .., 'used': ..} when the backend exposes it (the NVML
+    memory query analog)."""
+    stats = get_device_info(index).memory_stats
+    out = {}
+    if "bytes_limit" in stats:
+        out["total"] = stats["bytes_limit"]
+    if "bytes_in_use" in stats:
+        out["used"] = stats["bytes_in_use"]
+        if "total" in out:
+            out["free"] = out["total"] - out["used"]
+    return out
+
+
+class Monitor:
+    """Periodic sampler with listener callback (NVMLMonitor.java:49)."""
+
+    def __init__(self, period_millis: int,
+                 listener: Callable[[List[DeviceInfo]], None]):
+        self.period = period_millis / 1000.0
+        self.listener = listener
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        if self._running:
+            return
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(self.period * 4 + 1)
+            self._thread = None
+
+    def _loop(self):
+        while self._running:
+            infos = [get_device_info(i)
+                     for i in range(get_device_count())]
+            try:
+                self.listener(infos)
+            except Exception:
+                pass  # listener bugs must not kill the monitor
+            time.sleep(self.period)

@@ -1,0 +1,161 @@
+"""Profiler / fault injection / telemetry sidecar tests (reference
+ProfilerJni + faultinj + NVML contracts)."""
+
+import json
+import os
+import time
+
+import pytest
+
+from spark_rapids_tpu.memory import exceptions as exc
+from spark_rapids_tpu.utils import fault_injection as fi
+from spark_rapids_tpu.utils import profiler as prof
+from spark_rapids_tpu.utils import telemetry
+
+
+def test_profiler_lifecycle_and_records():
+    blobs = []
+    p = prof.Profiler.init(blobs.append, prof.Config(write_buffer_size=64))
+    try:
+        p.start()
+        with prof.op_range("murmur3_32", rows=100):
+            pass
+        with prof.op_range("convert_to_rows"):
+            pass
+        p.stop()
+        records = [r for b in blobs for r in prof.iter_records(b)]
+        kinds = [r["kind"] for r in records]
+        assert kinds[0] == "profiler_start"
+        assert kinds[-1] == "profiler_stop"
+        ops = [r for r in records if r["kind"] == "op_range"]
+        assert [o["name"] for o in ops] == ["murmur3_32",
+                                            "convert_to_rows"]
+        assert ops[0]["rows"] == 100
+        assert all(o["dur_ns"] >= 0 for o in ops)
+    finally:
+        prof.Profiler.shutdown()
+
+
+def test_profiler_double_init_and_idle_ranges():
+    blobs = []
+    prof.Profiler.init(blobs.append)
+    try:
+        with pytest.raises(RuntimeError):
+            prof.Profiler.init(blobs.append)
+        # ranges while not started are not recorded
+        with prof.op_range("idle_op"):
+            pass
+        prof.Profiler.get().flush()
+        assert not any(r["kind"] == "op_range"
+                       for b in blobs for r in prof.iter_records(b))
+    finally:
+        prof.Profiler.shutdown()
+
+
+def test_fault_injection_rules(tmp_path):
+    cfg = tmp_path / "faults.json"
+    cfg.write_text(json.dumps({
+        "seed": 1,
+        "faults": [
+            {"match": "hash", "repeat": 2,
+             "exception": "CudfException"},
+            {"match": "alloc", "probability": 0.0},
+        ]}))
+    inj = fi.FaultInjector(str(cfg))
+    with pytest.raises(exc.CudfException, match="injected fault in hash"):
+        inj.maybe_inject("hash")
+    with pytest.raises(exc.CudfException):
+        inj.maybe_inject("hash")
+    inj.maybe_inject("hash")       # repeat exhausted
+    inj.maybe_inject("alloc")      # probability 0
+    inj.maybe_inject("other_op")   # no matching rule
+
+
+def test_fault_injection_wildcard_and_oom(tmp_path):
+    cfg = tmp_path / "faults.json"
+    cfg.write_text(json.dumps({
+        "faults": [{"match": "*", "exception": "GpuRetryOOM",
+                    "repeat": 1}]}))
+    inj = fi.FaultInjector(str(cfg))
+    with pytest.raises(exc.GpuRetryOOM):
+        inj.maybe_inject("anything")
+    inj.maybe_inject("anything")
+
+
+def test_fault_injection_hot_reload(tmp_path):
+    cfg = tmp_path / "faults.json"
+    cfg.write_text(json.dumps({"faults": []}))
+    inj = fi.FaultInjector(str(cfg), watch=True)
+    try:
+        inj.maybe_inject("op")  # no rules yet
+        time.sleep(0.05)
+        cfg.write_text(json.dumps({
+            "faults": [{"match": "op", "exception": "CudfException"}]}))
+        os.utime(cfg, (time.time() + 5, time.time() + 5))
+        deadline = time.time() + 5
+        injected = False
+        while time.time() < deadline:
+            try:
+                inj.maybe_inject("op")
+            except exc.CudfException:
+                injected = True
+                break
+            time.sleep(0.05)
+        assert injected, "hot reload never picked up the new rule"
+    finally:
+        inj.stop()
+
+
+def test_global_injector_install():
+    fi.uninstall()
+    fi.maybe_inject("noop")  # no injector installed: no-op
+    assert fi._global is None
+
+
+def test_telemetry_device_info():
+    n = telemetry.get_device_count()
+    assert n >= 1
+    info = telemetry.get_device_info(0)
+    assert info.platform in ("cpu", "tpu", "axon")
+    assert info.index == 0
+    telemetry.get_memory_info(0)  # must not raise
+
+
+def test_telemetry_monitor():
+    samples = []
+    mon = telemetry.Monitor(20, samples.append)
+    mon.start()
+    time.sleep(0.15)
+    mon.stop()
+    assert len(samples) >= 2
+    assert all(len(s) == telemetry.get_device_count() for s in samples)
+
+
+def test_profiler_reentrant_writer_no_deadlock():
+    """Writer that re-enters flush must not deadlock (review regression)."""
+    done = []
+
+    def writer(blob):
+        p = prof.Profiler.get()
+        if p is not None:
+            p.flush()  # re-entrant call
+        done.append(blob)
+
+    p = prof.Profiler.init(writer, prof.Config(write_buffer_size=1))
+    try:
+        p.start()
+        with prof.op_range("x"):
+            pass
+        p.stop()
+        assert done
+    finally:
+        prof.Profiler.shutdown()
+
+
+def test_install_replaces_and_stops_previous(tmp_path):
+    cfg = tmp_path / "f.json"
+    cfg.write_text(json.dumps({"faults": []}))
+    first = fi.install(str(cfg), watch=True)
+    second = fi.install(str(cfg), watch=False)
+    assert first._watching is False  # old watcher stopped
+    fi.uninstall()
