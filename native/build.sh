@@ -1,0 +1,7 @@
+#!/bin/sh
+# Build the native runtime kernels (g++ only; no cmake needed for one TU).
+set -e
+cd "$(dirname "$0")"
+g++ -O3 -std=c++17 -shared -fPIC -o libcolumnar_native.so \
+    columnar_native.cpp
+echo "built $(pwd)/libcolumnar_native.so"

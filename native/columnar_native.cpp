@@ -1,0 +1,46 @@
+// Native runtime kernels for spark_rapids_tpu (the role C++ plays in the
+// reference: host-side hot loops the managed layer is too slow for —
+// SURVEY.md §2.2 kudo merge, join key preparation).
+//
+// Exposed as a plain C ABI consumed through ctypes (no pybind11 in this
+// image). Build: native/build.sh (g++ -O3 -shared -fPIC).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <numeric>
+#include <string_view>
+#include <vector>
+
+extern "C" {
+
+// Dense lexicographic ranks of n byte strings (Arrow layout: chars +
+// int64 offsets — callers widen int32 column offsets so concatenated
+// multi-column buffers can exceed 2^31 bytes). out_ranks[i] = rank of
+// row i; equal strings get equal ranks. Returns the distinct count.
+int64_t rank_strings(const uint8_t* chars, const int64_t* offsets,
+                     int64_t n, int64_t* out_ranks) {
+  std::vector<int64_t> idx(n);
+  std::iota(idx.begin(), idx.end(), 0);
+  auto view = [&](int64_t i) {
+    return std::string_view(reinterpret_cast<const char*>(chars) + offsets[i],
+                            offsets[i + 1] - offsets[i]);
+  };
+  std::sort(idx.begin(), idx.end(),
+            [&](int64_t a, int64_t b) { return view(a) < view(b); });
+  int64_t rank = -1;
+  std::string_view prev;
+  bool first = true;
+  for (int64_t k = 0; k < n; ++k) {
+    auto v = view(idx[k]);
+    if (first || v != prev) {
+      ++rank;
+      prev = v;
+      first = false;
+    }
+    out_ranks[idx[k]] = rank;
+  }
+  return rank + 1;
+}
+
+}  // extern "C"

@@ -25,7 +25,7 @@ from spark_rapids_tpu.columns import dtypes
 from spark_rapids_tpu.columns.column import Column
 from spark_rapids_tpu.columns.dtypes import Kind
 from spark_rapids_tpu.columns.table import Table
-from spark_rapids_tpu.utils import floats
+from spark_rapids_tpu.utils import floats, native
 
 _I32 = jnp.int32
 
@@ -33,13 +33,37 @@ NULL_EQUAL = "EQUAL"
 NULL_UNEQUAL = "UNEQUAL"
 
 
+def _mask_of(col: Column) -> np.ndarray:
+    return (np.ones(col.length, bool) if col.validity is None
+            else np.asarray(col.validity).astype(bool))
+
+
+def _string_buf(col: Column) -> np.ndarray:
+    return (np.asarray(col.data) if col.data is not None
+            else np.zeros(0, np.uint8))
+
+
+def _string_ranks(chars: np.ndarray, offsets: np.ndarray) -> np.ndarray:
+    """Dense lexicographic ranks of an Arrow string buffer — native C++
+    kernel when available (utils/native.py), np.unique fallback."""
+    offsets = np.asarray(offsets, dtype=np.int64)
+    ranks = native.rank_strings(chars, offsets)
+    if ranks is not None:
+        return ranks
+    vals = np.array([chars[offsets[i]:offsets[i + 1]].tobytes()
+                     for i in range(len(offsets) - 1)], dtype=object)
+    _, inv = np.unique(vals, return_inverse=True)
+    return inv.astype(np.int64)
+
+
 def _column_rank_host(col: Column) -> Tuple[np.ndarray, np.ndarray]:
     """(rank int64 array, null mask) — ranks order rows like the column's
     natural ordering; nulls get rank -1."""
     kind = col.dtype.kind
-    mask = (np.ones(col.length, bool) if col.validity is None
-            else np.asarray(col.validity).astype(bool))
-    if kind in (Kind.STRING, Kind.DECIMAL128):
+    mask = _mask_of(col)
+    if kind == Kind.STRING:
+        rank = _string_ranks(_string_buf(col), np.asarray(col.offsets))
+    elif kind == Kind.DECIMAL128:
         _, inv = np.unique(_raw_values(col), return_inverse=True)
         rank = inv.astype(np.int64)
     elif kind == Kind.FLOAT64:
@@ -69,13 +93,20 @@ def _key_ids(left: Table, right: Table, compare_nulls: str):
     for lc, rc in cols:
         if lc.dtype.kind != rc.dtype.kind:
             raise ValueError("join key dtypes must match")
-        if lc.dtype.kind in (Kind.STRING, Kind.DECIMAL128):
-            # ordinal ranks must be comparable across tables: rank jointly
-            # (single extraction pass per column)
-            lm = (np.ones(nl, bool) if lc.validity is None
-                  else np.asarray(lc.validity).astype(bool))
-            rm = (np.ones(nr, bool) if rc.validity is None
-                  else np.asarray(rc.validity).astype(bool))
+        if lc.dtype.kind == Kind.STRING:
+            # joint ranking over the concatenated Arrow buffers (native
+            # C++ rank kernel when available); int64 offsets so the
+            # combined buffers may exceed 2^31 bytes
+            lm, rm = _mask_of(lc), _mask_of(rc)
+            lchars, rchars = _string_buf(lc), _string_buf(rc)
+            loffs = np.asarray(lc.offsets).astype(np.int64)
+            roffs = np.asarray(rc.offsets).astype(np.int64)
+            chars = np.concatenate([lchars, rchars])
+            offsets = np.concatenate([loffs, roffs[1:] + len(lchars)])
+            inv = _string_ranks(chars, offsets)
+            lr, rr = inv[:nl], inv[nl:]
+        elif lc.dtype.kind == Kind.DECIMAL128:
+            lm, rm = _mask_of(lc), _mask_of(rc)
             lvals, rvals = _raw_values(lc), _raw_values(rc)
             _, inv = np.unique(np.concatenate([lvals, rvals]),
                                return_inverse=True)
@@ -102,12 +133,6 @@ def _key_ids(left: Table, right: Table, compare_nulls: str):
 
 def _raw_values(col: Column) -> np.ndarray:
     kind = col.dtype.kind
-    if kind == Kind.STRING:
-        chars = np.asarray(col.data).tobytes() if col.data is not None \
-            else b""
-        offs = np.asarray(col.offsets)
-        return np.array([chars[offs[i]:offs[i + 1]]
-                         for i in range(col.length)], dtype=object)
     if kind == Kind.DECIMAL128:
         limbs = np.asarray(col.data).astype(np.uint32).astype(object)
         vals = (limbs[:, 0] + (limbs[:, 1] << 32) + (limbs[:, 2] << 64)

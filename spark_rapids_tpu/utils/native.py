@@ -1,0 +1,83 @@
+"""Loader for the native runtime kernels (native/columnar_native.cpp).
+
+The reference's runtime around the device compute path is C++
+(SparkResourceAdaptorJni, kudo merge, join prep); here the native library
+is compiled on first use with the system g++ and bound through ctypes
+(no pybind11 in this image).  Everything has a pure-Python fallback —
+set SPARK_RAPIDS_TPU_DISABLE_NATIVE=1 to force it."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libcolumnar_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if os.environ.get("SPARK_RAPIDS_TPU_DISABLE_NATIVE") == "1":
+        return None
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        src = os.path.join(_NATIVE_DIR, "columnar_native.cpp")
+        try:
+            if not os.path.exists(_LIB_PATH) or (
+                    os.path.exists(src)
+                    and os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)):
+                # compile to a temp name and rename: atomic against
+                # concurrent builders (multi-process executors)
+                tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
+                subprocess.run(
+                    ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                     "-o", tmp, src],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(tmp, _LIB_PATH)
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.rank_strings.restype = ctypes.c_int64
+            lib.rank_strings.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_void_p]
+            _lib = lib
+        except (OSError, subprocess.SubprocessError):
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def rank_strings(chars: np.ndarray, offsets: np.ndarray
+                 ) -> Optional[np.ndarray]:
+    """Dense lexicographic ranks for an Arrow string buffer; None when the
+    native library is unavailable (caller falls back to np.unique)."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(offsets) - 1
+    chars = np.ascontiguousarray(chars, dtype=np.uint8)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    out = np.empty(n, np.int64)
+    lib.rank_strings(
+        chars.ctypes.data_as(ctypes.c_void_p),
+        offsets.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(n),
+        out.ctypes.data_as(ctypes.c_void_p))
+    return out
+
+
+
