@@ -1,0 +1,297 @@
+"""Parquet footer parsing + column pruning (reference
+NativeParquetJni.cpp 917 LoC: host-side thrift TCompactProtocol parse,
+column_pruner :126 / column_pruning_maps :88, case-insensitive schema
+matching; ParquetFooter.java:225 readAndFilter).
+
+The footer is decoded into a GENERIC thrift value tree (field ids
+preserved, unknown fields kept verbatim), pruned, and re-encoded — so
+everything the writer put in the footer survives except the pruned
+columns, exactly the trimmed-footer contract."""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional, Tuple
+
+# thrift compact type ids
+_T_BOOL_TRUE = 1
+_T_BOOL_FALSE = 2
+_T_BYTE = 3
+_T_I16 = 4
+_T_I32 = 5
+_T_I64 = 6
+_T_DOUBLE = 7
+_T_BINARY = 8
+_T_LIST = 9
+_T_SET = 10
+_T_MAP = 11
+_T_STRUCT = 12
+
+PARQUET_MAGIC = b"PAR1"
+
+
+class _Reader:
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def byte(self) -> int:
+        b = self.buf[self.pos]
+        self.pos += 1
+        return b
+
+    def varint(self) -> int:
+        result = 0
+        shift = 0
+        while True:
+            b = self.byte()
+            result |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return result
+            shift += 7
+
+    def zigzag(self) -> int:
+        v = self.varint()
+        return (v >> 1) ^ -(v & 1)
+
+    def read_binary(self) -> bytes:
+        n = self.varint()
+        out = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def read_value(self, ttype: int):
+        if ttype == _T_BOOL_TRUE:
+            return True
+        if ttype == _T_BOOL_FALSE:
+            return False
+        if ttype == _T_BYTE:
+            return self._read_byte_val()
+        if ttype in (_T_I16, _T_I32, _T_I64):
+            return self.zigzag()
+        if ttype == _T_DOUBLE:
+            v = struct.unpack_from("<d", self.buf, self.pos)[0]
+            self.pos += 8
+            return v
+        if ttype == _T_BINARY:
+            return self.read_binary()
+        if ttype in (_T_LIST, _T_SET):
+            return self.read_list()
+        if ttype == _T_STRUCT:
+            return self.read_struct()
+        raise ValueError(f"unsupported thrift type {ttype}")
+
+    def _read_byte_val(self) -> int:
+        v = self.byte()
+        return v - 256 if v >= 128 else v
+
+    def read_list(self):
+        head = self.byte()
+        size = head >> 4
+        etype = head & 0x0F
+        if size == 15:
+            size = self.varint()
+        if etype in (_T_BOOL_TRUE, _T_BOOL_FALSE):
+            # list bools are one byte per element (unlike struct fields)
+            items = [self.byte() == _T_BOOL_TRUE for _ in range(size)]
+        else:
+            items = [self.read_value(etype) for _ in range(size)]
+        return ("list", etype, items)
+
+    def read_struct(self):
+        fields: Dict[int, Tuple[int, object]] = {}
+        fid = 0
+        while True:
+            head = self.byte()
+            if head == 0:
+                return ("struct", fields)
+            delta = head >> 4
+            ttype = head & 0x0F
+            if delta:
+                fid += delta
+            else:
+                fid = self.zigzag()
+            if ttype in (_T_BOOL_TRUE, _T_BOOL_FALSE):
+                fields[fid] = (ttype, ttype == _T_BOOL_TRUE)
+            else:
+                fields[fid] = (ttype, self.read_value(ttype))
+
+
+class _Writer:
+    def __init__(self):
+        self.out = bytearray()
+
+    def byte(self, b: int):
+        self.out.append(b & 0xFF)
+
+    def varint(self, v: int):
+        v &= (1 << 64) - 1
+        while True:
+            b = v & 0x7F
+            v >>= 7
+            if v:
+                self.byte(b | 0x80)
+            else:
+                self.byte(b)
+                return
+
+    def zigzag(self, v: int):
+        self.varint((v << 1) ^ (v >> 63) if v < 0 else v << 1)
+
+    def write_value(self, ttype: int, v):
+        if ttype in (_T_BOOL_TRUE, _T_BOOL_FALSE):
+            return  # encoded in the field header
+        if ttype == _T_BYTE:
+            self.byte(v & 0xFF)
+        elif ttype in (_T_I16, _T_I32, _T_I64):
+            self.zigzag(v)
+        elif ttype == _T_DOUBLE:
+            self.out += struct.pack("<d", v)
+        elif ttype == _T_BINARY:
+            self.varint(len(v))
+            self.out += v
+        elif ttype in (_T_LIST, _T_SET):
+            _, etype, items = v
+            if len(items) < 15:
+                self.byte((len(items) << 4) | etype)
+            else:
+                self.byte(0xF0 | etype)
+                self.varint(len(items))
+            for item in items:
+                if etype == _T_BOOL_TRUE:
+                    self.byte(1 if item else 2)
+                else:
+                    self.write_value(etype, item)
+        elif ttype == _T_STRUCT:
+            self.write_struct(v)
+        else:
+            raise ValueError(f"unsupported thrift type {ttype}")
+
+    def write_struct(self, sv):
+        _, fields = sv
+        last = 0
+        for fid in sorted(fields):
+            ttype, v = fields[fid]
+            if ttype in (_T_BOOL_TRUE, _T_BOOL_FALSE):
+                ttype = _T_BOOL_TRUE if v else _T_BOOL_FALSE
+            delta = fid - last
+            if 0 < delta <= 15:
+                self.byte((delta << 4) | ttype)
+            else:
+                self.byte(ttype)
+                self.zigzag(fid)
+            self.write_value(ttype, v)
+            last = fid
+        self.byte(0)
+
+
+# --------------------------------------------------------- footer model
+
+
+def _sval(sv, fid, default=None):
+    if sv is None:
+        return default
+    t = sv[1].get(fid)
+    return default if t is None else t[1]
+
+
+def parse_footer(data: bytes):
+    """Thrift bytes (without the trailing length+PAR1) -> generic tree."""
+    return _Reader(data).read_struct()
+
+
+def serialize_footer(tree) -> bytes:
+    w = _Writer()
+    w.write_struct(tree)
+    return bytes(w.out)
+
+
+def read_footer_from_file(path: str):
+    """Extract and parse the footer from a .parquet file."""
+    with open(path, "rb") as f:
+        f.seek(0, 2)
+        size = f.tell()
+        f.seek(size - 8)
+        tail = f.read(8)
+        if tail[4:] != PARQUET_MAGIC:
+            raise ValueError("not a parquet file")
+        flen = struct.unpack("<I", tail[:4])[0]
+        f.seek(size - 8 - flen)
+        return parse_footer(f.read(flen))
+
+
+def _schema_elements(tree) -> List:
+    return _sval(tree, 2)[2]
+
+
+def prune_columns(tree, keep_names: List[str],
+                  case_sensitive: bool = True):
+    """Trim the footer to the requested TOP-LEVEL columns (nested
+    subtrees of kept columns are preserved whole) — the common pruning
+    shape of ParquetFooter.readAndFilter; per-leaf nested pruning is a
+    later extension.  Returns a new tree."""
+    elems = _schema_elements(tree)
+    # schema is a depth-first flattened tree; element 0 is the root
+    def subtree_size(i: int) -> int:
+        nc = _sval(elems[i], 5, 0)
+        size = 1
+        j = i + 1
+        for _ in range(nc):
+            sz = subtree_size(j)
+            size += sz
+            j += sz
+        return size
+
+    def norm(s: bytes) -> str:
+        t = s.decode("utf-8", "replace")
+        return t if case_sensitive else t.lower()
+
+    want = {n if case_sensitive else n.lower() for n in keep_names}
+    root = elems[0]
+    kept_elems = []
+    kept_names = set()
+    kept_top = 0
+    i = 1
+    top_count = _sval(root, 5, 0)
+    for _ in range(top_count):
+        sz = subtree_size(i)
+        name = norm(_sval(elems[i], 4, b""))
+        if name in want:
+            kept_elems.extend(elems[i:i + sz])
+            kept_names.add(name)
+            kept_top += 1
+        i += sz
+    new_root = ("struct", dict(root[1]))
+    new_root[1][5] = (_T_I32, kept_top)
+    # rebuild tree
+    new_fields = dict(tree[1])
+    new_fields[2] = (_T_LIST, ("list", _T_STRUCT,
+                               [new_root] + kept_elems))
+    # prune row group column chunks by path head
+    rg_entry = tree[1].get(4)
+    if rg_entry is not None:
+        new_rgs = []
+        for rg in rg_entry[1][2]:
+            rg_fields = dict(rg[1])
+            cols_entry = rg_fields.get(1)
+            if cols_entry is not None:
+                new_cols = []
+                for cc in cols_entry[1][2]:
+                    md = _sval(cc, 3)
+                    path = _sval(md, 3)
+                    head = norm(path[2][0]) if path and path[2] else None
+                    if head is None or head in kept_names:
+                        new_cols.append(cc)
+                rg_fields[1] = (_T_LIST, ("list", _T_STRUCT, new_cols))
+            new_rgs.append(("struct", rg_fields))
+        new_fields[4] = (_T_LIST, ("list", _T_STRUCT, new_rgs))
+    return ("struct", new_fields)
+
+
+def read_and_filter(path: str, keep_names: List[str],
+                    case_sensitive: bool = True) -> bytes:
+    """ParquetFooter.readAndFilter: read, prune, return trimmed thrift
+    bytes."""
+    tree = read_footer_from_file(path)
+    return serialize_footer(prune_columns(tree, keep_names,
+                                          case_sensitive))

@@ -80,3 +80,8 @@ from spark_rapids_tpu.ops.strings_misc import (  # noqa: F401
     list_slice,
     literal_range_pattern,
 )
+from spark_rapids_tpu.ops import map_utils  # noqa: F401
+from spark_rapids_tpu.ops import json_utils  # noqa: F401
+from spark_rapids_tpu.ops import iceberg  # noqa: F401
+from spark_rapids_tpu.ops import protobuf  # noqa: F401
+from spark_rapids_tpu.ops.uuid_gen import random_uuids  # noqa: F401

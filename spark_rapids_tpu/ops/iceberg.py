@@ -1,0 +1,170 @@
+"""Iceberg partition transforms (reference src/main/cpp/src/iceberg/:
+iceberg_bucket.cu, iceberg_truncate.cu, iceberg_datetime_util.cu;
+IcebergBucket.java etc.) — bucket (STANDARD murmur3_32 seed 0, NOT the
+Spark variant: ints promote to longs and hash as 8 LE bytes, decimals
+hash their minimal big-endian two's-complement unscaled bytes), truncate
+(positive-mod for integrals/decimals, leading codepoints for strings),
+and year/month/day/hour datetime transforms."""
+
+from __future__ import annotations
+
+from typing import Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.columns.dtypes import Kind
+from spark_rapids_tpu.ops.hash import (_MM_C1, _MM_C2, _MM_C3, _mm_fmix,
+                                       _mm_update, _rotl32, _split_u64,
+                                       _dec128_min_be_bytes, _pad_chars,
+                                       _chars_to_u32_blocks)
+from spark_rapids_tpu.ops.datetime_ops import _days_to_ymd
+
+_U8 = jnp.uint8
+_U32 = jnp.uint32
+_U64 = jnp.uint64
+_I32 = jnp.int32
+_I64 = jnp.int64
+
+MICROS_PER_HOUR = 3_600_000_000
+MICROS_PER_DAY = 86_400_000_000
+
+
+def _std_murmur_varbytes(chars: jnp.ndarray, lens: jnp.ndarray
+                         ) -> jnp.ndarray:
+    """STANDARD murmur3_32 (seed 0) over per-row byte strings — unlike
+    Spark's variant, the tail partial block is combined little-endian and
+    mixed once without the h-rotation (iceberg_bucket.cu hash_bytes via
+    cuco MurmurHash3_32)."""
+    chars = _pad_chars(chars, 4)
+    blocks = _chars_to_u32_blocks(chars)
+    nblocks = (lens // 4).astype(_I32)
+    rows = chars.shape[0]
+    h = jnp.zeros(rows, _U32)
+
+    def body(hc, xs):
+        i, blk = xs
+        h2 = _mm_update(hc, blk)
+        return jnp.where(i < nblocks, h2, hc), None
+
+    nb = blocks.shape[1]
+    h, _ = lax.scan(body, h,
+                    (jnp.arange(nb, dtype=_I32), blocks.T))
+    # standard tail: combine remaining 1-3 bytes LE, single k1 mix
+    p = chars.shape[1]
+    tail = jnp.zeros(rows, _U32)
+    for j in range(3):
+        idx = nblocks * 4 + j
+        byte = jnp.take_along_axis(
+            chars, jnp.clip(idx, 0, p - 1)[:, None], axis=1)[:, 0]
+        tail = tail | jnp.where(idx < lens,
+                                byte.astype(_U32) << _U32(8 * j), _U32(0))
+    k1 = tail * _MM_C1
+    k1 = _rotl32(k1, 15)
+    k1 = k1 * _MM_C2
+    h = jnp.where(lens % 4 != 0, h ^ k1, h)
+    h = h ^ lens.astype(_U32)
+    return _mm_fmix(h)
+
+
+def _std_murmur_u64(v: jnp.ndarray) -> jnp.ndarray:
+    """Standard murmur3_32 of 8 LE bytes (Iceberg hashLong)."""
+    lo, hi = _split_u64(v.astype(_U64))
+    h = jnp.zeros(v.shape, _U32)
+    h = _mm_update(h, lo)
+    h = _mm_update(h, hi)
+    h = h ^ _U32(8)
+    return _mm_fmix(h)
+
+
+def bucket(col: Column, num_buckets: int) -> Column:
+    """Iceberg bucket transform: (hash & MAX_INT) % N, null-preserving."""
+    kind = col.dtype.kind
+    if kind in (Kind.INT32, Kind.INT64, Kind.TIMESTAMP_DAYS,
+                Kind.TIMESTAMP_MICROS):
+        h = _std_murmur_u64(col.data.astype(_I64))
+    elif kind == Kind.STRING:
+        chars, lens = col.to_padded_chars()
+        h = _std_murmur_varbytes(chars, lens)
+    elif kind in (Kind.DECIMAL32, Kind.DECIMAL64):
+        # minimal big-endian two's complement of the unscaled value
+        from spark_rapids_tpu.ops.hash import _fixed_width_blocks
+        v = col.data.astype(_I64)
+        limbs = jnp.stack([
+            (v & _I64(0xFFFFFFFF)).astype(_I32),
+            ((v >> _I64(32)) & _I64(0xFFFFFFFF)).astype(_I32),
+            jnp.where(v < 0, _I32(-1), _I32(0)),
+            jnp.where(v < 0, _I32(-1), _I32(0))], axis=1)
+        be, length = _dec128_min_be_bytes(limbs)
+        h = _std_murmur_varbytes(be, length)
+    elif kind == Kind.DECIMAL128:
+        be, length = _dec128_min_be_bytes(col.data)
+        h = _std_murmur_varbytes(be, length)
+    else:
+        raise NotImplementedError(f"iceberg bucket of {kind}")
+    b = (h & _U32(0x7FFFFFFF)) % _U32(num_buckets)
+    return Column(dtypes.INT32, col.length, data=b.astype(_I32),
+                  validity=col.validity)
+
+
+def truncate(col: Column, width: int) -> Column:
+    """Iceberg truncate transform (iceberg_truncate.cu:48-61 examples:
+    truncate(10, 5)=0, truncate(10, 15)=10, truncate(10, -5)=-10)."""
+    kind = col.dtype.kind
+    if kind in (Kind.INT32, Kind.INT64, Kind.DECIMAL32, Kind.DECIMAL64):
+        v = col.data.astype(_I64)
+        w = _I64(width)
+        out = v - (((v % w) + w) % w)
+        return Column(col.dtype, col.length,
+                      data=out.astype(col.dtype.np_dtype),
+                      validity=col.validity)
+    if kind == Kind.STRING:
+        # first `width` CODEPOINTS (not bytes): keep bytes whose position
+        # in codepoints is < width
+        out = []
+        mask = (np.ones(col.length, bool) if col.validity is None
+                else np.asarray(col.validity).astype(bool))
+        for i, s in enumerate(col.to_pylist()):
+            out.append(s[:width] if mask[i] and s is not None else None)
+        return Column.from_strings(out)
+    raise NotImplementedError(f"iceberg truncate of {kind}")
+
+
+def year(col: Column) -> Column:
+    """Years since 1970 (iceberg_datetime_util.cu)."""
+    days = _col_days(col)
+    y, _, _ = _days_to_ymd(days)
+    return Column(dtypes.INT32, col.length,
+                  data=(y - 1970).astype(_I32), validity=col.validity)
+
+
+def month(col: Column) -> Column:
+    days = _col_days(col)
+    y, m, _ = _days_to_ymd(days)
+    return Column(dtypes.INT32, col.length,
+                  data=((y - 1970) * 12 + m - 1).astype(_I32),
+                  validity=col.validity)
+
+
+def day(col: Column) -> Column:
+    days = _col_days(col)
+    return Column(dtypes.INT32, col.length, data=days.astype(_I32),
+                  validity=col.validity)
+
+
+def hour(col: Column) -> Column:
+    assert col.dtype.kind == Kind.TIMESTAMP_MICROS
+    h = col.data.astype(_I64) // _I64(MICROS_PER_HOUR)
+    return Column(dtypes.INT32, col.length, data=h.astype(_I32),
+                  validity=col.validity)
+
+
+def _col_days(col: Column) -> jnp.ndarray:
+    if col.dtype.kind == Kind.TIMESTAMP_DAYS:
+        return col.data.astype(_I64)
+    if col.dtype.kind == Kind.TIMESTAMP_MICROS:
+        return col.data.astype(_I64) // _I64(MICROS_PER_DAY)
+    raise NotImplementedError(f"datetime transform of {col.dtype.kind}")

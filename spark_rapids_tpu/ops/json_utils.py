@@ -1,0 +1,159 @@
+"""from_json family (reference from_json_to_raw_map.cu,
+from_json_to_structs.cu, json_utils.hpp helpers; JSONUtils.java:159-188):
+Spark from_json to MAP<STRING,STRING> and to typed structs, plus the
+remove_quotes / concat_json helpers, all over the tolerant parser in
+ops/json_path.py."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.columns.dtypes import DType, Kind
+from spark_rapids_tpu.ops.json_path import _Invalid, _Parser, _render_json
+from spark_rapids_tpu.ops import cast_string
+
+
+def _parse_rows(col: Column):
+    for v in col.to_pylist():
+        if v is None:
+            yield None
+            continue
+        try:
+            yield _Parser(v).parse()
+        except _Invalid:
+            yield None
+
+
+def _value_as_raw_string(v) -> str:
+    """Raw-map value rendering: string scalars unescaped, everything else
+    as (normalized) JSON text."""
+    if v[0] == "str":
+        return v[1]
+    return _render_json(v)
+
+
+def from_json_to_raw_map(col: Column) -> Column:
+    """JSON object rows -> MAP<STRING,STRING>
+    (JSONUtils.extractRawMapFromJsonString:159).  Non-object / invalid
+    rows are null; duplicate keys keep the last value."""
+    assert col.dtype.is_string
+    rows = col.length
+    keys: List[str] = []
+    vals: List[str] = []
+    new_offs = np.zeros(rows + 1, np.int32)
+    validity = np.zeros(rows, np.uint8)
+    for i, tree in enumerate(_parse_rows(col)):
+        if tree is None or tree[0] != "obj":
+            new_offs[i + 1] = len(keys)
+            continue
+        validity[i] = 1
+        seen = {}
+        order = []
+        for k, v in tree[1]:
+            if k not in seen:
+                order.append(k)
+            seen[k] = _value_as_raw_string(v)
+        for k in order:
+            keys.append(k)
+            vals.append(seen[k])
+        new_offs[i + 1] = len(keys)
+    st = Column.make_struct(len(keys), [Column.from_strings(keys),
+                                        Column.from_strings(vals)])
+    return Column(dtypes.LIST, rows,
+                  validity=None if validity.all() else
+                  jnp.asarray(validity),
+                  offsets=jnp.asarray(new_offs), children=(st,))
+
+
+def from_json_to_structs(col: Column,
+                         fields: Sequence[Tuple[str, DType]]) -> Column:
+    """JSON object rows -> STRUCT column with the requested fields
+    (JSONUtils.fromJSONToStructs:188; schema as parallel vectors in the
+    reference json_utils.hpp:10-23).  Missing/mistyped fields are null;
+    invalid rows null the whole struct."""
+    assert col.dtype.is_string
+    rows = col.length
+    extracted: List[List[Optional[str]]] = [[] for _ in fields]
+    validity = np.zeros(rows, np.uint8)
+    for i, tree in enumerate(_parse_rows(col)):
+        if tree is None or tree[0] != "obj":
+            for lst in extracted:
+                lst.append(None)
+            continue
+        obj = dict(tree[1])
+        validity[i] = 1
+        for (name, _dt), lst in zip(fields, extracted):
+            v = obj.get(name)
+            if v is None or v == ("lit", "null"):
+                lst.append(None)
+            else:
+                lst.append(_value_as_raw_string(v))
+    children = []
+    for (name, dt), raw in zip(fields, extracted):
+        scol = Column.from_strings(raw)
+        children.append(convert_from_strings(scol, dt))
+    return Column.make_struct(rows, children,
+                              validity=None if validity.all()
+                              else validity)
+
+
+def convert_from_strings(col: Column, dtype: DType) -> Column:
+    """String column -> typed column with Spark cast semantics
+    (json_utils.hpp:67 convert_from_strings)."""
+    if dtype.is_string:
+        return col
+    if dtype.kind == Kind.BOOL8:
+        vals = [None if v is None else
+                (True if v == "true" else False if v == "false" else None)
+                for v in col.to_pylist()]
+        return Column.from_pylist(vals, dtype)
+    if dtype.kind in (Kind.INT8, Kind.INT16, Kind.INT32, Kind.INT64):
+        return cast_string.string_to_integer(col, dtype)
+    if dtype.kind in (Kind.FLOAT32, Kind.FLOAT64):
+        return cast_string.string_to_float(col, dtype)
+    raise NotImplementedError(f"from_json field type {dtype.kind}")
+
+
+def remove_quotes(col: Column, nullify_if_not_quoted: bool = False
+                  ) -> Column:
+    """Strip one pair of surrounding double quotes (json_utils.hpp:84)."""
+    assert col.dtype.is_string
+    out = []
+    for v in col.to_pylist():
+        if v is None:
+            out.append(None)
+        elif len(v) >= 2 and v[0] == '"' and v[-1] == '"':
+            out.append(v[1:-1])
+        else:
+            out.append(None if nullify_if_not_quoted else v)
+    return Column.from_strings(out)
+
+
+def concat_json(col: Column) -> Tuple[bytes, str, Column]:
+    """Join all rows into one JSON-lines buffer with a chosen delimiter
+    (json_utils.hpp:110 concat_json): returns (buffer, delimiter,
+    is_valid-and-non-empty BOOL8 column).  Null/empty/whitespace rows are
+    replaced by empty entries."""
+    assert col.dtype.is_string
+    candidates = "\n\r\x01\x02\x03"
+    vals = col.to_pylist()
+    joined_src = "".join(v for v in vals if v)
+    delim = next((c for c in candidates if c not in joined_src), None)
+    if delim is None:
+        raise ValueError("no usable delimiter byte found")
+    parts = []
+    valid = np.zeros(col.length, np.uint8)
+    for i, v in enumerate(vals):
+        if v is None or not v.strip():
+            parts.append("")
+        else:
+            parts.append(v)
+            valid[i] = 1
+    buffer = (delim.join(parts) + delim).encode()
+    return buffer, delim, Column(dtypes.BOOL8, col.length,
+                                 data=jnp.asarray(valid))

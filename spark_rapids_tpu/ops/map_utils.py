@@ -1,0 +1,118 @@
+"""Map column utilities (reference map_utils.hpp / map.hpp /
+map_zip_with_utils.hpp, Map.java / MapUtils.java / GpuMapZipWithUtils):
+maps are LIST<STRUCT<key, value>> columns with Spark semantics."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.columns.dtypes import Kind
+from spark_rapids_tpu.ops.copying import gather
+from spark_rapids_tpu.ops.exceptions import ExceptionWithRowIndex
+from spark_rapids_tpu.ops.joins import _column_rank_host
+
+
+def _entries(col: Column) -> Tuple[Column, Column, Column]:
+    assert col.dtype.kind == Kind.LIST
+    st = col.children[0]
+    assert st.dtype.kind == Kind.STRUCT and len(st.children) == 2
+    return st, st.children[0], st.children[1]
+
+
+def is_valid_map(col: Column, throw_on_null_key: bool = False) -> bool:
+    """True when every entry struct is non-null and every key is non-null
+    (map_utils.hpp:58)."""
+    st, keys, _ = _entries(col)
+    if st.validity is not None and not np.asarray(st.validity).all():
+        return False
+    if keys.validity is not None and not np.asarray(keys.validity).all():
+        if throw_on_null_key:
+            bad = int(np.argmin(np.asarray(keys.validity)))
+            raise ExceptionWithRowIndex(bad, "null map key")
+        return False
+    return True
+
+
+def map_from_entries(col: Column, throw_on_null_key: bool = True
+                     ) -> Column:
+    """LIST<STRUCT<K,V>> -> valid Spark map: null keys throw (or drop),
+    duplicate keys keep the LAST occurrence (Spark LAST_WIN policy),
+    entry order of first occurrence preserved (map_utils.hpp:97)."""
+    st, keys, vals = _entries(col)
+    offs = np.asarray(col.offsets)
+    key_ranks, key_mask = _column_rank_host(keys)
+    st_mask = (np.ones(st.length, bool) if st.validity is None
+               else np.asarray(st.validity).astype(bool))
+    row_mask = (np.ones(col.length, bool) if col.validity is None
+                else np.asarray(col.validity).astype(bool))
+    if throw_on_null_key and not key_mask.all():
+        # only entries under valid rows AND valid structs count
+        for row in range(col.length):
+            if not row_mask[row]:
+                continue
+            for e in range(offs[row], offs[row + 1]):
+                if st_mask[e] and not key_mask[e]:
+                    raise ExceptionWithRowIndex(row, "null map key")
+    take = []
+    new_offs = np.zeros(col.length + 1, np.int32)
+    for row in range(col.length):
+        seen = {}
+        order = []
+        if row_mask[row]:
+            for e in range(offs[row], offs[row + 1]):
+                if not st_mask[e] or not key_mask[e]:
+                    continue  # drop null entries/keys (non-throw mode)
+                k = key_ranks[e]
+                if k not in seen:
+                    order.append(k)
+                seen[k] = e           # last occurrence wins
+        take.extend(seen[k] for k in order)
+        new_offs[row + 1] = len(take)
+    idx = jnp.asarray(np.array(take, np.int32))
+    new_st = Column.make_struct(len(take),
+                                [gather(keys, idx), gather(vals, idx)])
+    return Column(dtypes.LIST, col.length, validity=col.validity,
+                  offsets=jnp.asarray(new_offs), children=(new_st,))
+
+
+def sort_map_column(col: Column, descending: bool = False) -> Column:
+    """Sort each map's entries by key (map.hpp:39 sort_map_column)."""
+    st, keys, vals = _entries(col)
+    offs = np.asarray(col.offsets)
+    key_ranks, _ = _column_rank_host(keys)
+    take = []
+    for row in range(col.length):
+        es = list(range(offs[row], offs[row + 1]))
+        es.sort(key=lambda e: key_ranks[e], reverse=descending)
+        take.extend(es)
+    idx = jnp.asarray(np.array(take, np.int32))
+    new_st = Column.make_struct(
+        len(take), [gather(keys, idx), gather(vals, idx)],
+        validity=None if st.validity is None
+        else np.asarray(st.validity)[np.array(take, np.int64)]
+        if len(take) else None)
+    return Column(dtypes.LIST, col.length, validity=col.validity,
+                  offsets=col.offsets, children=(new_st,))
+
+
+def map_zip(keys_list: Column, a_vals: Column, b_vals: Column) -> Column:
+    """Zip aligned LIST columns into LIST<STRUCT<key, a, b>> — the
+    map_zip_with building block (map_zip_with_utils.hpp:60); the three
+    lists must share offsets."""
+    for c in (keys_list, a_vals, b_vals):
+        assert c.dtype.kind == Kind.LIST
+    ko = np.asarray(keys_list.offsets)
+    if not (np.array_equal(ko, np.asarray(a_vals.offsets))
+            and np.array_equal(ko, np.asarray(b_vals.offsets))):
+        raise ValueError("map_zip requires aligned list offsets")
+    st = Column.make_struct(
+        keys_list.children[0].length,
+        [keys_list.children[0], a_vals.children[0], b_vals.children[0]])
+    return Column(dtypes.LIST, keys_list.length,
+                  validity=keys_list.validity, offsets=keys_list.offsets,
+                  children=(st,))

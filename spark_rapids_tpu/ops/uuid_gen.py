@@ -1,0 +1,62 @@
+"""random_uuids column generator (reference uuid.cu/uuid.hpp:2): a
+strings column of version-4 variant-2 UUIDs.
+
+TPU design: bits come from jax.random (threefry) — two u32 words per
+half, formatted via vectorized nibble-to-hex byte assembly on device."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+
+_U8 = jnp.uint8
+_U32 = jnp.uint32
+_U64 = jnp.uint64
+_I32 = jnp.int32
+
+_UUID_LEN = 36
+_DASH_POS = (8, 13, 18, 23)
+
+
+def random_uuids(rows: int, seed: int = 0) -> Column:
+    """STRING column of random UUIDs (xxxxxxxx-xxxx-4xxx-yxxx-xxxxxxxxxxxx,
+    y in 8..b)."""
+    key = jax.random.PRNGKey(seed)
+    bits = jax.random.bits(key, (rows, 4), dtype=jnp.uint32)
+    msb = bits[:, 0].astype(_U64) << _U64(32) | bits[:, 1].astype(_U64)
+    lsb = bits[:, 2].astype(_U64) << _U64(32) | bits[:, 3].astype(_U64)
+    # version 4 + IETF variant
+    msb = (msb & _U64(0xFFFFFFFFFFFF0FFF)) | _U64(0x4000)
+    lsb = (lsb & _U64(0x3FFFFFFFFFFFFFFF)) | _U64(0x8000000000000000)
+
+    # 32 hex nibbles, most significant first
+    nib_idx = jnp.arange(32, dtype=_I32)
+    src = jnp.where(nib_idx < 16, msb[:, None], lsb[:, None])
+    shift = (15 - (nib_idx % 16)).astype(_U64) * _U64(4)
+    nibbles = ((src >> shift[None, :]) & _U64(0xF)).astype(_U8)
+    hex_bytes = jnp.where(nibbles < 10, nibbles + _U8(48),
+                          nibbles + _U8(87))  # '0'..'9', 'a'..'f'
+
+    # interleave dashes: output position -> nibble index
+    out_map = []
+    nib = 0
+    for pos in range(_UUID_LEN):
+        if pos in _DASH_POS:
+            out_map.append(-1)
+        else:
+            out_map.append(nib)
+            nib += 1
+    out_map_arr = jnp.asarray(out_map, _I32)
+    gathered = jnp.where(
+        out_map_arr[None, :] >= 0,
+        jnp.take_along_axis(
+            hex_bytes,
+            jnp.clip(out_map_arr, 0, 31)[None, :].repeat(rows, 0),
+            axis=1),
+        _U8(45))  # '-'
+    data = gathered.reshape(-1)
+    offsets = jnp.arange(rows + 1, dtype=_I32) * _I32(_UUID_LEN)
+    return Column(dtypes.STRING, rows, data=data, offsets=offsets)

@@ -1,0 +1,72 @@
+"""Spark platform/version predicates + device attributes + file IO SPI
+(reference version.hpp / SparkPlatformType.java, DeviceAttr.java,
+fileio/RapidsFileIO.java)."""
+
+from __future__ import annotations
+
+import io
+import os
+from dataclasses import dataclass
+from typing import BinaryIO
+
+import jax
+
+# SparkPlatformType.java:17-37 (enum kept in sync with version.hpp)
+VANILLA_SPARK = 0
+DATABRICKS = 1
+CLOUDERA = 2
+
+
+@dataclass(frozen=True)
+class SparkSystem:
+    """version.hpp spark_system: platform + version predicates passed to
+    kernels whose semantics differ per Spark distro."""
+
+    platform: int
+    major: int
+    minor: int
+    patch: int = 0
+
+    def is_vanilla_320(self) -> bool:
+        return (self.platform == VANILLA_SPARK
+                and (self.major, self.minor) == (3, 2))
+
+    def is_databricks_14_3_or_later(self) -> bool:
+        return (self.platform == DATABRICKS
+                and (self.major, self.minor) >= (14, 3))
+
+    def is_vanilla(self) -> bool:
+        return self.platform == VANILLA_SPARK
+
+
+def is_integrated_gpu() -> bool:
+    """DeviceAttr.isIntegratedGPU analog: TPUs are discrete accelerators;
+    True only for the CPU fallback backend (shares host memory)."""
+    return jax.default_backend() == "cpu"
+
+
+# ----------------------------------------------------- file IO SPI
+# (fileio/RapidsFileIO.java:28 — pluggable storage for e.g. parquet
+# footers; local-file default, other schemes plug in via subclassing)
+
+
+class SeekableInputStream(io.BufferedReader):
+    """SeekableInputStream contract: read/seek/tell over any storage."""
+
+
+class RapidsInputFile:
+    def __init__(self, path: str):
+        self._path = path
+
+    def get_length(self) -> int:
+        return os.path.getsize(self._path)
+
+    def open(self) -> "SeekableInputStream":
+        return SeekableInputStream(open(self._path, "rb", buffering=0))
+
+
+class RapidsFileIO:
+    """Default local-filesystem implementation of the SPI."""
+
+    def open_input_file(self, path: str) -> RapidsInputFile:
+        return RapidsInputFile(path)

@@ -1,0 +1,206 @@
+"""maps / from_json / iceberg / uuid / platform inventory tests."""
+
+import uuid as pyuuid
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.ops import iceberg, json_utils, map_utils, uuid_gen
+from spark_rapids_tpu.ops.exceptions import ExceptionWithRowIndex
+from spark_rapids_tpu.utils import platform
+
+
+def mk_map(offsets, keys, vals, entry_validity=None, key_validity=None):
+    import jax.numpy as jnp
+    k = Column.from_strings(keys) if keys and isinstance(keys[0], str) \
+        else Column.from_pylist(keys, dtypes.INT64)
+    if key_validity is not None:
+        k = Column(k.dtype, k.length, data=k.data, offsets=k.offsets,
+                   validity=jnp.asarray(np.asarray(key_validity,
+                                                   np.uint8)),
+                   children=k.children)
+    v = Column.from_pylist(vals, dtypes.INT64)
+    st = Column.make_struct(len(vals), [k, v], validity=entry_validity)
+    return Column(dtypes.LIST, len(offsets) - 1,
+                  offsets=jnp.asarray(np.asarray(offsets, np.int32)),
+                  children=(st,))
+
+
+def test_map_from_entries_dedup_and_nulls():
+    m = mk_map([0, 3, 5], ["a", "b", "a", "x", "y"], [1, 2, 3, 4, 5])
+    out = map_utils.map_from_entries(m, throw_on_null_key=False)
+    assert out.to_pylist() == [[("a", 3), ("b", 2)], [("x", 4), ("y", 5)]]
+    # null key throws with row index
+    m2 = mk_map([0, 2], ["a", "b"], [1, 2],
+                key_validity=np.array([1, 0]))
+    with pytest.raises(ExceptionWithRowIndex) as ei:
+        map_utils.map_from_entries(m2)
+    assert ei.value.row_index == 0
+    assert not map_utils.is_valid_map(m2)
+    assert map_utils.is_valid_map(m)
+
+
+def test_sort_map_column():
+    m = mk_map([0, 3], ["c", "a", "b"], [1, 2, 3])
+    out = map_utils.sort_map_column(m)
+    assert out.to_pylist() == [[("a", 2), ("b", 3), ("c", 1)]]
+    out_d = map_utils.sort_map_column(m, descending=True)
+    assert out_d.to_pylist() == [[("c", 1), ("b", 3), ("a", 2)]]
+
+
+def test_map_zip():
+    import jax.numpy as jnp
+    keys = Column.make_list(np.array([0, 2]),
+                            Column.from_strings(["k1", "k2"]))
+    a = Column.make_list(np.array([0, 2]),
+                         Column.from_pylist([1, 2], dtypes.INT64))
+    b = Column.make_list(np.array([0, 2]),
+                         Column.from_pylist([10, 20], dtypes.INT64))
+    out = map_utils.map_zip(keys, a, b)
+    assert out.to_pylist() == [[("k1", 1, 10), ("k2", 2, 20)]]
+    bad = Column.make_list(np.array([0, 1]),
+                           Column.from_pylist([1], dtypes.INT64))
+    with pytest.raises(ValueError):
+        map_utils.map_zip(keys, a, bad)
+
+
+def test_from_json_to_raw_map():
+    c = Column.from_strings([
+        '{"a": 1, "b": "x", "c": [1,2], "a": 9}',
+        'not json', '[1,2]', None, "{}"])
+    out = json_utils.from_json_to_raw_map(c)
+    got = out.to_pylist()
+    assert got[0] == [("a", "9"), ("b", "x"), ("c", "[1,2]")]
+    assert got[1] is None and got[2] is None and got[3] is None
+    assert got[4] == []
+
+
+def test_from_json_to_structs():
+    c = Column.from_strings([
+        '{"id": 7, "name": "n1", "score": 1.5, "ok": true}',
+        '{"id": "8", "name": null}',
+        'garbage'])
+    out = json_utils.from_json_to_structs(
+        c, [("id", dtypes.INT64), ("name", dtypes.STRING),
+            ("score", dtypes.FLOAT64), ("ok", dtypes.BOOL8)])
+    rows = out.to_pylist()
+    assert rows[0] == (7, "n1", 1.5, True)
+    assert rows[1] == (8, None, None, None)  # "8" casts; missing -> null
+    assert rows[2] is None
+
+
+def test_remove_quotes_and_concat_json():
+    c = Column.from_strings(['"hi"', "plain", None])
+    assert json_utils.remove_quotes(c).to_pylist() == ["hi", "plain",
+                                                       None]
+    assert json_utils.remove_quotes(
+        c, nullify_if_not_quoted=True).to_pylist() == ["hi", None, None]
+    docs = Column.from_strings(['{"a":1}', None, "  ", '{"b":2}'])
+    buf, delim, valid = json_utils.concat_json(docs)
+    assert valid.to_pylist() == [True, False, False, True]
+    assert buf.decode().count(delim) == 4
+
+
+def test_iceberg_bucket_known_values():
+    """Iceberg spec test vectors: bucket hash of int 34 = 2017239379,
+    string 'iceberg' = 1210000089 (Iceberg BucketUtil javadoc)."""
+    c = Column.from_pylist([34], dtypes.INT32)
+    import jax.numpy as jnp
+    from spark_rapids_tpu.ops.iceberg import _std_murmur_u64
+    h = int(np.asarray(_std_murmur_u64(c.data.astype(jnp.int64)))[0]
+            .astype(np.int32))
+    assert h == 2017239379
+    s = Column.from_strings(["iceberg"])
+    chars, lens = s.to_padded_chars()
+    from spark_rapids_tpu.ops.iceberg import _std_murmur_varbytes
+    hs = int(np.asarray(_std_murmur_varbytes(chars, lens))[0]
+             .astype(np.int32))
+    assert hs == 1210000089
+    # bucket applies (h & MAX) % N
+    out = iceberg.bucket(c, 16)
+    assert out.to_pylist() == [(2017239379 & 0x7FFFFFFF) % 16]
+
+
+def test_iceberg_truncate():
+    c = Column.from_pylist([10, 15, -5, None], dtypes.INT32)
+    assert iceberg.truncate(c, 10).to_pylist() == [10, 10, -10, None]
+    s = Column.from_strings(["日本語テキスト", "ab", None])
+    assert iceberg.truncate(s, 3).to_pylist() == ["日本語", "ab", None]
+
+
+def test_iceberg_datetime_transforms():
+    import datetime
+    d = (datetime.date(2017, 11, 16) - datetime.date(1970, 1, 1)).days
+    c = Column.from_pylist([d], dtypes.TIMESTAMP_DAYS)
+    assert iceberg.year(c).to_pylist() == [47]
+    assert iceberg.month(c).to_pylist() == [47 * 12 + 10]
+    assert iceberg.day(c).to_pylist() == [d]
+    us = d * 86_400_000_000 + 3 * 3_600_000_000
+    t = Column.from_pylist([us], dtypes.TIMESTAMP_MICROS)
+    assert iceberg.hour(t).to_pylist() == [d * 24 + 3]
+
+
+def test_random_uuids():
+    out = uuid_gen.random_uuids(50, seed=7).to_pylist()
+    assert len(set(out)) == 50
+    for u in out:
+        parsed = pyuuid.UUID(u)       # well-formed
+        assert parsed.version == 4
+        assert u[14] == "4" and u[19] in "89ab"
+    # deterministic per seed
+    assert uuid_gen.random_uuids(5, seed=7).to_pylist() == out[:5]
+
+
+def test_platform_predicates_and_fileio(tmp_path):
+    s = platform.SparkSystem(platform.VANILLA_SPARK, 3, 2)
+    assert s.is_vanilla_320() and s.is_vanilla()
+    db = platform.SparkSystem(platform.DATABRICKS, 14, 3)
+    assert db.is_databricks_14_3_or_later()
+    assert isinstance(platform.is_integrated_gpu(), bool)
+    f = tmp_path / "x.bin"
+    f.write_bytes(b"hello parquet footer")
+    fio = platform.RapidsFileIO()
+    inf = fio.open_input_file(str(f))
+    assert inf.get_length() == 20
+    with inf.open() as fh:
+        fh.seek(6)
+        assert fh.read(7) == b"parquet"
+
+
+def test_review_regressions_inventory():
+    import jax.numpy as jnp
+    from spark_rapids_tpu.io import parquet_footer as pf
+    from spark_rapids_tpu.ops import protobuf as pb
+    # bool lists round-trip through the thrift codec
+    tree = ("struct", {1: (9, ("list", 1, [True, False, True])),
+                       2: (5, 42)})
+    again = pf.parse_footer(pf.serialize_footer(tree))
+    assert pf._sval(again, 1)[2] == [True, False, True]
+    assert pf._sval(again, 2) == 42
+    # null top-level map row with a null key under it must not throw
+    m = mk_map([0, 1], ["a"], [1], key_validity=np.array([0]))
+    m = Column(m.dtype, m.length, offsets=m.offsets, children=m.children,
+               validity=jnp.asarray(np.array([0], np.uint8)))
+    out = map_utils.map_from_entries(m)
+    assert out.to_pylist() == [None]
+    # nested required violation nulls the whole row
+    fields = [pb.Field(1, dtypes.STRUCT, children=(
+        pb.Field(1, dtypes.INT64, required=True),))]
+    col = Column.from_strings([bytes([0x0A, 0x00])])  # empty submessage
+    assert pb.decode_protobuf_to_struct(col, fields).to_pylist() == [None]
+    # truncated unknown fixed64 is malformed, not silently skipped
+    col2 = Column.from_strings([bytes([0x49, 0x01, 0x02])])
+    assert pb.decode_protobuf_to_struct(
+        col2, [pb.Field(1, dtypes.INT64)]).to_pylist() == [None]
+    # SPI stream type contract
+    from spark_rapids_tpu.utils import platform as plat
+    import tempfile, os
+    with tempfile.NamedTemporaryFile(delete=False) as f:
+        f.write(b"x")
+        name = f.name
+    stream = plat.RapidsFileIO().open_input_file(name).open()
+    assert isinstance(stream, plat.SeekableInputStream)
+    stream.close()
+    os.unlink(name)
