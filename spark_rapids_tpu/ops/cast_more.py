@@ -1,0 +1,320 @@
+"""Remaining cast/format kernels (reference cast_string.hpp:36-72,
+cast_decimal_to_string.cu, cast_long_to_binary_string.cu, hex.cu,
+format_float.cu, cast_string_to_datetime.cu /
+parse_timestamp_with_format): bin(), hex(), decimal->string,
+format_number(), and Spark string->date/timestamp parsing."""
+
+from __future__ import annotations
+
+import datetime
+import re
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.columns.dtypes import DType, Kind
+from spark_rapids_tpu.ops.exceptions import CastException
+
+_I64 = jnp.int64
+_U64 = jnp.uint64
+_U8 = jnp.uint8
+_I32 = jnp.int32
+
+
+def long_to_binary_string(col: Column) -> Column:
+    """Spark bin(): unsigned 64-bit binary, no leading zeros
+    (cast_string.hpp long_to_binary_string).  Fully on device: 64
+    bit-lanes -> '0'/'1' bytes, compacted by leading-zero count."""
+    assert col.dtype.kind == Kind.INT64
+    u = col.data.astype(_U64)
+    shifts = jnp.arange(63, -1, -1, dtype=_U64)
+    bits = ((u[:, None] >> shifts[None, :]) & _U64(1)).astype(_U8)
+    digits = bits + _U8(48)
+    nbits = 64 - jnp.sum(jnp.cumsum(bits, axis=1) == 0, axis=1)
+    nbits = jnp.maximum(nbits, 1).astype(_I32)  # 0 -> "0"
+    lens_host = np.asarray(nbits)
+    mask = np.asarray(col.valid_mask())
+    lens_host = np.where(mask, lens_host, 0)
+    offsets = np.zeros(col.length + 1, np.int32)
+    np.cumsum(lens_host, out=offsets[1:])
+    total = int(offsets[-1])
+    offs_j = jnp.asarray(offsets)
+    i = jnp.arange(total, dtype=_I32)
+    r = jnp.searchsorted(offs_j, i, side="right").astype(_I32) - 1
+    pos = i - offs_j[r]
+    src_col = 64 - nbits[r] + pos
+    data = digits[r, src_col] if total else jnp.zeros(0, jnp.uint8)
+    return Column(dtypes.STRING, col.length, data=data,
+                  validity=col.validity, offsets=offs_j)
+
+
+def bytes_to_hex(col: Column) -> Column:
+    """hex() of a binary (LIST<UINT8>) or string column: two uppercase
+    hex digits per byte (cast_string.hpp bytes_to_hex)."""
+    if col.dtype.kind == Kind.LIST:
+        chars = np.asarray(col.children[0].to_numpy())
+        offs = np.asarray(col.offsets)
+    elif col.dtype.is_string:
+        chars = (np.asarray(col.data) if col.data is not None
+                 else np.zeros(0, np.uint8))
+        offs = np.asarray(col.offsets)
+    else:
+        raise ValueError("binary or string column required")
+    mask = np.asarray(col.valid_mask())
+    out = []
+    blob = chars.tobytes()
+    for i in range(col.length):
+        out.append(blob[offs[i]:offs[i + 1]].hex().upper()
+                   if mask[i] else None)
+    return Column.from_strings(out)
+
+
+def long_to_hex_string(col: Column) -> Column:
+    """hex() of an INT64 column (unsigned, no leading zeros)."""
+    assert col.dtype.kind == Kind.INT64
+    host = col.to_numpy().astype(np.uint64)
+    mask = np.asarray(col.valid_mask())
+    return Column.from_strings(
+        [format(int(host[i]), "X") if mask[i] else None
+         for i in range(col.length)])
+
+
+def decimal_to_non_ansi_string(col: Column) -> Column:
+    """decimal -> string, non-ANSI Spark formatting
+    (cast_decimal_to_string.cu): scale digits after the point, leading
+    0 for |v| < 1, no trailing-zero trimming."""
+    if not col.dtype.is_decimal:
+        raise ValueError("decimal column required")
+    scale = -col.dtype.scale  # digits after the point
+    unscaled = col.to_pylist()
+    out: List[Optional[str]] = []
+    for v in unscaled:
+        if v is None:
+            out.append(None)
+            continue
+        v = int(v)
+        neg = v < 0
+        digits = str(abs(v))
+        if scale <= 0:
+            body = digits + "0" * (-scale)
+        else:
+            digits = digits.rjust(scale + 1, "0")
+            body = f"{digits[:-scale]}.{digits[-scale:]}"
+        out.append(("-" if neg else "") + body)
+    return Column.from_strings(out)
+
+
+def format_number(col: Column, digits: int) -> Column:
+    """Spark format_number(x, d): thousands separators + d decimal places
+    HALF_EVEN (format_float.cu / cast_string.hpp format_float)."""
+    from spark_rapids_tpu.utils import floats as fl
+    kind = col.dtype.kind
+    mask = np.asarray(col.valid_mask())
+    host = col.to_numpy()
+    out: List[Optional[str]] = []
+    for i in range(col.length):
+        if not mask[i]:
+            out.append(None)
+            continue
+        v = float(host[i]) if kind in (Kind.FLOAT32, Kind.FLOAT64) else \
+            int(host[i])
+        if isinstance(v, float) and (np.isnan(v) or np.isinf(v)):
+            out.append("NaN" if np.isnan(v) else
+                       ("∞" if v > 0 else "-∞"))
+            continue
+        out.append(f"{v:,.{max(digits, 0)}f}")
+    return Column.from_strings(out)
+
+
+# ------------------------------------------------ string -> date/timestamp
+
+_DATE_RE = re.compile(
+    r"^\s*([+-]?\d{1,7})(?:-(\d{1,2})(?:-(\d{1,2})(.*))?)?\s*$")
+_TIME_RE = re.compile(
+    r"^[T ](\d{1,2}):(\d{1,2})(?::(\d{1,2})(?:\.(\d{1,9}))?)?"
+    r"(Z|[+-]\d{1,2}(?::\d{1,2})?)?\s*$")
+
+
+from spark_rapids_tpu.ops.datetime_ops import civil_days_scalar as \
+    _days_from_civil
+
+
+def _valid_ymd(y, m, d) -> bool:
+    if not (1 <= m <= 12 and 1 <= d <= 31):
+        return False
+    if 1 <= y <= 9999:
+        try:
+            datetime.date(y, m, d)
+            return True
+        except ValueError:
+            return False
+    # proleptic years outside datetime.date's range: manual day-in-month
+    dim = [31, 29 if (y % 4 == 0 and (y % 100 != 0 or y % 400 == 0))
+           else 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31][m - 1]
+    return d <= dim
+
+
+def parse_strings_to_date(col: Column, ansi_mode: bool = False) -> Column:
+    """Spark CAST(string AS DATE) (cast_string.hpp parse_strings_to_date):
+    accepts yyyy, yyyy-[M]M, yyyy-[M]M-[d]d (trailing time part ignored
+    when it starts with T or space)."""
+    assert col.dtype.is_string
+    vals = col.to_pylist()
+    out = np.zeros(col.length, np.int32)
+    valid = np.zeros(col.length, bool)
+    for i, s in enumerate(vals):
+        if s is None:
+            continue
+        m = _DATE_RE.match(s)
+        if not m:
+            continue
+        y = int(m.group(1))
+        mo = int(m.group(2)) if m.group(2) else 1
+        d = int(m.group(3)) if m.group(3) else 1
+        rest = m.group(4) or ""
+        if rest and not (rest.startswith("T") or rest.startswith(" ")):
+            continue
+        if not _valid_ymd(y, mo, d):
+            continue
+        out[i] = _days_from_civil(y, mo, d)
+        valid[i] = True
+    base_valid = np.asarray(col.valid_mask())
+    if ansi_mode:
+        bad = base_valid & ~valid
+        if bad.any():
+            row = int(np.argmax(bad))
+            raise CastException(row, vals[row])
+        validity = col.validity
+    else:
+        validity = jnp.asarray((valid & base_valid).astype(np.uint8))
+    return Column(dtypes.TIMESTAMP_DAYS, col.length,
+                  data=jnp.asarray(out), validity=validity)
+
+
+def parse_timestamp_strings(col: Column, default_tz_offset_sec: int = 0,
+                            ansi_mode: bool = False) -> Column:
+    """Spark CAST(string AS TIMESTAMP) (cast_string.hpp
+    parse_timestamp_strings): date part + optional time-of-day with
+    fractional seconds and optional Z/±hh[:mm] zone; zoneless values use
+    default_tz_offset_sec."""
+    assert col.dtype.is_string
+    vals = col.to_pylist()
+    out = np.zeros(col.length, np.int64)
+    valid = np.zeros(col.length, bool)
+    for i, s in enumerate(vals):
+        if s is None:
+            continue
+        m = _DATE_RE.match(s)
+        if not m:
+            continue
+        y = int(m.group(1))
+        mo = int(m.group(2)) if m.group(2) else 1
+        d = int(m.group(3)) if m.group(3) else 1
+        if not _valid_ymd(y, mo, d):
+            continue
+        rest = m.group(4) or ""
+        hh = mm = ss = frac_us = 0
+        off = default_tz_offset_sec
+        if rest:
+            t = _TIME_RE.match(rest)
+            if not t:
+                continue
+            hh = int(t.group(1))
+            mm = int(t.group(2))
+            ss = int(t.group(3)) if t.group(3) else 0
+            if t.group(4):
+                frac_us = int(t.group(4)[:6].ljust(6, "0"))
+            if t.group(5):
+                z = t.group(5)
+                if z == "Z":
+                    off = 0
+                else:
+                    sign = -1 if z[0] == "-" else 1
+                    parts = z[1:].split(":")
+                    off = sign * (int(parts[0]) * 3600
+                                  + (int(parts[1]) * 60
+                                     if len(parts) > 1 else 0))
+            if not (hh < 24 and mm < 60 and ss < 60):
+                continue
+        days = _days_from_civil(y, mo, d)
+        micros = ((days * 86400 + hh * 3600 + mm * 60 + ss - off)
+                  * 1_000_000 + frac_us)
+        out[i] = micros
+        valid[i] = True
+    base_valid = np.asarray(col.valid_mask())
+    if ansi_mode:
+        bad = base_valid & ~valid
+        if bad.any():
+            row = int(np.argmax(bad))
+            raise CastException(row, vals[row])
+        validity = col.validity
+    else:
+        validity = jnp.asarray((valid & base_valid).astype(np.uint8))
+    return Column(dtypes.TIMESTAMP_MICROS, col.length,
+                  data=jnp.asarray(out), validity=validity)
+
+
+_FORMAT_TOKENS = [
+    ("yyyy", r"(?P<y>\d{4})"), ("MM", r"(?P<M>\d{2})"),
+    ("dd", r"(?P<d>\d{2})"), ("HH", r"(?P<H>\d{2})"),
+    ("mm", r"(?P<m>\d{2})"), ("ss", r"(?P<s>\d{2})"),
+    ("SSSSSS", r"(?P<f6>\d{6})"), ("SSS", r"(?P<f3>\d{3})"),
+]
+
+
+def parse_timestamp_strings_with_format(col: Column, fmt: str,
+                                        ansi_mode: bool = False) -> Column:
+    """to_timestamp(str, fmt) with the common Java SimpleDateFormat tokens
+    (cast_string.hpp parse_timestamp_strings_with_format)."""
+    assert col.dtype.is_string
+    pattern = ""
+    i = 0
+    while i < len(fmt):
+        for tok, rx in _FORMAT_TOKENS:
+            if fmt.startswith(tok, i):
+                pattern += rx
+                i += len(tok)
+                break
+        else:
+            pattern += re.escape(fmt[i])
+            i += 1
+    rx = re.compile("^" + pattern + "$")
+    vals = col.to_pylist()
+    out = np.zeros(col.length, np.int64)
+    valid = np.zeros(col.length, bool)
+    for i, s in enumerate(vals):
+        if s is None:
+            continue
+        m = rx.match(s.strip())
+        if not m:
+            continue
+        g = m.groupdict()
+        y = int(g.get("y") or 1970)
+        mo = int(g.get("M") or 1)
+        d = int(g.get("d") or 1)
+        if not _valid_ymd(y, mo, d):
+            continue
+        hh = int(g.get("H") or 0)
+        mm = int(g.get("m") or 0)
+        ss = int(g.get("s") or 0)
+        if not (hh < 24 and mm < 60 and ss < 60):
+            continue
+        frac = int(g.get("f6") or 0) + int(g.get("f3") or 0) * 1000
+        days = _days_from_civil(y, mo, d)
+        out[i] = (days * 86400 + hh * 3600 + mm * 60 + ss) * 1_000_000 \
+            + frac
+        valid[i] = True
+    base_valid = np.asarray(col.valid_mask())
+    if ansi_mode:
+        bad = base_valid & ~valid
+        if bad.any():
+            row = int(np.argmax(bad))
+            raise CastException(row, vals[row])
+        validity = col.validity
+    else:
+        validity = jnp.asarray((valid & base_valid).astype(np.uint8))
+    return Column(dtypes.TIMESTAMP_MICROS, col.length,
+                  data=jnp.asarray(out), validity=validity)

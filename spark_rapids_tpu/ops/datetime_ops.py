@@ -29,6 +29,18 @@ SECS_PER_DAY = 86400
 
 # ----------------------------------------------------- civil date helpers
 
+def civil_days_scalar(y: int, m: int, d: int) -> int:
+    """Scalar Hinnant days-from-civil (shared by host-loop parsers)."""
+    y -= m <= 2
+    era = (y if y >= 0 else y - 399) // 400
+    yoe = y - era * 400
+    mp = (m - 3) % 12
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+
 def _days_to_ymd(z):
     """Vectorized proleptic-Gregorian days-since-epoch -> (y, m, d)."""
     z = z + 719468
@@ -260,4 +272,27 @@ def truncate(col: Column, component: Union[str, Column]) -> Column:
     day_us = MICROS_PER_SEC * SECS_PER_DAY
     return Column(col.dtype, col.length,
                   data=out_days * day_us + out_tod,
+                  validity=col.validity)
+
+
+def convert_orc_timezones(col: Column, writer_zone: str,
+                          reader_zone: str) -> Column:
+    """ORC timestamp rectification (timezones.hpp:24-31
+    convert_orc_timezones, OrcTimezoneInfo.java): ORC stores wall-clock
+    values in the writer's zone; shift each instant by the difference of
+    the writer/reader offsets in effect at that instant so the reader's
+    interpretation matches the writer's wall clock."""
+    assert col.dtype.kind == Kind.TIMESTAMP_MICROS
+    micros = col.data.astype(_I64)
+    secs = _floor_div(micros, MICROS_PER_SEC)
+    w_off = _offsets_at(secs, writer_zone, wall_time=False)
+    r_off = _offsets_at(secs, reader_zone, wall_time=False)
+    adjusted = micros + (w_off - r_off) * MICROS_PER_SEC
+    # second reader lookup AT the adjusted instant: shifts landing across
+    # a reader DST transition must use the post-shift offset
+    # (timezones.cu convert_timestamp_between_timezones :340-348)
+    r_off2 = _offsets_at(_floor_div(adjusted, MICROS_PER_SEC),
+                         reader_zone, wall_time=False)
+    return Column(col.dtype, col.length,
+                  data=micros + (w_off - r_off2) * MICROS_PER_SEC,
                   validity=col.validity)

@@ -1,0 +1,2 @@
+from spark_rapids_tpu.shim.handles import HandleRegistry  # noqa: F401
+from spark_rapids_tpu.shim import jni_api  # noqa: F401

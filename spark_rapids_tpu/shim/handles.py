@@ -1,0 +1,51 @@
+"""Java handle model (SURVEY.md §7.1): the cudf-java surface works on
+`long` native pointers; here a process-global registry maps opaque int64
+handles to device Column/Table objects so the JNI layer (or any FFI) can
+round-trip them without marshalling data.
+
+Mirrors the reference ownership rules: every handle returned to the
+caller must be released exactly once (ColumnVector.close); leaks are
+observable via live_count for tests/sanitizers."""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Dict, Optional
+
+
+class HandleRegistry:
+    def __init__(self):
+        self._objects: Dict[int, Any] = {}
+        self._next = itertools.count(1)
+        self._lock = threading.Lock()
+
+    def register(self, obj: Any) -> int:
+        with self._lock:
+            h = next(self._next)
+            self._objects[h] = obj
+            return h
+
+    def get(self, handle: int) -> Any:
+        with self._lock:
+            try:
+                return self._objects[handle]
+            except KeyError:
+                raise ValueError(f"invalid or released handle {handle}")
+
+    def release(self, handle: int) -> None:
+        with self._lock:
+            if self._objects.pop(handle, None) is None:
+                raise ValueError(
+                    f"double release or invalid handle {handle}")
+
+    def live_count(self) -> int:
+        with self._lock:
+            return len(self._objects)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._objects.clear()
+
+
+REGISTRY = HandleRegistry()

@@ -1,0 +1,118 @@
+"""Flat handle-based API mirroring the reference JNI export surface
+(src/main/cpp/src/*Jni.cpp pattern: unwrap jlong handles ->
+column_views -> call the op -> release_as_jlong).  This is the layer a
+real JNI/C-FFI binding calls; every function takes/returns int64 handles
+and plain scalars, mirroring the Java native method signatures
+(Hash.java:44 murmurHash32, RowConversion.java:35 convertToRows, ...).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.columns.table import Table
+from spark_rapids_tpu.shim.handles import REGISTRY
+from spark_rapids_tpu.utils.fault_injection import maybe_inject
+from spark_rapids_tpu.utils.profiler import op_range
+
+
+def _cols(handles: Sequence[int]) -> List[Column]:
+    return [REGISTRY.get(h) for h in handles]
+
+
+def make_column_from_host(values, dtype) -> int:
+    col = (Column.from_strings(values) if dtype.is_string
+           else Column.from_pylist(values, dtype))
+    return REGISTRY.register(col)
+
+
+def release_column(handle: int) -> None:
+    REGISTRY.release(handle)
+
+
+def column_to_host(handle: int):
+    return REGISTRY.get(handle).to_pylist()
+
+
+# --------------------------------------------------------------- ops
+# (each export follows the reference JNI shape: inject-check, NVTX-like
+# range, unwrap handles, run, wrap result)
+
+
+def murmur_hash3_32(seed: int, column_handles: Sequence[int]) -> int:
+    maybe_inject("murmur3_32")
+    with op_range("murmur3_32"):
+        from spark_rapids_tpu.ops import murmur3_32
+        return REGISTRY.register(murmur3_32(_cols(column_handles), seed))
+
+
+def xx_hash_64(seed: int, column_handles: Sequence[int]) -> int:
+    maybe_inject("xxhash64")
+    with op_range("xxhash64"):
+        from spark_rapids_tpu.ops import xxhash64
+        return REGISTRY.register(xxhash64(_cols(column_handles), seed))
+
+
+def hive_hash(column_handles: Sequence[int]) -> int:
+    maybe_inject("hive_hash")
+    with op_range("hive_hash"):
+        from spark_rapids_tpu.ops import hive_hash as _hh
+        return REGISTRY.register(_hh(_cols(column_handles)))
+
+
+def convert_to_rows(table_handles: Sequence[int]) -> int:
+    maybe_inject("convert_to_rows")
+    with op_range("convert_to_rows"):
+        from spark_rapids_tpu.ops.row_conversion import convert_to_rows
+        return REGISTRY.register(
+            convert_to_rows(Table(_cols(table_handles))))
+
+
+def convert_from_rows(rows_handle: int, type_ids: Sequence[str],
+                      scales: Sequence[int]) -> List[int]:
+    maybe_inject("convert_from_rows")
+    with op_range("convert_from_rows"):
+        from spark_rapids_tpu.columns.dtypes import DType
+        from spark_rapids_tpu.ops.row_conversion import convert_from_rows
+        schema = [DType(k, s) for k, s in zip(type_ids, scales)]
+        out = convert_from_rows(REGISTRY.get(rows_handle), schema)
+        return [REGISTRY.register(c) for c in out.columns]
+
+
+def string_to_integer(column_handle: int, type_id: str,
+                      ansi_mode: bool, strip: bool) -> int:
+    maybe_inject("string_to_integer")
+    with op_range("string_to_integer"):
+        from spark_rapids_tpu.columns.dtypes import DType
+        from spark_rapids_tpu.ops.cast_string import string_to_integer
+        return REGISTRY.register(string_to_integer(
+            REGISTRY.get(column_handle), DType(type_id), ansi_mode,
+            strip))
+
+
+def get_json_object(column_handle: int, path: str) -> int:
+    maybe_inject("get_json_object")
+    with op_range("get_json_object"):
+        from spark_rapids_tpu.ops.json_path import get_json_object
+        return REGISTRY.register(
+            get_json_object(REGISTRY.get(column_handle), path))
+
+
+def sort_merge_inner_join(left_handles: Sequence[int],
+                          right_handles: Sequence[int],
+                          compare_nulls_equal: bool) -> List[int]:
+    maybe_inject("sort_merge_inner_join")
+    with op_range("sort_merge_inner_join"):
+        import jax.numpy as jnp
+        from spark_rapids_tpu.columns import dtypes
+        from spark_rapids_tpu.ops import joins
+        li, ri = joins.sort_merge_inner_join(
+            Table(_cols(left_handles)), Table(_cols(right_handles)),
+            joins.NULL_EQUAL if compare_nulls_equal
+            else joins.NULL_UNEQUAL)
+        lc = Column(dtypes.INT32, int(li.shape[0]), data=li)
+        rc = Column(dtypes.INT32, int(ri.shape[0]), data=ri)
+        return [REGISTRY.register(lc), REGISTRY.register(rc)]

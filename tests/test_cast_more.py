@@ -1,0 +1,151 @@
+"""bin/hex/decimal-string/format_number/date-timestamp parse tests."""
+
+import datetime
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.ops import cast_more as CM
+from spark_rapids_tpu.ops.exceptions import CastException
+
+
+def test_long_to_binary_string():
+    c = Column.from_pylist([13, 0, 1, None, -1], dtypes.INT64)
+    out = CM.long_to_binary_string(c).to_pylist()
+    assert out == ["1101", "0", "1", None, "1" * 64]
+
+
+def test_hex():
+    c = Column.from_pylist([255, 0, 4096, None], dtypes.INT64)
+    assert CM.long_to_hex_string(c).to_pylist() == ["FF", "0", "1000",
+                                                    None]
+    s = Column.from_strings(["abc", None, ""])
+    assert CM.bytes_to_hex(s).to_pylist() == ["616263", None, ""]
+
+
+def test_decimal_to_string():
+    c = Column.from_pylist([12345, -12345, 5, 0, None],
+                           dtypes.decimal128(-2))
+    out = CM.decimal_to_non_ansi_string(c).to_pylist()
+    assert out == ["123.45", "-123.45", "0.05", "0.00", None]
+    c2 = Column.from_pylist([42], dtypes.decimal128(2))  # scale +2
+    assert CM.decimal_to_non_ansi_string(c2).to_pylist() == ["4200"]
+
+
+def test_format_number():
+    c = Column.from_pylist([1234567.891, -0.5, None], dtypes.FLOAT64)
+    out = CM.format_number(c, 2).to_pylist()
+    assert out == ["1,234,567.89", "-0.50", None]
+    i = Column.from_pylist([1234567], dtypes.INT64)
+    assert CM.format_number(i, 0).to_pylist() == ["1,234,567"]
+
+
+def d2e(y, m, d):
+    return (datetime.date(y, m, d) - datetime.date(1970, 1, 1)).days
+
+
+def test_parse_strings_to_date():
+    c = Column.from_strings(["2023-07-26", "2023-7-6", "2023", "2023-02",
+                             "2023-02-30", "bogus", None,
+                             "2023-07-26 anything", "2023-07-26Tx"])
+    out = CM.parse_strings_to_date(c).to_pylist()
+    assert out[0] == d2e(2023, 7, 26)
+    assert out[1] == d2e(2023, 7, 6)
+    assert out[2] == d2e(2023, 1, 1)
+    assert out[3] == d2e(2023, 2, 1)
+    assert out[4] is None       # Feb 30 invalid
+    assert out[5] is None and out[6] is None
+    assert out[7] == d2e(2023, 7, 26)  # trailing time-ish ignored
+    assert out[8] == d2e(2023, 7, 26)
+    with pytest.raises(CastException) as ei:
+        CM.parse_strings_to_date(Column.from_strings(["x"]),
+                                 ansi_mode=True)
+    assert ei.value.row_index == 0
+
+
+def test_parse_timestamp_strings():
+    c = Column.from_strings([
+        "2023-07-26 14:30:05",
+        "2023-07-26T14:30:05.123456",
+        "2023-07-26T14:30:05Z",
+        "2023-07-26T14:30:05+02:00",
+        "2023-07-26",
+        "2023-07-26 25:00:00",
+    ])
+    out = CM.parse_timestamp_strings(c).to_pylist()
+    base = int(datetime.datetime(2023, 7, 26, 14, 30, 5,
+                                 tzinfo=datetime.timezone.utc)
+               .timestamp() * 1e6)
+    assert out[0] == base
+    assert out[1] == base + 123456
+    assert out[2] == base
+    assert out[3] == base - 7200 * 1_000_000
+    assert out[4] == int(datetime.datetime(
+        2023, 7, 26, tzinfo=datetime.timezone.utc).timestamp() * 1e6)
+    assert out[5] is None
+    # zoneless with a default tz offset
+    out2 = CM.parse_timestamp_strings(
+        Column.from_strings(["2023-07-26 00:00:00"]),
+        default_tz_offset_sec=3600).to_pylist()
+    assert out2[0] == int(datetime.datetime(
+        2023, 7, 25, 23, tzinfo=datetime.timezone.utc).timestamp() * 1e6)
+
+
+def test_parse_timestamp_with_format():
+    c = Column.from_strings(["26/07/2023 14:30", "bad", None])
+    out = CM.parse_timestamp_strings_with_format(
+        c, "dd/MM/yyyy HH:mm").to_pylist()
+    assert out[0] == int(datetime.datetime(
+        2023, 7, 26, 14, 30, tzinfo=datetime.timezone.utc)
+        .timestamp() * 1e6)
+    assert out[1] is None and out[2] is None
+    out2 = CM.parse_timestamp_strings_with_format(
+        Column.from_strings(["2023-07-26 14:30:05.123"]),
+        "yyyy-MM-dd HH:mm:ss.SSS").to_pylist()
+    assert out2[0] % 1_000_000 == 123000
+
+
+def test_orc_timezone_rectification():
+    from spark_rapids_tpu.ops import datetime_ops as dt
+    # 2023-01-15 12:00 instant: LA offset -8h, Shanghai +8h
+    us = int(datetime.datetime(2023, 1, 15, 12,
+                               tzinfo=datetime.timezone.utc)
+             .timestamp() * 1e6)
+    c = Column.from_pylist([us], dtypes.TIMESTAMP_MICROS)
+    out = dt.convert_orc_timezones(c, "America/Los_Angeles",
+                                   "Asia/Shanghai").to_pylist()
+    assert out[0] == us + (-8 - 8) * 3600 * 1_000_000
+    same = dt.convert_orc_timezones(c, "UTC", "UTC").to_pylist()
+    assert same[0] == us
+
+
+def test_bitmask_or_and_traits():
+    import jax.numpy as jnp
+    from spark_rapids_tpu.ops import utilities as U
+    a = jnp.array([0b1010], jnp.uint8)
+    b = jnp.array([0b0101], jnp.uint8)
+    assert int(U.bitmask_bitwise_or([a, b])[0]) == 0b1111
+    with pytest.raises(ValueError):
+        U.bitmask_bitwise_or([a, jnp.zeros(2, jnp.uint8)])
+    assert U.is_spark_numeric(dtypes.INT64)
+    assert U.is_spark_numeric(dtypes.decimal128(-2))
+    assert not U.is_spark_numeric(dtypes.STRING)
+
+
+def test_review_regressions_cast_more():
+    from spark_rapids_tpu.ops import datetime_ops as dt
+    # ORC shift across the reader's DST transition uses the post-shift
+    # offset: UTC writer, LA reader, instant just before spring-forward
+    us = int(datetime.datetime(2023, 3, 12, 9, 30,
+                               tzinfo=datetime.timezone.utc)
+             .timestamp() * 1e6)  # 01:30 PST
+    c = Column.from_pylist([us], dtypes.TIMESTAMP_MICROS)
+    out = dt.convert_orc_timezones(c, "UTC",
+                                   "America/Los_Angeles").to_pylist()
+    assert out[0] == us + 7 * 3600 * 1_000_000  # post-shift PDT, not PST
+    # leap day in proleptic year 0 parses
+    got = CM.parse_strings_to_date(
+        Column.from_strings(["0000-02-29"])).to_pylist()
+    assert got[0] is not None
