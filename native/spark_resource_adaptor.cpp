@@ -1,0 +1,769 @@
+// Native port of the SparkResourceAdaptor OOM state machine — the role
+// the reference implements in SparkResourceAdaptorJni.cpp (2,903 LoC of
+// C++): alloc bracketing, blocked-thread wake ordering, deadlock
+// detection with BUFN rollback / split selection, forced-OOM injection,
+// per-task metrics.  Semantics mirror the Python implementation in
+// spark_rapids_tpu/memory/spark_resource_adaptor.py, which the
+// differential test suite runs against this library.
+//
+// C ABI for ctypes.  Blocking calls (sra_alloc, sra_block_until_ready)
+// park on a condition variable; Python's ctypes releases the GIL, so
+// other Python threads keep running — the same threading shape as JNI.
+
+#include <algorithm>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <set>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+enum State {
+  RUNNING = 0,
+  ALLOC = 1,
+  ALLOC_FREE = 2,
+  BLOCKED = 3,
+  BUFN_THROW = 4,
+  BUFN_WAIT = 5,
+  BUFN = 6,
+  SPLIT_THROW = 7,
+  REMOVE_THROW = 8,
+};
+
+// status codes returned to python (0 = ok)
+enum Status {
+  OK = 0,
+  ERR_RETRY_OOM = -1,
+  ERR_SPLIT_OOM = -2,
+  ERR_CUDF = -3,
+  ERR_GPU_OOM = -4,
+  ERR_REMOVED = -5,
+  ERR_INVALID = -6,
+};
+
+constexpr int kRetryLimit = 500;
+
+struct Injection {
+  long hit_count = 0;
+  long skip_count = 0;
+  int filter = 2;  // 0=CPU_OR_GPU 1=CPU 2=GPU
+  bool matches(bool is_cpu) const {
+    if (hit_count <= 0 && skip_count <= 0) return false;
+    if (filter == 0) return true;
+    return (filter == 1) == is_cpu;
+  }
+};
+
+struct Metrics {
+  long num_retry = 0;
+  long num_split_retry = 0;
+  long block_time_ns = 0;
+  long lost_time_ns = 0;
+  long gpu_max_memory = 0;
+  long footprint = 0;
+  long max_footprint = 0;
+  void add(const Metrics& o) {
+    num_retry += o.num_retry;
+    num_split_retry += o.num_split_retry;
+    block_time_ns += o.block_time_ns;
+    lost_time_ns += o.lost_time_ns;
+    gpu_max_memory = std::max(gpu_max_memory, o.gpu_max_memory);
+    max_footprint = std::max(max_footprint, o.max_footprint);
+  }
+};
+
+struct ThreadState {
+  long thread_id;
+  long task_id;  // -1 = pool/shuffle
+  std::set<long> pool_task_ids;
+  int state = RUNNING;
+  bool is_cpu_alloc = false;
+  bool pool_blocked = false;
+  bool retry_before_bufn = false;
+  bool in_spilling = false;
+  long num_retried = 0;
+  Injection retry_oom, split_oom;
+  long cudf_injected = 0;
+  Metrics metrics;
+  std::condition_variable wake;
+  Clock::time_point block_start{};
+  Clock::time_point retry_point = Clock::now();
+
+  // priority: (task_priority, thread_id); larger = higher priority
+  std::pair<long, long> priority() const {
+    long tp = task_id < 0 ? INT64_MAX : INT64_MAX - (task_id + 1);
+    return {tp, thread_id};
+  }
+};
+
+struct Adaptor {
+  std::mutex mu;
+  std::map<long, ThreadState> threads;
+  std::map<long, Metrics> checkpointed;
+  long limit = 0;
+  long used = 0;
+  long gpu_allocated = 0;
+  // bounded ring (same guard as the Python port's deque(maxlen=100000)):
+  // long-lived executors must not accumulate log strings forever
+  static constexpr size_t kMaxLog = 100000;
+  std::vector<std::string> log;
+  size_t log_dropped = 0;
+
+  void log_transition(ThreadState& t, int to, const char* note) {
+    char buf[160];
+    snprintf(buf, sizeof(buf), "TRANSITION,%ld,%ld,%d,%d,%s", t.thread_id,
+             t.task_id, t.state, to, note ? note : "");
+    if (log.size() >= kMaxLog) {
+      log.erase(log.begin(), log.begin() + kMaxLog / 2);
+      log_dropped += kMaxLog / 2;
+    }
+    log.emplace_back(buf);
+  }
+
+  void transition(ThreadState& t, int to, const char* note = nullptr) {
+    log_transition(t, to, note);
+    t.state = to;
+  }
+
+  void checkpoint_metrics(ThreadState& t) {
+    if (t.task_id >= 0) {
+      checkpointed[t.task_id].add(t.metrics);
+    } else {
+      for (long task : t.pool_task_ids) checkpointed[task].add(t.metrics);
+    }
+    t.metrics = Metrics{};
+  }
+
+  bool is_blocked(int s) const { return s == BLOCKED || s == BUFN; }
+
+  bool bufn_or_above(const ThreadState& t) const {
+    if (t.pool_blocked) return true;
+    if (t.state == BLOCKED) return false;
+    return t.state == BUFN;
+  }
+
+  void wake_next_highest_blocked(bool is_cpu) {
+    ThreadState* best = nullptr;
+    for (auto& [id, t] : threads) {
+      if (t.state == BLOCKED && t.is_cpu_alloc == is_cpu) {
+        if (!best || t.priority() > best->priority()) best = &t;
+      }
+    }
+    if (best) {
+      transition(*best, RUNNING);
+      best->wake.notify_all();
+    }
+  }
+
+  void wake_after_task_finishes() {
+    bool any_blocked = false;
+    for (auto& [id, t] : threads) {
+      if (t.state == BLOCKED) {
+        transition(t, RUNNING);
+        t.wake.notify_all();
+        any_blocked = true;
+      }
+    }
+    if (!any_blocked) {
+      for (auto& [id, t] : threads) {
+        if (t.state == BUFN || t.state == BUFN_THROW ||
+            t.state == BUFN_WAIT) {
+          transition(t, RUNNING);
+          t.wake.notify_all();
+        }
+      }
+    }
+  }
+
+  void check_and_update_for_bufn() {
+    std::set<long> all_tasks, blocked_tasks, bufn_tasks;
+    std::map<long, long> pool_count, pool_bufn_count;
+    for (auto& [id, t] : threads) {
+      if (t.task_id >= 0) {
+        all_tasks.insert(t.task_id);
+        bool bp = bufn_or_above(t);
+        if (bp) bufn_tasks.insert(t.task_id);
+        if (bp || t.state == BLOCKED) blocked_tasks.insert(t.task_id);
+      }
+    }
+    for (auto& [id, t] : threads) {
+      if (t.task_id < 0) {
+        bool bp = bufn_or_above(t);
+        for (long task : t.pool_task_ids) {
+          pool_count[task]++;
+          if (bp) pool_bufn_count[task]++;
+        }
+        if (!bp && t.state != BLOCKED) {
+          for (long task : t.pool_task_ids) blocked_tasks.erase(task);
+        }
+      }
+    }
+    if (all_tasks.empty() || blocked_tasks.size() != all_tasks.size())
+      return;
+    // lowest-priority BLOCKED thread rolls back
+    ThreadState* to_bufn = nullptr;
+    int blocked_count = 0;
+    for (auto& [id, t] : threads) {
+      if (t.state == BLOCKED) {
+        blocked_count++;
+        if (!to_bufn || t.priority() < to_bufn->priority()) to_bufn = &t;
+      }
+    }
+    if (to_bufn) {
+      if (blocked_count == 1) {
+        to_bufn->retry_before_bufn = true;
+        transition(*to_bufn, RUNNING, "retry_before_bufn");
+      } else {
+        transition(*to_bufn, BUFN_THROW);
+      }
+      to_bufn->wake.notify_all();
+    }
+    for (auto& [task, bufn_n] : pool_bufn_count) {
+      auto it = pool_count.find(task);
+      if (it != pool_count.end() && it->second <= bufn_n)
+        bufn_tasks.insert(task);
+    }
+    if (bufn_tasks.size() == all_tasks.size()) {
+      // all BUFN: highest-priority BUFN thread splits
+      ThreadState* to_split = nullptr;
+      for (auto& [id, t] : threads) {
+        if (t.state == BUFN) {
+          if (!to_split || t.priority() > to_split->priority())
+            to_split = &t;
+        }
+      }
+      if (to_split) {
+        transition(*to_split, SPLIT_THROW);
+        to_split->wake.notify_all();
+      }
+    }
+  }
+
+  int check_before_oom(ThreadState& t) {
+    if (t.num_retried + 1 > kRetryLimit) return ERR_GPU_OOM;
+    t.num_retried++;
+    return OK;
+  }
+
+  void record_failed_retry(ThreadState& t) {
+    auto now = Clock::now();
+    t.metrics.lost_time_ns +=
+        std::chrono::duration_cast<std::chrono::nanoseconds>(
+            now - t.retry_point)
+            .count();
+    t.retry_point = now;
+  }
+
+  // returns a Status; on throw-status the caller raises in python
+  int block_until_ready(std::unique_lock<std::mutex>& lk, long thread_id) {
+    bool done = false;
+    while (!done) {
+      auto it = threads.find(thread_id);
+      if (it == threads.end()) return OK;
+      ThreadState& t = it->second;
+      switch (t.state) {
+        case BLOCKED:
+        case BUFN: {
+          t.block_start = Clock::now();
+          while (true) {
+            t.wake.wait(lk);
+            auto it2 = threads.find(thread_id);
+            if (it2 == threads.end() || !is_blocked(it2->second.state))
+              break;
+          }
+          auto it3 = threads.find(thread_id);
+          if (it3 != threads.end()) {
+            it3->second.metrics.block_time_ns +=
+                std::chrono::duration_cast<std::chrono::nanoseconds>(
+                    Clock::now() - it3->second.block_start)
+                    .count();
+          }
+          break;
+        }
+        case BUFN_THROW: {
+          transition(t, BUFN_WAIT);
+          record_failed_retry(t);
+          t.metrics.num_retry++;
+          int rc = check_before_oom(t);
+          if (rc != OK) return rc;
+          record_failed_retry(t);
+          // CPU alloc entry points are not in the C ABI yet; when they
+          // land this must return a distinct ERR_CPU_RETRY_OOM
+          return ERR_RETRY_OOM;
+        }
+        case BUFN_WAIT: {
+          transition(t, BUFN);
+          check_and_update_for_bufn();
+          auto it4 = threads.find(thread_id);
+          if (it4 != threads.end() && is_blocked(it4->second.state)) {
+            it4->second.block_start = Clock::now();
+            while (true) {
+              it4->second.wake.wait(lk);
+              auto it5 = threads.find(thread_id);
+              if (it5 == threads.end() || !is_blocked(it5->second.state))
+                break;
+            }
+            auto it6 = threads.find(thread_id);
+            if (it6 != threads.end()) {
+              it6->second.metrics.block_time_ns +=
+                  std::chrono::duration_cast<std::chrono::nanoseconds>(
+                      Clock::now() - it6->second.block_start)
+                      .count();
+            }
+          }
+          break;
+        }
+        case SPLIT_THROW: {
+          transition(t, RUNNING);
+          record_failed_retry(t);
+          t.metrics.num_split_retry++;
+          int rc = check_before_oom(t);
+          if (rc != OK) return rc;
+          record_failed_retry(t);
+          return ERR_SPLIT_OOM;
+        }
+        case REMOVE_THROW: {
+          log_transition(t, -1, "removed");
+          threads.erase(thread_id);
+          return ERR_REMOVED;
+        }
+        default:
+          done = true;
+      }
+    }
+    return OK;
+  }
+
+  // pre_alloc: returns OK, a throw-status, or 1 (recursive)
+  int pre_alloc(std::unique_lock<std::mutex>& lk, long thread_id,
+                bool is_cpu, bool blocking) {
+    auto it = threads.find(thread_id);
+    if (it == threads.end()) return OK;
+    ThreadState& t = it->second;
+    if (t.state == ALLOC || t.state == ALLOC_FREE) {
+      if (is_cpu && blocking) return ERR_INVALID;
+      return 1;  // recursive
+    }
+    if (t.retry_oom.matches(is_cpu)) {
+      if (t.retry_oom.skip_count > 0) {
+        t.retry_oom.skip_count--;
+      } else if (t.retry_oom.hit_count > 0) {
+        t.retry_oom.hit_count--;
+        t.metrics.num_retry++;
+        record_failed_retry(t);
+        return ERR_RETRY_OOM;
+      }
+    }
+    if (t.cudf_injected > 0) {
+      t.cudf_injected--;
+      record_failed_retry(t);
+      return ERR_CUDF;
+    }
+    if (t.split_oom.matches(is_cpu)) {
+      if (t.split_oom.skip_count > 0) {
+        t.split_oom.skip_count--;
+      } else if (t.split_oom.hit_count > 0) {
+        t.split_oom.hit_count--;
+        t.metrics.num_split_retry++;
+        record_failed_retry(t);
+        return ERR_SPLIT_OOM;
+      }
+    }
+    if (blocking) {
+      int rc = block_until_ready(lk, thread_id);
+      if (rc != OK) return rc;
+    }
+    auto it2 = threads.find(thread_id);
+    if (it2 == threads.end()) return OK;
+    ThreadState& t2 = it2->second;
+    if (t2.state == RUNNING) {
+      transition(t2, ALLOC);
+      t2.is_cpu_alloc = is_cpu;
+      return OK;
+    }
+    return ERR_INVALID;
+  }
+
+  void post_alloc_success(long thread_id, bool is_cpu, bool recursive,
+                          long nbytes) {
+    auto it = threads.find(thread_id);
+    if (recursive || it == threads.end()) return;
+    ThreadState& t = it->second;
+    t.retry_before_bufn = false;
+    if (t.state == ALLOC || t.state == ALLOC_FREE) {
+      transition(t, RUNNING);
+      t.is_cpu_alloc = false;
+      t.retry_point = Clock::now();
+      if (!is_cpu) {
+        if (!t.in_spilling) {
+          t.metrics.footprint += nbytes;
+          t.metrics.max_footprint =
+              std::max(t.metrics.max_footprint, t.metrics.footprint);
+        }
+        gpu_allocated += nbytes;
+        t.metrics.gpu_max_memory =
+            std::max(t.metrics.gpu_max_memory, gpu_allocated);
+      }
+    }
+    wake_next_highest_blocked(is_cpu);
+  }
+
+  // returns: 1 retry, 0 no-retry, throw-status (<0)
+  int post_alloc_failed(long thread_id, bool is_cpu, bool is_oom,
+                        bool blocking, bool recursive) {
+    auto it = threads.find(thread_id);
+    if (recursive || it == threads.end()) {
+      check_and_update_for_bufn();
+      return 0;
+    }
+    ThreadState& t = it->second;
+    if (t.state == ALLOC_FREE) {
+      transition(t, RUNNING);
+    } else if (t.state == ALLOC) {
+      if (is_oom && t.retry_before_bufn) {
+        t.retry_before_bufn = false;
+        transition(t, BUFN_THROW);
+        t.wake.notify_all();
+      } else if (is_oom && blocking) {
+        transition(t, BLOCKED);
+      } else {
+        transition(t, RUNNING);
+      }
+    } else {
+      return ERR_INVALID;
+    }
+    check_and_update_for_bufn();
+    return 1;
+  }
+
+  void dealloc(long thread_id, bool is_cpu, long nbytes) {
+    auto it = threads.find(thread_id);
+    if (it != threads.end()) {
+      ThreadState& t = it->second;
+      if (!is_cpu) {
+        if (!t.in_spilling) t.metrics.footprint -= nbytes;
+        gpu_allocated -= nbytes;
+      }
+    }
+    for (auto& [id, t] : threads) {
+      if (id != thread_id && t.state == ALLOC &&
+          t.is_cpu_alloc == is_cpu) {
+        transition(t, ALLOC_FREE);
+      }
+    }
+    wake_next_highest_blocked(is_cpu);
+  }
+
+  bool remove_association(long thread_id, long remove_task) {
+    auto it = threads.find(thread_id);
+    if (it == threads.end()) return false;
+    ThreadState& t = it->second;
+    checkpoint_metrics(t);
+    bool remove = false;
+    if (remove_task < 0) {
+      remove = true;
+    } else if (t.task_id >= 0) {
+      remove = t.task_id == remove_task;
+    } else {
+      t.pool_task_ids.erase(remove_task);
+      remove = t.pool_task_ids.empty();
+    }
+    bool ret = false;
+    if (remove) {
+      if (t.state == BLOCKED || t.state == BUFN) {
+        transition(t, REMOVE_THROW);
+        t.wake.notify_all();
+      } else {
+        if (t.state == RUNNING) ret = true;
+        log_transition(t, -1, "unregistered");
+        threads.erase(thread_id);
+      }
+    }
+    return ret;
+  }
+};
+
+std::mutex g_mu;
+std::unordered_map<long, Adaptor*> g_adaptors;
+long g_next = 1;
+
+Adaptor* get(long h) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_adaptors.find(h);
+  return it == g_adaptors.end() ? nullptr : it->second;
+}
+
+}  // namespace
+
+extern "C" {
+
+long sra_create(long limit) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto* a = new Adaptor();
+  a->limit = limit;
+  long h = g_next++;
+  g_adaptors[h] = a;
+  return h;
+}
+
+void sra_destroy(long h) {
+  Adaptor* a = nullptr;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_adaptors.find(h);
+    if (it == g_adaptors.end()) return;
+    a = it->second;
+    g_adaptors.erase(it);
+  }
+  bool any_parked = false;
+  {
+    std::unique_lock<std::mutex> lk(a->mu);
+    for (auto& [id, t] : a->threads) {
+      if (t.state == BLOCKED || t.state == BUFN) {
+        a->transition(t, REMOVE_THROW);
+        t.wake.notify_all();
+        any_parked = true;
+      }
+    }
+  }
+  if (!any_parked) {
+    delete a;  // clean shutdown path frees everything
+  }
+  // else: leaked deliberately — woken threads still reference the
+  // adaptor; production shutdown drains tasks first (reference caveat).
+}
+
+int sra_start_dedicated_task_thread(long h, long tid, long task) {
+  Adaptor* a = get(h);
+  if (!a) return ERR_INVALID;
+  std::unique_lock<std::mutex> lk(a->mu);
+  auto it = a->threads.find(tid);
+  if (it != a->threads.end())
+    return it->second.task_id == task ? OK : ERR_INVALID;
+  ThreadState& t = a->threads[tid];
+  t.thread_id = tid;
+  t.task_id = task;
+  a->log_transition(t, RUNNING, "dedicated");
+  return OK;
+}
+
+int sra_pool_thread_working_on_tasks(long h, long tid, int is_shuffle,
+                                     const long* tasks, long n) {
+  Adaptor* a = get(h);
+  if (!a) return ERR_INVALID;
+  std::unique_lock<std::mutex> lk(a->mu);
+  auto it = a->threads.find(tid);
+  if (it == a->threads.end()) {
+    ThreadState& t = a->threads[tid];
+    t.thread_id = tid;
+    t.task_id = -1;
+    a->log_transition(t, RUNNING, is_shuffle ? "shuffle" : "pool");
+    it = a->threads.find(tid);
+  } else if (it->second.task_id >= 0) {
+    return ERR_INVALID;
+  }
+  for (long i = 0; i < n; ++i) it->second.pool_task_ids.insert(tasks[i]);
+  return OK;
+}
+
+int sra_remove_thread_association(long h, long tid, long task) {
+  Adaptor* a = get(h);
+  if (!a) return ERR_INVALID;
+  std::unique_lock<std::mutex> lk(a->mu);
+  a->remove_association(tid, task);
+  return OK;
+}
+
+int sra_task_done(long h, long task) {
+  Adaptor* a = get(h);
+  if (!a) return ERR_INVALID;
+  std::unique_lock<std::mutex> lk(a->mu);
+  std::vector<long> ids;
+  for (auto& [id, t] : a->threads) {
+    if (t.task_id == task || t.pool_task_ids.count(task)) ids.push_back(id);
+  }
+  for (long id : ids) a->remove_association(id, task);
+  a->wake_after_task_finishes();
+  return OK;
+}
+
+int sra_alloc(long h, long tid, long nbytes) {
+  Adaptor* a = get(h);
+  if (!a) return ERR_INVALID;
+  std::unique_lock<std::mutex> lk(a->mu);
+  while (true) {
+    int pre = a->pre_alloc(lk, tid, false, true);
+    bool recursive = pre == 1;
+    if (pre < 0) return pre;
+    // the reservation itself
+    if (a->used + nbytes <= a->limit) {
+      a->used += nbytes;
+      a->post_alloc_success(tid, false, recursive, nbytes);
+      return OK;
+    }
+    int rc = a->post_alloc_failed(tid, false, true, true, recursive);
+    if (rc < 0) return rc;
+    if (rc == 0) return ERR_GPU_OOM;
+    // loop retries: pre_alloc blocks until ready
+  }
+}
+
+int sra_dealloc(long h, long tid, long nbytes) {
+  Adaptor* a = get(h);
+  if (!a) return ERR_INVALID;
+  std::unique_lock<std::mutex> lk(a->mu);
+  a->used -= nbytes;
+  a->dealloc(tid, false, nbytes);
+  return OK;
+}
+
+int sra_block_thread_until_ready(long h, long tid) {
+  Adaptor* a = get(h);
+  if (!a) return ERR_INVALID;
+  std::unique_lock<std::mutex> lk(a->mu);
+  return a->block_until_ready(lk, tid);
+}
+
+int sra_force_retry_oom(long h, long tid, long n, int filter, long skip) {
+  Adaptor* a = get(h);
+  if (!a) return ERR_INVALID;
+  std::unique_lock<std::mutex> lk(a->mu);
+  auto it = a->threads.find(tid);
+  if (it == a->threads.end()) return ERR_INVALID;
+  it->second.retry_oom.hit_count = n;
+  it->second.retry_oom.skip_count = skip;
+  it->second.retry_oom.filter = filter;
+  return OK;
+}
+
+int sra_force_split_and_retry_oom(long h, long tid, long n, int filter,
+                                  long skip) {
+  Adaptor* a = get(h);
+  if (!a) return ERR_INVALID;
+  std::unique_lock<std::mutex> lk(a->mu);
+  auto it = a->threads.find(tid);
+  if (it == a->threads.end()) return ERR_INVALID;
+  it->second.split_oom.hit_count = n;
+  it->second.split_oom.skip_count = skip;
+  it->second.split_oom.filter = filter;
+  return OK;
+}
+
+int sra_force_cudf_exception(long h, long tid, long n) {
+  Adaptor* a = get(h);
+  if (!a) return ERR_INVALID;
+  std::unique_lock<std::mutex> lk(a->mu);
+  auto it = a->threads.find(tid);
+  if (it == a->threads.end()) return ERR_INVALID;
+  it->second.cudf_injected = n;
+  return OK;
+}
+
+int sra_get_state(long h, long tid) {
+  Adaptor* a = get(h);
+  if (!a) return -100;
+  std::unique_lock<std::mutex> lk(a->mu);
+  auto it = a->threads.find(tid);
+  if (it == a->threads.end()) return -1;  // UNKNOWN
+  return it->second.state;
+}
+
+long sra_used(long h) {
+  Adaptor* a = get(h);
+  if (!a) return -1;
+  std::unique_lock<std::mutex> lk(a->mu);
+  return a->used;
+}
+
+long sra_gpu_allocated(long h) {
+  Adaptor* a = get(h);
+  if (!a) return -1;
+  std::unique_lock<std::mutex> lk(a->mu);
+  return a->gpu_allocated;
+}
+
+int sra_thread_waiting_on_pool(long h, long tid, int waiting) {
+  Adaptor* a = get(h);
+  if (!a) return ERR_INVALID;
+  std::unique_lock<std::mutex> lk(a->mu);
+  auto it = a->threads.find(tid);
+  if (it == a->threads.end()) return ERR_INVALID;
+  it->second.pool_blocked = waiting != 0;
+  if (waiting) a->check_and_update_for_bufn();
+  return OK;
+}
+
+int sra_check_and_break_deadlocks(long h) {
+  Adaptor* a = get(h);
+  if (!a) return ERR_INVALID;
+  std::unique_lock<std::mutex> lk(a->mu);
+  a->check_and_update_for_bufn();
+  return OK;
+}
+
+// metric kinds: 0 retry, 1 split, 2 block_ns, 3 lost_ns, 4 gpu_max,
+// 5 max_footprint
+long sra_get_and_reset_metric(long h, long task, int kind, int reset) {
+  Adaptor* a = get(h);
+  if (!a) return -1;
+  std::unique_lock<std::mutex> lk(a->mu);
+  long total = 0;
+  bool is_max = kind == 4 || kind == 5;
+  auto pull = [&](Metrics& m) {
+    long* p = nullptr;
+    switch (kind) {
+      case 0: p = &m.num_retry; break;
+      case 1: p = &m.num_split_retry; break;
+      case 2: p = &m.block_time_ns; break;
+      case 3: p = &m.lost_time_ns; break;
+      case 4: p = &m.gpu_max_memory; break;
+      case 5: p = &m.max_footprint; break;
+      default: return;
+    }
+    total = is_max ? std::max(total, *p) : total + *p;
+    if (reset) *p = 0;
+  };
+  auto it = a->checkpointed.find(task);
+  if (it != a->checkpointed.end()) pull(it->second);
+  for (auto& [id, t] : a->threads) {
+    if (t.task_id == task || t.pool_task_ids.count(task))
+      pull(t.metrics);
+  }
+  return total;
+}
+
+void sra_remove_task_metrics(long h, long task) {
+  Adaptor* a = get(h);
+  if (!a) return;
+  std::unique_lock<std::mutex> lk(a->mu);
+  a->checkpointed.erase(task);
+}
+
+long sra_log_count(long h) {
+  Adaptor* a = get(h);
+  if (!a) return 0;
+  std::unique_lock<std::mutex> lk(a->mu);
+  return static_cast<long>(a->log.size());
+}
+
+long sra_log_line(long h, long idx, char* out, long cap) {
+  Adaptor* a = get(h);
+  if (!a) return 0;
+  std::unique_lock<std::mutex> lk(a->mu);
+  if (idx < 0 || idx >= static_cast<long>(a->log.size())) return 0;
+  const std::string& s = a->log[idx];
+  long n = std::min<long>(cap - 1, s.size());
+  memcpy(out, s.data(), n);
+  out[n] = 0;
+  return n;
+}
+
+}  // extern "C"

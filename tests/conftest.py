@@ -18,3 +18,18 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_enable_x64", True)
+
+
+def make_oom_adaptor(impl: str, limit: int = 1000):
+    """Shared python-or-native adaptor factory for the differential OOM
+    state-machine suites (skips when the native build is unavailable)."""
+    import pytest
+    from spark_rapids_tpu.memory.resource import LimitingMemoryResource
+    from spark_rapids_tpu.memory.spark_resource_adaptor import \
+        SparkResourceAdaptor
+    if impl == "python":
+        return SparkResourceAdaptor(LimitingMemoryResource(limit))
+    from spark_rapids_tpu.memory import native_adaptor
+    if not native_adaptor.available():
+        pytest.skip("native adaptor unavailable (g++ build failed)")
+    return native_adaptor.NativeSparkResourceAdaptor(limit)

@@ -77,9 +77,15 @@ def run_task(adaptor, task_id, seed, skewed, stats, stats_lock):
         stats["completed"] += 1
 
 
+def _make_adaptor(impl, limit=1000):
+    from conftest import make_oom_adaptor
+    return make_oom_adaptor(impl, limit)
+
+
+@pytest.mark.parametrize("impl", ["python", "native"])
 @pytest.mark.parametrize("skewed", [False, True])
-def test_monte_carlo_no_deadlock_no_leak(skewed):
-    adaptor = SparkResourceAdaptor(LimitingMemoryResource(1000))
+def test_monte_carlo_no_deadlock_no_leak(skewed, impl):
+    adaptor = _make_adaptor(impl)
     n_tasks = 24
     stats = {"retries": 0, "splits": 0, "completed": 0}
     stats_lock = threading.Lock()
@@ -103,10 +109,11 @@ def test_monte_carlo_no_deadlock_no_leak(skewed):
     adaptor.shutdown()
 
 
-def test_monte_carlo_high_pressure_hits_retry_path():
+@pytest.mark.parametrize("impl", ["python", "native"])
+def test_monte_carlo_high_pressure_hits_retry_path(impl):
     """Greedy tasks (each wanting 40-90% of the pool) must deadlock and
     recover via rollback/split — asserts the machinery actually fired."""
-    adaptor = SparkResourceAdaptor(LimitingMemoryResource(1000))
+    adaptor = _make_adaptor(impl)
     n_tasks = 8
     stats = {"retries": 0, "splits": 0, "completed": 0}
     stats_lock = threading.Lock()
