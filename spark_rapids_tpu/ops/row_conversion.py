@@ -180,10 +180,12 @@ def _column_word_contribs(col: Column, start: int):
 
 def _assemble_fixed_words(cols, starts, validity_offset,
                           row_size) -> jnp.ndarray:
-    """Word-oriented row assembly: compose each 4-byte word of the row from
-    (rows,) u32 vectors (full-lane friendly), transpose once, bitcast to
-    bytes.  Avoids the 16x lane padding of narrow (rows, k) uint8 pieces.
-    Returns flat (rows*row_size,) uint8."""
+    """Word-oriented row assembly: compose each 4-byte word of the row
+    from (rows,) u32 vectors (full-lane friendly) and stack them into the
+    (rows, W) matrix.  Avoids the 16x lane padding of narrow (rows, k)
+    uint8 pieces; measured equivalent to stack(axis=0)+transpose (~59
+    GB/s of output on one v5e chip); a single-pass Pallas assembly kernel
+    is the known next lever.  Returns flat packed u32 LE words."""
     rows = cols[0].length
     n_words = row_size // 4
     contribs = {}
@@ -206,8 +208,7 @@ def _assemble_fixed_words(cols, starts, validity_offset,
             if zeros is None:
                 zeros = jnp.zeros((rows,), _U32)
             words.append(zeros)
-    wt = jnp.stack(words, axis=0)          # (W, rows): cheap, no padding
-    mat = wt.T                              # one big transpose
+    mat = jnp.stack(words, axis=1)         # (rows, W) directly
     return mat.reshape(-1)                  # packed u32 LE words
 
 

@@ -400,8 +400,60 @@ def merge_to_table(kudo_tables: Sequence[KudoTable],
                    fields: Sequence[Field]) -> Table:
     """Concatenate N kudo tables into one device Table
     (KudoSerializer.mergeToTable:407 / KudoTableMerger)."""
+    table, _ = merge_to_table_with_metrics(kudo_tables, fields)
+    return table
+
+
+# ------------------------------------------------------- metrics & dump
+
+
+@dataclass
+class WriteMetrics:
+    """KudoSerializer WriteMetrics analog: bytes written + copy time."""
+    written_bytes: int = 0
+    copy_time_ns: int = 0
+
+
+@dataclass
+class MergeMetrics:
+    """KudoTableMerger MergeMetrics analog."""
+    parse_time_ns: int = 0
+    concat_time_ns: int = 0
+    total_rows: int = 0
+
+
+def write_to_stream_with_metrics(columns, out, row_offset: int,
+                                 num_rows: int) -> "WriteMetrics":
+    """writeToStreamWithMetrics (KudoSerializer.java:249)."""
+    import time as _time
+    t0 = _time.monotonic_ns()
+    n = write_to_stream(columns, out, row_offset, num_rows)
+    return WriteMetrics(written_bytes=n,
+                        copy_time_ns=_time.monotonic_ns() - t0)
+
+
+def merge_to_table_with_metrics(kudo_tables, fields):
+    import time as _time
+    t0 = _time.monotonic_ns()
     parsed = [_parse_table(kt, fields) for kt in kudo_tables]
-    cols = []
-    for i, f in enumerate(fields):
-        cols.append(_concat_host_cols([p[i] for p in parsed], f))
-    return Table(cols)
+    t1 = _time.monotonic_ns()
+    cols = [_concat_host_cols([p[i] for p in parsed], f)
+            for i, f in enumerate(fields)]
+    t2 = _time.monotonic_ns()
+    table = Table(cols)
+    return table, MergeMetrics(parse_time_ns=t1 - t0,
+                               concat_time_ns=t2 - t1,
+                               total_rows=table.num_rows)
+
+
+def dump_tables(kudo_tables, path_prefix: str) -> List[str]:
+    """Debug dump of shuffle blocks to files (kudo/DumpOption.java /
+    WriteInput dump support): one file per kudo table, header+body."""
+    paths = []
+    for i, kt in enumerate(kudo_tables):
+        p = f"{path_prefix}{i:05d}.kudo"
+        with open(p, "wb") as f:
+            kt.header.write(f)
+            f.write(kt.buffer)
+        paths.append(p)
+    return paths

@@ -136,3 +136,22 @@ def test_merge_empty_list_decimal128_shape():
     from spark_rapids_tpu.shuffle.schema import Field
     out = kudo.merge_to_table([], [Field(dtypes.decimal128(-2))])
     assert out.columns[0].data.shape == (0, 4)
+
+
+def test_metrics_and_dump(tmp_path):
+    t = mk_table()
+    buf = io.BytesIO()
+    wm = kudo.write_to_stream_with_metrics(t.columns, buf, 0, 7)
+    assert wm.written_bytes > 0 and wm.copy_time_ns >= 0
+    assert wm.written_bytes == len(buf.getvalue())
+    buf.seek(0)
+    kts = [kudo.read_one_table(buf)]
+    merged, mm = kudo.merge_to_table_with_metrics(
+        kts, schema_of_table(t))
+    assert mm.total_rows == 7 and mm.parse_time_ns >= 0
+    paths = kudo.dump_tables(kts, str(tmp_path / "blk_"))
+    assert len(paths) == 1
+    with open(paths[0], "rb") as f:
+        re_read = kudo.read_one_table(f)
+    assert re_read.header.num_rows == 7
+    assert re_read.buffer == kts[0].buffer
