@@ -1,0 +1,176 @@
+"""Extended benchmark suite covering the BASELINE.json configs beyond the
+headline row-conversion metric (bench.py remains the driver's single-line
+entry):
+
+  config 2: hash group-by aggregate on a 1e7-row int64/float64 table
+  config 3: inner join on two large int64 tables
+  config 4: string ops (get_json_object + parse_url + substring) on 1e6
+            rows
+  plus: murmur3/xxhash64 hash throughput, OOM state machine ops/sec
+        (python vs native)
+
+Writes BENCH_EXTRA.json and prints it.  Timings that touch the device
+use the chained-dependency pattern from bench_impl.py; host-path ops use
+plain wall clock.
+"""
+
+import json
+import time
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+
+
+def bench_groupby(n=10_000_000, groups=10_000):
+    from spark_rapids_tpu.columns.column import Column
+    from spark_rapids_tpu.columns.table import Table
+    from spark_rapids_tpu.ops import groupby as gb
+    rng = np.random.default_rng(0)
+    keys = Table([Column.from_numpy(
+        rng.integers(0, groups, n, dtype=np.int64))])
+    vals = Column.from_numpy(rng.normal(size=n))
+    results = {}
+    for label in ("cold", "warm"):  # cold includes eager-op compiles
+        t0 = time.perf_counter()
+        out = gb.groupby_aggregate(keys, [vals, vals],
+                                   [gb.SUM, gb.COUNT])
+        total = int(np.asarray(out.columns[2].data).sum())
+        dt = time.perf_counter() - t0
+        assert total == n
+        results[label] = round(dt, 3)
+    return {"rows": n, "groups": groups, "seconds": results,
+            "warm_rows_per_sec_M": round(n / results["warm"] / 1e6, 1)}
+
+
+def bench_join(n=10_000_000, keyspace=1_000_000):
+    from spark_rapids_tpu.columns.column import Column
+    from spark_rapids_tpu.columns.table import Table
+    from spark_rapids_tpu.ops import joins
+    rng = np.random.default_rng(1)
+    left = Table([Column.from_numpy(
+        rng.integers(0, keyspace, n, dtype=np.int64))])
+    right = Table([Column.from_numpy(
+        np.arange(keyspace, dtype=np.int64))])
+    t0 = time.perf_counter()
+    li, ri = joins.sort_merge_inner_join(left, right)
+    pairs = int(np.asarray(li).shape[0])
+    dt = time.perf_counter() - t0
+    return {"left_rows": n, "right_rows": keyspace, "pairs": pairs,
+            "seconds": round(dt, 3),
+            "rows_per_sec": round(n / dt / 1e6, 1)}
+
+
+def bench_strings(n=1_000_000):
+    from spark_rapids_tpu.columns.column import Column
+    from spark_rapids_tpu.ops import json_path, parse_uri
+    from spark_rapids_tpu.ops.substring_index import substring_index
+    docs = [f'{{"user": {{"id": {i}, "name": "u{i}"}}, "n": {i % 97}}}'
+            for i in range(n // 10)]  # 100k json docs
+    jcol = Column.from_strings(docs)
+    t0 = time.perf_counter()
+    out = json_path.get_json_object(jcol, "$.user.name")
+    dt_json = time.perf_counter() - t0
+    assert out.to_pylist()[1] == "u1"
+
+    urls = [f"https://host{i % 50}.example.com/p/{i}?k={i}&x=1"
+            for i in range(n // 10)]
+    ucol = Column.from_strings(urls)
+    t0 = time.perf_counter()
+    hosts = parse_uri.parse_uri_to_host(ucol)
+    dt_uri = time.perf_counter() - t0
+
+    strs = Column.from_strings(
+        [f"a{i}.b{i}.c{i}" for i in range(n)])
+    t0 = time.perf_counter()
+    sub = substring_index(strs, ".", 2)
+    dt_sub = time.perf_counter() - t0
+    return {
+        "get_json_object_rows_per_sec":
+            round(len(docs) / dt_json / 1e3, 1),
+        "parse_url_rows_per_sec": round(len(urls) / dt_uri / 1e3, 1),
+        "substring_index_rows_per_sec": round(n / dt_sub / 1e6, 2),
+        "units": "k or M rows/sec (host paths except substring)",
+    }
+
+
+def bench_hash(n=10_000_000):
+    import jax.numpy as jnp
+    from spark_rapids_tpu.columns import dtypes
+    from spark_rapids_tpu.columns.column import Column
+    from spark_rapids_tpu.ops import hash as H
+    rng = np.random.default_rng(2)
+    col = Column.from_numpy(rng.integers(-2**60, 2**60, n,
+                                         dtype=np.int64))
+
+    def step(salt):
+        c = Column(dtypes.INT64, n, data=col.data + salt)
+        h = H.murmur3_32([c], 42).data
+        x = H.xxhash64([c]).data
+        # return the hash arrays: jit outputs must be materialized
+        return h, x, h[0].astype(jnp.int64) + salt
+
+    stepj = jax.jit(step)
+    tiny = jax.jit(lambda v: v + 1)
+    int(tiny(jnp.int64(0)))
+    _h, _x, salt = stepj(jnp.int64(0))
+    int(salt)
+    t0 = time.perf_counter()
+    int(tiny(jnp.int64(1)))
+    rtt = time.perf_counter() - t0
+    K = 20
+    t0 = time.perf_counter()
+    for _ in range(K):
+        _h, _x, salt = stepj(salt)
+    int(salt)
+    dt = max(time.perf_counter() - t0 - rtt, 1e-9) / K
+    return {"rows": n, "seconds_per_pass": round(dt, 4),
+            "hash_rows_per_sec_M": round(n / dt / 1e6, 0),
+            "note": "murmur3_32 + xxhash64 per pass, chained timing"}
+
+
+def bench_oom_machine(ops=20_000):
+    import threading
+    results = {}
+    for impl in ("python", "native"):
+        if impl == "python":
+            from spark_rapids_tpu.memory.resource import \
+                LimitingMemoryResource
+            from spark_rapids_tpu.memory.spark_resource_adaptor import \
+                SparkResourceAdaptor
+            a = SparkResourceAdaptor(LimitingMemoryResource(1 << 40))
+        else:
+            from spark_rapids_tpu.memory import native_adaptor
+            if not native_adaptor.available():
+                continue
+            a = native_adaptor.NativeSparkResourceAdaptor(1 << 40)
+        tid = threading.get_ident()
+        a.start_dedicated_task_thread(tid, 1)
+        t0 = time.perf_counter()
+        for _ in range(ops):
+            a.allocate(64)
+            a.deallocate(64)
+        dt = time.perf_counter() - t0
+        a.task_done(1)
+        a.shutdown()
+        results[impl] = round(ops * 2 / dt / 1e3, 1)
+    return {"alloc_dealloc_kops_per_sec": results}
+
+
+def main():
+    out = {
+        "groupby_1e7": bench_groupby(),
+        "join_1e7": bench_join(),
+        "string_ops_1e6": bench_strings(),
+        "hash_1e7": bench_hash(),
+        "oom_machine": bench_oom_machine(),
+    }
+    with open("BENCH_EXTRA.json", "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -84,13 +84,18 @@ def run():
                     validity=c0.validity)
         rows_col = RC.convert_to_rows(_T([salted] + t.columns[1:]))
         data = rows_col.children[0].data
-        new_salt = data[0].astype(jnp.int64) + data[-1].astype(jnp.int64)
-        return new_salt
+        # the buffer is RETURNED from jit: XLA must materialize it fully
+        # (a reduction-only salt lets XLA push the sum through the stack
+        # and skip the writes; an element-only salt risks slicing).  The
+        # cheap chained salt serializes iterations; TPU programs complete
+        # atomically, so salt availability implies the buffer was built.
+        new_salt = data[0].astype(jnp.int64) + salt
+        return data, new_salt
 
     step_j = jax.jit(step)
     tiny = jax.jit(lambda x: x + 1)
     int(tiny(jnp.int64(0)))
-    salt = step_j(table, jnp.int64(0))
+    _buf, salt = step_j(table, jnp.int64(0))
     int(salt)  # warm + sync
 
     rtts = []
@@ -103,8 +108,8 @@ def run():
     iters = 30
     t0 = time.perf_counter()
     for _ in range(iters):
-        salt = step_j(table, salt)   # chained: serialized on device
-    int(salt)                        # single readback fence
+        _buf, salt = step_j(table, salt)  # chained: serialized on device
+    int(salt)                             # single readback fence
     wall = time.perf_counter() - t0
     dt_tpu = max(wall - rtt, 1e-9) / iters
     gbps = total_bytes / dt_tpu / 1e9

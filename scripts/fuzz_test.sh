@@ -1,0 +1,11 @@
+#!/bin/sh
+# Monte-Carlo OOM stress gate (reference ci/fuzz-test.sh:31-34 analog):
+# runs the randomized retry-framework stress, including the high-pressure
+# deadlock-recovery config, against BOTH the python and native adaptors.
+set -e
+cd "$(dirname "$0")/.."
+python -m pytest tests/test_rmm_monte_carlo.py -q -p no:randomly
+for i in 1 2 3 4 5; do
+  python -m pytest tests/test_rmm_monte_carlo.py -q >/dev/null || exit 1
+done
+echo "fuzz: 6x monte-carlo clean"

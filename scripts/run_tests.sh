@@ -1,0 +1,6 @@
+#!/bin/sh
+# CI test entry (reference ci/Jenkinsfile.premerge analog): full suite on
+# the 8-device virtual CPU mesh.
+set -e
+cd "$(dirname "$0")/.."
+python -m pytest tests/ -q

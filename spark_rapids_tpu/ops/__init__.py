@@ -85,3 +85,14 @@ from spark_rapids_tpu.ops import json_utils  # noqa: F401
 from spark_rapids_tpu.ops import iceberg  # noqa: F401
 from spark_rapids_tpu.ops import protobuf  # noqa: F401
 from spark_rapids_tpu.ops.uuid_gen import random_uuids  # noqa: F401
+from spark_rapids_tpu.ops.sorting import order_by, sort_table  # noqa: F401
+from spark_rapids_tpu.ops.cast_more import (  # noqa: F401
+    long_to_binary_string,
+    bytes_to_hex,
+    long_to_hex_string,
+    decimal_to_non_ansi_string,
+    format_number,
+    parse_strings_to_date,
+    parse_timestamp_strings,
+    parse_timestamp_strings_with_format,
+)

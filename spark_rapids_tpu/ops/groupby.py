@@ -24,7 +24,8 @@ from spark_rapids_tpu.columns.column import Column
 from spark_rapids_tpu.columns.dtypes import Kind
 from spark_rapids_tpu.columns.table import Table
 from spark_rapids_tpu.ops.copying import gather_table
-from spark_rapids_tpu.ops.joins import _column_rank_host
+from spark_rapids_tpu.ops.joins import _column_rank_host, \
+    group_ids_from_ranks
 from spark_rapids_tpu.utils import floats
 
 SUM = "sum"
@@ -40,12 +41,15 @@ def _group_ids(keys: Table) -> Tuple[jnp.ndarray, np.ndarray, int]:
     cols = []
     for c in keys.columns:
         rank, mask = _column_rank_host(c)
-        cols.append(np.where(mask, rank + 1, np.int64(0)))  # 0 = null
-    key_mat = np.stack(cols, axis=1) if cols else \
-        np.zeros((keys.num_rows, 0), np.int64)
-    uniq, first_idx, ids = np.unique(key_mat, axis=0, return_index=True,
-                                     return_inverse=True)
-    return jnp.asarray(ids.astype(np.int32)), first_idx, len(uniq)
+        # mask as its own key column: no sentinel value can collide with
+        # a legal rank (e.g. -1 or INT64_MIN keys)
+        cols.append(mask.astype(np.int64))
+        cols.append(np.where(mask, rank, np.int64(0)))
+    if not cols:
+        return (jnp.zeros(keys.num_rows, np.int32),
+                np.zeros(0, np.int64), 0)
+    ids, first_idx, ngroups = group_ids_from_ranks(cols)
+    return jnp.asarray(ids.astype(np.int32)), first_idx, ngroups
 
 
 def _value_f64(col: Column) -> jnp.ndarray:

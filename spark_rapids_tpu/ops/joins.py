@@ -81,6 +81,33 @@ def _column_rank_host(col: Column) -> Tuple[np.ndarray, np.ndarray]:
     return rank, mask
 
 
+def group_ids_from_ranks(rank_cols):
+    """(ids, first_index_per_group, ngroups) from per-column rank arrays.
+    Single column uses the fast 1-D np.unique; multi-column avoids the
+    slow np.unique(axis=0) structured path via lexsort + adjacent-diff."""
+    n = len(rank_cols[0]) if rank_cols else 0
+    if n == 0:
+        return (np.zeros(0, np.int64), np.zeros(0, np.int64), 0)
+    if len(rank_cols) == 1:
+        uniq, first_idx, ids = np.unique(
+            rank_cols[0], return_index=True, return_inverse=True)
+        return ids.astype(np.int64), first_idx, len(uniq)
+    order = np.lexsort(tuple(reversed(rank_cols)))
+    diff = np.zeros(n, bool)
+    for c in rank_cols:
+        cs = c[order]
+        diff[1:] |= cs[1:] != cs[:-1]
+    gid_sorted = np.cumsum(diff)  # 0-based after subtracting below
+    ids = np.empty(n, np.int64)
+    ids[order] = gid_sorted
+    ngroups = int(gid_sorted[-1]) + 1
+    # stable lexsort: the first sorted element of each group is its
+    # earliest original occurrence (np.unique return_index semantics)
+    starts = np.concatenate([[0], np.nonzero(diff)[0]])
+    first_idx = order[starts]
+    return ids, first_idx, ngroups
+
+
 def _key_ids(left: Table, right: Table, compare_nulls: str):
     """Canonical group id per row of left and right (equal keys <=> equal
     id), plus per-row key-validity (any null key under UNEQUAL = no
@@ -114,20 +141,20 @@ def _key_ids(left: Table, right: Table, compare_nulls: str):
         else:
             lr, lm = _column_rank_host(lc)
             rr, rm = _column_rank_host(rc)
-        # encode null as a distinct smallest value
-        lcol = np.where(lm, lr, np.int64(np.iinfo(np.int64).min))
-        rcol = np.where(rm, rr, np.int64(np.iinfo(np.int64).min))
-        ranks.append((lcol, rcol))
+        # null encoding WITHOUT sentinel values (a sentinel collides with
+        # legal ranks like INT64_MIN): the mask itself becomes an extra
+        # key column, and null rows zero their value column
+        ranks.append((lm.astype(np.int64), rm.astype(np.int64)))
+        ranks.append((np.where(lm, lr, np.int64(0)),
+                      np.where(rm, rr, np.int64(0))))
         if compare_nulls == NULL_UNEQUAL:
             valid_l &= lm
             valid_r &= rm
-    lkey = np.stack([a for a, _ in ranks], axis=0) if ranks else \
-        np.zeros((0, nl), np.int64)
-    rkey = np.stack([b for _, b in ranks], axis=0) if ranks else \
-        np.zeros((0, nr), np.int64)
-    both = np.concatenate([lkey, rkey], axis=1)
-    _, ids = np.unique(both.T, axis=0, return_inverse=True) if \
-        both.shape[1] else (None, np.zeros(0, np.int64))
+    combined = [np.concatenate([a, b]) for a, b in ranks]
+    if combined and len(combined[0]):
+        ids, _, _ = group_ids_from_ranks(combined)
+    else:
+        ids = np.zeros(nl + nr, np.int64)
     return ids[:nl], ids[nl:], valid_l, valid_r
 
 

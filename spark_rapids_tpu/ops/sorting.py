@@ -1,0 +1,58 @@
+"""Multi-key sort (the libcudf sort slice of the substrate the reference
+leans on for sort_merge joins and ORDER BY; SURVEY.md §7.1): stable
+lexicographic ordering with Spark null placement, floats ordered by the
+total-order transform (NaN largest, -0.0 < 0.0)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.columns.table import Table
+from spark_rapids_tpu.ops.copying import gather_table
+from spark_rapids_tpu.ops.joins import _column_rank_host
+
+ASC = True
+DESC = False
+
+
+def order_by(keys: Table,
+             ascending: Optional[Sequence[bool]] = None,
+             nulls_first: Optional[Sequence[bool]] = None) -> jnp.ndarray:
+    """Stable argsort over the key columns (leftmost key most
+    significant).  Returns an int32 gather map.  Spark defaults: ASC with
+    nulls first; DESC places nulls last unless overridden."""
+    n = keys.num_columns
+    asc = list(ascending) if ascending is not None else [True] * n
+    if nulls_first is None:
+        nf = [a for a in asc]  # Spark: ASC->nulls first, DESC->nulls last
+    else:
+        nf = list(nulls_first)
+    if not (len(asc) == len(nf) == n):
+        raise ValueError("ascending/nulls_first must match key count")
+    if n == 0:
+        return jnp.arange(keys.num_rows, dtype=jnp.int32)
+    sort_keys: List[np.ndarray] = []
+    for col, a, f in zip(keys.columns, asc, nf):
+        rank, mask = _column_rank_host(col)
+        # descending via bitwise NOT (order-reversing, no INT64_MIN
+        # negation overflow); nulls ordered by a dedicated mask key so no
+        # sentinel can collide with a legal rank value
+        key = rank if a else ~rank
+        null_key = np.where(mask, 1, 0) if f else np.where(mask, 0, 1)
+        sort_keys.append(null_key.astype(np.int64))
+        sort_keys.append(np.where(mask, key, np.int64(0)))
+    # np.lexsort: last key is primary -> reverse
+    order = np.lexsort(tuple(reversed(sort_keys)))
+    return jnp.asarray(order.astype(np.int32))
+
+
+def sort_table(table: Table, key_indices: Sequence[int],
+               ascending: Optional[Sequence[bool]] = None,
+               nulls_first: Optional[Sequence[bool]] = None) -> Table:
+    keys = Table([table.columns[i] for i in key_indices])
+    order = order_by(keys, ascending, nulls_first)
+    return gather_table(table, order)

@@ -154,3 +154,20 @@ def test_groupby_float32_nan_minmax_review_regression():
     row = out.to_pylist()[0]
     assert row[1] == 1.0          # NaN is largest: min is 1.0
     assert np.isnan(row[2])       # max is NaN
+
+
+def test_null_vs_extreme_key_regressions():
+    """NULL must not merge with -1 / INT64_MIN keys (code review)."""
+    keys = Table([Column.from_pylist([-1, None, 5], dtypes.INT64)])
+    vals = Column.from_pylist([1, 1, 1], dtypes.INT64)
+    out = gb.groupby_aggregate(keys, [vals], [gb.COUNT])
+    rows = {r[0]: r[1] for r in out.to_pylist()}
+    assert rows == {-1: 1, None: 1, 5: 1}
+    li, ri = J.sort_merge_inner_join(
+        Table([Column.from_pylist([-2**63], dtypes.INT64)]),
+        Table([Column.from_pylist([None], dtypes.INT64)]), J.NULL_EQUAL)
+    assert np.asarray(li).shape == (0,)  # -2^63 is NOT null
+    li2, _ = J.sort_merge_inner_join(
+        Table([Column.from_pylist([None], dtypes.INT64)]),
+        Table([Column.from_pylist([None], dtypes.INT64)]), J.NULL_EQUAL)
+    assert np.asarray(li2).shape == (1,)  # null==null under EQUAL

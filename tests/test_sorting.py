@@ -1,0 +1,62 @@
+"""Multi-key sort tests (ORDER BY substrate)."""
+
+import numpy as np
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.columns.table import Table
+from spark_rapids_tpu.ops import sorting as S
+
+
+def test_sort_multi_key_with_nulls():
+    t = Table([
+        Column.from_pylist([2, 1, None, 1, 2], dtypes.INT64),
+        Column.from_strings(["b", "z", "m", "a", "a"]),
+    ])
+    out = S.sort_table(t, [0, 1])
+    # ASC: nulls first, then (1,a),(1,z),(2,a),(2,b)
+    assert out.to_pylist() == [(None, "m"), (1, "a"), (1, "z"),
+                               (2, "a"), (2, "b")]
+    out_d = S.sort_table(t, [0, 1], ascending=[False, True])
+    # DESC key 0: nulls last
+    assert out_d.to_pylist() == [(2, "a"), (2, "b"), (1, "a"), (1, "z"),
+                                 (None, "m")]
+
+
+def test_sort_floats_total_order():
+    t = Table([Column.from_pylist(
+        [1.5, float("nan"), -0.0, 0.0, float("-inf"), None],
+        dtypes.FLOAT64)])
+    out = S.sort_table(t, [0])
+    vals = [r[0] for r in out.to_pylist()]
+    assert vals[0] is None
+    assert vals[1] == float("-inf")
+    assert str(vals[2]) == "-0.0" and str(vals[3]) == "0.0"
+    assert vals[4] == 1.5
+    assert np.isnan(vals[5])  # NaN sorts largest
+
+
+def test_sort_stability():
+    t = Table([
+        Column.from_pylist([1, 1, 1], dtypes.INT32),
+        Column.from_strings(["first", "second", "third"]),
+    ])
+    out = S.sort_table(t, [0])
+    assert [r[1] for r in out.to_pylist()] == ["first", "second",
+                                               "third"]
+
+
+def test_sort_sentinel_collision_regressions():
+    """INT64_MIN keys and null sentinels must not collide (code review)."""
+    t = Table([Column.from_pylist([0, -2**63, 5], dtypes.INT64)])
+    out = S.sort_table(t, [0], ascending=[False])
+    assert [r[0] for r in out.to_pylist()] == [5, 0, -2**63]
+    t2 = Table([Column.from_pylist([-2**63, None, 2**63 - 1],
+                                   dtypes.INT64)])
+    out2 = S.sort_table(t2, [0])  # ASC: nulls first
+    assert [r[0] for r in out2.to_pylist()] == [None, -2**63, 2**63 - 1]
+    out3 = S.sort_table(t2, [0], ascending=[False])  # DESC: nulls last
+    assert [r[0] for r in out3.to_pylist()] == [2**63 - 1, -2**63, None]
+    # zero key columns: identity order
+    empty_keys = S.order_by(Table([]))
+    assert empty_keys.shape == (0,)
