@@ -19,6 +19,7 @@ device DFA.
 
 from __future__ import annotations
 
+import re
 from typing import Optional, Tuple
 
 import jax.numpy as jnp
@@ -325,3 +326,81 @@ def float_to_string(col: Column) -> Column:
         for i in range(col.length)
     ]
     return Column.from_strings(vals)
+
+
+# ------------------------------------------------------ string -> decimal
+
+_DEC_RE_STRIP = re.compile(
+    r"^[\x00-\x1f ]*([+-]?)(\d*)(?:\.(\d*))?(?:[eE]([+-]?\d+))?"
+    r"[\x00-\x1f ]*$")
+_DEC_RE_NOSTRIP = re.compile(
+    r"^([+-]?)(\d*)(?:\.(\d*))?(?:[eE]([+-]?\d+))?$")
+
+
+def string_to_decimal(col: Column, precision: int, scale: int,
+                      ansi_mode: bool = False,
+                      strip: bool = True) -> Column:
+    """Spark CAST(string AS DECIMAL(precision, scale))
+    (cast_string.hpp:97 string_to_decimal; CastStrings.toDecimal):
+    optional sign/decimal point/exponent, HALF_UP rounding to the target
+    scale, null (or ANSI row error) when invalid or when the value does
+    not fit `precision` digits.  Output type by precision: decimal32
+    (<=9), decimal64 (<=18), else decimal128 — cudf scale convention
+    (negative = fractional digits)."""
+    assert col.dtype.is_string
+    vals = col.to_pylist()
+    rx = _DEC_RE_STRIP if strip else _DEC_RE_NOSTRIP
+    out = []
+    for s in vals:
+        if s is None:
+            out.append(None)
+            continue
+        m = rx.match(s)
+        if not m:
+            out.append(None)
+            continue
+        sign_s, ipart, fpart, exp_s = m.groups()
+        ipart = ipart or ""
+        fpart = fpart or ""
+        if not ipart and not fpart:
+            out.append(None)
+            continue
+        digits = int((ipart + fpart) or "0")
+        exp10 = (int(exp_s) if exp_s else 0) - len(fpart)
+        # unscaled at target scale: value * 10^{-scale}
+        shift = exp10 - scale
+        # bound the power before computing it exactly: a hostile
+        # exponent ("1e2147483647") must not allocate a gigabyte int
+        ndig = len(str(abs(digits))) if digits else 0
+        if digits == 0:
+            shift = 0
+        elif shift > precision:
+            out.append(None)  # unscaled >= 10^shift > 10^precision
+            continue
+        elif shift < -(ndig + 1):
+            digits, shift = 0, 0  # |value| < 0.1 -> rounds to 0
+        if shift >= 0:
+            unscaled = digits * 10**shift
+        else:
+            d = 10 ** (-shift)
+            unscaled = (2 * digits + d) // (2 * d)  # HALF_UP (positive)
+        if sign_s == "-":
+            unscaled = -unscaled
+        if abs(unscaled) >= 10**precision:
+            out.append(None)  # doesn't fit the requested precision
+            continue
+        out.append(unscaled)
+    base_valid = np.asarray(col.valid_mask())
+    computed = np.array([v is not None for v in out])
+    if ansi_mode:
+        bad = base_valid & ~computed
+        if bad.any():
+            row = int(np.argmax(bad))
+            raise CastException(row, vals[row])
+    if precision <= 9:
+        dt = dtypes.decimal32(scale)
+    elif precision <= 18:
+        dt = dtypes.decimal64(scale)
+    else:
+        dt = dtypes.decimal128(scale)
+    return Column.from_pylist(out, dt)

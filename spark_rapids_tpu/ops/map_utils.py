@@ -116,3 +116,90 @@ def map_zip(keys_list: Column, a_vals: Column, b_vals: Column) -> Column:
     return Column(dtypes.LIST, keys_list.length,
                   validity=keys_list.validity, offsets=keys_list.offsets,
                   children=(st,))
+
+
+def map_zip_full(col1: Column, col2: Column) -> Column:
+    """Spark map_zip_with key alignment (map_zip_with_utils.cu:356-420
+    map_zip; GpuMapZipWithUtils.mapZip): per row, take the distinct
+    union of both maps' keys (col1's keys in first-appearance order,
+    then col2's new keys), and for each key build STRUCT<value1, value2>
+    where a side's value is null when that map lacks the key.  Result
+    row validity is the AND of the input validities."""
+    from spark_rapids_tpu.ops.copying import concat_columns
+
+    st1, k1, v1 = _entries(col1)
+    st2, k2, v2 = _entries(col2)
+    assert col1.length == col2.length
+    all_keys = concat_columns([k1, k2])
+    ranks, _ = _column_rank_host(all_keys)
+    r1, r2 = ranks[:k1.length], ranks[k1.length:]
+    o1 = np.asarray(col1.offsets)
+    o2 = np.asarray(col2.offsets)
+    m1 = (np.ones(col1.length, bool) if col1.validity is None
+          else np.asarray(col1.validity).astype(bool))
+    m2 = (np.ones(col2.length, bool) if col2.validity is None
+          else np.asarray(col2.validity).astype(bool))
+    row_mask = m1 & m2
+    key_take = []          # index into the concatenated key column
+    take1, take2 = [], []  # value gathers; -1 = absent
+    new_offs = np.zeros(col1.length + 1, np.int32)
+    for row in range(col1.length):
+        if row_mask[row]:
+            pos = {}   # rank -> output slot
+            for e in range(o1[row], o1[row + 1]):
+                if r1[e] not in pos:
+                    pos[r1[e]] = len(key_take)
+                    key_take.append(e)
+                    take1.append(e)
+                    take2.append(-1)
+                else:
+                    take1[pos[r1[e]]] = e  # duplicate key: last wins
+            for e in range(o2[row], o2[row + 1]):
+                if r2[e] not in pos:
+                    pos[r2[e]] = len(key_take)
+                    key_take.append(k1.length + e)
+                    take1.append(-1)
+                    take2.append(e)
+                else:
+                    take2[pos[r2[e]]] = e
+        new_offs[row + 1] = len(key_take)
+
+    def _all_null_like(src: Column, n: int) -> Column:
+        """n all-null rows shaped like src (src may be zero-length)."""
+        if src.dtype.kind == Kind.LIST:
+            return Column(src.dtype, n,
+                          validity=jnp.zeros(n, jnp.uint8),
+                          offsets=jnp.zeros(n + 1, jnp.int32),
+                          children=src.children)
+        if src.dtype.kind == Kind.STRUCT:
+            return Column.make_struct(
+                n, [_all_null_like(c, n) for c in src.children],
+                validity=np.zeros(n, np.uint8))
+        return Column.from_pylist([None] * n, src.dtype)
+
+    def _gather_opt(src: Column, take) -> Column:
+        t = np.array(take, np.int64)
+        present = t >= 0
+        if src.length == 0:
+            # one side contributed no entries at all: every take is -1
+            return _all_null_like(src, len(t))
+        g = gather(src, jnp.asarray(np.where(present, t, 0).astype(
+            np.int32)))
+        base = (present if g.validity is None
+                else np.asarray(g.validity).astype(bool) & present)
+        return Column(g.dtype, g.length,
+                      data=g.data, validity=jnp.asarray(
+                          base.astype(np.uint8)),
+                      offsets=g.offsets, children=g.children)
+
+    # key_take never holds -1, so a plain gather over the concatenation
+    # already built for ranking is enough
+    keys_out = gather(all_keys, jnp.asarray(
+        np.array(key_take, np.int32)))
+    pair = Column.make_struct(len(key_take),
+                              [_gather_opt(v1, take1),
+                               _gather_opt(v2, take2)])
+    st = Column.make_struct(len(key_take), [keys_out, pair])
+    return Column(dtypes.LIST, col1.length,
+                  validity=jnp.asarray(row_mask.astype(np.uint8)),
+                  offsets=jnp.asarray(new_offs), children=(st,))

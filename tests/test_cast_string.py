@@ -133,3 +133,49 @@ def test_float32_to_string():
     assert out[0] == "1.5"
     assert out[1] == "0.1"          # shortest f32 repr
     assert out[2] == "3.4028235E38"
+
+
+def test_string_to_decimal_reference_vectors():
+    """castToDecimalTest vectors (precision/scale triplets)."""
+    c1 = Column.from_strings([" 3", "9", "4", "2", "20.5", None, "7.6asd",
+                              "\x00 \x1f1\x14"])
+    out1 = CS.string_to_decimal(c1, 2, 0)
+    assert out1.dtype.kind == "decimal32"
+    assert out1.to_pylist() == [3, 9, 4, 2, 21, None, None, 1]
+    c2 = Column.from_strings(["5", "1 ", "0", "2", "7.1", None, "asdf",
+                              "\x00 \x1f1\x14"])
+    out2 = CS.string_to_decimal(c2, 10, 0)
+    assert out2.dtype.kind == "decimal64"
+    assert out2.to_pylist() == [5, 1, 0, 2, 7, None, None, 1]
+    c3 = Column.from_strings(["2", "3", " 4 ", "5.07", "9.23", None,
+                              "7.8.3", "\x00 \x1f1\x14"])
+    out3 = CS.string_to_decimal(c3, 3, -1)
+    assert out3.to_pylist() == [20, 30, 40, 51, 92, None, None, 10]
+
+
+def test_string_to_decimal_more():
+    c = Column.from_strings(["1e2", "-3.555", "999", "0.004", ""])
+    out = CS.string_to_decimal(c, 5, -2)
+    # 1e2 -> 10000 (100.00); -3.555 -> -356 HALF_UP; 999 -> 99900;
+    # 0.004 -> 0 (0.00); "" -> null
+    assert out.to_pylist() == [10000, -356, 99900, 0, None]
+    # precision overflow -> null; ansi throws with row
+    big = Column.from_strings(["12345"])
+    assert CS.string_to_decimal(big, 3, 0).to_pylist() == [None]
+    import pytest as _pytest
+    with _pytest.raises(CastException):
+        CS.string_to_decimal(big, 3, 0, ansi_mode=True)
+    # no-strip mode rejects padded input
+    assert CS.string_to_decimal(Column.from_strings([" 3"]), 3, 0,
+                                strip=False).to_pylist() == [None]
+    # decimal128 output for big precision
+    wide = CS.string_to_decimal(Column.from_strings(["1" * 25]), 30, 0)
+    assert wide.dtype.kind == "decimal128"
+    assert wide.to_pylist() == [int("1" * 25)]
+
+
+def test_string_to_decimal_hostile_exponents():
+    """A hostile exponent must not compute a gigabyte big-int."""
+    c = Column.from_strings(["1e2147483647", "-5e2147483647",
+                             "1e-2147483647", "0e2147483647"])
+    assert CS.string_to_decimal(c, 10, 0).to_pylist() == [None, None, 0, 0]

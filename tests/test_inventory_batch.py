@@ -204,3 +204,37 @@ def test_review_regressions_inventory():
     assert isinstance(stream, plat.SeekableInputStream)
     stream.close()
     os.unlink(name)
+
+
+def test_map_zip_full_key_union():
+    """mapZip semantics (map_zip_with_utils.cu): per-row distinct key
+    union, STRUCT<v1,v2> with nulls for absent sides, AND row validity."""
+    def mk(rows):
+        offs = [0]; ks = []; vs = []
+        for r in rows:
+            if r is not None:
+                for k, v in r:
+                    ks.append(k); vs.append(v)
+            offs.append(len(ks))
+        st = Column.make_struct(len(ks), [
+            Column.from_strings(ks),
+            Column.from_pylist(vs, dtypes.INT64)])
+        return Column(dtypes.LIST, len(rows),
+                      offsets=np.array(offs, np.int32),
+                      validity=np.array([r is not None for r in rows],
+                                        np.uint8),
+                      children=(st,))
+
+    a = mk([[("a", 1), ("b", 2)], [("x", 5)], None, [],
+            [("d", 1), ("d", 2)]])
+    b = mk([[("b", 20), ("c", 30)], [], [("q", 9)], [("z", 7)],
+            [("d", 3)]])
+    out = map_utils.map_zip_full(a, b)
+    st = out.children[0]
+    assert np.asarray(out.offsets).tolist() == [0, 3, 4, 4, 5, 6]
+    assert np.asarray(out.validity).tolist() == [1, 1, 0, 1, 1]
+    assert st.children[0].to_pylist() == ["a", "b", "c", "x", "z", "d"]
+    pair = st.children[1]
+    # duplicate key inside one map: last value wins (row 4: d->2)
+    assert pair.children[0].to_pylist() == [1, 2, None, 5, None, 2]
+    assert pair.children[1].to_pylist() == [None, 20, 30, None, 7, 3]

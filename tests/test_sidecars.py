@@ -159,3 +159,34 @@ def test_install_replaces_and_stops_previous(tmp_path):
     second = fi.install(str(cfg), watch=False)
     assert first._watching is False  # old watcher stopped
     fi.uninstall()
+
+
+def test_fileio_local_vectored(tmp_path):
+    """RapidsInputFile.readVectored contract
+    (fileio/RapidsInputFile.java:68-95)."""
+    from spark_rapids_tpu.io.fileio import CopyRange, LocalFileIO
+
+    p = tmp_path / "blob.bin"
+    payload = bytes(range(256)) * 4
+    fio = LocalFileIO()
+    with fio.new_output_file(str(p)).create() as w:
+        w.write(payload)
+    inf = fio.new_input_file(str(p))
+    assert inf.get_length() == len(payload)
+    assert inf.read_fully() == payload
+    out = bytearray(32)
+    inf.read_vectored(out, [CopyRange(0, 8, 24), CopyRange(100, 8, 0),
+                            CopyRange(1000, 4, 12)])
+    assert out[24:32] == payload[:8]
+    assert out[0:8] == payload[100:108]
+    assert out[12:16] == payload[1000:1004]
+    # empty list is a no-op; bad ranges rejected before any IO
+    inf.read_vectored(out, [])
+    import pytest as _p
+    with _p.raises(ValueError):
+        inf.read_vectored(out, [CopyRange(0, 16, 20)])  # overruns output
+    with _p.raises(ValueError):
+        inf.read_vectored(out, [CopyRange(-1, 4, 0)])
+    with _p.raises(EOFError):
+        inf.read_vectored(bytearray(2048),
+                          [CopyRange(len(payload) - 2, 8, 0)])
