@@ -1,0 +1,47 @@
+"""Global task-attempt priority registry (reference TaskPriority.java:33
+and TaskPriorityJni.cpp:25-60): earlier-registered attempts get higher
+priority, the special attempt id -1 always gets the maximum, and
+`task_done` releases an attempt's entry.  Used by the shuffle path to
+order task work; the OOM deadlock breaker derives its own priority from
+(task, thread) ids independently (spark_resource_adaptor.py)."""
+
+from __future__ import annotations
+
+import threading
+
+_MAX_LONG = (1 << 63) - 1
+
+
+class TaskPriorityRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._next = _MAX_LONG - 1
+        self._priorities: dict = {}
+
+    def get_task_priority(self, attempt_id: int) -> int:
+        if attempt_id == -1:
+            return _MAX_LONG  # special case: always highest
+        with self._lock:
+            if attempt_id in self._priorities:
+                return self._priorities[attempt_id]
+            priority = self._next
+            self._next -= 1
+            self._priorities[attempt_id] = priority
+            return priority
+
+    def task_done(self, attempt_id: int) -> None:
+        if attempt_id == -1:
+            return
+        with self._lock:
+            self._priorities.pop(attempt_id, None)
+
+
+_global = TaskPriorityRegistry()
+
+
+def get_task_priority(attempt_id: int) -> int:
+    return _global.get_task_priority(attempt_id)
+
+
+def task_done(attempt_id: int) -> None:
+    _global.task_done(attempt_id)

@@ -404,3 +404,62 @@ def string_to_decimal(col: Column, precision: int, scale: int,
     else:
         dt = dtypes.decimal128(scale)
     return Column.from_pylist(out, dt)
+
+
+# ------------------------------------------- integer <-> string with base
+
+def string_to_integers_with_base(col: Column, base: int,
+                                 ansi_mode: bool = False,
+                                 dtype: DType = dtypes.UINT64) -> Column:
+    """CastStrings.toIntegersWithBase(:134) — the string leg of Spark
+    conv(): trim ASCII spaces, optional '-', longest valid-digit prefix
+    in `base`; no digits -> 0 (still a valid row), negatives wrap to
+    unsigned, overflow clamps to 2^64-1.  Matches baseDec2Hex/baseHex2Dec
+    test vectors (CastStringsTest.java:430-560)."""
+    from spark_rapids_tpu.ops.strings_misc import parse_base_prefix
+
+    assert col.dtype.is_string
+    if not (2 <= base <= 36):
+        raise ValueError(f"unsupported base {base}")
+    np_dt = np.dtype(dtype.np_dtype)
+    bits = np_dt.itemsize * 8
+    signed = np_dt.kind == "i"
+    out = []
+    for s in col.to_pylist():
+        if s is None:
+            out.append(None)
+            continue
+        t = s.lstrip(" \t\n\r\f\v")
+        if not t:
+            # rows matching ^\s*$ are NULL (CastStringJni.cpp:234-240),
+            # unlike no-digit junk which yields 0
+            out.append(None)
+            continue
+        val, overflow = parse_base_prefix(t, base)
+        if overflow and ansi_mode:
+            raise CastException(len(out), s)
+        val &= (1 << bits) - 1
+        if signed and val >= 1 << (bits - 1):
+            val -= 1 << bits
+        out.append(val)
+    return Column.from_pylist(out, dtype)
+
+
+def integers_with_base_to_string(col: Column, base: int) -> Column:
+    """CastStrings.fromIntegersWithBase(:158): base 10 renders the value
+    as-is (signed for signed dtypes); base 16 renders the two's-complement
+    bits of the column's width, uppercase, no leading zeros
+    ([123,-1] int32 -> ['7B','FFFFFFFF'])."""
+    if base not in (10, 16):
+        raise ValueError("only base 10 and 16 are supported")
+    np_dt = np.dtype(col.dtype.np_dtype)
+    bits = np_dt.itemsize * 8
+    out = []
+    for v in col.to_pylist():
+        if v is None:
+            out.append(None)
+        elif base == 10:
+            out.append(str(v))
+        else:
+            out.append(format(int(v) & ((1 << bits) - 1), "X"))
+    return Column.from_strings(out)

@@ -20,6 +20,31 @@ _DIGITS = "0123456789abcdefghijklmnopqrstuvwxyz"
 
 # ------------------------------------------------------- number_converter
 
+def parse_base_prefix(t: str, base: int) -> Tuple[int, bool]:
+    """Optional '-' then the longest valid-digit prefix of t in `base`,
+    accumulated as unsigned 64-bit: overflow clamps to 2^64-1 (and stays
+    clamped under negation), negatives wrap.  Shared by conv()
+    (number_converter.cu) and CastStrings.toIntegersWithBase."""
+    neg = t[:1] == "-"
+    if neg:
+        t = t[1:]
+    val = 0
+    overflow = False
+    for ch in t:
+        d = _DIGITS.find(ch.lower())
+        if d < 0 or d >= base:
+            break
+        if not overflow:
+            val = val * base + d
+            if val >= 1 << 64:
+                overflow = True
+    if overflow:
+        val = (1 << 64) - 1
+    elif neg:
+        val = ((1 << 64) - val) & ((1 << 64) - 1)
+    return val, overflow
+
+
 def _conv_one(s: Optional[str], from_base: int, to_base: int
               ) -> Tuple[Optional[str], bool]:
     """Spark conv() single value; returns (result, overflowed).
@@ -35,24 +60,7 @@ def _conv_one(s: Optional[str], from_base: int, to_base: int
     t = s.strip(" ")
     if not t:
         return None, False
-    neg = False
-    if t[:1] == "-":
-        neg = True
-        t = t[1:]
-    val = 0
-    overflow = False
-    for ch in t:
-        d = _DIGITS.find(ch.lower())
-        if d < 0 or d >= from_base:
-            break
-        if not overflow:
-            val = val * from_base + d
-            if val >= 1 << 64:
-                overflow = True
-    if overflow:
-        val = (1 << 64) - 1
-    elif neg:
-        val = ((1 << 64) - val) & ((1 << 64) - 1)
+    val, overflow = parse_base_prefix(t, from_base)
     tb = abs(to_base)
     if to_base < 0:
         # signed rendering

@@ -179,3 +179,43 @@ def test_string_to_decimal_hostile_exponents():
     c = Column.from_strings(["1e2147483647", "-5e2147483647",
                              "1e-2147483647", "0e2147483647"])
     assert CS.string_to_decimal(c, 10, 0).to_pylist() == [None, None, 0, 0]
+
+
+def test_integers_with_base_reference_vectors():
+    """baseDec2HexTestNoNulls + baseHex2DecTest vectors
+    (CastStringsTest.java:430-560)."""
+    dec = Column.from_strings(["510", "00510", "00-510"])
+    u = CS.string_to_integers_with_base(dec, 10, dtype=dtypes.UINT64)
+    assert CS.integers_with_base_to_string(u, 10).to_pylist() == \
+        ["510", "510", "0"]
+    assert CS.integers_with_base_to_string(u, 16).to_pylist() == \
+        ["1FE", "1FE", "0"]
+
+    mixed = Column.from_strings([None, " ", "junk-510junk510", "--510",
+                                "   -510junk510", "  510junk510", "510",
+                                "00510", "00-510", "\t510"])
+    u = CS.string_to_integers_with_base(mixed, 10, dtype=dtypes.UINT64)
+    # baseDec2HexTestMixed: whitespace-only rows are NULL, junk rows are 0
+    assert CS.integers_with_base_to_string(u, 10).to_pylist() == \
+        [None, None, "0", "0", "18446744073709551106", "510", "510",
+         "510", "0", "510"]
+    assert CS.integers_with_base_to_string(u, 16).to_pylist() == \
+        [None, None, "0", "0", "FFFFFFFFFFFFFE02", "1FE", "1FE", "1FE",
+         "0", "1FE"]
+
+    hx = Column.from_strings([None, "junk", "0", "f", "junk-5Ajunk5A",
+                              "--5A", "   -5Ajunk5A", "  5Ajunk5A", "5a",
+                              "05a", "005a", "00-5a", "NzGGImWNRh"])
+    u = CS.string_to_integers_with_base(hx, 16, dtype=dtypes.UINT64)
+    assert CS.integers_with_base_to_string(u, 10).to_pylist() == \
+        [None, "0", "0", "15", "0", "0", "18446744073709551526", "90",
+         "90", "90", "90", "0", "0"]
+    assert CS.integers_with_base_to_string(u, 16).to_pylist() == \
+        [None, "0", "0", "F", "0", "0", "FFFFFFFFFFFFFFA6", "5A", "5A",
+         "5A", "5A", "0", "0"]
+    # signed narrow dtype renders two's-complement bits in hex
+    i32 = Column.from_pylist([123, -1, 0, 27, 342718233], dtypes.INT32)
+    assert CS.integers_with_base_to_string(i32, 16).to_pylist() == \
+        ["7B", "FFFFFFFF", "0", "1B", "146D7719"]
+    assert CS.integers_with_base_to_string(i32, 10).to_pylist() == \
+        ["123", "-1", "0", "27", "342718233"]

@@ -190,3 +190,62 @@ def test_fileio_local_vectored(tmp_path):
     with _p.raises(EOFError):
         inf.read_vectored(bytearray(2048),
                           [CopyRange(len(payload) - 2, 8, 0)])
+
+
+def test_task_priority_registry():
+    """TaskPriorityJni.cpp:25-60 semantics: decreasing assignment,
+    stable per attempt, -1 pinned to MAX_LONG, released on done."""
+    from spark_rapids_tpu.memory.task_priority import TaskPriorityRegistry
+
+    reg = TaskPriorityRegistry()
+    maxlong = (1 << 63) - 1
+    p10 = reg.get_task_priority(10)
+    p20 = reg.get_task_priority(20)
+    assert p10 == maxlong - 1 and p20 == maxlong - 2
+    assert reg.get_task_priority(10) == p10          # stable
+    assert reg.get_task_priority(-1) == maxlong      # special case
+    reg.task_done(10)
+    assert reg.get_task_priority(10) == maxlong - 3  # re-registered anew
+    reg.task_done(-1)                                # no-op
+
+
+def test_arms_helpers():
+    """Arms.java closeIfException/closeAll; Preconditions ensure*."""
+    from spark_rapids_tpu.utils.arms import (
+        Pair, close_all, close_if_exception, ensure, ensure_non_negative,
+        with_resources)
+
+    class Res:
+        def __init__(self, fail=False):
+            self.closed = 0
+            self.fail = fail
+
+        def close(self):
+            self.closed += 1
+            if self.fail:
+                raise RuntimeError("close failed")
+
+    r = Res()
+    assert close_if_exception(r, lambda x: 42) == 42
+    assert r.closed == 0                      # kept open on success
+    import pytest as _p
+    with _p.raises(KeyError):
+        close_if_exception(r, lambda x: (_ for _ in ()).throw(KeyError()))
+    assert r.closed == 1                      # closed on exception
+
+    a, b, c = Res(), Res(fail=True), Res()
+    with _p.raises(RuntimeError):
+        close_all([a, None, b, c])
+    assert a.closed == 1 and c.closed == 1    # later closes still ran
+
+    rs = [Res(), Res()]
+    assert with_resources(rs, lambda xs: len(xs)) == 2
+    assert all(x.closed for x in rs)
+
+    ensure(True, "never")
+    with _p.raises(ValueError, match="boom"):
+        ensure(False, lambda: "boom")
+    assert ensure_non_negative(7, "n") == 7
+    with _p.raises(ValueError, match="n must be non-negative"):
+        ensure_non_negative(-1, "n")
+    assert Pair.of(1, "x").left == 1 and Pair.of(1, "x").right == "x"
