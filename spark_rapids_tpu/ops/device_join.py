@@ -1,0 +1,92 @@
+"""Fully-jittable fixed-capacity inner join.
+
+The eager joins in ops/joins.py (reference join_primitives.hpp) produce
+exact variable-size index pairs at the eager boundary.  This module is
+the *device* counterpart for use INSIDE jit/shard_map — the piece a
+distributed join needs so the whole partition→exchange→join step
+compiles to one XLA program: static shapes, a caller-chosen pair
+capacity, and a true pair count so overflow is detectable (the same
+fixed-capacity-plus-true-count contract as parallel/exchange.py).
+
+TPU-first shape: both sides sort by key (total-order integer ranks —
+callers canonicalize floats/strings first, as ops/joins does), the
+right side's run for every left row comes from two vectorized
+searchsorteds, and pair slot j reverse-maps to its (left row, offset
+within run) with another searchsorted — no data-dependent loops, no
+dynamic shapes, O(P log N) work for P = capacity.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax.numpy as jnp
+
+
+class JoinPairs(NamedTuple):
+    left_indices: jnp.ndarray   # (capacity,) int32 into the left table
+    right_indices: jnp.ndarray  # (capacity,) int32 into the right table
+    valid: jnp.ndarray          # (capacity,) bool — slot holds a pair
+    total: jnp.ndarray          # () int64 TRUE pair count (may exceed
+    #                               capacity: caller must retry bigger)
+
+
+def inner_join_device(left_keys: jnp.ndarray, right_keys: jnp.ndarray,
+                      capacity: int,
+                      left_valid: jnp.ndarray | None = None,
+                      right_valid: jnp.ndarray | None = None
+                      ) -> JoinPairs:
+    """Jittable inner join on integer key arrays (join_primitives.hpp
+    sort_merge_inner_join contract, device-resident).  Rows with
+    valid=False never match (NULL-inequality semantics; encode
+    null-equals by mapping nulls to a shared sentinel key AND a
+    dedicated validity column upstream, as ops/joins._key_ids does)."""
+    nl = left_keys.shape[0]
+    nr = right_keys.shape[0]
+    lk = left_keys.astype(jnp.int64)
+    rk = right_keys.astype(jnp.int64)
+    if left_valid is None:
+        left_valid = jnp.ones(nl, jnp.bool_)
+    if right_valid is None:
+        right_valid = jnp.ones(nr, jnp.bool_)
+
+    if nl == 0 or nr == 0:
+        z = jnp.zeros(capacity, jnp.int32)
+        return JoinPairs(z, z, jnp.zeros(capacity, jnp.bool_),
+                         jnp.int64(0))
+
+    # sort right by (invalid, key): invalid rows go last and are excluded
+    # from every searched run by searching only the valid prefix
+    # (lexsort's primary key is the LAST entry).  Invalid keys map to
+    # INT64_MAX so rk_sorted stays globally ascending — searchsorted
+    # requires it; the n_valid_r clip below breaks the tie when valid
+    # keys legitimately equal INT64_MAX.
+    r_sortkey = jnp.where(right_valid, rk, jnp.int64(2**63 - 1))
+    r_order = jnp.lexsort((jnp.arange(nr), r_sortkey,
+                           (~right_valid).astype(jnp.int32)))
+    rk_sorted = r_sortkey[r_order]
+    n_valid_r = jnp.sum(right_valid.astype(jnp.int32))
+
+    # run bounds for each left key within the valid prefix
+    lo = jnp.searchsorted(rk_sorted, lk, side="left")
+    hi = jnp.searchsorted(rk_sorted, lk, side="right")
+    lo = jnp.minimum(lo, n_valid_r)
+    hi = jnp.minimum(hi, n_valid_r)
+    # pair accounting is int64: two 64k-row sides sharing one key are
+    # 2^32 pairs, which would wrap int32 and defeat overflow detection
+    counts = jnp.where(left_valid, hi - lo, 0).astype(jnp.int64)
+
+    offs = jnp.cumsum(counts) - counts          # exclusive prefix sum
+    total = offs[-1] + counts[-1]
+
+    # reverse map: pair slot j -> left row i with offs[i] <= j < offs[i+1]
+    j = jnp.arange(capacity, dtype=jnp.int64)
+    i = jnp.searchsorted(offs, j, side="right").astype(jnp.int32) - 1
+    i = jnp.clip(i, 0, nl - 1)
+    k = j - offs[i]
+    valid = (j < total) & (k < counts[i])
+    r_pos = jnp.clip(lo[i] + k, 0, nr - 1)
+    right_idx = r_order[r_pos].astype(jnp.int32)
+    return JoinPairs(jnp.where(valid, i, 0).astype(jnp.int32),
+                     jnp.where(valid, right_idx, 0),
+                     valid, total)

@@ -1,0 +1,105 @@
+"""Jittable fixed-capacity join + distributed shuffle-join tests
+(device_join.py, models/distributed_join.py) against brute-force
+oracles on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from spark_rapids_tpu.models.distributed_join import make_distributed_join
+from spark_rapids_tpu.ops.device_join import inner_join_device
+
+
+def _oracle(lk, rk, lval, rval):
+    return sorted((i, j) for i in range(len(lk)) for j in range(len(rk))
+                  if lval[i] and rval[j] and lk[i] == rk[j])
+
+
+def test_inner_join_device_vs_oracle():
+    rng = np.random.default_rng(5)
+    jfn = jax.jit(lambda a, b, c, d: inner_join_device(a, b, 4096, c, d))
+    for trial in range(8):
+        nl, nr = rng.integers(1, 200, 2)
+        lk = rng.integers(0, 40, nl)
+        rk = rng.integers(0, 40, nr)
+        lval = rng.random(nl) < 0.9
+        rval = rng.random(nr) < 0.9
+        want = _oracle(lk, rk, lval, rval)
+        out = jfn(jnp.asarray(lk), jnp.asarray(rk), jnp.asarray(lval),
+                  jnp.asarray(rval))
+        v = np.asarray(out.valid)
+        got = sorted(zip(np.asarray(out.left_indices)[v].tolist(),
+                         np.asarray(out.right_indices)[v].tolist()))
+        assert int(out.total) == len(want)
+        assert got == want
+
+
+def test_inner_join_device_edges():
+    # capacity overflow: true total reported, slots saturate
+    out = inner_join_device(jnp.zeros(50, jnp.int64),
+                            jnp.zeros(50, jnp.int64), 64)
+    assert int(out.total) == 2500 and int(out.valid.sum()) == 64
+    # empty sides
+    out = inner_join_device(jnp.zeros(0, jnp.int64),
+                            jnp.zeros(5, jnp.int64), 16)
+    assert int(out.total) == 0 and not bool(out.valid.any())
+    # INT64_MAX keys still join (sentinel-free invalid encoding)
+    big = jnp.asarray([2**63 - 1, 1], jnp.int64)
+    out = inner_join_device(big, big, 16)
+    assert int(out.total) == 2
+    # ...but an INVALID row with INT64_MAX key does not
+    out = inner_join_device(big, big, 16,
+                            right_valid=jnp.asarray([False, True]))
+    assert int(out.total) == 1
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 devices")
+    return Mesh(np.array(devs[:8]), ("x",))
+
+
+def test_distributed_join_exact(mesh8):
+    rng = np.random.default_rng(11)
+    NL = NR = 512
+    lk = rng.integers(0, 300, NL).astype(np.int64)
+    rk = rng.integers(0, 300, NR).astype(np.int64)
+    lv = rng.integers(0, 1000, NL).astype(np.int64)
+    rv = rng.integers(0, 1000, NR).astype(np.int64)
+    step = make_distributed_join(mesh8, exch_cap=64, pair_cap=2048)
+    k, olv, orv, valid, totals, ovf = step(
+        jnp.asarray(lk), jnp.asarray(lv), jnp.asarray(rk),
+        jnp.asarray(rv))
+    assert not bool(np.asarray(ovf).any())
+    v = np.asarray(valid).reshape(-1)
+    got = sorted(zip(np.asarray(k).reshape(-1)[v].tolist(),
+                     np.asarray(olv).reshape(-1)[v].tolist(),
+                     np.asarray(orv).reshape(-1)[v].tolist()))
+    want = sorted((int(a), int(b), int(c))
+                  for a, b in zip(lk, lv)
+                  for a2, c in zip(rk, rv) if a == a2)
+    assert got == want
+
+
+def test_distributed_join_overflow_flag(mesh8):
+    rng = np.random.default_rng(12)
+    lk = rng.integers(0, 10, 256).astype(np.int64)
+    vals = np.arange(256, dtype=np.int64)
+    step = make_distributed_join(mesh8, exch_cap=2, pair_cap=8)
+    *_, ovf = step(jnp.asarray(lk), jnp.asarray(vals), jnp.asarray(lk),
+                   jnp.asarray(vals))
+    assert bool(np.asarray(ovf).any())
+
+
+def test_inner_join_device_no_int32_wrap():
+    """2^32 true pairs must not wrap the pair accounting to 0 (which
+    would silently defeat overflow detection)."""
+    n = 1 << 16
+    k = jnp.zeros(n, jnp.int64)
+    out = inner_join_device(k, k, 16)
+    assert int(out.total) == 1 << 32
+    assert int(out.valid.sum()) == 16
