@@ -18,6 +18,7 @@ from __future__ import annotations
 
 from typing import Callable, List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -168,12 +169,159 @@ def _raw_values(col: Column) -> np.ndarray:
     raise AssertionError
 
 
+# dtypes whose rank is a pure device transform (no host readback):
+# everything fixed-width except decimal128 (object-path big ints) and
+# strings (ranked by the native kernel)
+_DEVICE_RANK_KINDS = frozenset({
+    Kind.BOOL8, Kind.INT8, Kind.INT16, Kind.INT32, Kind.INT64,
+    Kind.UINT8, Kind.UINT16, Kind.UINT32, Kind.UINT64,
+    Kind.FLOAT32, Kind.FLOAT64, Kind.TIMESTAMP_DAYS,
+    Kind.TIMESTAMP_MICROS, Kind.DECIMAL32, Kind.DECIMAL64})
+
+
+def _device_rank(col: Column) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(int64 equality-rank, bool mask) computed entirely on device.
+    Ranks are injective per value (sufficient for equality joins);
+    float ranks also order correctly (total-order bit transform)."""
+    from jax import lax
+
+    kind = col.dtype.kind
+    if kind == Kind.FLOAT64:
+        r = floats.total_order_key(col.data)   # data carries raw bits
+    elif kind == Kind.FLOAT32:
+        bits = lax.bitcast_convert_type(col.data, jnp.uint32)
+        r = jnp.where(bits >> 31 != 0, ~bits,
+                      bits | jnp.uint32(1 << 31)).astype(jnp.int64)
+    else:
+        r = col.data.astype(jnp.int64)  # uint64 wraps but stays injective
+    mask = (jnp.ones(col.length, jnp.bool_) if col.validity is None
+            else jnp.asarray(col.validity).astype(jnp.bool_))
+    return r, mask
+
+
+def _joint_ids_device(rank_pairs, mask_pairs):
+    """Group ids over the concatenated left+right rank columns, all on
+    device: masks become extra key columns (sentinel-free null encoding,
+    same scheme as _key_ids), ids from lexsort + adjacent-diff."""
+    cols = []
+    for (r, m) in zip(rank_pairs, mask_pairs):
+        cols.append(m.astype(jnp.int64))
+        cols.append(jnp.where(m, r, jnp.int64(0)))
+    n = cols[0].shape[0]
+    # lexsort's LAST key is primary: arange tiebreaker first (least
+    # significant), then the key columns with cols[0] most significant
+    order = jnp.lexsort((jnp.arange(n),) + tuple(reversed(cols)))
+    diff = jnp.zeros(n, jnp.bool_)
+    for c in cols:
+        cs = c[order]
+        diff = diff.at[1:].set(diff[1:] | (cs[1:] != cs[:-1]))
+    gid_sorted = jnp.cumsum(diff.astype(jnp.int64))
+    return jnp.zeros(n, jnp.int64).at[order].set(gid_sorted)
+
+
+def _sort_merge_inner_join_device(left: Table, right: Table,
+                                  compare_nulls: str):
+    """Device fast path: ranks, joint ids, run search, and pair
+    expansion are one XLA program each; only the true pair count crosses
+    to the host (to size the output)."""
+    from spark_rapids_tpu.ops.device_join import inner_join_device
+
+    nl, nr = left.num_rows, right.num_rows
+    if nl == 0 or nr == 0 or not left.columns:
+        return (jnp.zeros(0, jnp.int32), jnp.zeros(0, jnp.int32))
+
+    lid, rid, lval, rval = _device_ids(left, right, compare_nulls)
+    total = int(_device_join_total(lid, rid, lval, rval))
+    if total == 0:
+        return (jnp.zeros(0, jnp.int32), jnp.zeros(0, jnp.int32))
+    cap = 1 << (total - 1).bit_length()   # pow2-bucketed: few recompiles
+    pairs = _device_join_pairs(lid, rid, lval, rval, cap)
+    # with capacity >= total the first `total` slots are exactly the
+    # valid pairs, in (left row, right sorted-run) order — identical to
+    # the host path's layout
+    return pairs.left_indices[:total], pairs.right_indices[:total]
+
+
+# module-level jitted helpers: jax.jit caches on function identity, so
+# these compile once per (shape, static arg) instead of once per call
+from functools import partial as _partial  # noqa: E402
+
+
+@_partial(jax.jit, static_argnames=("compare_nulls",))
+def _device_ids(left: Table, right: Table, compare_nulls: str):
+    nl, nr = left.num_rows, right.num_rows
+    ranks, masks = [], []
+    vl = jnp.ones(nl, jnp.bool_)
+    vr = jnp.ones(nr, jnp.bool_)
+    for lc, rc in zip(left.columns, right.columns):
+        lr_, lm = _device_rank(lc)
+        rr_, rm = _device_rank(rc)
+        ranks.append(jnp.concatenate([lr_, rr_]))
+        masks.append(jnp.concatenate([lm, rm]))
+        if compare_nulls == NULL_UNEQUAL:
+            vl &= lm
+            vr &= rm
+    ids = _joint_ids_device(ranks, masks)
+    return ids[:nl], ids[nl:], vl, vr
+
+
+@jax.jit
+def _device_join_total(lid, rid, lval, rval):
+    """Count-only half of inner_join_device: sort + two searchsorteds
+    (no reverse map, no pair expansion)."""
+    r_sortkey = jnp.where(rval, rid, jnp.int64(2**63 - 1))
+    rk_sorted = jnp.sort(r_sortkey)
+    n_valid_r = jnp.sum(rval.astype(jnp.int32))
+    lo = jnp.minimum(jnp.searchsorted(rk_sorted, lid, side="left"),
+                     n_valid_r)
+    hi = jnp.minimum(jnp.searchsorted(rk_sorted, lid, side="right"),
+                     n_valid_r)
+    counts = jnp.where(lval, hi - lo, 0).astype(jnp.int64)
+    return jnp.sum(counts)
+
+
+@_partial(jax.jit, static_argnames=("capacity",))
+def _device_join_pairs(lid, rid, lval, rval, capacity: int):
+    from spark_rapids_tpu.ops.device_join import inner_join_device
+
+    return inner_join_device(lid, rid, capacity, lval, rval)
+
+
 def sort_merge_inner_join(left_keys: Table, right_keys: Table,
                           compare_nulls: str = NULL_EQUAL
                           ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(left_indices, right_indices) gather maps of matching row pairs
     (join_primitives.hpp:64).  Pair order: grouped by key, row-order
-    within group."""
+    within group.  Fixed-width-only keys take a device-resident fast
+    path on accelerators (avoids shipping whole key columns across the
+    host boundary); on the CPU backend numpy's sorts win, so the host
+    path stays default there (override with
+    SPARK_RAPIDS_TPU_FORCE_DEVICE_JOIN=1)."""
+    import os
+
+    use_device = (jax.default_backend() != "cpu"
+                  or os.environ.get("SPARK_RAPIDS_TPU_FORCE_DEVICE_JOIN")
+                  == "1")
+    # both sides must be device-rankable AND per-column kinds must match
+    # (a mismatch falls through to the host path's ValueError)
+    device_ok = (
+        len(left_keys.columns) == len(right_keys.columns)
+        and all(lc.dtype.kind == rc.dtype.kind
+                and lc.dtype.kind in _DEVICE_RANK_KINDS
+                for lc, rc in zip(left_keys.columns, right_keys.columns)))
+    if use_device and device_ok:
+        return _sort_merge_inner_join_device(left_keys, right_keys,
+                                             compare_nulls)
+    return _sort_merge_inner_join_host(left_keys, right_keys,
+                                       compare_nulls)
+
+
+def _sort_merge_inner_join_host(left_keys: Table, right_keys: Table,
+                                compare_nulls: str = NULL_EQUAL
+                                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Host rank path (all dtypes incl. strings/decimal128/nested) —
+    also the executable oracle for the device path's differential
+    tests."""
     lid, rid, lval, rval = _key_ids(left_keys, right_keys, compare_nulls)
     nl = left_keys.num_rows
     # bucket right rows by id

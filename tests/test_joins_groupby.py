@@ -171,3 +171,46 @@ def test_null_vs_extreme_key_regressions():
         Table([Column.from_pylist([None], dtypes.INT64)]),
         Table([Column.from_pylist([None], dtypes.INT64)]), J.NULL_EQUAL)
     assert np.asarray(li2).shape == (1,)  # null==null under EQUAL
+
+
+def test_device_vs_host_join_differential():
+    """The fixed-width device fast path must produce byte-identical
+    (left, right) pair lists to the host rank path, across dtypes and
+    both null modes."""
+    rng = np.random.default_rng(21)
+    for trial in range(10):
+        nl, nr = rng.integers(1, 120, 2)
+        dt = [dtypes.INT64, dtypes.INT32, dtypes.FLOAT64,
+              dtypes.UINT64, dtypes.INT8][trial % 5]
+
+        def mk(n):
+            if dt.kind == "float64":
+                vals = [None if rng.random() < 0.2 else
+                        float(rng.choice([0.0, -0.0, 1.5, float("nan"),
+                                          float("inf"), -3.25]))
+                        for _ in range(n)]
+            else:
+                info = np.iinfo(dt.np_dtype)
+                vals = [None if rng.random() < 0.2 else
+                        int(rng.integers(max(info.min, -50),
+                                         min(info.max, 50)))
+                        for _ in range(n)]
+            return Column.from_pylist(vals, dt)
+
+        lk2 = Column.from_pylist(
+            [None if rng.random() < 0.2 else int(v)
+             for v in rng.integers(0, 4, nl)], dtypes.INT64)
+        rk2 = Column.from_pylist(
+            [None if rng.random() < 0.2 else int(v)
+             for v in rng.integers(0, 4, nr)], dtypes.INT64)
+        left = Table([mk(nl), lk2])
+        right = Table([mk(nr), rk2])
+        for nulls in (J.NULL_EQUAL, J.NULL_UNEQUAL):
+            li_d, ri_d = J._sort_merge_inner_join_device(left, right,
+                                                         nulls)
+            li_h, ri_h = J._sort_merge_inner_join_host(left, right,
+                                                       nulls)
+            assert np.asarray(li_d).tolist() == \
+                np.asarray(li_h).tolist(), (trial, nulls, dt.kind)
+            assert np.asarray(ri_d).tolist() == \
+                np.asarray(ri_h).tolist(), (trial, nulls, dt.kind)
