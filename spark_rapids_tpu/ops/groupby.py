@@ -35,9 +35,39 @@ MAX = "max"
 MEAN = "mean"
 
 
-def _group_ids(keys: Table) -> Tuple[jnp.ndarray, np.ndarray, int]:
-    """(per-row group id (device), first-row index per group (host),
-    num_groups).  Nulls group together (Spark GROUP BY semantics)."""
+@jax.jit
+def _device_group_ids_jit(keys: Table):
+    """Device group ids for fixed-width keys (shared lexsort/diff core
+    with the device join, joins._sorted_gid_core); first-occurrence
+    index per group via segment_min.  Returns (ids int32, first_full
+    (n,) int64 — slice [:ngroups] on the host, ngroups scalar)."""
+    from spark_rapids_tpu.ops.joins import (
+        _device_null_keyed_cols, _device_rank, _sorted_gid_core)
+
+    n = keys.num_rows
+    ranks, masks = [], []
+    for c in keys.columns:
+        rank, mask = _device_rank(c)
+        ranks.append(rank)
+        masks.append(mask)
+    cols = _device_null_keyed_cols(ranks, masks)
+    order, gid_sorted = _sorted_gid_core(cols)
+    ids = jnp.zeros(n, jnp.int64).at[order].set(gid_sorted)
+    first_full = jax.ops.segment_min(jnp.arange(n, dtype=jnp.int64),
+                                     ids, num_segments=n)
+    return ids.astype(jnp.int32), first_full, gid_sorted[-1] + 1
+
+
+def _group_ids_device(keys: Table):
+    """Device branch of _group_ids (same return contract)."""
+    ids, first_full, ng = _device_group_ids_jit(keys)
+    ngroups = int(ng)
+    return ids, first_full[:ngroups], ngroups
+
+
+def _group_ids_host(keys: Table):
+    """Host rank branch of _group_ids (all dtypes; also the executable
+    oracle for the device branch's differential test)."""
     cols = []
     for c in keys.columns:
         rank, mask = _column_rank_host(c)
@@ -45,11 +75,31 @@ def _group_ids(keys: Table) -> Tuple[jnp.ndarray, np.ndarray, int]:
         # a legal rank (e.g. -1 or INT64_MIN keys)
         cols.append(mask.astype(np.int64))
         cols.append(np.where(mask, rank, np.int64(0)))
-    if not cols:
-        return (jnp.zeros(keys.num_rows, np.int32),
-                np.zeros(0, np.int64), 0)
     ids, first_idx, ngroups = group_ids_from_ranks(cols)
     return jnp.asarray(ids.astype(np.int32)), first_idx, ngroups
+
+
+def _group_ids(keys: Table) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
+    """(per-row group id (device), first-row index per group (host or
+    device array), num_groups).  Nulls group together (Spark GROUP BY
+    semantics).  Fixed-width keys compute ids on device on accelerator
+    backends (only the group count crosses to the host);
+    strings/decimal128 and the CPU backend use the host rank path."""
+    import os
+
+    from spark_rapids_tpu.ops.joins import _DEVICE_RANK_KINDS
+
+    if not keys.columns:
+        return (jnp.zeros(keys.num_rows, np.int32),
+                np.zeros(0, np.int64), 0)
+    use_device = (jax.default_backend() != "cpu"
+                  or os.environ.get(
+                      "SPARK_RAPIDS_TPU_FORCE_DEVICE_GROUPBY") == "1")
+    if (use_device and keys.num_rows > 0
+            and all(c.dtype.kind in _DEVICE_RANK_KINDS
+                    for c in keys.columns)):
+        return _group_ids_device(keys)
+    return _group_ids_host(keys)
 
 
 def _value_f64(col: Column) -> jnp.ndarray:

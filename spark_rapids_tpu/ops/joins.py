@@ -199,14 +199,21 @@ def _device_rank(col: Column) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return r, mask
 
 
-def _joint_ids_device(rank_pairs, mask_pairs):
-    """Group ids over the concatenated left+right rank columns, all on
-    device: masks become extra key columns (sentinel-free null encoding,
-    same scheme as _key_ids), ids from lexsort + adjacent-diff."""
+def _device_null_keyed_cols(rank_pairs, mask_pairs):
+    """Interleave (mask, zeroed-rank) key columns — the sentinel-free
+    null encoding shared by joins and group-by (a sentinel value would
+    collide with legal ranks like INT64_MIN)."""
     cols = []
     for (r, m) in zip(rank_pairs, mask_pairs):
         cols.append(m.astype(jnp.int64))
         cols.append(jnp.where(m, r, jnp.int64(0)))
+    return cols
+
+
+def _sorted_gid_core(cols):
+    """(order, gid_sorted): stable lexsort over the key columns plus
+    adjacent-diff group numbering.  Shared device core for join key ids
+    and group-by ids."""
     n = cols[0].shape[0]
     # lexsort's LAST key is primary: arange tiebreaker first (least
     # significant), then the key columns with cols[0] most significant
@@ -216,6 +223,15 @@ def _joint_ids_device(rank_pairs, mask_pairs):
         cs = c[order]
         diff = diff.at[1:].set(diff[1:] | (cs[1:] != cs[:-1]))
     gid_sorted = jnp.cumsum(diff.astype(jnp.int64))
+    return order, gid_sorted
+
+
+def _joint_ids_device(rank_pairs, mask_pairs):
+    """Group ids over the concatenated left+right rank columns, all on
+    device (same null encoding as the host _key_ids)."""
+    cols = _device_null_keyed_cols(rank_pairs, mask_pairs)
+    order, gid_sorted = _sorted_gid_core(cols)
+    n = cols[0].shape[0]
     return jnp.zeros(n, jnp.int64).at[order].set(gid_sorted)
 
 

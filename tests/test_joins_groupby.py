@@ -214,3 +214,35 @@ def test_device_vs_host_join_differential():
                 np.asarray(li_h).tolist(), (trial, nulls, dt.kind)
             assert np.asarray(ri_d).tolist() == \
                 np.asarray(ri_h).tolist(), (trial, nulls, dt.kind)
+
+
+def test_device_vs_host_groupby_differential(monkeypatch):
+    """Device group ids must produce identical groupby_aggregate output
+    to the host rank path."""
+    from spark_rapids_tpu.ops import groupby as G
+
+    rng = np.random.default_rng(31)
+    for trial in range(6):
+        n = int(rng.integers(1, 200))
+        kc = Column.from_pylist(
+            [None if rng.random() < 0.2 else
+             float(rng.choice([0.0, -0.0, 2.5, float("nan")]))
+             for _ in range(n)], dtypes.FLOAT64)
+        kc2 = Column.from_pylist(
+            [None if rng.random() < 0.2 else int(v)
+             for v in rng.integers(-3, 3, n)], dtypes.INT64)
+        vals = Column.from_pylist(
+            [None if rng.random() < 0.1 else float(v)
+             for v in rng.random(n)], dtypes.FLOAT64)
+        keys = Table([kc, kc2])
+        # select each branch explicitly: the env/backend gate would make
+        # this comparison vacuous on accelerator backends
+        monkeypatch.setattr(G, "_group_ids", G._group_ids_host)
+        host = G.groupby_aggregate(keys, [vals, vals], ["sum", "count"])
+        monkeypatch.setattr(G, "_group_ids", G._group_ids_device)
+        dev = G.groupby_aggregate(keys, [vals, vals], ["sum", "count"])
+        def norm(vs):
+            return [repr(v) for v in vs]   # NaN-aware equality
+
+        for hcol, dcol in zip(host.columns, dev.columns):
+            assert norm(hcol.to_pylist()) == norm(dcol.to_pylist()), trial
