@@ -26,6 +26,7 @@ gather keyed on searchsorted(row_offsets) — vectorized, no per-row loops.
 
 from __future__ import annotations
 
+import os
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -152,30 +153,54 @@ def _validity_byte_vector(cols: Sequence[Column], b: int) -> jnp.ndarray:
     return byte
 
 
-def _column_word_contribs(col: Column, start: int):
-    """[(word_index, (rows,) uint32 contribution)] for a fixed-width column
-    at byte offset `start` in the row."""
-    kind = col.dtype.kind
-    d = col.data
-    w = start // 4
-    if kind == Kind.FLOAT32:
-        return [(w, lax.bitcast_convert_type(d, _U32))]
-    if kind == Kind.DECIMAL128:
-        u = d.astype(_U32)
-        return [(w + k, u[:, k]) for k in range(4)]
-    size = _col_byte_size(col.dtype)
-    if size == 8:
-        u = d.astype(_U64) if kind == Kind.FLOAT64 else \
-            d.astype(jnp.int64).astype(_U64)
-        return [(w, (u & _U64(0xFFFFFFFF)).astype(_U32)),
-                (w + 1, (u >> _U64(32)).astype(_U32))]
-    if size == 4:
-        return [(w, d.astype(_I32).astype(_U32))]
-    # 1- or 2-byte value, possibly sharing its word with neighbors
-    shift = (start % 4) * 8
-    mask = (1 << (8 * size)) - 1
-    u = (d.astype(_I32).astype(_U32) & _U32(mask)) << _U32(shift)
-    return [(w, u)]
+def build_plan(cols: Sequence[Column], starts: Sequence[int],
+               validity_offset: int, n_words: int):
+    """(inputs, plan): one (rows,) array per word contribution in its
+    native width (u8/u16/u32; 8-byte columns split into u32 lo/hi —
+    (rows, 2) u32 bitcasts are not tile-safe on this backend, see
+    docs/tpu_design.md §2), and the (word_index, left_shift_bits) each
+    lands at.  THE single source of the JCUDF word layout: consumed by
+    the default stack assembly below and by the Pallas tile kernel
+    (ops/row_assembly_pallas.py)."""
+    inputs = []
+    plan = []
+
+    def add(arr, word, shift=0):
+        inputs.append(arr)
+        plan.append((word, shift))
+
+    for c, st in zip(cols, starts):
+        kind = c.dtype.kind
+        w = st // 4
+        d = c.data
+        if kind == Kind.FLOAT32:
+            add(lax.bitcast_convert_type(d, _U32), w)
+        elif kind == Kind.DECIMAL128:
+            u = lax.bitcast_convert_type(d, _U32)
+            for k in range(4):
+                add(u[:, k], w + k)
+        elif _col_byte_size(c.dtype) == 8:
+            u = (d if d.dtype == jnp.uint64
+                 else d.astype(jnp.int64).astype(_U64))
+            add((u & _U64(0xFFFFFFFF)).astype(_U32), w)
+            add((u >> _U64(32)).astype(_U32), w + 1)
+        elif _col_byte_size(c.dtype) == 4:
+            add(lax.bitcast_convert_type(d.astype(_I32), _U32), w)
+        else:
+            size = _col_byte_size(c.dtype)
+            native = jnp.uint8 if size == 1 else jnp.uint16
+            src = (d if d.dtype == native
+                   else lax.bitcast_convert_type(
+                       d.astype(jnp.int16 if size == 2 else jnp.int8),
+                       native))
+            add(src, w, (st % 4) * 8)
+
+    for b in range((len(cols) + 7) // 8):
+        off = validity_offset + b
+        add(_validity_byte_vector(cols, b), off // 4, (off % 4) * 8)
+
+    assert all(w < n_words for w, _ in plan)
+    return inputs, plan
 
 
 def _assemble_fixed_words(cols, starts, validity_offset,
@@ -183,19 +208,19 @@ def _assemble_fixed_words(cols, starts, validity_offset,
     """Word-oriented row assembly: compose each 4-byte word of the row
     from (rows,) u32 vectors (full-lane friendly) and stack them into the
     (rows, W) matrix.  Avoids the 16x lane padding of narrow (rows, k)
-    uint8 pieces; measured equivalent to stack(axis=0)+transpose (~59
-    GB/s of output on one v5e chip); a single-pass Pallas assembly kernel
-    is the known next lever.  Returns flat packed u32 LE words."""
+    uint8 pieces; measured ~59 GB/s of output on one v5e chip.  The
+    single-pass Pallas tile kernel (row_assembly_pallas.py, env opt-in
+    in convert_to_rows) consumes the same build_plan.  Returns flat
+    packed u32 LE words."""
     rows = cols[0].length
     n_words = row_size // 4
+    inputs, plan = build_plan(cols, starts, validity_offset, n_words)
     contribs = {}
-    for c, st in zip(cols, starts):
-        for w, u in _column_word_contribs(c, st):
-            contribs.setdefault(w, []).append(u)
-    for b in range((len(cols) + 7) // 8):
-        off = validity_offset + b
-        u = _validity_byte_vector(cols, b).astype(_U32) << _U32((off % 4) * 8)
-        contribs.setdefault(off // 4, []).append(u)
+    for arr, (w, sh) in zip(inputs, plan):
+        u = arr if arr.dtype == _U32 else arr.astype(_U32)
+        if sh:
+            u = u << _U32(sh)
+        contribs.setdefault(w, []).append(u)
     zeros = None
     words = []
     for w in range(n_words):
@@ -225,7 +250,17 @@ def convert_to_rows(table: Table) -> Column:
     str_cols = [c for c in cols if c.dtype.is_string]
     if not str_cols:
         row_size = _round_up(fixed_size, JCUDF_ROW_ALIGNMENT)
-        data = _assemble_fixed_words(cols, starts, validity_offset, row_size)
+        if os.environ.get("SPARK_RAPIDS_TPU_PALLAS_ROWCONV") == "1":
+            # single-pass Pallas tile kernel (opt-in until profiled on
+            # real hardware); interpret mode on the CPU backend
+            from spark_rapids_tpu.ops.row_assembly_pallas import \
+                assemble_fixed_words_pallas
+            data = assemble_fixed_words_pallas(
+                cols, starts, validity_offset, row_size,
+                interpret=jax.default_backend() == "cpu")
+        else:
+            data = _assemble_fixed_words(cols, starts, validity_offset,
+                                         row_size)
         offsets = jnp.arange(rows + 1, dtype=_I32) * _I32(row_size)
         return Column.make_list_from_parts(offsets, data,
                                            nbytes=rows * row_size)
