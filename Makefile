@@ -1,0 +1,34 @@
+# Build/test/bench entry points (counterpart of the reference's maven
+# reactor + build/buildcpp.sh + ci/ scripts, SURVEY.md §2.5).
+
+PY ?= python
+
+.PHONY: test fuzz native bench bench-all dryrun clean
+
+test:
+	$(PY) -m pytest tests/ -q
+
+fuzz:
+	bash scripts/fuzz_test.sh
+
+# native C++ kernels (also built on-demand at import; this forces it)
+native:
+	bash native/build.sh
+
+# one JSON line on the TPU chip (CPU fallback if the relay is down)
+bench:
+	$(PY) bench.py
+
+bench-all:
+	$(PY) bench_all.py
+
+# NOTE: jax.config.update, not the env var — this image's sitecustomize
+# pre-imports jax with the axon backend, so JAX_PLATFORMS=cpu is too late
+dryrun:
+	$(PY) -c "import jax; \
+	jax.config.update('jax_platforms', 'cpu'); \
+	jax.config.update('jax_num_cpu_devices', 8); \
+	import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"
+
+clean:
+	rm -rf native/build __pycache__ spark_rapids_tpu/**/__pycache__
