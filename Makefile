@@ -31,4 +31,5 @@ dryrun:
 	import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"
 
 clean:
-	rm -rf native/build __pycache__ spark_rapids_tpu/**/__pycache__
+	rm -rf native/build
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -1,0 +1,285 @@
+"""Device decimal128 arithmetic on (rows, 4) int32 limb columns.
+
+The exact host big-int path (ops/decimal_utils.py) is the semantic
+reference (reference decimal_utils.cu dec128_multiplier/dec128_adder);
+this module runs the same math as vectorized 32-bit limb arithmetic so
+large columns never leave the device:
+
+- products via 4x4 schoolbook partial products accumulated in uint64
+  (a 256-bit intermediate, like the reference's __int128 chunks);
+- rescaling by 10^k as k vectorized divmod-by-10 sweeps (k is static —
+  scales are column metadata — so the sweep unrolls at trace time);
+- HALF_UP decided by the most significant dropped digit, identical to
+  _div_round_half_up;
+- overflow = |result| > 10^38-1, reported per row exactly like the host
+  path's overflow column.
+
+All helpers operate on uint32 limb matrices little-endian (limb 0 =
+least significant), rows vectorized, and are jit-safe.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.columns.dtypes import Kind
+
+_U32 = jnp.uint32
+_U64 = jnp.uint64
+_MASK32 = jnp.uint64(0xFFFFFFFF)
+
+MAX_38 = 10**38 - 1
+_MAX38_LIMBS = tuple((MAX_38 >> (32 * k)) & 0xFFFFFFFF for k in range(4))
+
+
+def _mag_sign(limbs_i32: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(rows,4) int32 two's-complement -> ((rows,4) u32 magnitude,
+    (rows,) bool negative)."""
+    x = limbs_i32
+    neg = x[:, 3] < 0
+    u = jax.lax.bitcast_convert_type(x, _U32)
+    flipped = jnp.where(neg[:, None], ~u, u)
+    # +1 with ripple carry for the negate
+    carry = neg.astype(_U64)
+    out = []
+    for k in range(4):
+        t = flipped[:, k].astype(_U64) + carry
+        out.append((t & _MASK32).astype(_U32))
+        carry = t >> jnp.uint64(32)
+    return jnp.stack(out, axis=1), neg
+
+
+def _apply_sign(mag4: jnp.ndarray, neg: jnp.ndarray) -> jnp.ndarray:
+    """(rows,4) u32 magnitude + sign -> (rows,4) int32 two's complement."""
+    flipped = jnp.where(neg[:, None], ~mag4, mag4)
+    carry = neg.astype(_U64)
+    out = []
+    for k in range(4):
+        t = flipped[:, k].astype(_U64) + carry
+        out.append((t & _MASK32).astype(_U32))
+        carry = t >> jnp.uint64(32)
+    return jax.lax.bitcast_convert_type(jnp.stack(out, axis=1),
+                                        jnp.int32)
+
+
+def _mul_4x4(a4: jnp.ndarray, b4: jnp.ndarray) -> jnp.ndarray:
+    """(rows,4) u32 x (rows,4) u32 -> (rows,8) u32 full 256-bit product
+    (schoolbook partial products in u64; max term 2^64-1 exactly)."""
+    rows = a4.shape[0]
+    acc = [jnp.zeros(rows, _U64) for _ in range(8)]
+    for i in range(4):
+        carry = jnp.zeros(rows, _U64)
+        ai = a4[:, i].astype(_U64)
+        for j in range(4):
+            t = acc[i + j] + ai * b4[:, j].astype(_U64) + carry
+            acc[i + j] = t & _MASK32
+            carry = t >> jnp.uint64(32)
+        acc[i + 4] = acc[i + 4] + carry
+    return jnp.stack([a.astype(_U32) for a in acc], axis=1)
+
+
+def _divmod10(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(rows,L) u32 // 10 with remainder, one high-to-low sweep
+    (each step value < 10*2^32, fits u64)."""
+    L = x.shape[1]
+    r = jnp.zeros(x.shape[0], _U64)
+    q = [None] * L
+    for k in range(L - 1, -1, -1):
+        cur = (r << jnp.uint64(32)) | x[:, k].astype(_U64)
+        q[k] = (cur // jnp.uint64(10)).astype(_U32)
+        r = cur % jnp.uint64(10)
+    return jnp.stack(q, axis=1), r
+
+
+def _mul10(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(rows,L) u32 * 10; returns (product, overflowed-beyond-L-limbs)."""
+    L = x.shape[1]
+    carry = jnp.zeros(x.shape[0], _U64)
+    out = []
+    for k in range(L):
+        t = x[:, k].astype(_U64) * jnp.uint64(10) + carry
+        out.append((t & _MASK32).astype(_U32))
+        carry = t >> jnp.uint64(32)
+    return jnp.stack(out, axis=1), carry != 0
+
+
+def _add_one(x: jnp.ndarray, inc: jnp.ndarray) -> jnp.ndarray:
+    """x + inc (inc bool per row), ripple carry."""
+    carry = inc.astype(_U64)
+    out = []
+    for k in range(x.shape[1]):
+        t = x[:, k].astype(_U64) + carry
+        out.append((t & _MASK32).astype(_U32))
+        carry = t >> jnp.uint64(32)
+    return jnp.stack(out, axis=1)
+
+
+def _rescale_down(x: jnp.ndarray, k: int) -> jnp.ndarray:
+    """x // 10^k with HALF_UP (k static; the most significant dropped
+    digit alone decides the rounding, as in _div_round_half_up)."""
+    if k <= 0:
+        return x
+    for _ in range(k - 1):
+        x, _ = _divmod10(x)
+    x, r = _divmod10(x)
+    return _add_one(x, r >= 5)
+
+
+def _scale_up(x: jnp.ndarray, k: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """x * 10^k (k static); returns (result, overflowed)."""
+    ovf = jnp.zeros(x.shape[0], jnp.bool_)
+    for _ in range(k):
+        x, o = _mul10(x)
+        ovf = ovf | o
+    return x, ovf
+
+
+def _exceeds_max38(x: jnp.ndarray) -> jnp.ndarray:
+    """(rows,L) u32 magnitude > 10^38-1 (per row)."""
+    high_nonzero = jnp.zeros(x.shape[0], jnp.bool_)
+    for k in range(4, x.shape[1]):
+        high_nonzero = high_nonzero | (x[:, k] != 0)
+    # lexicographic compare of the low 4 limbs against MAX_38
+    gt = jnp.zeros(x.shape[0], jnp.bool_)
+    eq = jnp.ones(x.shape[0], jnp.bool_)
+    for k in range(3, -1, -1):
+        lim = _U32(_MAX38_LIMBS[k])
+        gt = gt | (eq & (x[:, k] > lim))
+        eq = eq & (x[:, k] == lim)
+    return high_nonzero | gt
+
+
+def _widen(x4: jnp.ndarray, limbs: int) -> jnp.ndarray:
+    pad = jnp.zeros((x4.shape[0], limbs - x4.shape[1]), _U32)
+    return jnp.concatenate([x4, pad], axis=1)
+
+
+@partial(jax.jit, static_argnames=("a_scale", "b_scale", "product_scale"))
+def _multiply_core(a_limbs, b_limbs, a_scale: int, b_scale: int,
+                   product_scale: int):
+    amag, aneg = _mag_sign(a_limbs)
+    bmag, bneg = _mag_sign(b_limbs)
+    p = _mul_4x4(amag, bmag)                       # (rows, 8)
+    neg = aneg ^ bneg
+    exponent = product_scale - (a_scale + b_scale)
+    ovf = jnp.zeros(p.shape[0], jnp.bool_)
+    if exponent > 0:
+        p = _rescale_down(p, exponent)
+    elif exponent < 0:
+        if exponent <= -38:
+            # host-path parity: the precision pre-check flags even a
+            # ZERO product when precision10(0)=1 minus exponent exceeds
+            # 38 (decimal_utils.multiply_decimal128); the magnitude
+            # check below can never catch 0 * 10^k
+            is_zero = jnp.ones(p.shape[0], jnp.bool_)
+            for k in range(p.shape[1]):
+                is_zero = is_zero & (p[:, k] == 0)
+            ovf = ovf | is_zero
+        p, o = _scale_up(p, -exponent)
+        ovf = ovf | o
+    ovf = ovf | _exceeds_max38(p)
+    return ovf, _apply_sign(p[:, :4], neg)
+
+
+@partial(jax.jit, static_argnames=("a_scale", "b_scale", "out_scale",
+                                   "sub"))
+def _add_sub_core(a_limbs, b_limbs, a_scale: int, b_scale: int,
+                  out_scale: int, sub: bool):
+    s = min(a_scale, b_scale)
+    amag, aneg = _mag_sign(a_limbs)
+    bmag, bneg = _mag_sign(b_limbs)
+    if sub:
+        bneg = ~bneg
+    # limb budget sized to the STATIC upscale so a legitimately-huge
+    # intermediate (big scale gap, later divided back down) stays exact:
+    # 10^k < 2^(4k), plus one limb of headroom for the add
+    max_shift = max(a_scale - s, b_scale - s)
+    wide = 4 + (max_shift * 4 + 31) // 32 + 1
+    x, oa = _scale_up(_widen(amag, wide), a_scale - s)
+    y, ob = _scale_up(_widen(bmag, wide), b_scale - s)
+    x7 = _apply_sign_wide(x, aneg)
+    y7 = _apply_sign_wide(y, bneg)
+    v = _add_wide(x7, y7)
+    vneg = (jax.lax.bitcast_convert_type(v[:, -1:], jnp.int32)
+            [:, 0] < 0)
+    vmag = _negate_if(v, vneg)
+    shift = out_scale - s
+    ovf = oa | ob
+    if shift < 0:
+        vmag, o = _scale_up(vmag, -shift)
+        ovf = ovf | o
+    elif shift > 0:
+        vmag = _rescale_down(vmag, shift)
+    ovf = ovf | _exceeds_max38(vmag)
+    return ovf, _apply_sign(vmag[:, :4], vneg)
+
+
+def _apply_sign_wide(mag: jnp.ndarray, neg: jnp.ndarray) -> jnp.ndarray:
+    flipped = jnp.where(neg[:, None], ~mag, mag)
+    return _add_one(flipped, neg)
+
+
+def _negate_if(x: jnp.ndarray, neg: jnp.ndarray) -> jnp.ndarray:
+    flipped = jnp.where(neg[:, None], ~x, x)
+    return _add_one(flipped, neg)
+
+
+def _add_wide(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    carry = jnp.zeros(x.shape[0], _U64)
+    out = []
+    for k in range(x.shape[1]):
+        t = x[:, k].astype(_U64) + y[:, k].astype(_U64) + carry
+        out.append((t & _MASK32).astype(_U32))
+        carry = t >> jnp.uint64(32)
+    return jnp.stack(out, axis=1)
+
+
+# ------------------------------------------------------- Column wrappers
+
+def _check(a: Column, b: Column):
+    if (a.dtype.kind != Kind.DECIMAL128
+            or b.dtype.kind != Kind.DECIMAL128):
+        raise ValueError("decimal128 columns required")
+    if a.length != b.length:
+        raise ValueError("length mismatch")
+
+
+def _wrap(ovf, limbs, a: Column, b: Column, out_scale: int):
+    from spark_rapids_tpu.ops.arithmetic import _combined_validity
+
+    mask = _combined_validity(a, b)  # device-side; None = all valid
+    ovf_col = Column(dtypes.BOOL8, a.length,
+                     data=ovf.astype(jnp.uint8), validity=mask)
+    out = Column(dtypes.decimal128(out_scale), a.length, data=limbs,
+                 validity=mask)
+    return ovf_col, out
+
+
+def multiply128_device(a: Column, b: Column, product_scale: int):
+    """Device counterpart of decimal_utils.multiply_decimal128 (without
+    the SPARK-40129 interim cast — the host path covers that legacy
+    mode)."""
+    _check(a, b)
+    ovf, limbs = _multiply_core(a.data, b.data, a.dtype.scale,
+                                b.dtype.scale, product_scale)
+    return _wrap(ovf, limbs, a, b, product_scale)
+
+
+def add128_device(a: Column, b: Column, out_scale: int):
+    _check(a, b)
+    ovf, limbs = _add_sub_core(a.data, b.data, a.dtype.scale,
+                               b.dtype.scale, out_scale, False)
+    return _wrap(ovf, limbs, a, b, out_scale)
+
+
+def sub128_device(a: Column, b: Column, out_scale: int):
+    _check(a, b)
+    ovf, limbs = _add_sub_core(a.data, b.data, a.dtype.scale,
+                               b.dtype.scale, out_scale, True)
+    return _wrap(ovf, limbs, a, b, out_scale)

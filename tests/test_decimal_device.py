@@ -1,0 +1,93 @@
+"""Device decimal128 limb kernels vs the exact host big-int path
+(ops/decimal_device.py vs ops/decimal_utils.py)."""
+
+import random
+
+import pytest
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.ops import decimal_device as DD
+from spark_rapids_tpu.ops import decimal_utils as DU
+
+
+def _mkcol(rng, n, scale, max_bytes=15):
+    vals = []
+    for _ in range(n):
+        if rng.random() < 0.1:
+            vals.append(None)
+        else:
+            vals.append(int.from_bytes(
+                rng.randbytes(rng.randint(1, max_bytes)),
+                "little", signed=True))
+    return Column.from_pylist(vals, dtypes.decimal128(scale))
+
+
+def _assert_same(host, dev):
+    ho, hr = host
+    do, dr = dev
+    assert ho.to_pylist() == do.to_pylist()       # overflow flags
+    for x, y, o in zip(hr.to_pylist(), dr.to_pylist(), ho.to_pylist()):
+        if o is True:
+            continue  # overflow rows carry unspecified values
+        assert x == y
+
+
+@pytest.mark.parametrize("sa,sb,ps", [
+    (-2, -3, -4), (0, 0, 0), (-10, 10, -2), (-38, 0, -38), (3, -5, 1),
+])
+def test_device_decimal_matches_host(sa, sb, ps):
+    rng = random.Random(sa * 100 + sb * 10 + ps)
+    a = _mkcol(rng, 200, sa, max_bytes=8)
+    b = _mkcol(rng, 200, sb, max_bytes=8)
+    _assert_same(DU.multiply_decimal128(a, b, ps),
+                 DD.multiply128_device(a, b, ps))
+    _assert_same(DU.add_decimal128(a, b, ps), DD.add128_device(a, b, ps))
+    _assert_same(DU.sub_decimal128(a, b, ps), DD.sub128_device(a, b, ps))
+
+
+def test_device_decimal_full_range_and_edges():
+    rng = random.Random(7)
+    a = _mkcol(rng, 300, -2)
+    b = _mkcol(rng, 300, -2)
+    _assert_same(DU.multiply_decimal128(a, b, -2),
+                 DD.multiply128_device(a, b, -2))
+    _assert_same(DU.add_decimal128(a, b, -2), DD.add128_device(a, b, -2))
+    _assert_same(DU.sub_decimal128(a, b, -2), DD.sub128_device(a, b, -2))
+    # explicit edges: MAX_38 boundary, INT128_MIN-adjacent, zeros, -1
+    edge = Column.from_pylist(
+        [10**38 - 1, -(10**38 - 1), 0, -1, 1, -(2**126)],
+        dtypes.decimal128(0))
+    one = Column.from_pylist([1, 1, 1, 1, 1, 1], dtypes.decimal128(0))
+    _assert_same(DU.multiply_decimal128(edge, one, 0),
+                 DD.multiply128_device(edge, one, 0))
+    _assert_same(DU.add_decimal128(edge, one, 0),
+                 DD.add128_device(edge, one, 0))
+    # HALF_UP at the .5 boundary both signs
+    h = Column.from_pylist([5, -5, 15, -15, 4, -4], dtypes.decimal128(-1))
+    oneh = Column.from_pylist([10] * 6, dtypes.decimal128(-1))
+    _assert_same(DU.multiply_decimal128(h, oneh, 0),
+                 DD.multiply128_device(h, oneh, 0))
+
+
+def test_device_decimal_type_errors():
+    a = Column.from_pylist([1], dtypes.INT64)
+    d = Column.from_pylist([1], dtypes.decimal128(0))
+    with pytest.raises(ValueError):
+        DD.multiply128_device(a, d, 0)
+    with pytest.raises(ValueError):
+        DD.add128_device(d, Column.from_pylist([1, 2],
+                                               dtypes.decimal128(0)), 0)
+
+
+def test_device_decimal_zero_deep_negative_exponent():
+    """Host-parity regression: 0 * 10^38 is flagged as overflow by the
+    host precision pre-check even though the magnitude stays 0."""
+    a = Column.from_pylist([0, 1, 5], dtypes.decimal128(0))
+    b = Column.from_pylist([1, 1, 1], dtypes.decimal128(0))
+    ho, _ = DU.multiply_decimal128(a, b, -38)
+    do, _ = DD.multiply128_device(a, b, -38)
+    assert ho.to_pylist() == do.to_pylist() == [True, True, True]
+    # no-validity inputs keep validity None (codebase convention)
+    _, out = DD.multiply128_device(a, b, 0)
+    assert out.validity is None
