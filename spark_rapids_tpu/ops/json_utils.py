@@ -76,30 +76,9 @@ def from_json_to_structs(col: Column,
     (JSONUtils.fromJSONToStructs:188; schema as parallel vectors in the
     reference json_utils.hpp:10-23).  Missing/mistyped fields are null;
     invalid rows null the whole struct."""
-    assert col.dtype.is_string
-    rows = col.length
-    extracted: List[List[Optional[str]]] = [[] for _ in fields]
-    validity = np.zeros(rows, np.uint8)
-    for i, tree in enumerate(_parse_rows(col)):
-        if tree is None or tree[0] != "obj":
-            for lst in extracted:
-                lst.append(None)
-            continue
-        obj = dict(tree[1])
-        validity[i] = 1
-        for (name, _dt), lst in zip(fields, extracted):
-            v = obj.get(name)
-            if v is None or v == ("lit", "null"):
-                lst.append(None)
-            else:
-                lst.append(_value_as_raw_string(v))
-    children = []
-    for (name, dt), raw in zip(fields, extracted):
-        scol = Column.from_strings(raw)
-        children.append(convert_from_strings(scol, dt))
-    return Column.make_struct(rows, children,
-                              validity=None if validity.all()
-                              else validity)
+    # a flat schema is just a one-level nested schema: delegate so the
+    # null/leniency rules live in exactly one place
+    return from_json_to_structs_nested(col, ("struct", list(fields)))
 
 
 def convert_from_strings(col: Column, dtype: DType) -> Column:
@@ -157,3 +136,60 @@ def concat_json(col: Column) -> Tuple[bytes, str, Column]:
     buffer = (delim.join(parts) + delim).encode()
     return buffer, delim, Column(dtypes.BOOL8, col.length,
                                  data=jnp.asarray(valid))
+
+
+# ----------------------------------------- nested from_json schemas
+
+def _build_json_column(values, spec) -> Column:
+    """Recursive column builder from parsed JSON value trees.
+
+    spec: a leaf DType, ("struct", [(name, spec), ...]), or
+    ("list", spec) — mirroring the reference's nested schema vectors
+    (json_utils.hpp:10-23, JSONUtils.fromJSONToStructs).  Mistyped
+    values null the row at that level (Spark from_json leniency)."""
+    if isinstance(spec, DType):
+        raw = [None if v is None or v == ("lit", "null")
+               else _value_as_raw_string(v) for v in values]
+        return convert_from_strings(Column.from_strings(raw), spec)
+    tag, arg = spec
+    n = len(values)
+    if tag == "struct":
+        validity = np.array(
+            [v is not None and v[0] == "obj" for v in values], np.uint8)
+        # one dict per row (duplicate keys: last wins), not per field
+        dicts = [dict(v[1]) if v is not None and v[0] == "obj" else None
+                 for v in values]
+        children = []
+        for name, child_spec in arg:
+            sub = []
+            for d in dicts:
+                got = None if d is None else d.get(name)
+                sub.append(None if got == ("lit", "null") else got)
+            children.append(_build_json_column(sub, child_spec))
+        return Column.make_struct(n, children,
+                                  validity=None if validity.all()
+                                  else validity)
+    if tag == "list":
+        validity = np.array(
+            [v is not None and v[0] == "arr" for v in values], np.uint8)
+        offs = np.zeros(n + 1, np.int32)
+        flat = []
+        for i, v in enumerate(values):
+            if validity[i]:
+                flat.extend(None if it == ("lit", "null") else it
+                            for it in v[1])
+            offs[i + 1] = len(flat)
+        return Column.make_list(offs, _build_json_column(flat, arg),
+                                validity=None if validity.all()
+                                else validity)
+    raise ValueError(f"unknown schema node {tag!r}")
+
+
+def from_json_to_structs_nested(col: Column, schema) -> Column:
+    """JSON rows -> arbitrarily nested STRUCT/LIST column
+    (JSONUtils.fromJSONToStructs:188 with a nested Schema).  `schema`
+    must be a ("struct", ...) node; invalid JSON rows are null."""
+    assert col.dtype.is_string
+    if not (isinstance(schema, tuple) and schema[0] == "struct"):
+        raise ValueError("top-level schema must be a struct")
+    return _build_json_column(list(_parse_rows(col)), schema)

@@ -238,3 +238,49 @@ def test_map_zip_full_key_union():
     # duplicate key inside one map: last value wins (row 4: d->2)
     assert pair.children[0].to_pylist() == [1, 2, None, 5, None, 2]
     assert pair.children[1].to_pylist() == [None, 20, 30, None, 7, 3]
+
+
+def test_from_json_to_structs_nested():
+    """Nested schema: struct{a: int, b: struct{x: string, y: float},
+    c: list<int>, d: list<struct{k: int}>}."""
+    rows = [
+        '{"a": 1, "b": {"x": "hi", "y": 2.5}, "c": [1,2,3],'
+        ' "d": [{"k": 7}, {"k": 8}]}',
+        '{"a": 2, "b": null, "c": [], "d": null}',
+        '{"b": {"x": null, "y": "nope"}, "c": [4, null]}',
+        'not json',
+        None,
+        '[1,2]',                      # top-level not an object -> null
+        '{"a": "5", "c": "notalist", "d": [{"z": 1}, 3]}',
+    ]
+    schema = ("struct", [
+        ("a", dtypes.INT64),
+        ("b", ("struct", [("x", dtypes.STRING), ("y", dtypes.FLOAT64)])),
+        ("c", ("list", dtypes.INT64)),
+        ("d", ("list", ("struct", [("k", dtypes.INT32)]))),
+    ])
+    out = json_utils.from_json_to_structs_nested(
+        Column.from_strings(rows), schema)
+    assert np.asarray(out.validity).tolist() == [1, 1, 1, 0, 0, 0, 1]
+    a, b, c, d = out.children
+    assert a.to_pylist() == [1, 2, None, None, None, None, 5]
+    bx, by = b.children
+    assert bx.to_pylist()[:3] == ["hi", None, None]
+    assert by.to_pylist()[:3] == [2.5, None, None]
+    assert np.asarray(b.validity).tolist() == [1, 0, 1, 0, 0, 0, 0]
+    # c: [1,2,3] / [] / [4,null] / invalid rows null
+    co = np.asarray(c.offsets).tolist()
+    assert c.children[0].to_pylist()[co[0]:co[1]] == [1, 2, 3]
+    assert co[1] == co[2]                      # empty list row
+    assert c.children[0].to_pylist()[co[2]:co[3]] == [4, None]
+    assert np.asarray(c.validity).tolist() == [1, 1, 1, 0, 0, 0, 0]
+    # d: list of structs; element 3 of last row is a non-object -> null
+    dk = d.children[0].children[0]
+    do = np.asarray(d.offsets).tolist()
+    assert dk.to_pylist()[do[0]:do[1]] == [7, 8]
+    last = slice(do[-2], do[-1])
+    assert dk.to_pylist()[last] == [None, None]   # {"z":1} and 3
+    # {"z":1} IS an object (valid struct, missing field k -> null k);
+    # 3 is not an object (null struct)
+    assert np.asarray(d.children[0].validity).tolist()[do[-2]:do[-1]] \
+        == [1, 0]
