@@ -47,6 +47,8 @@ enum Status {
   ERR_GPU_OOM = -4,
   ERR_REMOVED = -5,
   ERR_INVALID = -6,
+  ERR_CPU_RETRY_OOM = -7,
+  ERR_CPU_SPLIT_OOM = -8,
 };
 
 constexpr int kRetryLimit = 500;
@@ -295,9 +297,7 @@ struct Adaptor {
           int rc = check_before_oom(t);
           if (rc != OK) return rc;
           record_failed_retry(t);
-          // CPU alloc entry points are not in the C ABI yet; when they
-          // land this must return a distinct ERR_CPU_RETRY_OOM
-          return ERR_RETRY_OOM;
+          return t.is_cpu_alloc ? ERR_CPU_RETRY_OOM : ERR_RETRY_OOM;
         }
         case BUFN_WAIT: {
           transition(t, BUFN);
@@ -328,7 +328,7 @@ struct Adaptor {
           int rc = check_before_oom(t);
           if (rc != OK) return rc;
           record_failed_retry(t);
-          return ERR_SPLIT_OOM;
+          return t.is_cpu_alloc ? ERR_CPU_SPLIT_OOM : ERR_SPLIT_OOM;
         }
         case REMOVE_THROW: {
           log_transition(t, -1, "removed");
@@ -359,7 +359,7 @@ struct Adaptor {
         t.retry_oom.hit_count--;
         t.metrics.num_retry++;
         record_failed_retry(t);
-        return ERR_RETRY_OOM;
+        return is_cpu ? ERR_CPU_RETRY_OOM : ERR_RETRY_OOM;
       }
     }
     if (t.cudf_injected > 0) {
@@ -374,7 +374,7 @@ struct Adaptor {
         t.split_oom.hit_count--;
         t.metrics.num_split_retry++;
         record_failed_retry(t);
-        return ERR_SPLIT_OOM;
+        return is_cpu ? ERR_CPU_SPLIT_OOM : ERR_SPLIT_OOM;
       }
     }
     if (blocking) {
@@ -622,6 +622,44 @@ int sra_dealloc(long h, long tid, long nbytes) {
   std::unique_lock<std::mutex> lk(a->mu);
   a->used -= nbytes;
   a->dealloc(tid, false, nbytes);
+  return OK;
+}
+
+// ---- host(CPU)-alloc bracket (RmmSpark.preCpuAlloc/postCpuAlloc*
+// :790-854).  Host memory itself is the caller's to manage; these only
+// drive the state machine, mirroring the Python adaptor's cpu hooks.
+
+int sra_cpu_prealloc(long h, long tid, int blocking) {
+  Adaptor* a = get(h);
+  if (!a) return ERR_INVALID;
+  std::unique_lock<std::mutex> lk(a->mu);
+  return a->pre_alloc(lk, tid, true, blocking);  // 1 = was_recursive
+}
+
+int sra_post_cpu_alloc_success(long h, long tid, long nbytes,
+                               int was_recursive) {
+  Adaptor* a = get(h);
+  if (!a) return ERR_INVALID;
+  std::unique_lock<std::mutex> lk(a->mu);
+  a->post_alloc_success(tid, true, was_recursive != 0, nbytes);
+  return OK;
+}
+
+int sra_post_cpu_alloc_failed(long h, long tid, int was_oom,
+                              int blocking, int was_recursive) {
+  Adaptor* a = get(h);
+  if (!a) return ERR_INVALID;
+  std::unique_lock<std::mutex> lk(a->mu);
+  // 1 = retry the allocation, 0 = give up, <0 = thrown state
+  return a->post_alloc_failed(tid, true, was_oom != 0, blocking != 0,
+                              was_recursive != 0);
+}
+
+int sra_cpu_dealloc(long h, long tid, long nbytes) {
+  Adaptor* a = get(h);
+  if (!a) return ERR_INVALID;
+  std::unique_lock<std::mutex> lk(a->mu);
+  a->dealloc(tid, true, nbytes);
   return OK;
 }
 

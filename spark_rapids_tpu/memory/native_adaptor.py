@@ -65,6 +65,15 @@ def _load() -> Optional[ctypes.CDLL]:
                 ("sra_task_done", ctypes.c_int, [ctypes.c_long] * 2),
                 ("sra_alloc", ctypes.c_int, [ctypes.c_long] * 3),
                 ("sra_dealloc", ctypes.c_int, [ctypes.c_long] * 3),
+                ("sra_cpu_prealloc", ctypes.c_int,
+                 [ctypes.c_long, ctypes.c_long, ctypes.c_int]),
+                ("sra_post_cpu_alloc_success", ctypes.c_int,
+                 [ctypes.c_long, ctypes.c_long, ctypes.c_long,
+                  ctypes.c_int]),
+                ("sra_post_cpu_alloc_failed", ctypes.c_int,
+                 [ctypes.c_long, ctypes.c_long, ctypes.c_int,
+                  ctypes.c_int, ctypes.c_int]),
+                ("sra_cpu_dealloc", ctypes.c_int, [ctypes.c_long] * 3),
                 ("sra_block_thread_until_ready", ctypes.c_int,
                  [ctypes.c_long] * 2),
                 ("sra_force_retry_oom", ctypes.c_int,
@@ -118,6 +127,10 @@ def _raise_for(status: int, ctx: str = ""):
         raise exc.GpuOOM("GPU OutOfMemory")
     if status == -5:
         raise exc.ThreadRemovedException("thread removed while blocked")
+    if status == -7:
+        raise exc.CpuRetryOOM()   # injected OR real CPU backpressure
+    if status == -8:
+        raise exc.CpuSplitAndRetryOOM()
     raise ValueError(f"native adaptor error {status} {ctx}")
 
 
@@ -208,6 +221,33 @@ class NativeSparkResourceAdaptor:
     def deallocate(self, num_bytes: int):
         tid = threading.get_ident()
         _raise_for(self._lib.sra_dealloc(self._h, tid, num_bytes))
+
+    def cpu_prealloc(self, num_bytes: int, blocking: bool) -> bool:
+        """Host-alloc bracket (RmmSpark.preCpuAlloc :790): returns
+        was_recursive."""
+        tid = threading.get_ident()
+        rc = self._lib.sra_cpu_prealloc(self._h, tid, int(blocking))
+        _raise_for(rc if rc < 0 else 0)
+        return rc == 1
+
+    def post_cpu_alloc_success(self, num_bytes: int, blocking: bool,
+                               was_recursive: bool):
+        tid = threading.get_ident()
+        _raise_for(self._lib.sra_post_cpu_alloc_success(
+            self._h, tid, num_bytes, int(was_recursive)))
+
+    def post_cpu_alloc_failed(self, was_oom: bool, blocking: bool,
+                              was_recursive: bool) -> bool:
+        tid = threading.get_ident()
+        rc = self._lib.sra_post_cpu_alloc_failed(
+            self._h, tid, int(was_oom), int(blocking),
+            int(was_recursive))
+        _raise_for(rc if rc < 0 else 0)
+        return rc == 1
+
+    def cpu_deallocate(self, num_bytes: int):
+        tid = threading.get_ident()
+        _raise_for(self._lib.sra_cpu_dealloc(self._h, tid, num_bytes))
 
     def block_thread_until_ready(self, thread_id: Optional[int] = None):
         if thread_id is None:

@@ -340,3 +340,83 @@ def test_pool_blocked_breaks_producer_consumer_deadlock(adaptor):
     adaptor.task_done(1)
     t1.done()
     pool.done()
+
+
+def test_cpu_alloc_bracket(adaptor):
+    """Host-alloc hooks (RmmSpark.preCpuAlloc/postCpuAlloc* :790-854):
+    success path, failure path returning retry, and dealloc — same
+    surface on the python and native adaptors."""
+    tid = threading.get_ident()
+    adaptor.start_dedicated_task_thread(tid, 1)
+    try:
+        was_recursive = adaptor.cpu_prealloc(100, blocking=False)
+        assert was_recursive is False
+        adaptor.post_cpu_alloc_success(100, False, was_recursive)
+        adaptor.cpu_deallocate(100)
+        # failed non-recursive alloc: thread returns to RUNNING and may
+        # retry (post_alloc_failed returns True); a RECURSIVE bracket
+        # (alloc within alloc) must not retry
+        r = adaptor.cpu_prealloc(50, blocking=False)
+        assert r is False
+        inner = adaptor.cpu_prealloc(10, blocking=False)
+        assert inner is True                       # recursive
+        assert adaptor.post_cpu_alloc_failed(False, False, inner) is False
+        assert adaptor.post_cpu_alloc_failed(False, False, r) is True
+        # forced retry-OOM injection applies to the CPU filter too
+        adaptor.force_retry_oom(tid, 1, sra.CPU, 0)
+        with pytest.raises(exc.CpuRetryOOM):
+            adaptor.cpu_prealloc(10, blocking=False)
+    finally:
+        adaptor.remove_thread_association(tid, 1)
+        adaptor.task_done(1)
+
+
+def test_cpu_split_injection(adaptor):
+    """CPU-filtered split injection surfaces as CpuSplitAndRetryOOM on
+    both implementations (ERR_CPU_SPLIT_OOM in the C ABI)."""
+    tid = threading.get_ident()
+    adaptor.start_dedicated_task_thread(tid, 1)
+    try:
+        adaptor.force_split_and_retry_oom(tid, 1, sra.CPU, 0)
+        with pytest.raises(exc.CpuSplitAndRetryOOM):
+            adaptor.cpu_prealloc(10, blocking=False)
+        # GPU-filtered injection must NOT hit a cpu alloc
+        adaptor.force_retry_oom(tid, 1, sra.GPU, 0)
+        r = adaptor.cpu_prealloc(5, blocking=False)
+        adaptor.post_cpu_alloc_success(5, False, r)
+        adaptor.cpu_deallocate(5)
+    finally:
+        adaptor.remove_thread_association(tid, 1)
+        adaptor.task_done(1)
+
+
+def test_cpu_bufn_throw_raises_cpu_typed(adaptor):
+    """A CPU-blocked thread chosen by the deadlock breaker must raise
+    the CPU-typed RetryOOM (block_until_ready BUFN_THROW path), not the
+    GPU one — the parity this C ABI change exists to establish."""
+    barrier = threading.Barrier(2, timeout=TIMEOUT)
+    errs = {}
+
+    def worker(task_id):
+        tid = threading.get_ident()
+        adaptor.start_dedicated_task_thread(tid, task_id)
+        try:
+            r = adaptor.cpu_prealloc(100, blocking=True)
+            barrier.wait()   # both tasks fail their cpu alloc together
+            adaptor.post_cpu_alloc_failed(True, True, r)  # -> BLOCKED
+            adaptor.block_thread_until_ready(tid)
+        except (exc.CpuRetryOOM, exc.GpuRetryOOM) as e:
+            errs[task_id] = type(e).__name__
+        finally:
+            adaptor.remove_thread_association(tid, task_id)
+            adaptor.task_done(task_id)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in (1, 2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=TIMEOUT)
+    assert not any(t.is_alive() for t in ts)
+    # with every task blocked on a CPU alloc, the breaker rolls back the
+    # lowest-priority thread with a CPU-typed OOM
+    assert list(errs.values()) == ["CpuRetryOOM"], errs
