@@ -228,64 +228,12 @@ def prune_columns(tree, keep_names: List[str],
                   case_sensitive: bool = True):
     """Trim the footer to the requested TOP-LEVEL columns (nested
     subtrees of kept columns are preserved whole) — the common pruning
-    shape of ParquetFooter.readAndFilter; per-leaf nested pruning is a
-    later extension.  Returns a new tree."""
-    elems = _schema_elements(tree)
-    # schema is a depth-first flattened tree; element 0 is the root
-    def subtree_size(i: int) -> int:
-        nc = _sval(elems[i], 5, 0)
-        size = 1
-        j = i + 1
-        for _ in range(nc):
-            sz = subtree_size(j)
-            size += sz
-            j += sz
-        return size
-
-    def norm(s: bytes) -> str:
-        t = s.decode("utf-8", "replace")
-        return t if case_sensitive else t.lower()
-
-    want = {n if case_sensitive else n.lower() for n in keep_names}
-    root = elems[0]
-    kept_elems = []
-    kept_names = set()
-    kept_top = 0
-    i = 1
-    top_count = _sval(root, 5, 0)
-    for _ in range(top_count):
-        sz = subtree_size(i)
-        name = norm(_sval(elems[i], 4, b""))
-        if name in want:
-            kept_elems.extend(elems[i:i + sz])
-            kept_names.add(name)
-            kept_top += 1
-        i += sz
-    new_root = ("struct", dict(root[1]))
-    new_root[1][5] = (_T_I32, kept_top)
-    # rebuild tree
-    new_fields = dict(tree[1])
-    new_fields[2] = (_T_LIST, ("list", _T_STRUCT,
-                               [new_root] + kept_elems))
-    # prune row group column chunks by path head
-    rg_entry = tree[1].get(4)
-    if rg_entry is not None:
-        new_rgs = []
-        for rg in rg_entry[1][2]:
-            rg_fields = dict(rg[1])
-            cols_entry = rg_fields.get(1)
-            if cols_entry is not None:
-                new_cols = []
-                for cc in cols_entry[1][2]:
-                    md = _sval(cc, 3)
-                    path = _sval(md, 3)
-                    head = norm(path[2][0]) if path and path[2] else None
-                    if head is None or head in kept_names:
-                        new_cols.append(cc)
-                rg_fields[1] = (_T_LIST, ("list", _T_STRUCT, new_cols))
-            new_rgs.append(("struct", rg_fields))
-        new_fields[4] = (_T_LIST, ("list", _T_STRUCT, new_rgs))
-    return ("struct", new_fields)
+    shape of ParquetFooter.readAndFilter.  Delegates to the per-leaf
+    pruner with a keep-whole spec, which also keeps the column_orders
+    list aligned (the old standalone path left it unpruned, producing
+    footers pyarrow rejects)."""
+    return prune_columns_nested(tree, {n: None for n in keep_names},
+                                case_sensitive=case_sensitive)
 
 
 def read_and_filter(path: str, keep_names: List[str],
@@ -295,3 +243,130 @@ def read_and_filter(path: str, keep_names: List[str],
     tree = read_footer_from_file(path)
     return serialize_footer(prune_columns(tree, keep_names,
                                           case_sensitive))
+
+
+_DROP = object()  # unique missing-key sentinel (a str could collide)
+
+
+def prune_columns_nested(tree, keep_spec: Dict,
+                         case_sensitive: bool = True):
+    """Per-leaf nested pruning (NativeParquetJni.cpp:126 column_pruner /
+    filter_schema): `keep_spec` is a nested dict of schema-element
+    names — `{"col": None}` keeps the whole subtree, `{"col": {...}}`
+    keeps the group element and recurses, so pruning inside structs
+    (including under parquet's list/map wrapper groups, which are
+    addressed by their literal names, e.g.
+    {"arr": {"list": {"element": {"a": None}}}}) drops unrequested
+    leaves.  Row-group column chunks are pruned by LEAF ORDINAL — the
+    reference's chunk_map — so dropping `b` inside a struct removes
+    exactly that chunk.  Returns a new tree."""
+    elems = _schema_elements(tree)
+
+    def norm(s) -> str:
+        t = s.decode("utf-8", "replace") if isinstance(s, bytes) else s
+        return t if case_sensitive else t.lower()
+
+    def norm_spec(spec):
+        if spec is None:
+            return None
+        if not isinstance(spec, dict):
+            raise TypeError(
+                f"keep_spec values must be None or dict, got "
+                f"{type(spec).__name__}")
+        return {norm(k): norm_spec(v) for k, v in spec.items()}
+
+    want_root = norm_spec(keep_spec)
+    kept_elems: List = []
+    kept_leaf_ordinals: List[int] = []
+    leaf_counter = 0
+
+    def count_leaves(i: int) -> Tuple[int, int]:
+        """(subtree size, leaf count) of the flattened subtree at i."""
+        nc = _sval(elems[i], 5, 0)
+        if nc == 0:
+            return 1, 1
+        size, leaves = 1, 0
+        j = i + 1
+        for _ in range(nc):
+            sz, lv = count_leaves(j)
+            size += sz
+            leaves += lv
+            j += sz
+        return size, leaves
+
+    def keep_whole(i: int) -> int:
+        nonlocal leaf_counter
+        sz, lv = count_leaves(i)
+        kept_elems.extend(elems[i:i + sz])
+        kept_leaf_ordinals.extend(range(leaf_counter, leaf_counter + lv))
+        leaf_counter += lv
+        return sz
+
+    def skip_whole(i: int) -> int:
+        nonlocal leaf_counter
+        sz, lv = count_leaves(i)
+        leaf_counter += lv
+        return sz
+
+    def walk_children(i: int, nc: int, spec) -> Tuple[int, int]:
+        """Process nc children starting at i under `spec`; returns
+        (next index, number of kept children)."""
+        kept_children = 0
+        for _ in range(nc):
+            name = norm(_sval(elems[i], 4, b""))
+            child_spec = spec.get(name, _DROP) if spec else _DROP
+            if child_spec is _DROP:
+                i = i + skip_whole(i)
+            elif child_spec is None:
+                i = i + keep_whole(i)
+                kept_children += 1
+            else:
+                child_nc = _sval(elems[i], 5, 0)
+                if child_nc == 0:
+                    # spec recurses into a leaf: keep the leaf itself
+                    i = i + keep_whole(i)
+                    kept_children += 1
+                    continue
+                slot = len(kept_elems)
+                kept_elems.append(None)  # placeholder, fixed below
+                i2, sub_kept = walk_children(i + 1, child_nc, child_spec)
+                if sub_kept == 0:
+                    kept_elems.pop(slot)  # nothing survived below
+                else:
+                    fields = dict(elems[i][1])
+                    fields[5] = (_T_I32, sub_kept)
+                    kept_elems[slot] = ("struct", fields)
+                    kept_children += 1
+                i = i2
+        return i, kept_children
+
+    root = elems[0]
+    _, kept_top = walk_children(1, _sval(root, 5, 0), want_root)
+    new_root_fields = dict(root[1])
+    new_root_fields[5] = (_T_I32, kept_top)
+    new_fields = dict(tree[1])
+    new_fields[2] = (_T_LIST, ("list", _T_STRUCT,
+                               [("struct", new_root_fields)] + kept_elems))
+
+    # prune row-group column chunks by original leaf ordinal (chunk_map)
+    keep_set = set(kept_leaf_ordinals)
+    rg_entry = tree[1].get(4)
+    if rg_entry is not None:
+        new_rgs = []
+        for rg in rg_entry[1][2]:
+            rg_fields = dict(rg[1])
+            cols_entry = rg_fields.get(1)
+            if cols_entry is not None:
+                new_cols = [cc for k, cc in enumerate(cols_entry[1][2])
+                            if k in keep_set]
+                rg_fields[1] = (_T_LIST, ("list", _T_STRUCT, new_cols))
+            new_rgs.append(("struct", rg_fields))
+        new_fields[4] = (_T_LIST, ("list", _T_STRUCT, new_rgs))
+    # column_orders (FileMetaData field 7) holds one entry per LEAF and
+    # must stay aligned with the surviving leaves
+    co_entry = tree[1].get(7)
+    if co_entry is not None:
+        kept_co = [co for k, co in enumerate(co_entry[1][2])
+                   if k in keep_set]
+        new_fields[7] = (_T_LIST, ("list", co_entry[1][1], kept_co))
+    return ("struct", new_fields)

@@ -131,6 +131,9 @@ def _raise_for(status: int, ctx: str = ""):
         raise exc.CpuRetryOOM()   # injected OR real CPU backpressure
     if status == -8:
         raise exc.CpuSplitAndRetryOOM()
+    if status == -6:
+        # same exception type as the Python adaptor's invalid-state path
+        raise RuntimeError(f"Internal error: invalid adaptor state {ctx}")
     raise ValueError(f"native adaptor error {status} {ctx}")
 
 

@@ -87,3 +87,70 @@ def test_not_parquet(tmp_path):
     bad.write_bytes(b"0123456789abcdef")
     with pytest.raises(ValueError, match="not a parquet file"):
         pf.read_footer_from_file(str(bad))
+
+
+def test_prune_columns_nested_per_leaf(tmp_path):
+    """Per-leaf pruning (NativeParquetJni column_pruner): drop s.b and
+    arr.element.p; pyarrow itself must read the rewritten file."""
+    pa = pytest.importorskip("pyarrow")
+    pq = pytest.importorskip("pyarrow.parquet")
+
+    t = pa.table({
+        "id": pa.array([1, 2], pa.int64()),
+        "s": pa.array([{"a": 1, "b": "x", "c": 2.5},
+                       {"a": 3, "b": "y", "c": 0.5}],
+                      pa.struct([("a", pa.int32()), ("b", pa.string()),
+                                 ("c", pa.float64())])),
+        "arr": pa.array([[{"p": 1, "q": 2}], []],
+                        pa.list_(pa.struct([("p", pa.int32()),
+                                            ("q", pa.int32())]))),
+        "drop_me": pa.array(["z", "w"]),
+    })
+    src = tmp_path / "nested.parquet"
+    pq.write_table(t, str(src))
+    raw = src.read_bytes()
+    flen = int.from_bytes(raw[-8:-4], "little")
+    tree = pf.parse_footer(raw[-8 - flen:-8])
+    spec = {"id": None, "s": {"a": None, "c": None},
+            "arr": {"list": {"element": {"q": None}}}}
+    out = pf.serialize_footer(pf.prune_columns_nested(tree, spec))
+    dst = tmp_path / "pruned.parquet"
+    dst.write_bytes(raw[:-8 - flen] + out
+                    + len(out).to_bytes(4, "little") + b"PAR1")
+    md = pq.read_metadata(str(dst))
+    paths = [md.row_group(0).column(i).path_in_schema
+             for i in range(md.row_group(0).num_columns)]
+    assert paths == ["id", "s.a", "s.c", "arr.list.element.q"]
+    got = pq.read_table(str(dst)).to_pydict()
+    assert got == {"id": [1, 2],
+                   "s": [{"a": 1, "c": 2.5}, {"a": 3, "c": 0.5}],
+                   "arr": [[{"q": 2}], []]}
+
+
+def test_prune_columns_nested_edge_specs(tmp_path):
+    pa = pytest.importorskip("pyarrow")
+    pq = pytest.importorskip("pyarrow.parquet")
+
+    t = pa.table({"s": pa.array([{"a": 1, "b": 2}],
+                                pa.struct([("a", pa.int32()),
+                                           ("b", pa.int32())])),
+                  "x": pa.array([9], pa.int64())})
+    src = tmp_path / "e.parquet"
+    pq.write_table(t, str(src))
+    raw = src.read_bytes()
+    flen = int.from_bytes(raw[-8:-4], "little")
+    tree = pf.parse_footer(raw[-8 - flen:-8])
+    # group whose every child is dropped vanishes entirely
+    out = pf.serialize_footer(pf.prune_columns_nested(
+        tree, {"s": {"nope": None}, "x": None}))
+    dst = tmp_path / "e2.parquet"
+    dst.write_bytes(raw[:-8 - flen] + out
+                    + len(out).to_bytes(4, "little") + b"PAR1")
+    got = pq.read_table(str(dst)).to_pydict()
+    assert got == {"x": [9]}
+    # case-insensitive matching
+    out = pf.serialize_footer(pf.prune_columns_nested(
+        tree, {"S": {"A": None}}, case_sensitive=False))
+    dst.write_bytes(raw[:-8 - flen] + out
+                    + len(out).to_bytes(4, "little") + b"PAR1")
+    assert pq.read_table(str(dst)).to_pydict() == {"s": [{"a": 1}]}
