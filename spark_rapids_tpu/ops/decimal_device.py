@@ -283,3 +283,204 @@ def sub128_device(a: Column, b: Column, out_scale: int):
     ovf, limbs = _add_sub_core(a.data, b.data, a.dtype.scale,
                                b.dtype.scale, out_scale, True)
     return _wrap(ovf, limbs, a, b, out_scale)
+
+
+# --------------------------------------------------- division / remainder
+
+def _shl1_inject(x: jnp.ndarray, bit: jnp.ndarray) -> jnp.ndarray:
+    """(rows,L) u32 << 1 with `bit` (rows,) injected at bit 0."""
+    hi = x >> _U32(31)
+    shifted = x << _U32(1)
+    carry_in = jnp.concatenate(
+        [bit.astype(_U32)[:, None], hi[:, :-1]], axis=1)
+    return shifted | carry_in
+
+
+def _ge_limbs(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """x >= y, limbwise lexicographic from the top (per row)."""
+    gt = jnp.zeros(x.shape[0], jnp.bool_)
+    eq = jnp.ones(x.shape[0], jnp.bool_)
+    for k in range(x.shape[1] - 1, -1, -1):
+        gt = gt | (eq & (x[:, k] > y[:, k]))
+        eq = eq & (x[:, k] == y[:, k])
+    return gt | eq
+
+
+def _sub_limbs(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """x - y (x >= y assumed), ripple borrow."""
+    borrow = jnp.zeros(x.shape[0], _U64)
+    out = []
+    for k in range(x.shape[1]):
+        t = (x[:, k].astype(_U64) | (jnp.uint64(1) << jnp.uint64(32))) \
+            - y[:, k].astype(_U64) - borrow
+        out.append((t & _MASK32).astype(_U32))
+        borrow = jnp.uint64(1) - (t >> jnp.uint64(32))
+    return jnp.stack(out, axis=1)
+
+
+def _long_divide(num: jnp.ndarray, den: jnp.ndarray,
+                 num_bits: int | None = None
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Restoring binary long division, vectorized over rows.
+    num (rows,N) u32 / den (rows,N) u32 -> (quotient, remainder), both
+    (rows,N).  Caller guarantees den != 0 (zero rows are masked out and
+    flagged upstream).  `num_bits` statically bounds the numerator's
+    bit-length — the loop runs num_bits iterations, not 32*N, which is
+    the difference between ~280 and ~480 rounds for deep scale gaps."""
+    rows, N = num.shape
+    total_bits = min(num_bits, N * 32) if num_bits else N * 32
+
+    def body(i, st):
+        rem, q = st
+        k = total_bits - 1 - i
+        limb = jax.lax.dynamic_index_in_dim(
+            num, k // 32, axis=1, keepdims=False)
+        bit = (limb >> (k % 32).astype(_U32)) & _U32(1)
+        rem = _shl1_inject(rem, bit)
+        ge = _ge_limbs(rem, den)
+        rem = jnp.where(ge[:, None], _sub_limbs(rem, den), rem)
+        qlimb = jax.lax.dynamic_index_in_dim(
+            q, k // 32, axis=1, keepdims=False)
+        qlimb = qlimb | (ge.astype(_U32) << (k % 32).astype(_U32))
+        q = jax.lax.dynamic_update_index_in_dim(
+            q, qlimb, k // 32, axis=1)
+        return rem, q
+
+    rem0 = jnp.zeros((rows, N), _U32)
+    q0 = jnp.zeros((rows, N), _U32)
+    rem, q = jax.lax.fori_loop(0, total_bits, body, (rem0, q0))
+    return q, rem
+
+
+def _limbs_for_shift(shift: int) -> int:
+    return 4 + (abs(shift) * 4 + 31) // 32 + 1
+
+
+def _bits_for_shift(shift: int) -> int:
+    """Static bit bound for a 128-bit magnitude scaled up by 10^shift
+    (10^k < 2^(4k))."""
+    return 128 + 4 * max(shift, 0) + 1
+
+
+def _is_zero_mag(mag: jnp.ndarray) -> jnp.ndarray:
+    """(rows,) bool: every limb zero."""
+    z = jnp.ones(mag.shape[0], jnp.bool_)
+    for k in range(mag.shape[1]):
+        z = z & (mag[:, k] == 0)
+    return z
+
+
+def _replace_zero_den(den: jnp.ndarray,
+                      div_zero: jnp.ndarray) -> jnp.ndarray:
+    """Zero divisors (flagged upstream) divide by 1 so the long
+    division stays well-defined; their values are unspecified."""
+    one = jnp.concatenate(
+        [jnp.ones((den.shape[0], 1), _U32),
+         jnp.zeros((den.shape[0], den.shape[1] - 1), _U32)], axis=1)
+    return jnp.where(div_zero[:, None], one, den)
+
+
+@partial(jax.jit, static_argnames=("a_scale", "b_scale",
+                                   "quotient_scale", "integer_divide"))
+def _divide_core(a_limbs, b_limbs, a_scale: int, b_scale: int,
+                 quotient_scale: int, integer_divide: bool):
+    shift = a_scale - b_scale - quotient_scale
+    num_bits = _bits_for_shift(shift)
+    wide = max((num_bits + 31) // 32,
+               (_bits_for_shift(-shift) + 31) // 32)
+    amag, aneg = _mag_sign(a_limbs)
+    bmag, bneg = _mag_sign(b_limbs)
+    div_zero = _is_zero_mag(bmag)
+    num = _widen(amag, wide)
+    den = _widen(bmag, wide)
+    ovf = jnp.zeros(a_limbs.shape[0], jnp.bool_)
+    if shift >= 0:
+        num, o = _scale_up(num, shift)
+    else:
+        den, o = _scale_up(den, -shift)
+    ovf = ovf | o
+    den = _replace_zero_den(den, div_zero)
+    q, rem = _long_divide(num, den, num_bits=num_bits)
+    neg = aneg ^ bneg
+    if not integer_divide:
+        # HALF_UP on the magnitude: round up when 2*rem >= den
+        rem2, c = _mul_by_2(rem)
+        up = (_ge_limbs(rem2, den) | c) & ~div_zero
+        q = _add_one(q, up)
+    ovf = ovf | div_zero | _exceeds_max38(q)
+    if integer_divide:
+        # Spark integral division bounds the result to int64
+        # (dec128_divider is_int_div path)
+        int64_ovf = jnp.zeros(q.shape[0], jnp.bool_)
+        for k in range(2, q.shape[1]):
+            int64_ovf = int64_ovf | (q[:, k] != 0)
+        hi = q[:, 1]
+        # |q| must be <= 2^63-1 (or 2^63 when negative)
+        too_big = (hi > _U32(0x7FFFFFFF)) | int64_ovf
+        exactly_min = (hi == _U32(0x80000000)) & (q[:, 0] == 0) \
+            & ~int64_ovf
+        ovf = ovf | jnp.where(neg, too_big & ~exactly_min, too_big)
+    return ovf, _apply_sign(q[:, :4], neg)
+
+
+def _mul_by_2(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    carry_out = x[:, -1] >> _U32(31) != 0
+    return _shl1_inject(x, jnp.zeros(x.shape[0], jnp.bool_)), carry_out
+
+
+@partial(jax.jit, static_argnames=("a_scale", "b_scale",
+                                   "remainder_scale"))
+def _remainder_core(a_limbs, b_limbs, a_scale: int, b_scale: int,
+                    remainder_scale: int):
+    s = min(a_scale, b_scale)
+    # width is driven by the ALIGNMENT upscales only; the remainder is
+    # re-widened after the division if the output rescale needs it
+    num_bits = _bits_for_shift(a_scale - s)
+    wide = max((num_bits + 31) // 32,
+               (_bits_for_shift(b_scale - s) + 31) // 32)
+    amag, aneg = _mag_sign(a_limbs)
+    bmag, _ = _mag_sign(b_limbs)
+    div_zero = _is_zero_mag(bmag)
+    x, oa = _scale_up(_widen(amag, wide), a_scale - s)
+    y, ob = _scale_up(_widen(bmag, wide), b_scale - s)
+    y = _replace_zero_den(y, div_zero)
+    _, rem = _long_divide(x, y, num_bits=num_bits)
+    shift = remainder_scale - s
+    ovf = oa | ob
+    if shift < 0:
+        need = (_bits_for_shift(b_scale - s) + 4 * (-shift) + 31) \
+            // 32 + 1
+        if need > rem.shape[1]:
+            rem = _widen(rem, need)
+        rem, o = _scale_up(rem, -shift)
+        ovf = ovf | o
+    elif shift > 0:
+        rem = _rescale_down(rem, shift)
+    ovf = ovf | div_zero | _exceeds_max38(rem)
+    return ovf, _apply_sign(rem[:, :4], aneg)   # sign follows the dividend
+
+
+def divide128_device(a: Column, b: Column, quotient_scale: int,
+                     integer_divide: bool = False):
+    """Device counterpart of decimal_utils.divide_decimal128
+    (dec128_divider): restoring binary long division on u32 limbs,
+    HALF_UP (or truncation for integral division with int64 bounds);
+    division by zero flags overflow."""
+    _check(a, b)
+    ovf, limbs = _divide_core(a.data, b.data, a.dtype.scale,
+                              b.dtype.scale, quotient_scale,
+                              integer_divide)
+    return _wrap(ovf, limbs, a, b, quotient_scale)
+
+
+def integer_divide128_device(a: Column, b: Column, quotient_scale: int):
+    return divide128_device(a, b, quotient_scale, integer_divide=True)
+
+
+def remainder128_device(a: Column, b: Column, remainder_scale: int):
+    """Device counterpart of decimal_utils.remainder_decimal128:
+    truncated-division remainder with the dividend's sign."""
+    _check(a, b)
+    ovf, limbs = _remainder_core(a.data, b.data, a.dtype.scale,
+                                 b.dtype.scale, remainder_scale)
+    return _wrap(ovf, limbs, a, b, remainder_scale)

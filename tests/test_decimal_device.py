@@ -91,3 +91,48 @@ def test_device_decimal_zero_deep_negative_exponent():
     # no-validity inputs keep validity None (codebase convention)
     _, out = DD.multiply128_device(a, b, 0)
     assert out.validity is None
+
+
+@pytest.mark.parametrize("sa,sb,qs", [
+    (-2, -3, -6), (0, 0, 0), (-10, 4, -2), (3, -5, -1),
+])
+def test_device_divide_mod_matches_host(sa, sb, qs):
+    rng = random.Random(sa * 37 + sb * 7 + qs)
+    a = _mkcol(rng, 150, sa)
+    b = _mkcol(rng, 150, sb)
+    _assert_same(DU.divide_decimal128(a, b, qs),
+                 DD.divide128_device(a, b, qs))
+    _assert_same(DU.integer_divide_decimal128(a, b, qs),
+                 DD.integer_divide128_device(a, b, qs))
+    _assert_same(DU.remainder_decimal128(a, b, qs),
+                 DD.remainder128_device(a, b, qs))
+
+
+def test_device_divide_edges():
+    # division by zero -> overflow flag on both paths
+    a = Column.from_pylist([10, 0, None, 5], dtypes.decimal128(0))
+    z = Column.from_pylist([0, 0, 0, 2], dtypes.decimal128(0))
+    _assert_same(DU.divide_decimal128(a, z, 0),
+                 DD.divide128_device(a, z, 0))
+    ho, _ = DD.divide128_device(a, z, 0)
+    assert ho.to_pylist() == [True, True, None, False]
+    # HALF_UP at exactly .5 both signs: 1/2, -1/2 at scale 0
+    x = Column.from_pylist([1, -1, 3, -3], dtypes.decimal128(0))
+    two = Column.from_pylist([2, 2, 2, 2], dtypes.decimal128(0))
+    _assert_same(DU.divide_decimal128(x, two, 0),
+                 DD.divide128_device(x, two, 0))
+    _, r = DD.divide128_device(x, two, 0)
+    assert r.to_pylist() == [1, -1, 2, -2]         # HALF_UP away from 0
+    # integral-divide int64 bounds incl. exact INT64_MIN
+    big = Column.from_pylist([2**63, -(2**63), 2**63 - 1, -(2**63) - 1],
+                             dtypes.decimal128(0))
+    one = Column.from_pylist([1] * 4, dtypes.decimal128(0))
+    _assert_same(DU.integer_divide_decimal128(big, one, 0),
+                 DD.integer_divide128_device(big, one, 0))
+    # remainder sign follows the dividend
+    x = Column.from_pylist([7, -7, 7, -7], dtypes.decimal128(0))
+    y = Column.from_pylist([3, 3, -3, -3], dtypes.decimal128(0))
+    _assert_same(DU.remainder_decimal128(x, y, 0),
+                 DD.remainder128_device(x, y, 0))
+    _, r = DD.remainder128_device(x, y, 0)
+    assert r.to_pylist() == [1, -1, 1, -1]
