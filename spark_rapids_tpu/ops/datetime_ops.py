@@ -147,23 +147,31 @@ def rebase_gregorian_to_julian(col: Column) -> Column:
     Dates on/after 1582-10-15 are unchanged; earlier dates keep their
     Y/M/D field values reinterpreted in the Julian calendar."""
     if col.dtype.kind == Kind.TIMESTAMP_DAYS:
-        days = col.data.astype(_I64)
-        y, m, d = _days_to_ymd(days)
-        jd = _julian_ymd_to_days(y, m, d)
-        out = jnp.where(days >= _GREG_START_DAYS, days, jd)
+        out = _rebase_days_g2j(col.data.astype(_I64))
         return Column(col.dtype, col.length, data=out.astype(_I32),
                       validity=col.validity)
     if col.dtype.kind == Kind.TIMESTAMP_MICROS:
         micros = col.data.astype(_I64)
         days = _floor_div(micros, MICROS_PER_SEC * SECS_PER_DAY)
         tod = micros - days * MICROS_PER_SEC * SECS_PER_DAY
-        y, m, d = _days_to_ymd(days)
-        jd = _julian_ymd_to_days(y, m, d)
-        out_days = jnp.where(days >= _GREG_START_DAYS, days, jd)
+        out_days = _rebase_days_g2j(days)
         return Column(col.dtype, col.length,
                       data=out_days * MICROS_PER_SEC * SECS_PER_DAY + tod,
                       validity=col.validity)
     raise ValueError("date or timestamp column required")
+
+
+def _rebase_days_g2j(days: jnp.ndarray) -> jnp.ndarray:
+    """Shared day computation for both rebase branches.  Dates INSIDE
+    the cutover gap (1582-10-05..14) do not exist in the hybrid
+    calendar: Spark clamps them to the Gregorian start day
+    (datetime_rebase.cu:86-89); earlier dates reinterpret their Y/M/D
+    in the Julian calendar; later dates are unchanged."""
+    y, m, d = _days_to_ymd(days)
+    jd = _julian_ymd_to_days(y, m, d)
+    in_gap = (days >= _GREG_START_DAYS - 10) & (days < _GREG_START_DAYS)
+    return jnp.where(days >= _GREG_START_DAYS, days,
+                     jnp.where(in_gap, jnp.int64(_GREG_START_DAYS), jd))
 
 
 def rebase_julian_to_gregorian(col: Column) -> Column:

@@ -200,7 +200,7 @@ def _add_sub_core(a_limbs, b_limbs, a_scale: int, b_scale: int,
     # intermediate (big scale gap, later divided back down) stays exact:
     # 10^k < 2^(4k), plus one limb of headroom for the add
     max_shift = max(a_scale - s, b_scale - s)
-    wide = 4 + (max_shift * 4 + 31) // 32 + 1
+    wide = _limbs_for_shift(max_shift)
     x, oa = _scale_up(_widen(amag, wide), a_scale - s)
     y, ob = _scale_up(_widen(bmag, wide), b_scale - s)
     x7 = _apply_sign_wide(x, aneg)

@@ -206,3 +206,55 @@ def test_timezone_roundtrip_vs_zoneinfo(zone):
     # and back: local wall time -> utc
     back = dt.convert_timestamp_to_utc(local, zone)
     assert back.to_pylist() == utc_us
+
+
+def test_rebase_reference_vectors_days():
+    """rebaseDaysToJulianTest / rebaseDaysToGregorianTest
+    (DateTimeUtilsTest.java:27-56) — exact vectors."""
+    inp = [-719162, -354285, None, -141714, -141438, -141437, None, None,
+           -141432, -141427, -31463, -31453, -1, 0, 18335]
+    to_julian = [-719164, -354280, None, -141704, -141428, -141427, None,
+                 None, -141427, -141427, -31463, -31453, -1, 0, 18335]
+    c = Column.from_pylist(inp, dtypes.TIMESTAMP_DAYS)
+    assert dt.rebase_gregorian_to_julian(c).to_pylist() == to_julian
+    back = [-719162, -354285, None, -141714, -141438, -141427, None,
+            None, -141427, -141427, -31463, -31453, -1, 0, 18335]
+    cj = Column.from_pylist(to_julian, dtypes.TIMESTAMP_DAYS)
+    assert dt.rebase_julian_to_gregorian(cj).to_pylist() == back
+
+
+def test_rebase_reference_vectors_micros():
+    """rebaseMicroToJulian / rebaseMicroToGregorian
+    (DateTimeUtilsTest.java:59-118) — exact vectors."""
+    inp = [-62135593076345679, -30610213078876544, None,
+           -12244061221876544, -12220243200000000, -12219639001448163,
+           -12219292799000001, -45446999900, 1, None, 1584178381500000]
+    to_julian = [-62135765876345679, -30609781078876544, None,
+                 -12243197221876544, -12219379200000000,
+                 -12219207001448163, -12219292799000001, -45446999900, 1,
+                 None, 1584178381500000]
+    c = Column.from_pylist(inp, dtypes.TIMESTAMP_MICROS)
+    assert dt.rebase_gregorian_to_julian(c).to_pylist() == to_julian
+    back = [-62135593076345679, -30610213078876544, None,
+            -12244061221876544, -12220243200000000, -12219207001448163,
+            -12219292799000001, -45446999900, 1, None, 1584178381500000]
+    cj = Column.from_pylist(to_julian, dtypes.TIMESTAMP_MICROS)
+    assert dt.rebase_julian_to_gregorian(cj).to_pylist() == back
+
+
+def test_truncate_reference_vectors():
+    """truncateDateTest / truncateTimestampTest
+    (DateTimeUtilsTest.java:121-149) — exact vectors."""
+    days = Column.from_pylist([-31463, -31453, None, 0, 18335],
+                              dtypes.TIMESTAMP_DAYS)
+    fmt = Column.from_strings(["YEAR", "MONTH", "WEEK", "QUARTER", "YY"])
+    assert dt.truncate(days, fmt).to_pylist() == \
+        [-31776, -31472, None, 0, 18262]
+    ts = Column.from_pylist(
+        [-12219292799000001, -45446999900, 1, None, 1584178381500000],
+        dtypes.TIMESTAMP_MICROS)
+    fmt2 = Column.from_strings(["YEAR", "HOUR", "WEEK", "QUARTER",
+                                "SECOND"])
+    assert dt.truncate(ts, fmt2).to_pylist() == \
+        [-12244089600000000, -46800000000, -259200000000, None,
+         1584178381000000]
