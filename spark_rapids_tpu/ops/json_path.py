@@ -98,10 +98,11 @@ _ESCAPES = {'"': '"', "'": "'", "\\": "\\", "/": "/", "b": "\b",
 
 
 class _Parser:
-    def __init__(self, s: str):
+    def __init__(self, s: str, allow_leading_zeros: bool = False):
         self.s = s
         self.i = 0
         self.n = len(s)
+        self.allow_leading_zeros = allow_leading_zeros
 
     def ws(self):
         while self.i < self.n and self.s[self.i] in _WS:
@@ -221,10 +222,16 @@ class _Parser:
         if self.i < self.n and self.s[self.i] == "-":
             self.i += 1
         digits = 0
+        first_digit_i = self.i
         while self.i < self.n and self.s[self.i].isdigit():
             self.i += 1
             digits += 1
         if digits == 0:
+            raise _Invalid()
+        if digits > 1 and self.s[first_digit_i] == "0" \
+                and not self.allow_leading_zeros:
+            # invalid JSON numbers for get_json_object; from_json can
+            # opt in via Spark's allowNumericLeadingZeros
             raise _Invalid()
         if self.i < self.n and self.s[self.i] == ".":
             self.i += 1
@@ -266,16 +273,39 @@ def _escape(s: str) -> str:
     return "".join(out)
 
 
-def _render_json(v) -> str:
+def _normalize_number(text: str) -> str:
+    """Spark-normalized number rendering (get_json_object writes
+    numbers through Java double formatting when fractional/exponential;
+    GetJsonObjectTest getJsonObjectTest_Number_Normalization):
+    integer tokens stay verbatim (arbitrary precision, -0 -> 0);
+    float tokens render as Java Double.toString, overflowing to the
+    JSON STRING "Infinity"/"-Infinity"."""
+    if not any(c in text for c in ".eE"):
+        return "0" if text in ("-0", "0") else text
+    from spark_rapids_tpu.ops.cast_string import _java_double_repr
+    v = float(text)
+    if v in (float("inf"), float("-inf")):
+        return _escape("Infinity" if v > 0 else "-Infinity")
+    return _java_double_repr(v, False)
+
+
+def _render_json(v, normalize_numbers: bool = True) -> str:
+    """normalize_numbers=True is get_json_object's Java-normalized
+    rendering; the from_json family passes False to keep number tokens
+    verbatim (from_json_to_raw_map.cu copies raw token substrings)."""
     kind = v[0]
     if kind == "str":
         return _escape(v[1])
-    if kind in ("num", "lit"):
+    if kind == "num":
+        return _normalize_number(v[1]) if normalize_numbers else v[1]
+    if kind == "lit":
         return v[1]
     if kind == "obj":
-        return "{" + ",".join(f"{_escape(k)}:{_render_json(x)}"
-                              for k, x in v[1]) + "}"
-    return "[" + ",".join(_render_json(x) for x in v[1]) + "]"
+        return "{" + ",".join(
+            f"{_escape(k)}:{_render_json(x, normalize_numbers)}"
+            for k, x in v[1]) + "}"
+    return "[" + ",".join(_render_json(x, normalize_numbers)
+                          for x in v[1]) + "]"
 
 
 def _eval(v, path: List) -> List:

@@ -18,26 +18,29 @@ from spark_rapids_tpu.ops.json_path import _Invalid, _Parser, _render_json
 from spark_rapids_tpu.ops import cast_string
 
 
-def _parse_rows(col: Column):
+def _parse_rows(col: Column, allow_leading_zeros: bool = False):
     for v in col.to_pylist():
         if v is None:
             yield None
             continue
         try:
-            yield _Parser(v).parse()
+            yield _Parser(v, allow_leading_zeros).parse()
         except _Invalid:
             yield None
 
 
 def _value_as_raw_string(v) -> str:
-    """Raw-map value rendering: string scalars unescaped, everything else
-    as (normalized) JSON text."""
+    """Raw-map value rendering: string scalars unescaped, everything
+    else as JSON text with number tokens VERBATIM — the reference's
+    from_json_to_raw_map copies raw token substrings, no Double
+    normalization (from_json_to_raw_map.cu)."""
     if v[0] == "str":
         return v[1]
-    return _render_json(v)
+    return _render_json(v, normalize_numbers=False)
 
 
-def from_json_to_raw_map(col: Column) -> Column:
+def from_json_to_raw_map(col: Column,
+                         allow_leading_zeros: bool = False) -> Column:
     """JSON object rows -> MAP<STRING,STRING>
     (JSONUtils.extractRawMapFromJsonString:159).  Non-object / invalid
     rows are null; duplicate keys keep the last value."""
@@ -47,7 +50,7 @@ def from_json_to_raw_map(col: Column) -> Column:
     vals: List[str] = []
     new_offs = np.zeros(rows + 1, np.int32)
     validity = np.zeros(rows, np.uint8)
-    for i, tree in enumerate(_parse_rows(col)):
+    for i, tree in enumerate(_parse_rows(col, allow_leading_zeros)):
         if tree is None or tree[0] != "obj":
             new_offs[i + 1] = len(keys)
             continue
@@ -185,11 +188,14 @@ def _build_json_column(values, spec) -> Column:
     raise ValueError(f"unknown schema node {tag!r}")
 
 
-def from_json_to_structs_nested(col: Column, schema) -> Column:
+def from_json_to_structs_nested(col: Column, schema,
+                                allow_leading_zeros: bool = False
+                                ) -> Column:
     """JSON rows -> arbitrarily nested STRUCT/LIST column
     (JSONUtils.fromJSONToStructs:188 with a nested Schema).  `schema`
     must be a ("struct", ...) node; invalid JSON rows are null."""
     assert col.dtype.is_string
     if not (isinstance(schema, tuple) and schema[0] == "struct"):
         raise ValueError("top-level schema must be a struct")
-    return _build_json_column(list(_parse_rows(col)), schema)
+    return _build_json_column(
+        list(_parse_rows(col, allow_leading_zeros)), schema)

@@ -47,7 +47,9 @@ def test_json_tolerant_parser():
     assert jq('{"k": "a\\nb"}', "$.k") == "a\nb"   # escapes
     assert jq('{"k": "\\u0041"}', "$.k") == "A"
     assert jq('{ "k" :  42 }', "$.k") == "42"
-    assert jq('{"k": 1.5e3}', "$.k") == "1.5e3"    # number kept verbatim
+    # fractional/exponential numbers render Java-normalized (Spark
+    # get_json_object semantics; see the Number_Normalization vectors)
+    assert jq('{"k": 1.5e3}', "$.k") == "1500.0"
 
 
 def test_json_bracket_name_and_quotes():
@@ -174,3 +176,63 @@ def test_literal_range_pattern():
     c = Column.from_strings(["abc123", "abcx", "zabc99z", None])
     out = SM.literal_range_pattern(c, "abc", 2, ord("0"), ord("9"))
     assert out.to_pylist() == [True, False, True, None]
+
+
+def test_get_json_object_number_normalization():
+    """getJsonObjectTest_Number_Normalization vectors
+    (GetJsonObjectTest.java:200-240): fractional/exponential numbers
+    render through Java double formatting, integers stay verbatim,
+    overflow becomes the JSON string Infinity."""
+    nums = ["[100.0,200.000,351.980]", "[12345678900000000000.0]",
+            "[0.0]", "[-0.0]", "[-0]", "[12345678999999999999999999]",
+            "[9.299999257686047e-0005603333574677677]",
+            "9.299999257686047e0005603333574677677", "[1E308]",
+            "[1.0E309,-1E309,1E5000]", "0.3", "0.03", "0.003", "0.0003",
+            "0.00003"]
+    expected = ["[100.0,200.0,351.98]", "[1.23456789E19]", "[0.0]",
+                "[-0.0]", "[0]", "[12345678999999999999999999]",
+                "[0.0]", '"Infinity"', "[1.0E308]",
+                '["Infinity","-Infinity","Infinity"]', "0.3", "0.03",
+                "0.003", "3.0E-4", "3.0E-5"]
+    got = J.get_json_object(Column.from_strings(nums), "$").to_pylist()
+    assert got == expected
+
+
+def test_get_json_object_leading_zeros_invalid():
+    """getJsonObjectTest_Test_leading_zeros (GetJsonObjectTest.java:245):
+    00/01/-01 etc. are invalid JSON numbers -> null."""
+    zeros = ["00", "01", "02", "000", "-01", "-00", "-02"]
+    got = J.get_json_object(Column.from_strings(zeros), "$").to_pylist()
+    assert got == [None] * 7
+    # plain 0 / -0 / 0.5 / exponent leading zeros remain VALID
+    ok = ["0", "-0", "0.5", "1e007"]
+    got = J.get_json_object(Column.from_strings(ok), "$").to_pylist()
+    assert got == ["0", "0", "0.5", "1.0E7"]
+
+
+def test_get_json_object_escape_vectors():
+    """getJsonObjectTest_Escape vectors (GetJsonObjectTest.java:168)."""
+    docs = ["{ \"a\": \"A\" }", "{'a':'A\"'}", "{'a':\"B'\"}",
+            "['a','b','\"C\"']",
+            "'\\u4e2d\\u56FD\\\"\\'\\\\\\/\\b\\f\\n\\r\\t\\b'"]
+    expected = ['{"a":"A"}', '{"a":"A\\""}', '{"a":"B\'"}',
+                '["a","b","\\"C\\""]', '中国"\'\\/\b\f\n\r\t\b']
+    got = J.get_json_object(Column.from_strings(docs), "$").to_pylist()
+    assert got == expected
+
+
+def test_from_json_number_verbatim_and_leading_zero_knob():
+    """from_json_to_raw_map copies number tokens VERBATIM (no Double
+    normalization — from_json_to_raw_map.cu) and exposes Spark's
+    allowNumericLeadingZeros."""
+    from spark_rapids_tpu.ops import json_utils as JU
+
+    m = JU.from_json_to_raw_map(Column.from_strings(
+        ['{"price": 200.000, "x": 1.5e3}']))
+    assert m.children[0].children[1].to_pylist() == ["200.000", "1.5e3"]
+    bad = Column.from_strings(['{"k": 01}'])
+    assert np.asarray(JU.from_json_to_raw_map(bad).validity).tolist() \
+        == [0]
+    ok = JU.from_json_to_raw_map(bad, allow_leading_zeros=True)
+    assert ok.validity is None
+    assert ok.children[0].children[1].to_pylist() == ["01"]
