@@ -74,11 +74,33 @@ def _check_both(a: Column, b: Column):
         raise ValueError("column lengths must match")
 
 
+
+
+def _use_device() -> bool:
+    """Route to the device limb kernels (ops/decimal_device.py) on
+    accelerator backends — same gating pattern as the device join and
+    group-by fast paths (override with
+    SPARK_RAPIDS_TPU_FORCE_DEVICE_DECIMAL=1, disable with =0)."""
+    import os
+
+    import jax
+
+    force = os.environ.get("SPARK_RAPIDS_TPU_FORCE_DEVICE_DECIMAL")
+    if force == "1":
+        return True
+    if force == "0":
+        return False
+    return jax.default_backend() != "cpu"
+
+
 def multiply_decimal128(a: Column, b: Column, product_scale: int,
                         cast_interim_result: bool = False):
     """(overflow, product) (decimal_utils.cu dec128_multiplier incl. the
     SPARK-40129 legacy interim rounding when cast_interim_result)."""
     _check_both(a, b)
+    if not cast_interim_result and _use_device():
+        from spark_rapids_tpu.ops.decimal_device import multiply128_device
+        return multiply128_device(a, b, product_scale)
     av, am = _to_ints(a)
     bv, bm = _to_ints(b)
     mask = am & bm
@@ -116,6 +138,9 @@ def divide_decimal128(a: Column, b: Column, quotient_scale: int,
     """(overflow, quotient) at quotient_scale; HALF_UP rounding
     (dec128_divider)."""
     _check_both(a, b)
+    if _use_device():
+        from spark_rapids_tpu.ops.decimal_device import divide128_device
+        return divide128_device(a, b, quotient_scale, integer_divide)
     av, am = _to_ints(a)
     bv, bm = _to_ints(b)
     mask = am & bm
@@ -162,6 +187,10 @@ def integer_divide_decimal128(a: Column, b: Column, quotient_scale: int):
 def remainder_decimal128(a: Column, b: Column, remainder_scale: int):
     """(overflow, a % b) with C/Java truncated-division remainder."""
     _check_both(a, b)
+    if _use_device():
+        from spark_rapids_tpu.ops.decimal_device import \
+            remainder128_device
+        return remainder128_device(a, b, remainder_scale)
     av, am = _to_ints(a)
     bv, bm = _to_ints(b)
     mask = am & bm
@@ -194,6 +223,10 @@ def remainder_decimal128(a: Column, b: Column, remainder_scale: int):
 
 def _add_sub(a: Column, b: Column, out_scale: int, sub: bool):
     _check_both(a, b)
+    if _use_device():
+        from spark_rapids_tpu.ops.decimal_device import (add128_device,
+                                                         sub128_device)
+        return (sub128_device if sub else add128_device)(a, b, out_scale)
     av, am = _to_ints(a)
     bv, bm = _to_ints(b)
     mask = am & bm
