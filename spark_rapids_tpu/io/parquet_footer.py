@@ -158,7 +158,9 @@ class _Writer:
                 self.byte(0xF0 | etype)
                 self.varint(len(items))
             for item in items:
-                if etype == _T_BOOL_TRUE:
+                # bool list elements carry a 1/2 byte each; writers may
+                # declare the element type with either bool code
+                if etype in (_T_BOOL_TRUE, _T_BOOL_FALSE):
                     self.byte(1 if item else 2)
                 else:
                     self.write_value(etype, item)

@@ -405,14 +405,31 @@ def _fixed_width_blocks(col: Column, algo) -> tuple:
     raise NotImplementedError(f"hash of {kind}")
 
 
+def _resolve_str_pad(col: Column, max_str_len: Optional[int]) -> int:
+    """Padded char width for a string column.  max_str_len is an upper
+    bound that must dominate the data: truncating the char matrix while
+    keeping true lengths would produce silently wrong hashes (and e.g.
+    shuffle-partition misrouting), so a too-small value is an error.
+    Under jit the offsets are tracers and validation would need a host
+    sync, so the bound is trusted there."""
+    if max_str_len is None:
+        return max(1, col.max_string_length())
+    if not isinstance(col.offsets, jax.core.Tracer):
+        actual = col.max_string_length()
+        if max_str_len < actual:
+            raise ValueError(
+                f"max_str_len={max_str_len} is smaller than the column's "
+                f"longest string ({actual} bytes); refusing to truncate")
+    return max(1, max_str_len)
+
+
 def _hash_element_column(algo, h, col: Column,
                          max_str_len: Optional[int]) -> jnp.ndarray:
     """h' for every row: element hash seeded by h; null rows keep h."""
     kind = col.dtype.kind
     if kind == Kind.STRING:
-        pad = max_str_len if max_str_len is not None \
-            else max(1, col.max_string_length())
-        chars, lens = col.to_padded_chars(pad_to=max(pad, 1))
+        pad = _resolve_str_pad(col, max_str_len)
+        chars, lens = col.to_padded_chars(pad_to=pad)
         h2 = algo.hash_varbytes(h, chars, lens)
     elif kind == Kind.DECIMAL128:
         be, length = _dec128_min_be_bytes(col.data)
@@ -476,8 +493,7 @@ def _hash_list_column(algo, h, col: Column, max_str_len: Optional[int]):
                       if leaf.validity is not None else None)
         is_string = leaf.dtype.is_string
         if is_string:
-            pad = max_str_len if max_str_len is not None else max(
-                1, leaf.max_string_length())
+            pad = _resolve_str_pad(leaf, max_str_len)
             leaf_chars_len = leaf.data.shape[0]
         else:
             blocks_all, nbytes = _fixed_width_blocks(leaf, algo)
@@ -492,7 +508,7 @@ def _hash_list_column(algo, h, col: Column, max_str_len: Optional[int]):
             if is_string:
                 s0 = leaf.offsets[idx]
                 lens = leaf.offsets[idx + 1] - s0
-                cidx = s0[:, None] + jnp.arange(max(pad, 1), dtype=_I32)
+                cidx = s0[:, None] + jnp.arange(pad, dtype=_I32)
                 in_r = cidx < leaf.offsets[idx + 1][:, None]
                 cidx = jnp.clip(cidx, 0, max(leaf_chars_len - 1, 0))
                 chars = jnp.where(
@@ -570,9 +586,8 @@ def _hive_element(col: Column, max_str_len: Optional[int]) -> jnp.ndarray:
         u = res.astype(_U64)
         hv = ((u >> _U64(32)) ^ u).astype(_U32).astype(_I32)
     elif kind == Kind.STRING:
-        pad = max_str_len if max_str_len is not None else max(
-            1, col.max_string_length())
-        chars, lens = col.to_padded_chars(pad_to=max(pad, 1))
+        pad = _resolve_str_pad(col, max_str_len)
+        chars, lens = col.to_padded_chars(pad_to=pad)
         sb = chars.astype(jnp.int8).astype(_I32)
 
         def body(hacc, xs):
