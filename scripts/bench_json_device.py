@@ -1,0 +1,24 @@
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+import jax
+jax.config.update("jax_enable_x64", True)
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.ops import json_device as JD
+
+n = int(os.environ.get("N", 1_000_000))
+docs = ['{"name":"user%d","id":%d,"tags":["a","b"],"info":{"x":%d,"y":"z"}}'
+        % (i, i, i % 97) for i in range(n)]
+t0 = time.time()
+col = Column.from_strings(docs)
+jax.block_until_ready(col.data)
+print("build col %.1fs" % (time.time() - t0), flush=True)
+for path in ["$.name", "$.info.x"]:
+    t0 = time.time(); out = JD.get_json_object_device(col, path)
+    jax.block_until_ready(out.data); t1 = time.time()
+    print(path, "cold %.2fs" % (t1-t0), flush=True)
+    t0b = time.time(); out = JD.get_json_object_device(col, path)
+    jax.block_until_ready(out.data); t2 = time.time()
+    print(path, "warm %.2fs -> %.2fM rows/s, fb=%d" %
+          (t2-t0b, n/(t2-t0b)/1e6, JD.last_stats["fallback_rows"]), flush=True)
+print(jax.devices(), flush=True)

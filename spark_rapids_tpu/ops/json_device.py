@@ -1,0 +1,624 @@
+"""Device get_json_object: vectorized JSON pushdown automaton.
+
+Reference: get_json_object.cu:820-888 (thread-per-row pull-parse kernel)
+and json_parser.cuh (tolerant parser).  The TPU design replaces the
+per-row pull parser with ONE lax.scan over the padded char axis that
+carries, for every row simultaneously:
+
+  * a tolerant-JSON validity DFA (single quotes, unescaped control
+    chars, Spark leading-zero number rules),
+  * a bounded container stack (type / path-position / element ordinal)
+    implementing JSONPath evaluation with Spark's implicit array
+    flattening under named access,
+  * capture registers for the matched value's byte span, and
+  * "verbatim-safety" flags telling whether the matched span can be
+    copied byte-for-byte (the overwhelmingly common case for compact
+    machine JSON).
+
+TPU shape discipline: the scan body is pure elementwise VPU work — the
+container stack lives in (rows, D) arrays addressed by one-hot depth
+masks (scatter/gather lower catastrophically inside a TPU scan), and
+key-name / literal-token recognition is hoisted OUT of the scan as
+shifted-window equality over the padded char matrix (the
+substring_index pattern), so each step consumes a precomputed
+"key-matches-here" lane instead of marching name bytes char by char.
+
+Rows whose rendering needs host work (Java double normalization of
+fractional numbers, escape rewriting, whitespace-stripped re-rendering,
+multiple wildcard matches, nesting deeper than the tracked stack) are
+flagged and routed through the host evaluator in ops/json_path.py —
+per-row fallback, never whole-column.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+
+_I32 = jnp.int32
+_U8 = jnp.uint8
+_B = jnp.bool_
+
+MAX_NEST_TRACK = 16   # container stack depth tracked on device; deeper
+                      # rows fall back to host (path depth is <=16)
+DEVICE_ROW_CHUNK = 1 << 17  # rows per scan launch (bounds stack memory)
+
+# parser DFA states
+_PS_VALUE = 0        # expect a value (root / after '[', ',', ':')
+_PS_VAL_OR_CLOSE = 1  # just after '[': value or ']'
+_PS_KEY_OR_CLOSE = 2  # just after '{': key or '}'
+_PS_KEY = 3          # after ',' in object: key required
+_PS_COLON = 4        # after key: ':' required
+_PS_AFTER = 5        # after a value: ',' / close / end
+_PS_PRIM = 6         # consuming a number token
+_PS_LIT = 7          # consuming the tail of true/false/null
+
+# number DFA states
+_N_SIGN, _N_ZERO, _N_DIG, _N_DOT, _N_FRAC, _N_E, _N_ESIGN, _N_EDIG, \
+    _N_BAD = range(1, 10)
+
+# match kinds
+_K_STR, _K_NUM, _K_LIT, _K_OBJ, _K_ARR = range(5)
+
+# path instruction kinds
+_INS_NAMED, _INS_INDEX, _INS_WILD = range(3)
+
+
+def _compile_path(instructions) -> Tuple:
+    """Static spec describing the path for embedding into the scan."""
+    from spark_rapids_tpu.ops.json_path import Index, Named
+    P = len(instructions)
+    kinds, idxv, names = [], [], []
+    for ins in instructions:
+        if isinstance(ins, Named):
+            kinds.append(_INS_NAMED)
+            idxv.append(0)
+            names.append(ins.name.encode("utf-8"))
+        elif isinstance(ins, Index):
+            kinds.append(_INS_INDEX)
+            idxv.append(ins.index)
+            names.append(b"")
+        else:
+            kinds.append(_INS_WILD)
+            idxv.append(0)
+            names.append(b"")
+    return (P, tuple(kinds), tuple(idxv), tuple(names))
+
+
+def _onehot_or(pv, flags) -> jnp.ndarray:
+    """OR over static positions: flags[d] holds for row where pv == d."""
+    acc = jnp.zeros(pv.shape, _B)
+    for d, f in enumerate(flags):
+        if f:
+            acc = acc | (pv == d)
+    return acc
+
+
+def _onehot_val(pv, vals, default=0) -> jnp.ndarray:
+    acc = jnp.full(pv.shape, default, _I32)
+    for d, v in enumerate(vals):
+        acc = jnp.where(pv == d, _I32(v), acc)
+    return acc
+
+
+@functools.lru_cache(maxsize=64)
+def _build_scan(path_key):
+    """jit-compiled scan specialized to one JSON path."""
+    P, kinds, idxv, names = path_key
+    D = MAX_NEST_TRACK
+    named_f = [k == _INS_NAMED for k in kinds]
+    wild_f = [k == _INS_WILD for k in kinds]
+    # distinct named instructions -> lane in the precomputed KEYEQ block
+    name_lanes: List[bytes] = []
+    lane_of = []
+    for k, nm in zip(kinds, names):
+        if k == _INS_NAMED:
+            if nm not in name_lanes:
+                name_lanes.append(nm)
+            lane_of.append(name_lanes.index(nm))
+        else:
+            lane_of.append(-1)
+    NL = max(len(name_lanes), 1)
+
+    def scan(chars: jnp.ndarray, lens: jnp.ndarray):
+        rows, L1 = chars.shape
+        nmax = max([len(n) for n in name_lanes], default=0)
+        # window width must cover both key probes (1+len+close) and the
+        # longest literal probe ("false": start+4 .. start+4+L)
+        pad = jnp.zeros((rows, max(nmax + 2, 5)), jnp.uint8)
+        padded = jnp.concatenate([chars, pad], axis=1)
+
+        # ---- hoisted recognition lanes (shifted-window equalities) ----
+        # KEYEQ[lane]: at j, chars[j] is a quote opening a string whose
+        # raw bytes equal the lane's name, closed by the same quote.
+        is_quote0 = (chars == _U8(34)) | (chars == _U8(39))
+        keyeqs = []
+        for nm in name_lanes:
+            m = is_quote0
+            for k, b in enumerate(nm):
+                m = m & (padded[:, 1 + k: 1 + k + L1] == _U8(b))
+            m = m & (padded[:, 1 + len(nm): 1 + len(nm) + L1] == chars)
+            keyeqs.append(m)
+        if not keyeqs:
+            keyeqs.append(jnp.zeros_like(is_quote0))
+        keyeq = jnp.stack(keyeqs, axis=-1)        # (rows, L1, NL)
+
+        # LITOK: at j, bytes spell true/false/null exactly
+        lit_ok = jnp.zeros_like(is_quote0)
+        lit_len = jnp.zeros(chars.shape, _I32)
+        for word in (b"true", b"false", b"null"):
+            m = jnp.ones_like(is_quote0)
+            for k, b in enumerate(word):
+                m = m & (padded[:, k: k + L1] == _U8(b))
+            lit_ok = lit_ok | m
+            lit_len = jnp.where(m, len(word), lit_len)
+
+        r_dummy = jnp.zeros(rows, _I32)
+        d_iota = jnp.arange(D, dtype=_I32)[None, :]
+
+        def step(carry, xs):
+            (qs, esc, u_rem, ps, valid, depth, pend, is_key, key_match,
+             key_live, pstate, pneg, pfloat, lrem, sact, mcount, mstart,
+             mend, mkind, mdepth, mfloat, mneg, f_ws, f_sq, f_escun,
+             f_ctrl, f_anyesc, f_float, f_negz, fb,
+             s_isobj, s_cvpos, s_elem) = carry
+            j, c, keq, lok, llen = xs
+            j = j.astype(_I32)
+            active = j < lens            # real char; j == lens: terminator
+            at_end = j == lens
+            in_str = qs > 0
+
+            # one-hot stack lanes
+            ohd = d_iota == depth[:, None]          # push slot
+            ohd1 = d_iota == (depth - 1)[:, None]   # parent slot
+            pv = jnp.sum(jnp.where(ohd1, s_cvpos, 0), axis=1).astype(_I32)
+            p_isobj = jnp.any(ohd1 & s_isobj, axis=1)
+            pelem = jnp.sum(jnp.where(ohd1, s_elem, 0),
+                            axis=1).astype(_I32)
+
+            # ---------------------------------------- inside a string
+            is_hex = (((c >= _U8(48)) & (c <= _U8(57)))
+                      | ((c >= _U8(97)) & (c <= _U8(102)))
+                      | ((c >= _U8(65)) & (c <= _U8(70))))
+            quote_ch = jnp.where(qs == 1, _U8(34), _U8(39))
+            esc_safe = ((c == _U8(34)) | (c == _U8(92)) | (c == _U8(110))
+                        | (c == _U8(114)) | (c == _U8(116)))
+            esc_ok = (esc_safe | (c == _U8(39)) | (c == _U8(47))
+                      | (c == _U8(98)) | (c == _U8(102)) | (c == _U8(117)))
+            s_esc = in_str & esc & active
+            s_hex = in_str & ~esc & (u_rem > 0) & active
+            s_close = in_str & ~esc & (u_rem == 0) & (c == quote_ch) & active
+            s_open_esc = in_str & ~esc & (u_rem == 0) & (c == _U8(92)) \
+                & active
+            s_content = in_str & ~esc & (u_rem == 0) & ~s_close \
+                & ~s_open_esc & active
+            span = sact | (mdepth >= 0)
+
+            valid = valid & ~(s_esc & ~esc_ok)
+            valid = valid & ~(s_hex & ~is_hex)
+            n_urem = jnp.where(s_esc & (c == _U8(117)), _U8(4),
+                               jnp.where(s_hex, u_rem - _U8(1), u_rem))
+            n_esc = jnp.where(s_esc | s_hex | s_close | s_content,
+                              False, jnp.where(s_open_esc, True, esc))
+            f_anyesc = f_anyesc | (s_open_esc & span)
+            f_escun = f_escun | (s_esc & ~esc_safe & span)
+            fb = fb | (s_open_esc & is_key & key_live)
+            f_ctrl = f_ctrl | (s_content & (c < _U8(0x20)) & span)
+
+            # string end: key -> expect colon; value -> after-value
+            end_key = s_close & is_key
+            end_val = s_close & ~is_key
+            n_pend = jnp.where(
+                end_key,
+                jnp.where(key_live & key_match, pv + 1, -1), pend)
+            ps = jnp.where(end_key, _PS_COLON,
+                           jnp.where(end_val, _PS_AFTER, ps))
+            mend = jnp.where(end_val & sact, j + 1, mend)
+            n_sact = jnp.where(end_val, False, sact)
+            n_qs = jnp.where(s_close, _U8(0), qs)
+            n_is_key = jnp.where(s_close, False, is_key)
+
+            # ------------------------------------ number continuation
+            digit = (c >= _U8(48)) & (c <= _U8(57))
+            dot = c == _U8(46)
+            ee = (c == _U8(101)) | (c == _U8(69))
+            pm = (c == _U8(43)) | (c == _U8(45))
+            num_here = ~in_str & (ps == _PS_PRIM) & (active | at_end)
+            p_cont = num_here & (digit | dot | ee | pm)
+
+            ns = pstate
+            ns = jnp.where(pstate == _N_SIGN,
+                           jnp.where(c == _U8(48), _N_ZERO,
+                                     jnp.where(digit, _N_DIG, _N_BAD)), ns)
+            ns = jnp.where(pstate == _N_ZERO,
+                           jnp.where(dot, _N_DOT,
+                                     jnp.where(ee, _N_E, _N_BAD)), ns)
+            ns = jnp.where(pstate == _N_DIG,
+                           jnp.where(digit, _N_DIG,
+                                     jnp.where(dot, _N_DOT,
+                                               jnp.where(ee, _N_E,
+                                                         _N_BAD))), ns)
+            ns = jnp.where(pstate == _N_DOT,
+                           jnp.where(digit, _N_FRAC,
+                                     jnp.where(ee, _N_E, _N_BAD)), ns)
+            ns = jnp.where(pstate == _N_FRAC,
+                           jnp.where(digit, _N_FRAC,
+                                     jnp.where(ee, _N_E, _N_BAD)), ns)
+            ns = jnp.where(pstate == _N_E,
+                           jnp.where(digit, _N_EDIG,
+                                     jnp.where(pm, _N_ESIGN, _N_BAD)), ns)
+            ns = jnp.where(pstate == _N_ESIGN,
+                           jnp.where(digit, _N_EDIG, _N_BAD), ns)
+            ns = jnp.where(pstate == _N_EDIG,
+                           jnp.where(digit, _N_EDIG, _N_BAD), ns)
+            n_pstate = jnp.where(p_cont, ns.astype(_U8), pstate)
+            n_pfloat = pfloat | (p_cont & (dot | ee))
+
+            # number termination (terminator char falls through to the
+            # structural logic below with ps already AFTER_VALUE)
+            p_term = num_here & ~p_cont
+            num_accept = ((pstate == _N_ZERO) | (pstate == _N_DIG)
+                          | (pstate == _N_DOT) | (pstate == _N_FRAC)
+                          | (pstate == _N_EDIG))
+            valid = valid & ~(p_term & ~num_accept)
+            negzero = pneg & (pstate == _N_ZERO)
+            f_float = f_float | (p_term & pfloat & span)
+            f_negz = f_negz | (p_term & negzero & span)
+            mend = jnp.where(p_term & n_sact, j, mend)
+            mfloat = jnp.where(p_term & n_sact, pfloat, mfloat)
+            mneg = jnp.where(p_term & n_sact, negzero, mneg)
+            n_sact = jnp.where(p_term, False, n_sact)
+            ps = jnp.where(p_term, _PS_AFTER, ps)
+            n_pstate = jnp.where(p_term, _U8(0), n_pstate)
+
+            # literal tail: count down remaining pre-validated chars
+            lit_here = ~in_str & (ps == _PS_LIT) & active
+            n_lrem = jnp.where(lit_here, lrem - 1, lrem)
+            ps = jnp.where(lit_here & (n_lrem == 0), _PS_AFTER, ps)
+
+            # ------------------------------------------ structural chars
+            # (includes the virtual terminator at j == lens)
+            struct_here = ~in_str & ~p_cont & ~lit_here \
+                & (ps != _PS_LIT) & (active | at_end)
+            ws = ((c == _U8(32)) | (c == _U8(9)) | (c == _U8(10))
+                  | (c == _U8(13)))
+            is_ws = struct_here & ws & active
+            f_ws = f_ws | (is_ws & (mdepth >= 0))
+
+            open_obj = struct_here & (c == _U8(123)) & active
+            open_arr = struct_here & (c == _U8(91)) & active
+            close_obj = struct_here & (c == _U8(125)) & active
+            close_arr = struct_here & (c == _U8(93)) & active
+            comma = struct_here & (c == _U8(44)) & active
+            colon = struct_here & (c == _U8(58)) & active
+            quote = struct_here & ((c == _U8(34)) | (c == _U8(39))) & active
+            num_start = struct_here & (digit | (c == _U8(45))) & active
+            lit_start = struct_here & ((c == _U8(116)) | (c == _U8(102))
+                                       | (c == _U8(110))) & active
+            other = struct_here & active & ~(
+                is_ws | open_obj | open_arr | close_obj | close_arr
+                | comma | colon | quote | num_start | lit_start)
+            valid = valid & ~other
+
+            can_value = (ps == _PS_VALUE) | (ps == _PS_VAL_OR_CLOSE)
+            can_key = (ps == _PS_KEY_OR_CLOSE) | (ps == _PS_KEY)
+            val_start = (open_obj | open_arr | quote | num_start
+                         | lit_start) & can_value
+            key_start = quote & can_key
+            bad_tok = ((open_obj | open_arr | num_start | lit_start)
+                       & ~can_value) | (quote & ~can_value & ~can_key)
+            valid = valid & ~bad_tok
+
+            # value path position (static unroll over path instructions)
+            p_named = _onehot_or(pv, named_f)
+            p_wild = _onehot_or(pv, wild_f)
+            p_idxtgt = _onehot_val(pv, idxv, default=-1)
+            arr_v = jnp.where(
+                p_named, pv,
+                jnp.where(p_wild, pv + 1,
+                          jnp.where(pelem == p_idxtgt, pv + 1, -1)))
+            arr_v = jnp.where(pv >= 0, arr_v, -1)
+            v = jnp.where(depth == 0, 0,
+                          jnp.where(p_isobj, pend, arr_v))
+            v = jnp.where(val_start, v, -1)
+
+            is_match = val_start & (v == _I32(P))
+            mcount = mcount + jnp.where(is_match, 1, 0).astype(_I32)
+            mstart = jnp.where(is_match, j, mstart)
+            new_kind = jnp.where(
+                open_obj, _K_OBJ,
+                jnp.where(open_arr, _K_ARR,
+                          jnp.where(quote, _K_STR,
+                                    jnp.where(num_start, _K_NUM,
+                                              _K_LIT)))).astype(_U8)
+            mkind = jnp.where(is_match, new_kind, mkind)
+            scalar_match = is_match & (quote | num_start | lit_start)
+            n_sact = jnp.where(scalar_match, True, n_sact)
+            cont_match = is_match & (open_obj | open_arr)
+            mdepth = jnp.where(cont_match, depth, mdepth)
+            f_sq = f_sq | (quote & (c == _U8(39)) & (mdepth >= 0))
+
+            # element ordinal bump for array parents
+            in_arr_parent = val_start & (depth > 0) & ~p_isobj
+            s_elem = jnp.where(ohd1 & in_arr_parent[:, None],
+                               s_elem + 1, s_elem)
+
+            # container push (one-hot write at the current depth slot)
+            push = open_obj | open_arr
+            fb = fb | (push & (depth >= D))
+            push_cv = jnp.where(v < _I32(P), v, -1)
+            pm_ = (push & (depth < D))[:, None] & ohd
+            s_isobj = jnp.where(pm_, open_obj[:, None], s_isobj)
+            s_cvpos = jnp.where(pm_, push_cv[:, None], s_cvpos)
+            s_elem = jnp.where(pm_, 0, s_elem)
+            depth = depth + jnp.where(push, 1, 0).astype(_I32)
+            ps = jnp.where(push,
+                           jnp.where(open_obj, _PS_KEY_OR_CLOSE,
+                                     _PS_VAL_OR_CLOSE), ps)
+
+            # container close
+            ok_close_obj = close_obj & (depth > 0) & p_isobj & (
+                (ps == _PS_AFTER) | (ps == _PS_KEY_OR_CLOSE))
+            ok_close_arr = close_arr & (depth > 0) & ~p_isobj & (
+                (ps == _PS_AFTER) | (ps == _PS_VAL_OR_CLOSE))
+            valid = valid & ~((close_obj | close_arr)
+                              & ~(ok_close_obj | ok_close_arr))
+            do_close = ok_close_obj | ok_close_arr
+            depth = depth - jnp.where(do_close, 1, 0).astype(_I32)
+            ps = jnp.where(do_close, _PS_AFTER, ps)
+            close_match = do_close & (mdepth == depth)
+            mend = jnp.where(close_match, j + 1, mend)
+            mdepth = jnp.where(close_match, -1, mdepth)
+
+            # comma / colon (parent lanes AFTER any pop)
+            ohd1b = d_iota == (depth - 1)[:, None]
+            in_obj_now = (depth > 0) & jnp.any(ohd1b & s_isobj, axis=1)
+            ok_comma = comma & (ps == _PS_AFTER) & (depth > 0)
+            valid = valid & ~(comma & ~ok_comma)
+            ps = jnp.where(ok_comma,
+                           jnp.where(in_obj_now, _PS_KEY, _PS_VALUE), ps)
+            ok_colon = colon & (ps == _PS_COLON)
+            valid = valid & ~(colon & ~ok_colon)
+            ps = jnp.where(ok_colon, _PS_VALUE, ps)
+
+            # scalar token starts
+            n_qs = jnp.where((val_start | key_start) & quote,
+                             jnp.where(c == _U8(34), _U8(1), _U8(2)), n_qs)
+            n_is_key = jnp.where(key_start, True, n_is_key)
+            n_key_live = jnp.where(key_start, (pv >= 0) & p_named,
+                                   key_live)
+            # key recognition was hoisted: keq lanes say whether the
+            # string starting HERE equals each distinct path name
+            lane_sel = _onehot_val(pv, lane_of, default=-1)
+            keq_any = jnp.zeros(rows, _B)
+            for ln in range(NL):
+                keq_any = keq_any | ((lane_sel == ln) & keq[:, ln])
+            n_key_match = jnp.where(key_start, keq_any, key_match)
+
+            n_pstate = jnp.where(
+                num_start & can_value,
+                jnp.where(c == _U8(45), _U8(_N_SIGN),
+                          jnp.where(c == _U8(48), _U8(_N_ZERO),
+                                    _U8(_N_DIG))), n_pstate)
+            n_pneg = jnp.where(num_start & can_value, c == _U8(45), pneg)
+            n_pfloat = jnp.where(num_start & can_value, False, n_pfloat)
+            ps = jnp.where(num_start & can_value, _PS_PRIM, ps)
+
+            # literal start: pre-validated token, just skip its tail
+            lit_go = lit_start & can_value
+            valid = valid & ~(lit_go & ~lok)
+            n_lrem = jnp.where(lit_go, llen - 1, n_lrem)
+            ps = jnp.where(lit_go & (llen > 1), _PS_LIT, ps)
+            mend = jnp.where(lit_go & scalar_match, j + llen, mend)
+            n_sact = jnp.where(lit_go & scalar_match, False, n_sact)
+
+            # end-of-document check (exactly once, at j == lens)
+            valid = valid & jnp.where(
+                at_end, (ps == _PS_AFTER) & (depth == 0) & (n_qs == 0),
+                True)
+
+            return ((n_qs, n_esc, n_urem, ps.astype(_U8), valid, depth,
+                     n_pend, n_is_key, n_key_match, n_key_live, n_pstate,
+                     n_pneg, n_pfloat, n_lrem, n_sact, mcount, mstart,
+                     mend, mkind, mdepth, mfloat, mneg, f_ws, f_sq,
+                     f_escun, f_ctrl, f_anyesc, f_float, f_negz, fb,
+                     s_isobj, s_cvpos, s_elem), None)
+
+        z_b = jnp.zeros(rows, _B)
+        carry0 = (
+            jnp.zeros(rows, _U8),            # qs
+            z_b,                             # esc
+            jnp.zeros(rows, _U8),            # u_rem
+            jnp.full(rows, _PS_VALUE, _U8),  # ps
+            jnp.ones(rows, _B),              # valid
+            jnp.zeros(rows, _I32),           # depth
+            jnp.full(rows, -1, _I32),        # pend
+            z_b,                             # is_key
+            z_b,                             # key_match
+            z_b,                             # key_live
+            jnp.zeros(rows, _U8),            # pstate
+            z_b,                             # pneg
+            z_b,                             # pfloat
+            jnp.zeros(rows, _I32),           # lrem
+            z_b,                             # sact
+            jnp.zeros(rows, _I32),           # mcount
+            jnp.zeros(rows, _I32),           # mstart
+            jnp.zeros(rows, _I32),           # mend
+            jnp.zeros(rows, _U8),            # mkind
+            jnp.full(rows, -1, _I32),        # mdepth
+            z_b,                             # mfloat
+            z_b,                             # mneg
+            z_b, z_b, z_b, z_b, z_b, z_b, z_b,  # f_ws..f_negz
+            z_b,                             # fb
+            jnp.zeros((rows, D), _B),        # s_isobj
+            jnp.full((rows, D), -1, _I32),   # s_cvpos
+            jnp.zeros((rows, D), _I32),      # s_elem
+        )
+        js = jnp.arange(L1, dtype=_I32)
+        xs = (js, chars.T, jnp.moveaxis(keyeq, 1, 0), lit_ok.T,
+              lit_len.T)
+        final, _ = lax.scan(step, carry0, xs)
+        (qs, esc, u_rem, ps, valid, depth, pend, is_key, key_match,
+         key_live, pstate, pneg, pfloat, lrem, sact, mcount, mstart,
+         mend, mkind, mdepth, mfloat, mneg, f_ws, f_sq, f_escun, f_ctrl,
+         f_anyesc, f_float, f_negz, fb, s_isobj, s_cvpos, s_elem) = final
+        return (valid, mcount, mstart, mend, mkind, mfloat, mneg,
+                f_ws, f_sq, f_escun, f_ctrl, f_anyesc, f_float, f_negz,
+                fb)
+
+    return jax.jit(scan)
+
+
+# statistics from the most recent device evaluation (tests/bench probes)
+last_stats = {"rows": 0, "fallback_rows": 0, "device_rows": 0}
+
+
+def _padded_with_terminator(col: Column):
+    """(rows, L+1) padded char matrix + lengths — built once per column
+    and shared across paths by the multi-path entry."""
+    chars, lens = col.to_padded_chars()
+    rows = chars.shape[0]
+    # one extra terminator column so end-of-doc handling fires at j==lens
+    chars = jnp.concatenate(
+        [chars, jnp.zeros((rows, 1), jnp.uint8)], axis=1)
+    return chars, lens
+
+
+def _scan_column(col: Column, instructions, padded=None) -> List[np.ndarray]:
+    """Run the path-matching scan, chunked over rows; host-side results."""
+    fn = _build_scan(_compile_path(instructions))
+    chars, lens = padded if padded is not None \
+        else _padded_with_terminator(col)
+    rows = chars.shape[0]
+    outs: List[List[np.ndarray]] = []
+    for c0 in range(0, rows, DEVICE_ROW_CHUNK):
+        c1 = min(rows, c0 + DEVICE_ROW_CHUNK)
+        res = fn(chars[c0:c1], lens[c0:c1])
+        outs.append([np.asarray(x) for x in res])
+    return [np.concatenate([o[i] for o in outs]) for i in
+            range(len(outs[0]))]
+
+
+def get_json_object_device(col: Column, path: str,
+                           _padded=None) -> Column:
+    """Device-first get_json_object with per-row host fallback.
+
+    Matches ops/json_path.get_json_object_host exactly for valid UTF-8
+    input (the host evaluator is the oracle for flagged rows).  For
+    documents containing invalid UTF-8 — out of contract for Spark
+    strings — verbatim device rows pass the raw bytes through while
+    host-rendered rows substitute U+FFFD."""
+    from spark_rapids_tpu.ops import json_path as JP
+
+    assert col.dtype.is_string
+    rows = col.length
+    instructions = JP.parse_path(path)
+    if instructions is None or rows == 0:
+        return Column.from_strings([None] * rows)
+
+    (valid, mcount, mstart, mend, mkind, mfloat, mneg, f_ws, f_sq,
+     f_escun, f_ctrl, f_anyesc, f_float, f_negz, fb) = \
+        _scan_column(col, instructions, padded=_padded)
+
+    in_valid = (np.ones(rows, bool) if col.validity is None
+                else np.asarray(col.validity).astype(bool)[:rows])
+
+    # per-row verbatim-safety decision
+    is_str = mkind == _K_STR
+    is_num = mkind == _K_NUM
+    is_nested = (mkind == _K_OBJ) | (mkind == _K_ARR)
+    nested_unsafe = f_ws | f_sq | f_escun | f_ctrl | f_float | f_negz
+    fast_ok = np.where(
+        is_str, ~f_anyesc,
+        np.where(is_num, ~(mfloat | mneg),
+                 np.where(is_nested, ~nested_unsafe, True)))
+    need_host = in_valid & (fb | (valid & (
+        (mcount > 1) | ((mcount == 1) & ~fast_ok))))
+    dev_copy = in_valid & ~need_host & valid & (mcount == 1)
+    out_null = ~dev_copy & ~need_host          # null on device path
+
+    # spans into the flat char buffer
+    offs = np.asarray(col.offsets)
+    span_start = offs[:-1] + np.where(is_str, mstart + 1, mstart)
+    span_len = np.where(is_str, mend - mstart - 2, mend - mstart)
+    span_len = np.where(dev_copy, np.maximum(span_len, 0), 0)
+
+    # host fallback rows
+    fb_idx = np.nonzero(need_host)[0]
+    fb_bytes = b""
+    fb_lens = np.zeros(rows, np.int64)
+    fb_starts = np.zeros(rows, np.int64)
+    fb_null = np.zeros(rows, bool)
+    if fb_idx.size:
+        all_chars = np.asarray(col.data).tobytes()
+        pieces = []
+        pos = 0
+        for i in fb_idx:
+            doc = all_chars[offs[i]: offs[i + 1]].decode(
+                "utf-8", errors="replace")
+            r = JP._run_one(doc, instructions)
+            if r is None:
+                fb_null[i] = True
+                continue
+            rb = r.encode("utf-8", "replace")
+            fb_starts[i] = pos
+            fb_lens[i] = len(rb)
+            pieces.append(rb)
+            pos += len(rb)
+        fb_bytes = b"".join(pieces)
+
+    global last_stats
+    last_stats = {"rows": int(rows), "fallback_rows": int(fb_idx.size),
+                  "device_rows": int(dev_copy.sum())}
+
+    # assemble: gather from [device chars ++ fallback bytes]
+    base = int(offs[-1])
+    src_start = np.where(need_host, base + fb_starts, span_start)
+    out_len = np.where(need_host, fb_lens, span_len).astype(np.int64)
+    validity_out = in_valid & ~out_null & ~(need_host & fb_null)
+    out_len = np.where(validity_out, out_len, 0)
+
+    new_offs = np.zeros(rows + 1, np.int32)
+    np.cumsum(out_len, out=new_offs[1:])
+    total = int(new_offs[-1])
+    if fb_bytes:
+        fb_arr = jnp.asarray(np.frombuffer(fb_bytes, np.uint8))
+        src = jnp.concatenate([col.data.astype(jnp.uint8), fb_arr])
+    else:
+        src = col.data.astype(jnp.uint8)
+    offs_j = jnp.asarray(new_offs)
+    if total:
+        i_flat = jnp.arange(total, dtype=_I32)
+        r = jnp.searchsorted(offs_j, i_flat, side="right").astype(_I32) - 1
+        cpos = i_flat - offs_j[r]
+        srcs = jnp.asarray(src_start.astype(np.int64))
+        data = src[jnp.clip(srcs[r] + cpos, 0, src.shape[0] - 1)]
+    else:
+        data = jnp.zeros(0, jnp.uint8)
+    v = None if validity_out.all() else jnp.asarray(
+        validity_out.astype(np.uint8))
+    return Column(dtypes.STRING, rows, data=data, validity=v,
+                  offsets=offs_j)
+
+
+def get_json_object_multiple_paths_device(
+        col: Column, paths: Sequence[str],
+        memory_budget_bytes: int = -1,
+        parallel_override: int = -1) -> List[Column]:
+    """Multi-path batch over the device scan (get_json_object.hpp:9).
+
+    Each path compiles to its own specialized scan; the padded char
+    matrix is built ONCE here and shared by every path's scan.  The
+    budget knobs shape row chunking exactly as the reference's scratch
+    budget shapes path chunking."""
+    padded = _padded_with_terminator(col) if col.length else None
+    return [get_json_object_device(col, p, _padded=padded)
+            for p in paths]

@@ -16,6 +16,7 @@ scratch fits the budget — the same chunking contract, applied host-side.
 
 from __future__ import annotations
 
+import os
 from typing import List, Optional, Sequence, Tuple, Union
 
 from spark_rapids_tpu.columns.column import Column
@@ -203,10 +204,29 @@ class _Parser:
                     if len(hexs) < 4:
                         raise _Invalid()
                     try:
-                        out.append(chr(int(hexs, 16)))
+                        cp = int(hexs, 16)
                     except ValueError:
                         raise _Invalid()
                     self.i += 5
+                    # combine surrogate pairs (json.dumps ensure_ascii
+                    # writes emoji as 😀); lone surrogates are
+                    # unencodable in UTF-8 -> U+FFFD like Java's replace
+                    if 0xD800 <= cp <= 0xDBFF and \
+                            self.s[self.i: self.i + 2] == "\\u":
+                        hex2 = self.s[self.i + 2: self.i + 6]
+                        try:
+                            lo = int(hex2, 16)
+                        except ValueError:
+                            lo = -1
+                        if 0xDC00 <= lo <= 0xDFFF:
+                            cp = 0x10000 + ((cp - 0xD800) << 10) \
+                                + (lo - 0xDC00)
+                            self.i += 6
+                        else:
+                            cp = 0xFFFD
+                    elif 0xD800 <= cp <= 0xDFFF:
+                        cp = 0xFFFD
+                    out.append(chr(cp))
                     continue
                 if e not in _ESCAPES:
                     raise _Invalid()
@@ -358,12 +378,30 @@ def _run_one(doc: Optional[str], path: Optional[List]) -> Optional[str]:
     return "[" + ",".join(_render_json(m) for m in matches) + "]"
 
 
-def get_json_object(col: Column, path: str) -> Column:
-    """One strings column of extraction results (JSONUtils.getJsonObject)."""
+def get_json_object_host(col: Column, path: str) -> Column:
+    """Host evaluator (the oracle for the device engine's fallback rows)."""
     assert col.dtype.is_string
     instructions = parse_path(path)
     vals = col.to_pylist()
     return Column.from_strings([_run_one(v, instructions) for v in vals])
+
+
+# rows at or above this count route through the device scan; tiny columns
+# stay host-side where compile cost would dominate (override via env)
+DEVICE_MIN_ROWS = int(os.environ.get("SPARK_RAPIDS_TPU_JSON_MIN_ROWS", 32))
+
+
+def get_json_object(col: Column, path: str) -> Column:
+    """One strings column of extraction results (JSONUtils.getJsonObject).
+
+    Device-first: the vectorized scan in ops/json_device.py handles the
+    column, falling back to the host evaluator per flagged row."""
+    mode = os.environ.get("SPARK_RAPIDS_TPU_JSON", "auto")
+    if mode != "host" and (mode == "device"
+                           or col.length >= DEVICE_MIN_ROWS):
+        from spark_rapids_tpu.ops.json_device import get_json_object_device
+        return get_json_object_device(col, path)
+    return get_json_object_host(col, path)
 
 
 def get_json_object_multiple_paths(col: Column, paths: Sequence[str],

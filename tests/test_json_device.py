@@ -1,0 +1,162 @@
+"""Device get_json_object engine vs the host oracle.
+
+Replays every golden vector family from test_json_uri_strings.py through
+the device scan (ops/json_device.py) and differentially fuzzes it
+against the host evaluator; also asserts the verbatim fast path really
+stays on device for compact machine JSON."""
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.ops import json_device as JD
+from spark_rapids_tpu.ops import json_path as JP
+
+
+def dev(docs, path):
+    return JD.get_json_object_device(
+        Column.from_strings(docs), path).to_pylist()
+
+
+def host(docs, path):
+    return JP.get_json_object_host(
+        Column.from_strings(docs), path).to_pylist()
+
+
+def check(docs, path):
+    assert dev(docs, path) == host(docs, path)
+
+
+def test_device_basic_paths():
+    docs = ['{"k": "v"}', '{"k1": {"k2": "v"}}', '{"a": 7}',
+            '{"a": true}', '{"a": null}', '{"a": [1, 2]}',
+            '{"a": {"x": 1, "y": "z"}}', '{"a": 1}', "not json", None]
+    for p in ["$.k", "$.k1.k2", "$.a", "$.b", "$.a.x"]:
+        check(docs, p)
+    assert dev(['{"a": 1}'], "bad path") == [None]
+
+
+def test_device_arrays_wildcards_flatten():
+    docs = ['{"a": [{"b": 1}, {"b": 2}, {"c": 3}]}',
+            '{"a": [{"b": "only"}]}',
+            '{"a": [[1,2],[3]]}',
+            '{"a": []}', '[1,2,3]']
+    for p in ["$.a[0]", "$.a[0].b", "$.a[*].b", "$.a[9]", "$.a.b",
+              "$.a", "$[1]", "$[*]"]:
+        check(docs, p)
+
+
+def test_device_tolerant_parser():
+    docs = ["{'k': 'v'}", '{"k": "a\\nb"}', '{"k": "\\u0041"}',
+            '{ "k" :  42 }', '{"k": 1.5e3}', '{"k" "v"}', '{"k":}',
+            '{"k": 1,}', '[1 2]', '""', "''", '" x "', "{}", "[]",
+            '  {"k": 3}  ', '\t[true]\n']
+    check(docs, "$.k")
+    check(docs, "$")
+
+
+def test_device_number_normalization_vectors():
+    nums = ["[100.0,200.000,351.980]", "[12345678900000000000.0]",
+            "[0.0]", "[-0.0]", "[-0]", "[12345678999999999999999999]",
+            "[9.299999257686047e-0005603333574677677]",
+            "9.299999257686047e0005603333574677677", "[1E308]",
+            "[1.0E309,-1E309,1E5000]", "0.3", "0.03", "0.003", "0.0003",
+            "0.00003"]
+    check(nums, "$")
+    check(nums, "$[0]")
+
+
+def test_device_leading_zeros():
+    zeros = ["00", "01", "02", "000", "-01", "-00", "-02",
+             "0", "-0", "0.5", "1e007", "1.", "-", ".5", "+1",
+             "1e", "1e+", "01.5", "truex", "tru", "nul", "falsee"]
+    check(zeros, "$")
+
+
+def test_device_escape_vectors():
+    docs = ["{ \"a\": \"A\" }", "{'a':'A\"'}", "{'a':\"B'\"}",
+            "['a','b','\"C\"']",
+            "'\\u4e2d\\u56FD\\\"\\'\\\\\\/\\b\\f\\n\\r\\t\\b'"]
+    check(docs, "$")
+    check(docs, "$.a")
+
+
+def test_device_bracket_names():
+    docs = ['{"a b": 5}', '{"a": {"b c": [10, 20]}}']
+    check(docs, "$['a b']")
+    check(docs, "$.a['b c'][1]")
+
+
+def test_device_deep_nesting_falls_back():
+    deep = "[" * 40 + "1" + "]" * 40
+    check([deep], "$")
+    check([deep], "$[0]")
+
+
+def test_device_fast_path_stays_on_device():
+    docs = ['{"name":"u%d","id":%d,"tags":["a","b"],"info":{"x":1}}'
+            % (i, i) for i in range(64)]
+    col = Column.from_strings(docs)
+    out = JD.get_json_object_device(col, "$.name")
+    assert JD.last_stats["fallback_rows"] == 0
+    assert out.to_pylist() == [f"u{i}" for i in range(64)]
+    out2 = JD.get_json_object_device(col, "$.info")
+    assert JD.last_stats["fallback_rows"] == 0
+    assert out2.to_pylist() == ['{"x":1}'] * 64
+    out3 = JD.get_json_object_device(col, "$.id")
+    assert JD.last_stats["fallback_rows"] == 0
+    assert out3.to_pylist() == [str(i) for i in range(64)]
+
+
+def _rand_json(rng, depth=0):
+    r = rng.random()
+    if depth > 3 or r < 0.25:
+        return rng.choice(
+            [1, -5, 0, 3.25, 1e3, True, False, None, "s", "a b",
+             'q"x', 17, 123456789012345678901234567890])
+    if r < 0.55:
+        return {rng.choice("abcde"): _rand_json(rng, depth + 1)
+                for _ in range(rng.randrange(4))}
+    return [_rand_json(rng, depth + 1) for _ in range(rng.randrange(4))]
+
+
+def test_device_differential_fuzz():
+    rng = random.Random(7)
+    docs = []
+    for _ in range(300):
+        v = _rand_json(rng)
+        s = json.dumps(v)
+        if rng.random() < 0.3:
+            s = s.replace('"', "'")
+        if rng.random() < 0.2:
+            s = " " + s.replace(":", " : ") + "  "
+        if rng.random() < 0.1:
+            s = s[: max(1, len(s) - 2)]   # corrupt tail
+        docs.append(s)
+    docs += [None, "", "{", "}", "[[]", '{"a"}', '{"a":1 2}']
+    for path in ["$", "$.a", "$.a.b", "$.a[0]", "$.a[*]", "$[0]",
+                 "$.b.c", "$['a']", "$.a[1].b"]:
+        assert dev(docs, path) == host(docs, path), f"path {path}"
+
+
+def test_device_surrogate_escapes():
+    """ensure_ascii emoji (escaped surrogate pairs) must not crash the
+    column; lone surrogates render as U+FFFD (unencodable in UTF-8)."""
+    docs = ['{"a":"\\ud83d\\ude00"}', '{"a":"\\ud83d"}',
+            '{"a":"\\udc00x"}', '{"a":"ok"}']
+    expect = ["😀", "�", "�x", "ok"]
+    assert host(docs, "$.a") == expect
+    assert dev(docs * 16, "$.a") == expect * 16
+
+
+def test_device_multi_path():
+    docs = ['{"a": 1, "b": "two", "c": [1,2]}'] * 5 + ['{"a": 9}']
+    outs = JD.get_json_object_multiple_paths_device(
+        Column.from_strings(docs), ["$.a", "$.b", "$.c", "$.d"])
+    expect = JP.get_json_object_multiple_paths(
+        Column.from_strings(docs), ["$.a", "$.b", "$.c", "$.d"])
+    for o, e in zip(outs, expect):
+        assert o.to_pylist() == e.to_pylist()

@@ -1,0 +1,103 @@
+"""ORC timezone rectification vs an independent zoneinfo oracle
+(reference GpuTimeZoneDBTest.testConvertOrcTimezones +
+convertOrcTimezonesOnCPU, SerializationUtils.convertBetweenTimezones)."""
+
+import datetime
+import random
+import zoneinfo
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.ops import orc_timezones as OT
+
+# the reference test's zone list minus DST zones (which the reference
+# rejects too) plus a fixed-offset id
+ZONES = ["Asia/Shanghai", "Antarctica/DumontDUrville", "Etc/GMT-12",
+         "Asia/Tokyo", "UTC", "+05:30"]
+
+
+def _oracle_offset_ms(zone_id, ms, info):
+    """java.util.TimeZone.getOffset oracle: zoneinfo for instants inside
+    the historical table; the documented raw-offset rule outside it."""
+    if info.transitions is None:
+        return info.raw_offset
+    if ms < info.transitions[0] or ms >= info.transitions[-1]:
+        return info.raw_offset
+    tz = zoneinfo.ZoneInfo(zone_id)
+    dt = datetime.datetime.fromtimestamp(ms / 1000.0, tz)
+    return int(tz.utcoffset(dt).total_seconds() * 1000)
+
+
+def _oracle_convert(us, wtz, rtz):
+    wi = OT.get_orc_timezone_info(wtz)
+    ri = OT.get_orc_timezone_info(rtz)
+    ms = us // 1000  # python floor division == Math.floorDiv
+    wo = _oracle_offset_ms(wtz, ms, wi)
+    ro = _oracle_offset_ms(rtz, ms, ri)
+    adj = ms + wo - ro
+    ra = _oracle_offset_ms(rtz, adj, ri)
+    return us + (wo - ra) * 1000
+
+
+def test_orc_timezone_pairs():
+    rng = random.Random(20260729)
+    lo = int(datetime.datetime(1880, 1, 1,
+                               tzinfo=datetime.timezone.utc).timestamp()
+             * 1_000_000)
+    hi = int(datetime.datetime(9999, 12, 31,
+                               tzinfo=datetime.timezone.utc).timestamp()
+             * 1_000_000)
+    us = np.array([rng.randrange(lo, hi) for _ in range(256)]
+                  + [0, 1, -1, -1001, 1001, lo, hi], np.int64)
+    for wtz in ZONES:
+        for rtz in ZONES:
+            col = Column.from_numpy(us, dtype=dtypes.TIMESTAMP_MICROS)
+            out = np.asarray(
+                OT.convert_orc_timezones(col, wtz, rtz).data)
+            exp = np.array([_oracle_convert(int(u), wtz, rtz)
+                            for u in us], np.int64)
+            mism = np.nonzero(out != exp)[0]
+            assert mism.size == 0, (
+                f"{wtz}->{rtz}: row {mism[:3]} us={us[mism[:3]]} "
+                f"got {out[mism[:3]]} want {exp[mism[:3]]}")
+
+
+def test_orc_timezone_dst_rejected():
+    col = Column.from_numpy(np.zeros(1, np.int64),
+                            dtype=dtypes.TIMESTAMP_MICROS)
+    with pytest.raises(NotImplementedError):
+        OT.convert_orc_timezones(col, "America/Los_Angeles", "UTC")
+    with pytest.raises(NotImplementedError):
+        OT.convert_orc_timezones(col, "UTC", "Australia/Sydney")
+
+
+def test_orc_timezone_invalid_id():
+    with pytest.raises(ValueError):
+        OT.get_orc_timezone_info("Invalid/Zone")
+    with pytest.raises(ValueError):
+        OT.get_orc_timezone_info("+25:00")
+
+
+def test_orc_dst_detection():
+    assert OT.has_daylight_saving_time("America/Los_Angeles")
+    assert OT.has_daylight_saving_time("Australia/Sydney")
+    assert not OT.has_daylight_saving_time("Asia/Shanghai")
+    assert not OT.has_daylight_saving_time("Asia/Tokyo")
+    assert not OT.has_daylight_saving_time("UTC")
+    assert not OT.has_daylight_saving_time("+05:30")
+    assert not OT.has_daylight_saving_time("Etc/GMT-12")
+
+
+def test_orc_fixed_offset_ids():
+    info = OT.get_orc_timezone_info("+05:30")
+    assert info.raw_offset == 19800000 and info.transitions is None
+    # Etc/GMT-12 is POSIX-inverted: UTC+12... no, Etc/GMT-12 = UTC+12
+    info12 = OT.get_orc_timezone_info("Etc/GMT-12")
+    assert info12.raw_offset == 12 * 3600 * 1000
+    sh = OT.get_orc_timezone_info("Asia/Shanghai")
+    assert sh.raw_offset == 8 * 3600 * 1000
+    assert sh.transitions is not None
+    assert (np.diff(sh.transitions) > 0).all()
