@@ -201,12 +201,12 @@ class _Parser:
                 e = self.s[self.i]
                 if e == "u":
                     hexs = self.s[self.i + 1: self.i + 5]
-                    if len(hexs) < 4:
+                    # strict 4 hex digits (int() would tolerate ' 041',
+                    # '0x..', '1_2' — Java and the device DFA reject)
+                    if len(hexs) < 4 or not all(
+                            c in "0123456789abcdefABCDEF" for c in hexs):
                         raise _Invalid()
-                    try:
-                        cp = int(hexs, 16)
-                    except ValueError:
-                        raise _Invalid()
+                    cp = int(hexs, 16)
                     self.i += 5
                     # combine surrogate pairs (json.dumps ensure_ascii
                     # writes emoji as 😀); lone surrogates are
@@ -214,9 +214,11 @@ class _Parser:
                     if 0xD800 <= cp <= 0xDBFF and \
                             self.s[self.i: self.i + 2] == "\\u":
                         hex2 = self.s[self.i + 2: self.i + 6]
-                        try:
+                        if len(hex2) == 4 and all(
+                                c in "0123456789abcdefABCDEF"
+                                for c in hex2):
                             lo = int(hex2, 16)
-                        except ValueError:
+                        else:
                             lo = -1
                         if 0xDC00 <= lo <= 0xDFFF:
                             cp = 0x10000 + ((cp - 0xD800) << 10) \
@@ -410,8 +412,17 @@ def get_json_object_multiple_paths(col: Column, paths: Sequence[str],
                                    ) -> List[Column]:
     """One output column per path (get_json_object.hpp:9 multi-path batch).
     The budget/parallel knobs shape chunking in the reference kernel; the
-    host evaluator parses each document once per chunk of paths."""
+    host evaluator parses each document once per chunk of paths.  Large
+    columns route through the device engine (padded matrix built once,
+    shared across paths), same rule as get_json_object."""
     assert col.dtype.is_string
+    mode = os.environ.get("SPARK_RAPIDS_TPU_JSON", "auto")
+    if mode != "host" and (mode == "device"
+                           or col.length >= DEVICE_MIN_ROWS):
+        from spark_rapids_tpu.ops.json_device import \
+            get_json_object_multiple_paths_device
+        return get_json_object_multiple_paths_device(
+            col, paths, memory_budget_bytes, parallel_override)
     parsed_paths = [parse_path(p) for p in paths]
     vals = col.to_pylist()
     if parallel_override > 0:

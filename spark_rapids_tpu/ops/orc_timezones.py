@@ -63,6 +63,8 @@ def _parse_fixed_offset(zone_id: str) -> Optional[int]:
     """Offset millis for '+05:30'-style ids (valid ZoneIds that
     java.util.TimeZone would silently map to GMT; the reference derives
     the offset from ZoneRules instead, OrcTimezoneInfo.java:131-139)."""
+    if zone_id == "Z":          # java ZoneId accepts bare 'Z' for UTC
+        return 0
     zid = zone_id
     if zid.upper().startswith(("UTC+", "UTC-", "GMT+", "GMT-")):
         zid = zid[3:]

@@ -5,16 +5,19 @@ Reference: src/main/cpp/src/hash/sha.cpp (sha224/256/384/512_nulls_preserved
 HashJni.cpp:134-157 (hostCrc32 — zlib crc32 over a host buffer, used for
 shuffle block checksums).
 
-TPU note: SHA is a bit-serial algorithm with no vector parallelism per
-message; per-row messages are independent, so a Pallas lane-per-row SHA-256
-is feasible but low-value (Spark uses sha for checksumming, not joins).
-This implementation computes digests on host via hashlib — the same
-host-path decision the reference makes for CRC32.
+TPU note: per-row messages are independent, so SHA vectorizes as one
+lane per row — ops/sha_device.py runs the block compression for every
+row simultaneously with a lax.scan over message blocks.  Columns at or
+above DEVICE_MIN_ROWS route there (override with SPARK_RAPIDS_TPU_SHA=
+host|device); tiny columns use the hashlib host path, which doubles as
+the differential oracle.  CRC32 stays host zlib — the same decision the
+reference makes (HashJni.cpp hostCrc32).
 """
 
 from __future__ import annotations
 
 import hashlib
+import os
 import zlib
 from typing import Optional, Union
 
@@ -22,6 +25,17 @@ import numpy as np
 
 from spark_rapids_tpu.columns.column import Column
 from spark_rapids_tpu.columns.dtypes import Kind
+
+DEVICE_MIN_ROWS = int(os.environ.get("SPARK_RAPIDS_TPU_SHA_MIN_ROWS", 32))
+
+
+def _use_device(col: Column) -> bool:
+    mode = os.environ.get("SPARK_RAPIDS_TPU_SHA", "auto")
+    if mode == "host":
+        return False
+    if not (col.dtype.is_string or col.dtype.is_fixed_width):
+        return False
+    return mode == "device" or col.length >= DEVICE_MIN_ROWS
 
 
 def _row_bytes(col: Column):
@@ -49,20 +63,27 @@ def _sha_impl(algo_name: str, col: Column) -> Column:
     return Column.from_strings(out)
 
 
+def _sha(algo_name: str, bits: int, col: Column) -> Column:
+    if _use_device(col):
+        from spark_rapids_tpu.ops import sha_device
+        return sha_device._sha_device(col, bits)
+    return _sha_impl(algo_name, col)
+
+
 def sha224_nulls_preserved(col: Column) -> Column:
-    return _sha_impl("sha224", col)
+    return _sha("sha224", 224, col)
 
 
 def sha256_nulls_preserved(col: Column) -> Column:
-    return _sha_impl("sha256", col)
+    return _sha("sha256", 256, col)
 
 
 def sha384_nulls_preserved(col: Column) -> Column:
-    return _sha_impl("sha384", col)
+    return _sha("sha384", 384, col)
 
 
 def sha512_nulls_preserved(col: Column) -> Column:
-    return _sha_impl("sha512", col)
+    return _sha("sha512", 512, col)
 
 
 def host_crc32(crc: int, buffer: Optional[Union[bytes, np.ndarray]],

@@ -1,0 +1,304 @@
+"""Device SHA-2: lane-per-row vectorized SHA-224/256/384/512.
+
+Reference: src/main/cpp/src/hash/sha.cpp delegates to cudf's device SHA
+(one thread per row); here every row is a vector lane and the block loop
+is a lax.scan — the same shape as ops/hash.py's xxhash64 block scan.
+
+Message padding (0x80, zero fill, 8/16-byte big-endian bit length) is
+materialized as a (rows, max_blocks*B) byte matrix with closed-form
+selects, then packed big-endian into 32/64-bit schedule words.  Rows
+with fewer blocks than max_blocks mask their state updates off once
+their block count is reached, so mixed-length columns hash correctly in
+one pass.  Output is the lowercase hex digest as a strings column,
+matching hashlib/cudf byte-for-byte (tests/test_sha_device.py runs the
+hashlib differential).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+
+_U8 = jnp.uint8
+_U32 = jnp.uint32
+_U64 = jnp.uint64
+_I32 = jnp.int32
+
+_K256 = np.array([
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b,
+    0x59f111f1, 0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01,
+    0x243185be, 0x550c7dc3, 0x72be5d74, 0x80deb1fe, 0x9bdc06a7,
+    0xc19bf174, 0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+    0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da, 0x983e5152,
+    0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc,
+    0x53380d13, 0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+    0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3, 0xd192e819,
+    0xd6990624, 0xf40e3585, 0x106aa070, 0x19a4c116, 0x1e376c08,
+    0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f,
+    0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2], np.uint32)
+
+_IV256 = np.array([0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                   0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19],
+                  np.uint32)
+_IV224 = np.array([0xc1059ed8, 0x367cd507, 0x3070dd17, 0xf70e5939,
+                   0xffc00b31, 0x68581511, 0x64f98fa7, 0xbefa4fa4],
+                  np.uint32)
+
+_K512 = np.array([
+    0x428a2f98d728ae22, 0x7137449123ef65cd, 0xb5c0fbcfec4d3b2f,
+    0xe9b5dba58189dbbc, 0x3956c25bf348b538, 0x59f111f1b605d019,
+    0x923f82a4af194f9b, 0xab1c5ed5da6d8118, 0xd807aa98a3030242,
+    0x12835b0145706fbe, 0x243185be4ee4b28c, 0x550c7dc3d5ffb4e2,
+    0x72be5d74f27b896f, 0x80deb1fe3b1696b1, 0x9bdc06a725c71235,
+    0xc19bf174cf692694, 0xe49b69c19ef14ad2, 0xefbe4786384f25e3,
+    0x0fc19dc68b8cd5b5, 0x240ca1cc77ac9c65, 0x2de92c6f592b0275,
+    0x4a7484aa6ea6e483, 0x5cb0a9dcbd41fbd4, 0x76f988da831153b5,
+    0x983e5152ee66dfab, 0xa831c66d2db43210, 0xb00327c898fb213f,
+    0xbf597fc7beef0ee4, 0xc6e00bf33da88fc2, 0xd5a79147930aa725,
+    0x06ca6351e003826f, 0x142929670a0e6e70, 0x27b70a8546d22ffc,
+    0x2e1b21385c26c926, 0x4d2c6dfc5ac42aed, 0x53380d139d95b3df,
+    0x650a73548baf63de, 0x766a0abb3c77b2a8, 0x81c2c92e47edaee6,
+    0x92722c851482353b, 0xa2bfe8a14cf10364, 0xa81a664bbc423001,
+    0xc24b8b70d0f89791, 0xc76c51a30654be30, 0xd192e819d6ef5218,
+    0xd69906245565a910, 0xf40e35855771202a, 0x106aa07032bbd1b8,
+    0x19a4c116b8d2d0c8, 0x1e376c085141ab53, 0x2748774cdf8eeb99,
+    0x34b0bcb5e19b48a8, 0x391c0cb3c5c95a63, 0x4ed8aa4ae3418acb,
+    0x5b9cca4f7763e373, 0x682e6ff3d6b2b8a3, 0x748f82ee5defb2fc,
+    0x78a5636f43172f60, 0x84c87814a1f0ab72, 0x8cc702081a6439ec,
+    0x90befffa23631e28, 0xa4506cebde82bde9, 0xbef9a3f7b2c67915,
+    0xc67178f2e372532b, 0xca273eceea26619c, 0xd186b8c721c0c207,
+    0xeada7dd6cde0eb1e, 0xf57d4f7fee6ed178, 0x06f067aa72176fba,
+    0x0a637dc5a2c898a6, 0x113f9804bef90dae, 0x1b710b35131c471b,
+    0x28db77f523047d84, 0x32caab7b40c72493, 0x3c9ebe0a15c9bebc,
+    0x431d67c49c100d4c, 0x4cc5d4becb3e42b6, 0x597f299cfc657e2a,
+    0x5fcb6fab3ad6faec, 0x6c44198c4a475817], np.uint64)
+
+_IV512 = np.array([0x6a09e667f3bcc908, 0xbb67ae8584caa73b,
+                   0x3c6ef372fe94f82b, 0xa54ff53a5f1d36f1,
+                   0x510e527fade682d1, 0x9b05688c2b3e6c1f,
+                   0x1f83d9abfb41bd6b, 0x5be0cd19137e2179], np.uint64)
+_IV384 = np.array([0xcbbb9d5dc1059ed8, 0x629a292a367cd507,
+                   0x9159015a3070dd17, 0x152fecd8f70e5939,
+                   0x67332667ffc00b31, 0x8eb44a8768581511,
+                   0xdb0c2e0d64f98fa7, 0x47b5481dbefa4fa4], np.uint64)
+
+
+def _padded_message(chars: jnp.ndarray, lens: jnp.ndarray,
+                    block_bytes: int, len_bytes: int
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(rows, nblocks*B) padded message matrix + (rows,) block counts."""
+    rows, L = chars.shape
+    maxblocks = max((L + len_bytes + 1 + block_bytes - 1) // block_bytes,
+                    1)
+    total = maxblocks * block_bytes
+    nblk = (lens + len_bytes + 1 + block_bytes - 1) // block_bytes
+    blk_end = nblk * block_bytes                     # (rows,)
+    j = jnp.arange(total, dtype=_I32)[None, :]
+    body = jnp.concatenate(
+        [chars, jnp.zeros((rows, total - L), _U8)], axis=1)
+    msg = jnp.where(j < lens[:, None], body, _U8(0))
+    msg = jnp.where(j == lens[:, None], _U8(0x80), msg)
+    # big-endian bit length in the trailing len_bytes of the last block
+    bitlen = (lens.astype(_U64) * _U64(8))
+    lpos = j - (blk_end[:, None] - len_bytes)        # 0..len_bytes-1
+    in_len = (lpos >= 0) & (j < blk_end[:, None])
+    shift = ((len_bytes - 1 - lpos).astype(_U64) * _U64(8))
+    lbyte = ((bitlen[:, None] >> jnp.where(in_len, shift, _U64(0)))
+             & _U64(0xFF)).astype(_U8)
+    msg = jnp.where(in_len & (j >= lens[:, None] + 1), lbyte, msg)
+    return msg, nblk
+
+
+def _rotr32(x, n):
+    return (x >> _U32(n)) | (x << _U32(32 - n))
+
+
+def _rotr64(x, n):
+    return (x >> _U64(n)) | (x << _U64(64 - n))
+
+
+def _sha2_core(chars, lens, iv, *, bits64: bool):
+    """Shared SHA-256/512 compression: outer scan over message blocks,
+    inner scan over rounds with a 16-word sliding schedule window (a
+    fully-unrolled round graph makes LLVM compile time explode; the
+    two-level scan keeps the body ~20 ops)."""
+    rows = chars.shape[0]
+    if bits64:
+        B, LB, NR, dt = 128, 16, 80, _U64
+        K = jnp.asarray(_K512)
+        r1, r2, r3 = (1, 8, 7), (19, 61, 6), (14, 18, 41)
+        r0 = (28, 34, 39)
+        rot, width = _rotr64, 64
+    else:
+        B, LB, NR, dt = 64, 8, 64, _U32
+        K = jnp.asarray(_K256)
+        r1, r2, r3 = (7, 18, 3), (17, 19, 10), (6, 11, 25)
+        r0 = (2, 13, 22)
+        rot, width = _rotr32, 32
+    msg, nblk = _padded_message(chars, lens, B, LB)
+    maxblocks = msg.shape[1] // B
+    nbw = B // 16                                  # bytes per word
+    w8 = msg.reshape(rows, maxblocks, 16, nbw).astype(dt)
+    words = jnp.zeros(w8.shape[:3], dt)
+    for k in range(nbw):
+        words = words | (w8[..., k] << dt(8 * (nbw - 1 - k)))
+    words = jnp.moveaxis(words, 1, 0)              # (blocks, rows, 16)
+    state0 = tuple(jnp.full(rows, iv[i], dt) for i in range(8))
+    ts = jnp.arange(NR, dtype=_I32)
+
+    def block(carry, wblk):
+        state, b = carry
+        win0 = jnp.zeros((rows, 16), dt)
+        # first 16 w's come from the block; later ones from the window
+        w_in = jnp.concatenate(
+            [wblk.T, jnp.zeros((NR - 16, rows), dt)], axis=0)
+
+        def rnd(c, xs):
+            (a, bb, cc, d, e, f, g, h, win) = c
+            k_t, w0_t, t = xs
+            wm16, wm15 = win[:, 0], win[:, 1]
+            wm7, wm2 = win[:, 9], win[:, 14]
+            s0 = rot(wm15, r1[0]) ^ rot(wm15, r1[1]) \
+                ^ (wm15 >> dt(r1[2]))
+            s1 = rot(wm2, r2[0]) ^ rot(wm2, r2[1]) \
+                ^ (wm2 >> dt(r2[2]))
+            w_t = jnp.where(t < 16, w0_t, wm16 + s0 + wm7 + s1)
+            S1 = rot(e, r3[0]) ^ rot(e, r3[1]) ^ rot(e, r3[2])
+            ch = (e & f) ^ (~e & g)
+            t1 = h + S1 + ch + k_t + w_t
+            S0 = rot(a, r0[0]) ^ rot(a, r0[1]) ^ rot(a, r0[2])
+            maj = (a & bb) ^ (a & cc) ^ (bb & cc)
+            t2 = S0 + maj
+            win = jnp.concatenate([win[:, 1:], w_t[:, None]], axis=1)
+            return (t1 + t2, a, bb, cc, d + t1, e, f, g, win), None
+
+        init = state + (win0,)
+        out, _ = lax.scan(rnd, init, (K, w_in, ts))
+        upd = (b < nblk)
+        new = tuple(jnp.where(upd, s + n, s)
+                    for s, n in zip(state, out[:8]))
+        return (new, b + 1), None
+
+    (state, _), _ = lax.scan(block, (state0, jnp.zeros((), _I32)),
+                             words)
+    return state
+
+
+_HEX = jnp.asarray(np.frombuffer(b"0123456789abcdef", np.uint8))
+
+
+def _hex_column(state, word_bits: int, out_words: int,
+                validity) -> Column:
+    """8/6/4-word big-endian state -> lowercase hex strings column."""
+    rows = state[0].shape[0]
+    nbytes_per_word = word_bits // 8
+    digest_bytes = out_words * nbytes_per_word
+    cols = []
+    for wi in range(out_words):
+        wv = state[wi]
+        for k in range(nbytes_per_word):
+            shift = (nbytes_per_word - 1 - k) * 8
+            byte = ((wv >> wv.dtype.type(shift))
+                    & wv.dtype.type(0xFF)).astype(_I32)
+            cols.append(_HEX[byte >> 4])
+            cols.append(_HEX[byte & 0xF])
+    hexmat = jnp.stack(cols, axis=1)          # (rows, digest_bytes*2)
+    n = digest_bytes * 2
+    if validity is None:
+        data = hexmat.reshape(rows * n)
+        offs = jnp.arange(rows + 1, dtype=_I32) * n
+        return Column(dtypes.STRING, rows, data=data, offsets=offs)
+    vmask = np.asarray(validity).astype(bool)[:rows]
+    lens = np.where(vmask, n, 0).astype(np.int64)
+    offs = np.zeros(rows + 1, np.int32)
+    np.cumsum(lens, out=offs[1:])
+    keep = jnp.asarray(np.repeat(vmask, n) if rows else
+                       np.zeros(0, bool))
+    data = hexmat.reshape(rows * n)[keep] if rows else \
+        jnp.zeros(0, _U8)
+    return Column(dtypes.STRING, rows, data=data,
+                  validity=jnp.asarray(vmask.astype(np.uint8)),
+                  offsets=jnp.asarray(offs))
+
+
+def _le_bytes(vals, itemsize: int):
+    """Little-endian byte planes of an unsigned integer array."""
+    out = []
+    for k in range(itemsize):
+        out.append(((vals >> vals.dtype.type(8 * k))
+                    & vals.dtype.type(0xFF)).astype(_U8))
+    return out
+
+
+def _col_bytes_matrix(col: Column):
+    """(rows, L) byte matrix + lengths for string or fixed-width input
+    (fixed-width rows hash their little-endian storage bytes, matching
+    numpy .tobytes() and cudf's byte-wise SHA of the element).  Floats
+    hash their IEEE-754 bit patterns (FLOAT64 data already carries raw
+    uint64 bits per the Column convention; FLOAT32 is bit-cast here)."""
+    from jax import lax as _lax
+
+    if col.dtype.is_string:
+        return col.to_padded_chars()
+    rows = col.length
+    kind = col.dtype.kind
+    if kind == dtypes.Kind.DECIMAL128:
+        # (rows, 4) int32 LE limbs -> 16 LE bytes, limb 0 first
+        limbs = col.data.astype(jnp.uint32)
+        planes = []
+        for limb in range(4):
+            planes.extend(_le_bytes(limbs[:, limb], 4))
+        return (jnp.stack(planes, axis=1),
+                jnp.full(rows, 16, _I32))
+    data = col.data
+    if kind == dtypes.Kind.FLOAT32:
+        data = _lax.bitcast_convert_type(data, jnp.uint32)
+    itemsize = np.dtype(col.dtype.np_dtype).itemsize
+    vals = data.astype({1: jnp.uint8, 2: jnp.uint16,
+                        4: jnp.uint32, 8: jnp.uint64}[itemsize])
+    chars = jnp.stack(_le_bytes(vals, itemsize), axis=1)
+    lens = jnp.full(rows, itemsize, _I32)
+    return chars, lens
+
+
+_SPECS = {224: (_IV224, False, 32, 7), 256: (_IV256, False, 32, 8),
+          384: (_IV384, True, 64, 6), 512: (_IV512, True, 64, 8)}
+
+
+@functools.partial(jax.jit, static_argnames=("bits",))
+def _sha_jit(chars, lens, bits: int):
+    iv, bits64, _, _ = _SPECS[bits]
+    return _sha2_core(chars, lens, iv, bits64=bits64)
+
+
+def _sha_device(col: Column, bits: int) -> Column:
+    chars, lens = _col_bytes_matrix(col)
+    _, _, word_bits, out_words = _SPECS[bits]
+    state = _sha_jit(chars, lens, bits)
+    return _hex_column(state, word_bits, out_words, col.validity)
+
+
+def sha224_device(col: Column) -> Column:
+    return _sha_device(col, 224)
+
+
+def sha256_device(col: Column) -> Column:
+    return _sha_device(col, 256)
+
+
+def sha384_device(col: Column) -> Column:
+    return _sha_device(col, 384)
+
+
+def sha512_device(col: Column) -> Column:
+    return _sha_device(col, 512)

@@ -20,7 +20,6 @@ import numpy as np
 
 TZDIR = os.environ.get("TZDIR", "/usr/share/zoneinfo")
 
-_cache: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
 _info_cache: Dict[str, "ZoneInfoRecord"] = {}
 _lock = threading.Lock()
 
@@ -126,10 +125,5 @@ def get_zone_info(zone_id: str) -> ZoneInfoRecord:
 def get_transitions(zone_id: str) -> Tuple[np.ndarray, np.ndarray]:
     """(transition UTC seconds (ascending, starts with -inf sentinel),
     UTC offset seconds in effect from that instant)."""
-    with _lock:
-        if zone_id in _cache:
-            return _cache[zone_id]
     rec = get_zone_info(zone_id)
-    with _lock:
-        _cache[zone_id] = (rec.trans, rec.offs)
     return rec.trans, rec.offs

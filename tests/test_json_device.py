@@ -153,10 +153,30 @@ def test_device_surrogate_escapes():
 
 
 def test_device_multi_path():
+    import os
     docs = ['{"a": 1, "b": "two", "c": [1,2]}'] * 5 + ['{"a": 9}']
     outs = JD.get_json_object_multiple_paths_device(
         Column.from_strings(docs), ["$.a", "$.b", "$.c", "$.d"])
-    expect = JP.get_json_object_multiple_paths(
-        Column.from_strings(docs), ["$.a", "$.b", "$.c", "$.d"])
+    os.environ["SPARK_RAPIDS_TPU_JSON"] = "host"
+    try:
+        expect = JP.get_json_object_multiple_paths(
+            Column.from_strings(docs), ["$.a", "$.b", "$.c", "$.d"])
+    finally:
+        del os.environ["SPARK_RAPIDS_TPU_JSON"]
     for o, e in zip(outs, expect):
         assert o.to_pylist() == e.to_pylist()
+    # the public multi-path entry routes big columns to the device engine
+    big = Column.from_strings(['{"a": %d}' % i for i in range(40)])
+    outs2 = JP.get_json_object_multiple_paths(big, ["$.a", "$.b"])
+    assert JD.last_stats["rows"] == 40
+    assert outs2[0].to_pylist() == [str(i) for i in range(40)]
+
+
+def test_device_strict_hex_escapes():
+    """int()-lenient hex ('\\u 041', '\\u0x41') must be invalid in BOTH
+    engines, not parsed by the host and rejected by the device."""
+    docs = ['{"a":"\\u 041"}', '{"a":"\\u0x41"}', '{"a":"\\u00_1"}',
+            '{"a":"\\u0041"}']
+    expect = [None, None, None, "A"]
+    assert host(docs, "$.a") == expect
+    assert dev(docs * 16, "$.a") == expect * 16
