@@ -61,10 +61,15 @@ def inner_join_device(left_keys: jnp.ndarray, right_keys: jnp.ndarray,
     # INT64_MAX so rk_sorted stays globally ascending — searchsorted
     # requires it; the n_valid_r clip below breaks the tie when valid
     # keys legitimately equal INT64_MAX.
+    from jax import lax
+
     r_sortkey = jnp.where(right_valid, rk, jnp.int64(2**63 - 1))
-    r_order = jnp.lexsort((jnp.arange(nr), r_sortkey,
-                           (~right_valid).astype(jnp.int32)))
-    rk_sorted = r_sortkey[r_order]
+    # one lax.sort delivers the sorted keys AND the permutation: keys
+    # (invalid-last, key, iota-for-stability); rk_sorted stays globally
+    # ascending because invalid keys are already INT64_MAX
+    _, rk_sorted, r_order = lax.sort(
+        ((~right_valid).astype(jnp.int32), r_sortkey,
+         lax.iota(jnp.int32, nr)), num_keys=3)
     n_valid_r = jnp.sum(right_valid.astype(jnp.int32))
 
     # run bounds for each left key within the valid prefix

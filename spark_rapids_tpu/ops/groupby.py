@@ -42,15 +42,10 @@ def _device_group_ids_jit(keys: Table):
     index per group via segment_min.  Returns (ids int32, first_full
     (n,) int64 — slice [:ngroups] on the host, ngroups scalar)."""
     from spark_rapids_tpu.ops.joins import (
-        _device_null_keyed_cols, _device_rank, _sorted_gid_core)
+        _device_key_columns, _sorted_gid_core)
 
     n = keys.num_rows
-    ranks, masks = [], []
-    for c in keys.columns:
-        rank, mask = _device_rank(c)
-        ranks.append(rank)
-        masks.append(mask)
-    cols = _device_null_keyed_cols(ranks, masks)
+    cols = _device_key_columns(keys.columns)
     order, gid_sorted = _sorted_gid_core(cols)
     ids = jnp.zeros(n, jnp.int64).at[order].set(gid_sorted)
     first_full = jax.ops.segment_min(jnp.arange(n, dtype=jnp.int64),

@@ -199,40 +199,42 @@ def _device_rank(col: Column) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return r, mask
 
 
-def _device_null_keyed_cols(rank_pairs, mask_pairs):
-    """Interleave (mask, zeroed-rank) key columns — the sentinel-free
-    null encoding shared by joins and group-by (a sentinel value would
-    collide with legal ranks like INT64_MIN)."""
+def _device_key_columns(columns) -> list:
+    """int64 equality-key columns for the sorted-gid core.  Nullable
+    columns (validity present — a static pytree property) contribute a
+    (mask, zeroed-rank) pair: the sentinel-free null encoding shared by
+    joins and group-by (a sentinel value would collide with legal ranks
+    like INT64_MIN).  All-valid columns contribute just their rank,
+    keeping the sort comparator as narrow as possible — comparator
+    width is what drives XLA sort compile/runtime cost."""
     cols = []
-    for (r, m) in zip(rank_pairs, mask_pairs):
-        cols.append(m.astype(jnp.int64))
-        cols.append(jnp.where(m, r, jnp.int64(0)))
+    for c in columns:
+        r, m = _device_rank(c)
+        if c.validity is not None:
+            cols.append(m.astype(jnp.int64))
+            cols.append(jnp.where(m, r, jnp.int64(0)))
+        else:
+            cols.append(r)
     return cols
 
 
 def _sorted_gid_core(cols):
-    """(order, gid_sorted): stable lexsort over the key columns plus
+    """(order, gid_sorted): stable sort over the key columns plus
     adjacent-diff group numbering.  Shared device core for join key ids
-    and group-by ids."""
+    and group-by ids.  Uses lax.sort directly: the iota as the final
+    sort key gives deterministic (stable) ordering, and the co-sorted
+    key columns come back from the same sort — no post-sort gathers."""
+    from jax import lax
+
     n = cols[0].shape[0]
-    # lexsort's LAST key is primary: arange tiebreaker first (least
-    # significant), then the key columns with cols[0] most significant
-    order = jnp.lexsort((jnp.arange(n),) + tuple(reversed(cols)))
+    iota = lax.iota(jnp.int32, n)
+    sorted_all = lax.sort(tuple(cols) + (iota,), num_keys=len(cols) + 1)
+    order = sorted_all[-1]
     diff = jnp.zeros(n, jnp.bool_)
-    for c in cols:
-        cs = c[order]
+    for cs in sorted_all[:-1]:
         diff = diff.at[1:].set(diff[1:] | (cs[1:] != cs[:-1]))
     gid_sorted = jnp.cumsum(diff.astype(jnp.int64))
     return order, gid_sorted
-
-
-def _joint_ids_device(rank_pairs, mask_pairs):
-    """Group ids over the concatenated left+right rank columns, all on
-    device (same null encoding as the host _key_ids)."""
-    cols = _device_null_keyed_cols(rank_pairs, mask_pairs)
-    order, gid_sorted = _sorted_gid_core(cols)
-    n = cols[0].shape[0]
-    return jnp.zeros(n, jnp.int64).at[order].set(gid_sorted)
 
 
 def _sort_merge_inner_join_device(left: Table, right: Table,
@@ -265,19 +267,33 @@ from functools import partial as _partial  # noqa: E402
 
 @_partial(jax.jit, static_argnames=("compare_nulls",))
 def _device_ids(left: Table, right: Table, compare_nulls: str):
+    """Per-row equality ids over the joined key columns.  The join core
+    only needs an injective int64 key (it sorts + searchsorts), so a
+    single all-valid key column IS its own id — no sort at all.  Only
+    multi-column or nullable keys pay for the sorted-gid pass."""
     nl, nr = left.num_rows, right.num_rows
-    ranks, masks = [], []
+    key_cols = []
     vl = jnp.ones(nl, jnp.bool_)
     vr = jnp.ones(nr, jnp.bool_)
     for lc, rc in zip(left.columns, right.columns):
         lr_, lm = _device_rank(lc)
         rr_, rm = _device_rank(rc)
-        ranks.append(jnp.concatenate([lr_, rr_]))
-        masks.append(jnp.concatenate([lm, rm]))
+        nullable = lc.validity is not None or rc.validity is not None
+        if nullable:
+            key_cols.append(jnp.concatenate([lm, rm]).astype(jnp.int64))
+            key_cols.append(jnp.concatenate(
+                [jnp.where(lm, lr_, jnp.int64(0)),
+                 jnp.where(rm, rr_, jnp.int64(0))]))
+        else:
+            key_cols.append(jnp.concatenate([lr_, rr_]))
         if compare_nulls == NULL_UNEQUAL:
             vl &= lm
             vr &= rm
-    ids = _joint_ids_device(ranks, masks)
+    if len(key_cols) == 1:
+        ids = key_cols[0]
+    else:
+        order, gid_sorted = _sorted_gid_core(key_cols)
+        ids = jnp.zeros(nl + nr, jnp.int64).at[order].set(gid_sorted)
     return ids[:nl], ids[nl:], vl, vr
 
 

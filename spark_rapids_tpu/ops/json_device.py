@@ -492,15 +492,17 @@ def _padded_with_terminator(col: Column):
     return chars, lens
 
 
-def _scan_column(col: Column, instructions, padded=None) -> List[np.ndarray]:
+def _scan_column(col: Column, instructions, padded=None,
+                 row_chunk: int = 0) -> List[np.ndarray]:
     """Run the path-matching scan, chunked over rows; host-side results."""
     fn = _build_scan(_compile_path(instructions))
     chars, lens = padded if padded is not None \
         else _padded_with_terminator(col)
     rows = chars.shape[0]
+    chunk = row_chunk if row_chunk > 0 else DEVICE_ROW_CHUNK
     outs: List[List[np.ndarray]] = []
-    for c0 in range(0, rows, DEVICE_ROW_CHUNK):
-        c1 = min(rows, c0 + DEVICE_ROW_CHUNK)
+    for c0 in range(0, rows, chunk):
+        c1 = min(rows, c0 + chunk)
         res = fn(chars[c0:c1], lens[c0:c1])
         outs.append([np.asarray(x) for x in res])
     return [np.concatenate([o[i] for o in outs]) for i in
@@ -508,7 +510,7 @@ def _scan_column(col: Column, instructions, padded=None) -> List[np.ndarray]:
 
 
 def get_json_object_device(col: Column, path: str,
-                           _padded=None) -> Column:
+                           _padded=None, _row_chunk: int = 0) -> Column:
     """Device-first get_json_object with per-row host fallback.
 
     Matches ops/json_path.get_json_object_host exactly for valid UTF-8
@@ -526,7 +528,8 @@ def get_json_object_device(col: Column, path: str,
 
     (valid, mcount, mstart, mend, mkind, mfloat, mneg, f_ws, f_sq,
      f_escun, f_ctrl, f_anyesc, f_float, f_negz, fb) = \
-        _scan_column(col, instructions, padded=_padded)
+        _scan_column(col, instructions, padded=_padded,
+                     row_chunk=_row_chunk)
 
     in_valid = (np.ones(rows, bool) if col.validity is None
                 else np.asarray(col.validity).astype(bool)[:rows])
@@ -617,8 +620,32 @@ def get_json_object_multiple_paths_device(
 
     Each path compiles to its own specialized scan; the padded char
     matrix is built ONCE here and shared by every path's scan.  The
-    budget knobs shape row chunking exactly as the reference's scratch
-    budget shapes path chunking."""
+    budget knobs shape row chunking the way the reference's scratch
+    budget shapes path chunking (get_json_object.cu:965-988):
+    parallel_override pins the rows-per-launch directly, else
+    memory_budget_bytes bounds the per-launch scan footprint (padded
+    chars + per-row outputs)."""
+    row_chunk = 0
+    if parallel_override > 0:
+        row_chunk = parallel_override
+    elif memory_budget_bytes > 0 and col.length:
+        per_row = 2 * (int(col.max_string_length()) + 1) + 64
+        row_chunk = max(1, memory_budget_bytes // per_row)
+    if row_chunk > 0 and col.length > row_chunk:
+        # budget smaller than the column: evaluate on row slices so each
+        # launch pads only its own rows (to the slice's own max width)
+        from spark_rapids_tpu.columns.table import Table
+        from spark_rapids_tpu.ops.copying import concat_tables, \
+            slice_table
+        chunks = []
+        for c0 in range(0, col.length, row_chunk):
+            sub = slice_table(Table([col]), c0,
+                              min(col.length, c0 + row_chunk)).columns[0]
+            pad = _padded_with_terminator(sub) if sub.length else None
+            chunks.append([get_json_object_device(sub, p, _padded=pad)
+                           for p in paths])
+        return [concat_tables([Table([ch[i]]) for ch in chunks])
+                .columns[0] for i in range(len(paths))]
     padded = _padded_with_terminator(col) if col.length else None
     return [get_json_object_device(col, p, _padded=padded)
             for p in paths]

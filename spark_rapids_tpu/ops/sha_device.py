@@ -113,8 +113,14 @@ def _padded_message(chars: jnp.ndarray, lens: jnp.ndarray,
     lpos = j - (blk_end[:, None] - len_bytes)        # 0..len_bytes-1
     in_len = (lpos >= 0) & (j < blk_end[:, None])
     shift = ((len_bytes - 1 - lpos).astype(_U64) * _U64(8))
-    lbyte = ((bitlen[:, None] >> jnp.where(in_len, shift, _U64(0)))
-             & _U64(0xFF)).astype(_U8)
+    # shifts >= 64 are undefined in XLA (hardware may mask the amount):
+    # for the 16-byte SHA-384/512 length field only the low 8 bytes can
+    # be nonzero for a 64-bit bit length — force the rest to 0
+    shift_ok = in_len & (shift < _U64(64))
+    lbyte = jnp.where(
+        shift_ok,
+        (bitlen[:, None] >> jnp.where(shift_ok, shift, _U64(0)))
+        & _U64(0xFF), _U64(0)).astype(_U8)
     msg = jnp.where(in_len & (j >= lens[:, None] + 1), lbyte, msg)
     return msg, nblk
 

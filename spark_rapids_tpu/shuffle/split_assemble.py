@@ -25,12 +25,32 @@ from spark_rapids_tpu.shuffle import kudo
 from spark_rapids_tpu.shuffle.schema import Field
 
 
+def _use_device() -> bool:
+    import os
+
+    import jax
+
+    if os.environ.get("SPARK_RAPIDS_TPU_FORCE_DEVICE_SHUFFLE") == "1":
+        return True
+    if os.environ.get("SPARK_RAPIDS_TPU_FORCE_DEVICE_SHUFFLE") == "0":
+        return False
+    return jax.default_backend() != "cpu"
+
+
 def shuffle_split(table: Table, splits: Sequence[int]
                   ) -> Tuple[bytes, np.ndarray]:
     """Split at row boundaries and serialize every partition as a kudo
     blob; returns (packed buffer, int64 offsets per partition) — the same
     (data, offsets) pair shape as KudoGpuSerializer.splitAndSerializeToDevice
-    (KudoGpuSerializer.java:50)."""
+    (KudoGpuSerializer.java:50).  On accelerator backends the bytes are
+    packed by the device blob kernels (shuffle/device_split.py) and read
+    back once; the host writer remains the differential oracle."""
+    if _use_device():
+        from spark_rapids_tpu.shuffle.device_split import \
+            device_shuffle_split
+
+        blob, offsets = device_shuffle_split(table, splits)
+        return bytes(np.asarray(blob)), offsets
     bounds = [0] + list(splits) + [table.num_rows]
     out = io.BytesIO()
     offsets = np.zeros(len(bounds), np.int64)
@@ -45,7 +65,23 @@ def shuffle_split(table: Table, splits: Sequence[int]
 def shuffle_assemble(fields: Sequence[Field], buffer: bytes,
                      offsets: np.ndarray) -> Table:
     """Reassemble partitions into one device table
-    (shuffle_split.hpp:183 shuffle_assemble)."""
+    (shuffle_split.hpp:183 shuffle_assemble).  On accelerator backends
+    the body bytes are gathered into columns by device kernels; the
+    host parse/concat path is the oracle and the fallback.
+
+    Note: this entry point accepts one kudo table per partition slot
+    (the device writer's layout).  Multi-table-per-slot streams take
+    the host path."""
+    if _use_device() and len(offsets) > 1 and fields:
+        try:
+            from spark_rapids_tpu.shuffle.device_split import \
+                device_shuffle_assemble
+            import jax.numpy as jnp
+
+            blob = jnp.asarray(np.frombuffer(buffer, np.uint8))
+            return device_shuffle_assemble(fields, blob, offsets)
+        except ValueError:
+            pass  # e.g. multi-table partitions: host path below
     kts: List[kudo.KudoTable] = []
     for i in range(len(offsets) - 1):
         stream = io.BytesIO(buffer[offsets[i]:offsets[i + 1]])

@@ -180,3 +180,17 @@ def test_device_strict_hex_escapes():
     expect = [None, None, None, "A"]
     assert host(docs, "$.a") == expect
     assert dev(docs * 16, "$.a") == expect * 16
+
+
+def test_device_multi_path_budget_chunking():
+    """memory_budget_bytes / parallel_override bound the per-launch
+    footprint by slicing rows; results identical to unbudgeted."""
+    docs = ['{"a": %d, "b": "x%d"}' % (i, i) for i in range(50)]
+    col = Column.from_strings(docs)
+    base = JD.get_json_object_multiple_paths_device(col, ["$.a", "$.b"])
+    tiny = JD.get_json_object_multiple_paths_device(
+        col, ["$.a", "$.b"], memory_budget_bytes=512)
+    forced = JD.get_json_object_multiple_paths_device(
+        col, ["$.a", "$.b"], parallel_override=7)
+    for b, t, f in zip(base, tiny, forced):
+        assert b.to_pylist() == t.to_pylist() == f.to_pylist()
