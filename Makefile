@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test fuzz native bench bench-all dryrun clean
+.PHONY: test fuzz native sanitizers bench bench-all dryrun ci clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -14,6 +14,11 @@ fuzz:
 # native C++ kernels (also built on-demand at import; this forces it)
 native:
 	bash native/build.sh
+
+# ASAN+UBSAN and TSAN builds of the native runtime + check driver
+# (reference: sanitizer maven profile, pom.xml:237-283)
+sanitizers:
+	bash native/build_sanitizers.sh
 
 # one JSON line on the TPU chip (CPU fallback if the relay is down)
 bench:
@@ -29,6 +34,15 @@ dryrun:
 	jax.config.update('jax_platforms', 'cpu'); \
 	jax.config.update('jax_num_cpu_devices', 8); \
 	import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"
+
+# one-command premerge gate (reference ci/Jenkinsfile.premerge:196-232):
+# unit tests + OOM fuzz (python AND native adaptors differentially) +
+# sanitizer builds + multichip dryrun + bench probe.  Fails loudly on
+# the first red step; bench.py itself never hangs (subprocess probe
+# with timeout, CPU fallback marked in the metric name).
+ci: test fuzz native sanitizers dryrun
+	$(PY) bench.py
+	@echo "ci: all gates green"
 
 clean:
 	rm -rf native/build

@@ -1,0 +1,22 @@
+#!/bin/sh
+# ASAN/UBSAN + TSAN builds of the native runtime, run as part of
+# `make ci` (reference analog: the sanitizer maven profile,
+# pom.xml:237-283, wrapping native tests in compute-sanitizer).
+set -e
+cd "$(dirname "$0")"
+mkdir -p build
+
+echo "== ASAN+UBSAN =="
+g++ -std=c++17 -g -O1 -fsanitize=address,undefined \
+    -fno-sanitize-recover=all \
+    sanitizer_check.cpp spark_resource_adaptor.cpp columnar_native.cpp \
+    -o build/sanitizer_check_asan -lpthread
+./build/sanitizer_check_asan
+
+echo "== TSAN =="
+g++ -std=c++17 -g -O1 -fsanitize=thread \
+    sanitizer_check.cpp spark_resource_adaptor.cpp columnar_native.cpp \
+    -o build/sanitizer_check_tsan -lpthread
+./build/sanitizer_check_tsan
+
+echo "sanitizers: all green"

@@ -755,6 +755,8 @@ class SparkResourceAdaptor:
                 with self._lock:
                     self._post_alloc_success_core(tid, False, likely_spill,
                                                   num_bytes)
+                from spark_rapids_tpu.utils.profiler import record_alloc
+                record_alloc("alloc", num_bytes)
                 return num_bytes
             except AllocationFailed:
                 with self._lock:
@@ -775,6 +777,8 @@ class SparkResourceAdaptor:
         self.resource.deallocate(num_bytes)
         with self._lock:
             self._dealloc_core(False, num_bytes)
+        from spark_rapids_tpu.utils.profiler import record_alloc
+        record_alloc("free", num_bytes)
 
     # ------------------------------------------------------ cpu alloc hooks
 

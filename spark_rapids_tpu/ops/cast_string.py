@@ -315,9 +315,16 @@ def _java_double_repr(v: float, is_f32: bool) -> str:
 
 
 def float_to_string(col: Column) -> Column:
-    """Spark-compatible float->string (CastStrings.fromFloat:103,
-    ftos_converter.cuh digit engine — host path here)."""
+    """Spark-compatible float->string (CastStrings.fromFloat:103).
+    Columns above the routing threshold run the vectorized device Ryu
+    digit engine (ops/ftos_device.py, the ftos_converter.cuh analog);
+    this host path is the differential oracle (SPARK_RAPIDS_TPU_FTOS=
+    host|device overrides)."""
     assert col.dtype.kind in (Kind.FLOAT32, Kind.FLOAT64)
+    from spark_rapids_tpu.ops import ftos_device
+
+    if ftos_device.use_device(col):
+        return ftos_device.float_to_string_device(col)
     host = col.to_numpy()
     is_f32 = col.dtype.kind == Kind.FLOAT32
     mask = np.asarray(col.valid_mask())

@@ -1,0 +1,603 @@
+"""Device float->string digit engine (reference: ftos_converter.cuh,
+1,493 LoC of device Ryu; CastStrings.fromFloat:103).
+
+Vectorized shortest-round-trip decimal conversion (the published Ryu
+algorithm) in lane-per-row jnp u64 arithmetic:
+
+  * the float decomposes into (mantissa, exponent); three scaled
+    candidates vm < vr < vp bracket the value's rounding interval
+  * one 128-bit multiply per candidate by a precomputed power-of-5
+    (or inverse) table entry converts to the decimal domain; the table
+    is generated at import with exact Python big-int arithmetic
+  * a masked fixed-trip loop strips digits while the whole interval
+    agrees, with the tie/trailing-zero refinements that make the result
+    exactly the shortest representation that round-trips
+  * digits + decimal exponent render into Java's Double.toString /
+    Float.toString layout (plain for 1e-3 <= |v| < 1e7, else E-notation)
+    as one byte matrix -> offsets/chars string column
+
+The host path (cast_string._java_double_repr) is the differential
+oracle; tests fuzz random bit patterns incl. subnormals and boundary
+mantissas.  128-bit products are composed from 32-bit limbs so every
+lane op stays in native u64.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial as _partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.columns.dtypes import Kind
+
+_U64 = jnp.uint64
+_U32 = jnp.uint32
+_I32 = jnp.int32
+
+DEVICE_MIN_ROWS = int(os.environ.get("SPARK_RAPIDS_TPU_FTOS_MIN_ROWS",
+                                     32))
+
+
+def use_device(col: Column) -> bool:
+    mode = os.environ.get("SPARK_RAPIDS_TPU_FTOS", "auto")
+    if mode == "host":
+        return False
+    return mode == "device" or col.length >= DEVICE_MIN_ROWS
+
+
+# ------------------------------------------------------------- tables
+# Exact big-int generation (ryu d2s_full_table shapes): for e2 >= 0 the
+# inverse table INV[q] ~ 2^j / 5^q rounded up; for e2 < 0 the table
+# P5[i] = top bits of 5^i.  125-bit significands, split into hi/lo u64.
+
+_B_INV = 125   # bits kept of 2^j/5^q  (double)
+_B_POW = 125   # bits kept of 5^i      (double)
+_FB_INV = 59   # float tables are single u64 entries
+_FB_POW = 61
+
+
+def _pow5bits(e: int) -> int:
+    return ((e * 1217359) >> 19) + 1
+
+
+def _gen_double_tables():
+    inv = np.zeros((292, 2), np.uint64)
+    for q in range(292):
+        j = _pow5bits(q) - 1 + _B_INV
+        v = (1 << j) // (5 ** q) + 1
+        inv[q, 0] = v & ((1 << 64) - 1)
+        inv[q, 1] = v >> 64
+    p5 = np.zeros((326, 2), np.uint64)
+    for i in range(326):
+        shift = _pow5bits(i) - _B_POW
+        v = (5 ** i) >> shift if shift >= 0 else (5 ** i) << -shift
+        p5[i, 0] = v & ((1 << 64) - 1)
+        p5[i, 1] = v >> 64
+    return inv, p5
+
+
+def _gen_float_tables():
+    inv = np.zeros(31, np.uint64)
+    for q in range(31):
+        j = _pow5bits(q) - 1 + _FB_INV
+        inv[q] = (1 << j) // (5 ** q) + 1
+    # i = -e2 - q reaches 48 at the deepest f32 subnormal (e2 = -151,
+    # with the corrected q = log10Pow5(151) - 1)
+    p5 = np.zeros(49, np.uint64)
+    for i in range(49):
+        shift = _pow5bits(i) - _FB_POW
+        p5[i] = (5 ** i) >> shift if shift >= 0 else (5 ** i) << -shift
+    return inv, p5
+
+
+_D_INV, _D_POW5 = _gen_double_tables()
+_F_INV, _F_POW5 = _gen_float_tables()
+
+_POW10_U64 = np.array([10 ** k for k in range(20)], np.uint64)
+
+
+def _log10_pow2(e):
+    # floor(log10(2^e)) for 0 <= e <= 1650
+    return (e * 78913) >> 18
+
+
+def _log10_pow5(e):
+    # floor(log10(5^e)) for 0 <= e <= 2620
+    return (e * 732923) >> 20
+
+
+def _pow5bits_j(e):
+    return ((e * 1217359) >> 19) + 1
+
+
+# --------------------------------------------------- 128-bit primitives
+
+
+def _umul128(a, b) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(lo, hi) of the 128-bit product of two u64 lanes via 32-bit
+    limbs."""
+    mask = _U64(0xFFFFFFFF)
+    a_lo = a & mask
+    a_hi = a >> _U64(32)
+    b_lo = b & mask
+    b_hi = b >> _U64(32)
+    p_ll = a_lo * b_lo
+    p_lh = a_lo * b_hi
+    p_hl = a_hi * b_lo
+    p_hh = a_hi * b_hi
+    mid = (p_ll >> _U64(32)) + (p_lh & mask) + (p_hl & mask)
+    lo = (p_ll & mask) | (mid << _U64(32))
+    hi = p_hh + (p_lh >> _U64(32)) + (p_hl >> _U64(32)) \
+        + (mid >> _U64(32))
+    return lo, hi
+
+
+def _mul_shift64(m, mul_lo, mul_hi, j):
+    """floor((m * (mul_hi:mul_lo)) / 2^j) for 64 < j < 128+64, result
+    fitting u64 (ryu mulShift64)."""
+    b0_lo, b0_hi = _umul128(m, mul_lo)
+    b2_lo, b2_hi = _umul128(m, mul_hi)
+    s_mid = b0_hi + b2_lo
+    carry = (s_mid < b0_hi).astype(_U64)
+    s_hi = b2_hi + carry
+    jj = (j - _U64(64)) & _U64(63)
+    # j - 64 in (0, 64): combine mid and hi
+    return (s_mid >> jj) | jnp.where(
+        jj == 0, _U64(0), s_hi << ((_U64(64) - jj) & _U64(63)))
+
+
+def _pow5_factor_ge(value, p):
+    """value divisible by 5^p?  (p <= 23 suffices for doubles)."""
+    v = value
+    count = jnp.zeros_like(value, dtype=_I32)
+    for _ in range(24):
+        div = v // _U64(5)
+        is_mult = div * _U64(5) == v
+        take = is_mult & (count < 24)
+        v = jnp.where(take, div, v)
+        count = count + take.astype(_I32)
+    return count >= p
+
+
+def _multiple_of_pow2(value, p):
+    mask = jnp.where(p >= 64, _U64(0xFFFFFFFFFFFFFFFF),
+                     (_U64(1) << (p.astype(_U64) & _U64(63))) - _U64(1))
+    return (value & mask) == _U64(0)
+
+
+# --------------------------------------------------------- core (f64)
+
+
+@jax.jit
+def _d2d(bits: jnp.ndarray):
+    """Shortest-decimal core for f64 raw bits (sign handled by caller).
+    Returns (digits u64, e10 int32) for finite nonzero inputs."""
+    mant = bits & _U64((1 << 52) - 1)
+    expo = ((bits >> _U64(52)) & _U64(0x7FF)).astype(_I32)
+    is_sub = expo == 0
+    m2 = jnp.where(is_sub, mant, mant | _U64(1 << 52))
+    e2 = jnp.where(is_sub, 1, expo) - 1075 - 2
+    accept = (m2 & _U64(1)) == _U64(0)          # even mantissa
+    mm_shift = ((mant != _U64(0)) | (expo <= 1)).astype(_U64)
+    mv = m2 * _U64(4)
+    mp = mv + _U64(2)
+    mm = mv - _U64(1) - mm_shift
+
+    # ---- decimal-domain candidates, both e2 branches computed & merged
+    pos = e2 >= 0
+    e2p = jnp.maximum(e2, 0)
+    q_pos = jnp.maximum(_log10_pow2(e2p) - (e2p > 3), 0)
+    k_pos = _B_INV + _pow5bits_j(q_pos) - 1
+    i_pos = -e2p + q_pos + k_pos
+    inv = jnp.asarray(_D_INV)
+    q_idx = jnp.clip(q_pos, 0, inv.shape[0] - 1)
+    vr_p = _mul_shift64(mv, inv[q_idx, 0], inv[q_idx, 1],
+                        i_pos.astype(_U64))
+    vp_p = _mul_shift64(mp, inv[q_idx, 0], inv[q_idx, 1],
+                        i_pos.astype(_U64))
+    vm_p = _mul_shift64(mm, inv[q_idx, 0], inv[q_idx, 1],
+                        i_pos.astype(_U64))
+    e10_p = q_pos
+    qp_small = q_pos <= 21
+    mv5 = _pow5_factor_ge(mv, q_pos)
+    vr_t_p = qp_small & mv5 & ((mv % _U64(5)) == _U64(0))
+    vm_t_p = qp_small & _pow5_factor_ge(mm, q_pos) \
+        & ((mv % _U64(5)) != _U64(0)) & accept
+    vp_adj_p = qp_small & _pow5_factor_ge(mp, q_pos) \
+        & ((mv % _U64(5)) != _U64(0)) & ~accept
+
+    e2n = jnp.minimum(e2, 0)
+    nq = jnp.maximum(_log10_pow5(-e2n) - ((-e2n) > 1), 0)
+    e10_n = nq + e2n
+    i_neg = jnp.maximum(-e2n - nq, 0)
+    k_neg = _pow5bits_j(i_neg) - _B_POW
+    j_neg = nq - k_neg
+    p5 = jnp.asarray(_D_POW5)
+    i_idx = jnp.clip(i_neg, 0, p5.shape[0] - 1)
+    vr_n = _mul_shift64(mv, p5[i_idx, 0], p5[i_idx, 1],
+                        j_neg.astype(_U64))
+    vp_n = _mul_shift64(mp, p5[i_idx, 0], p5[i_idx, 1],
+                        j_neg.astype(_U64))
+    vm_n = _mul_shift64(mm, p5[i_idx, 0], p5[i_idx, 1],
+                        j_neg.astype(_U64))
+    nq_u = nq.astype(_U64)
+    vr_t_n = (nq <= 1) | ((nq < 63) & _multiple_of_pow2(mv, nq_u))
+    # (q<=1: mv=4m2 has >=2 factors of 2 -> vr trailing if q<=1 and...)
+    vr_t_n = jnp.where(nq <= 1, jnp.ones_like(vr_t_n), vr_t_n)
+    vm_t_n = jnp.where(
+        nq <= 1, accept & (mm_shift == _U64(1)),
+        (nq < 63) & _multiple_of_pow2(mm, nq_u))
+    # ryu: for q<=1, vp trailing-adjust when !acceptBounds
+    vp_adj_n = (nq <= 1) & ~accept
+
+    vr = jnp.where(pos, vr_p, vr_n)
+    vp = jnp.where(pos, vp_p, vp_n)
+    vm = jnp.where(pos, vm_p, vm_n)
+    e10 = jnp.where(pos, e10_p, e10_n)
+    vr_trail = jnp.where(pos, vr_t_p, vr_t_n)
+    vm_trail = jnp.where(pos, vm_t_p, vm_t_n)
+    vp_dec = jnp.where(pos, vp_adj_p, vp_adj_n)
+    vp = vp - vp_dec.astype(_U64)
+
+    # ---- digit stripping (masked fixed-trip loops) ------------------
+    def strip_body(_, st):
+        vr, vp, vm, last, removed, vm_t, vr_t = st
+        cond = (vp // _U64(10)) > (vm // _U64(10))
+        vm_t = jnp.where(cond, vm_t & ((vm % _U64(10)) == _U64(0)), vm_t)
+        vr_t = jnp.where(cond, vr_t & (last == _U64(0)), vr_t)
+        last = jnp.where(cond, vr % _U64(10), last)
+        vr = jnp.where(cond, vr // _U64(10), vr)
+        vp = jnp.where(cond, vp // _U64(10), vp)
+        vm = jnp.where(cond, vm // _U64(10), vm)
+        removed = removed + cond.astype(_I32)
+        return vr, vp, vm, last, removed, vm_t, vr_t
+
+    last0 = jnp.zeros_like(vr)
+    rem0 = jnp.zeros_like(vr, dtype=_I32)
+    vr, vp, vm, last, removed, vm_trail, vr_trail = jax.lax.fori_loop(
+        0, 19, strip_body,
+        (vr, vp, vm, last0, rem0, vm_trail, vr_trail))
+
+    def strip_vm_body(_, st):
+        vr, vp, vm, last, removed, vr_t = st
+        cond = (vm % _U64(10)) == _U64(0)
+        vr_t = jnp.where(cond, vr_t & (last == _U64(0)), vr_t)
+        last = jnp.where(cond, vr % _U64(10), last)
+        vr = jnp.where(cond, vr // _U64(10), vr)
+        vp = jnp.where(cond, vp // _U64(10), vp)
+        vm = jnp.where(cond, vm // _U64(10), vm)
+        removed = removed + cond.astype(_I32)
+        return vr, vp, vm, last, removed, vr_t
+
+    def run_vm_strip(st):
+        return jax.lax.fori_loop(0, 19, strip_vm_body, st)
+
+    vr2, vp2, vm2, last2, removed2, vr_trail2 = run_vm_strip(
+        (vr, vp, vm, last, removed, vr_trail))
+    use2 = vm_trail
+    vr = jnp.where(use2, vr2, vr)
+    vm = jnp.where(use2, vm2, vm)
+    last = jnp.where(use2, last2, last)
+    removed = jnp.where(use2, removed2, removed)
+    vr_trail = jnp.where(use2, vr_trail2, vr_trail)
+
+    # round-even on exact ties
+    tie = vr_trail & (last == _U64(5)) & ((vr % _U64(2)) == _U64(0))
+    last = jnp.where(tie, _U64(4), last)
+    need_up = ((vr == vm) & (~accept | ~vm_trail)) | (last >= _U64(5))
+    out = vr + need_up.astype(_U64)
+    return out, (e10 + removed).astype(_I32)
+
+
+@jax.jit
+def _f2d(bits32: jnp.ndarray):
+    """Shortest-decimal core for f32 raw bits."""
+    b = bits32.astype(_U64)
+    mant = b & _U64((1 << 23) - 1)
+    expo = ((b >> _U64(23)) & _U64(0xFF)).astype(_I32)
+    is_sub = expo == 0
+    m2 = jnp.where(is_sub, mant, mant | _U64(1 << 23))
+    e2 = jnp.where(is_sub, 1, expo) - 150 - 2
+    accept = (m2 & _U64(1)) == _U64(0)
+    mm_shift = ((mant != _U64(0)) | (expo <= 1)).astype(_U64)
+    mv = m2 * _U64(4)
+    mp = mv + _U64(2)
+    mm = mv - _U64(1) - mm_shift
+
+    def mul_shift32(m, factor, shift):
+        # m < 2^26, factor < 2^64, shift in (32, 96)
+        f_hi = factor >> _U64(32)
+        f_lo = factor & _U64(0xFFFFFFFF)
+        hi = m * f_hi
+        lo = m * f_lo
+        s = hi + (lo >> _U64(32))
+        return s >> ((shift - _U64(32)) & _U64(63))
+
+    # d2d-style q (one smaller than the naive log10): guarantees the
+    # strip loop removes >= 1 digit whenever q >= 1, so no separate
+    # last-removed-digit patch is needed (same argument as _d2d)
+    pos = e2 >= 0
+    e2p = jnp.maximum(e2, 0)
+    q_pos = jnp.maximum(_log10_pow2(e2p) - (e2p > 3), 0)
+    k_pos = _FB_INV + _pow5bits_j(q_pos) - 1
+    i_pos = (-e2p + q_pos + k_pos).astype(_U64)
+    finv = jnp.asarray(_F_INV)
+    q_idx = jnp.clip(q_pos, 0, finv.shape[0] - 1)
+    vr_p = mul_shift32(mv, finv[q_idx], i_pos)
+    vp_p = mul_shift32(mp, finv[q_idx], i_pos)
+    vm_p = mul_shift32(mm, finv[q_idx], i_pos)
+    e10_p = q_pos
+    # mv < 2^26 so 5^q | mv is only possible for q <= 11
+    qp_small = q_pos <= 11
+    vr_t_p = qp_small & ((mv % _U64(5)) == _U64(0)) \
+        & _pow5_factor_ge(mv, q_pos)
+    vm_t_p = qp_small & _pow5_factor_ge(mm, q_pos) \
+        & ((mv % _U64(5)) != _U64(0)) & accept
+    vp_adj_p = qp_small & _pow5_factor_ge(mp, q_pos) \
+        & ((mv % _U64(5)) != _U64(0)) & ~accept
+
+    e2n = jnp.minimum(e2, 0)
+    nq = jnp.maximum(_log10_pow5(-e2n) - ((-e2n) > 1), 0)
+    e10_n = nq + e2n
+    i_neg = jnp.maximum(-e2n - nq, 0)
+    k_neg = _pow5bits_j(i_neg) - _FB_POW
+    j_neg = (nq - k_neg).astype(_U64)
+    fp5 = jnp.asarray(_F_POW5)
+    i_idx = jnp.clip(i_neg, 0, fp5.shape[0] - 1)
+    vr_n = mul_shift32(mv, fp5[i_idx], j_neg)
+    vp_n = mul_shift32(mp, fp5[i_idx], j_neg)
+    vm_n = mul_shift32(mm, fp5[i_idx], j_neg)
+    nq_u = nq.astype(_U64)
+    # vr = mv*5^i/2^q is an integer (no nonzero digit dropped by the
+    # scaling) iff 2^q divides mv
+    vr_t_n = (nq <= 1) | _multiple_of_pow2(mv, nq_u)
+    vm_t_n = jnp.where(nq <= 1, accept & (mm_shift == _U64(1)),
+                       _multiple_of_pow2(mm, nq_u))
+    vp_adj_n = (nq <= 1) & ~accept
+
+    vr = jnp.where(pos, vr_p, vr_n)
+    vp = jnp.where(pos, vp_p, vp_n)
+    vm = jnp.where(pos, vm_p, vm_n)
+    e10 = jnp.where(pos, e10_p, e10_n)
+    vr_trail = jnp.where(pos, vr_t_p, vr_t_n)
+    vm_trail = jnp.where(pos, vm_t_p, vm_t_n)
+    vp = vp - jnp.where(pos, vp_adj_p, vp_adj_n).astype(_U64)
+
+    def strip_body(_, st):
+        vr, vp, vm, last, removed, vm_t, vr_t = st
+        cond = (vp // _U64(10)) > (vm // _U64(10))
+        vm_t = jnp.where(cond, vm_t & ((vm % _U64(10)) == _U64(0)), vm_t)
+        vr_t = jnp.where(cond, vr_t & (last == _U64(0)), vr_t)
+        last = jnp.where(cond, vr % _U64(10), last)
+        vr = jnp.where(cond, vr // _U64(10), vr)
+        vp = jnp.where(cond, vp // _U64(10), vp)
+        vm = jnp.where(cond, vm // _U64(10), vm)
+        removed = removed + cond.astype(_I32)
+        return vr, vp, vm, last, removed, vm_t, vr_t
+
+    last0 = jnp.zeros_like(vr)
+    rem0 = jnp.zeros_like(vr, dtype=_I32)
+    vr, vp, vm, last, removed, vm_trail, vr_trail = jax.lax.fori_loop(
+        0, 11, strip_body,
+        (vr, vp, vm, last0, rem0, vm_trail, vr_trail))
+
+    def strip_vm_body(_, st):
+        vr, vp, vm, last, removed, vr_t = st
+        cond = (vm % _U64(10)) == _U64(0)
+        vr_t = jnp.where(cond, vr_t & (last == _U64(0)), vr_t)
+        last = jnp.where(cond, vr % _U64(10), last)
+        vr = jnp.where(cond, vr // _U64(10), vr)
+        vp = jnp.where(cond, vp // _U64(10), vp)
+        vm = jnp.where(cond, vm // _U64(10), vm)
+        removed = removed + cond.astype(_I32)
+        return vr, vp, vm, last, removed, vr_t
+
+    vr2, vp2, vm2, last2, removed2, vr_trail2 = jax.lax.fori_loop(
+        0, 11, strip_vm_body, (vr, vp, vm, last, removed, vr_trail))
+    use2 = vm_trail
+    vr = jnp.where(use2, vr2, vr)
+    vm = jnp.where(use2, vm2, vm)
+    last = jnp.where(use2, last2, last)
+    removed = jnp.where(use2, removed2, removed)
+    vr_trail = jnp.where(use2, vr_trail2, vr_trail)
+
+    tie = vr_trail & (last == _U64(5)) & ((vr % _U64(2)) == _U64(0))
+    last = jnp.where(tie, _U64(4), last)
+    need_up = ((vr == vm) & (~accept | ~vm_trail)) | (last >= _U64(5))
+    out = vr + need_up.astype(_U64)
+    return out, (e10 + removed).astype(_I32)
+
+
+# ------------------------------------------------------------- layout
+
+_MAXW = 32          # widest Java rendering fits comfortably
+_NAN = np.frombuffer(b"NaN", np.uint8)
+_INF = np.frombuffer(b"Infinity", np.uint8)
+
+
+@_partial(jax.jit, static_argnames=("is_f32",))
+def _render(bits64: jnp.ndarray, is_f32: bool):
+    """(bytes (rows, _MAXW) u8, lengths (rows,) int32) in Java
+    Double/Float.toString layout."""
+    rows = bits64.shape[0]
+    if is_f32:
+        sign = (bits64 >> _U64(31)) & _U64(1)
+        expfield = (bits64 >> _U64(23)) & _U64(0xFF)
+        mantfield = bits64 & _U64((1 << 23) - 1)
+        is_nan = (expfield == _U64(0xFF)) & (mantfield != _U64(0))
+        is_inf = (expfield == _U64(0xFF)) & (mantfield == _U64(0))
+        is_zero = (expfield == _U64(0)) & (mantfield == _U64(0))
+        digits, e10 = _f2d(bits64)
+    else:
+        sign = (bits64 >> _U64(63)) & _U64(1)
+        expfield = (bits64 >> _U64(52)) & _U64(0x7FF)
+        mantfield = bits64 & _U64((1 << 52) - 1)
+        is_nan = (expfield == _U64(0x7FF)) & (mantfield != _U64(0))
+        is_inf = (expfield == _U64(0x7FF)) & (mantfield == _U64(0))
+        is_zero = (expfield == _U64(0)) & (mantfield == _U64(0))
+        digits, e10 = _d2d(bits64)
+
+    neg = sign == _U64(1)
+    # digit count and most-significant-first digit bytes
+    p10 = jnp.asarray(_POW10_U64)
+    ndig = jnp.sum((digits[:, None] >= p10[None, :]).astype(_I32),
+                   axis=1)
+    ndig = jnp.maximum(ndig, 1)
+    # extract up to 17 digits LSB-first
+    ND = 17
+    def dig_body(k, st):
+        v, out = st
+        out = out.at[:, k].set((v % _U64(10)).astype(jnp.uint8))
+        return v // _U64(10), out
+    _, dlsb = jax.lax.fori_loop(
+        0, ND, dig_body,
+        (digits, jnp.zeros((rows, ND), jnp.uint8)))
+    # digit i (0 = most significant) = dlsb[ndig-1-i]
+    sci_exp = e10 + ndig - 1
+    plain = (sci_exp >= -3) & (sci_exp < 7)
+
+    j = jnp.arange(_MAXW, dtype=_I32)[None, :]
+    nd = ndig[:, None]
+    sneg = neg[:, None]
+    sgn_off = sneg.astype(_I32)
+
+    def digit_at(i):
+        idx = jnp.clip(nd - 1 - i, 0, ND - 1)
+        return jnp.take_along_axis(dlsb, idx.astype(_I32), axis=1)
+
+    # ---------- plain notation -------------------------------------
+    se = sci_exp[:, None]
+    int_digits = jnp.where(se >= 0, se + 1, 1)       # digits before '.'
+    # frac digits: max(ndig - int_digits, 1) when se >= 0; for se < 0
+    # frac = leading zeros + all digits
+    lead_zeros = jnp.where(se < 0, -se - 1, 0)
+    frac_digits = jnp.where(se >= 0,
+                            jnp.maximum(nd - int_digits, 1),
+                            lead_zeros + nd)
+    plain_len = sgn_off + jnp.where(se >= 0, int_digits, 1) \
+        + 1 + frac_digits
+    # byte at position j (after sign): integer part, '.', fraction
+    pj = j - sgn_off
+    in_int = (pj >= 0) & (pj < jnp.where(se >= 0, int_digits, 1))
+    int_digit = jnp.where(
+        se >= 0,
+        jnp.where(pj < nd, digit_at(pj), jnp.zeros_like(pj, jnp.uint8)),
+        jnp.zeros_like(pj, jnp.uint8))          # "0." case
+    dot_pos = jnp.where(se >= 0, int_digits, 1)
+    in_dot = pj == dot_pos
+    fj = pj - dot_pos - 1                       # index into fraction
+    in_frac = (fj >= 0) & (fj < frac_digits)
+    frac_digit = jnp.where(
+        se >= 0,
+        jnp.where(fj < nd - int_digits, digit_at(int_digits + fj),
+                  jnp.zeros_like(fj, jnp.uint8)),
+        jnp.where(fj < lead_zeros, jnp.zeros_like(fj, jnp.uint8),
+                  digit_at(fj - lead_zeros)))
+    plain_b = jnp.where(
+        in_int, int_digit + jnp.uint8(48),
+        jnp.where(in_dot, jnp.uint8(46),
+                  jnp.where(in_frac, frac_digit + jnp.uint8(48),
+                            jnp.uint8(0))))
+
+    # ---------- E notation -----------------------------------------
+    # d.dddE[-]xx ; fraction = remaining digits or "0"
+    efrac = jnp.maximum(nd - 1, 1)
+    eneg = se < 0
+    ae = jnp.abs(se)
+    exp_digits = jnp.where(ae >= 100, 3, jnp.where(ae >= 10, 2, 1))
+    sci_len = sgn_off + 1 + 1 + efrac + 1 + eneg.astype(_I32) \
+        + exp_digits
+    in_d0 = pj == 0
+    in_dot_s = pj == 1
+    sfj = pj - 2
+    in_sfrac = (sfj >= 0) & (sfj < efrac)
+    sfrac_digit = jnp.where(sfj < nd - 1, digit_at(1 + sfj),
+                            jnp.zeros_like(sfj, jnp.uint8))
+    epos = 2 + efrac
+    in_e = pj == epos
+    in_esign = (pj == epos + 1) & eneg
+    edig_start = epos + 1 + eneg.astype(_I32)
+    ej = pj - edig_start
+    in_edig = (ej >= 0) & (ej < exp_digits)
+    # exponent digits MSB first
+    div = jnp.where(ej == exp_digits - 1, 1,
+                    jnp.where(ej == exp_digits - 2, 10, 100))
+    edigit = (ae // div) % 10
+    sci_b = jnp.where(
+        in_d0, digit_at(jnp.zeros_like(pj)) + jnp.uint8(48),
+        jnp.where(in_dot_s, jnp.uint8(46),
+                  jnp.where(in_sfrac, sfrac_digit + jnp.uint8(48),
+                            jnp.where(in_e, jnp.uint8(69),
+                                      jnp.where(in_esign, jnp.uint8(45),
+                                                jnp.where(in_edig,
+                                                          edigit.astype(jnp.uint8) + jnp.uint8(48),
+                                                          jnp.uint8(0)))))))
+
+    # body already leaves position 0 free on negative rows (pj = j - 1)
+    body = jnp.where(plain[:, None], plain_b, sci_b)
+    body = jnp.where(sneg & (j == 0), jnp.uint8(45), body)
+    length = jnp.where(plain, plain_len[:, 0], sci_len[:, 0])
+    out = jnp.where(j < length[:, None], body, jnp.uint8(0))
+
+    # ---------- specials -------------------------------------------
+    nan_b = jnp.zeros(_MAXW, jnp.uint8).at[:3].set(jnp.asarray(_NAN))
+    inf_b = jnp.zeros(_MAXW, jnp.uint8).at[:8].set(jnp.asarray(_INF))
+    ninf_b = jnp.zeros(_MAXW, jnp.uint8).at[0].set(jnp.uint8(45)) \
+        .at[1:9].set(jnp.asarray(_INF))
+    zero_b = jnp.zeros(_MAXW, jnp.uint8).at[:3].set(
+        jnp.asarray(np.frombuffer(b"0.0", np.uint8)))
+    nzero_b = jnp.zeros(_MAXW, jnp.uint8).at[:4].set(
+        jnp.asarray(np.frombuffer(b"-0.0", np.uint8)))
+
+    out = jnp.where(is_nan[:, None], nan_b[None, :], out)
+    length = jnp.where(is_nan, 3, length)
+    out = jnp.where((is_inf & ~neg)[:, None], inf_b[None, :], out)
+    length = jnp.where(is_inf & ~neg, 8, length)
+    out = jnp.where((is_inf & neg)[:, None], ninf_b[None, :], out)
+    length = jnp.where(is_inf & neg, 9, length)
+    out = jnp.where((is_zero & ~neg)[:, None], zero_b[None, :], out)
+    length = jnp.where(is_zero & ~neg, 3, length)
+    out = jnp.where((is_zero & neg)[:, None], nzero_b[None, :], out)
+    length = jnp.where(is_zero & neg, 4, length)
+    return out, length.astype(_I32)
+
+
+def float_to_string_device(col: Column) -> Column:
+    """Device path of cast_string.float_to_string (same output)."""
+    assert col.dtype.kind in (Kind.FLOAT32, Kind.FLOAT64)
+    rows = col.length
+    if rows == 0:
+        return Column.from_strings([])
+    is_f32 = col.dtype.kind == Kind.FLOAT32
+    if is_f32:
+        from jax import lax
+
+        bits = lax.bitcast_convert_type(col.data, jnp.uint32) \
+            .astype(_U64)
+    else:
+        bits = col.data.astype(_U64)   # FLOAT64 data carries raw bits
+    mat, lens = _render(bits, is_f32)
+    lens_np = np.asarray(lens)
+    mask = np.asarray(col.valid_mask()).astype(bool)
+    lens_np = np.where(mask, lens_np, 0)
+    offs = np.zeros(rows + 1, np.int32)
+    np.cumsum(lens_np, out=offs[1:])
+    total = int(offs[-1])
+    offs_j = jnp.asarray(offs)
+    if total:
+        i_flat = jnp.arange(total, dtype=_I32)
+        r = jnp.searchsorted(offs_j, i_flat, side="right") \
+            .astype(_I32) - 1
+        cpos = i_flat - offs_j[r]
+        data = mat[r, cpos]
+    else:
+        data = jnp.zeros(0, jnp.uint8)
+    v = None if mask.all() else jnp.asarray(mask.astype(np.uint8))
+    return Column(dtypes.STRING, rows, data=data, validity=v,
+                  offsets=offs_j)

@@ -492,17 +492,15 @@ def _padded_with_terminator(col: Column):
     return chars, lens
 
 
-def _scan_column(col: Column, instructions, padded=None,
-                 row_chunk: int = 0) -> List[np.ndarray]:
+def _scan_column(col: Column, instructions, padded=None) -> List[np.ndarray]:
     """Run the path-matching scan, chunked over rows; host-side results."""
     fn = _build_scan(_compile_path(instructions))
     chars, lens = padded if padded is not None \
         else _padded_with_terminator(col)
     rows = chars.shape[0]
-    chunk = row_chunk if row_chunk > 0 else DEVICE_ROW_CHUNK
     outs: List[List[np.ndarray]] = []
-    for c0 in range(0, rows, chunk):
-        c1 = min(rows, c0 + chunk)
+    for c0 in range(0, rows, DEVICE_ROW_CHUNK):
+        c1 = min(rows, c0 + DEVICE_ROW_CHUNK)
         res = fn(chars[c0:c1], lens[c0:c1])
         outs.append([np.asarray(x) for x in res])
     return [np.concatenate([o[i] for o in outs]) for i in
@@ -510,7 +508,7 @@ def _scan_column(col: Column, instructions, padded=None,
 
 
 def get_json_object_device(col: Column, path: str,
-                           _padded=None, _row_chunk: int = 0) -> Column:
+                           _padded=None) -> Column:
     """Device-first get_json_object with per-row host fallback.
 
     Matches ops/json_path.get_json_object_host exactly for valid UTF-8
@@ -528,8 +526,7 @@ def get_json_object_device(col: Column, path: str,
 
     (valid, mcount, mstart, mend, mkind, mfloat, mneg, f_ws, f_sq,
      f_escun, f_ctrl, f_anyesc, f_float, f_negz, fb) = \
-        _scan_column(col, instructions, padded=_padded,
-                     row_chunk=_row_chunk)
+        _scan_column(col, instructions, padded=_padded)
 
     in_valid = (np.ones(rows, bool) if col.validity is None
                 else np.asarray(col.validity).astype(bool)[:rows])

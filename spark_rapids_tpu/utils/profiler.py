@@ -147,17 +147,40 @@ class Profiler:
 def op_range(name: str, **attrs):
     """NVTX3_FUNC_RANGE analog (nvtx_ranges.hpp): wraps an op in a
     jax.profiler annotation + emits a range record to the in-process
-    profiler when one is running."""
+    profiler when one is running.  Same-name nesting records once (the
+    outermost bracket): the shim and the op-layer `traced` decorator
+    both bracket the same op, and double inject/record would skew fault
+    probabilities and op counts."""
+    s = active_op_names()
+    outer = name not in s
+    if outer:
+        s.add(name)
     prof = Profiler.get()
     t0 = time.monotonic_ns()
     try:
         with jax.profiler.TraceAnnotation(name):
             yield
     finally:
-        if prof is not None:
-            prof.record("op_range", {"name": name,
-                                     "dur_ns": time.monotonic_ns() - t0,
-                                     **attrs})
+        if outer:
+            s.discard(name)
+            if prof is not None:
+                prof.record("op_range",
+                            {"name": name,
+                             "dur_ns": time.monotonic_ns() - t0,
+                             **attrs})
+
+
+_active_ranges = threading.local()
+
+
+def active_op_names() -> set:
+    """Thread-local set of op names currently inside an op_range (used
+    by utils/tracing.traced to skip duplicate brackets)."""
+    s = getattr(_active_ranges, "s", None)
+    if s is None:
+        s = set()
+        _active_ranges.s = s
+    return s
 
 
 def iter_records(blob: bytes):
@@ -169,3 +192,13 @@ def iter_records(blob: bytes):
         pos += 4
         yield json.loads(blob[pos:pos + n])
         pos += n
+
+def record_alloc(kind: str, num_bytes: int) -> None:
+    """Allocator hook (reference alloc-capture activity records,
+    profiler.fbs AllocActivity): called by the memory adaptor on every
+    device alloc/free; no-op unless a running profiler asked for
+    alloc_capture."""
+    prof = Profiler.get()
+    if prof is not None and prof.config.alloc_capture:
+        prof.record(kind, {"bytes": int(num_bytes),
+                           "thread": threading.get_ident()})

@@ -249,3 +249,114 @@ def test_arms_helpers():
     with _p.raises(ValueError, match="n must be non-negative"):
         ensure_non_negative(-1, "n")
     assert Pair.of(1, "x").left == 1 and Pair.of(1, "x").right == "x"
+
+
+def test_op_layer_injection_and_ranges(tmp_path):
+    """VERDICT r1 weak-6: injection must be able to target ops called
+    DIRECTLY (the way models/ and tests call them), not only the shim
+    surface — the traced decorator now lives at the op layer."""
+    import numpy as np
+
+    from spark_rapids_tpu import ops
+    from spark_rapids_tpu.columns import dtypes
+    from spark_rapids_tpu.columns.column import Column
+    from spark_rapids_tpu.columns.table import Table
+
+    cfg = tmp_path / "faults.json"
+    cfg.write_text(json.dumps({
+        "seed": 1,
+        "faults": [{"match": "murmur3_32", "repeat": 1,
+                    "exception": "CudfException"}]}))
+    fi.install(str(cfg))
+    try:
+        col = Column.from_pylist([1, 2, 3], dtypes.INT32)
+        with pytest.raises(exc.CudfException,
+                           match="injected fault in murmur3_32"):
+            ops.murmur3_32(Table([col]), 42)
+        out = ops.murmur3_32(Table([col]), 42)   # repeat exhausted
+        assert out.length == 3
+    finally:
+        fi.uninstall()
+
+    # op ranges from the op layer land in the profiler stream
+    records = []
+    p = prof.Profiler.init(lambda b: records.append(bytes(b)),
+                           prof.Config(write_buffer_size=1))
+    try:
+        p.start()
+        ops.murmur3_32(Table([col]), 42)
+        p.stop()
+        p.flush()
+    finally:
+        prof.Profiler.shutdown()
+    names = [r["name"] for b in records for r in prof.iter_records(b)
+             if r["kind"] == "op_range"]
+    assert "murmur3_32" in names
+
+
+def test_alloc_capture_via_adaptor():
+    """Profiler alloc_capture wired to the memory adaptor: alloc/free
+    records flow when enabled, none when disabled."""
+    from spark_rapids_tpu.memory.resource import LimitingMemoryResource
+    from spark_rapids_tpu.memory.spark_resource_adaptor import \
+        SparkResourceAdaptor
+
+    for capture, expect in ((True, {"alloc", "free"}), (False, set())):
+        records = []
+        p = prof.Profiler.init(
+            lambda b: records.append(bytes(b)),
+            prof.Config(write_buffer_size=1, alloc_capture=capture))
+        try:
+            p.start()
+            adaptor = SparkResourceAdaptor(LimitingMemoryResource(10000))
+            adaptor.start_dedicated_task_thread(1, 100)
+            adaptor.allocate(64)
+            adaptor.deallocate(64)
+            adaptor.task_done(100)
+            p.stop()
+            p.flush()
+        finally:
+            prof.Profiler.shutdown()
+        kinds = {r["kind"] for b in records
+                 for r in prof.iter_records(b)
+                 if r["kind"] in ("alloc", "free")}
+        assert kinds == expect
+
+
+def test_shim_op_bracket_fires_once(tmp_path):
+    """Shim bracket + op-layer traced wrapper must inject and record
+    exactly ONCE per call (same-name nesting is suppressed)."""
+    from spark_rapids_tpu.columns import dtypes
+    from spark_rapids_tpu.columns.column import Column
+    from spark_rapids_tpu.shim import jni_api
+
+    h = jni_api.make_column_from_host([1, 2, 3], dtypes.INT32)
+    cfg = tmp_path / "faults.json"
+    cfg.write_text(json.dumps({
+        "seed": 1,
+        "faults": [{"match": "murmur3_32", "repeat": 1,
+                    "exception": "CudfException"}]}))
+    fi.install(str(cfg))
+    try:
+        with pytest.raises(exc.CudfException):
+            jni_api.murmur_hash3_32(42, [h])
+        # a double-fire would consume repeat=1 on the outer AND raise
+        # again from the inner bracket; single-fire succeeds now
+        out = jni_api.murmur_hash3_32(42, [h])
+        assert out > 0
+    finally:
+        fi.uninstall()
+
+    records = []
+    p = prof.Profiler.init(lambda b: records.append(bytes(b)),
+                           prof.Config(write_buffer_size=1))
+    try:
+        p.start()
+        jni_api.murmur_hash3_32(42, [h])
+        p.stop()
+        p.flush()
+    finally:
+        prof.Profiler.shutdown()
+    names = [r["name"] for b in records for r in prof.iter_records(b)
+             if r["kind"] == "op_range"]
+    assert names.count("murmur3_32") == 1
