@@ -1,0 +1,94 @@
+"""Generate the HLL++ empirical bias-correction table
+(spark_rapids_tpu/ops/hllpp_bias.npz).
+
+The HLL++ paper's bias correction is an EMPIRICAL table: for each
+precision, the expected raw-estimator output is measured against the
+true cardinality at a grid of interpolation knots, and estimates in the
+bias zone (raw <= 5m) subtract the interpolated bias.  The reference
+gets its table from the cuco finalizer (hyper_log_log_plus_plus.cu
+estimate_fn); that data isn't vendored here, so this script reproduces
+the paper's measurement itself with the repo's own register pipeline:
+seeded uniform u64 "hashes" (the distribution xxhash64 produces over
+distinct inputs), register maxima, raw harmonic-mean estimates averaged
+over many trials per knot.
+
+Deterministic (fixed seeds): re-running regenerates the identical file.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+REGISTER_VALUE_BITS = 6
+P_RANGE = range(4, 19)
+# trials per precision: more where registers are few (noisier)
+TRIALS = {p: (2000 if p <= 10 else 600 if p <= 14 else 120)
+          for p in P_RANGE}
+KNOTS = 140
+
+
+def clz64(w: np.ndarray) -> np.ndarray:
+    """countl_zero on uint64 lanes (binary steps; no float rounding)."""
+    out = np.zeros(w.shape, np.int32)
+    x = w.copy()
+    for bits in (32, 16, 8, 4, 2, 1):
+        mask = x < (np.uint64(1) << np.uint64(64 - bits))
+        out = np.where(mask, out + bits, out)
+        x = np.where(mask, x << np.uint64(bits), x)
+    return np.where(w == 0, 64, out)
+
+
+def alpha_m(m: int) -> float:
+    if m == 16:
+        return 0.673
+    if m == 32:
+        return 0.697
+    if m == 64:
+        return 0.709
+    return 0.7213 / (1.0 + 1.079 / m)
+
+
+def gen_precision(p: int):
+    m = 1 << p
+    nmax = int(5.2 * m)
+    knots = np.unique(np.linspace(max(m // 8, 16), nmax,
+                                  KNOTS).astype(np.int64))
+    pow_neg = 2.0 ** -np.arange(65)
+    raw_acc = np.zeros(len(knots))
+    K = TRIALS[p]
+    rng = np.random.default_rng(1000 + p)
+    a = alpha_m(m)
+    for _ in range(K):
+        h = rng.integers(0, 1 << 64, nmax, dtype=np.uint64)
+        idx = (h >> np.uint64(64 - p)).astype(np.int64)
+        w = (h << np.uint64(p)) | np.uint64(1 << (p - 1))
+        val = (clz64(w) + 1).astype(np.int32)
+        regs = np.zeros(m, np.int32)
+        prev = 0
+        for j, n in enumerate(knots):
+            np.maximum.at(regs, idx[prev:n], val[prev:n])
+            prev = n
+            s = pow_neg[regs].sum()
+            raw_acc[j] += a * m * m / s
+    raw_mean = raw_acc / K
+    bias = raw_mean - knots
+    return raw_mean, bias
+
+
+def main():
+    out = {}
+    for p in P_RANGE:
+        t0 = time.time()
+        raw, bias = gen_precision(p)
+        out[f"raw_p{p}"] = raw.astype(np.float64)
+        out[f"bias_p{p}"] = bias.astype(np.float64)
+        print(f"p={p} knots={len(raw)} trials={TRIALS[p]} "
+              f"({time.time() - t0:.1f}s)", flush=True)
+    np.savez_compressed(
+        "spark_rapids_tpu/ops/hllpp_bias.npz", **out)
+    print("wrote spark_rapids_tpu/ops/hllpp_bias.npz")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -203,13 +203,70 @@ def _match_word(chars, lens, start, word: bytes):
     return ok
 
 
+def _float_parse_one(s: bytes, np_dt):
+    """(value, ok) for one stripped-input row — the libc-exact oracle
+    shared by the host loop and the device path's fallback rows."""
+    t = s.strip(b" \t\r\n\x0b\x0c\x00\x01\x02\x03\x04\x05\x06\x07\x08"
+                b"\x0e\x0f\x10\x11\x12\x13\x14\x15\x16\x17\x18\x19"
+                b"\x1a\x1b\x1c\x1d\x1e\x1f")
+    if not t:
+        return 0.0, False
+    body = t
+    sign = 1.0
+    had_sign = body[:1] in (b"+", b"-")
+    if had_sign:
+        if body[:1] == b"-":
+            sign = -1.0
+        body = body[1:]
+    low = body.lower()
+    if low in (b"inf", b"infinity"):
+        return sign * np.inf, True
+    if low == b"nan":
+        # Spark rejects signed NaN ("+naN"/"-nAn" -> null,
+        # castToFloatNanTest) but accepts signed Infinity
+        return (np.nan, True) if not had_sign else (0.0, False)
+    if b"_" in t:  # python float() extension Java/Spark don't have
+        return 0.0, False
+    try:
+        v = float(t)
+    except ValueError:
+        return 0.0, False
+    return np_dt(v), True
+
+
+def _float_host_rows(col: Column, idx: np.ndarray, is_f32: bool):
+    """(bits u64, ok bool) for the selected rows via the host oracle
+    (used by ops/stod_device.py for its fallback rows)."""
+    chars_host = np.asarray(col.data).tobytes() if col.data is not None \
+        else b""
+    offs = np.asarray(col.offsets)
+    np_dt = np.float32 if is_f32 else np.float64
+    bits = np.zeros(len(idx), np.uint64)
+    ok = np.zeros(len(idx), bool)
+    for k, i in enumerate(idx):
+        v, good = _float_parse_one(chars_host[offs[i]:offs[i + 1]],
+                                   np_dt)
+        ok[k] = good
+        if good:
+            if is_f32:
+                bits[k] = np.float32(v).view(np.uint32)
+            else:
+                bits[k] = np.float64(v).view(np.uint64)
+    return bits, ok
+
+
 def string_to_float(col: Column, dtype: DType = dtypes.FLOAT64,
                     ansi_mode: bool = False) -> Column:
     """Spark CAST(string AS float/double) (CastStrings.toFloat:66,
-    cast_string_to_float.cu).  Conversion goes through host strtod, which
-    is correctly rounded — equivalent to the reference's 128-bit exact
-    path; a vectorized device fast path is future work."""
+    cast_string_to_float.cu).  Columns above the routing threshold run
+    the vectorized Eisel-Lemire device path (ops/stod_device.py) with
+    per-row host fallback; this host loop is the differential oracle
+    (SPARK_RAPIDS_TPU_STOD=host|device overrides)."""
     assert col.dtype.is_string
+    from spark_rapids_tpu.ops import stod_device
+
+    if stod_device.use_device(col):
+        return stod_device.string_to_float_device(col, dtype, ansi_mode)
     rows = col.length
     np_dt = np.float32 if dtype.kind == Kind.FLOAT32 else np.float64
     if rows == 0:
@@ -230,39 +287,10 @@ def string_to_float(col: Column, dtype: DType = dtypes.FLOAT64,
     for i in range(rows):
         if not base_valid[i]:
             continue
-        s = chars_host[offs[i]:offs[i + 1]]
-        t = s.strip(b" \t\r\n\x0b\x0c\x00\x01\x02\x03\x04\x05\x06\x07\x08"
-                    b"\x0e\x0f\x10\x11\x12\x13\x14\x15\x16\x17\x18\x19"
-                    b"\x1a\x1b\x1c\x1d\x1e\x1f")
-        if not t:
-            continue
-        body = t
-        sign = 1.0
-        had_sign = body[:1] in (b"+", b"-")
-        if had_sign:
-            if body[:1] == b"-":
-                sign = -1.0
-            body = body[1:]
-        low = body.lower()
-        if low in (b"inf", b"infinity"):
-            out[i] = sign * np.inf
+        v, ok = _float_parse_one(chars_host[offs[i]:offs[i + 1]], np_dt)
+        if ok:
+            out[i] = v
             valid[i] = True
-            continue
-        if low == b"nan":
-            # Spark rejects signed NaN ("+naN"/"-nAn" -> null,
-            # castToFloatNanTest) but accepts signed Infinity
-            if not had_sign:
-                out[i] = np.nan
-                valid[i] = True
-            continue
-        if b"_" in t:  # python float() extension Java/Spark don't have
-            continue
-        try:
-            v = float(t)
-        except ValueError:
-            continue
-        out[i] = np_dt(v)
-        valid[i] = True
 
     if ansi_mode:
         bad = base_valid & ~valid

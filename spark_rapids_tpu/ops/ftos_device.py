@@ -112,30 +112,14 @@ def _log10_pow5(e):
     return (e * 732923) >> 20
 
 
-def _pow5bits_j(e):
-    return ((e * 1217359) >> 19) + 1
+# (table generation and jit cores share _pow5bits: the bit-count
+# formula must never desynchronize between them)
 
 
 # --------------------------------------------------- 128-bit primitives
 
 
-def _umul128(a, b) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """(lo, hi) of the 128-bit product of two u64 lanes via 32-bit
-    limbs."""
-    mask = _U64(0xFFFFFFFF)
-    a_lo = a & mask
-    a_hi = a >> _U64(32)
-    b_lo = b & mask
-    b_hi = b >> _U64(32)
-    p_ll = a_lo * b_lo
-    p_lh = a_lo * b_hi
-    p_hl = a_hi * b_lo
-    p_hh = a_hi * b_hi
-    mid = (p_ll >> _U64(32)) + (p_lh & mask) + (p_hl & mask)
-    lo = (p_ll & mask) | (mid << _U64(32))
-    hi = p_hh + (p_lh >> _U64(32)) + (p_hl >> _U64(32)) \
-        + (mid >> _U64(32))
-    return lo, hi
+from spark_rapids_tpu.utils.u64math import umul128 as _umul128  # noqa: E402
 
 
 def _mul_shift64(m, mul_lo, mul_hi, j):
@@ -193,7 +177,7 @@ def _d2d(bits: jnp.ndarray):
     pos = e2 >= 0
     e2p = jnp.maximum(e2, 0)
     q_pos = jnp.maximum(_log10_pow2(e2p) - (e2p > 3), 0)
-    k_pos = _B_INV + _pow5bits_j(q_pos) - 1
+    k_pos = _B_INV + _pow5bits(q_pos) - 1
     i_pos = -e2p + q_pos + k_pos
     inv = jnp.asarray(_D_INV)
     q_idx = jnp.clip(q_pos, 0, inv.shape[0] - 1)
@@ -216,7 +200,7 @@ def _d2d(bits: jnp.ndarray):
     nq = jnp.maximum(_log10_pow5(-e2n) - ((-e2n) > 1), 0)
     e10_n = nq + e2n
     i_neg = jnp.maximum(-e2n - nq, 0)
-    k_neg = _pow5bits_j(i_neg) - _B_POW
+    k_neg = _pow5bits(i_neg) - _B_POW
     j_neg = nq - k_neg
     p5 = jnp.asarray(_D_POW5)
     i_idx = jnp.clip(i_neg, 0, p5.shape[0] - 1)
@@ -325,7 +309,7 @@ def _f2d(bits32: jnp.ndarray):
     pos = e2 >= 0
     e2p = jnp.maximum(e2, 0)
     q_pos = jnp.maximum(_log10_pow2(e2p) - (e2p > 3), 0)
-    k_pos = _FB_INV + _pow5bits_j(q_pos) - 1
+    k_pos = _FB_INV + _pow5bits(q_pos) - 1
     i_pos = (-e2p + q_pos + k_pos).astype(_U64)
     finv = jnp.asarray(_F_INV)
     q_idx = jnp.clip(q_pos, 0, finv.shape[0] - 1)
@@ -346,7 +330,7 @@ def _f2d(bits32: jnp.ndarray):
     nq = jnp.maximum(_log10_pow5(-e2n) - ((-e2n) > 1), 0)
     e10_n = nq + e2n
     i_neg = jnp.maximum(-e2n - nq, 0)
-    k_neg = _pow5bits_j(i_neg) - _FB_POW
+    k_neg = _pow5bits(i_neg) - _FB_POW
     j_neg = (nq - k_neg).astype(_U64)
     fp5 = jnp.asarray(_F_POW5)
     i_idx = jnp.clip(i_neg, 0, fp5.shape[0] - 1)

@@ -6,10 +6,14 @@
     countl_zero((hash << p) | w_padding) + 1 (:190-212)
   * sketch = 2^p 6-bit registers packed 10 per int64, stored as a STRUCT
     of ceil-ish (2^p/10 + 1) INT64 columns (:373-382)
-  * estimate: harmonic mean + HLL++ linear-counting decision using the
-    paper's per-precision thresholds (estimate_fn :852-875 delegates to
-    the cuco finalizer; the empirical bias-correction table is NOT yet
-    ported, so mid-range estimates can differ slightly from Spark)
+  * estimate: harmonic mean + empirical bias correction in the mid
+    zone + HLL++ linear-counting decision with the paper's
+    per-precision thresholds (estimate_fn :852-875 delegates to the
+    cuco finalizer).  The bias table (ops/hllpp_bias.npz) is measured
+    by scripts/gen_hllpp_bias.py with this repo's own register
+    pipeline — the reference's table lives inside its cuco dependency,
+    so the paper's measurement is reproduced rather than vendored;
+    values can differ from Spark's table within estimator noise.
 
 TPU design: register maxima via segment_max over (group, register) ids;
 countl_zero as vectorized binary steps; packing as shift-OR reductions —
@@ -47,18 +51,7 @@ def _check_precision(precision: int) -> int:
     return min(precision, MAX_PRECISION)
 
 
-def _clz64(x: jnp.ndarray) -> jnp.ndarray:
-    """Vectorized count-leading-zeros of uint64."""
-    n = jnp.full(x.shape, 64, _I32)
-    shift = jnp.zeros(x.shape, _I32)
-    acc = x
-    for bits in (32, 16, 8, 4, 2, 1):
-        has = (acc >> _U64(64 - bits)) != 0
-        # if the top `bits` bits contain a 1, keep them; else shift left
-        acc = jnp.where(has, acc, acc << _U64(bits))
-        shift = shift + jnp.where(has, 0, bits)
-    # after normalization the top bit is 1 unless x == 0
-    return jnp.where(x == 0, _I32(64), shift)
+from spark_rapids_tpu.utils.u64math import clz64 as _clz64  # noqa: E402
 
 
 def _registers_for(col: Column, precision: int):
@@ -155,6 +148,23 @@ def reduce_merge_hllpp(sketch_col: Column, precision: int) -> Column:
                           1, precision)
 
 
+_BIAS_CACHE = {}
+
+
+def _bias_table(precision: int):
+    """(raw_estimate knots, bias knots) jnp arrays for jnp.interp."""
+    if precision not in _BIAS_CACHE:
+        import os
+
+        path = os.path.join(os.path.dirname(__file__),
+                            "hllpp_bias.npz")
+        data = np.load(path)
+        _BIAS_CACHE[precision] = (
+            jnp.asarray(data[f"raw_p{precision}"]),
+            jnp.asarray(data[f"bias_p{precision}"]))
+    return _BIAS_CACHE[precision]
+
+
 def estimate_from_hll_sketches(sketch_col: Column,
                                precision: int) -> Column:
     """INT64 estimates per sketch row (estimate_fn; HLL++ with linear
@@ -175,6 +185,15 @@ def estimate_from_hll_sketches(sketch_col: Column,
     s = inv.sum(axis=1)
     zeroes = (regs == 0).sum(axis=1).astype(jnp.float64)
     raw = alpha * m * m / s
+    # empirical bias correction in the mid zone (raw <= 5m), paper
+    # order: correct raw first, then the linear-counting decision.
+    # Table: ops/hllpp_bias.npz, measured with this repo's own register
+    # pipeline (scripts/gen_hllpp_bias.py) since the reference's table
+    # lives in its cuco dependency.
+    raw_knots, bias_knots = _bias_table(precision)
+    corrected = raw - jnp.interp(raw, raw_knots, bias_knots,
+                                 left=bias_knots[0], right=0.0)
+    e = jnp.where(raw <= 5.0 * m, corrected, raw)
     linear = m * jnp.log(m / jnp.maximum(zeroes, 1))
     # HLL++ linear-counting threshold per precision (paper appendix;
     # what the cuco finalizer uses), p=4..18
@@ -182,6 +201,6 @@ def estimate_from_hll_sketches(sketch_col: Column,
                   11: 1800, 12: 3100, 13: 6500, 14: 11500, 15: 20000,
                   16: 50000, 17: 120000, 18: 350000}
     thr = thresholds[precision]
-    est = jnp.where((zeroes > 0) & (linear <= thr), linear, raw)
+    est = jnp.where((zeroes > 0) & (linear <= thr), linear, e)
     return Column(dtypes.INT64, sketch_col.length,
                   data=jnp.round(est).astype(_I64))

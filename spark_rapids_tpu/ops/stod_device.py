@@ -1,0 +1,356 @@
+"""Device string->float conversion (reference cast_string_to_float.cu:
+57-235 — device correctly-rounded strtod).
+
+Two vectorized stages, with a per-row host fallback for the rare
+ambiguous cases (the same device-first/host-oracle split as
+ops/json_device.py):
+
+  1. a lax.scan DFA over the padded char axis parses sign, mantissa
+     (first 19 significant digits into one u64 lane), decimal point,
+     exponent, and the Spark validity rules; inf/nan keywords are
+     matched by direct padded-window compares before the scan.
+  2. the Eisel-Lemire algorithm converts (w, q) -> IEEE bits in pure
+     u64 integer ops: normalize w, one 64x64->128 multiply with a
+     128-bit-truncated power-of-ten significand (table generated at
+     import with exact big-int arithmetic), exponent bookkeeping, and
+     round-half-even with explicit ambiguity detection.  Integer-only
+     is the natural fit here: this backend carries f64 as raw bits.
+
+Fallback rows (truncated >19-digit mantissas, results in the subnormal
+range, products whose low bits make rounding ambiguous, possible
+round-even ties) are converted by the host libc path — bit-exact by
+construction, and rare (<<1% of random inputs).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.columns.dtypes import DType, Kind
+
+_U64 = jnp.uint64
+_U8 = jnp.uint8
+_I32 = jnp.int32
+
+DEVICE_MIN_ROWS = int(os.environ.get("SPARK_RAPIDS_TPU_STOD_MIN_ROWS",
+                                     32))
+
+Q_MIN, Q_MAX = -342, 308
+
+
+def use_device(col: Column) -> bool:
+    mode = os.environ.get("SPARK_RAPIDS_TPU_STOD", "auto")
+    if mode == "host":
+        return False
+    return mode == "device" or col.length >= DEVICE_MIN_ROWS
+
+
+def _gen_pow10_hi() -> np.ndarray:
+    """Top 64 bits of the normalized 128-bit significand of 10^q for
+    q in [Q_MIN, Q_MAX] (truncated, as Eisel-Lemire expects)."""
+    out = np.zeros(Q_MAX - Q_MIN + 1, np.uint64)
+    for q in range(Q_MIN, Q_MAX + 1):
+        if q >= 0:
+            v = 10 ** q
+            shift = v.bit_length() - 128
+            t = v >> shift if shift >= 0 else v << -shift
+        else:
+            # floor(2^k / 10^-q) normalized to 128 bits
+            d = 10 ** (-q)
+            k = d.bit_length() + 127
+            t = (1 << k) // d
+            if t.bit_length() == 129:   # can land one bit high
+                t >>= 1
+        assert t.bit_length() == 128
+        out[q - Q_MIN] = t >> 64
+    return out
+
+
+_POW10_HI = _gen_pow10_hi()
+
+
+from spark_rapids_tpu.utils.u64math import clz64 as _clz64  # noqa: E402
+from spark_rapids_tpu.utils.u64math import umul128 as _umul128  # noqa: E402
+
+
+def _eisel_lemire(w, q, is_f32: bool):
+    """(bits u64 without sign, ok bool).  ok=False rows need the host
+    fallback; w==0 handled by the caller."""
+    mant_bits = 23 if is_f32 else 52
+    kept = mant_bits + 2                     # mantissa + round bit
+    drop = 64 - kept - 1                     # shift when upperbit==0
+    exp_bias = 127 if is_f32 else 1023
+    exp_max = 255 if is_f32 else 2047
+
+    in_range = (q >= Q_MIN) & (q <= Q_MAX)
+    qc = jnp.clip(q, Q_MIN, Q_MAX)
+    t_hi = jnp.asarray(_POW10_HI)[qc - Q_MIN]
+    l = _clz64(w)
+    wn = w << (l.astype(_U64) & _U64(63))
+    lo, hi = _umul128(wn, t_hi)
+    upper = (hi >> _U64(63)).astype(_I32)
+    s = hi >> (upper + drop).astype(_U64)    # kept+1 bits incl. round
+    # floor(q*log2(10)): exact for |q| <= 1650; +63 accounts for the
+    # [2^63, 2^64) normalization of both operands
+    powq = ((217706 * qc) >> 16) + 63
+    e = powq + upper - l + exp_bias
+    m = (s + (s & _U64(1))) >> _U64(1)       # round half up (ties fixed
+    #                                          below / via fallback)
+    carried = m >> _U64(mant_bits + 1) != 0
+    m = jnp.where(carried, m >> _U64(1), m)
+    e = e + carried.astype(_I32)
+
+    # bits strictly below the round bit: drop of them when upperbit=0,
+    # drop+1 when upperbit=1.  All-ones -> the truncated table may hide
+    # a carry (ambiguous); all-zeros with round=1, kept-lsb=0 and a zero
+    # low product word -> possible exact half (tie).  Both fall back.
+    low_mask = (_U64(1) << (drop + upper).astype(_U64)) - _U64(1)
+    ambiguous = (hi & low_mask) == low_mask
+    tie = ((s & _U64(3)) == _U64(1)) & (lo == 0) \
+        & ((hi & low_mask) == 0)
+    subnormal = e <= 0
+    overflow = e >= exp_max
+    ok = in_range & ~ambiguous & ~tie & ~subnormal & ~overflow
+    bits = (m & _U64((1 << mant_bits) - 1)) \
+        | (jnp.clip(e, 0, exp_max).astype(_U64) << _U64(mant_bits))
+    # out-of-range exponents resolve exactly: q too small -> 0,
+    # q too large -> inf
+    bits = jnp.where(q < Q_MIN, _U64(0), bits)
+    bits = jnp.where(q > Q_MAX,
+                     _U64(exp_max) << _U64(mant_bits), bits)
+    ok = ok | (q < Q_MIN) | (q > Q_MAX)
+    return bits, ok
+
+
+# ------------------------------------------------------------- parsing
+
+
+def _is_ws(c):
+    return (c <= _U8(0x20)) & ((c <= _U8(0x1F)) | (c == _U8(0x20)))
+
+
+def _lower(c):
+    return jnp.where((c >= _U8(65)) & (c <= _U8(90)), c + _U8(32), c)
+
+
+@jax.jit
+def _parse_scan(chars, start, end):
+    """Numeric grammar DFA over the char axis (python float grammar
+    minus '_': [sign] (d+[.d*] | .d+) [eE [sign] d+]).  Returns
+    mantissa/exponent lanes + flags."""
+    rows, L = chars.shape
+    S_SIGN, S_INT, S_FRAC, S_ESIGN, S_EXP, S_BAD = 0, 1, 2, 3, 4, 5
+
+    def body(carry, j):
+        (st, mant, nsig, frac_kept, int_drop, dropped_nz, exp, eneg,
+         neg, saw_digit, saw_edigit) = carry
+        c = chars[:, j]
+        active = (j >= start) & (j < end)
+        digit = (c >= _U8(48)) & (c <= _U8(57))
+        d = (c - _U8(48)).astype(_U64)
+        is_dot = c == _U8(46)
+        is_e = (_lower(c) == _U8(101))
+        is_sign = (c == _U8(43)) | (c == _U8(45))
+
+        # transitions
+        ns = st
+        ns = jnp.where((st == S_SIGN) & is_sign, S_INT, ns)
+        ns = jnp.where((st == S_SIGN) & digit, S_INT, ns)
+        ns = jnp.where((st == S_SIGN) & is_dot, S_FRAC, ns)
+        ns = jnp.where((st == S_INT) & is_dot, S_FRAC, ns)
+        ns = jnp.where((st == S_INT) & is_e & saw_digit, S_ESIGN, ns)
+        ns = jnp.where((st == S_FRAC) & is_e & saw_digit, S_ESIGN, ns)
+        ns = jnp.where((st == S_ESIGN) & (is_sign | digit), S_EXP, ns)
+        bad = ((st == S_SIGN) & ~(is_sign | digit | is_dot)) \
+            | ((st == S_INT) & ~(digit | is_dot | (is_e & saw_digit))) \
+            | ((st == S_FRAC) & ~(digit | (is_e & saw_digit))) \
+            | ((st == S_ESIGN) & ~(is_sign | digit)) \
+            | ((st == S_EXP) & ~digit)
+        ns = jnp.where(bad, S_BAD, ns)
+        ns = jnp.where(active, ns, st)
+
+        in_mant = active & digit & ((st == S_SIGN) | (st == S_INT)
+                                    | (st == S_FRAC))
+        sig = in_mant & ((mant != _U64(0)) | (d != _U64(0)))
+        keep = sig & (nsig < 19)
+        mant = jnp.where(keep, mant * _U64(10) + d, mant)
+        nsig = nsig + sig.astype(_I32)
+        frac_kept = frac_kept + (keep & (st == S_FRAC)).astype(_I32)
+        int_drop = int_drop + (sig & ~keep
+                               & (st != S_FRAC)).astype(_I32)
+        dropped_nz = dropped_nz | (sig & ~keep & (d != _U64(0)))
+        # leading zeros in the fraction scale the exponent even though
+        # they are not significant
+        frac_kept = frac_kept + ((st == S_FRAC) & in_mant & ~sig
+                                 ).astype(_I32)
+        saw_digit = saw_digit | in_mant
+        neg = neg | (active & (st == S_SIGN) & (c == _U8(45)))
+        eneg = eneg | (active & (st == S_ESIGN) & (c == _U8(45)))
+        in_exp = active & digit & ((st == S_ESIGN) | (st == S_EXP))
+        exp = jnp.where(in_exp,
+                        jnp.minimum(exp * 10 + d.astype(_I32), 100000),
+                        exp)
+        saw_edigit = saw_edigit | in_exp
+        return (ns, mant, nsig, frac_kept, int_drop, dropped_nz, exp,
+                eneg, neg, saw_digit, saw_edigit), None
+
+    z64 = jnp.zeros(rows, _U64)
+    zi = jnp.zeros(rows, _I32)
+    zb = jnp.zeros(rows, jnp.bool_)
+    init = (zi, z64, zi, zi, zi, zb, zi, zb, zb, zb, zb)
+    (st, mant, nsig, frac_kept, int_drop, dropped_nz, exp, eneg, neg,
+     saw_digit, saw_edigit), _ = jax.lax.scan(
+        body, init, jnp.arange(L, dtype=_I32))
+    # terminal validity: digits seen, not stuck in a bad/e-dangling state
+    valid = saw_digit & (st != 5) \
+        & ~((st == 3) | ((st == 4) & ~saw_edigit))
+    q = jnp.where(eneg, -exp, exp) + int_drop - frac_kept
+    return mant, q, neg, valid, nsig, dropped_nz
+
+
+@jax.jit
+def _strip_bounds(chars, lens):
+    rows, L = chars.shape
+    j = jnp.arange(L, dtype=_I32)[None, :]
+    inrow = j < lens[:, None]
+    ws = _is_ws(chars) | ~inrow
+    nonws = ~ws
+    any_nonws = nonws.any(axis=1)
+    start = jnp.argmax(nonws, axis=1).astype(_I32)
+    end = (L - jnp.argmax(nonws[:, ::-1], axis=1)).astype(_I32)
+    return jnp.where(any_nonws, start, 0), \
+        jnp.where(any_nonws, end, 0)
+
+
+@jax.jit
+def _keyword_scan(chars, start, end):
+    """(is_inf, is_nan, kw_neg, kw_signed) after optional sign at
+    start: 'inf'/'infinity'/'nan' case-insensitive."""
+    rows, L = chars.shape
+
+    def char_at(pos):
+        p = jnp.clip(pos, 0, L - 1)
+        return _lower(chars[jnp.arange(rows), p])
+
+    c0 = char_at(start)
+    signed = (c0 == _U8(43)) | (c0 == _U8(45))
+    kw_neg = c0 == _U8(45)
+    s = start + signed.astype(_I32)
+    n = end - s
+
+    def matches(word: bytes):
+        m = n == len(word)
+        for k, ch in enumerate(word):
+            m = m & (char_at(s + k) == _U8(ch))
+        return m
+
+    is_inf = matches(b"inf") | matches(b"infinity")
+    is_nan = matches(b"nan")
+    return is_inf, is_nan, kw_neg, signed
+
+
+@jax.jit
+def _narrow_to_f32(bits64):
+    """f64 bits -> f32 bits, round-half-even, in exact integer ops
+    (the same narrowing the host path applies after its f64 parse, so
+    both paths double-round identically).  Subnormal f32 results are
+    flagged for the host fallback."""
+    exp64 = ((bits64 >> _U64(52)) & _U64(0x7FF)).astype(_I32)
+    mant = bits64 & _U64((1 << 52) - 1)
+    sign = (bits64 >> _U64(63)) << _U64(31)
+    is_special = exp64 == 0x7FF                    # inf / nan
+    e32 = exp64 - 1023 + 127
+    m53 = mant | _U64(1 << 52)
+    dropped = m53 & _U64((1 << 29) - 1)
+    m24 = m53 >> _U64(29)
+    half = _U64(1 << 28)
+    round_up = (dropped > half) | ((dropped == half)
+                                   & ((m24 & _U64(1)) == _U64(1)))
+    m24 = m24 + round_up.astype(_U64)
+    carried = m24 >> _U64(24) != 0
+    m24 = jnp.where(carried, m24 >> _U64(1), m24)
+    e32 = e32 + carried.astype(_I32)
+    overflow = (e32 >= 255) & ~is_special
+    need_fb = (e32 <= 0) & (exp64 != 0)            # f32 subnormal
+    out = (m24 & _U64((1 << 23) - 1)) \
+        | (jnp.clip(e32, 1, 254).astype(_U64) << _U64(23))
+    out = jnp.where(overflow, _U64(0xFF) << _U64(23), out)
+    out = jnp.where(is_special,
+                    (_U64(0xFF) << _U64(23))
+                    | jnp.where(mant != 0, _U64(1 << 22), _U64(0)),
+                    out)
+    out = jnp.where((exp64 == 0) & (mant == _U64(0)), _U64(0), out)
+    need_fb = need_fb & ~is_special
+    return out | sign, need_fb
+
+
+def string_to_float_device(col: Column, dtype: DType,
+                           ansi_mode: bool = False) -> Column:
+    """Device path of cast_string.string_to_float (same output)."""
+    from spark_rapids_tpu.ops.cast_string import _float_host_rows
+
+    assert col.dtype.is_string
+    rows = col.length
+    is_f32 = dtype.kind == Kind.FLOAT32
+    chars, lens = col.to_padded_chars()
+    if chars.shape[1] == 0:
+        chars = jnp.zeros((rows, 1), jnp.uint8)
+    start, end = _strip_bounds(chars, lens)
+    empty = end <= start
+    is_inf, is_nan, kw_neg, kw_signed = _keyword_scan(chars, start, end)
+    mant, q, neg, valid, nsig, dropped_nz = _parse_scan(
+        chars, start, end)
+
+    bits, ok = _eisel_lemire(mant, q, False)
+    need_fb = valid & ~ok & (mant != _U64(0))
+    need_fb = need_fb | (valid & dropped_nz)
+
+    bits = jnp.where(mant == _U64(0), _U64(0), bits)
+    inf_bits = _U64(0x7FF) << _U64(52)
+    nan_bits = inf_bits | (_U64(1) << _U64(51))
+    bits = jnp.where(is_inf, inf_bits, bits)
+    # Spark rejects signed NaN but accepts signed Infinity
+    bits = jnp.where(is_nan & ~kw_signed, nan_bits, bits)
+    out_valid = (valid | is_inf | (is_nan & ~kw_signed)) & ~empty
+    bits = bits | (jnp.where(neg | (is_inf & kw_neg), _U64(1), _U64(0))
+                   << _U64(63))
+    if is_f32:
+        bits, narrow_fb = _narrow_to_f32(bits)
+        need_fb = need_fb | (valid & narrow_fb)
+
+    bits_np = np.asarray(bits)
+    valid_np = np.asarray(out_valid) \
+        & np.asarray(col.valid_mask()).astype(bool)
+    fb_np = np.asarray(need_fb) & valid_np
+
+    if fb_np.any():
+        fb_idx = np.nonzero(fb_np)[0]
+        host_bits, host_ok = _float_host_rows(col, fb_idx, is_f32)
+        bits_np = bits_np.copy()
+        bits_np[fb_idx] = host_bits
+        valid_np[fb_idx] = host_ok
+
+    if is_f32:
+        data = jnp.asarray(
+            bits_np.astype(np.uint32).view(np.float32))
+    else:
+        data = jnp.asarray(bits_np)      # FLOAT64 carries raw bits
+    if ansi_mode:
+        from spark_rapids_tpu.ops.exceptions import CastException
+
+        base = np.asarray(col.valid_mask()).astype(bool)
+        bad = base & ~valid_np
+        if bad.any():
+            row = int(np.argmax(bad))
+            raise CastException(row, col.to_pylist()[row])
+        validity = col.validity
+    else:
+        validity = jnp.asarray(valid_np.astype(np.uint8))
+    return Column(dtype, rows, data=data, validity=validity)

@@ -1,0 +1,1 @@
+"""Offline tooling (reference profiler/ converter + tools/ analogs)."""

@@ -167,6 +167,7 @@ def op_range(name: str, **attrs):
                 prof.record("op_range",
                             {"name": name,
                              "dur_ns": time.monotonic_ns() - t0,
+                             "thread": threading.get_ident(),
                              **attrs})
 
 

@@ -1,0 +1,39 @@
+"""Shared u64 lane arithmetic for the device numeric engines
+(ftos_device Ryu, stod_device Eisel-Lemire, hllpp registers): 128-bit
+products from 32-bit limbs and branchless count-leading-zeros — the
+integer substrate this backend's f64-as-raw-bits convention runs on."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+_U64 = jnp.uint64
+_I32 = jnp.int32
+
+
+def umul128(a, b) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(lo, hi) of the 128-bit product of two u64 lanes."""
+    mask = _U64(0xFFFFFFFF)
+    a_lo, a_hi = a & mask, a >> _U64(32)
+    b_lo, b_hi = b & mask, b >> _U64(32)
+    p_ll = a_lo * b_lo
+    p_lh = a_lo * b_hi
+    p_hl = a_hi * b_lo
+    mid = (p_ll >> _U64(32)) + (p_lh & mask) + (p_hl & mask)
+    lo = (p_ll & mask) | (mid << _U64(32))
+    hi = a_hi * b_hi + (p_lh >> _U64(32)) + (p_hl >> _U64(32)) \
+        + (mid >> _U64(32))
+    return lo, hi
+
+
+def clz64(x) -> jnp.ndarray:
+    """countl_zero on u64 lanes (binary steps, no float rounding)."""
+    out = jnp.zeros(x.shape, _I32)
+    v = x
+    for bits in (32, 16, 8, 4, 2, 1):
+        m = v < (_U64(1) << _U64(64 - bits))
+        out = jnp.where(m, out + bits, out)
+        v = jnp.where(m, v << _U64(bits), v)
+    return jnp.where(x == 0, 64, out)

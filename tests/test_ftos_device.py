@@ -85,29 +85,35 @@ def test_f64_subnormals_and_boundaries():
 
 def test_mul_shift_tables_exact():
     """Property check of the table + shift math against exact big-int
-    arithmetic over the real mantissa range."""
+    arithmetic, with the EXACT shifts _d2d uses: for the inverse table
+    floor(m * INV[q] / 2^(-e2+q+k)) must equal floor(m * 2^(e2-q) / 5^q)
+    (the e2 >= 0 branch), and for the pow5 table
+    floor(m * P5[i] / 2^(q-k)) must equal floor(m * 5^i / 2^q)
+    (the e2 < 0 branch), over the real mv range (< 2^55)."""
     rng = np.random.default_rng(14)
     from spark_rapids_tpu.ops.ftos_device import (
-        _B_INV, _B_POW, _D_INV, _D_POW5, _pow5bits)
+        _B_INV, _B_POW, _D_INV, _D_POW5, _log10_pow2, _log10_pow5,
+        _pow5bits)
 
-    for q in [0, 1, 5, 21, 50, 150, 291]:
-        j = _B_INV + _pow5bits(q) - 1
+    for e2 in [0, 1, 4, 10, 40, 100, 500, 969]:
+        q = max(_log10_pow2(e2) - (e2 > 3), 0)
+        k = _B_INV + _pow5bits(q) - 1
+        j = -e2 + q + k
         table = int(_D_INV[q, 0]) + (int(_D_INV[q, 1]) << 64)
-        for m in list(rng.integers(1, 1 << 55, 50)) + [(1 << 55) - 1]:
+        for m in list(rng.integers(1, 1 << 55, 40)) + [(1 << 55) - 1]:
             m = int(m)
-            exact = m * (10 ** 0)  # placeholder
-            # mulShift computes floor(m * table / 2^(j + shift_extra));
-            # exactness claim: floor(m * 2^(e2-q) / 5^q) for the i used
-            # in _d2d; check the core identity floor(m*table/2^j)==
-            # floor(m/5^q) extended by powers of two
-            assert (m * table) >> j == m // (5 ** q) \
-                or (m * table) >> j == (m * (2 ** 0)) // (5 ** q)
-    for i in [0, 1, 30, 100, 325]:
-        shift = _pow5bits(i) - _B_POW
+            want = (m << (e2 - q)) // (5 ** q)
+            assert (m * table) >> j == want, (e2, q, m)
+    for e2 in [-1, -2, -5, -20, -80, -300, -1000, -1076]:
+        q = max(_log10_pow5(-e2) - ((-e2) > 1), 0)
+        i = -e2 - q
+        k = _pow5bits(i) - _B_POW
+        j = q - k
         table = int(_D_POW5[i, 0]) + (int(_D_POW5[i, 1]) << 64)
-        back = table << shift if shift >= 0 else table >> -shift
-        # top-bit truncation of 5^i: equal when it fits, floor otherwise
-        assert back <= 5 ** i < (back + (1 << max(shift, 0))) * 2
+        for m in list(rng.integers(1, 1 << 55, 40)) + [(1 << 55) - 1]:
+            m = int(m)
+            want = (m * 5 ** i) >> q
+            assert (m * table) >> j == want, (e2, q, i, m)
 
 
 def test_routing_threshold():

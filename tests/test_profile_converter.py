@@ -1,0 +1,60 @@
+"""Profile converter tool (reference
+profiler/spark_rapids_profile_converter.cpp role): stream -> chrome
+trace + summary."""
+
+import json
+
+from spark_rapids_tpu.tools import profile_converter as pc
+from spark_rapids_tpu.utils import profiler as prof
+
+
+def make_stream(tmp_path):
+    blobs = []
+    p = prof.Profiler.init(blobs.append,
+                           prof.Config(write_buffer_size=1,
+                                       alloc_capture=True))
+    try:
+        p.start()
+        with prof.op_range("murmur3_32", rows=10):
+            pass
+        with prof.op_range("convert_to_rows"):
+            pass
+        with prof.op_range("murmur3_32"):
+            pass
+        prof.record_alloc("alloc", 1024)
+        prof.record_alloc("alloc", 512)
+        prof.record_alloc("free", 1024)
+        p.stop()
+        p.flush()
+    finally:
+        prof.Profiler.shutdown()
+    f = tmp_path / "prof.bin"
+    f.write_bytes(b"".join(blobs))
+    return str(f)
+
+
+def test_chrome_trace_and_summary(tmp_path, capsys):
+    path = make_stream(tmp_path)
+    out = tmp_path / "trace.json"
+    assert pc.main([path, "--chrome", str(out), "--summary"]) == 0
+    trace = json.loads(out.read_text())
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert names.count("murmur3_32") == 2
+    assert "convert_to_rows" in names
+    assert "device_memory" in names
+    x = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert all(e["dur"] >= 0 and e["ts"] >= 0 for e in x)
+
+    text = capsys.readouterr().out
+    assert "murmur3_32" in text and "calls" in text
+    assert "allocs: 2" in text and "peak: 1536B" in text \
+        and "leaked: 512B" in text
+
+
+def test_summary_rows():
+    recs = [{"kind": "op_range", "name": "a", "dur_ns": 100, "t_ns": 1},
+            {"kind": "op_range", "name": "a", "dur_ns": 300, "t_ns": 2},
+            {"kind": "op_range", "name": "b", "dur_ns": 50, "t_ns": 3}]
+    rows = pc.summarize(recs)
+    assert rows[0] == {"op": "a", "calls": 2, "total_ns": 400,
+                       "max_ns": 300, "avg_ns": 200}

@@ -97,3 +97,24 @@ def test_histogram_validation_and_filtering():
         hg.create_histogram_if_valid(
             Column.from_pylist([1.0], dtypes.FLOAT64),
             Column.from_pylist([None], dtypes.INT64))
+
+
+def test_hllpp_bias_correction_mid_range():
+    """Mid-zone estimates (above the linear-counting threshold, below
+    5m) use the empirical bias table: error must stay tight where the
+    uncorrected raw estimator is known to overshoot."""
+    import numpy as np
+
+    from spark_rapids_tpu.columns import dtypes
+
+    p, n = 11, 4000           # m=2048: LC threshold 1800 < n < 5m=10240
+    errs = []
+    for seed in range(5):
+        rng = np.random.default_rng(100 + seed)
+        vals = rng.integers(-(1 << 62), 1 << 62, n, dtype=np.int64)
+        c = Column.from_pylist(list(np.unique(vals)), dtypes.INT64)
+        true_n = c.length
+        sk = hllpp.reduce_hllpp(c, p)
+        est = hllpp.estimate_from_hll_sketches(sk, p).to_pylist()[0]
+        errs.append(abs(est - true_n) / true_n)
+    assert np.mean(errs) < 0.04, errs
