@@ -36,17 +36,15 @@ MEAN = "mean"
 
 
 @jax.jit
-def _device_group_ids_jit(keys: Table):
-    """Device group ids for fixed-width keys (shared lexsort/diff core
-    with the device join, joins._sorted_gid_core); first-occurrence
-    index per group via segment_min.  Returns (ids int32, first_full
-    (n,) int64 — slice [:ngroups] on the host, ngroups scalar)."""
-    from spark_rapids_tpu.ops.joins import (
-        _device_key_columns, _sorted_gid_core)
+def _device_group_ids_jit(cols):
+    """Device group ids over prepared key columns (shared sorted-gid
+    core with the device join); first-occurrence index per group via
+    segment_min.  Returns (ids int32, first_full (n,) int64 — slice
+    [:ngroups] on the host, ngroups scalar)."""
+    from spark_rapids_tpu.ops.joins import _sorted_gid_core
 
-    n = keys.num_rows
-    cols = _device_key_columns(keys.columns)
-    order, gid_sorted = _sorted_gid_core(cols)
+    n = cols[0].shape[0]
+    order, gid_sorted = _sorted_gid_core(list(cols))
     ids = jnp.zeros(n, jnp.int64).at[order].set(gid_sorted)
     first_full = jax.ops.segment_min(jnp.arange(n, dtype=jnp.int64),
                                      ids, num_segments=n)
@@ -54,8 +52,13 @@ def _device_group_ids_jit(keys: Table):
 
 
 def _group_ids_device(keys: Table):
-    """Device branch of _group_ids (same return contract)."""
-    ids, first_full, ng = _device_group_ids_jit(keys)
+    """Device branch of _group_ids (same return contract).  Key
+    columns are prepared eagerly (string pad widths are
+    data-dependent), the gid core is one jitted program."""
+    from spark_rapids_tpu.ops.joins import _device_key_columns
+
+    cols = _device_key_columns(keys.columns)
+    ids, first_full, ng = _device_group_ids_jit(tuple(cols))
     ngroups = int(ng)
     return ids, first_full[:ngroups], ngroups
 
@@ -82,7 +85,7 @@ def _group_ids(keys: Table) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
     strings/decimal128 and the CPU backend use the host rank path."""
     import os
 
-    from spark_rapids_tpu.ops.joins import _DEVICE_RANK_KINDS
+    from spark_rapids_tpu.ops.joins import _device_key_kind_ok
 
     if not keys.columns:
         return (jnp.zeros(keys.num_rows, np.int32),
@@ -91,8 +94,7 @@ def _group_ids(keys: Table) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
                   or os.environ.get(
                       "SPARK_RAPIDS_TPU_FORCE_DEVICE_GROUPBY") == "1")
     if (use_device and keys.num_rows > 0
-            and all(c.dtype.kind in _DEVICE_RANK_KINDS
-                    for c in keys.columns)):
+            and all(_device_key_kind_ok(c) for c in keys.columns)):
         return _group_ids_device(keys)
     return _group_ids_host(keys)
 

@@ -169,9 +169,21 @@ def _raw_values(col: Column) -> np.ndarray:
     raise AssertionError
 
 
+def _device_key_kind_ok(c: Column) -> bool:
+    """Can this column be a device join/group-by key?  Fixed-width and
+    decimal128 always; strings up to the word-sort width cap."""
+    kind = c.dtype.kind
+    if kind in _DEVICE_RANK_KINDS or kind == Kind.DECIMAL128:
+        return True
+    if kind == Kind.STRING:
+        return c.max_string_length() <= DEVICE_STR_KEY_MAX_LEN
+    return False
+
+
 # dtypes whose rank is a pure device transform (no host readback):
-# everything fixed-width except decimal128 (object-path big ints) and
-# strings (ranked by the native kernel)
+# everything fixed-width except decimal128 (multi-word device encoding
+# via _decimal_words) and strings (packed-word device encoding via
+# _string_words, host native-rank fallback beyond the width cap)
 _DEVICE_RANK_KINDS = frozenset({
     Kind.BOOL8, Kind.INT8, Kind.INT16, Kind.INT32, Kind.INT64,
     Kind.UINT8, Kind.UINT16, Kind.UINT32, Kind.UINT64,
@@ -199,22 +211,79 @@ def _device_rank(col: Column) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return r, mask
 
 
+# Longest string key that still goes through the device word-sort path
+# (comparator width = ceil(maxlen/8)+1 columns per key; beyond this the
+# host rank path wins)
+DEVICE_STR_KEY_MAX_LEN = 256
+
+
+def _string_words(col: Column, pad_to: int) -> List[jnp.ndarray]:
+    """Exact string equality keys as packed big-endian u64 word columns
+    plus the byte length (padding zeros alone would conflate "a" and
+    "a\\x00" — the length column restores injectivity).  Entirely on
+    device; the joint pad width makes both join sides comparable."""
+    chars, lens = col.to_padded_chars(pad_to=max(pad_to, 1))
+    rows, L = chars.shape
+    k = (L + 7) // 8
+    padded = jnp.concatenate(
+        [chars, jnp.zeros((rows, k * 8 - L), jnp.uint8)], axis=1)
+    bytes_ = padded.reshape(rows, k, 8).astype(jnp.uint64)
+    shifts = jnp.asarray(
+        np.arange(56, -8, -8, dtype=np.uint64))      # big-endian
+    words = (bytes_ << shifts[None, None, :]).sum(
+        axis=2, dtype=jnp.uint64)
+    out = [words[:, i].astype(jnp.int64) for i in range(k)]
+    out.append(lens.astype(jnp.int64))
+    return out
+
+
+def _decimal_words(col: Column) -> List[jnp.ndarray]:
+    """decimal128 equality keys: the (n, 4) int32 limb matrix packed
+    into two u64 word columns (equality-injective; order irrelevant for
+    join/group-by ids)."""
+    limbs = col.data.astype(jnp.uint32).astype(jnp.uint64)
+    lo = limbs[:, 0] | (limbs[:, 1] << jnp.uint64(32))
+    hi = limbs[:, 2] | (limbs[:, 3] << jnp.uint64(32))
+    return [lo.astype(jnp.int64), hi.astype(jnp.int64)]
+
+
+def _device_equality_cols(col: Column, pad_to: int = 0
+                          ) -> Optional[List[jnp.ndarray]]:
+    """Device int64 equality-key columns for one column, or None when
+    the kind has no device path.  Multi-column encodings (strings,
+    decimal128) are fine: the sorted-gid core takes any column list."""
+    kind = col.dtype.kind
+    if kind in _DEVICE_RANK_KINDS:
+        r, _ = _device_rank(col)
+        return [r]
+    if kind == Kind.STRING:
+        return _string_words(col, pad_to)
+    if kind == Kind.DECIMAL128:
+        return _decimal_words(col)
+    return None
+
+
 def _device_key_columns(columns) -> list:
     """int64 equality-key columns for the sorted-gid core.  Nullable
     columns (validity present — a static pytree property) contribute a
-    (mask, zeroed-rank) pair: the sentinel-free null encoding shared by
-    joins and group-by (a sentinel value would collide with legal ranks
-    like INT64_MIN).  All-valid columns contribute just their rank,
-    keeping the sort comparator as narrow as possible — comparator
-    width is what drives XLA sort compile/runtime cost."""
+    mask column before their value columns: the sentinel-free null
+    encoding shared by joins and group-by (a sentinel value would
+    collide with legal ranks like INT64_MIN).  All-valid columns skip
+    the mask, keeping the sort comparator as narrow as possible —
+    comparator width is what drives XLA sort compile/runtime cost."""
     cols = []
     for c in columns:
-        r, m = _device_rank(c)
+        pad = c.max_string_length() if c.dtype.kind == Kind.STRING \
+            else 0
+        vals = _device_equality_cols(c, pad)
+        if vals is None:
+            raise ValueError(f"no device key path for {c.dtype}")
         if c.validity is not None:
+            m = c.validity.astype(jnp.bool_)
             cols.append(m.astype(jnp.int64))
-            cols.append(jnp.where(m, r, jnp.int64(0)))
+            cols.extend(jnp.where(m, v, jnp.int64(0)) for v in vals)
         else:
-            cols.append(r)
+            cols.extend(vals)
     return cols
 
 
@@ -265,35 +334,54 @@ def _sort_merge_inner_join_device(left: Table, right: Table,
 from functools import partial as _partial  # noqa: E402
 
 
-@_partial(jax.jit, static_argnames=("compare_nulls",))
+@jax.jit
+def _ids_from_cols_jit(cols):
+    order, gid_sorted = _sorted_gid_core(list(cols))
+    n = cols[0].shape[0]
+    return jnp.zeros(n, jnp.int64).at[order].set(gid_sorted)
+
+
+def _col_mask(c: Column) -> jnp.ndarray:
+    return (jnp.ones(c.length, jnp.bool_) if c.validity is None
+            else c.validity.astype(jnp.bool_))
+
+
 def _device_ids(left: Table, right: Table, compare_nulls: str):
-    """Per-row equality ids over the joined key columns.  The join core
-    only needs an injective int64 key (it sorts + searchsorts), so a
-    single all-valid key column IS its own id — no sort at all.  Only
-    multi-column or nullable keys pay for the sorted-gid pass."""
+    """Per-row equality ids over the joined key columns.  Eager key
+    prep (string pad widths are data-dependent) + one jitted sorted-gid
+    program.  The join core only needs an injective int64 key (it sorts
+    + searchsorts), so a single all-valid fixed-width key column IS its
+    own id — no sort at all; multi-column encodings (strings as packed
+    words + length, decimal128 as limb words) and nullable keys pay for
+    the sorted-gid pass."""
     nl, nr = left.num_rows, right.num_rows
     key_cols = []
     vl = jnp.ones(nl, jnp.bool_)
     vr = jnp.ones(nr, jnp.bool_)
     for lc, rc in zip(left.columns, right.columns):
-        lr_, lm = _device_rank(lc)
-        rr_, rm = _device_rank(rc)
+        pad = (max(lc.max_string_length(), rc.max_string_length())
+               if lc.dtype.kind == Kind.STRING else 0)
+        lvals = _device_equality_cols(lc, pad)
+        rvals = _device_equality_cols(rc, pad)
         nullable = lc.validity is not None or rc.validity is not None
+        if nullable or compare_nulls == NULL_UNEQUAL:
+            lm, rm = _col_mask(lc), _col_mask(rc)
         if nullable:
             key_cols.append(jnp.concatenate([lm, rm]).astype(jnp.int64))
-            key_cols.append(jnp.concatenate(
-                [jnp.where(lm, lr_, jnp.int64(0)),
-                 jnp.where(rm, rr_, jnp.int64(0))]))
+            key_cols.extend(
+                jnp.concatenate([jnp.where(lm, lv, jnp.int64(0)),
+                                 jnp.where(rm, rv, jnp.int64(0))])
+                for lv, rv in zip(lvals, rvals))
         else:
-            key_cols.append(jnp.concatenate([lr_, rr_]))
+            key_cols.extend(jnp.concatenate([lv, rv])
+                            for lv, rv in zip(lvals, rvals))
         if compare_nulls == NULL_UNEQUAL:
             vl &= lm
             vr &= rm
     if len(key_cols) == 1:
         ids = key_cols[0]
     else:
-        order, gid_sorted = _sorted_gid_core(key_cols)
-        ids = jnp.zeros(nl + nr, jnp.int64).at[order].set(gid_sorted)
+        ids = _ids_from_cols_jit(tuple(key_cols))
     return ids[:nl], ids[nl:], vl, vr
 
 
@@ -334,12 +422,13 @@ def sort_merge_inner_join(left_keys: Table, right_keys: Table,
     use_device = (jax.default_backend() != "cpu"
                   or os.environ.get("SPARK_RAPIDS_TPU_FORCE_DEVICE_JOIN")
                   == "1")
-    # both sides must be device-rankable AND per-column kinds must match
-    # (a mismatch falls through to the host path's ValueError)
+    # both sides must have a device key encoding AND per-column kinds
+    # must match (a mismatch falls through to the host path's
+    # ValueError); very long string keys sort better on the host
     device_ok = (
         len(left_keys.columns) == len(right_keys.columns)
         and all(lc.dtype.kind == rc.dtype.kind
-                and lc.dtype.kind in _DEVICE_RANK_KINDS
+                and _device_key_kind_ok(lc) and _device_key_kind_ok(rc)
                 for lc, rc in zip(left_keys.columns, right_keys.columns)))
     if use_device and device_ok:
         return _sort_merge_inner_join_device(left_keys, right_keys,
