@@ -1,5 +1,5 @@
 """Benchmark entry point. Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "attempts": [...]}
 
 Headline metric (BASELINE.json): row<->columnar conversion GB/s on TPU.
 vs_baseline is the ratio against a single-thread numpy host conversion of the
@@ -7,38 +7,86 @@ same table (the CPU reference the Spark plugin would otherwise use), since the
 reference publishes no GPU numbers (BASELINE.md).
 
 The TPU backend here is a tunneled relay that can wedge (jax.devices()
-then blocks forever, taking the whole process with it).  So the backend
-is probed in a SUBPROCESS with a timeout before jax is imported in this
-process; if the accelerator is unreachable the same benchmark runs on
-the CPU backend and the metric name says so — one honest JSON line
-either way, never a hang.
+then blocks forever, taking the whole process with it) and has been
+observed unreachable for >390s at a stretch.  So the bench FIGHTS for
+the chip: the backend is probed in a SUBPROCESS (so a wedge can't take
+this process down) with a generous per-probe timeout, and probing
+retries with pauses until a configurable deadline.  Every attempt is
+recorded with timestamp/duration/outcome in the output JSON so a
+fallback line is auditable.  Only after the whole window is exhausted
+does the same benchmark run on the CPU backend, with the metric name
+saying so — one honest JSON line either way, never a hang.
+
+Env knobs:
+  BENCH_FIGHT_SECONDS  total window to keep retrying the probe (default 1500)
+  BENCH_PROBE_TIMEOUT  per-probe subprocess timeout (default 600 — a >390s
+                       wedge has been observed; 150s was too short)
+  BENCH_PROBE_PAUSE    sleep between failed probes (default 20)
 """
 
 import json
 import os
 import subprocess
 import sys
+import time
 
 _PROBE = "import jax; jax.devices(); print('ok')"
 
 
-def _backend_mode(timeout_s: int = 150) -> str:
-    """'tpu' | 'cpu_pinned' (operator forced CPU via env — never probed)
-    | 'cpu_fallback' (probe failed or timed out)."""
-    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        return "cpu_pinned"
+def _probe_once(timeout_s: float) -> str:
+    """Run one backend probe in a subprocess. Returns 'ok'|'timeout'|'error'."""
     try:
         r = subprocess.run([sys.executable, "-c", _PROBE],
                            timeout=timeout_s, capture_output=True)
         if r.returncode == 0 and b"ok" in r.stdout:
-            return "tpu"
-        return "cpu_fallback"
+            return "ok"
+        return "error"
     except subprocess.TimeoutExpired:
-        return "cpu_fallback"
+        return "timeout"
+
+
+def _fight_for_backend():
+    """'tpu' | 'cpu_pinned' | 'cpu_fallback', plus the attempt log.
+
+    cpu_pinned: operator forced CPU via env — never probed.
+    cpu_fallback: every probe in the fight window failed or timed out.
+    """
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return "cpu_pinned", []
+
+    window = float(os.environ.get("BENCH_FIGHT_SECONDS", "1500"))
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "600"))
+    pause = float(os.environ.get("BENCH_PROBE_PAUSE", "20"))
+
+    attempts = []
+    deadline = time.time() + window
+    fast_errors = 0
+    while True:
+        t0 = time.time()
+        outcome = _probe_once(max(min(probe_timeout, deadline - t0), 10.0))
+        dur = time.time() - t0
+        attempts.append({
+            "t": round(t0, 1),
+            "dur_s": round(dur, 1),
+            "outcome": outcome,
+        })
+        if outcome == "ok":
+            return "tpu", attempts
+        # A wedged relay shows up as 'timeout'; a machine with no TPU
+        # plugin at all fails FAST and deterministically ('error' in a few
+        # seconds) — don't burn the whole window re-asking that machine.
+        fast_errors = fast_errors + 1 if (outcome == "error"
+                                          and dur < 30) else 0
+        if fast_errors >= 3:
+            break
+        if deadline - time.time() <= pause + 5:
+            break
+        time.sleep(pause)
+    return "cpu_fallback", attempts
 
 
 def main():
-    backend = _backend_mode()
+    backend, attempts = _fight_for_backend()
     import jax
 
     if backend != "tpu":
@@ -51,6 +99,7 @@ def main():
         result["metric"] += "_CPU_FALLBACK_tpu_unreachable"
     elif backend == "cpu_pinned":
         result["metric"] += "_CPU_pinned"
+    result["attempts"] = attempts
     print(json.dumps(result))
 
 

@@ -278,7 +278,10 @@ def _narrow_to_f32(bits64):
     m24 = jnp.where(carried, m24 >> _U64(1), m24)
     e32 = e32 + carried.astype(_I32)
     overflow = (e32 >= 255) & ~is_special
-    need_fb = (e32 <= 0) & (exp64 != 0)            # f32 subnormal
+    # f32-subnormal results AND f64-subnormal inputs go to the fallback:
+    # the clip-to-1 + forced hidden bit below would fabricate a normal f32
+    # for an exp64==0 input, so such rows must never take the device value.
+    need_fb = ((e32 <= 0) & (exp64 != 0)) | ((exp64 == 0) & (mant != _U64(0)))
     out = (m24 & _U64((1 << 23) - 1)) \
         | (jnp.clip(e32, 1, 254).astype(_U64) << _U64(23))
     out = jnp.where(overflow, _U64(0xFF) << _U64(23), out)

@@ -114,3 +114,22 @@ def test_routing_and_ansi():
 
     with pytest.raises(CastException):
         string_to_float(col, dtypes.FLOAT64, ansi_mode=True)
+
+
+def test_narrow_to_f32_subnormal_input_is_flagged():
+    """f64-subnormal inputs (exp64==0, mant!=0) must be routed to the
+    fallback by _narrow_to_f32 itself, not rely on callers pre-filtering:
+    the exponent clip + forced hidden bit would otherwise fabricate a
+    normal f32 (ADVICE r2)."""
+    import jax.numpy as jnp
+
+    vals = np.array([5e-324, 1e-310, 0.0, -0.0, 1.5, -2.25], np.float64)
+    bits = vals.view(np.uint64)
+    out, need_fb = stod_device._narrow_to_f32(jnp.asarray(bits))
+    out = np.asarray(out, np.uint64)
+    need_fb = np.asarray(need_fb, bool)
+    assert list(need_fb) == [True, True, False, False, False, False]
+    # zeros narrow to sign-only bits; normals narrow exactly
+    want = vals.astype(np.float32).view(np.uint32)
+    for i in (2, 3, 4, 5):
+        assert np.uint32(out[i]) == want[i]
