@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test fuzz native sanitizers bench bench-all dryrun ci clean
+.PHONY: test fuzz native sanitizers bench bench-all dryrun tpu-lower ci clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -27,6 +27,12 @@ bench:
 bench-all:
 	$(PY) bench_all.py
 
+# deviceless proof that every device engine still lowers for platform
+# "tpu" (jax.export AOT cross-lowering) — catches TPU-lowering breakage
+# even when the relay is down
+tpu-lower:
+	$(PY) scripts/tpu_lowering_gate.py
+
 # NOTE: jax.config.update, not the env var — this image's sitecustomize
 # pre-imports jax with the axon backend, so JAX_PLATFORMS=cpu is too late
 dryrun:
@@ -37,10 +43,12 @@ dryrun:
 
 # one-command premerge gate (reference ci/Jenkinsfile.premerge:196-232):
 # unit tests + OOM fuzz (python AND native adaptors differentially) +
-# sanitizer builds + multichip dryrun + bench probe.  Fails loudly on
-# the first red step; bench.py itself never hangs (subprocess probe
-# with timeout, CPU fallback marked in the metric name).
-ci: test fuzz native sanitizers dryrun
+# sanitizer builds + TPU lowering gate + multichip dryrun + bench.
+# Fails loudly on the first red step.  bench.py never hangs, but when
+# the relay is down it FIGHTS for the chip up to BENCH_FIGHT_SECONDS
+# (default 1500s) before emitting the CPU-fallback line — export
+# BENCH_FIGHT_SECONDS=1 for a quick local run.
+ci: test fuzz native sanitizers tpu-lower dryrun
 	$(PY) bench.py
 	@echo "ci: all gates green"
 

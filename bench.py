@@ -59,14 +59,14 @@ def _fight_for_backend():
     pause = float(os.environ.get("BENCH_PROBE_PAUSE", "20"))
 
     attempts = []
-    deadline = time.time() + window
+    deadline = time.monotonic() + window   # monotonic: immune to NTP steps
     fast_errors = 0
     while True:
-        t0 = time.time()
-        outcome = _probe_once(max(min(probe_timeout, deadline - t0), 10.0))
-        dur = time.time() - t0
+        m0 = time.monotonic()
+        outcome = _probe_once(max(min(probe_timeout, deadline - m0), 10.0))
+        dur = time.monotonic() - m0
         attempts.append({
-            "t": round(t0, 1),
+            "t": round(time.time() - dur, 1),   # wall epoch, for the audit log
             "dur_s": round(dur, 1),
             "outcome": outcome,
         })
@@ -79,7 +79,7 @@ def _fight_for_backend():
                                           and dur < 30) else 0
         if fast_errors >= 3:
             break
-        if deadline - time.time() <= pause + 5:
+        if deadline - time.monotonic() <= pause + 5:
             break
         time.sleep(pause)
     return "cpu_fallback", attempts
