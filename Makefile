@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test fuzz native sanitizers bench bench-all dryrun tpu-lower ci clean
+.PHONY: test fuzz native sanitizers bench bench-all dryrun tpu-lower jni-test ci clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -33,6 +33,14 @@ bench-all:
 tpu-lower:
 	$(PY) scripts/tpu_lowering_gate.py
 
+# end-to-end JVM binding smoke: real JVM -> JNI shim -> embedded
+# CPython -> runtime (reference: JUnit suites on GPU pods).  Uses
+# bazel's embedded JRE; skips cleanly when no JVM exists.
+jni-test:
+	@bash scripts/run_jni_smoke.sh; rc=$$?; \
+	if [ $$rc -eq 2 ]; then echo "jni-test: skipped (no JVM)"; \
+	elif [ $$rc -ne 0 ]; then exit $$rc; fi
+
 # NOTE: jax.config.update, not the env var — this image's sitecustomize
 # pre-imports jax with the axon backend, so JAX_PLATFORMS=cpu is too late
 dryrun:
@@ -48,7 +56,7 @@ dryrun:
 # the relay is down it FIGHTS for the chip up to BENCH_FIGHT_SECONDS
 # (default 1500s) before emitting the CPU-fallback line — export
 # BENCH_FIGHT_SECONDS=1 for a quick local run.
-ci: test fuzz native sanitizers tpu-lower dryrun
+ci: test fuzz native sanitizers tpu-lower jni-test dryrun
 	$(PY) bench.py
 	@echo "ci: all gates green"
 

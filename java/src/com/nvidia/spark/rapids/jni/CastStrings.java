@@ -1,0 +1,39 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Spark string cast kernels (reference:
+ * src/main/java/com/nvidia/spark/rapids/jni/CastStrings.java:39-134;
+ * TPU engines: spark_rapids_tpu/ops/cast_string.py — vectorized DFA —
+ * plus stod_device.py (Eisel-Lemire) and ftos_device.py (Ryu)).
+ */
+public final class CastStrings {
+  private CastStrings() {}
+
+  /**
+   * CAST(string AS integral) with Spark trimming/ANSI rules; in ANSI
+   * mode a failing row raises with its row index (reference
+   * cast_string.hpp:2-13 cast_error).
+   *
+   * @param column handle of a STRING column
+   * @param ansi   throw on invalid input instead of null
+   * @param strip  trim whitespace first (Spark semantics)
+   * @param typeId target dtype id ("int8","int16","int32","int64")
+   */
+  public static native long toInteger(long column, boolean ansi,
+                                      boolean strip, String typeId);
+
+  /**
+   * CAST(string AS float/double): correctly-rounded decimal-&gt;IEEE754
+   * (reference cast_string_to_float.cu; TPU engine is an integer-limb
+   * Eisel-Lemire scan, stod_device.py).
+   */
+  public static native long toFloat(long column, boolean ansi,
+                                    String typeId);
+
+  /**
+   * Java-compatible shortest-round-trip float-&gt;string (reference
+   * ftos_converter.cuh; TPU engine regenerates the Ryu tables at import
+   * and runs the digit engine vectorized, ftos_device.py).
+   */
+  public static native long fromFloat(long column);
+}

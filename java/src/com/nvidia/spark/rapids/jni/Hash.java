@@ -1,0 +1,35 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Spark-exact hash functions over columns (reference:
+ * src/main/java/com/nvidia/spark/rapids/jni/Hash.java:44 and
+ * src/main/cpp/src/hash/HashJni.cpp:31-46; TPU engines:
+ * spark_rapids_tpu/ops/hash.py — vectorized murmur3/xxhash64/hive over
+ * arbitrary nested tables, golden-validated against Spark).
+ */
+public final class Hash {
+  private Hash() {}
+
+  /** Default Spark seed for xxhash64. */
+  public static final long DEFAULT_XXHASH64_SEED = 42;
+
+  /**
+   * Spark murmur3_32 across the given columns (Spark seed-chaining
+   * rules; null rows contribute the seed).
+   *
+   * @param seed    initial seed (Spark uses 42)
+   * @param columns column handles, hashed left-to-right
+   * @return handle of an INT32 column
+   */
+  public static native long murmurHash32(int seed, long[] columns);
+
+  /**
+   * Spark xxhash64 across the given columns.
+   *
+   * @return handle of an INT64 column
+   */
+  public static native long xxHash64(long seed, long[] columns);
+
+  /** Hive hash across the given columns; returns an INT32 column. */
+  public static native long hiveHash(long[] columns);
+}

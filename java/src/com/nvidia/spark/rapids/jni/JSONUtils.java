@@ -1,0 +1,20 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Spark JSON kernels (reference:
+ * src/main/java/com/nvidia/spark/rapids/jni/JSONUtils.java:64-106 over
+ * get_json_object.cu; TPU engine: spark_rapids_tpu/ops/json_device.py —
+ * pushdown-automaton byte scan with budget chunking).
+ */
+public final class JSONUtils {
+  private JSONUtils() {}
+
+  /**
+   * Spark {@code get_json_object(col, path)}: evaluate a JSONPath
+   * against every row of a STRING column of JSON documents.
+   *
+   * @return handle of a STRING column (null where the path misses or
+   *         the document is invalid)
+   */
+  public static native long getJsonObject(long column, String path);
+}

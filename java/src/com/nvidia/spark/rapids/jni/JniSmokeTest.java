@@ -1,0 +1,80 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * End-to-end binding smoke test (source mirror of the bytecode emitted
+ * by scripts/gen_java_classes.py — see java/README.md for why this
+ * image runs emitted classes instead of compiling this file).
+ *
+ * <p>Reference counterpart: the JUnit suites calling
+ * Hash.murmurHash32 / RowConversion.convertToRows on a live GPU
+ * (HashTest.java, RowConversionTest.java).  Golden murmur values are
+ * the Spark-derived constants from tests/test_hash.py.
+ */
+public final class JniSmokeTest {
+  private JniSmokeTest() {}
+
+  public static void main(String[] args) {
+    System.load(args[0]);
+    TpuRuntime.initialize();
+    System.out.println("runtime initialized");
+
+    long strs = TpuColumns.fromStrings(new String[] {
+        "a", "B\nc",
+        "A very long (greater than 128 bytes/char string) to test a "
+        + "multi hash-step data point in the MD5 hash function. This "
+        + "string needed to be longer.A 60 character string to test "
+        + "MD5's message padding algorithm"});
+    long murmur = Hash.murmurHash32(42, new long[] {strs});
+    TestSupport.assertTrue(
+        TestSupport.checkIntColumn(murmur,
+            new int[] {1485273170, 1709559900, 176121990}),
+        "murmur3_32 Spark golden");
+    System.out.println("murmur3_32 golden ok");
+
+    long longs = TpuColumns.fromLongs(new long[] {1, 2, 3});
+    long xx = Hash.xxHash64(42, new long[] {longs});
+    TestSupport.assertTrue(
+        TestSupport.checkLongColumn(xx,
+            new long[] {-7001672635703045582L, -3341702809300393011L,
+                        3188756510806108107L}),
+        "xxhash64 engine golden");
+    System.out.println("xxhash64 golden ok");
+
+    long rows = RowConversion.convertToRows(new long[] {longs});
+    long[] back = RowConversion.convertFromRows(
+        rows, new String[] {"int64"}, new int[] {0});
+    TestSupport.assertTrue(
+        TestSupport.checkColumnsEqual(longs, back[0]),
+        "JCUDF row conversion round trip");
+    System.out.println("row conversion round trip ok");
+
+    long nums = TpuColumns.fromStrings(
+        new String[] {"123", "-45", "999"});
+    long ints = CastStrings.toInteger(nums, false, true, "int32");
+    TestSupport.assertTrue(
+        TestSupport.checkIntColumn(ints, new int[] {123, -45, 999}),
+        "CastStrings.toInteger");
+    System.out.println("cast string->int ok");
+
+    long json = TpuColumns.fromStrings(
+        new String[] {"{\"a\": 1}", "{\"a\": 2}"});
+    long jout = JSONUtils.getJsonObject(json, "$.a");
+    TestSupport.assertTrue(
+        TestSupport.checkStringColumn(jout, new String[] {"1", "2"}),
+        "JSONUtils.getJsonObject");
+    System.out.println("get_json_object ok");
+
+    RmmSpark.setEventHandler(1 << 20);
+    RmmSpark.startDedicatedTaskThread(99, 1);
+    RmmSpark.taskDone(1);
+    RmmSpark.clearEventHandler();
+    System.out.println("RmmSpark register/taskDone ok");
+
+    for (long h : new long[] {strs, murmur, longs, xx, rows, back[0],
+                              nums, ints, json, jout}) {
+      TpuColumns.free(h);
+    }
+    TpuRuntime.shutdown();
+    System.out.println("JNI smoke: ALL OK");
+  }
+}

@@ -1,0 +1,50 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Facade over the OOM retry/split state machine (reference:
+ * src/main/java/com/nvidia/spark/rapids/jni/RmmSpark.java:85-111 over
+ * SparkResourceAdaptorJni.cpp; TPU runtime:
+ * spark_rapids_tpu/memory/spark_resource_adaptor.py with the
+ * differentially-tested C ABI port native/spark_resource_adaptor.cpp).
+ *
+ * <p>This surface mirrors the reference method names so the plugin's
+ * retry framework maps 1:1; the subset exposed over JNI today covers
+ * registration, task completion, forced-OOM test injection, and state
+ * inspection.  The full state-machine contract (9 states, BUFN, split,
+ * deadlock-break, spill brackets, per-task metrics) lives behind the
+ * same facade in the runtime and is exercised by
+ * tests/test_rmm_spark.py + the Monte-Carlo fuzz
+ * (reference: RmmSparkTest.java, RmmSparkMonteCarlo.java).
+ */
+public final class RmmSpark {
+  private RmmSpark() {}
+
+  /**
+   * Install the resource adaptor over the device allocator with the
+   * given memory limit (reference RmmSpark.setEventHandler).
+   */
+  public static native void setEventHandler(long limitBytes);
+
+  /** Remove the adaptor (tests). */
+  public static native void clearEventHandler();
+
+  /**
+   * Associate a dedicated task thread with a task (reference
+   * RmmSpark.startDedicatedTaskThread:176).
+   */
+  public static native void startDedicatedTaskThread(long threadId,
+                                                     long taskId);
+
+  /** Task finished: release threads, wake BUFN waiters (reference :416). */
+  public static native void taskDone(long taskId);
+
+  /**
+   * Force the next allocation on a thread to throw GpuRetryOOM
+   * (test injection; reference RmmSpark.forceRetryOOM →
+   * SparkResourceAdaptorJni.cpp:955).
+   */
+  public static native void forceRetryOOM(long threadId, int numOOMs);
+
+  /** Thread-state name for assertions (reference RmmSparkThreadState). */
+  public static native String getStateOf(long threadId);
+}

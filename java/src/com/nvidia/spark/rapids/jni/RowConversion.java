@@ -1,0 +1,34 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * JCUDF row&lt;-&gt;columnar conversion (reference:
+ * src/main/java/com/nvidia/spark/rapids/jni/RowConversion.java:35-158
+ * over row_conversion.cu; TPU engine:
+ * spark_rapids_tpu/ops/row_conversion.py — word-composition XLA
+ * assembly, optional Pallas tile kernel).
+ *
+ * <p>Row format: Spark UnsafeRow-compatible fixed-width blobs, 8-byte
+ * aligned, trailing per-row null bitmask (JCUDF_ROW_ALIGNMENT=8,
+ * reference row_conversion.cu:64).
+ */
+public final class RowConversion {
+  private RowConversion() {}
+
+  /**
+   * Convert a table (array of column handles) to a LIST&lt;UINT8&gt;
+   * rows column.
+   */
+  public static native long convertToRows(long[] tableColumns);
+
+  /**
+   * Convert a rows column back to columns.
+   *
+   * @param rows    handle from {@link #convertToRows}
+   * @param typeIds dtype ids per output column (e.g. "int64", "f64",
+   *                "decimal64")
+   * @param scales  decimal scales (0 for non-decimals)
+   * @return one handle per output column
+   */
+  public static native long[] convertFromRows(long rows, String[] typeIds,
+                                              int[] scales);
+}

@@ -1,0 +1,31 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Native-side assertion helpers for the JNI smoke test.
+ *
+ * <p>Why native asserts: this image has a JRE but no Java compiler, so
+ * the runnable test classes are emitted directly as bytecode
+ * (scripts/gen_java_classes.py).  Keeping comparisons native lets the
+ * emitted bytecode stay straight-line (no branches, hence no
+ * StackMapTable frames).  {@link #assertTrue} throws
+ * {@link AssertionError} from the native side on failure.
+ */
+public final class TestSupport {
+  private TestSupport() {}
+
+  /** Throws AssertionError(msg) when cond == 0. */
+  public static native void assertTrue(int cond, String msg);
+
+  /** 1 iff the INT64 column equals the expected values. */
+  public static native int checkLongColumn(long column, long[] expected);
+
+  /** 1 iff the INT32 column equals the expected values. */
+  public static native int checkIntColumn(long column, int[] expected);
+
+  /** 1 iff the STRING column equals the expected values. */
+  public static native int checkStringColumn(long column,
+                                             String[] expected);
+
+  /** 1 iff both columns have equal host values. */
+  public static native int checkColumnsEqual(long a, long b);
+}

@@ -1,0 +1,30 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Column construction/release over opaque {@code long} handles — the
+ * stand-in for the cudf-java {@code ColumnVector} surface the reference
+ * ops operate on (reference ops take {@code ColumnView[]}, i.e. native
+ * pointers; here handles index the runtime's device-column registry,
+ * spark_rapids_tpu/shim/handles.py).
+ *
+ * <p>Ownership: every handle returned by any method in this package
+ * must be released exactly once via {@link #free(long)}.
+ */
+public final class TpuColumns {
+  private TpuColumns() {}
+
+  /** INT64 column from host values. */
+  public static native long fromLongs(long[] values);
+
+  /** INT32 column from host values. */
+  public static native long fromInts(int[] values);
+
+  /** FLOAT64 column from host values. */
+  public static native long fromDoubles(double[] values);
+
+  /** STRING column; null elements become null rows. */
+  public static native long fromStrings(String[] values);
+
+  /** Release a handle (exactly once). */
+  public static native void free(long handle);
+}

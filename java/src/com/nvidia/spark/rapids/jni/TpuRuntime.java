@@ -1,0 +1,36 @@
+/*
+ * spark-rapids-tpu: TPU-native re-implementation of the
+ * spark-rapids-jni acceleration library.  Same package as the
+ * reference (com.nvidia.spark.rapids.jni) so plugin-facing code keeps
+ * its imports; the native layer is the JAX/XLA runtime reached through
+ * libspark_rapids_tpu_jni.so (native/jni/spark_rapids_tpu_jni.cpp).
+ */
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Lifecycle of the embedded TPU runtime (the role the CUDA
+ * context/libcudf load plays in the reference).  The shim embeds one
+ * CPython interpreter per JVM hosting the JAX/XLA runtime; every other
+ * class in this package routes through it.
+ *
+ * <p>Load order: {@code System.load(<libspark_rapids_tpu_jni.so>)} then
+ * {@link #initialize()}.  Set env {@code SPARK_RAPIDS_TPU_ROOT} to the
+ * runtime checkout/install root and {@code SPARK_RAPIDS_TPU_PLATFORM}
+ * to pin a JAX platform (e.g. {@code cpu} for host testing).
+ */
+public final class TpuRuntime {
+  private TpuRuntime() {}
+
+  /** Bring up the embedded runtime; idempotent, thread-safe. */
+  public static native void initialize();
+
+  /** Release all live handles (JVM-exit hygiene). */
+  public static native void shutdown();
+
+  /**
+   * Number of live column handles (leak detection in tests; the
+   * reference's equivalent observability is ColumnVector ref-count
+   * asserts in cudf-java).
+   */
+  public static native int liveHandles();
+}

@@ -1,0 +1,39 @@
+#!/bin/bash
+# Build libspark_rapids_tpu_jni.so (the L4 JNI binding).
+#
+# jni.h comes from any JDK; this image has no system JDK, but bazel's
+# embedded Zulu ships the headers (and the JRE that runs the smoke
+# test).  Set SPARK_RAPIDS_JDK to override discovery.
+set -e
+cd "$(dirname "$0")"
+
+JDK="${SPARK_RAPIDS_JDK:-}"
+if [ -z "$JDK" ]; then
+    for d in "$HOME"/.cache/bazel/_bazel_*/install/*/embedded_tools/jdk; do
+        [ -e "$d/include/jni.h" ] && JDK="$d" && break
+    done
+fi
+if [ -z "$JDK" ] || [ ! -e "$JDK/include/jni.h" ]; then
+    # force bazel to unpack its install base (ships jni.h + a JRE)
+    if command -v bazel >/dev/null 2>&1; then
+        (cd /tmp && bazel version >/dev/null 2>&1) || true
+        for d in "$HOME"/.cache/bazel/_bazel_*/install/*/embedded_tools/jdk; do
+            [ -e "$d/include/jni.h" ] && JDK="$d" && break
+        done
+    fi
+fi
+if [ -z "$JDK" ] || [ ! -e "$JDK/include/jni.h" ]; then
+    echo "no jni.h found (no JDK; bazel embedded JDK unavailable)" >&2
+    exit 2
+fi
+
+PY_INC=$(python3-config --includes)
+PY_LIBDIR=$(python3 -c "import sysconfig; print(sysconfig.get_config_var('LIBDIR'))")
+
+g++ -O2 -std=c++17 -shared -fPIC \
+    -I"$JDK/include" -I"$JDK/include/linux" \
+    $PY_INC \
+    -o libspark_rapids_tpu_jni.so spark_rapids_tpu_jni.cpp \
+    -L"$PY_LIBDIR" -Wl,-rpath,"$PY_LIBDIR" -lpython3.12
+
+echo "built $(pwd)/libspark_rapids_tpu_jni.so (JDK=$JDK)"

@@ -1,0 +1,550 @@
+// JNI binding for spark_rapids_tpu: real JVM -> JNI -> embedded CPython
+// -> JAX/XLA runtime.
+//
+// This is the L4 layer of the reference architecture (SURVEY.md §1):
+// the reference's *Jni.cpp files unwrap jlong column handles, call the
+// native op, and wrap the result back into a jlong
+// (src/main/cpp/src/hash/HashJni.cpp:31-46).  Here the "native runtime"
+// is the JAX/XLA process: the shim embeds CPython once per JVM, routes
+// every call through spark_rapids_tpu.shim.jni_entry (flat
+// primitives-and-handles functions), and maps Python exceptions to
+// java.lang.RuntimeException with the formatted traceback as message.
+//
+// Threading: JNI entry points can arrive on any JVM thread;
+// PyGILState_Ensure/Release pairs make each call GIL-correct.  After
+// initialization the embedding thread RELEASES the GIL so JVM threads
+// never deadlock against it.
+//
+// Build: native/jni/build.sh (needs jni.h — bazel's embedded JDK ships
+// it — and libpython3.12).  Java-side classes: java/src/... (sources),
+// scripts/gen_java_classes.py (runnable class files for this JRE-only
+// image).
+
+#include <dlfcn.h>
+#include <jni.h>
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+namespace {
+
+PyObject* g_entry = nullptr;   // spark_rapids_tpu.shim.jni_entry
+std::once_flag g_init_flag;
+std::string g_init_error;
+
+void throw_java(JNIEnv* env, const char* msg) {
+  jclass cls = env->FindClass("java/lang/RuntimeException");
+  if (cls != nullptr) env->ThrowNew(cls, msg);
+}
+
+// Format the pending Python exception into a string and clear it.
+std::string pending_python_error() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string out = "python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) {
+        out = c;
+        if (type != nullptr) {
+          PyObject* tn = PyObject_GetAttrString(type, "__name__");
+          const char* tc = tn ? PyUnicode_AsUTF8(tn) : nullptr;
+          if (tc != nullptr) out = std::string(tc) + ": " + out;
+          Py_XDECREF(tn);
+        }
+      }
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return out;
+}
+
+void do_initialize() {
+  if (!Py_IsInitialized()) {
+    // System.load() binds our DT_NEEDED libpython with RTLD_LOCAL, so
+    // CPython extension modules (math, numpy core, ...) — which do not
+    // link libpython themselves — would fail to resolve Py* symbols.
+    // Re-open libpython with RTLD_GLOBAL to promote its symbols.
+    if (dlopen("libpython3.12.so", RTLD_NOW | RTLD_GLOBAL) == nullptr) {
+      dlopen("libpython3.12.so.1.0", RTLD_NOW | RTLD_GLOBAL);
+    }
+    Py_InitializeEx(0);  // 0: leave signal handling to the JVM
+  }
+  // Runtime root: env override first, else the JVM's working directory.
+  const char* root = std::getenv("SPARK_RAPIDS_TPU_ROOT");
+  std::string root_s = root ? root : ".";
+  PyObject* sys_path = PySys_GetObject("path");  // borrowed
+  if (sys_path != nullptr) {
+    PyObject* p = PyUnicode_FromString(root_s.c_str());
+    PyList_Insert(sys_path, 0, p);
+    Py_DECREF(p);
+  }
+  PyObject* mod = PyImport_ImportModule("spark_rapids_tpu.shim.jni_entry");
+  if (mod == nullptr) {
+    g_init_error = "import jni_entry failed: " + pending_python_error();
+    PyEval_SaveThread();  // never exit init still holding the GIL
+    return;
+  }
+  PyObject* r = PyObject_CallMethod(mod, "initialize", nullptr);
+  if (r == nullptr) {
+    g_init_error = "jni_entry.initialize failed: " + pending_python_error();
+    Py_DECREF(mod);
+    PyEval_SaveThread();
+    return;
+  }
+  Py_DECREF(r);
+  g_entry = mod;  // keep the reference for the life of the JVM
+  // Release the GIL taken by Py_InitializeEx so JVM threads can enter.
+  PyEval_SaveThread();
+}
+
+// Ensure the interpreter is up; returns false (with a Java exception
+// pending) on failure.  Safe to call from any JVM thread.
+bool ensure_runtime(JNIEnv* env) {
+  std::call_once(g_init_flag, do_initialize);
+  if (g_entry == nullptr) {
+    throw_java(env, g_init_error.empty()
+                        ? "spark_rapids_tpu runtime init failed"
+                        : g_init_error.c_str());
+    return false;
+  }
+  return true;
+}
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() : st(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(st); }
+};
+
+// ---- JNI <-> Python converters (GIL must be held) -------------------
+
+PyObject* longs_to_pylist(JNIEnv* env, jlongArray arr) {
+  jsize n = env->GetArrayLength(arr);
+  jlong* elems = env->GetLongArrayElements(arr, nullptr);
+  PyObject* list = PyList_New(n);
+  for (jsize i = 0; i < n; ++i) {
+    PyList_SET_ITEM(list, i, PyLong_FromLongLong(elems[i]));
+  }
+  env->ReleaseLongArrayElements(arr, elems, JNI_ABORT);
+  return list;
+}
+
+PyObject* ints_to_pylist(JNIEnv* env, jintArray arr) {
+  jsize n = env->GetArrayLength(arr);
+  jint* elems = env->GetIntArrayElements(arr, nullptr);
+  PyObject* list = PyList_New(n);
+  for (jsize i = 0; i < n; ++i) {
+    PyList_SET_ITEM(list, i, PyLong_FromLong(elems[i]));
+  }
+  env->ReleaseIntArrayElements(arr, elems, JNI_ABORT);
+  return list;
+}
+
+PyObject* doubles_to_pylist(JNIEnv* env, jdoubleArray arr) {
+  jsize n = env->GetArrayLength(arr);
+  jdouble* elems = env->GetDoubleArrayElements(arr, nullptr);
+  PyObject* list = PyList_New(n);
+  for (jsize i = 0; i < n; ++i) {
+    PyList_SET_ITEM(list, i, PyFloat_FromDouble(elems[i]));
+  }
+  env->ReleaseDoubleArrayElements(arr, elems, JNI_ABORT);
+  return list;
+}
+
+// Java String -> Python str via UTF-16 code units (NOT GetStringUTFChars,
+// which yields JNI modified UTF-8 — CESU-8 surrogate pairs for non-BMP
+// chars that PyUnicode_FromString rejects).
+PyObject* jstring_to_py(JNIEnv* env, jstring js) {
+  jsize len = env->GetStringLength(js);
+  const jchar* chars = env->GetStringChars(js, nullptr);
+  PyObject* s = PyUnicode_DecodeUTF16(
+      reinterpret_cast<const char*>(chars),
+      static_cast<Py_ssize_t>(len) * 2, nullptr,
+      nullptr /* native byte order */);
+  env->ReleaseStringChars(js, chars);
+  if (s == nullptr) {  // lone surrogates etc: substitute None
+    PyErr_Clear();
+    Py_RETURN_NONE;
+  }
+  return s;
+}
+
+PyObject* strings_to_pylist(JNIEnv* env, jobjectArray arr) {
+  jsize n = env->GetArrayLength(arr);
+  PyObject* list = PyList_New(n);
+  for (jsize i = 0; i < n; ++i) {
+    jstring js = static_cast<jstring>(env->GetObjectArrayElement(arr, i));
+    if (js == nullptr) {
+      Py_INCREF(Py_None);
+      PyList_SET_ITEM(list, i, Py_None);
+      continue;
+    }
+    PyList_SET_ITEM(list, i, jstring_to_py(env, js));
+    env->DeleteLocalRef(js);
+  }
+  return list;
+}
+
+// Call g_entry.<fn>(*args); steals `args` (a tuple).  On Python error:
+// clears it, throws Java RuntimeException, returns nullptr.
+PyObject* call_entry(JNIEnv* env, const char* fn, PyObject* args) {
+  PyObject* f = PyObject_GetAttrString(g_entry, fn);
+  if (f == nullptr) {
+    Py_DECREF(args);
+    throw_java(env, (std::string("no entry function ") + fn).c_str());
+    return nullptr;
+  }
+  PyObject* r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_DECREF(args);
+  if (r == nullptr) {
+    std::string msg = pending_python_error();
+    throw_java(env, msg.c_str());
+    return nullptr;
+  }
+  return r;
+}
+
+jlong as_jlong(JNIEnv* env, PyObject* r) {
+  if (r == nullptr) return 0;
+  jlong v = static_cast<jlong>(PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  if (PyErr_Occurred() != nullptr) {  // non-int return: surface, clear
+    throw_java(env, pending_python_error().c_str());
+    return 0;
+  }
+  return v;
+}
+
+jint as_jint(JNIEnv* env, PyObject* r) {
+  if (r == nullptr) return 0;
+  jint v = static_cast<jint>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  if (PyErr_Occurred() != nullptr) {
+    throw_java(env, pending_python_error().c_str());
+    return 0;
+  }
+  return v;
+}
+
+jlongArray as_jlong_array(JNIEnv* env, PyObject* r) {
+  if (r == nullptr) return nullptr;
+  Py_ssize_t n = PyList_Size(r);
+  jlongArray arr = env->NewLongArray(static_cast<jsize>(n));
+  if (arr != nullptr) {
+    jlong* buf = env->GetLongArrayElements(arr, nullptr);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      buf[i] = PyLong_AsLongLong(PyList_GET_ITEM(r, i));
+    }
+    env->ReleaseLongArrayElements(arr, buf, 0);
+  }
+  Py_DECREF(r);
+  if (PyErr_Occurred() != nullptr) {  // non-int element
+    throw_java(env, pending_python_error().c_str());
+    return nullptr;
+  }
+  return arr;
+}
+
+// Python str -> Java String via UTF-16 (NewStringUTF needs modified
+// UTF-8, which PyUnicode_AsUTF8 does not produce for non-BMP chars).
+jstring as_jstring(JNIEnv* env, PyObject* r) {
+  if (r == nullptr) return nullptr;
+  PyObject* u16 = PyUnicode_AsEncodedString(r, "utf-16-le", "replace");
+  Py_DECREF(r);
+  if (u16 == nullptr) {
+    PyErr_Clear();
+    return env->NewString(nullptr, 0);
+  }
+  jstring js = env->NewString(
+      reinterpret_cast<const jchar*>(PyBytes_AS_STRING(u16)),
+      static_cast<jsize>(PyBytes_GET_SIZE(u16) / 2));
+  Py_DECREF(u16);
+  return js;
+}
+
+}  // namespace
+
+#define JNI_FN(cls, name) \
+  JNIEXPORT JNICALL Java_com_nvidia_spark_rapids_jni_##cls##_##name
+
+extern "C" {
+
+// ------------------------------------------------------------ Runtime
+
+void JNI_FN(TpuRuntime, initialize)(JNIEnv* env, jclass) {
+  ensure_runtime(env);
+}
+
+void JNI_FN(TpuRuntime, shutdown)(JNIEnv* env, jclass) {
+  if (!ensure_runtime(env)) return;
+  Gil gil;
+  PyObject* r = call_entry(env, "shutdown", PyTuple_New(0));
+  Py_XDECREF(r);
+}
+
+jint JNI_FN(TpuRuntime, liveHandles)(JNIEnv* env, jclass) {
+  if (!ensure_runtime(env)) return -1;
+  Gil gil;
+  return as_jint(env, call_entry(env, "live_handles", PyTuple_New(0)));
+}
+
+// --------------------------------------------------------- TpuColumns
+
+jlong JNI_FN(TpuColumns, fromLongs)(JNIEnv* env, jclass, jlongArray v) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = PyTuple_Pack(1, longs_to_pylist(env, v));
+  Py_DECREF(PyTuple_GET_ITEM(args, 0));  // PyTuple_Pack incref'd it
+  return as_jlong(env, call_entry(env, "from_longs", args));
+}
+
+jlong JNI_FN(TpuColumns, fromInts)(JNIEnv* env, jclass, jintArray v) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* lst = ints_to_pylist(env, v);
+  PyObject* args = PyTuple_Pack(1, lst);
+  Py_DECREF(lst);
+  return as_jlong(env, call_entry(env, "from_ints", args));
+}
+
+jlong JNI_FN(TpuColumns, fromDoubles)(JNIEnv* env, jclass,
+                                      jdoubleArray v) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* lst = doubles_to_pylist(env, v);
+  PyObject* args = PyTuple_Pack(1, lst);
+  Py_DECREF(lst);
+  return as_jlong(env, call_entry(env, "from_doubles", args));
+}
+
+jlong JNI_FN(TpuColumns, fromStrings)(JNIEnv* env, jclass,
+                                      jobjectArray v) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* lst = strings_to_pylist(env, v);
+  PyObject* args = PyTuple_Pack(1, lst);
+  Py_DECREF(lst);
+  return as_jlong(env, call_entry(env, "from_strings", args));
+}
+
+void JNI_FN(TpuColumns, free)(JNIEnv* env, jclass, jlong handle) {
+  if (!ensure_runtime(env)) return;
+  Gil gil;
+  PyObject* r = call_entry(env, "free",
+                           Py_BuildValue("(L)", (long long)handle));
+  Py_XDECREF(r);
+}
+
+// --------------------------------------------------------------- Hash
+
+jlong JNI_FN(Hash, murmurHash32)(JNIEnv* env, jclass, jint seed,
+                                 jlongArray cols) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* lst = longs_to_pylist(env, cols);
+  PyObject* args = Py_BuildValue("(iN)", (int)seed, lst);
+  return as_jlong(env, call_entry(env, "murmur_hash3_32", args));
+}
+
+jlong JNI_FN(Hash, xxHash64)(JNIEnv* env, jclass, jlong seed,
+                             jlongArray cols) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* lst = longs_to_pylist(env, cols);
+  PyObject* args = Py_BuildValue("(LN)", (long long)seed, lst);
+  return as_jlong(env, call_entry(env, "xx_hash_64", args));
+}
+
+jlong JNI_FN(Hash, hiveHash)(JNIEnv* env, jclass, jlongArray cols) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* lst = longs_to_pylist(env, cols);
+  PyObject* args = Py_BuildValue("(N)", lst);
+  return as_jlong(env, call_entry(env, "hive_hash", args));
+}
+
+// ------------------------------------------------------ RowConversion
+
+jlong JNI_FN(RowConversion, convertToRows)(JNIEnv* env, jclass,
+                                           jlongArray cols) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* lst = longs_to_pylist(env, cols);
+  PyObject* args = Py_BuildValue("(N)", lst);
+  return as_jlong(env, call_entry(env, "convert_to_rows", args));
+}
+
+jlongArray JNI_FN(RowConversion, convertFromRows)(
+    JNIEnv* env, jclass, jlong rows, jobjectArray type_ids,
+    jintArray scales) {
+  if (!ensure_runtime(env)) return nullptr;
+  Gil gil;
+  PyObject* tids = strings_to_pylist(env, type_ids);
+  PyObject* scl = ints_to_pylist(env, scales);
+  PyObject* args = Py_BuildValue("(LNN)", (long long)rows, tids, scl);
+  return as_jlong_array(env,
+                        call_entry(env, "convert_from_rows", args));
+}
+
+// -------------------------------------------------------- CastStrings
+
+jlong JNI_FN(CastStrings, toInteger)(JNIEnv* env, jclass, jlong col,
+                                     jboolean ansi, jboolean strip,
+                                     jstring type_id) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  const char* t = env->GetStringUTFChars(type_id, nullptr);
+  PyObject* args = Py_BuildValue("(LsOO)", (long long)col, t,
+                                 ansi ? Py_True : Py_False,
+                                 strip ? Py_True : Py_False);
+  env->ReleaseStringUTFChars(type_id, t);
+  return as_jlong(env, call_entry(env, "string_to_integer", args));
+}
+
+jlong JNI_FN(CastStrings, toFloat)(JNIEnv* env, jclass, jlong col,
+                                   jboolean ansi, jstring type_id) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  const char* t = env->GetStringUTFChars(type_id, nullptr);
+  PyObject* args = Py_BuildValue("(LsO)", (long long)col, t,
+                                 ansi ? Py_True : Py_False);
+  env->ReleaseStringUTFChars(type_id, t);
+  return as_jlong(env, call_entry(env, "string_to_float", args));
+}
+
+jlong JNI_FN(CastStrings, fromFloat)(JNIEnv* env, jclass, jlong col) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(L)", (long long)col);
+  return as_jlong(env, call_entry(env, "float_to_string", args));
+}
+
+// ---------------------------------------------------------- JSONUtils
+
+jlong JNI_FN(JSONUtils, getJsonObject)(JNIEnv* env, jclass, jlong col,
+                                       jstring path) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  const char* p = env->GetStringUTFChars(path, nullptr);
+  PyObject* args = Py_BuildValue("(Ls)", (long long)col, p);
+  env->ReleaseStringUTFChars(path, p);
+  return as_jlong(env, call_entry(env, "get_json_object", args));
+}
+
+// ----------------------------------------------------------- RmmSpark
+
+void JNI_FN(RmmSpark, setEventHandler)(JNIEnv* env, jclass,
+                                       jlong limit) {
+  if (!ensure_runtime(env)) return;
+  Gil gil;
+  PyObject* r = call_entry(env, "rmm_set_event_handler",
+                           Py_BuildValue("(L)", (long long)limit));
+  Py_XDECREF(r);
+}
+
+void JNI_FN(RmmSpark, clearEventHandler)(JNIEnv* env, jclass) {
+  if (!ensure_runtime(env)) return;
+  Gil gil;
+  PyObject* r = call_entry(env, "rmm_clear_event_handler",
+                           PyTuple_New(0));
+  Py_XDECREF(r);
+}
+
+void JNI_FN(RmmSpark, startDedicatedTaskThread)(JNIEnv* env, jclass,
+                                                jlong tid, jlong task) {
+  if (!ensure_runtime(env)) return;
+  Gil gil;
+  PyObject* r = call_entry(
+      env, "rmm_start_dedicated_task_thread",
+      Py_BuildValue("(LL)", (long long)tid, (long long)task));
+  Py_XDECREF(r);
+}
+
+void JNI_FN(RmmSpark, taskDone)(JNIEnv* env, jclass, jlong task) {
+  if (!ensure_runtime(env)) return;
+  Gil gil;
+  PyObject* r = call_entry(env, "rmm_task_done",
+                           Py_BuildValue("(L)", (long long)task));
+  Py_XDECREF(r);
+}
+
+void JNI_FN(RmmSpark, forceRetryOOM)(JNIEnv* env, jclass, jlong tid,
+                                     jint n) {
+  if (!ensure_runtime(env)) return;
+  Gil gil;
+  PyObject* r = call_entry(
+      env, "rmm_force_retry_oom",
+      Py_BuildValue("(Li)", (long long)tid, (int)n));
+  Py_XDECREF(r);
+}
+
+jstring JNI_FN(RmmSpark, getStateOf)(JNIEnv* env, jclass, jlong tid) {
+  if (!ensure_runtime(env)) return nullptr;
+  Gil gil;
+  return as_jstring(env,
+                    call_entry(env, "rmm_get_state_of",
+                               Py_BuildValue("(L)", (long long)tid)));
+}
+
+// -------------------------------------------------------- TestSupport
+
+void JNI_FN(TestSupport, assertTrue)(JNIEnv* env, jclass, jint cond,
+                                     jstring msg) {
+  if (cond != 0) return;
+  const char* m = env->GetStringUTFChars(msg, nullptr);
+  std::string s = std::string("assertion failed: ") + (m ? m : "");
+  env->ReleaseStringUTFChars(msg, m);
+  jclass cls = env->FindClass("java/lang/AssertionError");
+  if (cls != nullptr) env->ThrowNew(cls, s.c_str());
+}
+
+jint JNI_FN(TestSupport, checkLongColumn)(JNIEnv* env, jclass,
+                                          jlong col, jlongArray exp) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* lst = longs_to_pylist(env, exp);
+  PyObject* args = Py_BuildValue("(LN)", (long long)col, lst);
+  return as_jint(env, call_entry(env, "check_long_column", args));
+}
+
+jint JNI_FN(TestSupport, checkIntColumn)(JNIEnv* env, jclass, jlong col,
+                                         jintArray exp) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* lst = ints_to_pylist(env, exp);
+  PyObject* args = Py_BuildValue("(LN)", (long long)col, lst);
+  return as_jint(env, call_entry(env, "check_int_column", args));
+}
+
+jint JNI_FN(TestSupport, checkStringColumn)(JNIEnv* env, jclass,
+                                            jlong col,
+                                            jobjectArray exp) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* lst = strings_to_pylist(env, exp);
+  PyObject* args = Py_BuildValue("(LN)", (long long)col, lst);
+  return as_jint(env, call_entry(env, "check_string_column", args));
+}
+
+jint JNI_FN(TestSupport, checkColumnsEqual)(JNIEnv* env, jclass,
+                                            jlong a, jlong b) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(LL)", (long long)a, (long long)b);
+  return as_jint(env, call_entry(env, "check_columns_equal", args));
+}
+
+}  // extern "C"

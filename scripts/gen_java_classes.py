@@ -1,0 +1,260 @@
+"""Emit the runnable JVM class files for the JNI binding smoke test.
+
+The canonical API definition is the .java sources under java/src/ (same
+package as the reference, com.nvidia.spark.rapids.jni, so code written
+against the reference keeps its imports).  This image has a JRE (bazel's
+embedded Zulu 21) but no Java compiler, so the classes actually executed
+here are emitted with scripts/jasm.py from the declarative specs below.
+The emitted surface is the subset the smoke test drives; the .java
+sources carry the full documented API.
+
+Golden values: murmur3 expectations are Spark-derived constants (same
+vectors as tests/test_hash.py); xxhash64/cast goldens are computed by
+the Python engines at emission time (those engines are themselves
+golden-validated against Spark vectors in tests/).
+
+Usage: python scripts/gen_java_classes.py [outdir]   (default java/classes)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from jasm import ClassFile, Code  # noqa: E402
+
+PKG = "com/nvidia/spark/rapids/jni"
+
+# (class, [(method, descriptor)...]) — all public static native
+NATIVE_CLASSES = {
+    "TpuRuntime": [
+        ("initialize", "()V"),
+        ("shutdown", "()V"),
+        ("liveHandles", "()I"),
+    ],
+    "TpuColumns": [
+        ("fromLongs", "([J)J"),
+        ("fromInts", "([I)J"),
+        ("fromDoubles", "([D)J"),
+        ("fromStrings", "([Ljava/lang/String;)J"),
+        ("free", "(J)V"),
+    ],
+    "Hash": [
+        ("murmurHash32", "(I[J)J"),
+        ("xxHash64", "(J[J)J"),
+        ("hiveHash", "([J)J"),
+    ],
+    "RowConversion": [
+        ("convertToRows", "([J)J"),
+        ("convertFromRows", "(J[Ljava/lang/String;[I)[J"),
+    ],
+    "CastStrings": [
+        ("toInteger", "(JZZLjava/lang/String;)J"),
+        ("toFloat", "(JZLjava/lang/String;)J"),
+        ("fromFloat", "(J)J"),
+    ],
+    "JSONUtils": [
+        ("getJsonObject", "(JLjava/lang/String;)J"),
+    ],
+    "RmmSpark": [
+        ("setEventHandler", "(J)V"),
+        ("clearEventHandler", "()V"),
+        ("startDedicatedTaskThread", "(JJ)V"),
+        ("taskDone", "(J)V"),
+        ("forceRetryOOM", "(JI)V"),
+        ("getStateOf", "(J)Ljava/lang/String;"),
+    ],
+    "TestSupport": [
+        ("assertTrue", "(ILjava/lang/String;)V"),
+        ("checkLongColumn", "(J[J)I"),
+        ("checkIntColumn", "(J[I)I"),
+        ("checkStringColumn", "(J[Ljava/lang/String;)I"),
+        ("checkColumnsEqual", "(JJ)I"),
+    ],
+}
+
+# Spark-derived murmur3 goldens (tests/test_hash.py:27 vectors, the
+# ASCII/non-null subset usable through JNI String[] marshalling)
+MURMUR_IN = ["a", "B\nc",
+             ("A very long (greater than 128 bytes/char string) to test "
+              "a multi hash-step data point in the MD5 hash function. "
+              "This string needed to be longer.A 60 character string to "
+              "test MD5's message padding algorithm")]
+MURMUR_GOLD = [1485273170, 1709559900, 176121990]
+
+
+def _computed_goldens():
+    """xxhash64 goldens from the (golden-validated) Python engine."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    from spark_rapids_tpu.columns import dtypes
+    from spark_rapids_tpu.columns.column import Column
+    from spark_rapids_tpu.ops import xxhash64
+    c = Column.from_pylist([1, 2, 3], dtypes.INT64)
+    return xxhash64([c], 42).to_pylist()
+
+
+def build_natives(outdir: str):
+    for cls, methods in NATIVE_CLASSES.items():
+        cf = ClassFile(f"{PKG}/{cls}")
+        for name, desc in methods:
+            cf.add_native(name, desc)
+        path = os.path.join(outdir, PKG, cls + ".class")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(cf.serialize())
+
+
+def build_smoke_test(outdir: str, xx_gold):
+    """JniSmokeTest.main: straight-line bytecode (assertions throw from
+    native TestSupport.assertTrue, so no branches / StackMapTable)."""
+    cf = ClassFile(f"{PKG}/JniSmokeTest")
+    c = Code(cf.cp, max_locals=26)
+    J = f"{PKG}/"
+
+    def assert_check(msg):
+        c.ldc_string(msg)
+        c.invokestatic(J + "TestSupport", "assertTrue",
+                       "(ILjava/lang/String;)V")
+
+    # System.load(args[0])  — absolute path to the shim .so
+    c.aload(0)
+    c.iconst(0)
+    c.aaload()
+    c.invokestatic("java/lang/System", "load", "(Ljava/lang/String;)V")
+    c.invokestatic(J + "TpuRuntime", "initialize", "()V")
+    c.println("runtime initialized")
+
+    # --- murmur3 against Spark-derived goldens -----------------------
+    H_STR = 2        # locals: 2=strings col, 4=murmur col
+    c.string_array(MURMUR_IN)
+    c.invokestatic(J + "TpuColumns", "fromStrings",
+                   "([Ljava/lang/String;)J")
+    c.lstore(H_STR)
+    c.iconst(42)
+    c.long_array_locals([H_STR])
+    c.invokestatic(J + "Hash", "murmurHash32", "(I[J)J")
+    c.lstore(4)
+    c.lload(4)
+    c.int_array(MURMUR_GOLD)
+    c.invokestatic(J + "TestSupport", "checkIntColumn", "(J[I)I")
+    assert_check("murmur3_32 Spark golden")
+    c.println("murmur3_32 golden ok")
+
+    # --- xxhash64 ----------------------------------------------------
+    H_LONGS = 6      # 6=int64 col, 8=xxhash col
+    c.long_array_consts([1, 2, 3])
+    c.invokestatic(J + "TpuColumns", "fromLongs", "([J)J")
+    c.lstore(H_LONGS)
+    c.lconst(42)
+    c.long_array_locals([H_LONGS])
+    c.invokestatic(J + "Hash", "xxHash64", "(J[J)J")
+    c.lstore(8)
+    c.lload(8)
+    c.long_array_consts(xx_gold)
+    c.invokestatic(J + "TestSupport", "checkLongColumn", "(J[J)I")
+    assert_check("xxhash64 engine golden")
+    c.println("xxhash64 golden ok")
+
+    # --- row conversion round trip ----------------------------------
+    ROWS, BACK_ARR, BACK0 = 10, 12, 13
+    c.long_array_locals([H_LONGS])
+    c.invokestatic(J + "RowConversion", "convertToRows", "([J)J")
+    c.lstore(ROWS)
+    c.lload(ROWS)
+    c.string_array(["int64"])
+    c.int_array([0])
+    c.invokestatic(J + "RowConversion", "convertFromRows",
+                   "(J[Ljava/lang/String;[I)[J")
+    c.astore(BACK_ARR)
+    c.aload(BACK_ARR)
+    c.iconst(0)
+    c.laload()
+    c.lstore(BACK0)
+    c.lload(H_LONGS)
+    c.lload(BACK0)
+    c.invokestatic(J + "TestSupport", "checkColumnsEqual", "(JJ)I")
+    assert_check("JCUDF row conversion round trip")
+    c.println("row conversion round trip ok")
+
+    # --- cast string -> int32 ---------------------------------------
+    H_NUM, H_CAST = 15, 17
+    c.string_array(["123", "-45", "999"])
+    c.invokestatic(J + "TpuColumns", "fromStrings",
+                   "([Ljava/lang/String;)J")
+    c.lstore(H_NUM)
+    c.lload(H_NUM)
+    c.iconst(0)          # ansi=false
+    c.iconst(1)          # strip=true
+    c.ldc_string("int32")
+    c.invokestatic(J + "CastStrings", "toInteger",
+                   "(JZZLjava/lang/String;)J")
+    c.lstore(H_CAST)
+    c.lload(H_CAST)
+    c.int_array([123, -45, 999])
+    c.invokestatic(J + "TestSupport", "checkIntColumn", "(J[I)I")
+    assert_check("CastStrings.toInteger")
+    c.println("cast string->int ok")
+
+    # --- get_json_object --------------------------------------------
+    H_JSON, H_JOUT = 19, 21
+    c.string_array(['{"a": 1}', '{"a": 2}'])
+    c.invokestatic(J + "TpuColumns", "fromStrings",
+                   "([Ljava/lang/String;)J")
+    c.lstore(H_JSON)
+    c.lload(H_JSON)
+    c.ldc_string("$.a")
+    c.invokestatic(J + "JSONUtils", "getJsonObject",
+                   "(JLjava/lang/String;)J")
+    c.lstore(H_JOUT)
+    c.lload(H_JOUT)
+    c.string_array(["1", "2"])
+    c.invokestatic(J + "TestSupport", "checkStringColumn",
+                   "(J[Ljava/lang/String;)I")
+    assert_check("JSONUtils.getJsonObject")
+    c.println("get_json_object ok")
+
+    # --- RmmSpark facade over the OOM state machine ------------------
+    c.lconst(1 << 20)
+    c.invokestatic(J + "RmmSpark", "setEventHandler", "(J)V")
+    c.lconst(99)
+    c.lconst(1)
+    c.invokestatic(J + "RmmSpark", "startDedicatedTaskThread", "(JJ)V")
+    c.lconst(1)
+    c.invokestatic(J + "RmmSpark", "taskDone", "(J)V")
+    c.invokestatic(J + "RmmSpark", "clearEventHandler", "()V")
+    c.println("RmmSpark register/taskDone ok")
+
+    # --- handle hygiene ----------------------------------------------
+    for h in [H_STR, 4, H_LONGS, 8, ROWS, BACK0, H_NUM, H_CAST,
+              H_JSON, H_JOUT]:
+        c.lload(h)
+        c.invokestatic(J + "TpuColumns", "free", "(J)V")
+    c.invokestatic(J + "TpuRuntime", "shutdown", "()V")
+
+    c.println("JNI smoke: ALL OK")
+    c.return_void()
+    cf.add_code_method("main", "([Ljava/lang/String;)V", c)
+
+    path = os.path.join(outdir, PKG, "JniSmokeTest.class")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(cf.serialize())
+
+
+def main():
+    outdir = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "java", "classes")
+    build_natives(outdir)
+    build_smoke_test(outdir, _computed_goldens())
+    print(f"emitted classes under {outdir}")
+
+
+if __name__ == "__main__":
+    main()

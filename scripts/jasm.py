@@ -1,0 +1,348 @@
+"""Minimal JVM class-file emitter (a tiny "jasm").
+
+This image ships a JRE (bazel's embedded Zulu 21) but NO Java compiler
+(no javac, no jdk.compiler module, no ECJ jar anywhere on disk), so the
+JNI smoke test's classes are emitted directly as class files from the
+declarative specs in scripts/gen_java_classes.py.  The canonical,
+human-readable API definition lives in java/src/ as real .java sources
+(compiled in any normal JDK environment); this emitter exists so a REAL
+JVM can execute the binding end-to-end in this image.
+
+Scope is deliberately tiny: static methods (native, or straight-line
+bytecode), String/int/long constants, array literals.  Straight-line
+code has no branch targets, so no StackMapTable is required even at
+class-file major 52 — assertions are delegated to a native method that
+throws on failure.
+
+Class-file layout per JVMS §4 (the format is a public, stable spec).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Tuple
+
+# constant-pool tags
+_UTF8, _INT, _LONG, _CLASS, _STRING, _FIELD, _METHOD, _NAT = \
+    1, 3, 5, 7, 8, 9, 10, 12
+
+ACC_PUBLIC, ACC_STATIC, ACC_FINAL, ACC_SUPER, ACC_NATIVE = \
+    0x0001, 0x0008, 0x0010, 0x0020, 0x0100
+
+T_INT, T_LONG = 10, 11
+
+
+class ConstPool:
+    def __init__(self):
+        self.entries: List[Tuple] = []   # (tag, payload...)
+        self._index: Dict[Tuple, int] = {}
+        self._next = 1                   # 1-based; Long takes 2 slots
+
+    def _add(self, key: Tuple) -> int:
+        if key in self._index:
+            return self._index[key]
+        self.entries.append(key)
+        idx = self._next
+        self._index[key] = idx
+        self._next += 2 if key[0] == _LONG else 1
+        return idx
+
+    def utf8(self, s: str) -> int:
+        return self._add((_UTF8, s))
+
+    def int_(self, v: int) -> int:
+        return self._add((_INT, v))
+
+    def long_(self, v: int) -> int:
+        return self._add((_LONG, v))
+
+    def cls(self, name: str) -> int:
+        return self._add((_CLASS, self.utf8(name)))
+
+    def string(self, s: str) -> int:
+        return self._add((_STRING, self.utf8(s)))
+
+    def nat(self, name: str, desc: str) -> int:
+        return self._add((_NAT, self.utf8(name), self.utf8(desc)))
+
+    def methodref(self, cls: str, name: str, desc: str) -> int:
+        return self._add((_METHOD, self.cls(cls), self.nat(name, desc)))
+
+    def fieldref(self, cls: str, name: str, desc: str) -> int:
+        return self._add((_FIELD, self.cls(cls), self.nat(name, desc)))
+
+    def serialize(self) -> bytes:
+        out = [struct.pack(">H", self._next)]
+        for e in self.entries:
+            tag = e[0]
+            if tag == _UTF8:
+                b = e[1].encode("utf-8")
+                out.append(struct.pack(">BH", tag, len(b)) + b)
+            elif tag == _INT:
+                out.append(struct.pack(">Bi", tag, e[1]))
+            elif tag == _LONG:
+                out.append(struct.pack(">Bq", tag, e[1]))
+            elif tag in (_CLASS, _STRING):
+                out.append(struct.pack(">BH", tag, e[1]))
+            elif tag in (_FIELD, _METHOD, _NAT):
+                out.append(struct.pack(">BHH", tag, e[1], e[2]))
+            else:
+                raise ValueError(f"bad tag {tag}")
+        return b"".join(out)
+
+
+class Code:
+    """Straight-line bytecode builder (no branches by design)."""
+
+    def __init__(self, cp: ConstPool, max_locals: int):
+        self.cp = cp
+        self.b = bytearray()
+        self.max_locals = max_locals
+        self.max_stack = 0
+        self._stack = 0
+
+    def _push(self, n=1):
+        self._stack += n
+        self.max_stack = max(self.max_stack, self._stack)
+
+    def _pop(self, n=1):
+        self._stack -= n
+
+    # ---- constants -------------------------------------------------
+    def iconst(self, v: int):
+        self._push()
+        if -1 <= v <= 5:
+            self.b.append(0x03 + v)        # iconst_<v> (0x02 is -1)
+        elif -128 <= v <= 127:
+            self.b += bytes([0x10, v & 0xFF])          # bipush
+        elif -32768 <= v <= 32767:
+            self.b += struct.pack(">Bh", 0x11, v)      # sipush
+        else:
+            idx = self.cp.int_(v)
+            self._ldc_idx(idx)
+
+    def _ldc_idx(self, idx: int):
+        if idx <= 255:
+            self.b += bytes([0x12, idx])               # ldc
+        else:
+            self.b += struct.pack(">BH", 0x13, idx)    # ldc_w
+
+    def lconst(self, v: int):
+        self._push(2)
+        if v in (0, 1):
+            self.b.append(0x09 + v)                    # lconst_<v>
+        else:
+            self.b += struct.pack(">BH", 0x14, self.cp.long_(v))  # ldc2_w
+
+    def ldc_string(self, s: str):
+        self._push()
+        self._ldc_idx(self.cp.string(s))
+
+    # ---- locals ----------------------------------------------------
+    def _var(self, base_short: int, base_gen: int, idx: int):
+        if idx <= 3:
+            self.b.append(base_short + idx)
+        else:
+            self.b += bytes([base_gen, idx])
+
+    def aload(self, idx: int):
+        self._push()
+        self._var(0x2A, 0x19, idx)
+
+    def iload(self, idx: int):
+        self._push()
+        self._var(0x1A, 0x15, idx)
+
+    def lload(self, idx: int):
+        self._push(2)
+        self._var(0x1E, 0x16, idx)
+
+    def astore(self, idx: int):
+        self._pop()
+        self._var(0x4B, 0x3A, idx)
+
+    def istore(self, idx: int):
+        self._pop()
+        self._var(0x3B, 0x36, idx)
+
+    def lstore(self, idx: int):
+        self._pop(2)
+        self._var(0x3F, 0x37, idx)
+
+    # ---- arrays ----------------------------------------------------
+    def newarray(self, atype: int):
+        self.b += bytes([0xBC, atype])                 # count -> arrayref
+
+    def anewarray(self, cls: str):
+        self.b += struct.pack(">BH", 0xBD, self.cp.cls(cls))
+
+    def dup(self):
+        self._push()
+        self.b.append(0x59)
+
+    def iastore(self):
+        self._pop(3)
+        self.b.append(0x4F)
+
+    def lastore(self):
+        self._pop(4)
+        self.b.append(0x50)
+
+    def aastore(self):
+        self._pop(3)
+        self.b.append(0x53)
+
+    def aaload(self):
+        self._pop(2)
+        self._push()
+        self.b.append(0x32)
+
+    def laload(self):
+        self._pop(2)
+        self._push(2)
+        self.b.append(0x2F)
+
+    def int_array(self, values):
+        """Push an int[] literal."""
+        self.iconst(len(values))
+        self.newarray(T_INT)
+        for i, v in enumerate(values):
+            self.dup()
+            self.iconst(i)
+            self.iconst(v)
+            self.iastore()
+
+    def long_array_consts(self, values):
+        """Push a long[] literal of constants."""
+        self.iconst(len(values))
+        self.newarray(T_LONG)
+        for i, v in enumerate(values):
+            self.dup()
+            self.iconst(i)
+            self.lconst(v)
+            self.lastore()
+
+    def long_array_locals(self, local_idxs):
+        """Push a long[] gathered from long locals (e.g. handles)."""
+        self.iconst(len(local_idxs))
+        self.newarray(T_LONG)
+        for i, li in enumerate(local_idxs):
+            self.dup()
+            self.iconst(i)
+            self.lload(li)
+            self.lastore()
+
+    def string_array(self, values):
+        self.iconst(len(values))
+        self.anewarray("java/lang/String")
+        for i, v in enumerate(values):
+            self.dup()
+            self.iconst(i)
+            self.ldc_string(v)
+            self.aastore()
+
+    # ---- calls / fields --------------------------------------------
+    @staticmethod
+    def _desc_slots(desc: str):
+        """(arg_slots, ret_slots) of a method descriptor."""
+        args = desc[1:desc.index(")")]
+        ret = desc[desc.index(")") + 1:]
+        n, i = 0, 0
+        while i < len(args):
+            c = args[i]
+            if c == "[":                   # array ref: one slot; skip
+                while args[i] == "[":      # the element descriptor
+                    i += 1
+                i = (args.index(";", i) + 1 if args[i] == "L"
+                     else i + 1)
+                n += 1
+            elif c in "JD":
+                n += 2
+                i += 1
+            elif c == "L":
+                n += 1
+                i = args.index(";", i) + 1
+            else:
+                n += 1
+                i += 1
+        r = 0 if ret == "V" else (2 if ret in "JD" else 1)
+        return n, r
+
+    def invokestatic(self, cls: str, name: str, desc: str):
+        a, r = self._desc_slots(desc)
+        self._pop(a)
+        self._push(r) if r else None
+        self.b += struct.pack(">BH", 0xB8,
+                              self.cp.methodref(cls, name, desc))
+
+    def invokevirtual(self, cls: str, name: str, desc: str):
+        a, r = self._desc_slots(desc)
+        self._pop(a + 1)
+        self._push(r) if r else None
+        self.b += struct.pack(">BH", 0xB6,
+                              self.cp.methodref(cls, name, desc))
+
+    def getstatic(self, cls: str, name: str, desc: str):
+        self._push(2 if desc in "JD" else 1)
+        self.b += struct.pack(">BH", 0xB2,
+                              self.cp.fieldref(cls, name, desc))
+
+    def println(self, s: str):
+        self.getstatic("java/lang/System", "out", "Ljava/io/PrintStream;")
+        self.ldc_string(s)
+        self.invokevirtual("java/io/PrintStream", "println",
+                           "(Ljava/lang/String;)V")
+
+    def pop_op(self):
+        self._pop()
+        self.b.append(0x57)
+
+    def pop2_op(self):
+        self._pop(2)
+        self.b.append(0x58)
+
+    def return_void(self):
+        self.b.append(0xB1)
+
+
+class ClassFile:
+    def __init__(self, name: str, super_name="java/lang/Object",
+                 major=52):
+        self.cp = ConstPool()
+        self.name = name
+        self.super_name = super_name
+        self.major = major
+        self.methods: List[Tuple[int, int, int, bytes]] = []
+
+    def add_native(self, name: str, desc: str,
+                   flags=ACC_PUBLIC | ACC_STATIC | ACC_NATIVE):
+        self.methods.append((flags, self.cp.utf8(name),
+                             self.cp.utf8(desc), b""))
+
+    def add_code_method(self, name: str, desc: str, code: Code,
+                        flags=ACC_PUBLIC | ACC_STATIC):
+        attr_name = self.cp.utf8("Code")
+        body = (struct.pack(">HHI", code.max_stack + 2, code.max_locals,
+                            len(code.b)) + bytes(code.b) +
+                struct.pack(">HH", 0, 0))
+        attr = struct.pack(">HI", attr_name, len(body)) + body
+        self.methods.append((flags, self.cp.utf8(name),
+                             self.cp.utf8(desc), attr))
+
+    def serialize(self) -> bytes:
+        this_c = self.cp.cls(self.name)
+        super_c = self.cp.cls(self.super_name)
+        # methods reference the pool, so serialize the pool LAST
+        mbytes = []
+        for flags, nidx, didx, attr in self.methods:
+            n_attr = 1 if attr else 0
+            mbytes.append(struct.pack(">HHHH", flags, nidx, didx,
+                                      n_attr) + attr)
+        head = struct.pack(">IHH", 0xCAFEBABE, 0, self.major)
+        pool = self.cp.serialize()
+        mid = struct.pack(">HHHH", ACC_PUBLIC | ACC_SUPER | ACC_FINAL,
+                          this_c, super_c, 0)
+        fields = struct.pack(">H", 0)
+        methods = struct.pack(">H", len(self.methods)) + b"".join(mbytes)
+        attrs = struct.pack(">H", 0)
+        return head + pool + mid + fields + methods + attrs

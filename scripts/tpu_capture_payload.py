@@ -1,0 +1,236 @@
+"""Deterministic device-engine capture payload for TPU evidence.
+
+Runs a fixed battery of device-engine checks and prints ONE JSON line:
+  {"platform": ..., "devices": [...], "checks": {name: {...}}, ...}
+
+Each check reports a sha256 digest of its canonical output bytes plus,
+where a pure-Python oracle is cheap, an absolute pass/fail.  The harness
+(scripts/tpu_evidence.py) runs this payload twice — once pinned to the
+CPU backend, once on the default (TPU relay) backend — and compares
+digests: a match is a true device-vs-host differential for every engine.
+
+Env knobs:
+  TPU_PAYLOAD_BENCH=1   also run bench_impl.run() (headline GB/s)
+  TPU_PAYLOAD_PALLAS=1  also run the Pallas row-assembly kernel
+                        (interpret=False on TPU, skipped on CPU) and
+                        compare it against the XLA assembly path
+
+The reference's equivalent evidence is its GPU-locked CI pods running
+the JUnit suite (ci/Jenkinsfile.premerge:206-232); here the chip is a
+single-client tunneled relay, so evidence is captured opportunistically.
+"""
+
+import hashlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _digest(b: bytes) -> str:
+    return hashlib.sha256(b).hexdigest()[:16]
+
+
+def main():
+    import os
+
+    import jax
+    # sitecustomize pre-imports jax with the axon backend, so env vars
+    # alone cannot pin the platform — go through jax.config (same as
+    # bench.py / conftest.py / jni_entry).
+    platform_pin = os.environ.get("SPARK_RAPIDS_TPU_PLATFORM", "")
+    if platform_pin:
+        jax.config.update("jax_platforms", platform_pin)
+    jax.config.update("jax_enable_x64", True)
+    import numpy as np
+
+    from spark_rapids_tpu.columns import dtypes
+    from spark_rapids_tpu.columns.column import Column
+
+    platform = jax.default_backend()
+    out = {
+        "platform": platform,
+        "devices": [str(d) for d in jax.devices()],
+        "checks": {},
+    }
+
+    def check(name, fn):
+        t0 = time.perf_counter()
+        try:
+            digest, ok_abs = fn()
+            out["checks"][name] = {
+                "digest": digest, "ok_abs": ok_abs,
+                "seconds": round(time.perf_counter() - t0, 3)}
+        except Exception as e:  # capture must never die on one engine
+            out["checks"][name] = {
+                "error": f"{type(e).__name__}: {e}",
+                "seconds": round(time.perf_counter() - t0, 3)}
+
+    strings = ["1.5", "-0.25", "3.4028235e38", "1e-320", "  7 ", "nan",
+               "Infinity", "bad", "0.1", "12345.6789"]
+    floats = [1.5, -0.25, 0.1, 1e-45, 3.14159265358979, 1e300, -0.0,
+              6.02214076e23]
+
+    def stod():
+        from spark_rapids_tpu.ops.stod_device import string_to_float_device
+        col = Column.from_strings(strings)
+        r = string_to_float_device(col, dtypes.FLOAT64)
+        vals = r.to_pylist()
+        oracle = []
+        for s in strings:
+            try:
+                oracle.append(float(s.strip()))
+            except ValueError:
+                oracle.append(None)
+        ok = all((a is None and b is None)
+                 or (a is not None and b is not None
+                     and (np.isnan(a) == np.isnan(b))
+                     and (np.isnan(a) or a == b))
+                 for a, b in zip(vals, oracle))
+        return _digest(repr(vals).encode()), ok
+
+    def ftos():
+        from spark_rapids_tpu.ops.ftos_device import float_to_string_device
+        col = Column.from_pylist(floats, dtypes.FLOAT64)
+        r = float_to_string_device(col)
+        return _digest("\x00".join(r.to_pylist()).encode()), None
+
+    def sha256():
+        from spark_rapids_tpu.ops.sha_device import sha256_device
+        vals = ["", "abc", "spark-rapids-tpu", "x" * 200]
+        col = Column.from_strings(vals)
+        r = sha256_device(col)
+        got = r.to_pylist()
+        exp = [hashlib.sha256(v.encode()).hexdigest() for v in vals]
+        return _digest(repr(got).encode()), got == exp
+
+    def hashes():
+        from spark_rapids_tpu.ops import murmur3_32, xxhash64
+        rng = np.random.default_rng(3)
+        a = Column.from_numpy(rng.integers(-2**31, 2**31, 4096,
+                                           dtype=np.int64))
+        b = Column.from_strings(
+            ["row%d" % i for i in range(4096)])
+        m = murmur3_32([a, b], 42).to_numpy()
+        x = xxhash64([a, b]).to_numpy()
+        return _digest(m.tobytes() + x.tobytes()), None
+
+    def json_dev():
+        from spark_rapids_tpu.ops.json_device import get_json_object_device
+        docs = ['{"a": {"b": %d}, "c": [1,2,%d]}' % (i, i)
+                for i in range(512)]
+        col = Column.from_strings(docs)
+        r = get_json_object_device(col, "$.a.b")
+        got = r.to_pylist()
+        ok = got == [str(i) for i in range(512)]
+        return _digest(repr(got).encode()), ok
+
+    def rowconv():
+        from spark_rapids_tpu.ops import row_conversion as RC
+        from spark_rapids_tpu.columns.table import Table
+        rng = np.random.default_rng(5)
+        cols = [
+            Column.from_numpy(rng.integers(-1000, 1000, 2048,
+                                           dtype=np.int64)),
+            Column.from_numpy(rng.normal(size=2048).astype(np.float32)),
+            Column.from_numpy(rng.integers(0, 2, 2048).astype(np.uint8),
+                              dtype=dtypes.BOOL8),
+        ]
+        t = Table(cols)
+        rows_col = RC.convert_to_rows(t)
+        blob = np.asarray(rows_col.children[0].data)
+        back = RC.convert_from_rows(rows_col, [c.dtype for c in cols])
+        ok = all(np.array_equal(np.asarray(a.to_numpy()),
+                                np.asarray(b.to_numpy()))
+                 for a, b in zip(t.columns, back.columns))
+        return _digest(blob.tobytes()), ok
+
+    def kudo_device():
+        from spark_rapids_tpu.columns.table import Table
+        from spark_rapids_tpu.shuffle.device_split import (
+            device_shuffle_assemble, device_shuffle_split)
+        from spark_rapids_tpu.shuffle.schema import schema_of_table
+        rng = np.random.default_rng(9)
+        t = Table([
+            Column.from_numpy(rng.integers(0, 100, 999, dtype=np.int32)),
+            Column.from_strings(["s%d" % (i % 37) for i in range(999)]),
+        ])
+        blob, offs = device_shuffle_split(t, [100, 500, 998])
+        back = device_shuffle_assemble(schema_of_table(t),
+                                       blob, offs)
+        ok = all(a.to_pylist() == b.to_pylist()
+                 for a, b in zip(t.columns, back.columns))
+        return _digest(np.asarray(blob).tobytes()), ok
+
+    check("stod_eisel_lemire", stod)
+    check("ftos_ryu", ftos)
+    check("sha256_lane_per_row", sha256)
+    check("murmur3_xxhash64", hashes)
+    check("json_pushdown_scan", json_dev)
+    check("row_conversion_roundtrip", rowconv)
+    check("kudo_device_split_assemble", kudo_device)
+
+    if os.environ.get("TPU_PAYLOAD_PALLAS") == "1":
+        def pallas():
+            from spark_rapids_tpu.columns.table import Table
+            from spark_rapids_tpu.ops import row_conversion as RC
+            from spark_rapids_tpu.ops.row_assembly_pallas import (
+                assemble_fixed_words_pallas)
+            rng = np.random.default_rng(11)
+            rows = 1 << 17
+            cols = []
+            cycle = [dtypes.INT64, dtypes.INT32, dtypes.FLOAT32,
+                     dtypes.INT16, dtypes.INT8]
+            for i in range(64):
+                dt = cycle[i % len(cycle)]
+                if dt.kind == "float32":
+                    arr = rng.normal(size=rows).astype(np.float32)
+                else:
+                    info = np.iinfo(dt.np_dtype)
+                    arr = rng.integers(info.min // 2, info.max // 2,
+                                       rows).astype(dt.np_dtype)
+                cols.append(Column.from_numpy(arr, dtype=dt))
+            t = Table(cols)
+            starts, voff, fixed = RC.compute_layout(
+                [c.dtype for c in cols])
+            row_size = (fixed + 7) // 8 * 8
+            interp = platform != "tpu"
+            words = assemble_fixed_words_pallas(
+                t.columns, starts, voff, row_size, interpret=interp)
+            words.block_until_ready()
+            ref = np.asarray(RC._assemble_fixed_words(
+                t.columns, starts, voff, row_size))
+            got = np.asarray(words)
+            ok = np.array_equal(got, ref)
+            if platform == "tpu" and ok:
+                import jax.numpy as jnp
+                t0 = time.perf_counter()
+                for _ in range(10):
+                    words = assemble_fixed_words_pallas(
+                        t.columns, starts, voff, row_size,
+                        interpret=False)
+                words.block_until_ready()
+                dt_s = (time.perf_counter() - t0) / 10
+                out["pallas_gbps"] = round(
+                    rows * row_size / dt_s / 1e9, 2)
+            return _digest(got.tobytes()), bool(ok)
+        check("pallas_row_assembly", pallas)
+
+    if os.environ.get("TPU_PAYLOAD_BENCH") == "1":
+        try:
+            t0 = time.perf_counter()
+            from bench_impl import run
+            out["bench"] = run()
+            out["bench_seconds"] = round(time.perf_counter() - t0, 1)
+        except Exception as e:
+            out["bench"] = {"error": f"{type(e).__name__}: {e}"}
+
+    print(json.dumps(out))
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

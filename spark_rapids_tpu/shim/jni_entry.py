@@ -1,0 +1,204 @@
+"""Embedded-interpreter entry points for the JNI shim
+(native/jni/spark_rapids_tpu_jni.cpp).
+
+Every function here takes/returns only primitives, strings, and flat
+lists of them — the shapes a hand-written JNI layer can marshal without
+any Python C-API object gymnastics.  This is the process-boundary twin
+of shim/jni_api.py: jni_api mirrors the reference's *Jni.cpp export
+signatures (unwrap jlong handles -> op -> wrap), and this module adapts
+those to the embedded-CPython calling convention used by the real JVM
+binding (reference: src/main/cpp/src/hash/HashJni.cpp:31-46 unwraps
+jlongs the same way before calling the native op).
+
+The JVM side lives in java/src/com/nvidia/spark/rapids/jni/ (same
+package as the reference so spark-rapids GpuExec-facing code keeps its
+imports); runnable class files for this JRE-only image are emitted by
+scripts/gen_java_classes.py.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+_INITIALIZED = False
+
+
+def initialize() -> None:
+    """One-time runtime init inside the embedded interpreter."""
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+    import os
+
+    import jax
+    # Env vars are too late on this image (sitecustomize pre-imports jax
+    # with the axon TPU plugin — see Makefile dryrun note), so platform
+    # pinning must go through jax.config.
+    platform = os.environ.get("SPARK_RAPIDS_TPU_PLATFORM", "")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    jax.config.update("jax_enable_x64", True)
+    _INITIALIZED = True
+
+
+def shutdown() -> None:
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    REGISTRY.clear()
+
+
+def live_handles() -> int:
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.live_count()
+
+
+# ------------------------------------------------------------- columns
+
+
+def from_longs(values: Sequence[int]) -> int:
+    from spark_rapids_tpu.columns import dtypes
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.make_column_from_host(list(values), dtypes.INT64)
+
+
+def from_ints(values: Sequence[int]) -> int:
+    from spark_rapids_tpu.columns import dtypes
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.make_column_from_host(list(values), dtypes.INT32)
+
+
+def from_doubles(values: Sequence[float]) -> int:
+    from spark_rapids_tpu.columns import dtypes
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.make_column_from_host(list(values), dtypes.FLOAT64)
+
+
+def from_strings(values: Sequence[Optional[str]]) -> int:
+    from spark_rapids_tpu.columns import dtypes
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.make_column_from_host(list(values), dtypes.STRING)
+
+
+def free(handle: int) -> None:
+    from spark_rapids_tpu.shim import jni_api
+    jni_api.release_column(handle)
+
+
+# ----------------------------------------------------------------- ops
+
+
+def murmur_hash3_32(seed: int, handles: Sequence[int]) -> int:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.murmur_hash3_32(seed, handles)
+
+
+def xx_hash_64(seed: int, handles: Sequence[int]) -> int:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.xx_hash_64(seed, handles)
+
+
+def hive_hash(handles: Sequence[int]) -> int:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.hive_hash(handles)
+
+
+def convert_to_rows(handles: Sequence[int]) -> int:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.convert_to_rows(handles)
+
+
+def convert_from_rows(rows_handle: int, type_ids: Sequence[str],
+                      scales: Sequence[int]) -> List[int]:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.convert_from_rows(rows_handle, type_ids, scales)
+
+
+def string_to_integer(handle: int, type_id: str, ansi: bool,
+                      strip: bool) -> int:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.string_to_integer(handle, type_id, ansi, strip)
+
+
+def string_to_float(handle: int, type_id: str, ansi: bool) -> int:
+    from spark_rapids_tpu.columns.dtypes import DType
+    from spark_rapids_tpu.ops.cast_string import string_to_float as stf
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(
+        stf(REGISTRY.get(handle), DType(type_id), ansi))
+
+
+def float_to_string(handle: int) -> int:
+    from spark_rapids_tpu.ops.cast_string import float_to_string as fts
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(fts(REGISTRY.get(handle)))
+
+
+def get_json_object(handle: int, path: str) -> int:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.get_json_object(handle, path)
+
+
+# ---------------------------------------------------------- RmmSpark
+
+
+def rmm_set_event_handler(limit_bytes: int) -> None:
+    from spark_rapids_tpu.memory import rmm_spark
+    rmm_spark.set_event_handler(limit_bytes)
+
+
+def rmm_clear_event_handler() -> None:
+    from spark_rapids_tpu.memory import rmm_spark
+    rmm_spark.clear_event_handler()
+
+
+def rmm_start_dedicated_task_thread(thread_id: int, task_id: int) -> None:
+    from spark_rapids_tpu.memory import rmm_spark
+    rmm_spark.start_dedicated_task_thread(thread_id, task_id)
+
+
+def rmm_task_done(task_id: int) -> None:
+    from spark_rapids_tpu.memory import rmm_spark
+    rmm_spark.task_done(task_id)
+
+
+def rmm_force_retry_oom(thread_id: int, num_ooms: int) -> None:
+    from spark_rapids_tpu.memory import rmm_spark
+    rmm_spark.force_retry_oom(thread_id, num_ooms)
+
+
+def rmm_get_state_of(thread_id: int) -> str:
+    from spark_rapids_tpu.memory import rmm_spark
+    return rmm_spark.get_state_of(thread_id)
+
+
+# ------------------------------------------------------- test support
+# (comparison happens Python-side so the emitted JVM test bytecode can
+# stay straight-line: a native assert throws on failure)
+
+
+def check_int_column(handle: int, expected: Sequence[int]) -> int:
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    got = REGISTRY.get(handle).to_pylist()
+    return 1 if got == list(expected) else 0
+
+
+def check_long_column(handle: int, expected: Sequence[int]) -> int:
+    return check_int_column(handle, expected)
+
+
+def check_string_column(handle: int, expected: Sequence[str]) -> int:
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    got = REGISTRY.get(handle).to_pylist()
+    return 1 if got == list(expected) else 0
+
+
+def check_columns_equal(h1: int, h2: int) -> int:
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    a = REGISTRY.get(h1).to_pylist()
+    b = REGISTRY.get(h2).to_pylist()
+    return 1 if a == b else 0
+
+
+def describe_column(handle: int) -> str:
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    col = REGISTRY.get(handle)
+    return f"{col.dtype.kind}[{col.length}]"
