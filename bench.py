@@ -22,6 +22,9 @@ Env knobs:
   BENCH_PROBE_TIMEOUT  per-probe subprocess timeout (default 600 — a >390s
                        wedge has been observed; 150s was too short)
   BENCH_PROBE_PAUSE    sleep between failed probes (default 20)
+
+Note: each probe waits at least ~10s even when the remaining window is
+smaller (the quick-smoke BENCH_FIGHT_SECONDS=1 run still takes ~10s).
 """
 
 import json
@@ -32,14 +35,23 @@ import time
 
 _PROBE = "import jax; jax.devices(); print('ok')"
 
+# stderr signatures meaning the machine has NO TPU plugin at all (a
+# permanent condition worth short-circuiting on) — as opposed to a
+# transiently-refusing relay, which the fight window exists to ride out
+_NO_PLUGIN_SIGNATURES = (b"ModuleNotFoundError", b"no TPU backend",
+                         b"Unable to initialize backend")
+
 
 def _probe_once(timeout_s: float) -> str:
-    """Run one backend probe in a subprocess. Returns 'ok'|'timeout'|'error'."""
+    """One backend probe in a subprocess.
+    Returns 'ok'|'timeout'|'no_plugin'|'error'."""
     try:
         r = subprocess.run([sys.executable, "-c", _PROBE],
                            timeout=timeout_s, capture_output=True)
         if r.returncode == 0 and b"ok" in r.stdout:
             return "ok"
+        if any(s in r.stderr for s in _NO_PLUGIN_SIGNATURES):
+            return "no_plugin"
         return "error"
     except subprocess.TimeoutExpired:
         return "timeout"
@@ -73,12 +85,17 @@ def _fight_for_backend():
         if outcome == "ok":
             return "tpu", attempts
         # A wedged relay shows up as 'timeout'; a machine with no TPU
-        # plugin at all fails FAST and deterministically ('error' in a few
-        # seconds) — don't burn the whole window re-asking that machine.
-        fast_errors = fast_errors + 1 if (outcome == "error"
+        # plugin at all fails FAST with a recognizable import/backend
+        # error — only THAT is worth abandoning the window for.  Plain
+        # fast 'error' (e.g. connection-refused during a relay restart)
+        # keeps retrying, with a growing pause so a fast-failing loop
+        # doesn't spin.
+        fast_errors = fast_errors + 1 if (outcome == "no_plugin"
                                           and dur < 30) else 0
         if fast_errors >= 3:
             break
+        if outcome == "error" and dur < 30:
+            pause = min(pause * 2, 120)
         if deadline - time.monotonic() <= pause + 5:
             break
         time.sleep(pause)

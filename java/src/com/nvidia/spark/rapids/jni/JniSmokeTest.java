@@ -64,6 +64,9 @@ public final class JniSmokeTest {
         "JSONUtils.getJsonObject");
     System.out.println("get_json_object ok");
 
+    long uuids = StringUtils.randomUUIDs(4, 1);
+    System.out.println("randomUUIDs ok");
+
     RmmSpark.setEventHandler(1 << 20);
     RmmSpark.startDedicatedTaskThread(99, 1);
     RmmSpark.taskDone(1);
@@ -71,7 +74,7 @@ public final class JniSmokeTest {
     System.out.println("RmmSpark register/taskDone ok");
 
     for (long h : new long[] {strs, murmur, longs, xx, rows, back[0],
-                              nums, ints, json, jout}) {
+                              nums, ints, json, jout, uuids}) {
       TpuColumns.free(h);
     }
     TpuRuntime.shutdown();

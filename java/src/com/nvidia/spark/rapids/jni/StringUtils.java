@@ -1,0 +1,13 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Assorted string helpers (reference StringUtils.java over
+ * StringUtilsJni.cpp — randomUUIDs; TPU engine:
+ * spark_rapids_tpu/ops/string_utils.py facade).
+ */
+public final class StringUtils {
+  private StringUtils() {}
+
+  /** Column of version-4 UUID strings (reference randomUUIDs). */
+  public static native long randomUUIDs(int rows, long seed);
+}

@@ -444,6 +444,16 @@ jlong JNI_FN(JSONUtils, getJsonObject)(JNIEnv* env, jclass, jlong col,
   return as_jlong(env, call_entry(env, "get_json_object", args));
 }
 
+// -------------------------------------------------------- StringUtils
+
+jlong JNI_FN(StringUtils, randomUUIDs)(JNIEnv* env, jclass, jint rows,
+                                       jlong seed) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(iL)", (int)rows, (long long)seed);
+  return as_jlong(env, call_entry(env, "random_uuids", args));
+}
+
 // ----------------------------------------------------------- RmmSpark
 
 void JNI_FN(RmmSpark, setEventHandler)(JNIEnv* env, jclass,

@@ -68,6 +68,9 @@ NATIVE_CLASSES = {
         ("forceRetryOOM", "(JI)V"),
         ("getStateOf", "(J)Ljava/lang/String;"),
     ],
+    "StringUtils": [
+        ("randomUUIDs", "(IJ)J"),
+    ],
     "TestSupport": [
         ("assertTrue", "(ILjava/lang/String;)V"),
         ("checkLongColumn", "(J[J)I"),
@@ -219,6 +222,14 @@ def build_smoke_test(outdir: str, xx_gold):
     assert_check("JSONUtils.getJsonObject")
     c.println("get_json_object ok")
 
+    # --- StringUtils.randomUUIDs ------------------------------------
+    H_UUID = 23
+    c.iconst(4)
+    c.lconst(1)
+    c.invokestatic(J + "StringUtils", "randomUUIDs", "(IJ)J")
+    c.lstore(H_UUID)
+    c.println("randomUUIDs ok")
+
     # --- RmmSpark facade over the OOM state machine ------------------
     c.lconst(1 << 20)
     c.invokestatic(J + "RmmSpark", "setEventHandler", "(J)V")
@@ -232,7 +243,7 @@ def build_smoke_test(outdir: str, xx_gold):
 
     # --- handle hygiene ----------------------------------------------
     for h in [H_STR, 4, H_LONGS, 8, ROWS, BACK0, H_NUM, H_CAST,
-              H_JSON, H_JOUT]:
+              H_JSON, H_JOUT, H_UUID]:
         c.lload(h)
         c.invokestatic(J + "TpuColumns", "free", "(J)V")
     c.invokestatic(J + "TpuRuntime", "shutdown", "()V")

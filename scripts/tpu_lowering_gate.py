@@ -14,8 +14,10 @@ Run:  python scripts/tpu_lowering_gate.py     (exit 1 on any failure)
 Wired into `make ci`.
 
 Reference analog: the premerge GPU build proving every .cu still
-compiles (ci/Jenkinsfile.premerge:196-232) — here compilation *is* the
-lowering.
+compiles (ci/Jenkinsfile.premerge:196-232).  Caveat: this gate runs
+JAX's TPU *lowering rules* to StableHLO; the XLA:TPU backend compile
+(tiling/layout legality) still needs the real chip, so a green gate
+proves lowering, not end-to-end compilation or numerics.
 """
 
 import os
@@ -73,6 +75,9 @@ def _specs():
     dst = jnp.asarray([0, 64], jnp.int64)
     src = jnp.asarray([0, 128], jnp.int64)
 
+    from spark_rapids_tpu.ops import protobuf_device, parse_uri_device
+    pb_specs = ((1, 0), (2, 2), (3, 1), (4, 5))  # varint/len/f64/f32
+
     return [
         ("ftos_d2d", ftos_device._d2d, (bits64,)),
         ("ftos_f2d", ftos_device._f2d, (bits32,)),
@@ -101,12 +106,18 @@ def _specs():
          (limbs, limbs)),
         ("row_conversion_to_rows",
          lambda t: rc.convert_to_rows(t), (fixed_table,)),
+        ("protobuf_decode",
+         lambda ch, ln: protobuf_device._decode_chunk(ch, ln, pb_specs),
+         (chars, lens)),
+        ("parse_uri_analyze", parse_uri_device._analyze,
+         (chars, lens)),
     ]
 
 
 def main():
     failures = []
-    for name, fn, args in _specs():
+    specs = _specs()
+    for name, fn, args in specs:
         try:
             exp = export.export(jax.jit(fn), platforms=["tpu"])(*args)
             nbytes = len(exp.mlir_module())
@@ -119,7 +130,7 @@ def main():
         print(f"tpu_lowering_gate: {len(failures)} engine(s) no longer "
               "lower for TPU", file=sys.stderr)
         return 1
-    print(f"tpu_lowering_gate: all {len(_specs())} engines lower for TPU")
+    print(f"tpu_lowering_gate: all {len(specs)} engines lower for TPU")
     return 0
 
 

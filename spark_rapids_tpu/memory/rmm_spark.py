@@ -27,6 +27,11 @@ def set_event_handler(limit_bytes: int,
             raise RuntimeError("event handler already installed")
         _adaptor = SparkResourceAdaptor(LimitingMemoryResource(limit_bytes),
                                         log_path=log_path)
+        # native-side adaptor -> managed-side thread registry callback
+        # (reference SparkResourceAdaptorJni.cpp:66-80 removeThread)
+        from spark_rapids_tpu.memory.thread_state_registry import \
+            REGISTRY as _TSR
+        _adaptor.on_thread_removed = _TSR.remove_thread
         return _adaptor
 
 
@@ -51,7 +56,10 @@ def current_thread_id() -> int:
 # thin delegating wrappers (RmmSpark.java public surface)
 
 def start_dedicated_task_thread(thread_id: int, task_id: int):
-    get_adaptor().start_dedicated_task_thread(thread_id, task_id)
+    from spark_rapids_tpu.memory.thread_state_registry import REGISTRY
+    adaptor = get_adaptor()      # validate BEFORE registering: a
+    REGISTRY.add_thread(thread_id)  # failed start must not leave a
+    adaptor.start_dedicated_task_thread(thread_id, task_id)  # stale id
 
 
 def current_thread_is_dedicated_to_task(task_id: int):

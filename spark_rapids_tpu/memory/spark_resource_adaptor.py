@@ -165,6 +165,11 @@ class SparkResourceAdaptor:
         # accumulate log strings forever
         self._log_rows = collections.deque(maxlen=100_000)
         self._log_file = open(log_path, "w") if log_path else None
+        # ThreadStateRegistry callback: the reference's native adaptor
+        # calls ThreadStateRegistry.removeThread when an association
+        # ends (SparkResourceAdaptorJni.cpp:66-80); set this to the
+        # registry's remove_thread to mirror that shape
+        self.on_thread_removed = None
         self._log("time,op,current thread,op thread,op task,from state,"
                   "to state,notes", raw=True)
 
@@ -264,6 +269,11 @@ class SparkResourceAdaptor:
                     ret = True
                 self._log_transition(t, UNKNOWN)
                 del self._threads[thread_id]
+                if self.on_thread_removed is not None:
+                    try:
+                        self.on_thread_removed(thread_id)
+                    except Exception:
+                        pass  # registry bugs must not corrupt the SM
         return ret
 
     def task_done(self, task_id: int):
@@ -492,6 +502,11 @@ class SparkResourceAdaptor:
             elif state == THREAD_REMOVE_THROW:
                 self._log_transition(t, UNKNOWN)
                 del self._threads[thread_id]
+                if self.on_thread_removed is not None:
+                    try:  # registry callback fires on BOTH removal
+                        self.on_thread_removed(thread_id)  # paths
+                    except Exception:
+                        pass
                 raise exc.ThreadRemovedException(
                     "thread removed while blocked")
             else:

@@ -76,3 +76,34 @@ def make_distributed_join(mesh: Mesh, exch_cap: int, pair_cap: int):
         return mapped(lk, lv, rk, rv)
 
     return step
+
+
+def make_distributed_join_auto(mesh: Mesh, exch_cap: int = 256,
+                               pair_cap: int = 512, *,
+                               max_doublings: int = 6):
+    """Budget-learning variant: the centralized overflow retry
+    (parallel/exchange.with_capacity_retry) re-runs with doubled
+    exchange/pair capacities until nothing is dropped — callers never
+    hand-check send_counts.
+
+    Returns run(lk, lv, rk, rv) -> ((keys, lvals, rvals, valid, totals,
+    overflow), (exch_cap_used, pair_cap_used))."""
+    from spark_rapids_tpu.parallel.exchange import with_capacity_retry
+
+    def make_step(cap):
+        # pair capacity scales with the exchange budget so one knob
+        # drives the doubling loop
+        scale = cap / exch_cap
+        return make_distributed_join(mesh, cap,
+                                     max(1, int(pair_cap * scale)))
+
+    inner = with_capacity_retry(make_step, exch_cap,
+                                max_doublings=max_doublings,
+                                overflow_index=5)
+
+    def run(lk, lv, rk, rv):
+        out, cap_used = inner(lk, lv, rk, rv)
+        scale = cap_used / exch_cap
+        return out, (cap_used, max(1, int(pair_cap * scale)))
+
+    return run

@@ -1,0 +1,183 @@
+"""Device from_json (flat schemas): typed extraction over the JSON
+pushdown scan.
+
+Reference: src/main/cpp/src/from_json_to_structs.cu:1-959 (typed
+extraction kernels behind JSONUtils.fromJSONToStructs).  The TPU design
+reuses the SAME compiled scan as get_json_object (json_device.py — one
+lax.scan over the padded char axis) once per schema field with path
+$.<name>, then diverges from get_json_object only in rendering rules:
+
+  * number tokens are copied VERBATIM (from_json does no Java double
+    normalization — from_json_to_raw_map.cu copies raw substrings), so
+    fractional/negative numbers stay on device;
+  * a matched literal `null` nulls the field (get_json_object renders
+    the text "null");
+  * leaf typing goes through convert_from_strings, whose int/float
+    paths are the existing device cast engines (stod_device /
+    cast_string DFA).
+
+Per-row host fallback (json_device discipline): rows the scan flags
+(deep nesting, invalid UTF-8 …), rows with duplicate keys (from_json is
+last-wins; the scan captures one match), string values with escapes,
+and nested values whose verbatim span may not equal the re-rendered
+text (whitespace / single quotes / control chars).  The host parser
+(json_utils._parse_rows) stays the oracle; each fallback row is parsed
+ONCE and shared across all schema fields.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.columns.dtypes import DType
+
+_WS = (0x20, 0x09, 0x0A, 0x0D)
+
+
+def _root_is_object(chars: np.ndarray, lens: np.ndarray) -> np.ndarray:
+    """First non-whitespace char is '{' (from_json nulls non-object
+    rows regardless of field matches)."""
+    R, L = chars.shape
+    idx = np.arange(L)[None, :]
+    ws = np.zeros((R, L), bool)
+    for w in _WS:
+        ws |= chars == w
+    nonws = ~ws & (idx < lens[:, None])
+    first = np.argmax(nonws, axis=1)
+    any_nonws = nonws.any(axis=1)
+    return any_nonws & (chars[np.arange(R), first] == ord("{"))
+
+
+def _field_strings(col: Column, name: str, padded, host_trees,
+                   chars_np: np.ndarray):
+    """One schema field -> (raw string column (pre-typing), scan-valid
+    mask): device spans with per-row host fallback."""
+    from spark_rapids_tpu.ops import json_device as JD
+    from spark_rapids_tpu.ops import json_path as JP
+    from spark_rapids_tpu.ops.json_utils import _value_as_raw_string
+
+    rows = col.length
+    (valid, mcount, mstart, mend, mkind, mfloat, mneg, f_ws, f_sq,
+     f_escun, f_ctrl, f_anyesc, f_float, f_negz, fb) = \
+        JD._scan_column(col, [JP.Named(name)], padded=padded)
+
+    in_valid = (np.ones(rows, bool) if col.validity is None
+                else np.asarray(col.validity).astype(bool)[:rows])
+
+    is_str = mkind == JD._K_STR
+    is_lit = mkind == JD._K_LIT
+    is_nested = (mkind == JD._K_OBJ) | (mkind == JD._K_ARR)
+    # from_json renders numbers verbatim: f_float / f_negz are safe
+    nested_unsafe = f_ws | f_sq | f_escun | f_ctrl
+    fast_ok = np.where(is_str, ~f_anyesc,
+                       np.where(is_nested, ~nested_unsafe, True))
+    need_host = in_valid & (fb | (valid & (
+        (mcount > 1) | ((mcount == 1) & ~fast_ok))))
+    dev_copy = in_valid & ~need_host & valid & (mcount == 1)
+
+    offs = np.asarray(col.offsets)
+    span_start = offs[:-1] + np.where(is_str, mstart + 1, mstart)
+    span_len = np.where(is_str, mend - mstart - 2, mend - mstart)
+    span_len = np.where(dev_copy, np.maximum(span_len, 0), 0)
+
+    # matched literal `null` -> field null (first span char is 'n')
+    all_chars = np.asarray(col.data)
+    lit_first = all_chars[np.clip(span_start, 0,
+                                  max(len(all_chars) - 1, 0))] \
+        if len(all_chars) else np.zeros(rows, np.uint8)
+    is_null_lit = dev_copy & is_lit & (lit_first == ord("n"))
+    dev_copy = dev_copy & ~is_null_lit
+    span_len = np.where(dev_copy, span_len, 0)
+
+    # host fallback rows: parse once, share the tree across fields
+    fb_idx = np.nonzero(need_host)[0]
+    fb_vals = {}
+    for i in fb_idx:
+        if i not in host_trees:
+            doc = bytes(all_chars[offs[i]:offs[i + 1]]).decode(
+                "utf-8", errors="replace")
+            try:
+                host_trees[i] = JP._Parser(doc).parse()
+            except JP._Invalid:
+                host_trees[i] = None
+        tree = host_trees[i]
+        if tree is None or tree[0] != "obj":
+            fb_vals[i] = None
+            continue
+        got = dict(tree[1]).get(name)
+        fb_vals[i] = (None if got is None or got == ("lit", "null")
+                      else _value_as_raw_string(got))
+
+    # assemble device spans + patch fallback rows
+    validity_out = dev_copy
+    out_len = span_len.astype(np.int64)
+    new_offs = np.concatenate([[0], np.cumsum(out_len)]) \
+        .astype(np.int32)
+    total = int(new_offs[-1])
+    if total:
+        i_flat = np.arange(total)
+        r = np.searchsorted(new_offs, i_flat, side="right") - 1
+        cpos = span_start[r] + (i_flat - new_offs[r])
+        data = all_chars[np.minimum(cpos, len(all_chars) - 1)]
+    else:
+        data = np.zeros(0, np.uint8)
+    out = Column(dtypes.STRING, rows, data=jnp.asarray(data),
+                 validity=None if validity_out.all() else
+                 jnp.asarray(validity_out.astype(np.uint8)),
+                 offsets=jnp.asarray(new_offs))
+    if fb_vals:
+        vals = out.to_pylist()
+        for i, v in fb_vals.items():
+            vals[i] = v
+        out = Column.from_strings(vals)
+    return out, valid
+
+
+def from_json_to_structs_device(
+        col: Column, fields: Sequence[Tuple[str, DType]],
+        allow_leading_zeros: bool = False) -> Optional[Column]:
+    """Flat-schema device from_json; None when the host path must run
+    (nested schemas, leading-zero tolerance, empty input)."""
+    if allow_leading_zeros or col.length == 0 or not fields:
+        return None
+    if not all(isinstance(spec, DType) for _n, spec in fields):
+        return None   # nested schema: host builder
+
+    from spark_rapids_tpu.ops import json_device as JD
+    from spark_rapids_tpu.ops.json_utils import convert_from_strings
+
+    padded = JD._padded_with_terminator(col)
+    chars_np = np.asarray(padded[0])
+    lens_np = np.asarray(padded[1])
+    rows = col.length
+
+    host_trees = {}
+    raw_cols = []
+    row_valid = None
+    for name, spec in fields:
+        raw, valid = _field_strings(col, name, padded, host_trees,
+                                    chars_np)
+        row_valid = valid if row_valid is None else row_valid
+        raw_cols.append(convert_from_strings(raw, spec))
+
+    # struct-level validity: tolerant-JSON valid AND root is an object;
+    # rows the scan couldn't judge (fb) take the host parse's verdict
+    root_obj = _root_is_object(chars_np, lens_np)
+    in_valid = (np.ones(rows, bool) if col.validity is None
+                else np.asarray(col.validity).astype(bool)[:rows])
+    struct_valid = in_valid & row_valid & root_obj
+
+    # fallback rows that parsed as valid objects must flip validity on
+    for i, tree in host_trees.items():
+        struct_valid[i] = in_valid[i] and tree is not None \
+            and tree[0] == "obj"
+
+    return Column.make_struct(
+        rows, raw_cols,
+        validity=None if struct_valid.all() else
+        struct_valid.astype(np.uint8))

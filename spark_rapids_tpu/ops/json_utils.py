@@ -78,7 +78,23 @@ def from_json_to_structs(col: Column,
     """JSON object rows -> STRUCT column with the requested fields
     (JSONUtils.fromJSONToStructs:188; schema as parallel vectors in the
     reference json_utils.hpp:10-23).  Missing/mistyped fields are null;
-    invalid rows null the whole struct."""
+    invalid rows null the whole struct.
+
+    Flat scalar schemas route to the device engine
+    (ops/from_json_device.py — the json_device scan with from_json
+    rendering rules); nested schemas and small columns run the host
+    builder below, which stays the differential oracle."""
+    import os
+
+    from spark_rapids_tpu.ops import from_json_device as FJ
+    min_rows = int(os.environ.get(
+        "SPARK_RAPIDS_TPU_FROM_JSON_DEVICE_MIN", "256"))
+    force = os.environ.get(
+        "SPARK_RAPIDS_TPU_FORCE_DEVICE_FROM_JSON") == "1"
+    if force or col.length >= min_rows:
+        out = FJ.from_json_to_structs_device(col, list(fields))
+        if out is not None:
+            return out
     # a flat schema is just a one-level nested schema: delegate so the
     # null/leniency rules live in exactly one place
     return from_json_to_structs_nested(col, ("struct", list(fields)))
@@ -90,10 +106,24 @@ def convert_from_strings(col: Column, dtype: DType) -> Column:
     if dtype.is_string:
         return col
     if dtype.kind == Kind.BOOL8:
-        vals = [None if v is None else
-                (True if v == "true" else False if v == "false" else None)
-                for v in col.to_pylist()]
-        return Column.from_pylist(vals, dtype)
+        # vectorized 'true'/'false' compare over the padded matrix
+        chars, lens = col.to_padded_chars(pad_to=max(
+            5, int(col.max_string_length()) or 1))
+        chars = np.asarray(chars)
+        lens = np.asarray(lens)
+        def _eq(word):
+            w = np.frombuffer(word.encode(), np.uint8)
+            return (lens == len(w)) & (
+                chars[:, :len(w)] == w[None, :]).all(axis=1)
+        is_t = _eq("true")
+        is_f = _eq("false")
+        valid = (is_t | is_f)
+        if col.validity is not None:
+            valid &= np.asarray(col.validity).astype(bool)
+        return Column.from_numpy(
+            is_t.astype(np.uint8),
+            validity=None if valid.all() else valid.astype(np.uint8),
+            dtype=dtype)
     if dtype.kind in (Kind.INT8, Kind.INT16, Kind.INT32, Kind.INT64):
         return cast_string.string_to_integer(col, dtype)
     if dtype.kind in (Kind.FLOAT32, Kind.FLOAT64):

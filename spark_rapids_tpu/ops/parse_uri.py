@@ -1,7 +1,12 @@
 """Spark parse_url (reference parse_uri.cu/.hpp, ParseURI.java): extract
 protocol/host/query/query-by-key/path with java.net.URI validation
 semantics — invalid URIs yield null (non-ANSI) or ExceptionWithRowIndex
-(ANSI), matching ParseURITest's java.net.URI oracle."""
+(ANSI), matching ParseURITest's java.net.URI oracle.
+
+Columns above a size threshold route to the vectorized device engine
+(ops/parse_uri_device.py, one jitted pass over the padded char matrix);
+the per-row _URI parser here is the semantic oracle and handles the
+device engine's fallback rows (non-ASCII, IPv6) plus small columns."""
 
 from __future__ import annotations
 
@@ -166,6 +171,26 @@ class _URI:
         _check_escapes(host, _USER_OK | {"[", "]"})
 
 
+def match_query_key(query, key):
+    """parse_url(..., 'QUERY', key) pair matching: value of the FIRST
+    '&'-delimited 'key=value' pair, else None.  THE single definition —
+    the host extractor, the device engine's fallback rows, and the
+    device materializer (parse_uri_device) all call this, so a
+    semantics change lands everywhere at once.  Accepts str or bytes
+    queries (key is always str)."""
+    if query is None or key is None:
+        return None
+    if isinstance(query, bytes):
+        sep, eq, k = b"&", b"=", key.encode()
+    else:
+        sep, eq, k = "&", "=", key
+    for pair in query.split(sep):
+        i = pair.find(eq)
+        if i >= 0 and pair[:i] == k:
+            return pair[i + 1:]
+    return None
+
+
 def _parse(s: Optional[str]) -> Optional[_URI]:
     if s is None:
         return None
@@ -176,8 +201,13 @@ def _parse(s: Optional[str]) -> Optional[_URI]:
 
 
 def _extract(col: Column, what: str, ansi_mode: bool,
-             keys: Optional[List[Optional[str]]] = None) -> Column:
+             keys: Optional[List[Optional[str]]] = None,
+             scalar_key: Optional[str] = None) -> Column:
     assert col.dtype.is_string
+    from spark_rapids_tpu.ops import parse_uri_device as PD
+    if PD.use_device(col) and (what != "query_key"
+                               or scalar_key is not None):
+        return PD.extract_device(col, what, ansi_mode, scalar_key)
     vals = col.to_pylist()
     out: List[Optional[str]] = []
     for i, s in enumerate(vals):
@@ -196,16 +226,7 @@ def _extract(col: Column, what: str, ansi_mode: bool,
         elif what == "path":
             out.append(uri.raw_path)
         elif what == "query_key":
-            q = uri.raw_query
-            sub = None
-            key = keys[i]
-            if q is not None and key is not None:
-                for pair in q.split("&"):
-                    eq = pair.find("=")
-                    if eq >= 0 and pair[:eq] == key:
-                        sub = pair[eq + 1:]
-                        break
-            out.append(sub)
+            out.append(match_query_key(uri.raw_query, keys[i]))
         else:
             raise ValueError(what)
     return Column.from_strings(out)
@@ -232,6 +253,6 @@ def parse_uri_to_query_with_key(col: Column,
                                 ansi_mode: bool = False) -> Column:
     if isinstance(key, Column):
         keys = key.to_pylist()
-    else:
-        keys = [key] * col.length
-    return _extract(col, "query_key", ansi_mode, keys)
+        return _extract(col, "query_key", ansi_mode, keys)
+    return _extract(col, "query_key", ansi_mode,
+                    [key] * col.length, scalar_key=key)

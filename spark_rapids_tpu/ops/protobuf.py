@@ -236,7 +236,18 @@ def decode_protobuf_to_struct(col: Column,
                               fields: Sequence[Field]) -> Column:
     """Binary (LIST<UINT8> or STRING) column of serialized messages ->
     STRUCT column (protobuf.hpp:64 decode_protobuf_to_struct).  Malformed
-    rows and rows missing required fields are null."""
+    rows and rows missing required fields are null.
+
+    Flat scalar schemas route to the vectorized device engine
+    (ops/protobuf_device.py, the masked-scan counterpart of the
+    reference's protobuf_kernels.cu); everything else — and small
+    columns — runs this host path, which doubles as the differential
+    oracle (tests/test_protobuf_device.py)."""
+    from spark_rapids_tpu.ops import protobuf_device as PD
+    if PD.use_device(col, fields):
+        out = PD.decode_protobuf_to_struct_device(col, fields)
+        if out is not None:
+            return out
     rows = col.length
     if col.dtype.kind == Kind.LIST or col.dtype.is_string:
         chars = (np.asarray(col.children[0].data) if

@@ -1,0 +1,380 @@
+"""Device protobuf decoder: vectorized wire-format parse on TPU.
+
+Reference: src/main/cpp/src/protobuf/protobuf_kernels.cu:1-1062 (+
+protobuf.cu 1,361, protobuf_builders.cu 623) — thread-per-row varint /
+wire-type parsing kernels feeding struct builders.  The TPU design
+replaces thread-per-row pointer chasing with ONE field-step loop over
+all rows simultaneously (the masked-scan shape this repo uses for stod /
+ftos / SHA / JSON / kudo):
+
+  * every row carries a cursor into its padded byte lane;
+  * each `lax.while_loop` step consumes exactly one tag+payload record
+    per active row: two bounded varint reads (10-byte gather windows,
+    lane-masked shifts — no data-dependent loops), a wire-type dispatch
+    for the next cursor, and unrolled per-schema-field capture selects
+    (proto3 last-value-wins);
+  * steps run until every row is done or malformed — the trip count is
+    the max field count per message, not the byte length.
+
+Scope of the device path (router below): FLAT schemas — scalar
+bool/int32/int64/float32/float64/string fields, DEFAULT/FIXED/ZIGZAG
+encodings, optional/required, non-string defaults.  Repeated fields,
+nested messages, and string defaults route to the host oracle
+(ops/protobuf.py), which stays the differential reference.
+
+Divergence note (shared with json_device): STRING payloads pass raw
+bytes through on device while the host oracle substitutes U+FFFD for
+invalid UTF-8 — Spark strings are UTF-8, so this is out of contract.
+
+Spark semantics parity with the host decoder:
+  * unknown fields / wire-type mismatches are skipped by wire type;
+  * truncated varints (no terminator in-row or within 10 bytes),
+    truncated payloads, and group/invalid wire types null the row;
+  * missing required fields null the row (proto2);
+  * missing optional fields take the schema default, else null.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.columns.dtypes import Kind
+
+_I32 = jnp.int32
+_U8 = jnp.uint8
+_U32 = jnp.uint32
+_U64 = jnp.uint64
+_B = jnp.bool_
+
+DEVICE_ROW_CHUNK = 1 << 17
+
+# wire types (protobuf encoding spec)
+_VARINT, _I64BIT, _LEN, _I32BIT = 0, 1, 2, 5
+
+
+def supported_schema(fields) -> bool:
+    """True when the flat-schema device engine can decode this schema."""
+    from spark_rapids_tpu.ops.protobuf import DEFAULT, FIXED, ZIGZAG
+    for f in fields:
+        if f.is_message or f.repeated:
+            return False
+        if f.dtype.kind not in (Kind.BOOL8, Kind.INT32, Kind.INT64,
+                                Kind.FLOAT32, Kind.FLOAT64,
+                                Kind.STRING):
+            return False
+        if f.encoding not in (DEFAULT, FIXED, ZIGZAG):
+            return False
+        if f.dtype.is_string and f.default is not None:
+            return False
+        if f.field_number <= 0 or f.field_number >= (1 << 29):
+            return False
+    return True
+
+
+def _expected_wire(f) -> int:
+    from spark_rapids_tpu.ops.protobuf import FIXED
+    kind = f.dtype.kind
+    if kind == Kind.STRING:
+        return _LEN
+    if f.encoding == FIXED:
+        return _I64BIT if kind in (Kind.INT64, Kind.FLOAT64) else _I32BIT
+    if kind == Kind.FLOAT64:
+        return _I64BIT
+    if kind == Kind.FLOAT32:
+        return _I32BIT
+    return _VARINT
+
+
+# lane shift table for varint assembly: lane i contributes bits
+# (b & 0x7f) << 7i, masked to 64 bits (lane 9 only bit 63 survives —
+# same wrap the host decoder applies)
+_V_SHIFTS = tuple(min(7 * i, 63) for i in range(10))
+_V_MASKS = tuple(0x7F if 7 * i <= 56 else (1 << (64 - 7 * i)) - 1
+                 for i in range(9)) + (0x01,)
+
+
+def _read_varint_at(chars: jnp.ndarray, pos: jnp.ndarray,
+                    row_len: jnp.ndarray):
+    """Vectorized varint read for every row at `pos` (row-relative).
+
+    Returns (value u64, nbytes i32, ok bool).  ok=False when the varint
+    has no terminator within 10 bytes or runs past the row end."""
+    L = chars.shape[1]
+    idx = pos[:, None] + jnp.arange(10, dtype=_I32)[None, :]
+    win = jnp.take_along_axis(
+        chars, jnp.clip(idx, 0, L - 1), axis=1)          # (R, 10)
+    win = jnp.where(idx < row_len[:, None], win, _U8(0))  # OOB: treat
+    is_term = (win & _U8(0x80)) == 0                      # as 0x00
+    has_term = jnp.any(is_term, axis=1)
+    nbytes = jnp.argmax(is_term, axis=1).astype(_I32) + 1
+    lane = jnp.arange(10, dtype=_I32)[None, :]
+    used = lane < nbytes[:, None]
+    contrib = jnp.zeros(chars.shape[0], _U64)
+    w64 = win.astype(_U64)
+    for i in range(10):
+        part = (w64[:, i] & _U64(_V_MASKS[i])) << _U64(_V_SHIFTS[i])
+        contrib = contrib | jnp.where(used[:, i], part, _U64(0))
+    ok = has_term & (pos + nbytes <= row_len) & (pos >= 0)
+    return contrib, nbytes, ok
+
+
+def _read_fixed(chars: jnp.ndarray, pos: jnp.ndarray,
+                row_len: jnp.ndarray, nbytes: int):
+    """Little-endian fixed32/64 load per row -> u64 (zero-extended)."""
+    L = chars.shape[1]
+    idx = pos[:, None] + jnp.arange(nbytes, dtype=_I32)[None, :]
+    win = jnp.take_along_axis(chars, jnp.clip(idx, 0, L - 1), axis=1)
+    win = jnp.where(idx < row_len[:, None], win, _U8(0))
+    val = jnp.zeros(chars.shape[0], _U64)
+    for i in range(nbytes):
+        val = val | (win[:, i].astype(_U64) << _U64(8 * i))
+    return val
+
+
+def _decode_chunk(chars: jnp.ndarray, lens: jnp.ndarray, specs):
+    """One jitted decode over a (R, L) padded byte chunk.
+
+    specs: static tuple of (field_number, expected_wire) per field.
+    Returns (malformed, [per-field (raw u64 value, seen)], and for LEN
+    fields the raw value packs (start << 32 | len))."""
+    R = chars.shape[0]
+    L = chars.shape[1]
+    F = len(specs)
+    max_steps = L // 2 + 2
+
+    def cond(state):
+        i, c, malformed, _vals, _seen = state
+        active = (~malformed) & (c < lens)
+        return (i < max_steps) & jnp.any(active)
+
+    def body(state):
+        i, c, malformed, vals, seen = state
+        active = (~malformed) & (c < lens)
+
+        tag, tlen, tag_ok = _read_varint_at(chars, c, lens)
+        wire = (tag & _U64(7)).astype(_I32)
+        num = (tag >> _U64(3)).astype(_I32)
+        s = c + tlen
+
+        pval, plen, p_ok = _read_varint_at(chars, s, lens)
+        # LEN payload length as i32 (cap: payload must fit in the row,
+        # so anything larger than L is malformed anyway)
+        plen_bytes = jnp.minimum(pval, _U64(1 << 30)).astype(_I32)
+
+        nxt = jnp.where(
+            wire == _VARINT, s + plen,
+            jnp.where(wire == _I64BIT, s + 8,
+                      jnp.where(wire == _I32BIT, s + 4,
+                                s + plen + plen_bytes)))
+        wire_ok = ((wire == _VARINT) | (wire == _I64BIT)
+                   | (wire == _I32BIT) | (wire == _LEN))
+        need_pv = (wire == _VARINT) | (wire == _LEN)
+        # NB: field number 0 is skipped like any unknown field (host
+        # by_num.get miss), not treated as malformed
+        step_ok = (tag_ok & wire_ok & (~need_pv | p_ok)
+                   & (nxt <= lens))
+
+        new_malformed = malformed | (active & ~step_ok)
+        capture = active & step_ok
+
+        f64 = _read_fixed(chars, s, lens, 8)
+        f32 = _read_fixed(chars, s, lens, 4)
+        str_pack = ((s + plen).astype(_U64) << _U64(32)) | \
+            jnp.minimum(pval, _U64(0xFFFFFFFF))
+
+        new_vals = list(vals)
+        new_seen = list(seen)
+        for k, (fnum, ewire) in enumerate(specs):
+            match = capture & (num == fnum) & (wire == ewire)
+            if ewire == _VARINT:
+                v = pval
+            elif ewire == _I64BIT:
+                v = f64
+            elif ewire == _I32BIT:
+                v = f32
+            else:                      # LEN: start/len pack
+                v = str_pack
+            new_vals[k] = jnp.where(match, v, vals[k])
+            new_seen[k] = seen[k] | match
+
+        c_new = jnp.where(active & step_ok,
+                          jnp.maximum(nxt, c + 1), c)
+        return (i + 1, c_new, new_malformed, tuple(new_vals),
+                tuple(new_seen))
+
+    state0 = (jnp.int32(0), jnp.zeros(R, _I32), jnp.zeros(R, _B),
+              tuple(jnp.zeros(R, _U64) for _ in range(F)),
+              tuple(jnp.zeros(R, _B) for _ in range(F)))
+    _i, c, malformed, vals, seen = lax.while_loop(cond, body, state0)
+    # a row that stopped before its end without being flagged is
+    # impossible (cursor advances or malforms), but guard anyway
+    malformed = malformed | (c < lens)
+    return malformed, vals, seen
+
+
+_ENGINE_CACHE = {}
+
+
+def _engine(specs):
+    if specs not in _ENGINE_CACHE:
+        _ENGINE_CACHE[specs] = jax.jit(
+            lambda ch, ln: _decode_chunk(ch, ln, specs))
+    return _ENGINE_CACHE[specs]
+
+
+def _finalize_numeric(f, raw: np.ndarray, seen: np.ndarray,
+                      rownull: np.ndarray) -> Column:
+    """Raw u64 capture -> typed column with defaults/validity."""
+    from spark_rapids_tpu.ops.protobuf import ZIGZAG
+    kind = f.dtype.kind
+    v = raw.astype(np.uint64)
+    if f.encoding == ZIGZAG:
+        v = (v >> np.uint64(1)) ^ (np.uint64(0) - (v & np.uint64(1)))
+    if kind == Kind.BOOL8:
+        out = (v != 0).astype(np.uint8)
+    elif kind == Kind.INT32:
+        out = (v & np.uint64(0xFFFFFFFF)).astype(np.uint32) \
+            .view(np.int32)
+    elif kind == Kind.INT64:
+        out = v.view(np.int64)
+    elif kind == Kind.FLOAT32:      # payload is a 4-byte LE float
+        out = (v & np.uint64(0xFFFFFFFF)).astype(np.uint32) \
+            .view(np.float32)
+    elif kind == Kind.FLOAT64:
+        out = v.view(np.float64)
+    else:
+        raise AssertionError(kind)
+
+    has_default = f.default is not None
+    if has_default:
+        fill = f.default
+        if kind == Kind.BOOL8:
+            fill = int(bool(fill))
+        out = np.where(seen, out, np.asarray(fill, out.dtype))
+    validity = (seen | has_default) & ~rownull
+    return Column.from_numpy(
+        out, validity=None if validity.all() else
+        validity.astype(np.uint8), dtype=f.dtype)
+
+
+def _finalize_string(chars: np.ndarray, lens: np.ndarray,
+                     raw: np.ndarray, seen: np.ndarray,
+                     rownull: np.ndarray) -> Column:
+    starts = (raw >> np.uint64(32)).astype(np.int64)
+    slens = (raw & np.uint64(0xFFFFFFFF)).astype(np.int64)
+    valid = seen & ~rownull
+    slens = np.where(valid, slens, 0)
+    offs = np.concatenate(
+        [[0], np.cumsum(slens)]).astype(np.int32)
+    total = int(offs[-1])
+    if total:
+        # flat gather: out[k] = chars[row(k), start(row)+k-offs(row)]
+        rows_idx = np.searchsorted(offs, np.arange(total),
+                                   side="right") - 1
+        cpos = starts[rows_idx] + (np.arange(total) - offs[rows_idx])
+        data = chars[rows_idx, np.minimum(cpos, chars.shape[1] - 1)]
+    else:
+        data = np.zeros(0, np.uint8)
+    validity = None if valid.all() else jnp.asarray(
+        valid.astype(np.uint8))
+    return Column(dtypes.STRING, len(slens), data=jnp.asarray(data),
+                  validity=validity, offsets=jnp.asarray(offs))
+
+
+def decode_protobuf_to_struct_device(col: Column,
+                                     fields) -> Optional[Column]:
+    """Flat-schema device decode; None when the schema needs the host
+    path (router: ops/protobuf.py decode_protobuf_to_struct)."""
+    if not supported_schema(fields):
+        return None
+    rows = col.length
+    if rows == 0:
+        return None
+    if col.dtype.kind == Kind.LIST:     # binary LIST<UINT8>: same
+        col = Column(dtypes.STRING, rows,  # layout as a string column
+                     data=col.children[0].data,
+                     validity=col.validity, offsets=col.offsets)
+    elif not col.dtype.is_string:
+        return None
+
+    specs = tuple((f.field_number, _expected_wire(f)) for f in fields)
+    engine = _engine(specs)
+
+    in_null = (np.zeros(rows, bool) if col.validity is None
+               else ~np.asarray(col.validity).astype(bool))
+
+    mal_parts: List[np.ndarray] = []
+    val_parts: List[List[np.ndarray]] = []
+    seen_parts: List[List[np.ndarray]] = []
+    char_parts: List[np.ndarray] = []
+    len_parts: List[np.ndarray] = []
+    for c0 in range(0, rows, DEVICE_ROW_CHUNK):
+        c1 = min(rows, c0 + DEVICE_ROW_CHUNK)
+        sub = Column(col.dtype, c1 - c0, data=col.data,
+                     validity=None,
+                     offsets=col.offsets[c0:c1 + 1],
+                     children=col.children)
+        chars, lens = sub.to_padded_chars()
+        malformed, vals, seen = engine(chars, lens)
+        mal_parts.append(np.asarray(malformed))
+        val_parts.append([np.asarray(v) for v in vals])
+        seen_parts.append([np.asarray(s) for s in seen])
+        char_parts.append(np.asarray(chars))
+        len_parts.append(np.asarray(lens))
+
+    malformed = np.concatenate(mal_parts)
+    fvals = [np.concatenate([p[k] for p in val_parts])
+             for k in range(len(fields))]
+    fseen = [np.concatenate([p[k] for p in seen_parts])
+             for k in range(len(fields))]
+
+    required_missing = np.zeros(rows, bool)
+    for k, f in enumerate(fields):
+        if f.required:
+            required_missing |= ~fseen[k]
+    rownull = in_null | malformed | required_missing
+
+    children = []
+    for k, f in enumerate(fields):
+        if f.dtype.is_string:
+            # per-chunk char matrices have differing widths; finalize
+            # chunk-wise and concatenate
+            parts = []
+            off = 0
+            for ci, ch in enumerate(char_parts):
+                n = ch.shape[0]
+                parts.append(_finalize_string(
+                    ch, len_parts[ci], val_parts[ci][k],
+                    seen_parts[ci][k], rownull[off:off + n]))
+                off += n
+            if len(parts) == 1:
+                children.append(parts[0])
+            else:
+                from spark_rapids_tpu.ops.copying import concat_tables
+                from spark_rapids_tpu.columns.table import Table
+                children.append(
+                    concat_tables([Table([p]) for p in parts])
+                    .columns[0])
+        else:
+            children.append(
+                _finalize_numeric(f, fvals[k], fseen[k], rownull))
+
+    validity = None if not rownull.any() else jnp.asarray(
+        (~rownull).astype(np.uint8))
+    return Column.make_struct(rows, children, validity=validity)
+
+
+def use_device(col: Column, fields) -> bool:
+    if os.environ.get("SPARK_RAPIDS_TPU_FORCE_DEVICE_PROTOBUF") == "1":
+        return supported_schema(fields)
+    min_rows = int(os.environ.get(
+        "SPARK_RAPIDS_TPU_PROTOBUF_DEVICE_MIN", "256"))
+    return col.length >= min_rows and supported_schema(fields)

@@ -61,26 +61,25 @@ def substring_index(col: Column, delimiter: Union[str, bytes],
         # left for count>0 but lastIndexOf from the right for count<0
         # (substring_index.cu rfind loop)
         if d > 1:
-            mh = np.asarray(m).copy()
-            for i in range(rows):
-                row = mh[i]
-                if count > 0:
-                    j = 0
-                    while j < row.shape[0]:
-                        if row[j]:
-                            row[j + 1: j + d] = False
-                            j += d
-                        else:
-                            j += 1
-                else:
-                    j = row.shape[0] - 1
-                    while j >= 0:
-                        if row[j]:
-                            row[max(j - d + 1, 0): j] = False
-                            j -= d
-                        else:
-                            j -= 1
-            m = jnp.asarray(mh)
+            # greedy non-overlap suppression, vectorized across rows:
+            # one sweep over positions with a per-row "suppressed
+            # until" cursor (directional per Spark indexOf/lastIndexOf)
+            mh = np.asarray(m)
+            P = mh.shape[1]
+            kept = np.zeros_like(mh)
+            if count > 0:
+                until = np.zeros(rows, np.int64)
+                for j in range(P):
+                    k = mh[:, j] & (j >= until)
+                    kept[:, j] = k
+                    until = np.where(k, j + d, until)
+            else:
+                until = np.full(rows, P, np.int64)
+                for j in range(P - 1, -1, -1):
+                    k = mh[:, j] & (j < until)
+                    kept[:, j] = k
+                    until = np.where(k, j - d + 1, until)
+            m = jnp.asarray(kept)
         cum = jnp.cumsum(m.astype(_I32), axis=1)
         total = cum[:, -1] if p >= d else jnp.zeros(rows, _I32)
         if count > 0:
@@ -107,17 +106,22 @@ def substring_index(col: Column, delimiter: Union[str, bytes],
             chars = jnp.where(in_r, jnp.take_along_axis(chars, idx, axis=1),
                               _U8(0))
 
-    # rebuild string column from per-row prefixes of `chars`
+    # rebuild string column from per-row prefixes of `chars` — numpy
+    # flat gather (the jnp 2D fancy gather lowers to a scalar loop on
+    # the CPU backend; this was the pathological path flagged in r1)
     keep_host = np.asarray(keep_len)
-    keep_host = np.where(mask_host, keep_host, 0)
-    new_offs = np.zeros(rows + 1, np.int32)
-    np.cumsum(keep_host, out=new_offs[1:])
+    keep_host = np.where(mask_host, np.maximum(keep_host, 0), 0)
+    new_offs = np.concatenate(
+        [[0], np.cumsum(keep_host)]).astype(np.int32)
     total_chars = int(new_offs[-1])
-    offs_j = jnp.asarray(new_offs)
-    i_flat = jnp.arange(total_chars, dtype=_I32)
-    r = jnp.searchsorted(offs_j, i_flat, side="right").astype(_I32) - 1
-    cpos = i_flat - offs_j[r]
-    data = chars[r, cpos] if total_chars else jnp.zeros(0, jnp.uint8)
-    validity = col.validity
-    return Column(dtypes.STRING, rows, data=data, validity=validity,
-                  offsets=offs_j)
+    if total_chars:
+        chars_np = np.asarray(chars)
+        i_flat = np.arange(total_chars)
+        r = np.searchsorted(new_offs, i_flat, side="right") - 1
+        cpos = i_flat - new_offs[r]
+        data = jnp.asarray(chars_np[r, cpos])
+    else:
+        data = jnp.zeros(0, jnp.uint8)
+    return Column(dtypes.STRING, rows, data=data,
+                  validity=col.validity,
+                  offsets=jnp.asarray(new_offs))

@@ -12,18 +12,24 @@ block per column plus true counts; receivers get (n_parts*capacity, ...)
 padded rows and a validity mask.  Capacity is the caller's budget — the
 same memory-budgeted-chunking philosophy as the reference's
 get_json_object batching (SURVEY.md §3.4).  Rows beyond capacity are
-dropped from the padded slots, but the returned send_counts carry the
-true per-destination sizes so callers MUST check
-`max(send_counts) <= capacity` (and re-run with a bigger budget or chunk
-the input when it fails) — overflow is detectable, never silent.
+dropped from the padded slots, but true per-destination sizes travel
+alongside the data, so overflow is detectable, never silent.
+
+Overflow handling is CENTRALIZED in `with_capacity_retry` below: wrap a
+capacity-parameterized program factory and the driver re-runs with a
+doubled budget whenever the program reports overflow — the same
+retry-with-larger-budget loop the reference's OOM machinery enforces on
+the JVM side (SparkResourceAdaptor split-and-retry).  Callers no longer
+hand-roll the check.
 """
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Callable, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 _I32 = jnp.int32
 
@@ -74,3 +80,46 @@ def exchange(arrays: Sequence[jnp.ndarray], part: jnp.ndarray,
     src_idx = jnp.arange(n_parts * capacity, dtype=_I32) // capacity
     valid = slot_idx < recv_counts[src_idx]
     return received, valid, jnp.sum(recv_counts).astype(_I32), send_counts
+
+
+class CapacityExceeded(RuntimeError):
+    """Raised when a budgeted SPMD program still overflows at the retry
+    ceiling (the analog of GpuSplitAndRetryOOM escaping the retries)."""
+
+    def __init__(self, capacity: int, doublings: int):
+        super().__init__(
+            f"exchange capacity {capacity} still overflowed after "
+            f"{doublings} doublings")
+        self.capacity = capacity
+
+
+def with_capacity_retry(make_step: Callable[[int], Callable],
+                        initial_capacity: int, *,
+                        max_doublings: int = 6,
+                        overflow_index: int = -1):
+    """Centralized overflow retry for fixed-capacity SPMD programs.
+
+    make_step(capacity) must return a callable whose output tuple
+    carries a boolean overflow indicator at `overflow_index` (any shape;
+    any True element means rows were dropped).  The wrapper runs the
+    program, checks the indicator on the host, and re-builds at double
+    the capacity until clean — compilation per capacity is cached by
+    jit, so steady-state workloads pay the retry only while the budget
+    is learning.
+
+    Returns run(*args) -> (outputs, capacity_used)."""
+    steps = {}
+
+    def run(*args):
+        cap = int(initial_capacity)
+        for attempt in range(max_doublings + 1):
+            if cap not in steps:
+                steps[cap] = make_step(cap)
+            out = steps[cap](*args)
+            if not bool(np.any(np.asarray(out[overflow_index]))):
+                return out, cap
+            if attempt < max_doublings:
+                cap *= 2
+        raise CapacityExceeded(cap, max_doublings)
+
+    return run

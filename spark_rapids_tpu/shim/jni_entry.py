@@ -137,6 +137,12 @@ def get_json_object(handle: int, path: str) -> int:
     return jni_api.get_json_object(handle, path)
 
 
+def random_uuids(rows: int, seed: int) -> int:
+    from spark_rapids_tpu.ops.string_utils import random_uuids as ru
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(ru(rows, seed))
+
+
 # ---------------------------------------------------------- RmmSpark
 
 

@@ -1,0 +1,76 @@
+"""Vectorized GBK decode vs the stdlib codec oracle — REPLACE/REPORT
+parity incl. malformed-byte taxonomy (reference charset_decode.cu
+REPLACE/REPORT error actions, CharsetDecodeTest model)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.ops import strings_misc as SM
+from spark_rapids_tpu.ops.exceptions import ExceptionWithRowIndex
+
+
+def _oracle(raw: bytes):
+    return raw.decode("gbk", errors="replace")
+
+
+def _differential(byte_rows):
+    col = Column.from_strings(byte_rows)
+    out = SM.decode_to_utf8(col, "GBK", SM.REPLACE).to_pylist()
+    for i, (b, got) in enumerate(zip(byte_rows, out)):
+        if b is None:
+            assert got is None
+            continue
+        assert got == _oracle(b), (
+            f"row {i} ({b!r}): got {got!r} want {_oracle(b)!r}")
+
+
+def test_curated():
+    _differential([
+        b"plain ascii",
+        b"",
+        None,
+        "中文字符串".encode("gbk"),
+        "mixed 中 text 文".encode("gbk"),
+        b"\x81\x30abc",            # bad trail: FFFD + re-process '0'
+        b"\x81",                   # truncated lead at end
+        b"abc\xfe",                # trailing lead
+        b"\x80abc",                # invalid single high byte
+        b"\xfe\xfeok",             # unmapped pair: two FFFD
+        b"\x81\x7fx",              # 0x7f not a valid trail
+        b"\x81\x40",               # first mapped pair
+        "元角分".encode("gbk"),
+    ])
+
+
+def test_report_raises_with_row_index():
+    col = Column.from_strings([b"ok", b"\x80bad", b"fine"])
+    with pytest.raises(ExceptionWithRowIndex) as ei:
+        SM.decode_to_utf8(col, "GBK", SM.REPORT)
+    assert ei.value.row_index == 1
+    # null rows with bad bytes are ignored
+    col2 = Column.from_strings(["好".encode("gbk"), None])
+    assert SM.decode_to_utf8(col2, "GBK", SM.REPORT).to_pylist() \
+        == ["好", None]
+
+
+def test_fuzz_differential():
+    rng = np.random.default_rng(5)
+    rows = []
+    for _ in range(500):
+        n = int(rng.integers(0, 24))
+        rows.append(bytes(rng.integers(0, 256, n, dtype=np.uint8)))
+    _differential(rows)
+
+
+def test_fuzz_valid_gbk_roundtrip():
+    rng = np.random.default_rng(9)
+    cjk = [chr(c) for c in range(0x4E00, 0x4E00 + 512)]
+    rows = []
+    for _ in range(200):
+        n = int(rng.integers(0, 12))
+        s = "".join(cjk[rng.integers(len(cjk))] for _ in range(n))
+        rows.append(s.encode("gbk"))
+    col = Column.from_strings(rows)
+    out = SM.decode_to_utf8(col, "GBK", SM.REPORT).to_pylist()
+    assert out == [b.decode("gbk") for b in rows]

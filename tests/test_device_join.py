@@ -103,3 +103,44 @@ def test_inner_join_device_no_int32_wrap():
     out = inner_join_device(k, k, 16)
     assert int(out.total) == 1 << 32
     assert int(out.valid.sum()) == 16
+
+
+def test_distributed_join_auto_retry(mesh8):
+    """The centralized capacity retry (with_capacity_retry) must grow a
+    deliberately-too-small budget until the join is complete and exact."""
+    from spark_rapids_tpu.models.distributed_join import \
+        make_distributed_join_auto
+
+    rng = np.random.default_rng(13)
+    NL = NR = 256
+    lk = rng.integers(0, 8, NL).astype(np.int64)    # heavy skew
+    rk = rng.integers(0, 8, NR).astype(np.int64)
+    lv = np.arange(NL, dtype=np.int64)
+    rv = np.arange(NR, dtype=np.int64) + 1000
+    run = make_distributed_join_auto(mesh8, exch_cap=2, pair_cap=4,
+                                    max_doublings=12)
+    (k, olv, orv, valid, _totals, ovf), (cap_used, _pc) = run(
+        jnp.asarray(lk), jnp.asarray(lv), jnp.asarray(rk),
+        jnp.asarray(rv))
+    assert cap_used > 2                      # budget actually grew
+    assert not bool(np.asarray(ovf).any())
+    v = np.asarray(valid).reshape(-1)
+    got = sorted(zip(np.asarray(k).reshape(-1)[v].tolist(),
+                     np.asarray(olv).reshape(-1)[v].tolist(),
+                     np.asarray(orv).reshape(-1)[v].tolist()))
+    want = sorted((int(a), int(b), int(c))
+                  for a, b in zip(lk, lv)
+                  for a2, c in zip(rk, rv) if a == a2)
+    assert got == want
+
+
+def test_capacity_retry_ceiling():
+    from spark_rapids_tpu.parallel.exchange import (CapacityExceeded,
+                                                    with_capacity_retry)
+
+    def make_step(cap):
+        return lambda: (np.array([True]),)   # always overflows
+
+    run = with_capacity_retry(make_step, 2, max_doublings=3)
+    with pytest.raises(CapacityExceeded):
+        run()

@@ -1,0 +1,101 @@
+"""Device from_json engine vs the host tree-builder oracle
+(json_utils.from_json_to_structs_nested) — differential over curated
+and fuzzed documents (reference FromJsonTest coverage model over
+from_json_to_structs.cu)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.ops import from_json_device as FJ
+from spark_rapids_tpu.ops import json_utils as JU
+
+FIELDS = [("a", dtypes.INT64), ("s", dtypes.STRING),
+          ("d", dtypes.FLOAT64), ("b", dtypes.BOOL8),
+          ("n", dtypes.INT32)]
+
+DOCS = [
+    '{"a": 1, "s": "x", "d": 2.5, "b": true, "n": -7}',
+    '{"a": 1}',                                # missing fields null
+    '{"s": "esc\\nape"}',                      # escape: host fallback
+    '{"a": null, "b": false}',                 # null literal
+    '{"a": "12", "d": "3.5"}',                 # quoted numbers cast
+    '{"a": 1.5}',                              # float to int64: null
+    '{"x": 9}',                                # all fields missing
+    '[1, 2]',                                  # root not object: null
+    '"just a string"',                         # root scalar: null
+    'not json',                                # invalid: null
+    '',                                        # empty: null
+    None,                                      # null row
+    '{"a": 1, "a": 2}',                        # dup key: last wins
+    '{"s": {"nested": 1}}',                    # object into string
+    '{"s": [1, 2, 3]}',                        # array into string
+    '{"d": -0.0}',                             # negative zero verbatim
+    '{"d": 1e300, "a": 9223372036854775807}',  # extremes
+    '{"n": 2147483648}',                       # int32 overflow: null
+    '{  "a"  :  42  }',                        # whitespace
+    "{'a': 5}",                                # single quotes(tolerant)
+    '{"b": "true"}',                           # quoted bool
+    '{"s": ""}',                               # empty string
+    '{"a": 007}',                              # leading zeros: invalid
+]
+
+
+def _differential(docs, fields):
+    col = Column.from_strings(docs)
+    host = JU.from_json_to_structs_nested(col, ("struct", list(fields)))
+    dev = FJ.from_json_to_structs_device(col, list(fields))
+    assert dev is not None
+    h, d = host.to_pylist(), dev.to_pylist()
+    for i, (hr, dr) in enumerate(zip(h, d)):
+        assert hr == dr, (f"row {i} ({docs[i]!r}):\n  host={hr!r}\n"
+                          f"  dev ={dr!r}")
+
+
+def test_curated_differential():
+    _differential(DOCS, FIELDS)
+
+
+def test_router_uses_device(monkeypatch):
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_FORCE_DEVICE_FROM_JSON", "1")
+    col = Column.from_strings(['{"a": 3}'] * 4)
+    out = JU.from_json_to_structs(col, [("a", dtypes.INT64)])
+    assert out.to_pylist() == [(3,)] * 4
+
+
+def test_nested_schema_routes_host():
+    col = Column.from_strings(['{"m": {"x": 1}}'])
+    out = FJ.from_json_to_structs_device(
+        col, [("m", ("struct", [("x", dtypes.INT64)]))])
+    assert out is None
+
+
+def test_fuzz_differential():
+    rng = np.random.default_rng(23)
+    keys = ["a", "s", "d", "b", "n", "zz"]
+    docs = []
+    for _ in range(300):
+        n = rng.integers(0, 5)
+        parts = []
+        for _k in range(n):
+            k = keys[rng.integers(len(keys))]
+            r = rng.random()
+            if r < 0.25:
+                v = str(rng.integers(-10**9, 10**9))
+            elif r < 0.45:
+                v = f"{rng.normal():.6g}"
+            elif r < 0.6:
+                v = '"w%d"' % rng.integers(100)
+            elif r < 0.7:
+                v = ["true", "false", "null"][rng.integers(3)]
+            elif r < 0.8:
+                v = '[1, 2]'
+            else:
+                v = '{"q": 1}'
+            parts.append('"%s": %s' % (k, v))
+        doc = "{" + ", ".join(parts) + "}"
+        if rng.random() < 0.1:
+            doc = doc[:-1]          # truncate: invalid
+        docs.append(doc)
+    _differential(docs, FIELDS)

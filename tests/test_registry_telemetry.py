@@ -1,0 +1,87 @@
+"""ThreadStateRegistry callback shape + telemetry depth + StringUtils
+facade (reference ThreadStateRegistry.java / NVMLMonitor.java /
+StringUtilsJni.cpp parity gaps from the r3 review)."""
+
+import threading
+import time
+
+import pytest
+
+from spark_rapids_tpu.memory import rmm_spark
+from spark_rapids_tpu.memory.thread_state_registry import REGISTRY
+from spark_rapids_tpu.utils import telemetry
+
+
+@pytest.fixture(autouse=True)
+def _clean_handler():
+    try:
+        rmm_spark.clear_event_handler()
+    except Exception:
+        pass
+    yield
+    try:
+        rmm_spark.clear_event_handler()
+    except Exception:
+        pass
+
+
+def test_registry_removeThread_callback():
+    rmm_spark.set_event_handler(1 << 20)
+    rmm_spark.start_dedicated_task_thread(4242, 7)
+    assert 4242 in REGISTRY.known_threads()
+    # ending the task triggers the adaptor's remove-association path,
+    # which must call back into the registry (removeThread shape)
+    rmm_spark.task_done(7)
+    assert 4242 not in REGISTRY.known_threads()
+
+
+def test_registry_blocked_ids_empty_when_running():
+    rmm_spark.set_event_handler(1 << 20)
+    rmm_spark.start_dedicated_task_thread(777, 1)
+    a = rmm_spark.get_adaptor()
+    assert REGISTRY.blocked_thread_ids(a) == []
+    rmm_spark.task_done(1)
+
+
+def test_telemetry_unsupported_surface():
+    with pytest.raises(telemetry.TelemetryNotSupported):
+        telemetry.get_power_usage_watts()
+    with pytest.raises(telemetry.TelemetryNotSupported):
+        telemetry.get_clock_mhz()
+
+
+def test_telemetry_host_counters():
+    cpu = telemetry.get_host_cpu_times()
+    assert cpu["user"] >= 0 and cpu["idle"] > 0
+    mem = telemetry.get_host_memory_info()
+    assert mem.get("MemTotal", 0) > 0
+
+
+def test_monitor_counts_errors_and_samples():
+    seen = []
+    errs = []
+
+    def listener(infos):
+        seen.append(len(infos))
+        if len(seen) == 2:
+            raise RuntimeError("listener bug")
+
+    m = telemetry.Monitor(20, listener, on_error=errs.append)
+    m.start()
+    time.sleep(0.3)
+    m.stop()
+    assert m.sample_count >= 2
+    assert m.error_count >= 1 and errs
+    assert m.last_cpu_utilization is None or \
+        0.0 <= m.last_cpu_utilization <= 1.0
+
+
+def test_string_utils_facade():
+    from spark_rapids_tpu.ops import string_utils as SU
+    col = SU.random_uuids(4, seed=1)
+    vals = col.to_pylist()
+    assert len(set(vals)) == 4
+    assert all(len(v) == 36 and v[14] == "4" for v in vals)
+    from spark_rapids_tpu.columns.column import Column
+    out = SU.substring_index(Column.from_strings(["a.b.c"]), ".", 2)
+    assert out.to_pylist() == ["a.b"]
