@@ -204,8 +204,19 @@ def bench_decoders(n=1_000_000):
     gcol = Column.from_strings(gbk_rows)
     dt_gbk = timed(SM.decode_to_utf8, gcol, "GBK", SM.REPLACE)
 
+    rmdocs = [f'{{"id": {i}, "tag": "t{i % 9}", "ok": true}}'
+              for i in range(n)]
+    rmcol = Column.from_strings(rmdocs)
+    dt_rm = timed(JU.from_json_to_raw_map, rmcol)
+
     return {
         "rows": n,
+        "from_json_raw_map": {
+            "k_rows_per_sec": round(n / dt_rm / 1e3, 1),
+            "path": ("device multi-capture scan"
+                     if jax.default_backend() != "cpu"
+                     else "host tree-builder (device scan is "
+                          "accelerator-gated)")},
         "protobuf_decode": {
             "k_rows_per_sec": round(n / dt_pb / 1e3, 1),
             "path": "device masked-scan (protobuf_device)"},

@@ -1,0 +1,12 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * CASE WHEN fast path (reference CaseWhen.java over case_when.cu; TPU
+ * engine: spark_rapids_tpu/ops/case_when.py).
+ */
+public final class CaseWhen {
+  private CaseWhen() {}
+
+  /** N boolean columns -> INT32 index of the first true per row. */
+  public static native long selectFirstTrueIndex(long[] boolColumns);
+}

@@ -1,0 +1,15 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * GBK to UTF-8 decode (reference CharsetDecode.java:55-79 over
+ * charset_decode.cu's two-pass table decode; TPU engine:
+ * spark_rapids_tpu/ops/strings_misc.decode_to_utf8 — generated 64K
+ * table + vectorized cursor loop + UTF-8 emission pass).
+ */
+public final class CharsetDecode {
+  private CharsetDecode() {}
+
+  /** onError: "REPLACE" (U+FFFD) or "REPORT" (raise with row index). */
+  public static native long decodeToUTF8(long column, String charset,
+                                         String onError);
+}

@@ -1,0 +1,14 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Julian&lt;-&gt;Gregorian rebase for legacy Parquet/Hive timestamps
+ * (reference DateTimeRebase.java over datetime_rebase.cu; TPU engine:
+ * spark_rapids_tpu/ops/datetime_ops rebase functions).
+ */
+public final class DateTimeRebase {
+  private DateTimeRebase() {}
+
+  public static native long rebaseGregorianToJulian(long column);
+
+  public static native long rebaseJulianToGregorian(long column);
+}

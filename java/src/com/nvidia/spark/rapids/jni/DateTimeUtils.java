@@ -1,0 +1,13 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * date_trunc / trunc (reference DateTimeUtils.java:41-115 over
+ * datetime_truncate.cu; TPU engine:
+ * spark_rapids_tpu/ops/datetime_ops.truncate).
+ */
+public final class DateTimeUtils {
+  private DateTimeUtils() {}
+
+  /** component: YEAR/QUARTER/MONTH/WEEK/DAY/HOUR/MINUTE/SECOND/... */
+  public static native long truncate(long column, String component);
+}

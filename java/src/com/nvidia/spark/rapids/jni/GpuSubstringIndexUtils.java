@@ -1,0 +1,14 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Spark substring_index (reference GpuSubstringIndexUtils.java over
+ * substring_index.cu; TPU engine:
+ * spark_rapids_tpu/ops/substring_index.py — sliding-window match scan
+ * with vectorized non-overlap suppression).
+ */
+public final class GpuSubstringIndexUtils {
+  private GpuSubstringIndexUtils() {}
+
+  public static native long substringIndex(long column, String delim,
+                                           int count);
+}

@@ -1,0 +1,22 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Whole-table host spill (reference HostTable.java:46-189 over
+ * HostTableJni.cpp — device table to one contiguous host buffer and
+ * back; TPU runtime: spark_rapids_tpu/memory/host_table.py, the
+ * spill half of the OOM machinery's retry contract).
+ */
+public final class HostTable {
+  private HostTable() {}
+
+  /** Copy a device table into one contiguous host buffer. */
+  public static native long fromTable(long[] tableColumns);
+
+  /** Buffer footprint (spill accounting). */
+  public static native long sizeBytes(long hostTable);
+
+  /** Upload back to the device; returns column handles. */
+  public static native long[] toDeviceColumns(long hostTable);
+
+  public static native void free(long hostTable);
+}

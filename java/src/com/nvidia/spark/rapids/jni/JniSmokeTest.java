@@ -64,6 +64,31 @@ public final class JniSmokeTest {
         "JSONUtils.getJsonObject");
     System.out.println("get_json_object ok");
 
+    long uris = TpuColumns.fromStrings(
+        new String[] {"https://h.example.com/p?a=1"});
+    long hosts = ParseURI.parseHost(uris, false);
+    TestSupport.assertTrue(
+        TestSupport.checkStringColumn(hosts,
+            new String[] {"h.example.com"}),
+        "ParseURI.parseHost");
+    System.out.println("parse_uri ok");
+
+    byte[] kb = KudoSerializer.writeToStream(new long[] {longs}, 0, 3);
+    long[] merged = KudoSerializer.mergeToTable(
+        kb, new String[] {"int64"}, new int[] {0});
+    TestSupport.assertTrue(
+        TestSupport.checkColumnsEqual(longs, merged[0]),
+        "Kudo write/merge over JNI");
+    System.out.println("kudo round trip ok");
+
+    long spilled = HostTable.fromTable(new long[] {longs});
+    long[] restored = HostTable.toDeviceColumns(spilled);
+    TestSupport.assertTrue(
+        TestSupport.checkColumnsEqual(longs, restored[0]),
+        "HostTable spill round trip");
+    HostTable.free(spilled);
+    System.out.println("host table spill ok");
+
     long uuids = StringUtils.randomUUIDs(4, 1);
     System.out.println("randomUUIDs ok");
 
@@ -74,7 +99,8 @@ public final class JniSmokeTest {
     System.out.println("RmmSpark register/taskDone ok");
 
     for (long h : new long[] {strs, murmur, longs, xx, rows, back[0],
-                              nums, ints, json, jout, uuids}) {
+                              nums, ints, json, jout, uuids, uris,
+                              hosts, merged[0], restored[0]}) {
       TpuColumns.free(h);
     }
     TpuRuntime.shutdown();

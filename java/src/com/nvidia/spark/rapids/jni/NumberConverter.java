@@ -1,0 +1,15 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Spark conv() (reference NumberConverter.java over
+ * number_converter.cu; TPU engine:
+ * spark_rapids_tpu/ops/strings_misc.convert — unsigned-64 clamp
+ * semantics, signed rendering for negative target bases).
+ */
+public final class NumberConverter {
+  private NumberConverter() {}
+
+  /** conv(column, fromBase, toBase) — column input, scalar bases. */
+  public static native long convertCvCv(long column, int fromBase,
+                                        int toBase);
+}

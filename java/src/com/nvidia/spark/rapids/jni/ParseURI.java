@@ -1,0 +1,23 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Spark parse_url (reference ParseURI.java over parse_uri.cu; TPU
+ * engine: spark_rapids_tpu/ops/parse_uri_device.py — single jitted
+ * pass, java.net.URI validation, per-row host fallback).  Invalid URIs
+ * yield null rows (ansi=false) or raise with the first failing row.
+ */
+public final class ParseURI {
+  private ParseURI() {}
+
+  public static native long parseProtocol(long column, boolean ansi);
+
+  public static native long parseHost(long column, boolean ansi);
+
+  public static native long parseQuery(long column, boolean ansi);
+
+  public static native long parsePath(long column, boolean ansi);
+
+  /** parse_url(col, 'QUERY', key): first '&'-delimited key=value. */
+  public static native long parseQueryWithKey(long column, String key,
+                                              boolean ansi);
+}

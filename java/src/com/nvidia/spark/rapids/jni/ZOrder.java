@@ -1,0 +1,15 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Z-order clustering helpers (reference ZOrder.java over zorder.cu;
+ * TPU engine: spark_rapids_tpu/ops/zorder.py).
+ */
+public final class ZOrder {
+  private ZOrder() {}
+
+  /** interleave_bits over the given columns -> binary column. */
+  public static native long interleaveBits(long[] columns);
+
+  /** Hilbert curve index (Delta/Iceberg clustering). */
+  public static native long hilbertIndex(int numBits, long[] columns);
+}

@@ -258,6 +258,33 @@ jlongArray as_jlong_array(JNIEnv* env, PyObject* r) {
   return arr;
 }
 
+PyObject* bytes_to_py(JNIEnv* env, jbyteArray arr) {
+  jsize n = env->GetArrayLength(arr);
+  jbyte* elems = env->GetByteArrayElements(arr, nullptr);
+  PyObject* b = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(elems), n);
+  env->ReleaseByteArrayElements(arr, elems, JNI_ABORT);
+  return b;
+}
+
+jbyteArray as_jbyte_array(JNIEnv* env, PyObject* r) {
+  if (r == nullptr) return nullptr;
+  if (!PyBytes_Check(r)) {
+    Py_DECREF(r);
+    throw_java(env, "entry function did not return bytes");
+    return nullptr;
+  }
+  jsize n = static_cast<jsize>(PyBytes_GET_SIZE(r));
+  jbyteArray arr = env->NewByteArray(n);
+  if (arr != nullptr) {
+    env->SetByteArrayRegion(
+        arr, 0, n,
+        reinterpret_cast<const jbyte*>(PyBytes_AS_STRING(r)));
+  }
+  Py_DECREF(r);
+  return arr;
+}
+
 // Python str -> Java String via UTF-16 (NewStringUTF needs modified
 // UTF-8, which PyUnicode_AsUTF8 does not produce for non-BMP chars).
 jstring as_jstring(JNIEnv* env, PyObject* r) {
@@ -442,6 +469,210 @@ jlong JNI_FN(JSONUtils, getJsonObject)(JNIEnv* env, jclass, jlong col,
   PyObject* args = Py_BuildValue("(Ls)", (long long)col, p);
   env->ReleaseStringUTFChars(path, p);
   return as_jlong(env, call_entry(env, "get_json_object", args));
+}
+
+// ----------------------------------------------------------- ParseURI
+
+static jlong parse_uri_component(JNIEnv* env, jlong col,
+                                 const char* what, jboolean ansi) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(LsO)", (long long)col, what,
+                                 ansi ? Py_True : Py_False);
+  return as_jlong(env, call_entry(env, "parse_uri", args));
+}
+
+jlong JNI_FN(ParseURI, parseProtocol)(JNIEnv* env, jclass, jlong col,
+                                      jboolean ansi) {
+  return parse_uri_component(env, col, "protocol", ansi);
+}
+
+jlong JNI_FN(ParseURI, parseHost)(JNIEnv* env, jclass, jlong col,
+                                  jboolean ansi) {
+  return parse_uri_component(env, col, "host", ansi);
+}
+
+jlong JNI_FN(ParseURI, parseQuery)(JNIEnv* env, jclass, jlong col,
+                                   jboolean ansi) {
+  return parse_uri_component(env, col, "query", ansi);
+}
+
+jlong JNI_FN(ParseURI, parsePath)(JNIEnv* env, jclass, jlong col,
+                                  jboolean ansi) {
+  return parse_uri_component(env, col, "path", ansi);
+}
+
+jlong JNI_FN(ParseURI, parseQueryWithKey)(JNIEnv* env, jclass,
+                                          jlong col, jstring key,
+                                          jboolean ansi) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  const char* k = env->GetStringUTFChars(key, nullptr);
+  PyObject* args = Py_BuildValue("(LsO)", (long long)col, k,
+                                 ansi ? Py_True : Py_False);
+  env->ReleaseStringUTFChars(key, k);
+  return as_jlong(env,
+                  call_entry(env, "parse_uri_query_with_key", args));
+}
+
+// ------------------------------------------- GpuSubstringIndexUtils
+
+jlong JNI_FN(GpuSubstringIndexUtils, substringIndex)(
+    JNIEnv* env, jclass, jlong col, jstring delim, jint count) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  const char* d = env->GetStringUTFChars(delim, nullptr);
+  PyObject* args = Py_BuildValue("(Lsi)", (long long)col, d,
+                                 (int)count);
+  env->ReleaseStringUTFChars(delim, d);
+  return as_jlong(env, call_entry(env, "substring_index", args));
+}
+
+// -------------------------------------------------------- CharsetDecode
+
+jlong JNI_FN(CharsetDecode, decodeToUTF8)(JNIEnv* env, jclass,
+                                          jlong col, jstring charset,
+                                          jstring on_error) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  const char* cs = env->GetStringUTFChars(charset, nullptr);
+  const char* oe = env->GetStringUTFChars(on_error, nullptr);
+  PyObject* args = Py_BuildValue("(Lss)", (long long)col, cs, oe);
+  env->ReleaseStringUTFChars(charset, cs);
+  env->ReleaseStringUTFChars(on_error, oe);
+  return as_jlong(env, call_entry(env, "charset_decode_to_utf8", args));
+}
+
+// --------------------------------------------------------------- ZOrder
+
+jlong JNI_FN(ZOrder, interleaveBits)(JNIEnv* env, jclass,
+                                     jlongArray cols) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(N)", longs_to_pylist(env, cols));
+  return as_jlong(env, call_entry(env, "interleave_bits", args));
+}
+
+jlong JNI_FN(ZOrder, hilbertIndex)(JNIEnv* env, jclass, jint num_bits,
+                                   jlongArray cols) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(iN)", (int)num_bits,
+                                 longs_to_pylist(env, cols));
+  return as_jlong(env, call_entry(env, "hilbert_index", args));
+}
+
+// ------------------------------------------------------------- CaseWhen
+
+jlong JNI_FN(CaseWhen, selectFirstTrueIndex)(JNIEnv* env, jclass,
+                                             jlongArray cols) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(N)", longs_to_pylist(env, cols));
+  return as_jlong(env, call_entry(env, "select_first_true_index",
+                                  args));
+}
+
+// ------------------------------------------------------ NumberConverter
+
+jlong JNI_FN(NumberConverter, convertCvCv)(JNIEnv* env, jclass,
+                                           jlong col, jint from_base,
+                                           jint to_base) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Lii)", (long long)col,
+                                 (int)from_base, (int)to_base);
+  return as_jlong(env, call_entry(env, "number_converter_convert",
+                                  args));
+}
+
+// -------------------------------------------------------- DateTimeUtils
+
+jlong JNI_FN(DateTimeUtils, truncate)(JNIEnv* env, jclass, jlong col,
+                                      jstring component) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  const char* c = env->GetStringUTFChars(component, nullptr);
+  PyObject* args = Py_BuildValue("(Ls)", (long long)col, c);
+  env->ReleaseStringUTFChars(component, c);
+  return as_jlong(env, call_entry(env, "datetime_truncate", args));
+}
+
+jlong JNI_FN(DateTimeRebase, rebaseGregorianToJulian)(JNIEnv* env,
+                                                      jclass,
+                                                      jlong col) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(LO)", (long long)col, Py_True);
+  return as_jlong(env, call_entry(env, "datetime_rebase", args));
+}
+
+jlong JNI_FN(DateTimeRebase, rebaseJulianToGregorian)(JNIEnv* env,
+                                                      jclass,
+                                                      jlong col) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(LO)", (long long)col, Py_False);
+  return as_jlong(env, call_entry(env, "datetime_rebase", args));
+}
+
+// ------------------------------------------------------------ HostTable
+
+jlong JNI_FN(HostTable, fromTable)(JNIEnv* env, jclass,
+                                   jlongArray cols) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(N)", longs_to_pylist(env, cols));
+  return as_jlong(env, call_entry(env, "host_table_from_table", args));
+}
+
+jlong JNI_FN(HostTable, sizeBytes)(JNIEnv* env, jclass, jlong handle) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(L)", (long long)handle);
+  return as_jlong(env, call_entry(env, "host_table_size_bytes", args));
+}
+
+jlongArray JNI_FN(HostTable, toDeviceColumns)(JNIEnv* env, jclass,
+                                              jlong handle) {
+  if (!ensure_runtime(env)) return nullptr;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(L)", (long long)handle);
+  return as_jlong_array(env,
+                        call_entry(env, "host_table_to_device", args));
+}
+
+void JNI_FN(HostTable, free)(JNIEnv* env, jclass, jlong handle) {
+  if (!ensure_runtime(env)) return;
+  Gil gil;
+  PyObject* r = call_entry(env, "host_table_free",
+                           Py_BuildValue("(L)", (long long)handle));
+  Py_XDECREF(r);
+}
+
+// ------------------------------------------------------- KudoSerializer
+
+jbyteArray JNI_FN(KudoSerializer, writeToStream)(JNIEnv* env, jclass,
+                                                 jlongArray cols,
+                                                 jint row_offset,
+                                                 jint num_rows) {
+  if (!ensure_runtime(env)) return nullptr;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Nii)", longs_to_pylist(env, cols),
+                                 (int)row_offset, (int)num_rows);
+  return as_jbyte_array(env, call_entry(env, "kudo_write", args));
+}
+
+jlongArray JNI_FN(KudoSerializer, mergeToTable)(JNIEnv* env, jclass,
+                                                jbyteArray blob,
+                                                jobjectArray type_ids,
+                                                jintArray scales) {
+  if (!ensure_runtime(env)) return nullptr;
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(NNN)", bytes_to_py(env, blob),
+      strings_to_pylist(env, type_ids), ints_to_pylist(env, scales));
+  return as_jlong_array(env, call_entry(env, "kudo_merge", args));
 }
 
 // -------------------------------------------------------- StringUtils

@@ -71,6 +71,46 @@ NATIVE_CLASSES = {
     "StringUtils": [
         ("randomUUIDs", "(IJ)J"),
     ],
+    "ParseURI": [
+        ("parseProtocol", "(JZ)J"),
+        ("parseHost", "(JZ)J"),
+        ("parseQuery", "(JZ)J"),
+        ("parsePath", "(JZ)J"),
+        ("parseQueryWithKey", "(JLjava/lang/String;Z)J"),
+    ],
+    "GpuSubstringIndexUtils": [
+        ("substringIndex", "(JLjava/lang/String;I)J"),
+    ],
+    "CharsetDecode": [
+        ("decodeToUTF8", "(JLjava/lang/String;Ljava/lang/String;)J"),
+    ],
+    "ZOrder": [
+        ("interleaveBits", "([J)J"),
+        ("hilbertIndex", "(I[J)J"),
+    ],
+    "CaseWhen": [
+        ("selectFirstTrueIndex", "([J)J"),
+    ],
+    "NumberConverter": [
+        ("convertCvCv", "(JII)J"),
+    ],
+    "DateTimeUtils": [
+        ("truncate", "(JLjava/lang/String;)J"),
+    ],
+    "DateTimeRebase": [
+        ("rebaseGregorianToJulian", "(J)J"),
+        ("rebaseJulianToGregorian", "(J)J"),
+    ],
+    "KudoSerializer": [
+        ("writeToStream", "([JII)[B"),
+        ("mergeToTable", "([B[Ljava/lang/String;[I)[J"),
+    ],
+    "HostTable": [
+        ("fromTable", "([J)J"),
+        ("sizeBytes", "(J)J"),
+        ("toDeviceColumns", "(J)[J"),
+        ("free", "(J)V"),
+    ],
     "TestSupport": [
         ("assertTrue", "(ILjava/lang/String;)V"),
         ("checkLongColumn", "(J[J)I"),
@@ -117,7 +157,7 @@ def build_smoke_test(outdir: str, xx_gold):
     """JniSmokeTest.main: straight-line bytecode (assertions throw from
     native TestSupport.assertTrue, so no branches / StackMapTable)."""
     cf = ClassFile(f"{PKG}/JniSmokeTest")
-    c = Code(cf.cp, max_locals=26)
+    c = Code(cf.cp, max_locals=40)
     J = f"{PKG}/"
 
     def assert_check(msg):
@@ -222,6 +262,66 @@ def build_smoke_test(outdir: str, xx_gold):
     assert_check("JSONUtils.getJsonObject")
     c.println("get_json_object ok")
 
+    # --- ParseURI over the device engine -----------------------------
+    H_URI, H_HOST = 25, 27
+    c.string_array(["https://h.example.com/p?a=1"])
+    c.invokestatic(J + "TpuColumns", "fromStrings",
+                   "([Ljava/lang/String;)J")
+    c.lstore(H_URI)
+    c.lload(H_URI)
+    c.iconst(0)
+    c.invokestatic(J + "ParseURI", "parseHost", "(JZ)J")
+    c.lstore(H_HOST)
+    c.lload(H_HOST)
+    c.string_array(["h.example.com"])
+    c.invokestatic(J + "TestSupport", "checkStringColumn",
+                   "(J[Ljava/lang/String;)I")
+    assert_check("ParseURI.parseHost")
+    c.println("parse_uri ok")
+
+    # --- Kudo serializer round trip over the JNI byte[] boundary -----
+    KB, MERGED, MERGED0 = 29, 30, 31
+    c.long_array_locals([H_LONGS])
+    c.iconst(0)
+    c.iconst(3)
+    c.invokestatic(J + "KudoSerializer", "writeToStream", "([JII)[B")
+    c.astore(KB)
+    c.aload(KB)
+    c.string_array(["int64"])
+    c.int_array([0])
+    c.invokestatic(J + "KudoSerializer", "mergeToTable",
+                   "([B[Ljava/lang/String;[I)[J")
+    c.astore(MERGED)
+    c.aload(MERGED)
+    c.iconst(0)
+    c.laload()
+    c.lstore(MERGED0)
+    c.lload(H_LONGS)
+    c.lload(MERGED0)
+    c.invokestatic(J + "TestSupport", "checkColumnsEqual", "(JJ)I")
+    assert_check("Kudo write/merge over JNI")
+    c.println("kudo round trip ok")
+
+    # --- HostTable spill round trip ---------------------------------
+    HT, RESTORED, RESTORED0 = 33, 35, 36
+    c.long_array_locals([H_LONGS])
+    c.invokestatic(J + "HostTable", "fromTable", "([J)J")
+    c.lstore(HT)
+    c.lload(HT)
+    c.invokestatic(J + "HostTable", "toDeviceColumns", "(J)[J")
+    c.astore(RESTORED)
+    c.aload(RESTORED)
+    c.iconst(0)
+    c.laload()
+    c.lstore(RESTORED0)
+    c.lload(H_LONGS)
+    c.lload(RESTORED0)
+    c.invokestatic(J + "TestSupport", "checkColumnsEqual", "(JJ)I")
+    assert_check("HostTable spill round trip")
+    c.lload(HT)
+    c.invokestatic(J + "HostTable", "free", "(J)V")
+    c.println("host table spill ok")
+
     # --- StringUtils.randomUUIDs ------------------------------------
     H_UUID = 23
     c.iconst(4)
@@ -243,7 +343,8 @@ def build_smoke_test(outdir: str, xx_gold):
 
     # --- handle hygiene ----------------------------------------------
     for h in [H_STR, 4, H_LONGS, 8, ROWS, BACK0, H_NUM, H_CAST,
-              H_JSON, H_JOUT, H_UUID]:
+              H_JSON, H_JOUT, H_UUID, H_URI, H_HOST, MERGED0,
+              RESTORED0]:
         c.lload(h)
         c.invokestatic(J + "TpuColumns", "free", "(J)V")
     c.invokestatic(J + "TpuRuntime", "shutdown", "()V")

@@ -75,7 +75,8 @@ def _specs():
     dst = jnp.asarray([0, 64], jnp.int64)
     src = jnp.asarray([0, 128], jnp.int64)
 
-    from spark_rapids_tpu.ops import protobuf_device, parse_uri_device
+    from spark_rapids_tpu.ops import (parse_uri_device, protobuf_device,
+                                      raw_map_device)
     pb_specs = ((1, 0), (2, 2), (3, 1), (4, 5))  # varint/len/f64/f32
 
     return [
@@ -111,6 +112,7 @@ def _specs():
          (chars, lens)),
         ("parse_uri_analyze", parse_uri_device._analyze,
          (chars, lens)),
+        ("raw_map_scan", raw_map_device._scan_raw_map, (chars, lens)),
     ]
 
 

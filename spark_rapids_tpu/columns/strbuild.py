@@ -1,0 +1,78 @@
+"""Shared flat-span STRING column builder.
+
+Every device string engine ends the same way: per-row (start, len)
+spans into some flat u8 source, materialized as one vectorized byte
+gather.  This is THE single implementation (r4 review: four divergent
+copies had grown in parse_uri_device / protobuf_device /
+from_json_device / raw_map_device); per-row host fallback values splice
+into the byte buffer directly — never a whole-column Python round-trip.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+
+
+def build_string_column(src: np.ndarray, starts: np.ndarray,
+                        lens: np.ndarray,
+                        valid: Optional[np.ndarray] = None,
+                        host_patch: Optional[Dict[int, Optional[str]]]
+                        = None) -> Column:
+    """STRING column from per-element spans into a flat u8 buffer.
+
+    src:    flat uint8 source (flatten a padded matrix with
+            starts = row * row_width + col for matrix sources).
+    starts/lens: per-element spans; elements with valid=False (or a
+            host_patch value of None) become null rows.
+    host_patch: {index: str|None} — values produced by a host fallback
+            path, written directly into the output bytes.
+    """
+    n = len(starts)
+    lens = np.asarray(lens, np.int64)
+    starts = np.asarray(starts, np.int64)
+    validity = (np.ones(n, bool) if valid is None
+                else np.asarray(valid).astype(bool).copy())
+
+    byte_lens = np.where(validity, np.maximum(lens, 0), 0)
+    host_bytes: Dict[int, bytes] = {}
+    if host_patch:
+        for i, s in host_patch.items():
+            if s is None:
+                validity[i] = False
+                byte_lens[i] = 0
+            else:
+                b = s.encode("utf-8")
+                host_bytes[i] = b
+                validity[i] = True
+                byte_lens[i] = len(b)
+
+    offs = np.concatenate([[0], np.cumsum(byte_lens)]).astype(np.int32)
+    total = int(offs[-1])
+    buf = np.zeros(total, np.uint8)
+    if total:
+        dev_mask = byte_lens > 0
+        for i in host_bytes:
+            dev_mask[i] = False
+        didx = np.nonzero(dev_mask)[0]
+        if didx.size:
+            seg_len = byte_lens[didx]
+            cum = np.cumsum(seg_len)
+            flat = np.arange(int(cum[-1]))
+            seg = np.searchsorted(cum, flat, side="right")
+            within = flat - np.concatenate([[0], cum[:-1]])[seg]
+            buf[offs[didx][seg] + within] = src[
+                np.minimum(starts[didx][seg] + within,
+                           max(len(src) - 1, 0))]
+        for i, b in host_bytes.items():
+            buf[offs[i]:offs[i] + len(b)] = np.frombuffer(b, np.uint8)
+
+    v = None if validity.all() else jnp.asarray(
+        validity.astype(np.uint8))
+    return Column(dtypes.STRING, n, data=jnp.asarray(buf),
+                  validity=v, offsets=jnp.asarray(offs))

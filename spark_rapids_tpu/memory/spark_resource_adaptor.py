@@ -830,6 +830,15 @@ class SparkResourceAdaptor:
                 if t.state in (THREAD_BLOCKED, THREAD_BUFN):
                     self._transition(t, THREAD_REMOVE_THROW)
                     t.wake.notify_all()
+            # registry teardown: still-registered (RUNNING) threads
+            # must not outlive the adaptor in the ThreadStateRegistry
+            # (removeThread parity holds across non-clean teardowns)
+            if self.on_thread_removed is not None:
+                for thread_id in list(self._threads):
+                    try:
+                        self.on_thread_removed(thread_id)
+                    except Exception:
+                        pass
             # detach the sink under the lock so woken threads can't race a
             # write against close(); close after releasing the lock
             log_file, self._log_file = self._log_file, None

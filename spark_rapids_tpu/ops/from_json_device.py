@@ -113,28 +113,12 @@ def _field_strings(col: Column, name: str, padded, host_trees,
         fb_vals[i] = (None if got is None or got == ("lit", "null")
                       else _value_as_raw_string(got))
 
-    # assemble device spans + patch fallback rows
-    validity_out = dev_copy
-    out_len = span_len.astype(np.int64)
-    new_offs = np.concatenate([[0], np.cumsum(out_len)]) \
-        .astype(np.int32)
-    total = int(new_offs[-1])
-    if total:
-        i_flat = np.arange(total)
-        r = np.searchsorted(new_offs, i_flat, side="right") - 1
-        cpos = span_start[r] + (i_flat - new_offs[r])
-        data = all_chars[np.minimum(cpos, len(all_chars) - 1)]
-    else:
-        data = np.zeros(0, np.uint8)
-    out = Column(dtypes.STRING, rows, data=jnp.asarray(data),
-                 validity=None if validity_out.all() else
-                 jnp.asarray(validity_out.astype(np.uint8)),
-                 offsets=jnp.asarray(new_offs))
-    if fb_vals:
-        vals = out.to_pylist()
-        for i, v in fb_vals.items():
-            vals[i] = v
-        out = Column.from_strings(vals)
+    # assemble device spans; fallback rows splice into the byte buffer
+    # (shared builder — never a whole-column Python round-trip)
+    from spark_rapids_tpu.columns.strbuild import build_string_column
+    out = build_string_column(np.asarray(all_chars), span_start,
+                              span_len, dev_copy,
+                              fb_vals if fb_vals else None)
     return out, valid
 
 

@@ -43,7 +43,29 @@ def from_json_to_raw_map(col: Column,
                          allow_leading_zeros: bool = False) -> Column:
     """JSON object rows -> MAP<STRING,STRING>
     (JSONUtils.extractRawMapFromJsonString:159).  Non-object / invalid
-    rows are null; duplicate keys keep the last value."""
+    rows are null; duplicate keys keep the last value.
+
+    Columns above a size threshold route to the device multi-capture
+    scan (ops/raw_map_device.py, the from_json_to_raw_map.cu
+    counterpart); this host tree-builder stays the oracle and handles
+    the device scan's fallback rows."""
+    import os
+
+    import jax
+
+    from spark_rapids_tpu.ops import raw_map_device as RM
+    min_rows = int(os.environ.get(
+        "SPARK_RAPIDS_TPU_RAW_MAP_DEVICE_MIN", "256"))
+    force = os.environ.get(
+        "SPARK_RAPIDS_TPU_FORCE_DEVICE_RAW_MAP") == "1"
+    # accelerator-gated like the joins/groupby device paths: the
+    # multi-capture scan's one-hot register writes are VPU-shaped; on
+    # the 1-core CPU backend the host tree-builder measures ~4x faster
+    on_accel = jax.default_backend() != "cpu"
+    if force or (on_accel and col.length >= min_rows):
+        out = RM.from_json_to_raw_map_device(col, allow_leading_zeros)
+        if out is not None:
+            return out
     assert col.dtype.is_string
     rows = col.length
     keys: List[str] = []

@@ -341,24 +341,16 @@ def _analyzed_chunk(col: Column, b0: int, b1: int):
 
 # ------------------------------------------------ span materialization
 def spans_to_strings(chars: np.ndarray, starts: np.ndarray,
-                     ends: np.ndarray, valid: np.ndarray) -> Column:
+                     ends: np.ndarray, valid: np.ndarray,
+                     host_patch=None) -> Column:
     """Gather [start,end) per row from the padded matrix into a STRING
-    column (ftos_device flat-gather pattern); invalid rows are null."""
-    slens = np.where(valid, np.maximum(ends - starts, 0), 0) \
-        .astype(np.int64)
-    offs = np.concatenate([[0], np.cumsum(slens)]).astype(np.int32)
-    total = int(offs[-1])
-    if total:
-        rows_idx = np.searchsorted(offs, np.arange(total),
-                                   side="right") - 1
-        cpos = starts[rows_idx] + (np.arange(total) - offs[rows_idx])
-        data = chars[rows_idx, np.minimum(cpos, chars.shape[1] - 1)]
-    else:
-        data = np.zeros(0, np.uint8)
-    validity = None if valid.all() else jnp.asarray(
-        valid.astype(np.uint8))
-    return Column(dtypes.STRING, len(slens), data=jnp.asarray(data),
-                  validity=validity, offsets=jnp.asarray(offs))
+    column; invalid rows are null (shared builder: columns/strbuild)."""
+    from spark_rapids_tpu.columns.strbuild import build_string_column
+    L = chars.shape[1]
+    rows_idx = np.arange(len(starts))
+    return build_string_column(
+        chars.reshape(-1), rows_idx * L + starts,
+        np.maximum(ends - starts, 0), valid, host_patch)
 
 
 def _component(res, what):
@@ -448,17 +440,12 @@ def extract_device(col: Column, what: str, ansi_mode: bool,
             for i, (_parses, v) in host_vals.items():
                 vals[i] = v
             parts.append(Column.from_strings(vals))
-        elif host_vals:
-            # mixed device/host: materialize device rows, patch host
-            dev_col = spans_to_strings(chars, lo, hi,
-                                       valid & ~in_null & ~fb)
-            vals = dev_col.to_pylist()
-            for i, (_parses, v) in host_vals.items():
-                vals[i] = v
-            parts.append(Column.from_strings(vals))
         else:
+            # device spans, host rows spliced in by the shared builder
+            patch = {i: v for i, (_p, v) in host_vals.items()} \
+                if host_vals else None
             parts.append(spans_to_strings(
-                chars, lo, hi, valid & ~in_null))
+                chars, lo, hi, valid & ~in_null & ~fb, patch))
 
     if len(parts) == 1:
         return parts[0]

@@ -268,25 +268,14 @@ def _finalize_numeric(f, raw: np.ndarray, seen: np.ndarray,
 def _finalize_string(chars: np.ndarray, lens: np.ndarray,
                      raw: np.ndarray, seen: np.ndarray,
                      rownull: np.ndarray) -> Column:
+    from spark_rapids_tpu.columns.strbuild import build_string_column
     starts = (raw >> np.uint64(32)).astype(np.int64)
     slens = (raw & np.uint64(0xFFFFFFFF)).astype(np.int64)
-    valid = seen & ~rownull
-    slens = np.where(valid, slens, 0)
-    offs = np.concatenate(
-        [[0], np.cumsum(slens)]).astype(np.int32)
-    total = int(offs[-1])
-    if total:
-        # flat gather: out[k] = chars[row(k), start(row)+k-offs(row)]
-        rows_idx = np.searchsorted(offs, np.arange(total),
-                                   side="right") - 1
-        cpos = starts[rows_idx] + (np.arange(total) - offs[rows_idx])
-        data = chars[rows_idx, np.minimum(cpos, chars.shape[1] - 1)]
-    else:
-        data = np.zeros(0, np.uint8)
-    validity = None if valid.all() else jnp.asarray(
-        valid.astype(np.uint8))
-    return Column(dtypes.STRING, len(slens), data=jnp.asarray(data),
-                  validity=validity, offsets=jnp.asarray(offs))
+    L = chars.shape[1]
+    rows_idx = np.arange(len(starts))
+    return build_string_column(chars.reshape(-1),
+                               rows_idx * L + starts, slens,
+                               seen & ~rownull)
 
 
 def decode_protobuf_to_struct_device(col: Column,

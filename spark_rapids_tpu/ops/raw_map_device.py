@@ -1,0 +1,408 @@
+"""Device from_json raw map: multi-capture JSON scan on TPU.
+
+Reference: src/main/cpp/src/from_json_to_raw_map.cu:1-894 (kernels
+behind JSONUtils.extractRawMapFromJsonString — every top-level key and
+value of a JSON object row into MAP<STRING,STRING>).
+
+Unlike get_json_object/from_json-to-structs (one capture register per
+row), raw map needs EVERY depth-1 pair, so this is a dedicated
+lax.scan: each row carries a token-mode DFA plus a pair cursor, and
+key/value spans land in (rows, MAX_PAIRS) registers via one-hot
+pair-index writes (the json_device stack-lane discipline — scatter
+lowers catastrophically inside TPU scans, masked one-hot writes don't).
+
+Device scope (everything else flags the row to the host oracle,
+json_utils.from_json_to_raw_map): flat objects of plain double-quoted
+keys and primitive values — strings without escapes, numbers without
+leading zeros, true/false/null.  Nested values, escapes, single quotes,
+control characters, >MAX_PAIRS pairs, and potential duplicate keys
+(detected post-scan by span length + content probes) all fall back
+per-row.  Rows whose first non-whitespace byte is not '{' are null
+directly (the host nulls every non-object row, valid JSON or not).
+
+Duplicate-key note: raw map keeps the FIRST position but the LAST value
+of a duplicated key; rather than cross-compare 32x32 spans on device,
+potential duplicates route to the host (false positives only cost a
+fallback, never correctness).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+
+_I32 = jnp.int32
+_U8 = jnp.uint8
+_B = jnp.bool_
+
+MAX_PAIRS = 32
+DEVICE_ROW_CHUNK = 1 << 15   # bounds the (rows, MAX_PAIRS) registers
+
+# DFA modes
+_M_PRE = 0        # before '{' (only ws allowed)
+_M_KEY_OR_END = 1  # after '{': key quote or '}'
+_M_KEY = 2        # inside key string
+_M_COLON = 3      # after key: ws then ':'
+_M_VAL_START = 4  # ws then a value first-char
+_M_VAL_PRIM = 5   # inside number/true/false/null token
+_M_VAL_STR = 6    # inside string value
+_M_AFTER_VAL = 7  # ws then ',' or '}'
+_M_KEY_REQ = 8    # after ',': key quote required
+_M_END = 9        # after final '}': only ws allowed
+
+
+def _scan_raw_map(chars: jnp.ndarray, lens: jnp.ndarray):
+    """Returns (is_obj, ok, npairs, ks, ke, vs, ve, val_is_str):
+    spans are row-relative; ok=False rows need the host oracle."""
+    R, L = chars.shape
+    pair_lane = jnp.arange(MAX_PAIRS, dtype=_I32)[None, :]
+
+    ws = (ord(" "), ord("\t"), ord("\n"), ord("\r"))
+
+    def step(carry, j_and_c):
+        (mode, fb, npairs, last_nonws, ks, ke, vs, ve, vstr) = carry
+        j, c = j_and_c
+        in_row = j < lens
+        is_ws = jnp.zeros(R, _B)
+        for w in ws:
+            is_ws |= c == w
+        bad_ctrl = (c < 0x20) & ~is_ws
+        high = c >= 0x80
+
+        def put(reg, val, active):
+            onehot = (pair_lane == npairs[:, None]) & active[:, None]
+            val = jnp.broadcast_to(val, (R,))   # j-scalars and (R,)
+            return jnp.where(onehot, val[:, None], reg)
+
+        m = lambda v: mode == v  # noqa: E731
+        act = in_row & ~fb
+
+        # --- _M_PRE: ws* then '{' (anything else: null-row marker,
+        # encoded as fb=False + is_obj False computed at the end)
+        to_obj = act & m(_M_PRE) & (c == ord("{"))
+        pre_other = act & m(_M_PRE) & ~is_ws & (c != ord("{"))
+
+        # --- key start / object end
+        kq = act & (m(_M_KEY_OR_END) | m(_M_KEY_REQ)) & (c == ord('"'))
+        obj_end_early = act & m(_M_KEY_OR_END) & (c == ord("}"))
+        key_bad = act & (m(_M_KEY_OR_END) | m(_M_KEY_REQ)) & ~is_ws \
+            & (c != ord('"')) & ~(m(_M_KEY_OR_END) & (c == ord("}")))
+
+        # --- inside key
+        key_end = act & m(_M_KEY) & (c == ord('"'))
+        key_esc = act & m(_M_KEY) & ((c == ord("\\")) | bad_ctrl | high)
+
+        # --- colon
+        colon = act & m(_M_COLON) & (c == ord(":"))
+        colon_bad = act & m(_M_COLON) & ~is_ws & (c != ord(":"))
+
+        # --- value start
+        vschar = act & m(_M_VAL_START) & ~is_ws
+        v_str = vschar & (c == ord('"'))
+        v_nest = vschar & ((c == ord("{")) | (c == ord("[")))
+        v_prim_ok = vschar & (
+            ((c >= ord("0")) & (c <= ord("9"))) | (c == ord("-"))
+            | (c == ord("t")) | (c == ord("f")) | (c == ord("n")))
+        v_bad = vschar & ~v_str & ~v_nest & ~v_prim_ok
+
+        # --- inside string value
+        vs_end = act & m(_M_VAL_STR) & (c == ord('"'))
+        vs_esc = act & m(_M_VAL_STR) & ((c == ord("\\")) | bad_ctrl
+                                        | high)
+
+        # --- inside primitive value: ends at ws, ',' or '}'
+        vp_delim = act & m(_M_VAL_PRIM) & (
+            is_ws | (c == ord(",")) | (c == ord("}")))
+        vp_bad = act & m(_M_VAL_PRIM) & (
+            bad_ctrl | (c == ord("[")) | (c == ord("{"))
+            | (c == ord('"')))
+
+        # --- after value
+        more = (act & m(_M_AFTER_VAL) & (c == ord(","))) | \
+            (vp_delim & (c == ord(",")))
+        obj_end = (act & m(_M_AFTER_VAL) & (c == ord("}"))) | \
+            (vp_delim & (c == ord("}"))) | obj_end_early
+        after_bad = act & m(_M_AFTER_VAL) & ~is_ws \
+            & (c != ord(",")) & (c != ord("}"))
+
+        # --- after '}': only trailing ws
+        end_bad = act & m(_M_END) & ~is_ws
+
+        new_fb = fb | (act & (
+            key_esc | vs_esc | colon_bad | v_bad | v_nest | vp_bad
+            | after_bad | end_bad | key_bad
+            | (kq & (npairs >= MAX_PAIRS))))
+
+        # span writes (one-hot at the current pair index)
+        ks = put(ks, j + 1, kq)
+        ke = put(ke, j, key_end)
+        vs = put(vs, jnp.where(v_str, j + 1, j), v_str | v_prim_ok)
+        # string value end: at closing quote; primitive end: last
+        # non-ws position + 1 (handled via last_nonws below)
+        ve = put(ve, j, vs_end)
+        ve = put(ve, last_nonws + 1, vp_delim)
+        vstr = jnp.where(
+            (pair_lane == npairs[:, None]) & (v_str | v_prim_ok)[:, None],
+            v_str[:, None], vstr)
+
+        npairs_new = npairs + (vs_end | vp_delim).astype(_I32)
+        last_nonws_new = jnp.where(act & m(_M_VAL_PRIM) & ~is_ws
+                                   & ~vp_delim, j, last_nonws)
+
+        mode_new = jnp.where(
+            to_obj, _M_KEY_OR_END,
+            jnp.where(kq, _M_KEY,
+            jnp.where(key_end, _M_COLON,
+            jnp.where(colon, _M_VAL_START,
+            jnp.where(v_str, _M_VAL_STR,
+            jnp.where(v_prim_ok & ~v_str, _M_VAL_PRIM,
+            jnp.where(vs_end, _M_AFTER_VAL,
+            jnp.where(vp_delim & ~more & ~(vp_delim & (c == ord("}"))),
+                      _M_AFTER_VAL,
+            jnp.where(more, _M_KEY_REQ,
+            jnp.where(obj_end, _M_END, mode))))))))))
+        mode_new = jnp.where(act, mode_new, mode)
+        # a non-'{' first char parks the row in _M_PRE permanently
+        mode_new = jnp.where(pre_other, _M_PRE, mode_new)
+        fb_keep = jnp.where(pre_other, fb, new_fb)  # null row, not fb
+
+        return ((mode_new, fb_keep, npairs_new, last_nonws_new,
+                 ks, ke, vs, ve, vstr), None)
+
+    z_pairs = jnp.zeros((R, MAX_PAIRS), _I32)
+    carry0 = (jnp.full(R, _M_PRE, _I32), jnp.zeros(R, _B),
+              jnp.zeros(R, _I32), jnp.zeros(R, _I32),
+              z_pairs, z_pairs, z_pairs, z_pairs,
+              jnp.zeros((R, MAX_PAIRS), _B))
+    js = jnp.arange(L, dtype=_I32)
+    (mode, fb, npairs, _ln, ks, ke, vs, ve, vstr), _ = lax.scan(
+        step, carry0, (js, chars.T))
+
+    # structural completion: mode must be _M_END (or _M_PRE for
+    # non-object rows); unterminated rows are invalid -> null (host
+    # agrees: invalid JSON nulls the row), EXCEPT fb rows (host decides)
+    is_obj = mode == _M_END
+    ok = ~fb
+    return is_obj, ok, npairs, ks, ke, vs, ve, vstr
+
+
+_scan_raw_map_jit = jax.jit(_scan_raw_map)
+
+
+_NUM_W = 26   # validation window: longer primitives fall back
+
+
+def _primitive_token_ok(chars: np.ndarray, vs, ve, pvalid
+                        ) -> np.ndarray:
+    """Per-pair primitive validation: exact true/false/null, or the
+    strict JSON number grammar run as a small unrolled DFA over a
+    fixed window (anything else — NaN, hex, overlong — host decides).
+    Returns (R, MAX_PAIRS) ok mask (True where not a primitive)."""
+    R = chars.shape[0]
+    L = chars.shape[1]
+    tok_len = np.where(pvalid, ve - vs, 0)
+    win_idx = vs[:, :, None] + np.arange(_NUM_W)[None, None, :]
+    win = chars[np.arange(R)[:, None, None],
+                np.minimum(win_idx, L - 1)]
+    inlen = np.arange(_NUM_W)[None, None, :] < tok_len[:, :, None]
+    win = np.where(inlen, win, 0)
+
+    def is_word(w: bytes):
+        m = tok_len == len(w)
+        for i, b in enumerate(w):
+            m = m & (win[:, :, i] == b)
+        return m
+
+    word_ok = is_word(b"true") | is_word(b"false") | is_word(b"null")
+
+    # number DFA states: 0 start, 1 after '-', 2 int digits,
+    # 3 after '.', 4 frac digits, 5 after e, 6 after e-sign,
+    # 7 exp digits, 8 reject
+    state = np.zeros(tok_len.shape, np.int8)
+    for i in range(_NUM_W):
+        c = win[:, :, i]
+        active = inlen[:, :, i]
+        dig = (c >= ord("0")) & (c <= ord("9"))
+        new = np.full_like(state, 8)
+        new = np.where((state == 0) & (c == ord("-")), 1, new)
+        new = np.where(((state == 0) | (state == 1) | (state == 2))
+                       & dig, 2, new)
+        new = np.where((state == 2) & (c == ord(".")), 3, new)
+        new = np.where(((state == 3) | (state == 4)) & dig, 4, new)
+        new = np.where(((state == 2) | (state == 4))
+                       & ((c == ord("e")) | (c == ord("E"))), 5, new)
+        new = np.where((state == 5)
+                       & ((c == ord("+")) | (c == ord("-"))), 6, new)
+        new = np.where(((state == 5) | (state == 6) | (state == 7))
+                       & dig, 7, new)
+        state = np.where(active, new, state)
+    num_ok = ((state == 2) | (state == 4) | (state == 7)) \
+        & (tok_len <= _NUM_W)
+
+    return ~pvalid | word_ok | num_ok
+
+
+def _dup_key_suspects(chars: np.ndarray, ks, ke, npairs) -> np.ndarray:
+    """Rows that MIGHT contain duplicate keys (probe: length + first/
+    last byte); false positives just fall back to host."""
+    R = chars.shape[0]
+    lane = np.arange(MAX_PAIRS)[None, :]
+    valid = lane < npairs[:, None]
+    klen = np.where(valid, ke - ks, -lane)          # unique when empty
+    first = np.where(valid, chars[np.arange(R)[:, None],
+                                  np.minimum(ks, chars.shape[1] - 1)],
+                     0)
+    last = np.where(valid, chars[np.arange(R)[:, None],
+                                 np.minimum(np.maximum(ke - 1, 0),
+                                            chars.shape[1] - 1)], 0)
+    probe = (klen.astype(np.int64) << 32) | \
+        (first.astype(np.int64) << 16) | last.astype(np.int64)
+    srt = np.sort(np.where(valid, probe, lane - 100_000), axis=1)
+    dup = (srt[:, 1:] == srt[:, :-1]).any(axis=1)
+    return dup & (npairs > 1)
+
+
+def _flat_string_build(chars: np.ndarray, starts: np.ndarray,
+                       lens: np.ndarray,
+                       host_patch) -> Column:
+    """STRING column from flat spans (starts encode row*width+col)
+    into the padded matrix (shared builder: columns/strbuild)."""
+    from spark_rapids_tpu.columns.strbuild import build_string_column
+    return build_string_column(chars.reshape(-1), starts, lens,
+                               None, host_patch or None)
+
+
+def from_json_to_raw_map_device(col: Column,
+                                allow_leading_zeros: bool = False
+                                ) -> Optional[Column]:
+    """Device raw-map extraction; None -> caller must run the host
+    path entirely (the router in json_utils handles that)."""
+    if col.length == 0:
+        return None
+
+    from spark_rapids_tpu.ops.json_utils import (_parse_rows,
+                                                 _value_as_raw_string)
+
+    rows = col.length
+    in_valid = (np.ones(rows, bool) if col.validity is None
+                else np.asarray(col.validity).astype(bool)[:rows])
+
+    counts = np.zeros(rows, np.int64)
+    validity = np.zeros(rows, np.uint8)
+    key_parts: List[Column] = []
+    val_parts: List[Column] = []
+
+    for b0 in range(0, rows, DEVICE_ROW_CHUNK):
+        b1 = min(rows, b0 + DEVICE_ROW_CHUNK)
+        R = b1 - b0
+        sub = Column(col.dtype, R, data=col.data, validity=None,
+                     offsets=col.offsets[b0:b1 + 1])
+        chars_j, lens_j = sub.to_padded_chars()
+        is_obj, ok, npairs, ks, ke, vs, ve, vstr = \
+            _scan_raw_map_jit(chars_j, lens_j)
+        chars = np.asarray(chars_j)
+        lens_np = np.asarray(lens_j)
+        is_obj = np.asarray(is_obj)
+        ok = np.asarray(ok)
+        npairs = np.asarray(npairs)
+        ks, ke = np.asarray(ks), np.asarray(ke)
+        vs, ve = np.asarray(vs), np.asarray(ve)
+        vstr = np.asarray(vstr)
+
+        # Spark leading-zero number rule: the scan is agnostic, so
+        # rows with a primitive value '0<digit>...' fall back to the
+        # host parser (which owns the allow_leading_zeros knob)
+        lane = np.arange(MAX_PAIRS)[None, :]
+        pvalid = (lane < npairs[:, None]) & ~vstr
+        rr = np.arange(R)[:, None]
+        c0 = chars[rr, np.minimum(vs, chars.shape[1] - 1)]
+        c1 = chars[rr, np.minimum(vs + 1, chars.shape[1] - 1)]
+        neg = c0 == ord("-")
+        d0 = np.where(neg, c1, c0)
+        lead_zero = pvalid & (d0 == ord("0")) & \
+            ((ve - vs) > (1 + neg.astype(np.int64)))
+        ok = ok & ~lead_zero.any(axis=1)
+        ok = ok & _primitive_token_ok(chars, vs, ve, pvalid).all(axis=1)
+        ok = ok & ~_dup_key_suspects(chars, ks, ke, npairs)
+
+        # host fallback rows: parse once per row, spark semantics
+        host_rows = np.nonzero(in_valid[b0:b1] & ~ok)[0]
+        host_maps = {}
+        if host_rows.size:
+            sub_host = Column.from_strings(
+                [bytes(chars[i, :lens_np[i]]) for i in host_rows])
+            for hi, tree in zip(host_rows,
+                                _parse_rows(sub_host,
+                                            allow_leading_zeros)):
+                if tree is None or tree[0] != "obj":
+                    host_maps[hi] = None
+                    continue
+                seen = {}
+                order = []
+                for k, v in tree[1]:
+                    if k not in seen:
+                        order.append(k)
+                    seen[k] = _value_as_raw_string(v)
+                host_maps[hi] = [(k, seen[k]) for k in order]
+
+        dev_ok = in_valid[b0:b1] & ok & is_obj
+        c_counts = np.where(dev_ok, npairs, 0)
+        for hi, pairs in host_maps.items():
+            if pairs is not None:
+                c_counts[hi] = len(pairs)
+        counts[b0:b1] = c_counts
+        validity[b0:b1] = (dev_ok | np.asarray(
+            [host_maps.get(i) is not None for i in range(R)])) \
+            .astype(np.uint8) if host_maps else dev_ok.astype(np.uint8)
+
+        # flat pair stream (row-major): device spans + host patches
+        lane_valid = (lane < npairs[:, None]) & dev_ok[:, None]
+        pair_base = np.concatenate([[0], np.cumsum(c_counts)])
+        total_pairs = int(pair_base[-1])
+        k_start = np.zeros(total_pairs, np.int64)
+        k_len = np.zeros(total_pairs, np.int64)
+        v_start = np.zeros(total_pairs, np.int64)
+        v_len = np.zeros(total_pairs, np.int64)
+        fp_row, fp_lane = np.nonzero(lane_valid)
+        gidx = pair_base[fp_row] + fp_lane
+        L = chars.shape[1]
+        k_start[gidx] = fp_row * L + ks[fp_row, fp_lane]
+        k_len[gidx] = (ke - ks)[fp_row, fp_lane]
+        v_start[gidx] = fp_row * L + vs[fp_row, fp_lane]
+        v_len[gidx] = (ve - vs)[fp_row, fp_lane]
+        key_patch, val_patch = {}, {}
+        for hi, pairs in host_maps.items():
+            if pairs is None:
+                continue
+            for p, (k, v) in enumerate(pairs):
+                key_patch[int(pair_base[hi]) + p] = k
+                val_patch[int(pair_base[hi]) + p] = v
+
+        key_parts.append(_flat_string_build(chars, k_start, k_len,
+                                            key_patch))
+        val_parts.append(_flat_string_build(chars, v_start, v_len,
+                                            val_patch))
+
+    if len(key_parts) == 1:
+        keys_col, vals_col = key_parts[0], val_parts[0]
+    else:
+        from spark_rapids_tpu.columns.table import Table
+        from spark_rapids_tpu.ops.copying import concat_tables
+        keys_col = concat_tables([Table([p]) for p in key_parts]) \
+            .columns[0]
+        vals_col = concat_tables([Table([p]) for p in val_parts]) \
+            .columns[0]
+
+    offs = np.concatenate([[0], np.cumsum(counts)]).astype(np.int32)
+    st = Column.make_struct(keys_col.length, [keys_col, vals_col])
+    return Column(dtypes.LIST, rows,
+                  validity=None if validity.all() else
+                  jnp.asarray(validity),
+                  offsets=jnp.asarray(offs), children=(st,))

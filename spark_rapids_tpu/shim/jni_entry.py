@@ -44,6 +44,7 @@ def initialize() -> None:
 def shutdown() -> None:
     from spark_rapids_tpu.shim.handles import REGISTRY
     REGISTRY.clear()
+    _HOST_TABLES.clear()   # spilled buffers are handles too
 
 
 def live_handles() -> int:
@@ -81,6 +82,11 @@ def from_strings(values: Sequence[Optional[str]]) -> int:
 def free(handle: int) -> None:
     from spark_rapids_tpu.shim import jni_api
     jni_api.release_column(handle)
+
+
+def column_to_host(handle: int):
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.column_to_host(handle)
 
 
 # ----------------------------------------------------------------- ops
@@ -141,6 +147,159 @@ def random_uuids(rows: int, seed: int) -> int:
     from spark_rapids_tpu.ops.string_utils import random_uuids as ru
     from spark_rapids_tpu.shim.handles import REGISTRY
     return REGISTRY.register(ru(rows, seed))
+
+
+def parse_uri(handle: int, what: str, ansi: bool) -> int:
+    """ParseURI.java surface: what in protocol|host|query|path."""
+    from spark_rapids_tpu.ops import parse_uri as PU
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    fn = {"protocol": PU.parse_uri_to_protocol,
+          "host": PU.parse_uri_to_host,
+          "query": PU.parse_uri_to_query,
+          "path": PU.parse_uri_to_path}[what]
+    return REGISTRY.register(fn(REGISTRY.get(handle), ansi))
+
+
+def parse_uri_query_with_key(handle: int, key: str, ansi: bool) -> int:
+    from spark_rapids_tpu.ops import parse_uri as PU
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(PU.parse_uri_to_query_with_key(
+        REGISTRY.get(handle), key, ansi))
+
+
+def substring_index(handle: int, delim: str, count: int) -> int:
+    from spark_rapids_tpu.ops.substring_index import substring_index as si
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(si(REGISTRY.get(handle), delim, count))
+
+
+def charset_decode_to_utf8(handle: int, charset: str,
+                           on_error: str) -> int:
+    from spark_rapids_tpu.ops.strings_misc import decode_to_utf8
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(
+        decode_to_utf8(REGISTRY.get(handle), charset, on_error))
+
+
+def interleave_bits(handles: Sequence[int]) -> int:
+    from spark_rapids_tpu.ops.zorder import interleave_bits as ib
+    from spark_rapids_tpu.shim import jni_api
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(ib(jni_api._cols(handles)))
+
+
+def hilbert_index(num_bits: int, handles: Sequence[int]) -> int:
+    from spark_rapids_tpu.ops.zorder import hilbert_index as hi
+    from spark_rapids_tpu.shim import jni_api
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(hi(num_bits, jni_api._cols(handles)))
+
+
+def select_first_true_index(handles: Sequence[int]) -> int:
+    from spark_rapids_tpu.ops.case_when import select_first_true_index
+    from spark_rapids_tpu.shim import jni_api
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(
+        select_first_true_index(jni_api._cols(handles)))
+
+
+def number_converter_convert(handle: int, from_base: int,
+                             to_base: int) -> int:
+    from spark_rapids_tpu.ops.strings_misc import convert
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(
+        convert(REGISTRY.get(handle), from_base, to_base))
+
+
+def datetime_truncate(handle: int, component: str) -> int:
+    from spark_rapids_tpu.ops.datetime_ops import truncate
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(truncate(REGISTRY.get(handle), component))
+
+
+def datetime_rebase(handle: int, to_julian: bool) -> int:
+    from spark_rapids_tpu.ops import datetime_ops as DT
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    fn = (DT.rebase_gregorian_to_julian if to_julian
+          else DT.rebase_julian_to_gregorian)
+    return REGISTRY.register(fn(REGISTRY.get(handle)))
+
+
+# --------------------------------------------------------- HostTable
+
+
+_HOST_TABLES = {}
+_HOST_TABLE_NEXT = [1]
+
+
+def host_table_from_table(handles: Sequence[int]) -> int:
+    """HostTable.fromTableAsync (HostTable.java:46): copy a device
+    table into one contiguous host buffer; returns a host-table
+    handle."""
+    from spark_rapids_tpu.columns.table import Table
+    from spark_rapids_tpu.memory.host_table import HostTable
+    from spark_rapids_tpu.shim import jni_api
+    ht = HostTable.from_table(Table(jni_api._cols(handles)))
+    h = _HOST_TABLE_NEXT[0]
+    _HOST_TABLE_NEXT[0] += 1
+    _HOST_TABLES[h] = ht
+    return h
+
+
+def host_table_size_bytes(handle: int) -> int:
+    return _HOST_TABLES[handle].size_bytes
+
+
+def host_table_to_device(handle: int) -> List[int]:
+    """HostTable.toDeviceColumnViews: upload back; returns column
+    handles."""
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    table = _HOST_TABLES[handle].to_table()
+    return [REGISTRY.register(c) for c in table.columns]
+
+
+def host_table_free(handle: int) -> None:
+    _HOST_TABLES.pop(handle, None)
+
+
+# ----------------------------------------------------- kudo over JNI
+
+
+def kudo_write(handles: Sequence[int], row_offset: int,
+               num_rows: int) -> bytes:
+    """KudoSerializer.writeToStreamWithMetrics: serialize a row slice
+    of a table to one kudo block (bytes cross the JNI boundary as
+    jbyteArray)."""
+    import io
+
+    from spark_rapids_tpu.shim import jni_api
+    from spark_rapids_tpu.shuffle import kudo
+    out = io.BytesIO()
+    kudo.write_to_stream(jni_api._cols(handles), out, row_offset,
+                         num_rows)
+    return out.getvalue()
+
+
+def kudo_merge(blob: bytes, type_ids: Sequence[str],
+               scales: Sequence[int]) -> List[int]:
+    """KudoSerializer.mergeToTable over a concatenated stream of kudo
+    blocks (flat schemas; the Python API handles nested)."""
+    import io
+
+    from spark_rapids_tpu.columns.dtypes import DType
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    from spark_rapids_tpu.shuffle import kudo
+    from spark_rapids_tpu.shuffle.schema import Field
+    stream = io.BytesIO(bytes(blob))
+    kts = []
+    while True:
+        kt = kudo.read_one_table(stream)
+        if kt is None:
+            break
+        kts.append(kt)
+    fields = [Field(DType(k, s)) for k, s in zip(type_ids, scales)]
+    table = kudo.merge_to_table(kts, fields)
+    return [REGISTRY.register(c) for c in table.columns]
 
 
 # ---------------------------------------------------------- RmmSpark

@@ -1,0 +1,106 @@
+"""Device raw-map scan vs the host tree-builder oracle
+(json_utils.from_json_to_raw_map host path) — differential over curated
+documents and fuzz (reference from_json_to_raw_map.cu coverage)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.ops import json_utils as JU
+from spark_rapids_tpu.ops import raw_map_device as RM
+
+DOCS = [
+    '{"a": 1, "b": "x"}',
+    '{}',
+    '{"k": true, "l": false, "m": null}',
+    '{"n": -1.5e3, "o": 0, "p": 0.25}',
+    '{"s": "with space", "t": ""}',
+    '{ "ws" : 7 , "x" : "y" }',
+    '{"nested": {"a": 1}}',              # nested: host fallback
+    '{"arr": [1, 2]}',                   # nested: host fallback
+    '{"esc": "a\\nb"}',                  # escape: host fallback
+    '{"dup": 1, "dup": 2}',              # dup: host (last wins)
+    '{"a": 007}',                        # leading zeros: invalid
+    '{"a": NaN}',                        # weird token: host decides
+    '[1, 2]',                            # non-object: null
+    '"str"',                             # non-object: null
+    'not json',                          # invalid: null
+    '',                                  # empty: null
+    None,                                # null row
+    '{"a": 1',                           # truncated: null
+    '{"a": 1} trailing',                 # trailing garbage
+    '{"many": 1, "keys": 2, "here": 3, "now": 4}',
+    "{'sq': 1}",                         # single quotes: host decides
+    '{"unicode": "café"}',          # non-ascii value
+]
+
+
+def _differential(docs):
+    col = Column.from_strings(docs)
+    dev = RM.from_json_to_raw_map_device(col)
+    assert dev is not None
+    # host path: force the router away from the device engine
+    import os
+    old = os.environ.get("SPARK_RAPIDS_TPU_RAW_MAP_DEVICE_MIN")
+    os.environ["SPARK_RAPIDS_TPU_RAW_MAP_DEVICE_MIN"] = "999999999"
+    try:
+        host = JU.from_json_to_raw_map(col)
+    finally:
+        if old is None:
+            del os.environ["SPARK_RAPIDS_TPU_RAW_MAP_DEVICE_MIN"]
+        else:
+            os.environ["SPARK_RAPIDS_TPU_RAW_MAP_DEVICE_MIN"] = old
+    h, d = host.to_pylist(), dev.to_pylist()
+    for i, (hr, dr) in enumerate(zip(h, d)):
+        assert hr == dr, (f"row {i} ({docs[i]!r}):\n  host={hr!r}\n"
+                          f"  dev ={dr!r}")
+
+
+def test_curated_differential():
+    _differential(DOCS)
+
+
+def test_router_uses_device(monkeypatch):
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_FORCE_DEVICE_RAW_MAP", "1")
+    col = Column.from_strings(['{"a": 1}'] * 3)
+    out = JU.from_json_to_raw_map(col)
+    assert out.to_pylist() == [[("a", "1")]] * 3
+
+
+def test_many_pairs_overflow_falls_back():
+    n = RM.MAX_PAIRS + 4
+    doc = "{" + ", ".join('"k%d": %d' % (i, i) for i in range(n)) + "}"
+    _differential([doc])
+
+
+def test_fuzz_differential():
+    rng = np.random.default_rng(31)
+    keys = ["a", "bb", "ccc", "d_d", "e-e", "f f"]
+    docs = []
+    for _ in range(400):
+        n = int(rng.integers(0, 6))
+        parts = []
+        for _k in range(n):
+            k = keys[rng.integers(len(keys))]
+            r = rng.random()
+            if r < 0.3:
+                v = str(rng.integers(-10**6, 10**6))
+            elif r < 0.5:
+                v = "%.4g" % rng.normal()
+            elif r < 0.65:
+                v = '"s%d"' % rng.integers(50)
+            elif r < 0.75:
+                v = ["true", "false", "null"][rng.integers(3)]
+            elif r < 0.85:
+                v = '{"in": 1}'
+            else:
+                v = "[3]"
+            parts.append('"%s": %s' % (k, v))
+        doc = "{" + ", ".join(parts) + "}"
+        r = rng.random()
+        if r < 0.07 and doc != "{}":
+            doc = doc[:-1]
+        elif r < 0.1:
+            doc = doc + "x"
+        docs.append(doc)
+    _differential(docs)
