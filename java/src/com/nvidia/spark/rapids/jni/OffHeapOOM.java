@@ -1,0 +1,13 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * OOM-taxonomy exception (reference: the typed unchecked exceptions
+ * thrown from native by class lookup, SparkResourceAdaptorJni.cpp:49-54;
+ * here thrown by the JNI shim when the runtime's state machine raises
+ * the Python exception of the same name).
+ */
+public class OffHeapOOM extends RuntimeException {
+  public OffHeapOOM(String message) {
+    super(message);
+  }
+}

@@ -1,0 +1,47 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * The OOM taxonomy across JNI (source mirror of the bytecode emitted
+ * by scripts/gen_java_classes.py at class-file major 49 — see
+ * java/README.md).  Reference counterpart: RmmSparkTest's forced-OOM
+ * flows (testBasicBUFN:1002) where the JVM catches GpuRetryOOM /
+ * GpuSplitAndRetryOOM thrown by the native state machine.
+ */
+public final class OomSmokeTest {
+  private OomSmokeTest() {}
+
+  public static void main(String[] args) {
+    System.load(args[0]);
+    TpuRuntime.initialize();
+    RmmSpark.setEventHandler(1 << 20);
+    RmmSpark.currentThreadIsDedicatedToTask(1);
+    long tid = RmmSpark.getCurrentThreadId();
+
+    RmmSpark.forceRetryOOM(tid, 1);
+    try {
+      RmmSpark.alloc(64);
+      TestSupport.assertTrue(0, "expected GpuRetryOOM was not thrown");
+    } catch (GpuRetryOOM e) {
+      System.out.println("caught GpuRetryOOM across JNI");
+    }
+    RmmSpark.blockThreadUntilReady();
+    RmmSpark.alloc(64);
+    RmmSpark.dealloc(64);
+
+    RmmSpark.forceSplitAndRetryOOM(tid, 1);
+    try {
+      RmmSpark.alloc(64);
+      TestSupport.assertTrue(0,
+          "expected GpuSplitAndRetryOOM was not thrown");
+    } catch (GpuSplitAndRetryOOM e) {
+      System.out.println("caught GpuSplitAndRetryOOM across JNI");
+    }
+    RmmSpark.blockThreadUntilReady();
+    RmmSpark.alloc(64);
+    RmmSpark.dealloc(64);
+
+    RmmSpark.taskDone(1);
+    RmmSpark.clearEventHandler();
+    System.out.println("OOM smoke: ALL OK");
+  }
+}

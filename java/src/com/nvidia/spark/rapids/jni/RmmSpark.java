@@ -35,6 +35,12 @@ public final class RmmSpark {
   public static native void startDedicatedTaskThread(long threadId,
                                                      long taskId);
 
+  /** Register the CALLING thread for a task (the common plugin path). */
+  public static native void currentThreadIsDedicatedToTask(long taskId);
+
+  /** Runtime-side id of the calling thread (stable per OS thread). */
+  public static native long getCurrentThreadId();
+
   /** Task finished: release threads, wake BUFN waiters (reference :416). */
   public static native void taskDone(long taskId);
 
@@ -44,6 +50,25 @@ public final class RmmSpark {
    * SparkResourceAdaptorJni.cpp:955).
    */
   public static native void forceRetryOOM(long threadId, int numOOMs);
+
+  /** Force GpuSplitAndRetryOOM on the thread's next allocation. */
+  public static native void forceSplitAndRetryOOM(long threadId,
+                                                  int numOOMs);
+
+  /**
+   * Park after catching a retry OOM until the machine frees capacity
+   * (reference RmmSpark.blockThreadUntilReady:513); the retry follows.
+   */
+  public static native void blockThreadUntilReady();
+
+  /**
+   * Device-allocation notification; forced OOMs fire here and cross
+   * JNI as {@link GpuRetryOOM} / {@link GpuSplitAndRetryOOM} — catch
+   * them exactly as with the reference (OomSmokeTest drives this).
+   */
+  public static native void alloc(long bytes);
+
+  public static native void dealloc(long bytes);
 
   /** Thread-state name for assertions (reference RmmSparkThreadState). */
   public static native String getStateOf(long threadId);

@@ -197,8 +197,41 @@ PyObject* strings_to_pylist(JNIEnv* env, jobjectArray arr) {
   return list;
 }
 
+// The reference's OOM taxonomy crosses JNI as typed unchecked
+// exceptions looked up by name (SparkResourceAdaptorJni.cpp:49-54);
+// the runtime's Python exceptions carry the same class names, so the
+// shim re-throws any "<TypeName>: msg" whose class exists under the
+// package — no hardcoded list to drift from the Python taxonomy
+// (unknown/unloadable names fall back to RuntimeException).
+void throw_java_typed(JNIEnv* env, const std::string& formatted) {
+  // pending_python_error formats as "TypeName: message"
+  size_t colon = formatted.find(": ");
+  if (colon != std::string::npos && colon > 0) {
+    std::string tname = formatted.substr(0, colon);
+    bool ident = true;
+    for (char ch : tname) {
+      if (!((ch >= 'A' && ch <= 'Z') || (ch >= 'a' && ch <= 'z') ||
+            (ch >= '0' && ch <= '9'))) {
+        ident = false;
+        break;
+      }
+    }
+    if (ident) {
+      std::string cls =
+          std::string("com/nvidia/spark/rapids/jni/") + tname;
+      jclass jc = env->FindClass(cls.c_str());
+      if (jc != nullptr) {
+        env->ThrowNew(jc, formatted.c_str() + colon + 2);
+        return;
+      }
+      env->ExceptionClear();  // no such class: plain RuntimeException
+    }
+  }
+  throw_java(env, formatted.c_str());
+}
+
 // Call g_entry.<fn>(*args); steals `args` (a tuple).  On Python error:
-// clears it, throws Java RuntimeException, returns nullptr.
+// clears it, throws the mapped Java exception, returns nullptr.
 PyObject* call_entry(JNIEnv* env, const char* fn, PyObject* args) {
   PyObject* f = PyObject_GetAttrString(g_entry, fn);
   if (f == nullptr) {
@@ -211,7 +244,7 @@ PyObject* call_entry(JNIEnv* env, const char* fn, PyObject* args) {
   Py_DECREF(args);
   if (r == nullptr) {
     std::string msg = pending_python_error();
-    throw_java(env, msg.c_str());
+    throw_java_typed(env, msg);
     return nullptr;
   }
   return r;
@@ -738,6 +771,57 @@ jstring JNI_FN(RmmSpark, getStateOf)(JNIEnv* env, jclass, jlong tid) {
   return as_jstring(env,
                     call_entry(env, "rmm_get_state_of",
                                Py_BuildValue("(L)", (long long)tid)));
+}
+
+jlong JNI_FN(RmmSpark, getCurrentThreadId)(JNIEnv* env, jclass) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  return as_jlong(env, call_entry(env, "rmm_current_thread_id",
+                                  PyTuple_New(0)));
+}
+
+void JNI_FN(RmmSpark, currentThreadIsDedicatedToTask)(JNIEnv* env,
+                                                      jclass,
+                                                      jlong task) {
+  if (!ensure_runtime(env)) return;
+  Gil gil;
+  PyObject* r = call_entry(env, "rmm_register_current_thread",
+                           Py_BuildValue("(L)", (long long)task));
+  Py_XDECREF(r);
+}
+
+void JNI_FN(RmmSpark, forceSplitAndRetryOOM)(JNIEnv* env, jclass,
+                                             jlong tid, jint n) {
+  if (!ensure_runtime(env)) return;
+  Gil gil;
+  PyObject* r = call_entry(
+      env, "rmm_force_split_and_retry_oom",
+      Py_BuildValue("(Li)", (long long)tid, (int)n));
+  Py_XDECREF(r);
+}
+
+void JNI_FN(RmmSpark, blockThreadUntilReady)(JNIEnv* env, jclass) {
+  if (!ensure_runtime(env)) return;
+  Gil gil;
+  PyObject* r = call_entry(env, "rmm_block_thread_until_ready",
+                           PyTuple_New(0));
+  Py_XDECREF(r);
+}
+
+void JNI_FN(RmmSpark, alloc)(JNIEnv* env, jclass, jlong nbytes) {
+  if (!ensure_runtime(env)) return;
+  Gil gil;
+  PyObject* r = call_entry(env, "rmm_alloc",
+                           Py_BuildValue("(L)", (long long)nbytes));
+  Py_XDECREF(r);
+}
+
+void JNI_FN(RmmSpark, dealloc)(JNIEnv* env, jclass, jlong nbytes) {
+  if (!ensure_runtime(env)) return;
+  Gil gil;
+  PyObject* r = call_entry(env, "rmm_dealloc",
+                           Py_BuildValue("(L)", (long long)nbytes));
+  Py_XDECREF(r);
 }
 
 // -------------------------------------------------------- TestSupport

@@ -25,9 +25,28 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-from jasm import ClassFile, Code  # noqa: E402
+from jasm import ACC_PUBLIC, ClassFile, Code, Label  # noqa: E402
 
 PKG = "com/nvidia/spark/rapids/jni"
+
+# OOM taxonomy (reference: typed unchecked exceptions looked up by
+# name from native, SparkResourceAdaptorJni.cpp:49-54).  Derived from
+# the runtime's exception module so the Java classes can't drift from
+# the Python names the shim maps by (bases excluded — only concrete
+# thrown types cross JNI).
+def _exception_classes():
+    import inspect
+
+    from spark_rapids_tpu.memory import exceptions as exc
+    out = []
+    for name, obj in vars(exc).items():
+        if (inspect.isclass(obj) and issubclass(obj, Exception)
+                and not name.endswith("Base")):
+            out.append(name)
+    return sorted(out)
+
+
+EXCEPTION_CLASSES = _exception_classes()
 
 # (class, [(method, descriptor)...]) — all public static native
 NATIVE_CLASSES = {
@@ -64,8 +83,14 @@ NATIVE_CLASSES = {
         ("setEventHandler", "(J)V"),
         ("clearEventHandler", "()V"),
         ("startDedicatedTaskThread", "(JJ)V"),
+        ("currentThreadIsDedicatedToTask", "(J)V"),
+        ("getCurrentThreadId", "()J"),
         ("taskDone", "(J)V"),
         ("forceRetryOOM", "(JI)V"),
+        ("forceSplitAndRetryOOM", "(JI)V"),
+        ("blockThreadUntilReady", "()V"),
+        ("alloc", "(J)V"),
+        ("dealloc", "(J)V"),
         ("getStateOf", "(J)Ljava/lang/String;"),
     ],
     "StringUtils": [
@@ -151,6 +176,94 @@ def build_natives(outdir: str):
         os.makedirs(os.path.dirname(path), exist_ok=True)
         with open(path, "wb") as f:
             f.write(cf.serialize())
+
+
+def build_exceptions(outdir: str):
+    """Typed OOM exceptions: public <init>(String) chaining to
+    RuntimeException, thrown from the shim by Python type name."""
+    for name in EXCEPTION_CLASSES:
+        cf = ClassFile(f"{PKG}/{name}",
+                       super_name="java/lang/RuntimeException")
+        c = Code(cf.cp, max_locals=2)
+        c.aload(0)
+        c.aload(1)
+        c.invokespecial("java/lang/RuntimeException", "<init>",
+                        "(Ljava/lang/String;)V")
+        c.return_void()
+        cf.add_code_method("<init>", "(Ljava/lang/String;)V", c,
+                           flags=ACC_PUBLIC)
+        path = os.path.join(outdir, PKG, name + ".class")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(cf.serialize())
+
+
+def build_oom_smoke_test(outdir: str):
+    """OomSmokeTest: a REAL JVM catch of the typed OOM exceptions the
+    runtime's state machine throws across JNI (reference
+    RmmSparkTest.testBasicBUFN-style forced-OOM flow).  Emitted at
+    class-file major 49 so try/catch needs no StackMapTable."""
+    J = f"{PKG}/"
+    cf = ClassFile(f"{PKG}/OomSmokeTest", major=49)
+    c = Code(cf.cp, max_locals=8)
+
+    c.aload(0)
+    c.iconst(0)
+    c.aaload()
+    c.invokestatic("java/lang/System", "load", "(Ljava/lang/String;)V")
+    c.invokestatic(J + "TpuRuntime", "initialize", "()V")
+    c.lconst(1 << 20)
+    c.invokestatic(J + "RmmSpark", "setEventHandler", "(J)V")
+    c.lconst(1)
+    c.invokestatic(J + "RmmSpark", "currentThreadIsDedicatedToTask",
+                   "(J)V")
+    TID = 2
+    c.invokestatic(J + "RmmSpark", "getCurrentThreadId", "()J")
+    c.lstore(TID)
+
+    def forced_oom_block(force_method, exc_cls, msg):
+        c.lload(TID)
+        c.iconst(1)
+        c.invokestatic(J + "RmmSpark", force_method, "(JI)V")
+        t_start, t_end, handler, after = (Label(), Label(), Label(),
+                                          Label())
+        c.place(t_start)
+        c.lconst(64)
+        c.invokestatic(J + "RmmSpark", "alloc", "(J)V")
+        c.iconst(0)
+        c.ldc_string("expected " + exc_cls + " was not thrown")
+        c.invokestatic(J + "TestSupport", "assertTrue",
+                       "(ILjava/lang/String;)V")
+        c.place(t_end)
+        c.goto(after)
+        c.place(handler)
+        c.handler_entry()
+        c.astore(4)
+        c.println(msg)
+        c.place(after)
+        c.try_catch(t_start, t_end, handler, J + exc_cls)
+        # retry contract: park until ready, then the retry succeeds
+        c.invokestatic(J + "RmmSpark", "blockThreadUntilReady", "()V")
+        c.lconst(64)
+        c.invokestatic(J + "RmmSpark", "alloc", "(J)V")
+        c.lconst(64)
+        c.invokestatic(J + "RmmSpark", "dealloc", "(J)V")
+
+    forced_oom_block("forceRetryOOM", "GpuRetryOOM",
+                     "caught GpuRetryOOM across JNI")
+    forced_oom_block("forceSplitAndRetryOOM", "GpuSplitAndRetryOOM",
+                     "caught GpuSplitAndRetryOOM across JNI")
+
+    c.lconst(1)
+    c.invokestatic(J + "RmmSpark", "taskDone", "(J)V")
+    c.invokestatic(J + "RmmSpark", "clearEventHandler", "()V")
+    c.println("OOM smoke: ALL OK")
+    c.return_void()
+    cf.add_code_method("main", "([Ljava/lang/String;)V", c)
+
+    path = os.path.join(outdir, PKG, "OomSmokeTest.class")
+    with open(path, "wb") as f:
+        f.write(cf.serialize())
 
 
 def build_smoke_test(outdir: str, xx_gold):
@@ -364,7 +477,9 @@ def main():
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "java", "classes")
     build_natives(outdir)
+    build_exceptions(outdir)
     build_smoke_test(outdir, _computed_goldens())
+    build_oom_smoke_test(outdir)
     print(f"emitted classes under {outdir}")
 
 

@@ -91,8 +91,17 @@ class ConstPool:
         return b"".join(out)
 
 
+class Label:
+    def __init__(self):
+        self.pos = None
+
+
 class Code:
-    """Straight-line bytecode builder (no branches by design)."""
+    """Bytecode builder.  Mostly straight-line (native-side asserts keep
+    StackMapTable out of major-52 classes); classes emitted at major 49
+    (old inference verifier) may additionally use labels, goto, and
+    exception tables — the OOM-taxonomy smoke test catches real Java
+    exception types that way."""
 
     def __init__(self, cp: ConstPool, max_locals: int):
         self.cp = cp
@@ -100,6 +109,36 @@ class Code:
         self.max_locals = max_locals
         self.max_stack = 0
         self._stack = 0
+        self._fixups = []          # (pos_of_offset, opcode_pos, label)
+        self.exceptions = []       # (start, end, handler, class|None)
+
+    # ---- labels / branches (major-49 classes only) -----------------
+    def place(self, label: Label):
+        label.pos = len(self.b)
+
+    def _branch(self, op: int, label: Label):
+        pos = len(self.b)
+        self.b += struct.pack(">Bh", op, 0)
+        self._fixups.append((pos + 1, pos, label))
+
+    def goto(self, label: Label):
+        self._branch(0xA7, label)
+
+    def handler_entry(self):
+        """Stack at a catch-handler entry holds the exception ref."""
+        self._stack = 1
+        self.max_stack = max(self.max_stack, 1)
+
+    def try_catch(self, start: Label, end: Label, handler: Label,
+                  cls: str):
+        self.exceptions.append((start, end, handler, cls))
+
+    def finalize(self) -> bytes:
+        for off_pos, op_pos, label in self._fixups:
+            assert label.pos is not None, "unplaced label"
+            rel = label.pos - op_pos
+            self.b[off_pos:off_pos + 2] = struct.pack(">h", rel)
+        return bytes(self.b)
 
     def _push(self, n=1):
         self._stack += n
@@ -282,6 +321,13 @@ class Code:
         self.b += struct.pack(">BH", 0xB6,
                               self.cp.methodref(cls, name, desc))
 
+    def invokespecial(self, cls: str, name: str, desc: str):
+        a, r = self._desc_slots(desc)
+        self._pop(a + 1)
+        self._push(r) if r else None
+        self.b += struct.pack(">BH", 0xB7,
+                              self.cp.methodref(cls, name, desc))
+
     def getstatic(self, cls: str, name: str, desc: str):
         self._push(2 if desc in "JD" else 1)
         self.b += struct.pack(">BH", 0xB2,
@@ -322,9 +368,15 @@ class ClassFile:
     def add_code_method(self, name: str, desc: str, code: Code,
                         flags=ACC_PUBLIC | ACC_STATIC):
         attr_name = self.cp.utf8("Code")
+        codeb = code.finalize()
+        etab = struct.pack(">H", len(code.exceptions))
+        for start, end, handler, cls in code.exceptions:
+            etab += struct.pack(
+                ">HHHH", start.pos, end.pos, handler.pos,
+                0 if cls is None else self.cp.cls(cls))
         body = (struct.pack(">HHI", code.max_stack + 2, code.max_locals,
-                            len(code.b)) + bytes(code.b) +
-                struct.pack(">HH", 0, 0))
+                            len(codeb)) + codeb + etab +
+                struct.pack(">H", 0))
         attr = struct.pack(">HI", attr_name, len(body)) + body
         self.methods.append((flags, self.cp.utf8(name),
                              self.cp.utf8(desc), attr))

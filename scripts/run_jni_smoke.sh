@@ -42,6 +42,12 @@ python scripts/gen_java_classes.py java/classes
 export JAX_PLATFORMS=cpu
 export SPARK_RAPIDS_TPU_PLATFORM=cpu
 export SPARK_RAPIDS_TPU_ROOT="$REPO"
-exec "$JAVA_BIN" -cp "$REPO/java/classes" \
+"$JAVA_BIN" -cp "$REPO/java/classes" \
     com.nvidia.spark.rapids.jni.JniSmokeTest \
+    "$REPO/native/jni/libspark_rapids_tpu_jni.so"
+# typed OOM exceptions across JNI (GpuRetryOOM / GpuSplitAndRetryOOM
+# caught by real JVM catch blocks; class file major 49 for try/catch
+# without StackMapTable)
+exec "$JAVA_BIN" -cp "$REPO/java/classes" \
+    com.nvidia.spark.rapids.jni.OomSmokeTest \
     "$REPO/native/jni/libspark_rapids_tpu_jni.so"

@@ -335,6 +335,44 @@ def rmm_get_state_of(thread_id: int) -> str:
     return rmm_spark.get_state_of(thread_id)
 
 
+def rmm_current_thread_id() -> int:
+    """The calling JVM thread's runtime-side id (stable per OS thread:
+    PyGILState attaches the same interpreter thread state)."""
+    from spark_rapids_tpu.memory import rmm_spark
+    return rmm_spark.current_thread_id()
+
+
+def rmm_register_current_thread(task_id: int) -> None:
+    from spark_rapids_tpu.memory import rmm_spark
+    # start_dedicated_task_thread validates the adaptor BEFORE adding
+    # to ThreadStateRegistry (a failed start must not leave a stale id)
+    rmm_spark.start_dedicated_task_thread(
+        rmm_spark.current_thread_id(), task_id)
+
+
+def rmm_force_split_and_retry_oom(thread_id: int, num_ooms: int) -> None:
+    from spark_rapids_tpu.memory import rmm_spark
+    rmm_spark.force_split_and_retry_oom(thread_id, num_ooms)
+
+
+def rmm_block_thread_until_ready() -> None:
+    from spark_rapids_tpu.memory import rmm_spark
+    rmm_spark.block_thread_until_ready()
+
+
+def rmm_alloc(nbytes: int) -> None:
+    """Device-allocation notification for the calling thread; forced
+    OOMs (forceRetryOOM / forceSplitAndRetryOOM) fire here and cross
+    JNI as the matching typed Java exceptions."""
+    from spark_rapids_tpu.memory import rmm_spark
+    rmm_spark.get_adaptor().allocate(nbytes)
+
+
+def rmm_dealloc(nbytes: int) -> None:
+    from spark_rapids_tpu.memory import rmm_spark
+    rmm_spark.get_adaptor().deallocate(nbytes)
+
+
 # ------------------------------------------------------- test support
 # (comparison happens Python-side so the emitted JVM test bytecode can
 # stay straight-line: a native assert throws on failure)
