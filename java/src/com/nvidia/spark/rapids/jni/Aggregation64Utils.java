@@ -1,0 +1,21 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Overflow-safe 64-bit SUM (reference Aggregation64Utils.java over
+ * aggregation64_utils.cu; TPU engine:
+ * spark_rapids_tpu/ops/aggregation64.py — split into 32-bit chunks,
+ * sum, reassemble with overflow detection).
+ */
+public final class Aggregation64Utils {
+  private Aggregation64Utils() {}
+
+  /** chunk 0 = low 32 bits (unsigned), chunk 1 = high (signed). */
+  public static native long extractChunk32From64bit(long column,
+                                                    String typeId,
+                                                    int chunk);
+
+  /** Returns {overflowFlags (BOOL8), values} column handles. */
+  public static native long[] assemble64FromSum(long lowSums,
+                                                long highSums,
+                                                String typeId);
+}

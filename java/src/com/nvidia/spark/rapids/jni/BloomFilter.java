@@ -1,0 +1,27 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Spark BloomFilter sketch (reference BloomFilter.java over
+ * bloom_filter.cu — versioned v1/v2 serialized headers, xxhash64
+ * probes; TPU engine: spark_rapids_tpu/ops/bloom_filter.py,
+ * byte-compatible with Spark's serialized form).
+ */
+public final class BloomFilter {
+  private BloomFilter() {}
+
+  public static native long create(int numHashes, int numLongs,
+                                   int version);
+
+  /** Returns a NEW filter handle with the column's values added. */
+  public static native long put(long bloomFilter, long column);
+
+  /** BOOL8 column: might-contain per row. */
+  public static native long probe(long bloomFilter, long column);
+
+  public static native long merge(long[] bloomFilters);
+
+  /** Spark-compatible serialized form (versioned header). */
+  public static native byte[] serialize(long bloomFilter);
+
+  public static native long deserialize(byte[] data);
+}

@@ -1,0 +1,20 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Timezone conversion (reference GpuTimeZoneDB.java:103-606 — a device
+ * transition table built from JVM ZoneRules — over timezones.cu; TPU
+ * runtime: spark_rapids_tpu/utils/tzdb.py builds the transition table
+ * from TZif files with java.time gap/overlap semantics, and
+ * ops/datetime_ops.py runs the binary-search conversion).
+ */
+public final class GpuTimeZoneDB {
+  private GpuTimeZoneDB() {}
+
+  /** Local timestamps (micros) in zoneId -> UTC. */
+  public static native long convertTimestampToUTC(long column,
+                                                  String zoneId);
+
+  /** UTC timestamps (micros) -> local time in zoneId. */
+  public static native long convertUTCTimestampToTimeZone(long column,
+                                                          String zoneId);
+}

@@ -89,6 +89,25 @@ public final class JniSmokeTest {
     HostTable.free(spilled);
     System.out.println("host table spill ok");
 
+    long rightKeys = TpuColumns.fromLongs(new long[] {2, 3, 4});
+    long[] jp = JoinPrimitives.sortMergeInnerJoin(
+        new long[] {longs}, new long[] {rightKeys}, true);
+    TestSupport.assertTrue(
+        TestSupport.checkIntColumn(jp[0], new int[] {1, 2}),
+        "JoinPrimitives left indices");
+    TestSupport.assertTrue(
+        TestSupport.checkIntColumn(jp[1], new int[] {0, 1}),
+        "JoinPrimitives right indices");
+    System.out.println("join primitives ok");
+
+    long bf = BloomFilter.create(3, 4, 2);
+    long bf2 = BloomFilter.put(bf, longs);
+    long probed = BloomFilter.probe(bf2, longs);
+    TestSupport.assertTrue(
+        TestSupport.checkIntColumn(probed, new int[] {1, 1, 1}),
+        "BloomFilter probe: inserted keys all hit");
+    System.out.println("bloom filter ok");
+
     long uuids = StringUtils.randomUUIDs(4, 1);
     System.out.println("randomUUIDs ok");
 
@@ -100,7 +119,8 @@ public final class JniSmokeTest {
 
     for (long h : new long[] {strs, murmur, longs, xx, rows, back[0],
                               nums, ints, json, jout, uuids, uris,
-                              hosts, merged[0], restored[0]}) {
+                              hosts, merged[0], restored[0], rightKeys,
+                              jp[0], jp[1], bf, bf2, probed}) {
       TpuColumns.free(h);
     }
     TpuRuntime.shutdown();

@@ -1,0 +1,18 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Join building blocks (reference JoinPrimitives.java over
+ * join_primitives.cu; TPU engine: spark_rapids_tpu/ops/joins.py —
+ * sort-based design with device lexsort paths on accelerators).
+ */
+public final class JoinPrimitives {
+  private JoinPrimitives() {}
+
+  /**
+   * Inner-join gather maps: returns {leftIndices, rightIndices}
+   * (INT32 column handles), pairs grouped by key.
+   */
+  public static native long[] sortMergeInnerJoin(long[] leftKeys,
+                                                 long[] rightKeys,
+                                                 boolean nullsEqual);
+}

@@ -1,0 +1,19 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Regex fast paths (reference RegexRewriteUtils.java over
+ * regex_rewrite_utils.cu; TPU engine:
+ * spark_rapids_tpu/ops/strings_misc.literal_range_pattern).
+ */
+public final class RegexRewriteUtils {
+  private RegexRewriteUtils() {}
+
+  /**
+   * BOOL8: row contains `literal` followed by rangeLen codepoints in
+   * [start, end] — the 'lit[a-b]{n}' trivial-regex fast path.
+   */
+  public static native long literalRangePattern(long column,
+                                                String literal,
+                                                int rangeLen, int start,
+                                                int end);
+}

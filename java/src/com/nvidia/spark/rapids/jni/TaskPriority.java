@@ -1,0 +1,15 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Global task priority for deadlock victim selection (reference
+ * TaskPriority.java:33 over task_priority.hpp; TPU runtime:
+ * spark_rapids_tpu/memory/task_priority.py — lower attempt ids win,
+ * shuffle threads outrank all tasks).
+ */
+public final class TaskPriority {
+  private TaskPriority() {}
+
+  public static native long getTaskPriority(long taskAttemptId);
+
+  public static native void taskDone(long taskAttemptId);
+}

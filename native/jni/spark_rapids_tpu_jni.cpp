@@ -221,10 +221,15 @@ void throw_java_typed(JNIEnv* env, const std::string& formatted) {
           std::string("com/nvidia/spark/rapids/jni/") + tname;
       jclass jc = env->FindClass(cls.c_str());
       if (jc != nullptr) {
-        env->ThrowNew(jc, formatted.c_str() + colon + 2);
-        return;
+        // ThrowNew fails for non-Throwable name collisions; fall back
+        // so a Python error NEVER goes unreported to the JVM
+        if (env->ThrowNew(jc, formatted.c_str() + colon + 2) == 0) {
+          return;
+        }
+        env->ExceptionClear();
+      } else {
+        env->ExceptionClear();  // no such class
       }
-      env->ExceptionClear();  // no such class: plain RuntimeException
     }
   }
   throw_java(env, formatted.c_str());
@@ -647,6 +652,160 @@ jlong JNI_FN(DateTimeRebase, rebaseJulianToGregorian)(JNIEnv* env,
   Gil gil;
   PyObject* args = Py_BuildValue("(LO)", (long long)col, Py_False);
   return as_jlong(env, call_entry(env, "datetime_rebase", args));
+}
+
+// ------------------------------------------------------ JoinPrimitives
+
+jlongArray JNI_FN(JoinPrimitives, sortMergeInnerJoin)(
+    JNIEnv* env, jclass, jlongArray left, jlongArray right,
+    jboolean nulls_equal) {
+  if (!ensure_runtime(env)) return nullptr;
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(NNO)", longs_to_pylist(env, left), longs_to_pylist(env, right),
+      nulls_equal ? Py_True : Py_False);
+  return as_jlong_array(env,
+                        call_entry(env, "sort_merge_inner_join", args));
+}
+
+// ---------------------------------------------------------- BloomFilter
+
+jlong JNI_FN(BloomFilter, create)(JNIEnv* env, jclass, jint num_hashes,
+                                  jint num_longs, jint version) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(iii)", (int)num_hashes,
+                                 (int)num_longs, (int)version);
+  return as_jlong(env, call_entry(env, "bloom_filter_create", args));
+}
+
+jlong JNI_FN(BloomFilter, put)(JNIEnv* env, jclass, jlong bf,
+                               jlong col) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(LL)", (long long)bf,
+                                 (long long)col);
+  return as_jlong(env, call_entry(env, "bloom_filter_put", args));
+}
+
+jlong JNI_FN(BloomFilter, probe)(JNIEnv* env, jclass, jlong bf,
+                                 jlong col) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(LL)", (long long)bf,
+                                 (long long)col);
+  return as_jlong(env, call_entry(env, "bloom_filter_probe", args));
+}
+
+jlong JNI_FN(BloomFilter, merge)(JNIEnv* env, jclass,
+                                 jlongArray bfs) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(N)", longs_to_pylist(env, bfs));
+  return as_jlong(env, call_entry(env, "bloom_filter_merge", args));
+}
+
+jbyteArray JNI_FN(BloomFilter, serialize)(JNIEnv* env, jclass,
+                                          jlong bf) {
+  if (!ensure_runtime(env)) return nullptr;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(L)", (long long)bf);
+  return as_jbyte_array(env,
+                        call_entry(env, "bloom_filter_serialize",
+                                   args));
+}
+
+jlong JNI_FN(BloomFilter, deserialize)(JNIEnv* env, jclass,
+                                       jbyteArray data) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(N)", bytes_to_py(env, data));
+  return as_jlong(env,
+                  call_entry(env, "bloom_filter_deserialize", args));
+}
+
+// --------------------------------------------------- Aggregation64Utils
+
+jlong JNI_FN(Aggregation64Utils, extractChunk32From64bit)(
+    JNIEnv* env, jclass, jlong col, jstring type_id, jint chunk) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  const char* t = env->GetStringUTFChars(type_id, nullptr);
+  PyObject* args = Py_BuildValue("(Lsi)", (long long)col, t,
+                                 (int)chunk);
+  env->ReleaseStringUTFChars(type_id, t);
+  return as_jlong(env,
+                  call_entry(env, "extract_chunk32_from_64bit", args));
+}
+
+jlongArray JNI_FN(Aggregation64Utils, assemble64FromSum)(
+    JNIEnv* env, jclass, jlong low, jlong high, jstring type_id) {
+  if (!ensure_runtime(env)) return nullptr;
+  Gil gil;
+  const char* t = env->GetStringUTFChars(type_id, nullptr);
+  PyObject* args = Py_BuildValue("(LLs)", (long long)low,
+                                 (long long)high, t);
+  env->ReleaseStringUTFChars(type_id, t);
+  return as_jlong_array(env,
+                        call_entry(env, "assemble64_from_sum", args));
+}
+
+// ---------------------------------------------------- RegexRewriteUtils
+
+jlong JNI_FN(RegexRewriteUtils, literalRangePattern)(
+    JNIEnv* env, jclass, jlong col, jstring literal, jint range_len,
+    jint start, jint end) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  // user literals can hold non-BMP chars: UTF-16 marshalling, not
+  // GetStringUTFChars (modified UTF-8 — see jstring_to_py)
+  PyObject* args = Py_BuildValue(
+      "(LNiii)", (long long)col, jstring_to_py(env, literal),
+      (int)range_len, (int)start, (int)end);
+  return as_jlong(env,
+                  call_entry(env, "literal_range_pattern", args));
+}
+
+// -------------------------------------------------------- GpuTimeZoneDB
+
+jlong JNI_FN(GpuTimeZoneDB, convertTimestampToUTC)(JNIEnv* env, jclass,
+                                                   jlong col,
+                                                   jstring zone) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  const char* z = env->GetStringUTFChars(zone, nullptr);
+  PyObject* args = Py_BuildValue("(LsO)", (long long)col, z, Py_True);
+  env->ReleaseStringUTFChars(zone, z);
+  return as_jlong(env, call_entry(env, "timezone_convert", args));
+}
+
+jlong JNI_FN(GpuTimeZoneDB, convertUTCTimestampToTimeZone)(
+    JNIEnv* env, jclass, jlong col, jstring zone) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  const char* z = env->GetStringUTFChars(zone, nullptr);
+  PyObject* args = Py_BuildValue("(LsO)", (long long)col, z, Py_False);
+  env->ReleaseStringUTFChars(zone, z);
+  return as_jlong(env, call_entry(env, "timezone_convert", args));
+}
+
+// --------------------------------------------------------- TaskPriority
+
+jlong JNI_FN(TaskPriority, getTaskPriority)(JNIEnv* env, jclass,
+                                            jlong attempt) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(L)", (long long)attempt);
+  return as_jlong(env, call_entry(env, "task_priority_get", args));
+}
+
+void JNI_FN(TaskPriority, taskDone)(JNIEnv* env, jclass,
+                                    jlong attempt) {
+  if (!ensure_runtime(env)) return;
+  Gil gil;
+  PyObject* r = call_entry(env, "task_priority_done",
+                           Py_BuildValue("(L)", (long long)attempt));
+  Py_XDECREF(r);
 }
 
 // ------------------------------------------------------------ HostTable

@@ -136,6 +136,32 @@ NATIVE_CLASSES = {
         ("toDeviceColumns", "(J)[J"),
         ("free", "(J)V"),
     ],
+    "JoinPrimitives": [
+        ("sortMergeInnerJoin", "([J[JZ)[J"),
+    ],
+    "BloomFilter": [
+        ("create", "(III)J"),
+        ("put", "(JJ)J"),
+        ("probe", "(JJ)J"),
+        ("merge", "([J)J"),
+        ("serialize", "(J)[B"),
+        ("deserialize", "([B)J"),
+    ],
+    "Aggregation64Utils": [
+        ("extractChunk32From64bit", "(JLjava/lang/String;I)J"),
+        ("assemble64FromSum", "(JJLjava/lang/String;)[J"),
+    ],
+    "RegexRewriteUtils": [
+        ("literalRangePattern", "(JLjava/lang/String;III)J"),
+    ],
+    "GpuTimeZoneDB": [
+        ("convertTimestampToUTC", "(JLjava/lang/String;)J"),
+        ("convertUTCTimestampToTimeZone", "(JLjava/lang/String;)J"),
+    ],
+    "TaskPriority": [
+        ("getTaskPriority", "(J)J"),
+        ("taskDone", "(J)V"),
+    ],
     "TestSupport": [
         ("assertTrue", "(ILjava/lang/String;)V"),
         ("checkLongColumn", "(J[J)I"),
@@ -270,7 +296,7 @@ def build_smoke_test(outdir: str, xx_gold):
     """JniSmokeTest.main: straight-line bytecode (assertions throw from
     native TestSupport.assertTrue, so no branches / StackMapTable)."""
     cf = ClassFile(f"{PKG}/JniSmokeTest")
-    c = Code(cf.cp, max_locals=40)
+    c = Code(cf.cp, max_locals=60)
     J = f"{PKG}/"
 
     def assert_check(msg):
@@ -435,6 +461,56 @@ def build_smoke_test(outdir: str, xx_gold):
     c.invokestatic(J + "HostTable", "free", "(J)V")
     c.println("host table spill ok")
 
+    # --- JoinPrimitives: [1,2,3] inner-join [2,3,4] ------------------
+    H_RK, JP, JP0, JP1 = 38, 40, 41, 43
+    c.long_array_consts([2, 3, 4])
+    c.invokestatic(J + "TpuColumns", "fromLongs", "([J)J")
+    c.lstore(H_RK)
+    c.long_array_locals([H_LONGS])
+    c.long_array_locals([H_RK])
+    c.iconst(1)
+    c.invokestatic(J + "JoinPrimitives", "sortMergeInnerJoin",
+                   "([J[JZ)[J")
+    c.astore(JP)
+    c.aload(JP)
+    c.iconst(0)
+    c.laload()
+    c.lstore(JP0)
+    c.aload(JP)
+    c.iconst(1)
+    c.laload()
+    c.lstore(JP1)
+    c.lload(JP0)
+    c.int_array([1, 2])          # keys 2,3 match at left rows 1,2
+    c.invokestatic(J + "TestSupport", "checkIntColumn", "(J[I)I")
+    assert_check("JoinPrimitives left indices")
+    c.lload(JP1)
+    c.int_array([0, 1])
+    c.invokestatic(J + "TestSupport", "checkIntColumn", "(J[I)I")
+    assert_check("JoinPrimitives right indices")
+    c.println("join primitives ok")
+
+    # --- BloomFilter: no false negatives on inserted keys ------------
+    BF, BF2, PRB = 45, 47, 49
+    c.iconst(3)
+    c.iconst(4)
+    c.iconst(2)
+    c.invokestatic(J + "BloomFilter", "create", "(III)J")
+    c.lstore(BF)
+    c.lload(BF)
+    c.lload(H_LONGS)
+    c.invokestatic(J + "BloomFilter", "put", "(JJ)J")
+    c.lstore(BF2)
+    c.lload(BF2)
+    c.lload(H_LONGS)
+    c.invokestatic(J + "BloomFilter", "probe", "(JJ)J")
+    c.lstore(PRB)
+    c.lload(PRB)
+    c.int_array([1, 1, 1])
+    c.invokestatic(J + "TestSupport", "checkIntColumn", "(J[I)I")
+    assert_check("BloomFilter probe: inserted keys all hit")
+    c.println("bloom filter ok")
+
     # --- StringUtils.randomUUIDs ------------------------------------
     H_UUID = 23
     c.iconst(4)
@@ -457,7 +533,7 @@ def build_smoke_test(outdir: str, xx_gold):
     # --- handle hygiene ----------------------------------------------
     for h in [H_STR, 4, H_LONGS, 8, ROWS, BACK0, H_NUM, H_CAST,
               H_JSON, H_JOUT, H_UUID, H_URI, H_HOST, MERGED0,
-              RESTORED0]:
+              RESTORED0, H_RK, JP0, JP1, BF, BF2, PRB]:
         c.lload(h)
         c.invokestatic(J + "TpuColumns", "free", "(J)V")
     c.invokestatic(J + "TpuRuntime", "shutdown", "()V")

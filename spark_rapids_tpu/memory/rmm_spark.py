@@ -63,7 +63,8 @@ def start_dedicated_task_thread(thread_id: int, task_id: int):
 
 
 def current_thread_is_dedicated_to_task(task_id: int):
-    get_adaptor().start_dedicated_task_thread(current_thread_id(), task_id)
+    # same validate-then-register contract as start_dedicated_task_thread
+    start_dedicated_task_thread(current_thread_id(), task_id)
 
 
 def shuffle_thread_working_on_tasks(task_ids):

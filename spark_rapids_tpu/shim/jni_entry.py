@@ -225,6 +225,102 @@ def datetime_rebase(handle: int, to_julian: bool) -> int:
     return REGISTRY.register(fn(REGISTRY.get(handle)))
 
 
+def sort_merge_inner_join(left_handles: Sequence[int],
+                          right_handles: Sequence[int],
+                          nulls_equal: bool) -> List[int]:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.sort_merge_inner_join(left_handles, right_handles,
+                                         nulls_equal)
+
+
+def bloom_filter_create(num_hashes: int, num_longs: int,
+                        version: int) -> int:
+    from spark_rapids_tpu.ops import bloom_filter as BF
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(BF.create(num_hashes, num_longs, version))
+
+
+def bloom_filter_put(bf_handle: int, col_handle: int) -> int:
+    from spark_rapids_tpu.ops import bloom_filter as BF
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(
+        BF.put(REGISTRY.get(bf_handle), REGISTRY.get(col_handle)))
+
+
+def bloom_filter_probe(bf_handle: int, col_handle: int) -> int:
+    from spark_rapids_tpu.ops import bloom_filter as BF
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(
+        BF.probe(REGISTRY.get(bf_handle), REGISTRY.get(col_handle)))
+
+
+def bloom_filter_merge(bf_handles: Sequence[int]) -> int:
+    from spark_rapids_tpu.ops import bloom_filter as BF
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(
+        BF.merge([REGISTRY.get(h) for h in bf_handles]))
+
+
+def bloom_filter_serialize(bf_handle: int) -> bytes:
+    from spark_rapids_tpu.ops import bloom_filter as BF
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return BF.serialize(REGISTRY.get(bf_handle))
+
+
+def bloom_filter_deserialize(data: bytes) -> int:
+    from spark_rapids_tpu.ops import bloom_filter as BF
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(BF.deserialize(bytes(data)))
+
+
+def extract_chunk32_from_64bit(handle: int, type_id: str,
+                               chunk: int) -> int:
+    from spark_rapids_tpu.columns.dtypes import DType
+    from spark_rapids_tpu.ops.aggregation64 import \
+        extract_chunk32_from_64bit as ec
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(
+        ec(REGISTRY.get(handle), DType(type_id), chunk))
+
+
+def assemble64_from_sum(low_handle: int, high_handle: int,
+                        type_id: str) -> List[int]:
+    from spark_rapids_tpu.columns.dtypes import DType
+    from spark_rapids_tpu.ops.aggregation64 import \
+        assemble64_from_sum as asm
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    out = asm(REGISTRY.get(low_handle), REGISTRY.get(high_handle),
+              DType(type_id))
+    return [REGISTRY.register(c) for c in out]
+
+
+def literal_range_pattern(handle: int, literal: str, range_len: int,
+                          start: int, end: int) -> int:
+    from spark_rapids_tpu.ops.strings_misc import \
+        literal_range_pattern as lrp
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(lrp(REGISTRY.get(handle), literal,
+                                 range_len, start, end))
+
+
+def timezone_convert(handle: int, zone_id: str, to_utc: bool) -> int:
+    from spark_rapids_tpu.ops import datetime_ops as DT
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    fn = (DT.convert_timestamp_to_utc if to_utc
+          else DT.convert_utc_timestamp_to_timezone)
+    return REGISTRY.register(fn(REGISTRY.get(handle), zone_id))
+
+
+def task_priority_get(attempt_id: int) -> int:
+    from spark_rapids_tpu.memory import task_priority
+    return task_priority.get_task_priority(attempt_id)
+
+
+def task_priority_done(attempt_id: int) -> None:
+    from spark_rapids_tpu.memory import task_priority
+    task_priority.task_done(attempt_id)
+
+
 # --------------------------------------------------------- HostTable
 
 
@@ -344,10 +440,7 @@ def rmm_current_thread_id() -> int:
 
 def rmm_register_current_thread(task_id: int) -> None:
     from spark_rapids_tpu.memory import rmm_spark
-    # start_dedicated_task_thread validates the adaptor BEFORE adding
-    # to ThreadStateRegistry (a failed start must not leave a stale id)
-    rmm_spark.start_dedicated_task_thread(
-        rmm_spark.current_thread_id(), task_id)
+    rmm_spark.current_thread_is_dedicated_to_task(task_id)
 
 
 def rmm_force_split_and_retry_oom(thread_id: int, num_ooms: int) -> None:
