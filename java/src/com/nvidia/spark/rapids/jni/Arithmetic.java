@@ -1,0 +1,18 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * ANSI/TRY-mode arithmetic (reference Arithmetic.java:45-185 over
+ * multiply.cu / round_float.cu; TPU engine:
+ * spark_rapids_tpu/ops/arithmetic.py — overflow wraps in regular mode,
+ * nulls in TRY, raises with the first failing row in ANSI).
+ */
+public final class Arithmetic {
+  private Arithmetic() {}
+
+  public static native long multiply(long lhs, long rhs, boolean ansi,
+                                     boolean tryMode);
+
+  /** Spark round()/bround(); mode: "HALF_UP" or "HALF_EVEN". */
+  public static native long round(long column, int decimalPlaces,
+                                  String mode);
+}

@@ -36,4 +36,13 @@ public final class CastStrings {
    * and runs the digit engine vectorized, ftos_device.py).
    */
   public static native long fromFloat(long column);
+
+  /** Spark to_date (reference CastStrings.toDate:331). */
+  public static native long toDate(long column, boolean ansi);
+
+  /** bin(): long -> binary string (cast_string.hpp:45). */
+  public static native long fromLongToBinary(long column);
+
+  /** Spark format_number(d, digits) (format_float.cu). */
+  public static native long formatNumber(long column, int digits);
 }

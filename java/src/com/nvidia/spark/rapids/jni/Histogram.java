@@ -1,0 +1,16 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Spark percentile() over (value, frequency) histograms (reference
+ * Histogram.java over histogram.cu; TPU engine:
+ * spark_rapids_tpu/ops/histogram.py).
+ */
+public final class Histogram {
+  private Histogram() {}
+
+  public static native long createHistogramIfValid(long values,
+                                                   long frequencies);
+
+  public static native long percentileFromHistogram(long histogram,
+                                                    double[] percentages);
+}

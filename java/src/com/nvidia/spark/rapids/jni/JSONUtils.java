@@ -17,4 +17,16 @@ public final class JSONUtils {
    *         the document is invalid)
    */
   public static native long getJsonObject(long column, String path);
+
+  /**
+   * Batched multi-path evaluation with a scratch-memory budget
+   * (reference JSONUtils.getJsonObjectMultiplePaths:87 — the
+   * budget/parallelism knobs shape chunking, get_json_object.cu:965).
+   *
+   * @param memBudgetBytes    -1 for unbudgeted
+   * @param parallelOverride  -1 for automatic
+   */
+  public static native long[] getJsonObjectMultiplePaths(
+      long column, String[] paths, long memBudgetBytes,
+      int parallelOverride);
 }

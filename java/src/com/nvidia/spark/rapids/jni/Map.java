@@ -1,0 +1,13 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Map column helpers (reference Map.java over map.cu; TPU engine:
+ * spark_rapids_tpu/ops/map_utils.py).
+ */
+public final class Map {
+  private Map() {}
+
+  /** Sort each map's entries by key (LIST&lt;STRUCT&lt;k,v&gt;&gt;). */
+  public static native long sortMapColumn(long column,
+                                          boolean descending);
+}

@@ -789,6 +789,105 @@ jlong JNI_FN(GpuTimeZoneDB, convertUTCTimestampToTimeZone)(
   return as_jlong(env, call_entry(env, "timezone_convert", args));
 }
 
+// ----------------------------------------------------------- Arithmetic
+
+jlong JNI_FN(Arithmetic, multiply)(JNIEnv* env, jclass, jlong lhs,
+                                   jlong rhs, jboolean ansi,
+                                   jboolean try_mode) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(LLOO)", (long long)lhs,
+                                 (long long)rhs,
+                                 ansi ? Py_True : Py_False,
+                                 try_mode ? Py_True : Py_False);
+  return as_jlong(env, call_entry(env, "arithmetic_multiply", args));
+}
+
+jlong JNI_FN(Arithmetic, round)(JNIEnv* env, jclass, jlong col,
+                                jint decimal_places, jstring mode) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  const char* m = env->GetStringUTFChars(mode, nullptr);
+  PyObject* args = Py_BuildValue("(Lis)", (long long)col,
+                                 (int)decimal_places, m);
+  env->ReleaseStringUTFChars(mode, m);
+  return as_jlong(env, call_entry(env, "arithmetic_round", args));
+}
+
+// ------------------------------------------------------------ Histogram
+
+jlong JNI_FN(Histogram, createHistogramIfValid)(JNIEnv* env, jclass,
+                                                jlong values,
+                                                jlong freqs) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(LL)", (long long)values,
+                                 (long long)freqs);
+  return as_jlong(env, call_entry(env, "histogram_create", args));
+}
+
+jlong JNI_FN(Histogram, percentileFromHistogram)(JNIEnv* env, jclass,
+                                                 jlong histogram,
+                                                 jdoubleArray pcts) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(LN)", (long long)histogram,
+                                 doubles_to_pylist(env, pcts));
+  return as_jlong(env, call_entry(env, "histogram_percentile", args));
+}
+
+// ----------------------------------------------- JSONUtils (multi-path)
+
+jlongArray JNI_FN(JSONUtils, getJsonObjectMultiplePaths)(
+    JNIEnv* env, jclass, jlong col, jobjectArray paths,
+    jlong mem_budget, jint parallel_override) {
+  if (!ensure_runtime(env)) return nullptr;
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(LNLi)", (long long)col, strings_to_pylist(env, paths),
+      (long long)mem_budget, (int)parallel_override);
+  return as_jlong_array(
+      env, call_entry(env, "get_json_object_multiple_paths", args));
+}
+
+// ---------------------------------------------- CastStrings (datetime+)
+
+jlong JNI_FN(CastStrings, toDate)(JNIEnv* env, jclass, jlong col,
+                                  jboolean ansi) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(LO)", (long long)col,
+                                 ansi ? Py_True : Py_False);
+  return as_jlong(env, call_entry(env, "cast_strings_to_date", args));
+}
+
+jlong JNI_FN(CastStrings, fromLongToBinary)(JNIEnv* env, jclass,
+                                            jlong col) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(L)", (long long)col);
+  return as_jlong(env, call_entry(env, "long_to_binary_string", args));
+}
+
+jlong JNI_FN(CastStrings, formatNumber)(JNIEnv* env, jclass, jlong col,
+                                        jint digits) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Li)", (long long)col, (int)digits);
+  return as_jlong(env, call_entry(env, "format_number", args));
+}
+
+// ------------------------------------------------------------------ Map
+
+jlong JNI_FN(Map, sortMapColumn)(JNIEnv* env, jclass, jlong col,
+                                 jboolean descending) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(LO)", (long long)col,
+                                 descending ? Py_True : Py_False);
+  return as_jlong(env, call_entry(env, "map_sort", args));
+}
+
 // --------------------------------------------------------- TaskPriority
 
 jlong JNI_FN(TaskPriority, getTaskPriority)(JNIEnv* env, jclass,

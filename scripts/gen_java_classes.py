@@ -75,9 +75,25 @@ NATIVE_CLASSES = {
         ("toInteger", "(JZZLjava/lang/String;)J"),
         ("toFloat", "(JZLjava/lang/String;)J"),
         ("fromFloat", "(J)J"),
+        ("toDate", "(JZ)J"),
+        ("fromLongToBinary", "(J)J"),
+        ("formatNumber", "(JI)J"),
     ],
     "JSONUtils": [
         ("getJsonObject", "(JLjava/lang/String;)J"),
+        ("getJsonObjectMultiplePaths",
+         "(J[Ljava/lang/String;JI)[J"),
+    ],
+    "Arithmetic": [
+        ("multiply", "(JJZZ)J"),
+        ("round", "(JILjava/lang/String;)J"),
+    ],
+    "Histogram": [
+        ("createHistogramIfValid", "(JJ)J"),
+        ("percentileFromHistogram", "(J[D)J"),
+    ],
+    "Map": [
+        ("sortMapColumn", "(JZ)J"),
     ],
     "RmmSpark": [
         ("setEventHandler", "(J)V"),
@@ -511,6 +527,36 @@ def build_smoke_test(outdir: str, xx_gold):
     assert_check("BloomFilter probe: inserted keys all hit")
     c.println("bloom filter ok")
 
+    # --- Arithmetic.multiply + JSONUtils multi-path ------------------
+    H_ML, H_MP, H_MP0 = 51, 53, 54
+    c.lload(H_LONGS)               # [1,2,3]
+    c.lload(H_RK)                  # [2,3,4]
+    c.iconst(0)
+    c.iconst(0)
+    c.invokestatic(J + "Arithmetic", "multiply", "(JJZZ)J")
+    c.lstore(H_ML)
+    c.lload(H_ML)
+    c.long_array_consts([2, 6, 12])
+    c.invokestatic(J + "TestSupport", "checkLongColumn", "(J[J)I")
+    assert_check("Arithmetic.multiply")
+    c.lload(H_JSON)                # ['{"a": 1}', '{"a": 2}']
+    c.string_array(["$.a"])
+    c.lconst(-1)
+    c.iconst(-1)
+    c.invokestatic(J + "JSONUtils", "getJsonObjectMultiplePaths",
+                   "(J[Ljava/lang/String;JI)[J")
+    c.astore(H_MP)
+    c.aload(H_MP)
+    c.iconst(0)
+    c.laload()
+    c.lstore(H_MP0)
+    c.lload(H_MP0)
+    c.string_array(["1", "2"])
+    c.invokestatic(J + "TestSupport", "checkStringColumn",
+                   "(J[Ljava/lang/String;)I")
+    assert_check("JSONUtils.getJsonObjectMultiplePaths")
+    c.println("arithmetic + multi-path json ok")
+
     # --- StringUtils.randomUUIDs ------------------------------------
     H_UUID = 23
     c.iconst(4)
@@ -533,7 +579,8 @@ def build_smoke_test(outdir: str, xx_gold):
     # --- handle hygiene ----------------------------------------------
     for h in [H_STR, 4, H_LONGS, 8, ROWS, BACK0, H_NUM, H_CAST,
               H_JSON, H_JOUT, H_UUID, H_URI, H_HOST, MERGED0,
-              RESTORED0, H_RK, JP0, JP1, BF, BF2, PRB]:
+              RESTORED0, H_RK, JP0, JP1, BF, BF2, PRB, H_ML,
+              H_MP0]:
         c.lload(h)
         c.invokestatic(J + "TpuColumns", "free", "(J)V")
     c.invokestatic(J + "TpuRuntime", "shutdown", "()V")

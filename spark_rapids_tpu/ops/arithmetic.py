@@ -79,6 +79,11 @@ def round_column(col: Column, decimal_places: int = 0,
                  method: str = HALF_UP) -> Column:
     """Spark round()/bround() (round_float.hpp): integers, floats,
     decimal32/64 (negated scale == decimal_places)."""
+    if method not in (HALF_UP, HALF_EVEN):
+        # unvalidated strings must not silently round the wrong way
+        # (JNI callers pass the mode through verbatim)
+        raise ValueError(f"unknown rounding method {method!r}; "
+                         f"expected {HALF_UP!r} or {HALF_EVEN!r}")
     kind = col.dtype.kind
     if kind in (Kind.INT8, Kind.INT16, Kind.INT32, Kind.INT64):
         if decimal_places >= 0:

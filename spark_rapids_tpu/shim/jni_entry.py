@@ -311,6 +311,77 @@ def timezone_convert(handle: int, zone_id: str, to_utc: bool) -> int:
     return REGISTRY.register(fn(REGISTRY.get(handle), zone_id))
 
 
+def arithmetic_multiply(lhs: int, rhs: int, ansi: bool,
+                        try_mode: bool) -> int:
+    from spark_rapids_tpu.ops.arithmetic import multiply
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(multiply(REGISTRY.get(lhs),
+                                      REGISTRY.get(rhs), ansi,
+                                      try_mode))
+
+
+def arithmetic_round(handle: int, decimal_places: int,
+                     mode: str) -> int:
+    from spark_rapids_tpu.ops.arithmetic import round_column
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(round_column(REGISTRY.get(handle),
+                                          decimal_places,
+                                          method=mode))
+
+
+def histogram_create(values: int, frequencies: int) -> int:
+    from spark_rapids_tpu.ops.histogram import create_histogram_if_valid
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(create_histogram_if_valid(
+        REGISTRY.get(values), REGISTRY.get(frequencies)))
+
+
+def histogram_percentile(histogram: int,
+                         percentages: Sequence[float]) -> int:
+    from spark_rapids_tpu.ops.histogram import percentile_from_histogram
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(percentile_from_histogram(
+        REGISTRY.get(histogram), list(percentages)))
+
+
+def get_json_object_multiple_paths(handle: int, paths: Sequence[str],
+                                   mem_budget: int,
+                                   parallel_override: int) -> List[int]:
+    from spark_rapids_tpu.ops.json_path import \
+        get_json_object_multiple_paths as gj
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    out = gj(REGISTRY.get(handle), list(paths), mem_budget,
+             parallel_override)
+    return [REGISTRY.register(c) for c in out]
+
+
+def cast_strings_to_date(handle: int, ansi: bool) -> int:
+    from spark_rapids_tpu.ops.cast_more import parse_strings_to_date
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(
+        parse_strings_to_date(REGISTRY.get(handle), ansi))
+
+
+def long_to_binary_string(handle: int) -> int:
+    from spark_rapids_tpu.ops.cast_more import long_to_binary_string
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(long_to_binary_string(
+        REGISTRY.get(handle)))
+
+
+def format_number(handle: int, digits: int) -> int:
+    from spark_rapids_tpu.ops.cast_more import format_number as fnum
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(fnum(REGISTRY.get(handle), digits))
+
+
+def map_sort(handle: int, descending: bool) -> int:
+    from spark_rapids_tpu.ops.map_utils import sort_map_column
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(sort_map_column(REGISTRY.get(handle),
+                                             descending))
+
+
 def task_priority_get(attempt_id: int) -> int:
     from spark_rapids_tpu.memory import task_priority
     return task_priority.get_task_priority(attempt_id)
