@@ -1,0 +1,28 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Always-on low-overhead tracer control (reference Profiler.java:36-120
+ * over the CUPTI-to-flatbuffers pipeline, profiler_serializer.hpp;
+ * TPU runtime: spark_rapids_tpu/utils/profiler.py — op ranges + alloc
+ * capture + jax.profiler device traces, with
+ * tools/profile_converter.py as the offline Chrome-trace converter,
+ * the spark_rapids_profile_converter analog).
+ *
+ * <p>The reference streams records through a JVM DataWriter callback;
+ * this binding delivers the same record stream to a file sink (pass
+ * the path), which the converter consumes offline.
+ */
+public final class Profiler {
+  private Profiler() {}
+
+  /** Initialize with a file sink for the record stream. */
+  public static native void nativeInit(String outputPath,
+                                       int flushPeriodMillis,
+                                       boolean allocCapture);
+
+  public static native void nativeStart();
+
+  public static native void nativeStop();
+
+  public static native void nativeShutdown();
+}

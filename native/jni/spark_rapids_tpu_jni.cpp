@@ -237,7 +237,13 @@ void throw_java_typed(JNIEnv* env, const std::string& formatted) {
 
 // Call g_entry.<fn>(*args); steals `args` (a tuple).  On Python error:
 // clears it, throws the mapped Java exception, returns nullptr.
+// args==NULL (a failed Py_BuildValue, e.g. modified-UTF-8 input) is
+// handled here once so no call site can feed Py_DECREF a null.
 PyObject* call_entry(JNIEnv* env, const char* fn, PyObject* args) {
+  if (args == nullptr) {
+    throw_java_typed(env, pending_python_error());
+    return nullptr;
+  }
   PyObject* f = PyObject_GetAttrString(g_entry, fn);
   if (f == nullptr) {
     Py_DECREF(args);
@@ -904,6 +910,43 @@ void JNI_FN(TaskPriority, taskDone)(JNIEnv* env, jclass,
   Gil gil;
   PyObject* r = call_entry(env, "task_priority_done",
                            Py_BuildValue("(L)", (long long)attempt));
+  Py_XDECREF(r);
+}
+
+// ------------------------------------------------------------- Profiler
+
+void JNI_FN(Profiler, nativeInit)(JNIEnv* env, jclass, jstring path,
+                                  jint flush_period_millis,
+                                  jboolean alloc_capture) {
+  if (!ensure_runtime(env)) return;
+  Gil gil;
+  const char* p = env->GetStringUTFChars(path, nullptr);
+  PyObject* args = Py_BuildValue("(siO)", p,
+                                 (int)flush_period_millis,
+                                 alloc_capture ? Py_True : Py_False);
+  env->ReleaseStringUTFChars(path, p);
+  PyObject* r = call_entry(env, "profiler_init", args);
+  Py_XDECREF(r);
+}
+
+void JNI_FN(Profiler, nativeStart)(JNIEnv* env, jclass) {
+  if (!ensure_runtime(env)) return;
+  Gil gil;
+  PyObject* r = call_entry(env, "profiler_start", PyTuple_New(0));
+  Py_XDECREF(r);
+}
+
+void JNI_FN(Profiler, nativeStop)(JNIEnv* env, jclass) {
+  if (!ensure_runtime(env)) return;
+  Gil gil;
+  PyObject* r = call_entry(env, "profiler_stop", PyTuple_New(0));
+  Py_XDECREF(r);
+}
+
+void JNI_FN(Profiler, nativeShutdown)(JNIEnv* env, jclass) {
+  if (!ensure_runtime(env)) return;
+  Gil gil;
+  PyObject* r = call_entry(env, "profiler_shutdown", PyTuple_New(0));
   Py_XDECREF(r);
 }
 

@@ -95,6 +95,12 @@ NATIVE_CLASSES = {
     "Map": [
         ("sortMapColumn", "(JZ)J"),
     ],
+    "Profiler": [
+        ("nativeInit", "(Ljava/lang/String;IZ)V"),
+        ("nativeStart", "()V"),
+        ("nativeStop", "()V"),
+        ("nativeShutdown", "()V"),
+    ],
     "RmmSpark": [
         ("setEventHandler", "(J)V"),
         ("clearEventHandler", "()V"),
@@ -564,6 +570,23 @@ def build_smoke_test(outdir: str, xx_gold):
     c.invokestatic(J + "StringUtils", "randomUUIDs", "(IJ)J")
     c.lstore(H_UUID)
     c.println("randomUUIDs ok")
+
+    # --- Profiler lifecycle with a file sink -------------------------
+    H_PF = 56
+    c.ldc_string("/tmp/jni_profile.bin")
+    c.iconst(0)
+    c.iconst(1)
+    c.invokestatic(J + "Profiler", "nativeInit",
+                   "(Ljava/lang/String;IZ)V")
+    c.invokestatic(J + "Profiler", "nativeStart", "()V")
+    c.long_array_consts([7, 8])
+    c.invokestatic(J + "TpuColumns", "fromLongs", "([J)J")
+    c.lstore(H_PF)
+    c.lload(H_PF)
+    c.invokestatic(J + "TpuColumns", "free", "(J)V")
+    c.invokestatic(J + "Profiler", "nativeStop", "()V")
+    c.invokestatic(J + "Profiler", "nativeShutdown", "()V")
+    c.println("profiler lifecycle ok")
 
     # --- RmmSpark facade over the OOM state machine ------------------
     c.lconst(1 << 20)

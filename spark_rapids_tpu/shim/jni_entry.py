@@ -43,8 +43,10 @@ def initialize() -> None:
 
 def shutdown() -> None:
     from spark_rapids_tpu.shim.handles import REGISTRY
+    from spark_rapids_tpu.utils.profiler import Profiler
     REGISTRY.clear()
     _HOST_TABLES.clear()   # spilled buffers are handles too
+    Profiler.shutdown()    # stops the flusher, closes file sinks
 
 
 def live_handles() -> int:
@@ -390,6 +392,52 @@ def task_priority_get(attempt_id: int) -> int:
 def task_priority_done(attempt_id: int) -> None:
     from spark_rapids_tpu.memory import task_priority
     task_priority.task_done(attempt_id)
+
+
+# ---------------------------------------------------------- Profiler
+
+
+def profiler_init(output_path: str, flush_period_millis: int,
+                  alloc_capture: bool) -> None:
+    """Profiler.init with a file sink (the reference's DataWriter
+    callback shape delivered to a path instead of a JVM method —
+    Profiler.java:36-120, profiler_serializer.hpp:30-65).  'wb': a
+    profile file holds ONE process's records (t_ns is per-process
+    monotonic; appended runs would interleave in the converter)."""
+    from spark_rapids_tpu.utils.profiler import Config, Profiler
+    f = open(output_path, "wb")
+
+    def writer(blob: bytes):
+        f.write(blob)
+        f.flush()
+
+    cfg = Config(flush_period_millis=flush_period_millis,
+                 alloc_capture=alloc_capture)
+    try:
+        prof = Profiler.init(writer, cfg)
+    except Exception:
+        f.close()          # double-init must not leak the descriptor
+        raise
+    prof.sink_close = f.close  # Profiler.shutdown closes every path
+
+
+def profiler_start() -> None:
+    from spark_rapids_tpu.utils.profiler import Profiler
+    inst = Profiler.get()
+    if inst is not None:
+        inst.start()
+
+
+def profiler_stop() -> None:
+    from spark_rapids_tpu.utils.profiler import Profiler
+    inst = Profiler.get()
+    if inst is not None:
+        inst.stop()
+
+
+def profiler_shutdown() -> None:
+    from spark_rapids_tpu.utils.profiler import Profiler
+    Profiler.shutdown()
 
 
 # --------------------------------------------------------- HostTable

@@ -71,6 +71,14 @@ class Profiler:
             inst.stop()
             inst.flush()
             cls._instance = None
+            # optional sink teardown (file-backed DataWriters set
+            # sink_close so EVERY shutdown path releases the file)
+            closer = getattr(inst, "sink_close", None)
+            if closer is not None:
+                try:
+                    closer()
+                except Exception:
+                    pass
 
     def start(self):
         if self._running:
