@@ -1,0 +1,24 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * 128-bit decimal arithmetic (reference DecimalUtils.java over
+ * decimal_utils.cu — every op returns an (overflow-flag, result)
+ * table; TPU engines: spark_rapids_tpu/ops/decimal_utils.py exact host
+ * path + decimal_device.py u32-limb device kernels with 256-bit
+ * intermediates).
+ *
+ * <p>Each method returns {overflowFlags (BOOL8), result} handles.
+ */
+public final class DecimalUtils {
+  private DecimalUtils() {}
+
+  public static native long[] multiply128(long a, long b,
+                                          int productScale);
+
+  public static native long[] divide128(long a, long b,
+                                        int quotientScale);
+
+  public static native long[] add128(long a, long b, int outScale);
+
+  public static native long[] subtract128(long a, long b, int outScale);
+}

@@ -1,0 +1,12 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Device attribute queries (reference DeviceAttr.java:25 over
+ * DeviceAttrJni.cpp; TPU runtime: spark_rapids_tpu/utils/platform.py).
+ */
+public final class DeviceAttr {
+  private DeviceAttr() {}
+
+  /** Integrated-accelerator query (always false for discrete TPUs). */
+  public static native boolean isIntegratedGPU();
+}

@@ -111,6 +111,31 @@ public final class JniSmokeTest {
     long uuids = StringUtils.randomUUIDs(4, 1);
     System.out.println("randomUUIDs ok");
 
+    long decA = TpuColumns.fromDecimals(new long[] {125, 250}, -2,
+                                        "decimal128");
+    long decB = TpuColumns.fromDecimals(new long[] {200, 400}, -2,
+                                        "decimal128");
+    long[] product = DecimalUtils.multiply128(decA, decB, -4);
+    TestSupport.assertTrue(
+        TestSupport.checkLongColumn(product[1],
+            new long[] {25000, 100000}),
+        "DecimalUtils.multiply128");
+    TestSupport.assertTrue(
+        TestSupport.checkIntColumn(product[0], new int[] {0, 0}),
+        "DecimalUtils.multiply128 overflow flags clear");
+    TestSupport.assertTrue(
+        DeviceAttr.isIntegratedGPU() ? 1 : 0,
+        "DeviceAttr.isIntegratedGPU (true on CPU backend)");
+    System.out.println("decimal128 multiply ok");
+
+    Profiler.nativeInit("/tmp/jni_profile.bin", 0, true);
+    Profiler.nativeStart();
+    long profiled = TpuColumns.fromLongs(new long[] {7, 8});
+    TpuColumns.free(profiled);
+    Profiler.nativeStop();
+    Profiler.nativeShutdown();
+    System.out.println("profiler lifecycle ok");
+
     RmmSpark.setEventHandler(1 << 20);
     RmmSpark.startDedicatedTaskThread(99, 1);
     RmmSpark.taskDone(1);
@@ -120,7 +145,8 @@ public final class JniSmokeTest {
     for (long h : new long[] {strs, murmur, longs, xx, rows, back[0],
                               nums, ints, json, jout, uuids, uris,
                               hosts, merged[0], restored[0], rightKeys,
-                              jp[0], jp[1], bf, bf2, probed}) {
+                              jp[0], jp[1], bf, bf2, probed, decA,
+                              decB, product[0], product[1]}) {
       TpuColumns.free(h);
     }
     TpuRuntime.shutdown();

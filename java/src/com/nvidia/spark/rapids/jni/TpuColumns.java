@@ -25,6 +25,14 @@ public final class TpuColumns {
   /** STRING column; null elements become null rows. */
   public static native long fromStrings(String[] values);
 
+  /**
+   * Decimal column from unscaled values (cudf-java
+   * ColumnVector.decimalFromLongs shape); typeId: "decimal32",
+   * "decimal64", or "decimal128".
+   */
+  public static native long fromDecimals(long[] unscaled, int scale,
+                                         String typeId);
+
   /** Release a handle (exactly once). */
   public static native void free(long handle);
 }

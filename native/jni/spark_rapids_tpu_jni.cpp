@@ -913,6 +913,66 @@ void JNI_FN(TaskPriority, taskDone)(JNIEnv* env, jclass,
   Py_XDECREF(r);
 }
 
+// --------------------------------------------------------- DecimalUtils
+
+static jlongArray decimal_binop(JNIEnv* env, const char* op, jlong a,
+                                jlong b, jint out_scale) {
+  if (!ensure_runtime(env)) return nullptr;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(sLLi)", op, (long long)a,
+                                 (long long)b, (int)out_scale);
+  return as_jlong_array(env, call_entry(env, "decimal128_binop", args));
+}
+
+jlongArray JNI_FN(DecimalUtils, multiply128)(JNIEnv* env, jclass,
+                                             jlong a, jlong b,
+                                             jint scale) {
+  return decimal_binop(env, "multiply", a, b, scale);
+}
+
+jlongArray JNI_FN(DecimalUtils, divide128)(JNIEnv* env, jclass, jlong a,
+                                           jlong b, jint scale) {
+  return decimal_binop(env, "divide", a, b, scale);
+}
+
+jlongArray JNI_FN(DecimalUtils, add128)(JNIEnv* env, jclass, jlong a,
+                                        jlong b, jint scale) {
+  return decimal_binop(env, "add", a, b, scale);
+}
+
+jlongArray JNI_FN(DecimalUtils, subtract128)(JNIEnv* env, jclass,
+                                             jlong a, jlong b,
+                                             jint scale) {
+  return decimal_binop(env, "sub", a, b, scale);
+}
+
+// ----------------------------------------------- TpuColumns (decimals)
+
+jlong JNI_FN(TpuColumns, fromDecimals)(JNIEnv* env, jclass,
+                                       jlongArray unscaled, jint scale,
+                                       jstring type_id) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  const char* t = env->GetStringUTFChars(type_id, nullptr);
+  PyObject* args = Py_BuildValue("(Nis)", longs_to_pylist(env, unscaled),
+                                 (int)scale, t);
+  env->ReleaseStringUTFChars(type_id, t);
+  return as_jlong(env, call_entry(env, "from_decimals", args));
+}
+
+// ----------------------------------------------------------- DeviceAttr
+
+jboolean JNI_FN(DeviceAttr, isIntegratedGPU)(JNIEnv* env, jclass) {
+  if (!ensure_runtime(env)) return JNI_FALSE;
+  Gil gil;
+  PyObject* r = call_entry(env, "device_attr_is_integrated",
+                           PyTuple_New(0));
+  if (r == nullptr) return JNI_FALSE;
+  jboolean v = PyObject_IsTrue(r) ? JNI_TRUE : JNI_FALSE;
+  Py_DECREF(r);
+  return v;
+}
+
 // ------------------------------------------------------------- Profiler
 
 void JNI_FN(Profiler, nativeInit)(JNIEnv* env, jclass, jstring path,

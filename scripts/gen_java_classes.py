@@ -60,7 +60,17 @@ NATIVE_CLASSES = {
         ("fromInts", "([I)J"),
         ("fromDoubles", "([D)J"),
         ("fromStrings", "([Ljava/lang/String;)J"),
+        ("fromDecimals", "([JILjava/lang/String;)J"),
         ("free", "(J)V"),
+    ],
+    "DecimalUtils": [
+        ("multiply128", "(JJI)[J"),
+        ("divide128", "(JJI)[J"),
+        ("add128", "(JJI)[J"),
+        ("subtract128", "(JJI)[J"),
+    ],
+    "DeviceAttr": [
+        ("isIntegratedGPU", "()Z"),
     ],
     "Hash": [
         ("murmurHash32", "(I[J)J"),
@@ -318,7 +328,7 @@ def build_smoke_test(outdir: str, xx_gold):
     """JniSmokeTest.main: straight-line bytecode (assertions throw from
     native TestSupport.assertTrue, so no branches / StackMapTable)."""
     cf = ClassFile(f"{PKG}/JniSmokeTest")
-    c = Code(cf.cp, max_locals=60)
+    c = Code(cf.cp, max_locals=72)
     J = f"{PKG}/"
 
     def assert_check(msg):
@@ -588,6 +598,47 @@ def build_smoke_test(outdir: str, xx_gold):
     c.invokestatic(J + "Profiler", "nativeShutdown", "()V")
     c.println("profiler lifecycle ok")
 
+    # --- DecimalUtils.multiply128 over fromDecimals ------------------
+    H_DA, H_DB, H_DR, H_DR0, H_DR1 = 58, 60, 62, 63, 65
+    c.long_array_consts([125, 250])
+    c.iconst(-2)
+    c.ldc_string("decimal128")
+    c.invokestatic(J + "TpuColumns", "fromDecimals",
+                   "([JILjava/lang/String;)J")
+    c.lstore(H_DA)
+    c.long_array_consts([200, 400])
+    c.iconst(-2)
+    c.ldc_string("decimal128")
+    c.invokestatic(J + "TpuColumns", "fromDecimals",
+                   "([JILjava/lang/String;)J")
+    c.lstore(H_DB)
+    c.lload(H_DA)
+    c.lload(H_DB)
+    c.iconst(-4)
+    c.invokestatic(J + "DecimalUtils", "multiply128", "(JJI)[J")
+    c.astore(H_DR)
+    c.aload(H_DR)
+    c.iconst(0)
+    c.laload()
+    c.lstore(H_DR0)                # overflow flags
+    c.aload(H_DR)
+    c.iconst(1)
+    c.laload()
+    c.lstore(H_DR1)                # product (unscaled)
+    c.lload(H_DR1)
+    c.long_array_consts([25000, 100000])
+    c.invokestatic(J + "TestSupport", "checkLongColumn", "(J[J)I")
+    assert_check("DecimalUtils.multiply128")
+    c.lload(H_DR0)
+    c.int_array([0, 0])
+    c.invokestatic(J + "TestSupport", "checkIntColumn", "(J[I)I")
+    assert_check("DecimalUtils.multiply128 overflow flags clear")
+    c.invokestatic(J + "DeviceAttr", "isIntegratedGPU", "()Z")
+    c.ldc_string("DeviceAttr.isIntegratedGPU (true on CPU backend)")
+    c.invokestatic(J + "TestSupport", "assertTrue",
+                   "(ILjava/lang/String;)V")
+    c.println("decimal128 multiply ok")
+
     # --- RmmSpark facade over the OOM state machine ------------------
     c.lconst(1 << 20)
     c.invokestatic(J + "RmmSpark", "setEventHandler", "(J)V")
@@ -603,7 +654,7 @@ def build_smoke_test(outdir: str, xx_gold):
     for h in [H_STR, 4, H_LONGS, 8, ROWS, BACK0, H_NUM, H_CAST,
               H_JSON, H_JOUT, H_UUID, H_URI, H_HOST, MERGED0,
               RESTORED0, H_RK, JP0, JP1, BF, BF2, PRB, H_ML,
-              H_MP0]:
+              H_MP0, H_DA, H_DB, H_DR0, H_DR1]:
         c.lload(h)
         c.invokestatic(J + "TpuColumns", "free", "(J)V")
     c.invokestatic(J + "TpuRuntime", "shutdown", "()V")

@@ -394,6 +394,36 @@ def task_priority_done(attempt_id: int) -> None:
     task_priority.task_done(attempt_id)
 
 
+def from_decimals(unscaled: Sequence[int], scale: int,
+                  type_id: str) -> int:
+    """Decimal column from UNSCALED int values (cudf-java
+    ColumnVector.decimalFromLongs shape; scale follows the cudf
+    convention — negative scale = fraction digits)."""
+    from spark_rapids_tpu.columns.dtypes import DType
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.make_column_from_host(list(unscaled),
+                                         DType(type_id, scale))
+
+
+def decimal128_binop(op: str, a: int, b: int,
+                     out_scale: int) -> List[int]:
+    """DecimalUtils surface: returns (overflow BOOL8, result) handles
+    (the decimal_utils.hpp:2-33 (flag, column) table shape)."""
+    from spark_rapids_tpu.ops import decimal_utils as DU
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    fn = {"multiply": DU.multiply_decimal128,
+          "divide": DU.divide_decimal128,
+          "add": DU.add_decimal128,
+          "sub": DU.sub_decimal128}[op]
+    ovf, res = fn(REGISTRY.get(a), REGISTRY.get(b), out_scale)
+    return [REGISTRY.register(ovf), REGISTRY.register(res)]
+
+
+def device_attr_is_integrated() -> bool:
+    from spark_rapids_tpu.utils.platform import is_integrated_gpu
+    return is_integrated_gpu()
+
+
 # ---------------------------------------------------------- Profiler
 
 
