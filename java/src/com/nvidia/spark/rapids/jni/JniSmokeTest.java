@@ -111,6 +111,14 @@ public final class JniSmokeTest {
     long uuids = StringUtils.randomUUIDs(4, 1);
     System.out.println("randomUUIDs ok");
 
+    Profiler.nativeInit("/tmp/jni_profile.bin", 0, true);
+    Profiler.nativeStart();
+    long profiled = TpuColumns.fromLongs(new long[] {7, 8});
+    TpuColumns.free(profiled);
+    Profiler.nativeStop();
+    Profiler.nativeShutdown();
+    System.out.println("profiler lifecycle ok");
+
     long decA = TpuColumns.fromDecimals(new long[] {125, 250}, -2,
                                         "decimal128");
     long decB = TpuColumns.fromDecimals(new long[] {200, 400}, -2,
@@ -127,14 +135,6 @@ public final class JniSmokeTest {
         DeviceAttr.isIntegratedGPU() ? 1 : 0,
         "DeviceAttr.isIntegratedGPU (true on CPU backend)");
     System.out.println("decimal128 multiply ok");
-
-    Profiler.nativeInit("/tmp/jni_profile.bin", 0, true);
-    Profiler.nativeStart();
-    long profiled = TpuColumns.fromLongs(new long[] {7, 8});
-    TpuColumns.free(profiled);
-    Profiler.nativeStop();
-    Profiler.nativeShutdown();
-    System.out.println("profiler lifecycle ok");
 
     RmmSpark.setEventHandler(1 << 20);
     RmmSpark.startDedicatedTaskThread(99, 1);

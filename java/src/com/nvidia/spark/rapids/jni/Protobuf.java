@@ -1,0 +1,22 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * GPU-class protobuf decoding (reference Protobuf.java +
+ * ProtobufSchemaDescriptor.java over protobuf_kernels.cu; TPU engine:
+ * spark_rapids_tpu/ops/protobuf_device.py — the field-step masked scan
+ * — with the host decoder as differential oracle).
+ *
+ * <p>Flat schemas pass the descriptor as parallel arrays (the
+ * reference's nested_field_descriptor vectors for depth-0 fields);
+ * encodings: 0=DEFAULT, 1=FIXED, 2=ZIGZAG.
+ */
+public final class Protobuf {
+  private Protobuf() {}
+
+  /** Binary/STRING column of serialized messages -> STRUCT column. */
+  public static native long decodeToStruct(long column,
+                                           int[] fieldNumbers,
+                                           String[] typeIds,
+                                           int[] encodings,
+                                           boolean[] required);
+}

@@ -33,6 +33,12 @@ public final class TpuColumns {
   public static native long fromDecimals(long[] unscaled, int scale,
                                          String typeId);
 
+  /**
+   * Child column of a STRUCT/LIST handle (cudf-java
+   * ColumnView.getChildColumnView shape); the child is a NEW handle.
+   */
+  public static native long getChild(long handle, int index);
+
   /** Release a handle (exactly once). */
   public static native void free(long handle);
 }

@@ -913,6 +913,46 @@ void JNI_FN(TaskPriority, taskDone)(JNIEnv* env, jclass,
   Py_XDECREF(r);
 }
 
+// ------------------------------------------------------------- Protobuf
+
+PyObject* bools_to_pylist(JNIEnv* env, jbooleanArray arr) {
+  jsize n = env->GetArrayLength(arr);
+  jboolean* elems = env->GetBooleanArrayElements(arr, nullptr);
+  PyObject* list = PyList_New(n);
+  for (jsize i = 0; i < n; ++i) {
+    PyObject* b = elems[i] ? Py_True : Py_False;
+    Py_INCREF(b);
+    PyList_SET_ITEM(list, i, b);
+  }
+  env->ReleaseBooleanArrayElements(arr, elems, JNI_ABORT);
+  return list;
+}
+
+jlong JNI_FN(Protobuf, decodeToStruct)(JNIEnv* env, jclass, jlong col,
+                                       jintArray field_numbers,
+                                       jobjectArray type_ids,
+                                       jintArray encodings,
+                                       jbooleanArray required) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(LNNNN)", (long long)col, ints_to_pylist(env, field_numbers),
+      strings_to_pylist(env, type_ids), ints_to_pylist(env, encodings),
+      bools_to_pylist(env, required));
+  return as_jlong(env,
+                  call_entry(env, "protobuf_decode_to_struct", args));
+}
+
+// ----------------------------------------------- TpuColumns (children)
+
+jlong JNI_FN(TpuColumns, getChild)(JNIEnv* env, jclass, jlong col,
+                                   jint index) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Li)", (long long)col, (int)index);
+  return as_jlong(env, call_entry(env, "struct_child", args));
+}
+
 // --------------------------------------------------------- DecimalUtils
 
 static jlongArray decimal_binop(JNIEnv* env, const char* op, jlong a,

@@ -61,6 +61,7 @@ NATIVE_CLASSES = {
         ("fromDoubles", "([D)J"),
         ("fromStrings", "([Ljava/lang/String;)J"),
         ("fromDecimals", "([JILjava/lang/String;)J"),
+        ("getChild", "(JI)J"),
         ("free", "(J)V"),
     ],
     "DecimalUtils": [
@@ -71,6 +72,9 @@ NATIVE_CLASSES = {
     ],
     "DeviceAttr": [
         ("isIntegratedGPU", "()Z"),
+    ],
+    "Protobuf": [
+        ("decodeToStruct", "(J[I[Ljava/lang/String;[I[Z)J"),
     ],
     "Hash": [
         ("murmurHash32", "(I[J)J"),

@@ -384,6 +384,31 @@ def map_sort(handle: int, descending: bool) -> int:
                                              descending))
 
 
+def protobuf_decode_to_struct(handle: int,
+                              field_numbers: Sequence[int],
+                              type_ids: Sequence[str],
+                              encodings: Sequence[int],
+                              required: Sequence[bool]) -> int:
+    """Protobuf.java surface over the flat-schema device decoder
+    (ops/protobuf_device.py; ProtobufSchemaDescriptor's parallel
+    vectors collapse to these arrays for flat messages)."""
+    from spark_rapids_tpu.columns.dtypes import DType
+    from spark_rapids_tpu.ops import protobuf as pb
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    fields = [pb.Field(n, DType(t), enc, False, bool(req))
+              for n, t, enc, req in zip(field_numbers, type_ids,
+                                        encodings, required)]
+    return REGISTRY.register(
+        pb.decode_protobuf_to_struct(REGISTRY.get(handle), fields))
+
+
+def struct_child(handle: int, index: int) -> int:
+    """Child column of a STRUCT/LIST handle (cudf-java
+    ColumnView.getChildColumnView shape)."""
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(REGISTRY.get(handle).children[index])
+
+
 def task_priority_get(attempt_id: int) -> int:
     from spark_rapids_tpu.memory import task_priority
     return task_priority.get_task_priority(attempt_id)
