@@ -1,0 +1,11 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * ANSI cast failure (reference CastException.java; subclass of
+ * ExceptionWithRowIndex so existing catch blocks keep working).
+ */
+public class CastException extends ExceptionWithRowIndex {
+  public CastException(String message) {
+    super(message);
+  }
+}

@@ -1,0 +1,21 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * ANSI-mode error carrying the first failing row index across JNI
+ * (reference ExceptionWithRowIndex.java over
+ * exception_with_row_index.hpp:4-12; thrown by the shim when the
+ * runtime raises the Python exception of the same name).
+ */
+public class ExceptionWithRowIndex extends RuntimeException {
+  public ExceptionWithRowIndex(String message) {
+    super(message);
+  }
+
+  /** First failing row, parsed from the runtime's message. */
+  public long getRowIndex() {
+    java.util.regex.Matcher m =
+        java.util.regex.Pattern.compile("row (\\d+)").matcher(
+            getMessage());
+    return m.find() ? Long.parseLong(m.group(1)) : -1;
+  }
+}

@@ -1,0 +1,18 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Spark approx_count_distinct HLL++ (reference
+ * HyperLogLogPlusPlusHostUDF.java over hyper_log_log_plus_plus.cu —
+ * sketches packed 10x6-bit registers per long; TPU engine:
+ * spark_rapids_tpu/ops/hllpp.py with a self-measured bias table,
+ * documented divergence from Spark's knots within estimator noise).
+ */
+public final class HyperLogLogPlusPlusHostUDF {
+  private HyperLogLogPlusPlusHostUDF() {}
+
+  /** Whole-column sketch (1-row packed-register struct). */
+  public static native long reduce(long column, int precision);
+
+  /** INT64 estimates per sketch row. */
+  public static native long estimate(long sketches, int precision);
+}

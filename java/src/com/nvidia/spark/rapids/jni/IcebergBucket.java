@@ -1,0 +1,12 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Iceberg bucket partition transform (reference iceberg/IcebergBucket.java
+ * over iceberg_bucket.cu — murmur-based; TPU engine:
+ * spark_rapids_tpu/ops/iceberg.py, spec test vectors pass).
+ */
+public final class IcebergBucket {
+  private IcebergBucket() {}
+
+  public static native long bucket(long column, int numBuckets);
+}

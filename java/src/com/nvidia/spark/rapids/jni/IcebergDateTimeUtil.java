@@ -1,0 +1,13 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Iceberg datetime partition transforms (reference
+ * iceberg/IcebergDateTimeUtil.java over iceberg_datetime_util.cu; TPU
+ * engine: spark_rapids_tpu/ops/iceberg.py).
+ */
+public final class IcebergDateTimeUtil {
+  private IcebergDateTimeUtil() {}
+
+  /** component: "year" | "month" | "day" | "hour". */
+  public static native long transform(long column, String component);
+}

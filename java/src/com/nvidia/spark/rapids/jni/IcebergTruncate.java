@@ -1,0 +1,12 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Iceberg truncate partition transform (reference
+ * iceberg/IcebergTruncate.java over iceberg_truncate.cu; TPU engine:
+ * spark_rapids_tpu/ops/iceberg.py).
+ */
+public final class IcebergTruncate {
+  private IcebergTruncate() {}
+
+  public static native long truncate(long column, int width);
+}

@@ -40,6 +40,19 @@ public final class OomSmokeTest {
     RmmSpark.alloc(64);
     RmmSpark.dealloc(64);
 
+    long badCol = TpuColumns.fromStrings(new String[] {"12", "boom"});
+    try {
+      CastStrings.toInteger(badCol, true, true, "int32");
+      TestSupport.assertTrue(0,
+          "expected CastException was not thrown");
+    } catch (ExceptionWithRowIndex e) {
+      // the runtime raises CastException; the Java hierarchy makes a
+      // superclass catch work exactly as with the reference
+      System.out.println(
+          "caught ExceptionWithRowIndex (ANSI cast) across JNI");
+    }
+    TpuColumns.free(badCol);
+
     RmmSpark.taskDone(1);
     RmmSpark.clearEventHandler();
     System.out.println("OOM smoke: ALL OK");

@@ -894,6 +894,58 @@ jlong JNI_FN(Map, sortMapColumn)(JNIEnv* env, jclass, jlong col,
   return as_jlong(env, call_entry(env, "map_sort", args));
 }
 
+// -------------------------------------------------------------- Iceberg
+
+jlong JNI_FN(IcebergBucket, bucket)(JNIEnv* env, jclass, jlong col,
+                                    jint num_buckets) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Li)", (long long)col,
+                                 (int)num_buckets);
+  return as_jlong(env, call_entry(env, "iceberg_bucket", args));
+}
+
+jlong JNI_FN(IcebergTruncate, truncate)(JNIEnv* env, jclass, jlong col,
+                                        jint width) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Li)", (long long)col, (int)width);
+  return as_jlong(env, call_entry(env, "iceberg_truncate", args));
+}
+
+jlong JNI_FN(IcebergDateTimeUtil, transform)(JNIEnv* env, jclass,
+                                             jlong col,
+                                             jstring component) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  const char* c = env->GetStringUTFChars(component, nullptr);
+  PyObject* args = Py_BuildValue("(Ls)", (long long)col, c);
+  env->ReleaseStringUTFChars(component, c);
+  return as_jlong(env, call_entry(env, "iceberg_datetime", args));
+}
+
+// ------------------------------------------ HyperLogLogPlusPlusHostUDF
+
+jlong JNI_FN(HyperLogLogPlusPlusHostUDF, reduce)(JNIEnv* env, jclass,
+                                                 jlong col,
+                                                 jint precision) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Li)", (long long)col,
+                                 (int)precision);
+  return as_jlong(env, call_entry(env, "hllpp_reduce", args));
+}
+
+jlong JNI_FN(HyperLogLogPlusPlusHostUDF, estimate)(JNIEnv* env, jclass,
+                                                   jlong sketches,
+                                                   jint precision) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Li)", (long long)sketches,
+                                 (int)precision);
+  return as_jlong(env, call_entry(env, "hllpp_estimate", args));
+}
+
 // --------------------------------------------------------- TaskPriority
 
 jlong JNI_FN(TaskPriority, getTaskPriority)(JNIEnv* env, jclass,

@@ -25,6 +25,14 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+# pin the CPU backend BEFORE any spark_rapids_tpu import: the ops
+# package builds device tables at import time, and the default axon
+# backend wedges when the TPU relay is down
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
 from jasm import ACC_PUBLIC, ClassFile, Code, Label  # noqa: E402
 
 PKG = "com/nvidia/spark/rapids/jni"
@@ -35,15 +43,24 @@ PKG = "com/nvidia/spark/rapids/jni"
 # the Python names the shim maps by (bases excluded — only concrete
 # thrown types cross JNI).
 def _exception_classes():
+    """{name: java_superclass} derived from the Python hierarchy, so a
+    Java catch of a base type keeps matching subclasses exactly as the
+    runtime's raises do."""
     import inspect
 
-    from spark_rapids_tpu.memory import exceptions as exc
-    out = []
-    for name, obj in vars(exc).items():
-        if (inspect.isclass(obj) and issubclass(obj, Exception)
-                and not name.endswith("Base")):
-            out.append(name)
-    return sorted(out)
+    from spark_rapids_tpu.memory import exceptions as mem_exc
+    from spark_rapids_tpu.ops import exceptions as ops_exc
+    names = set()
+    bases = {}
+    for mod in (mem_exc, ops_exc):
+        for name, obj in vars(mod).items():
+            if (inspect.isclass(obj) and issubclass(obj, Exception)
+                    and not name.endswith("Base")):
+                names.add(name)
+                bases[name] = obj.__bases__[0].__name__
+    return {n: (f"{PKG}/{bases[n]}" if bases[n] in names
+                else "java/lang/RuntimeException")
+            for n in sorted(names)}
 
 
 EXCEPTION_CLASSES = _exception_classes()
@@ -75,6 +92,19 @@ NATIVE_CLASSES = {
     ],
     "Protobuf": [
         ("decodeToStruct", "(J[I[Ljava/lang/String;[I[Z)J"),
+    ],
+    "IcebergBucket": [
+        ("bucket", "(JI)J"),
+    ],
+    "IcebergTruncate": [
+        ("truncate", "(JI)J"),
+    ],
+    "IcebergDateTimeUtil": [
+        ("transform", "(JLjava/lang/String;)J"),
+    ],
+    "HyperLogLogPlusPlusHostUDF": [
+        ("reduce", "(JI)J"),
+        ("estimate", "(JI)J"),
     ],
     "Hash": [
         ("murmurHash32", "(I[J)J"),
@@ -218,10 +248,8 @@ MURMUR_GOLD = [1485273170, 1709559900, 176121990]
 
 
 def _computed_goldens():
-    """xxhash64 goldens from the (golden-validated) Python engine."""
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_enable_x64", True)
+    """xxhash64 goldens from the (golden-validated) Python engine
+    (CPU backend pinned once at module top)."""
     from spark_rapids_tpu.columns import dtypes
     from spark_rapids_tpu.columns.column import Column
     from spark_rapids_tpu.ops import xxhash64
@@ -241,16 +269,19 @@ def build_natives(outdir: str):
 
 
 def build_exceptions(outdir: str):
-    """Typed OOM exceptions: public <init>(String) chaining to
-    RuntimeException, thrown from the shim by Python type name."""
-    for name in EXCEPTION_CLASSES:
-        cf = ClassFile(f"{PKG}/{name}",
-                       super_name="java/lang/RuntimeException")
+    """Typed exceptions: public <init>(String) chaining to the
+    superclass, thrown from the shim by Python type name."""
+    # parents first so subclass emission order never matters at load
+    names = sorted(EXCEPTION_CLASSES,
+                   key=lambda n: EXCEPTION_CLASSES[n] != 
+                   "java/lang/RuntimeException")
+    for name in names:
+        sup = EXCEPTION_CLASSES[name]
+        cf = ClassFile(f"{PKG}/{name}", super_name=sup, final=False)
         c = Code(cf.cp, max_locals=2)
         c.aload(0)
         c.aload(1)
-        c.invokespecial("java/lang/RuntimeException", "<init>",
-                        "(Ljava/lang/String;)V")
+        c.invokespecial(sup, "<init>", "(Ljava/lang/String;)V")
         c.return_void()
         cf.add_code_method("<init>", "(Ljava/lang/String;)V", c,
                            flags=ACC_PUBLIC)
@@ -315,6 +346,39 @@ def build_oom_smoke_test(outdir: str):
                      "caught GpuRetryOOM across JNI")
     forced_oom_block("forceSplitAndRetryOOM", "GpuSplitAndRetryOOM",
                      "caught GpuSplitAndRetryOOM across JNI")
+
+    # ANSI cast error: Python raises CastException; catching the Java
+    # SUPERCLASS ExceptionWithRowIndex proves the emitted hierarchy
+    BADCOL = 5
+    c.string_array(["12", "boom"])
+    c.invokestatic(J + "TpuColumns", "fromStrings",
+                   "([Ljava/lang/String;)J")
+    c.lstore(BADCOL)
+    t_start, t_end, handler, after = (Label(), Label(), Label(),
+                                      Label())
+    c.place(t_start)
+    c.lload(BADCOL)
+    c.iconst(1)                  # ansi=true
+    c.iconst(1)                  # strip=true
+    c.ldc_string("int32")
+    c.invokestatic(J + "CastStrings", "toInteger",
+                   "(JZZLjava/lang/String;)J")
+    c.pop2_op()                  # discard the (never-produced) handle
+    c.iconst(0)
+    c.ldc_string("expected CastException was not thrown")
+    c.invokestatic(J + "TestSupport", "assertTrue",
+                   "(ILjava/lang/String;)V")
+    c.place(t_end)
+    c.goto(after)
+    c.place(handler)
+    c.handler_entry()
+    c.astore(4)
+    c.println("caught ExceptionWithRowIndex (ANSI cast) across JNI")
+    c.place(after)
+    c.try_catch(t_start, t_end, handler,
+                J + "ExceptionWithRowIndex")
+    c.lload(BADCOL)
+    c.invokestatic(J + "TpuColumns", "free", "(J)V")
 
     c.lconst(1)
     c.invokestatic(J + "RmmSpark", "taskDone", "(J)V")

@@ -353,11 +353,12 @@ class Code:
 
 class ClassFile:
     def __init__(self, name: str, super_name="java/lang/Object",
-                 major=52):
+                 major=52, final=True):
         self.cp = ConstPool()
         self.name = name
         self.super_name = super_name
         self.major = major
+        self.final = final     # exception hierarchies need non-final
         self.methods: List[Tuple[int, int, int, bytes]] = []
 
     def add_native(self, name: str, desc: str,
@@ -392,8 +393,9 @@ class ClassFile:
                                       n_attr) + attr)
         head = struct.pack(">IHH", 0xCAFEBABE, 0, self.major)
         pool = self.cp.serialize()
-        mid = struct.pack(">HHHH", ACC_PUBLIC | ACC_SUPER | ACC_FINAL,
-                          this_c, super_c, 0)
+        flags = ACC_PUBLIC | ACC_SUPER | (ACC_FINAL if self.final
+                                          else 0)
+        mid = struct.pack(">HHHH", flags, this_c, super_c, 0)
         fields = struct.pack(">H", 0)
         methods = struct.pack(">H", len(self.methods)) + b"".join(mbytes)
         attrs = struct.pack(">H", 0)

@@ -409,6 +409,43 @@ def struct_child(handle: int, index: int) -> int:
     return REGISTRY.register(REGISTRY.get(handle).children[index])
 
 
+def iceberg_bucket(handle: int, num_buckets: int) -> int:
+    from spark_rapids_tpu.ops import iceberg as IB
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(IB.bucket(REGISTRY.get(handle),
+                                       num_buckets))
+
+
+def iceberg_truncate(handle: int, width: int) -> int:
+    from spark_rapids_tpu.ops import iceberg as IB
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(IB.truncate(REGISTRY.get(handle), width))
+
+
+def iceberg_datetime(handle: int, component: str) -> int:
+    from spark_rapids_tpu.ops import iceberg as IB
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    fn = {"year": IB.year, "month": IB.month, "day": IB.day,
+          "hour": IB.hour}[component]
+    return REGISTRY.register(fn(REGISTRY.get(handle)))
+
+
+def hllpp_reduce(handle: int, precision: int) -> int:
+    """HLL++ sketch of a whole column (reduce path,
+    hyper_log_log_plus_plus.hpp reduce_hyper_log_log_plus_plus)."""
+    from spark_rapids_tpu.ops.hllpp import reduce_hllpp
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(reduce_hllpp(REGISTRY.get(handle),
+                                          precision))
+
+
+def hllpp_estimate(handle: int, precision: int) -> int:
+    from spark_rapids_tpu.ops.hllpp import estimate_from_hll_sketches
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(estimate_from_hll_sketches(
+        REGISTRY.get(handle), precision))
+
+
 def task_priority_get(attempt_id: int) -> int:
     from spark_rapids_tpu.memory import task_priority
     return task_priority.get_task_priority(attempt_id)

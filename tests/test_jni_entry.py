@@ -193,3 +193,19 @@ def test_protobuf_and_children():
     assert J.column_to_host(st) == [(150, "hi")]
     child0 = J.struct_child(st, 0)
     assert J.column_to_host(child0) == [150]
+
+
+def test_iceberg_and_hllpp():
+    ic = J.from_longs([5, 6, 7])
+    assert J.column_to_host(J.iceberg_bucket(ic, 8)) == [7, 1, 3]
+    assert J.column_to_host(J.iceberg_truncate(ic, 5)) == [5, 5, 5]
+    from spark_rapids_tpu.columns import dtypes
+    from spark_rapids_tpu.columns.column import Column
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    ts = REGISTRY.register(Column.from_pylist(
+        [1_600_000_000_000_000], dtypes.TIMESTAMP_MICROS))
+    assert J.column_to_host(J.iceberg_datetime(ts, "year")) == [50]
+    h = J.from_longs(list(range(1000)))
+    sk = J.hllpp_reduce(h, 9)
+    est = J.column_to_host(J.hllpp_estimate(sk, 9))[0]
+    assert 900 < est < 1100     # +-10% at precision 9
