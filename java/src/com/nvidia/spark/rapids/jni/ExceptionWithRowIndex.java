@@ -13,9 +13,12 @@ public class ExceptionWithRowIndex extends RuntimeException {
 
   /** First failing row, parsed from the runtime's message. */
   public long getRowIndex() {
+    String msg = getMessage();
+    if (msg == null) {
+      return -1;
+    }
     java.util.regex.Matcher m =
-        java.util.regex.Pattern.compile("row (\\d+)").matcher(
-            getMessage());
+        java.util.regex.Pattern.compile("row (\\d+)").matcher(msg);
     return m.find() ? Long.parseLong(m.group(1)) : -1;
   }
 }

@@ -48,6 +48,8 @@ public final class OomSmokeTest {
     } catch (ExceptionWithRowIndex e) {
       // the runtime raises CastException; the Java hierarchy makes a
       // superclass catch work exactly as with the reference
+      TestSupport.assertTrue(e.getRowIndex() == 1 ? 1 : 0,
+          "getRowIndex() != 1 for the ANSI cast error");
       System.out.println(
           "caught ExceptionWithRowIndex (ANSI cast) across JNI");
     }

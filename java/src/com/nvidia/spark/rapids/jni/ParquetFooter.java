@@ -1,0 +1,18 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Host-side parquet footer parse + column pruning (reference
+ * ParquetFooter.java:225 over NativeParquetJni.cpp's thrift
+ * TCompactProtocol parser; TPU runtime:
+ * spark_rapids_tpu/io/parquet_footer.py — parse, prune with
+ * case-(in)sensitive matching, re-serialize).
+ */
+public final class ParquetFooter {
+  private ParquetFooter() {}
+
+  /** Footer bytes -> pruned footer bytes keeping only the named
+   *  top-level columns (nested subtrees preserved whole). */
+  public static native byte[] readAndFilter(byte[] footer,
+                                            String[] keepNames,
+                                            boolean caseSensitive);
+}

@@ -1,0 +1,18 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * JVM-side thread map the OOM machine calls back into (reference
+ * ThreadStateRegistry.java:44-53; TPU runtime:
+ * spark_rapids_tpu/memory/thread_state_registry.py — the adaptor's
+ * removal paths invoke removeThread exactly like
+ * SparkResourceAdaptorJni.cpp:66-80).
+ */
+public final class ThreadStateRegistry {
+  private ThreadStateRegistry() {}
+
+  public static native void addThread(long nativeId);
+
+  public static native void removeThread(long nativeId);
+
+  public static native long[] knownThreads();
+}

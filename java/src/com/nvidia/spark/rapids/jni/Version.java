@@ -1,0 +1,18 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Spark platform/version predicates passed to kernels whose semantics
+ * differ per distro (reference Version.java / version.hpp
+ * spark_system; TPU runtime: spark_rapids_tpu/utils/platform.py).
+ */
+public final class Version {
+  private Version() {}
+
+  /** SparkPlatformType ordinals (SparkPlatformType.java:17-37). */
+  public static final int VANILLA_SPARK = 0;
+  public static final int DATABRICKS = 1;
+  public static final int CLOUDERA = 2;
+
+  public static native boolean isVanilla320(int platform, int major,
+                                            int minor, int patch);
+}

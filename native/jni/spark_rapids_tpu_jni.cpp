@@ -946,6 +946,66 @@ jlong JNI_FN(HyperLogLogPlusPlusHostUDF, estimate)(JNIEnv* env, jclass,
   return as_jlong(env, call_entry(env, "hllpp_estimate", args));
 }
 
+// -------------------------------------------------------- ParquetFooter
+
+jbyteArray JNI_FN(ParquetFooter, readAndFilter)(
+    JNIEnv* env, jclass, jbyteArray footer, jobjectArray keep_names,
+    jboolean case_sensitive) {
+  if (!ensure_runtime(env)) return nullptr;
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(NNO)", bytes_to_py(env, footer),
+      strings_to_pylist(env, keep_names),
+      case_sensitive ? Py_True : Py_False);
+  return as_jbyte_array(
+      env, call_entry(env, "parquet_footer_read_and_filter", args));
+}
+
+// -------------------------------------------------------------- Version
+
+jboolean JNI_FN(Version, isVanilla320)(JNIEnv* env, jclass,
+                                       jint platform, jint major,
+                                       jint minor, jint patch) {
+  if (!ensure_runtime(env)) return JNI_FALSE;
+  Gil gil;
+  PyObject* r = call_entry(
+      env, "version_is_vanilla_320",
+      Py_BuildValue("(iiii)", (int)platform, (int)major, (int)minor,
+                    (int)patch));
+  if (r == nullptr) return JNI_FALSE;
+  jboolean v = PyObject_IsTrue(r) ? JNI_TRUE : JNI_FALSE;
+  Py_DECREF(r);
+  return v;
+}
+
+// -------------------------------------------------- ThreadStateRegistry
+
+void JNI_FN(ThreadStateRegistry, addThread)(JNIEnv* env, jclass,
+                                            jlong native_id) {
+  if (!ensure_runtime(env)) return;
+  Gil gil;
+  PyObject* r = call_entry(env, "registry_add_thread",
+                           Py_BuildValue("(L)", (long long)native_id));
+  Py_XDECREF(r);
+}
+
+void JNI_FN(ThreadStateRegistry, removeThread)(JNIEnv* env, jclass,
+                                               jlong native_id) {
+  if (!ensure_runtime(env)) return;
+  Gil gil;
+  PyObject* r = call_entry(env, "registry_remove_thread",
+                           Py_BuildValue("(L)", (long long)native_id));
+  Py_XDECREF(r);
+}
+
+jlongArray JNI_FN(ThreadStateRegistry, knownThreads)(JNIEnv* env,
+                                                     jclass) {
+  if (!ensure_runtime(env)) return nullptr;
+  Gil gil;
+  return as_jlong_array(env, call_entry(env, "registry_known_threads",
+                                        PyTuple_New(0)));
+}
+
 // --------------------------------------------------------- TaskPriority
 
 jlong JNI_FN(TaskPriority, getTaskPriority)(JNIEnv* env, jclass,

@@ -224,6 +224,17 @@ NATIVE_CLASSES = {
         ("convertTimestampToUTC", "(JLjava/lang/String;)J"),
         ("convertUTCTimestampToTimeZone", "(JLjava/lang/String;)J"),
     ],
+    "ParquetFooter": [
+        ("readAndFilter", "([B[Ljava/lang/String;Z)[B"),
+    ],
+    "Version": [
+        ("isVanilla320", "(IIII)Z"),
+    ],
+    "ThreadStateRegistry": [
+        ("addThread", "(J)V"),
+        ("removeThread", "(J)V"),
+        ("knownThreads", "()[J"),
+    ],
     "TaskPriority": [
         ("getTaskPriority", "(J)J"),
         ("taskDone", "(J)V"),
@@ -268,16 +279,101 @@ def build_natives(outdir: str):
             f.write(cf.serialize())
 
 
+def _emit_get_row_index(cf: "ClassFile"):
+    """public long getRowIndex(): the ExceptionWithRowIndex.java
+    contract — first 'row <digits>' occurrence (a bare 'row ' without
+    digits keeps scanning, matching the source's regex find()), digits
+    accumulated in a LONG.  Divergence from the source only past
+    Long.MAX_VALUE digits (parseLong throws; this wraps).
+    Locals: 0=this 1=msg 2=i 3=j 4=c 5-6=v."""
+    c = Code(cf.cp, max_locals=7)
+    l_neg, l_find, l_digits, l_ret = (Label(), Label(), Label(),
+                                      Label())
+    c.aload(0)
+    c.invokevirtual("java/lang/Throwable", "getMessage",
+                    "()Ljava/lang/String;")
+    c.astore(1)
+    c.aload(1)
+    c.ifnull(l_neg)
+    c.iconst(-1)
+    c.istore(2)
+    c.place(l_find)                       # i = indexOf("row ", i+1)
+    c.aload(1)
+    c.ldc_string("row ")
+    c.iload(2)
+    c.iconst(1)
+    c.iadd()
+    c.invokevirtual("java/lang/String", "indexOf",
+                    "(Ljava/lang/String;I)I")
+    c.istore(2)
+    c.iload(2)
+    c.iflt(l_neg)
+    c.iload(2)
+    c.iconst(4)
+    c.iadd()
+    c.istore(3)                           # j = i + 4
+    c.iload(3)
+    c.aload(1)
+    c.invokevirtual("java/lang/String", "length", "()I")
+    c.if_icmp("ge", l_find)
+    c.aload(1)
+    c.iload(3)
+    c.invokevirtual("java/lang/String", "charAt", "(I)C")
+    c.istore(4)
+    c.iload(4)
+    c.iconst(ord("0"))
+    c.if_icmp("lt", l_find)
+    c.iload(4)
+    c.iconst(ord("9"))
+    c.if_icmp("gt", l_find)
+    c.lconst(0)                           # v = 0L (>=1 digit known)
+    c.lstore(5)
+    c.place(l_digits)
+    c.iload(3)
+    c.aload(1)
+    c.invokevirtual("java/lang/String", "length", "()I")
+    c.if_icmp("ge", l_ret)
+    c.aload(1)
+    c.iload(3)
+    c.invokevirtual("java/lang/String", "charAt", "(I)C")
+    c.istore(4)
+    c.iload(4)
+    c.iconst(ord("0"))
+    c.if_icmp("lt", l_ret)
+    c.iload(4)
+    c.iconst(ord("9"))
+    c.if_icmp("gt", l_ret)
+    c.lload(5)                            # v = v*10 + (c-'0')
+    c.lconst(10)
+    c.lmul()
+    c.iload(4)
+    c.iconst(ord("0"))
+    c.isub()
+    c.i2l()
+    c.ladd()
+    c.lstore(5)
+    c.iinc(3, 1)
+    c.goto(l_digits)
+    c.place(l_ret)
+    c.lload(5)
+    c.lreturn()
+    c.place(l_neg)
+    c.lconst(-1)
+    c.lreturn()
+    c.max_stack = max(c.max_stack, 8)     # linear tracker + branches
+    cf.add_code_method("getRowIndex", "()J", c, flags=ACC_PUBLIC)
+
+
 def build_exceptions(outdir: str):
     """Typed exceptions: public <init>(String) chaining to the
-    superclass, thrown from the shim by Python type name."""
-    # parents first so subclass emission order never matters at load
-    names = sorted(EXCEPTION_CLASSES,
-                   key=lambda n: EXCEPTION_CLASSES[n] != 
-                   "java/lang/RuntimeException")
-    for name in names:
+    superclass, thrown from the shim by Python type name.  (Emission
+    order is irrelevant: the JVM resolves superclasses lazily from
+    the classpath.)  Emitted at major 49: getRowIndex carries a loop.
+    """
+    for name in EXCEPTION_CLASSES:
         sup = EXCEPTION_CLASSES[name]
-        cf = ClassFile(f"{PKG}/{name}", super_name=sup, final=False)
+        cf = ClassFile(f"{PKG}/{name}", super_name=sup, final=False,
+                       major=49)
         c = Code(cf.cp, max_locals=2)
         c.aload(0)
         c.aload(1)
@@ -285,6 +381,8 @@ def build_exceptions(outdir: str):
         c.return_void()
         cf.add_code_method("<init>", "(Ljava/lang/String;)V", c,
                            flags=ACC_PUBLIC)
+        if name == "ExceptionWithRowIndex":
+            _emit_get_row_index(cf)
         path = os.path.join(outdir, PKG, name + ".class")
         os.makedirs(os.path.dirname(path), exist_ok=True)
         with open(path, "wb") as f:
@@ -373,6 +471,18 @@ def build_oom_smoke_test(outdir: str):
     c.place(handler)
     c.handler_entry()
     c.astore(4)
+    # the typed exception's API works too: row index parses to 1
+    rownum_ok = Label()
+    c.aload(4)
+    c.invokevirtual(J + "ExceptionWithRowIndex", "getRowIndex", "()J")
+    c.lconst(1)
+    c.lcmp()
+    c.ifeq_lbl(rownum_ok)
+    c.iconst(0)
+    c.ldc_string("getRowIndex() != 1 for the ANSI cast error")
+    c.invokestatic(J + "TestSupport", "assertTrue",
+                   "(ILjava/lang/String;)V")
+    c.place(rownum_ok)
     c.println("caught ExceptionWithRowIndex (ANSI cast) across JNI")
     c.place(after)
     c.try_catch(t_start, t_end, handler,

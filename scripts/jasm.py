@@ -124,6 +124,59 @@ class Code:
     def goto(self, label: Label):
         self._branch(0xA7, label)
 
+    def ifnull(self, label: Label):
+        self._pop()
+        self._branch(0xC6, label)
+
+    def iflt(self, label: Label):
+        self._pop()
+        self._branch(0x9B, label)
+
+    def ifeq_lbl(self, label: Label):
+        self._pop()
+        self._branch(0x99, label)
+
+    def if_icmp(self, cond: str, label: Label):
+        op = {"eq": 0x9F, "ne": 0xA0, "lt": 0xA1, "ge": 0xA2,
+              "gt": 0xA3, "le": 0xA4}[cond]
+        self._pop(2)
+        self._branch(op, label)
+
+    def iadd(self):
+        self._pop()
+        self.b.append(0x60)
+
+    def isub(self):
+        self._pop()
+        self.b.append(0x64)
+
+    def imul(self):
+        self._pop()
+        self.b.append(0x68)
+
+    def i2l(self):
+        self._push()
+        self.b.append(0x85)
+
+    def iinc(self, idx: int, const: int):
+        self.b += struct.pack(">BBb", 0x84, idx, const)
+
+    def lreturn(self):
+        self._pop(2)
+        self.b.append(0xAD)
+
+    def lcmp(self):
+        self._pop(3)
+        self.b.append(0x94)
+
+    def ladd(self):
+        self._pop(2)
+        self.b.append(0x61)
+
+    def lmul(self):
+        self._pop(2)
+        self.b.append(0x69)
+
     def handler_entry(self):
         """Stack at a catch-handler entry holds the exception ref."""
         self._stack = 1

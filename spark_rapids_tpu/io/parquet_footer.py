@@ -226,6 +226,14 @@ def _schema_elements(tree) -> List:
     return _sval(tree, 2)[2]
 
 
+def schema_names(tree) -> List[str]:
+    """Schema element names in order, root excluded — THE helper for
+    asserting pruning results (used by the footer tests and the JNI
+    surface tests; keeps field-id knowledge in one place)."""
+    return [_sval(e, 4).decode() for e in _schema_elements(tree)[1:]
+            if _sval(e, 4) is not None]
+
+
 def prune_columns(tree, keep_names: List[str],
                   case_sensitive: bool = True):
     """Trim the footer to the requested TOP-LEVEL columns (nested

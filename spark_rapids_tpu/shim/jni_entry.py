@@ -425,9 +425,12 @@ def iceberg_truncate(handle: int, width: int) -> int:
 def iceberg_datetime(handle: int, component: str) -> int:
     from spark_rapids_tpu.ops import iceberg as IB
     from spark_rapids_tpu.shim.handles import REGISTRY
-    fn = {"year": IB.year, "month": IB.month, "day": IB.day,
-          "hour": IB.hour}[component]
-    return REGISTRY.register(fn(REGISTRY.get(handle)))
+    table = {"year": IB.year, "month": IB.month, "day": IB.day,
+             "hour": IB.hour}
+    if component not in table:
+        raise ValueError(f"unsupported component {component!r}: "
+                         f"expected year|month|day|hour")
+    return REGISTRY.register(table[component](REGISTRY.get(handle)))
 
 
 def hllpp_reduce(handle: int, precision: int) -> int:
@@ -444,6 +447,39 @@ def hllpp_estimate(handle: int, precision: int) -> int:
     from spark_rapids_tpu.shim.handles import REGISTRY
     return REGISTRY.register(estimate_from_hll_sketches(
         REGISTRY.get(handle), precision))
+
+
+def parquet_footer_read_and_filter(data: bytes,
+                                   keep_names: Sequence[str],
+                                   case_sensitive: bool) -> bytes:
+    """ParquetFooter.readAndFilter (ParquetFooter.java:225): parse the
+    thrift footer, prune to the requested columns, re-serialize."""
+    from spark_rapids_tpu.io import parquet_footer as PF
+    tree = PF.parse_footer(bytes(data))
+    pruned = PF.prune_columns(tree, list(keep_names),
+                              case_sensitive=case_sensitive)
+    return PF.serialize_footer(pruned)
+
+
+def version_is_vanilla_320(platform: int, major: int, minor: int,
+                           patch: int) -> bool:
+    from spark_rapids_tpu.utils.platform import SparkSystem
+    return SparkSystem(platform, major, minor, patch).is_vanilla_320()
+
+
+def registry_add_thread(native_id: int) -> None:
+    from spark_rapids_tpu.memory.thread_state_registry import REGISTRY
+    REGISTRY.add_thread(native_id)
+
+
+def registry_remove_thread(native_id: int) -> None:
+    from spark_rapids_tpu.memory.thread_state_registry import REGISTRY
+    REGISTRY.remove_thread(native_id)
+
+
+def registry_known_threads() -> List[int]:
+    from spark_rapids_tpu.memory.thread_state_registry import REGISTRY
+    return REGISTRY.known_threads()
 
 
 def task_priority_get(attempt_id: int) -> int:

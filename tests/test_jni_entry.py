@@ -209,3 +209,30 @@ def test_iceberg_and_hllpp():
     sk = J.hllpp_reduce(h, 9)
     est = J.column_to_host(J.hllpp_estimate(sk, 9))[0]
     assert 900 < est < 1100     # +-10% at precision 9
+
+
+def test_parquet_footer_version_registry(tmp_path):
+    pd = pytest.importorskip("pandas")
+    import numpy as np
+
+    path = tmp_path / "t.parquet"
+    pd.DataFrame({
+        "id": np.arange(4, dtype=np.int64),
+        "name": ["a", "b", "c", "d"],
+        "score": np.linspace(0, 1, 4),
+    }).to_parquet(path)
+    raw = path.read_bytes()
+    import struct
+    flen = struct.unpack("<I", raw[-8:-4])[0]
+    footer = raw[-8 - flen:-8]
+    pruned = J.parquet_footer_read_and_filter(footer, ["id"], True)
+    from spark_rapids_tpu.io import parquet_footer as pf
+    assert pf.schema_names(pf.parse_footer(pruned)) == ["id"]
+
+    assert J.version_is_vanilla_320(0, 3, 2, 1) is True
+    assert J.version_is_vanilla_320(0, 3, 5, 0) is False
+
+    J.registry_add_thread(31337)
+    assert 31337 in J.registry_known_threads()
+    J.registry_remove_thread(31337)
+    assert 31337 not in J.registry_known_threads()

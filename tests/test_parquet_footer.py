@@ -25,9 +25,7 @@ def pq_file(tmp_path):
 
 
 def names_of(tree):
-    elems = pf._schema_elements(tree)
-    return [pf._sval(e, 4).decode() for e in elems[1:]
-            if pf._sval(e, 4) is not None]
+    return pf.schema_names(tree)
 
 
 def test_parse_real_footer(pq_file):
