@@ -1,0 +1,43 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Try-with-resources helpers (reference Arms.java:27-93 — pure Java in
+ * the reference too; closes resources defensively and rethrows the
+ * first failure).
+ */
+public final class Arms {
+  private Arms() {}
+
+  /** Close quietly, collecting the first exception into `pending`. */
+  public static <R extends AutoCloseable> RuntimeException closeQuietly(
+      R resource, RuntimeException pending) {
+    if (resource != null) {
+      try {
+        resource.close();
+      } catch (Exception e) {
+        if (pending == null) {
+          // keep typed unchecked exceptions (GpuRetryOOM, ...) intact
+          // so callers' typed catch blocks still match — same
+          // semantics as the runtime's arms.close_all
+          pending = e instanceof RuntimeException
+              ? (RuntimeException) e : new RuntimeException(e);
+        } else {
+          pending.addSuppressed(e);
+        }
+      }
+    }
+    return pending;
+  }
+
+  /** Close all, then throw the first collected failure if any. */
+  public static <R extends AutoCloseable> void closeAll(
+      Iterable<R> resources) {
+    RuntimeException pending = null;
+    for (R r : resources) {
+      pending = closeQuietly(r, pending);
+    }
+    if (pending != null) {
+      throw pending;
+    }
+  }
+}

@@ -1,0 +1,24 @@
+package com.nvidia.spark.rapids.jni;
+
+/** Minimal immutable pair (reference Pair.java — pure Java util). */
+public final class Pair<K, V> {
+  private final K left;
+  private final V right;
+
+  public Pair(K left, V right) {
+    this.left = left;
+    this.right = right;
+  }
+
+  public K getLeft() {
+    return left;
+  }
+
+  public V getRight() {
+    return right;
+  }
+
+  public static <K, V> Pair<K, V> of(K left, V right) {
+    return new Pair<>(left, right);
+  }
+}

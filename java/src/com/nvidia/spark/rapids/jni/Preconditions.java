@@ -1,0 +1,21 @@
+package com.nvidia.spark.rapids.jni;
+
+import java.util.function.Supplier;
+
+/** Argument/state checks (reference Preconditions.java — pure Java). */
+public final class Preconditions {
+  private Preconditions() {}
+
+  public static void ensure(boolean condition, String message) {
+    if (!condition) {
+      throw new IllegalStateException(message);
+    }
+  }
+
+  public static void ensure(boolean condition,
+                            Supplier<String> message) {
+    if (!condition) {
+      throw new IllegalStateException(message.get());
+    }
+  }
+}

@@ -1,0 +1,19 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Thread states of the OOM machine for assertions (reference
+ * RmmSparkThreadState.java; names match the runtime's transition log
+ * and RmmSpark.getStateOf strings).
+ */
+public enum RmmSparkThreadState {
+  UNKNOWN,
+  THREAD_RUNNING,
+  THREAD_ALLOC,
+  THREAD_ALLOC_FREE,
+  THREAD_BLOCKED,
+  THREAD_BUFN_THROW,
+  THREAD_BUFN_WAIT,
+  THREAD_BUFN,
+  THREAD_SPLIT_THROW,
+  THREAD_REMOVE_THROW;
+}

@@ -1,0 +1,13 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Spark distribution discriminator passed to version predicates
+ * (reference SparkPlatformType.java:17-37 — ordinals must stay in sync
+ * with the native enum; here with Version.isVanilla320's platform arg
+ * and spark_rapids_tpu/utils/platform.py).
+ */
+public enum SparkPlatformType {
+  VANILLA_SPARK,
+  DATABRICKS,
+  CLOUDERA;
+}

@@ -8,10 +8,14 @@ package com.nvidia.spark.rapids.jni;
 public final class Version {
   private Version() {}
 
-  /** SparkPlatformType ordinals (SparkPlatformType.java:17-37). */
-  public static final int VANILLA_SPARK = 0;
-  public static final int DATABRICKS = 1;
-  public static final int CLOUDERA = 2;
+  /** Platform codes derive from the enum — ONE mapping (and it must
+   *  stay in sync with spark_rapids_tpu/utils/platform.py). */
+  public static final int VANILLA_SPARK =
+      SparkPlatformType.VANILLA_SPARK.ordinal();
+  public static final int DATABRICKS =
+      SparkPlatformType.DATABRICKS.ordinal();
+  public static final int CLOUDERA =
+      SparkPlatformType.CLOUDERA.ordinal();
 
   public static native boolean isVanilla320(int platform, int major,
                                             int minor, int patch);
