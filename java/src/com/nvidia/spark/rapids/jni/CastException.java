@@ -8,4 +8,8 @@ public class CastException extends ExceptionWithRowIndex {
   public CastException(String message) {
     super(message);
   }
+
+  public CastException(String message, int rowIndex) {
+    super(message, rowIndex);
+  }
 }

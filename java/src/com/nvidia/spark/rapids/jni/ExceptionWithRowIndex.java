@@ -5,20 +5,26 @@ package com.nvidia.spark.rapids.jni;
  * (reference ExceptionWithRowIndex.java over
  * exception_with_row_index.hpp:4-12; thrown by the shim when the
  * runtime raises the Python exception of the same name).
+ *
+ * The row index is carried as a field, marshalled by the native shim
+ * from the Python exception's {@code row_index} attribute via the
+ * (String, int) constructor — matching the reference's
+ * {@code public int getRowIndex()} descriptor exactly.
  */
 public class ExceptionWithRowIndex extends RuntimeException {
+  private final int rowIndex;
+
   public ExceptionWithRowIndex(String message) {
-    super(message);
+    this(message, -1);
   }
 
-  /** First failing row, parsed from the runtime's message. */
-  public long getRowIndex() {
-    String msg = getMessage();
-    if (msg == null) {
-      return -1;
-    }
-    java.util.regex.Matcher m =
-        java.util.regex.Pattern.compile("row (\\d+)").matcher(msg);
-    return m.find() ? Long.parseLong(m.group(1)) : -1;
+  public ExceptionWithRowIndex(String message, int rowIndex) {
+    super(message);
+    this.rowIndex = rowIndex;
+  }
+
+  /** First failing row, or -1 if unknown. */
+  public int getRowIndex() {
+    return rowIndex;
   }
 }

@@ -43,11 +43,30 @@ void throw_java(JNIEnv* env, const char* msg) {
 }
 
 // Format the pending Python exception into a string and clear it.
-std::string pending_python_error() {
+// Formats the pending Python error as "TypeName: message".  When
+// row_index is non-null it receives the exception's integer row_index
+// attribute (the ExceptionWithRowIndex family carries the first
+// failing row there), or -1 when absent — so the Java side gets the
+// index as a field, never by parsing the message (ADVICE r4).
+std::string pending_python_error(long* row_index = nullptr) {
   PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
   PyErr_Fetch(&type, &value, &tb);
   PyErr_NormalizeException(&type, &value, &tb);
   std::string out = "python error";
+  if (row_index != nullptr) {
+    *row_index = -1;
+    if (value != nullptr && PyObject_HasAttrString(value, "row_index")) {
+      PyObject* ri = PyObject_GetAttrString(value, "row_index");
+      if (ri != nullptr) {
+        long v = PyLong_AsLong(ri);
+        if (!(v == -1 && PyErr_Occurred())) *row_index = v;
+        PyErr_Clear();
+        Py_DECREF(ri);
+      } else {
+        PyErr_Clear();
+      }
+    }
+  }
   if (value != nullptr) {
     PyObject* s = PyObject_Str(value);
     if (s != nullptr) {
@@ -71,7 +90,15 @@ std::string pending_python_error() {
 }
 
 void do_initialize() {
-  if (!Py_IsInitialized()) {
+  // Two configurations (ADVICE r4): either this shim boots CPython
+  // itself (owns the GIL after Py_InitializeEx and must SaveThread on
+  // every exit), or another component in the same JVM process already
+  // embedded Python — then the GIL must be ACQUIRED here via
+  // PyGILState_Ensure/Release and SaveThread must NOT run (it would
+  // release a thread state this code does not own).
+  bool we_booted = !Py_IsInitialized();
+  PyGILState_STATE gil_state = PyGILState_UNLOCKED;
+  if (we_booted) {
     // System.load() binds our DT_NEEDED libpython with RTLD_LOCAL, so
     // CPython extension modules (math, numpy core, ...) — which do not
     // link libpython themselves — would fail to resolve Py* symbols.
@@ -80,7 +107,18 @@ void do_initialize() {
       dlopen("libpython3.12.so.1.0", RTLD_NOW | RTLD_GLOBAL);
     }
     Py_InitializeEx(0);  // 0: leave signal handling to the JVM
+  } else {
+    gil_state = PyGILState_Ensure();
   }
+  auto release_gil = [&]() {
+    if (we_booted) {
+      // Release the GIL taken by Py_InitializeEx so JVM threads can
+      // enter; never exit init still holding it.
+      PyEval_SaveThread();
+    } else {
+      PyGILState_Release(gil_state);
+    }
+  };
   // Runtime root: env override first, else the JVM's working directory.
   const char* root = std::getenv("SPARK_RAPIDS_TPU_ROOT");
   std::string root_s = root ? root : ".";
@@ -93,20 +131,19 @@ void do_initialize() {
   PyObject* mod = PyImport_ImportModule("spark_rapids_tpu.shim.jni_entry");
   if (mod == nullptr) {
     g_init_error = "import jni_entry failed: " + pending_python_error();
-    PyEval_SaveThread();  // never exit init still holding the GIL
+    release_gil();
     return;
   }
   PyObject* r = PyObject_CallMethod(mod, "initialize", nullptr);
   if (r == nullptr) {
     g_init_error = "jni_entry.initialize failed: " + pending_python_error();
     Py_DECREF(mod);
-    PyEval_SaveThread();
+    release_gil();
     return;
   }
   Py_DECREF(r);
   g_entry = mod;  // keep the reference for the life of the JVM
-  // Release the GIL taken by Py_InitializeEx so JVM threads can enter.
-  PyEval_SaveThread();
+  release_gil();
 }
 
 // Ensure the interpreter is up; returns false (with a Java exception
@@ -203,7 +240,8 @@ PyObject* strings_to_pylist(JNIEnv* env, jobjectArray arr) {
 // shim re-throws any "<TypeName>: msg" whose class exists under the
 // package — no hardcoded list to drift from the Python taxonomy
 // (unknown/unloadable names fall back to RuntimeException).
-void throw_java_typed(JNIEnv* env, const std::string& formatted) {
+void throw_java_typed(JNIEnv* env, const std::string& formatted,
+                      long row_index = -1) {
   // pending_python_error formats as "TypeName: message"
   size_t colon = formatted.find(": ");
   if (colon != std::string::npos && colon > 0) {
@@ -221,9 +259,29 @@ void throw_java_typed(JNIEnv* env, const std::string& formatted) {
           std::string("com/nvidia/spark/rapids/jni/") + tname;
       jclass jc = env->FindClass(cls.c_str());
       if (jc != nullptr) {
+        const char* msg = formatted.c_str() + colon + 2;
+        // ExceptionWithRowIndex family: construct via (String, int)
+        // so getRowIndex() reports the field the runtime set — the
+        // message is never parsed.
+        if (row_index >= 0) {
+          jmethodID ctor =
+              env->GetMethodID(jc, "<init>", "(Ljava/lang/String;I)V");
+          if (ctor != nullptr) {
+            jstring jmsg = env->NewStringUTF(msg);
+            if (jmsg != nullptr) {
+              jobject exc = env->NewObject(
+                  jc, ctor, jmsg, static_cast<jint>(row_index));
+              if (exc != nullptr &&
+                  env->Throw(static_cast<jthrowable>(exc)) == 0) {
+                return;
+              }
+            }
+          }
+          env->ExceptionClear();  // no such ctor / OOM: plain path
+        }
         // ThrowNew fails for non-Throwable name collisions; fall back
         // so a Python error NEVER goes unreported to the JVM
-        if (env->ThrowNew(jc, formatted.c_str() + colon + 2) == 0) {
+        if (env->ThrowNew(jc, msg) == 0) {
           return;
         }
         env->ExceptionClear();
@@ -241,7 +299,9 @@ void throw_java_typed(JNIEnv* env, const std::string& formatted) {
 // handled here once so no call site can feed Py_DECREF a null.
 PyObject* call_entry(JNIEnv* env, const char* fn, PyObject* args) {
   if (args == nullptr) {
-    throw_java_typed(env, pending_python_error());
+    long row = -1;
+    std::string msg = pending_python_error(&row);
+    throw_java_typed(env, msg, row);
     return nullptr;
   }
   PyObject* f = PyObject_GetAttrString(g_entry, fn);
@@ -254,8 +314,9 @@ PyObject* call_entry(JNIEnv* env, const char* fn, PyObject* args) {
   Py_DECREF(f);
   Py_DECREF(args);
   if (r == nullptr) {
-    std::string msg = pending_python_error();
-    throw_java_typed(env, msg);
+    long row = -1;
+    std::string msg = pending_python_error(&row);
+    throw_java_typed(env, msg, row);
     return nullptr;
   }
   return r;

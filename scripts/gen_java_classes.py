@@ -33,7 +33,8 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
-from jasm import ACC_PUBLIC, ClassFile, Code, Label  # noqa: E402
+from jasm import (ACC_FINAL, ACC_PRIVATE, ACC_PUBLIC, ClassFile, Code,
+                  Label)  # noqa: E402
 
 PKG = "com/nvidia/spark/rapids/jni"
 
@@ -279,110 +280,80 @@ def build_natives(outdir: str):
             f.write(cf.serialize())
 
 
-def _emit_get_row_index(cf: "ClassFile"):
-    """public long getRowIndex(): the ExceptionWithRowIndex.java
-    contract — first 'row <digits>' occurrence (a bare 'row ' without
-    digits keeps scanning, matching the source's regex find()), digits
-    accumulated in a LONG.  Divergence from the source only past
-    Long.MAX_VALUE digits (parseLong throws; this wraps).
-    Locals: 0=this 1=msg 2=i 3=j 4=c 5-6=v."""
-    c = Code(cf.cp, max_locals=7)
-    l_neg, l_find, l_digits, l_ret = (Label(), Label(), Label(),
-                                      Label())
-    c.aload(0)
-    c.invokevirtual("java/lang/Throwable", "getMessage",
-                    "()Ljava/lang/String;")
-    c.astore(1)
-    c.aload(1)
-    c.ifnull(l_neg)
-    c.iconst(-1)
-    c.istore(2)
-    c.place(l_find)                       # i = indexOf("row ", i+1)
-    c.aload(1)
-    c.ldc_string("row ")
-    c.iload(2)
-    c.iconst(1)
-    c.iadd()
-    c.invokevirtual("java/lang/String", "indexOf",
-                    "(Ljava/lang/String;I)I")
-    c.istore(2)
-    c.iload(2)
-    c.iflt(l_neg)
-    c.iload(2)
-    c.iconst(4)
-    c.iadd()
-    c.istore(3)                           # j = i + 4
-    c.iload(3)
-    c.aload(1)
-    c.invokevirtual("java/lang/String", "length", "()I")
-    c.if_icmp("ge", l_find)
-    c.aload(1)
-    c.iload(3)
-    c.invokevirtual("java/lang/String", "charAt", "(I)C")
-    c.istore(4)
-    c.iload(4)
-    c.iconst(ord("0"))
-    c.if_icmp("lt", l_find)
-    c.iload(4)
-    c.iconst(ord("9"))
-    c.if_icmp("gt", l_find)
-    c.lconst(0)                           # v = 0L (>=1 digit known)
-    c.lstore(5)
-    c.place(l_digits)
-    c.iload(3)
-    c.aload(1)
-    c.invokevirtual("java/lang/String", "length", "()I")
-    c.if_icmp("ge", l_ret)
-    c.aload(1)
-    c.iload(3)
-    c.invokevirtual("java/lang/String", "charAt", "(I)C")
-    c.istore(4)
-    c.iload(4)
-    c.iconst(ord("0"))
-    c.if_icmp("lt", l_ret)
-    c.iload(4)
-    c.iconst(ord("9"))
-    c.if_icmp("gt", l_ret)
-    c.lload(5)                            # v = v*10 + (c-'0')
-    c.lconst(10)
-    c.lmul()
-    c.iload(4)
-    c.iconst(ord("0"))
-    c.isub()
-    c.i2l()
-    c.ladd()
-    c.lstore(5)
-    c.iinc(3, 1)
-    c.goto(l_digits)
-    c.place(l_ret)
-    c.lload(5)
-    c.lreturn()
-    c.place(l_neg)
-    c.lconst(-1)
-    c.lreturn()
-    c.max_stack = max(c.max_stack, 8)     # linear tracker + branches
-    cf.add_code_method("getRowIndex", "()J", c, flags=ACC_PUBLIC)
+def _row_index_family():
+    """Names whose superclass chain reaches ExceptionWithRowIndex
+    (inclusive): these get the (String,int) constructor so the shim
+    can marshal the Python row_index attribute as a field instead of
+    parsing it back out of the message text."""
+    fam = {"ExceptionWithRowIndex"}
+    changed = True
+    while changed:
+        changed = False
+        for name, sup in EXCEPTION_CLASSES.items():
+            if name not in fam and sup.rsplit("/", 1)[-1] in fam:
+                fam.add(name)
+                changed = True
+    return fam
 
 
 def build_exceptions(outdir: str):
     """Typed exceptions: public <init>(String) chaining to the
-    superclass, thrown from the shim by Python type name.  (Emission
+    superclass, thrown from the shim by Python type name.  The
+    ExceptionWithRowIndex family additionally carries the row index in
+    an int FIELD set by a (String,int) constructor — matching the
+    reference's descriptor `public int getRowIndex()` exactly, so code
+    compiled against the reference links (ADVICE r4: the long-returning
+    message-parsing variant changed the method descriptor).  (Emission
     order is irrelevant: the JVM resolves superclasses lazily from
-    the classpath.)  Emitted at major 49: getRowIndex carries a loop.
-    """
+    the classpath.)"""
+    row_family = _row_index_family()
+    ROOT = f"{PKG}/ExceptionWithRowIndex"
     for name in EXCEPTION_CLASSES:
         sup = EXCEPTION_CLASSES[name]
         cf = ClassFile(f"{PKG}/{name}", super_name=sup, final=False,
                        major=49)
+        is_root = name == "ExceptionWithRowIndex"
+        if is_root:
+            # private final, matching the .java source exactly
+            cf.add_field("rowIndex", "I",
+                         flags=ACC_PRIVATE | ACC_FINAL)
+        # <init>(String): row index defaults to -1 (unknown)
         c = Code(cf.cp, max_locals=2)
         c.aload(0)
         c.aload(1)
         c.invokespecial(sup, "<init>", "(Ljava/lang/String;)V")
+        if is_root:
+            c.aload(0)
+            c.iconst(-1)
+            c.putfield(ROOT, "rowIndex", "I")
         c.return_void()
         cf.add_code_method("<init>", "(Ljava/lang/String;)V", c,
                            flags=ACC_PUBLIC)
-        if name == "ExceptionWithRowIndex":
-            _emit_get_row_index(cf)
+        if name in row_family:
+            # <init>(String, int): the shim's preferred constructor
+            c = Code(cf.cp, max_locals=3)
+            c.aload(0)
+            c.aload(1)
+            if is_root:
+                c.invokespecial(sup, "<init>",
+                                "(Ljava/lang/String;)V")
+                c.aload(0)
+                c.iload(2)
+                c.putfield(ROOT, "rowIndex", "I")
+            else:
+                c.iload(2)
+                c.invokespecial(sup, "<init>",
+                                "(Ljava/lang/String;I)V")
+            c.return_void()
+            cf.add_code_method("<init>", "(Ljava/lang/String;I)V", c,
+                               flags=ACC_PUBLIC)
+        if is_root:
+            c = Code(cf.cp, max_locals=1)
+            c.aload(0)
+            c.getfield(ROOT, "rowIndex", "I")
+            c.ireturn()
+            cf.add_code_method("getRowIndex", "()I", c,
+                               flags=ACC_PUBLIC)
         path = os.path.join(outdir, PKG, name + ".class")
         os.makedirs(os.path.dirname(path), exist_ok=True)
         with open(path, "wb") as f:
@@ -471,13 +442,13 @@ def build_oom_smoke_test(outdir: str):
     c.place(handler)
     c.handler_entry()
     c.astore(4)
-    # the typed exception's API works too: row index parses to 1
+    # the typed exception's API works too: the shim marshalled the
+    # Python row_index attribute into the int field (no message parse)
     rownum_ok = Label()
     c.aload(4)
-    c.invokevirtual(J + "ExceptionWithRowIndex", "getRowIndex", "()J")
-    c.lconst(1)
-    c.lcmp()
-    c.ifeq_lbl(rownum_ok)
+    c.invokevirtual(J + "ExceptionWithRowIndex", "getRowIndex", "()I")
+    c.iconst(1)
+    c.if_icmp("eq", rownum_ok)
     c.iconst(0)
     c.ldc_string("getRowIndex() != 1 for the ANSI cast error")
     c.invokestatic(J + "TestSupport", "assertTrue",

@@ -28,6 +28,7 @@ _UTF8, _INT, _LONG, _CLASS, _STRING, _FIELD, _METHOD, _NAT = \
 
 ACC_PUBLIC, ACC_STATIC, ACC_FINAL, ACC_SUPER, ACC_NATIVE = \
     0x0001, 0x0008, 0x0010, 0x0020, 0x0100
+ACC_PRIVATE = 0x0002
 
 T_INT, T_LONG = 10, 11
 
@@ -386,6 +387,20 @@ class Code:
         self.b += struct.pack(">BH", 0xB2,
                               self.cp.fieldref(cls, name, desc))
 
+    def getfield(self, cls: str, name: str, desc: str):
+        self._pop(1)
+        self._push(2 if desc in ("J", "D") else 1)
+        self.b += struct.pack(">BH", 0xB4,
+                              self.cp.fieldref(cls, name, desc))
+
+    def putfield(self, cls: str, name: str, desc: str):
+        self._pop(1 + (2 if desc in ("J", "D") else 1))
+        self.b += struct.pack(">BH", 0xB5,
+                              self.cp.fieldref(cls, name, desc))
+
+    def ireturn(self):
+        self.b.append(0xAC)
+
     def println(self, s: str):
         self.getstatic("java/lang/System", "out", "Ljava/io/PrintStream;")
         self.ldc_string(s)
@@ -413,6 +428,11 @@ class ClassFile:
         self.major = major
         self.final = final     # exception hierarchies need non-final
         self.methods: List[Tuple[int, int, int, bytes]] = []
+        self.fields: List[Tuple[int, int, int]] = []
+
+    def add_field(self, name: str, desc: str, flags=ACC_PUBLIC):
+        self.fields.append((flags, self.cp.utf8(name),
+                            self.cp.utf8(desc)))
 
     def add_native(self, name: str, desc: str,
                    flags=ACC_PUBLIC | ACC_STATIC | ACC_NATIVE):
@@ -449,7 +469,9 @@ class ClassFile:
         flags = ACC_PUBLIC | ACC_SUPER | (ACC_FINAL if self.final
                                           else 0)
         mid = struct.pack(">HHHH", flags, this_c, super_c, 0)
-        fields = struct.pack(">H", 0)
+        fields = struct.pack(">H", len(self.fields)) + b"".join(
+            struct.pack(">HHHH", f, n, d, 0)
+            for f, n, d in self.fields)
         methods = struct.pack(">H", len(self.methods)) + b"".join(mbytes)
         attrs = struct.pack(">H", 0)
         return head + pool + mid + fields + methods + attrs

@@ -57,9 +57,17 @@ def current_thread_id() -> int:
 
 def start_dedicated_task_thread(thread_id: int, task_id: int):
     from spark_rapids_tpu.memory.thread_state_registry import REGISTRY
-    adaptor = get_adaptor()      # validate BEFORE registering: a
-    REGISTRY.add_thread(thread_id)  # failed start must not leave a
-    adaptor.start_dedicated_task_thread(thread_id, task_id)  # stale id
+    # register BEFORE the adaptor start so a concurrent task_done's
+    # remove_thread callback can never race a not-yet-added id into a
+    # permanently stale entry; roll back on a failed start so it does
+    # not leave one either (ADVICE r4)
+    adaptor = get_adaptor()
+    REGISTRY.add_thread(thread_id)
+    try:
+        adaptor.start_dedicated_task_thread(thread_id, task_id)
+    except BaseException:
+        REGISTRY.remove_thread(thread_id)
+        raise
 
 
 def current_thread_is_dedicated_to_task(task_id: int):

@@ -108,12 +108,17 @@ def from_json_to_structs(col: Column,
     builder below, which stays the differential oracle."""
     import os
 
+    import jax
+
     from spark_rapids_tpu.ops import from_json_device as FJ
     min_rows = int(os.environ.get(
         "SPARK_RAPIDS_TPU_FROM_JSON_DEVICE_MIN", "256"))
     force = os.environ.get(
         "SPARK_RAPIDS_TPU_FORCE_DEVICE_FROM_JSON") == "1"
-    if force or col.length >= min_rows:
+    # accelerator-gated like from_json_to_raw_map (ADVICE r4): the host
+    # builder beats the device scan on the single-core CPU backend
+    on_accel = jax.default_backend() != "cpu"
+    if force or (on_accel and col.length >= min_rows):
         out = FJ.from_json_to_structs_device(col, list(fields))
         if out is not None:
             return out

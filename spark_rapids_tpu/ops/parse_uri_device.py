@@ -477,6 +477,11 @@ def _materialize_query_key(chars: np.ndarray, lo: np.ndarray,
 
 
 def use_device(col: Column) -> bool:
+    """NOT accelerator-gated, unlike from_json/protobuf (ADVICE r4):
+    parse_uri's host path is a per-row Python parse (ops/parse_uri.py),
+    so the vectorized scan wins even on the CPU backend; the raw-map /
+    from_json host paths are batch builders, which is why those ops
+    gate on jax.default_backend()."""
     if os.environ.get("SPARK_RAPIDS_TPU_FORCE_DEVICE_PARSE_URI") == "1":
         return True
     min_rows = int(os.environ.get(

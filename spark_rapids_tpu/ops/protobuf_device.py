@@ -364,6 +364,10 @@ def decode_protobuf_to_struct_device(col: Column,
 def use_device(col: Column, fields) -> bool:
     if os.environ.get("SPARK_RAPIDS_TPU_FORCE_DEVICE_PROTOBUF") == "1":
         return supported_schema(fields)
+    # accelerator-gated like raw_map_device (ADVICE r4): on the
+    # single-core CPU backend the host decoder beats the masked scan
+    if jax.default_backend() == "cpu":
+        return False
     min_rows = int(os.environ.get(
         "SPARK_RAPIDS_TPU_PROTOBUF_DEVICE_MIN", "256"))
     return col.length >= min_rows and supported_schema(fields)
