@@ -3,17 +3,29 @@ package com.nvidia.spark.rapids.jni;
 /**
  * Kudo shuffle wire format (reference kudo/KudoSerializer.java:48-170 —
  * the byte-exact spec — with writeToStreamWithMetrics:249 and
- * mergeToTable:407; TPU engine: spark_rapids_tpu/shuffle/kudo.py, the
- * byte-identical writer/merger validated by hand-assembled golden-byte
- * fixtures, plus shuffle/device_split.py for the device-resident
- * variant).
+ * mergeToTable:407; TPU engines: spark_rapids_tpu/shuffle/kudo.py, the
+ * byte-identical Python writer/merger validated by hand-assembled
+ * golden-byte fixtures, and native/kudo_native.hpp, the pure-C++
+ * engine the hot path runs on).
  *
- * <p>This JNI surface covers flat schemas; nested schemas go through
- * the Python API.  Blocks are self-delimiting: a blob may hold many
- * concatenated kudo tables and {@link #mergeToTable} consumes them all.
+ * <p><b>The GIL-free hot path.</b> The reference's kudo write/merge is
+ * pure JVM so dozens of executor threads serialize shuffle blocks
+ * concurrently.  Here the same property holds through the host-table
+ * API: {@link #hostTableFromColumns} exports a table's host buffers
+ * into the C++ engine ONCE (one embedded-Python crossing, amortized
+ * over all partition writes), after which {@link #writeHostTable} and
+ * {@link #mergeToHostTable} are plain C++ — no Python, no GIL — and
+ * scale linearly with JVM threads (KudoBench measures this).
+ *
+ * <p>Blocks are self-delimiting: a blob may hold many concatenated
+ * kudo tables and the merge entry points consume them all.
  */
 public final class KudoSerializer {
   private KudoSerializer() {}
+
+  // ---- convenience single-crossing path (Python engine) ----
+  // These two cover FLAT schemas; nested schemas go through the
+  // Python API or the host-table path below.
 
   /** Serialize rows [rowOffset, rowOffset+numRows) as one kudo block. */
   public static native byte[] writeToStream(long[] tableColumns,
@@ -22,4 +34,41 @@ public final class KudoSerializer {
   /** Merge a stream of kudo blocks into one table (column handles). */
   public static native long[] mergeToTable(byte[] blob, String[] typeIds,
                                            int[] scales);
+
+  // ---- GIL-free host-table path (C++ engine) ----
+
+  /**
+   * Export the columns' host buffers into the native kudo engine.
+   * One crossing; the returned host table is immutable and safe for
+   * concurrent {@link #writeHostTable} calls from many threads.
+   */
+  public static native long hostTableFromColumns(long[] columns);
+
+  /**
+   * Serialize one partition of a native host table — pure C++, never
+   * touches the embedded interpreter. Byte-identical to
+   * {@link #writeToStream} on the same rows.
+   */
+  public static native byte[] writeHostTable(long hostTable,
+                                             int rowOffset, int numRows);
+
+  /**
+   * Merge a concatenated blob of kudo blocks into a new native host
+   * table — pure C++. The schema (and dtype tags for later column
+   * import) comes from an existing host table of the same shape.
+   */
+  public static native long mergeToHostTable(byte[] blob,
+                                             long schemaTable);
+
+  /** Row count of a native host table. */
+  public static native long hostTableNumRows(long hostTable);
+
+  /** Free a native host table. */
+  public static native void freeHostTable(long hostTable);
+
+  /**
+   * Materialize a native host table (typically a merge result) back
+   * into runtime column handles. One crossing.
+   */
+  public static native long[] hostTableToColumns(long hostTable);
 }

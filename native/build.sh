@@ -5,3 +5,6 @@ cd "$(dirname "$0")"
 g++ -O3 -std=c++17 -shared -fPIC -o libcolumnar_native.so \
     columnar_native.cpp
 echo "built $(pwd)/libcolumnar_native.so"
+g++ -O3 -std=c++17 -shared -fPIC -o libkudo_native.so \
+    kudo_cabi.cpp
+echo "built $(pwd)/libkudo_native.so"

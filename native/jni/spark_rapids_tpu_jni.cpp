@@ -28,8 +28,12 @@
 
 #include <cstdio>
 #include <cstring>
+#include <memory>
 #include <mutex>
 #include <string>
+#include <vector>
+
+#include "../kudo_native.hpp"
 
 namespace {
 
@@ -1280,6 +1284,188 @@ jlongArray JNI_FN(KudoSerializer, mergeToTable)(JNIEnv* env, jclass,
       "(NNN)", bytes_to_py(env, blob),
       strings_to_pylist(env, type_ids), ints_to_pylist(env, scales));
   return as_jlong_array(env, call_entry(env, "kudo_merge", args));
+}
+
+// --- native host-table kudo: the GIL-FREE shuffle hot path ----------
+//
+// The reference's kudo write/merge is pure JVM (kudo/KudoSerializer
+// .java:48-170, KudoTableMerger.java) so executor threads serialize
+// shuffle blocks concurrently.  Here the equivalent: ONE crossing
+// exports a table's host buffers into the C++ engine
+// (native/kudo_native.hpp); after that, writeHostTable and
+// mergeToHostTable are plain C++ — no Python, no GIL — and scale
+// linearly with JVM threads.  hostTableToColumns crosses back once on
+// the receive side to re-materialize device columns.
+
+jlong JNI_FN(KudoSerializer, hostTableFromColumns)(JNIEnv* env, jclass,
+                                                   jlongArray cols) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* r = call_entry(
+      env, "export_kudo_host",
+      Py_BuildValue("(N)", longs_to_pylist(env, cols)));
+  if (r == nullptr) return 0;
+  if (!PyList_Check(r) || PyList_GET_SIZE(r) < 2) {
+    Py_DECREF(r);
+    throw_java(env, "export_kudo_host returned malformed list");
+    return 0;
+  }
+  auto get_long = [&](Py_ssize_t i) {
+    return PyLong_AsLongLong(PyList_GET_ITEM(r, i));
+  };
+  auto t = std::make_unique<kudo::Table>();
+  t->num_rows = get_long(0);
+  long long n_flat = get_long(1);
+  if (PyList_GET_SIZE(r) != 2 + 8 * n_flat) {
+    Py_DECREF(r);
+    throw_java(env, "export_kudo_host length mismatch");
+    return 0;
+  }
+  t->cols.resize(n_flat);
+  for (long long i = 0; i < n_flat; ++i) {
+    Py_ssize_t base = 2 + 8 * i;
+    kudo::Col& c = t->cols[i];
+    c.kind = static_cast<int32_t>(get_long(base));
+    c.item_size = static_cast<int32_t>(get_long(base + 1));
+    c.num_children = static_cast<int32_t>(get_long(base + 2));
+    const char* tid = PyUnicode_AsUTF8(PyList_GET_ITEM(r, base + 3));
+    c.type_id = tid ? tid : "";
+    PyErr_Clear();
+    c.scale = static_cast<int32_t>(get_long(base + 4));
+    PyObject* data = PyList_GET_ITEM(r, base + 5);
+    PyObject* validity = PyList_GET_ITEM(r, base + 6);
+    PyObject* offsets = PyList_GET_ITEM(r, base + 7);
+    if (PyBytes_Check(data)) {
+      const auto* p = reinterpret_cast<const uint8_t*>(
+          PyBytes_AS_STRING(data));
+      c.data.assign(p, p + PyBytes_GET_SIZE(data));
+    }
+    if (PyBytes_Check(validity)) {
+      const auto* p = reinterpret_cast<const uint8_t*>(
+          PyBytes_AS_STRING(validity));
+      c.validity.assign(p, p + PyBytes_GET_SIZE(validity));
+      c.has_validity = true;
+    }
+    if (PyBytes_Check(offsets)) {
+      Py_ssize_t nb = PyBytes_GET_SIZE(offsets);
+      c.offsets.resize(nb / 4);
+      std::memcpy(c.offsets.data(), PyBytes_AS_STRING(offsets), nb);
+      c.has_offsets = true;
+    }
+  }
+  Py_DECREF(r);
+  return reinterpret_cast<jlong>(t.release());
+}
+
+// Pure C++: callable concurrently from many JVM threads on one table.
+jbyteArray JNI_FN(KudoSerializer, writeHostTable)(JNIEnv* env, jclass,
+                                                  jlong table,
+                                                  jint row_offset,
+                                                  jint num_rows) {
+  try {
+    std::string s = kudo::write_table(
+        *reinterpret_cast<kudo::Table*>(table), row_offset, num_rows);
+    jbyteArray arr = env->NewByteArray(static_cast<jsize>(s.size()));
+    if (arr != nullptr) {
+      env->SetByteArrayRegion(
+          arr, 0, static_cast<jsize>(s.size()),
+          reinterpret_cast<const jbyte*>(s.data()));
+    }
+    return arr;
+  } catch (const std::exception& e) {
+    throw_java(env, e.what());
+    return nullptr;
+  }
+}
+
+// Pure C++ merge; schema (kinds/sizes/children + dtype tags) comes
+// from an existing host table with the same column structure.
+jlong JNI_FN(KudoSerializer, mergeToHostTable)(JNIEnv* env, jclass,
+                                               jbyteArray blob,
+                                               jlong schema_table) {
+  try {
+    auto* st = reinterpret_cast<kudo::Table*>(schema_table);
+    jsize len = env->GetArrayLength(blob);
+    std::vector<uint8_t> buf(static_cast<size_t>(len));
+    env->GetByteArrayRegion(blob, 0, len,
+                            reinterpret_cast<jbyte*>(buf.data()));
+    std::vector<int32_t> kinds, items, nch;
+    kinds.reserve(st->cols.size());
+    for (const kudo::Col& c : st->cols) {
+      kinds.push_back(c.kind);
+      items.push_back(c.item_size);
+      nch.push_back(c.num_children);
+    }
+    auto out = std::make_unique<kudo::Table>(kudo::merge_blocks(
+        buf.data(), len, kinds.data(), items.data(), nch.data(),
+        kinds.size()));
+    for (size_t i = 0; i < out->cols.size(); ++i) {
+      out->cols[i].type_id = st->cols[i].type_id;
+      out->cols[i].scale = st->cols[i].scale;
+    }
+    return reinterpret_cast<jlong>(out.release());
+  } catch (const std::exception& e) {
+    throw_java(env, e.what());
+    return 0;
+  }
+}
+
+jlong JNI_FN(KudoSerializer, hostTableNumRows)(JNIEnv*, jclass,
+                                               jlong table) {
+  return reinterpret_cast<kudo::Table*>(table)->num_rows;
+}
+
+void JNI_FN(KudoSerializer, freeHostTable)(JNIEnv*, jclass,
+                                           jlong table) {
+  delete reinterpret_cast<kudo::Table*>(table);
+}
+
+jlongArray JNI_FN(KudoSerializer, hostTableToColumns)(JNIEnv* env,
+                                                      jclass,
+                                                      jlong table) {
+  if (!ensure_runtime(env)) return nullptr;
+  auto* t = reinterpret_cast<kudo::Table*>(table);
+  Gil gil;
+  PyObject* flat = PyList_New(static_cast<Py_ssize_t>(
+      t->cols.size() * 8));
+  for (size_t i = 0; i < t->cols.size(); ++i) {
+    const kudo::Col& c = t->cols[i];
+    Py_ssize_t base = static_cast<Py_ssize_t>(i) * 8;
+    PyList_SET_ITEM(flat, base, PyLong_FromLong(c.kind));
+    PyList_SET_ITEM(flat, base + 1, PyLong_FromLong(c.item_size));
+    PyList_SET_ITEM(flat, base + 2, PyLong_FromLong(c.num_children));
+    PyList_SET_ITEM(flat, base + 3,
+                    PyUnicode_FromString(c.type_id.c_str()));
+    PyList_SET_ITEM(flat, base + 4, PyLong_FromLong(c.scale));
+    if (c.kind == kudo::LIST || c.kind == kudo::STRUCT) {
+      Py_INCREF(Py_None);
+      PyList_SET_ITEM(flat, base + 5, Py_None);
+    } else {
+      PyList_SET_ITEM(flat, base + 5, PyBytes_FromStringAndSize(
+          reinterpret_cast<const char*>(c.data.data()),
+          static_cast<Py_ssize_t>(c.data.size())));
+    }
+    if (c.has_validity) {
+      PyList_SET_ITEM(flat, base + 6, PyBytes_FromStringAndSize(
+          reinterpret_cast<const char*>(c.validity.data()),
+          static_cast<Py_ssize_t>(c.validity.size())));
+    } else {
+      Py_INCREF(Py_None);
+      PyList_SET_ITEM(flat, base + 6, Py_None);
+    }
+    if (c.has_offsets) {
+      PyList_SET_ITEM(flat, base + 7, PyBytes_FromStringAndSize(
+          reinterpret_cast<const char*>(c.offsets.data()),
+          static_cast<Py_ssize_t>(c.offsets.size() * 4)));
+    } else {
+      Py_INCREF(Py_None);
+      PyList_SET_ITEM(flat, base + 7, Py_None);
+    }
+  }
+  PyObject* args = Py_BuildValue("(LN)",
+                                 (long long)t->num_rows, flat);
+  return as_jlong_array(
+      env, call_entry(env, "columns_from_kudo_host", args));
 }
 
 // -------------------------------------------------------- StringUtils

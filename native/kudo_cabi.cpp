@@ -1,0 +1,177 @@
+// C ABI over the pure-C++ kudo engine (native/kudo_native.hpp) for
+// ctypes differential tests (tests/test_kudo_native.py drives this
+// against the golden-validated Python engine byte-for-byte) and for
+// any non-JVM host embedding.  The JNI shim uses the same header
+// directly.  All calls are thread-safe for concurrent writes on the
+// same (immutable once built) table — the design point that removes
+// the GIL from the shuffle hot path.
+
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "kudo_native.hpp"
+
+namespace {
+thread_local std::string g_last_error;
+
+void set_error(const char* what) { g_last_error = what ? what : "error"; }
+}  // namespace
+
+extern "C" {
+
+const char* kudo_last_error() { return g_last_error.c_str(); }
+
+void* kudo_table_create(int64_t num_rows, int32_t n_flat,
+                        const int32_t* kinds, const int32_t* item_sizes,
+                        const int32_t* num_children) {
+  try {
+    auto* t = new kudo::Table();
+    t->num_rows = num_rows;
+    t->cols.resize(n_flat);
+    for (int32_t i = 0; i < n_flat; ++i) {
+      t->cols[i].kind = kinds[i];
+      t->cols[i].item_size = item_sizes[i];
+      t->cols[i].num_children = num_children[i];
+    }
+    return t;
+  } catch (const std::exception& e) {
+    set_error(e.what());
+    return nullptr;
+  }
+}
+
+int32_t kudo_col_set_data(void* t, int32_t i, const uint8_t* p,
+                          int64_t len) {
+  try {
+    auto& c = static_cast<kudo::Table*>(t)->cols.at(i);
+    c.data.assign(p, p + len);
+    return 0;
+  } catch (const std::exception& e) {
+    set_error(e.what());
+    return -1;
+  }
+}
+
+int32_t kudo_col_set_validity(void* t, int32_t i, const uint8_t* p,
+                              int64_t len) {
+  try {
+    auto& c = static_cast<kudo::Table*>(t)->cols.at(i);
+    c.validity.assign(p, p + len);
+    c.has_validity = true;
+    return 0;
+  } catch (const std::exception& e) {
+    set_error(e.what());
+    return -1;
+  }
+}
+
+int32_t kudo_col_set_offsets(void* t, int32_t i, const int32_t* p,
+                             int64_t n) {
+  try {
+    auto& c = static_cast<kudo::Table*>(t)->cols.at(i);
+    c.offsets.assign(p, p + n);
+    c.has_offsets = true;
+    return 0;
+  } catch (const std::exception& e) {
+    set_error(e.what());
+    return -1;
+  }
+}
+
+void kudo_table_free(void* t) { delete static_cast<kudo::Table*>(t); }
+
+// Serialize one partition; returns a malloc'd buffer the caller frees
+// with kudo_buf_free, or NULL on error (-1 length).
+uint8_t* kudo_write(void* t, int64_t row_offset, int64_t num_rows,
+                    int64_t* out_len) {
+  try {
+    std::string s = kudo::write_table(*static_cast<kudo::Table*>(t),
+                                      row_offset, num_rows);
+    auto* buf = static_cast<uint8_t*>(std::malloc(s.size()));
+    if (buf == nullptr) throw std::bad_alloc();
+    std::memcpy(buf, s.data(), s.size());
+    *out_len = static_cast<int64_t>(s.size());
+    return buf;
+  } catch (const std::exception& e) {
+    set_error(e.what());
+    *out_len = -1;
+    return nullptr;
+  }
+}
+
+uint8_t* kudo_write_row_count_only(int64_t num_rows, int64_t* out_len) {
+  std::string s = kudo::write_row_count_only(num_rows);
+  auto* buf = static_cast<uint8_t*>(std::malloc(s.size()));
+  if (buf == nullptr) {
+    *out_len = -1;
+    return nullptr;
+  }
+  std::memcpy(buf, s.data(), s.size());
+  *out_len = static_cast<int64_t>(s.size());
+  return buf;
+}
+
+void kudo_buf_free(uint8_t* p) { std::free(p); }
+
+void* kudo_merge(const uint8_t* blob, int64_t blob_len, int32_t n_flat,
+                 const int32_t* kinds, const int32_t* item_sizes,
+                 const int32_t* num_children) {
+  try {
+    return new kudo::Table(kudo::merge_blocks(
+        blob, blob_len, kinds, item_sizes, num_children, n_flat));
+  } catch (const std::exception& e) {
+    set_error(e.what());
+    return nullptr;
+  }
+}
+
+int64_t kudo_table_num_rows(void* t) {
+  return static_cast<kudo::Table*>(t)->num_rows;
+}
+
+int32_t kudo_table_n_flat(void* t) {
+  return static_cast<int32_t>(static_cast<kudo::Table*>(t)->cols.size());
+}
+
+// Per-column accessors for a merged table: *_len to size the buffer,
+// *_get to copy out.  has_validity/has_offsets report presence.
+int64_t kudo_col_data_len(void* t, int32_t i) {
+  return static_cast<int64_t>(
+      static_cast<kudo::Table*>(t)->cols.at(i).data.size());
+}
+
+void kudo_col_get_data(void* t, int32_t i, uint8_t* out) {
+  auto& c = static_cast<kudo::Table*>(t)->cols.at(i);
+  std::memcpy(out, c.data.data(), c.data.size());
+}
+
+int32_t kudo_col_has_validity(void* t, int32_t i) {
+  return static_cast<kudo::Table*>(t)->cols.at(i).has_validity ? 1 : 0;
+}
+
+int64_t kudo_col_validity_len(void* t, int32_t i) {
+  return static_cast<int64_t>(
+      static_cast<kudo::Table*>(t)->cols.at(i).validity.size());
+}
+
+void kudo_col_get_validity(void* t, int32_t i, uint8_t* out) {
+  auto& c = static_cast<kudo::Table*>(t)->cols.at(i);
+  std::memcpy(out, c.validity.data(), c.validity.size());
+}
+
+int32_t kudo_col_has_offsets(void* t, int32_t i) {
+  return static_cast<kudo::Table*>(t)->cols.at(i).has_offsets ? 1 : 0;
+}
+
+int64_t kudo_col_offsets_len(void* t, int32_t i) {
+  return static_cast<int64_t>(
+      static_cast<kudo::Table*>(t)->cols.at(i).offsets.size());
+}
+
+void kudo_col_get_offsets(void* t, int32_t i, int32_t* out) {
+  auto& c = static_cast<kudo::Table*>(t)->cols.at(i);
+  std::memcpy(out, c.offsets.data(), c.offsets.size() * 4);
+}
+
+}  // extern "C"

@@ -269,6 +269,17 @@ class Code:
     def anewarray(self, cls: str):
         self.b += struct.pack(">BH", 0xBD, self.cp.cls(cls))
 
+    def arraylength(self):
+        self.b.append(0xBE)
+
+    def new_obj(self, cls: str):
+        self._push(1)
+        self.b += struct.pack(">BH", 0xBB, self.cp.cls(cls))
+
+    def lsub(self):
+        self._pop(2)
+        self.b.append(0x65)
+
     def dup(self):
         self._push()
         self.b.append(0x59)

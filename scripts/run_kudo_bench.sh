@@ -1,0 +1,34 @@
+#!/bin/bash
+# Multi-threaded JVM kudo shuffle-write bench over the GIL-free native
+# path (KudoSerializer.writeHostTable — pure C++, no embedded-Python
+# crossing per write).  Prints per-thread-count wall times; the total
+# write count is CONSTANT across configs, so wall time dropping with
+# thread count demonstrates the scaling the Python route cannot have
+# (VERDICT r4 #1).  Exits 0 on success, 2 when no JVM (skip).
+set -e
+cd "$(dirname "$0")/.."
+REPO="$(pwd)"
+
+JAVA_BIN="${SPARK_RAPIDS_JAVA:-}"
+if [ -z "$JAVA_BIN" ] && command -v java >/dev/null 2>&1; then
+    JAVA_BIN=java
+fi
+if [ -z "$JAVA_BIN" ]; then
+    for d in "$HOME"/.cache/bazel/_bazel_*/install/*/embedded_tools/jdk/bin/java; do
+        [ -x "$d" ] && JAVA_BIN="$d" && break
+    done
+fi
+if [ -z "$JAVA_BIN" ]; then
+    echo "kudo-bench: SKIP (no JVM available)" >&2
+    exit 2
+fi
+
+bash native/jni/build.sh
+python scripts/gen_java_classes.py java/classes
+
+export JAX_PLATFORMS=cpu
+export SPARK_RAPIDS_TPU_PLATFORM=cpu
+export SPARK_RAPIDS_TPU_ROOT="$REPO"
+exec "$JAVA_BIN" -cp "$REPO/java/classes" \
+    com.nvidia.spark.rapids.jni.KudoBench \
+    "$REPO/native/jni/libspark_rapids_tpu_jni.so"
