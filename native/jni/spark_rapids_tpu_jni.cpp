@@ -1348,6 +1348,11 @@ jlong JNI_FN(KudoSerializer, hostTableFromColumns)(JNIEnv* env, jclass,
     }
     if (PyBytes_Check(offsets)) {
       Py_ssize_t nb = PyBytes_GET_SIZE(offsets);
+      if (nb % 4 != 0) {
+        Py_DECREF(r);
+        throw_java(env, "export_kudo_host offsets not int32-aligned");
+        return 0;
+      }
       c.offsets.resize(nb / 4);
       std::memcpy(c.offsets.data(), PyBytes_AS_STRING(offsets), nb);
       c.has_offsets = true;

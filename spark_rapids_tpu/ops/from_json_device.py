@@ -53,10 +53,44 @@ def _root_is_object(chars: np.ndarray, lens: np.ndarray) -> np.ndarray:
     return any_nonws & (chars[np.arange(R), first] == ord("{"))
 
 
-def _field_strings(col: Column, name: str, padded, host_trees,
-                   chars_np: np.ndarray):
-    """One schema field -> (raw string column (pre-typing), scan-valid
-    mask): device spans with per-row host fallback."""
+def _host_bufs(col):
+    """One device->host materialization of (offsets, chars) shared by
+    every fallback loop over a column (hoisted: per-row np.asarray
+    would pay one full readback per fallback row)."""
+    return np.asarray(col.offsets), np.asarray(col.data)
+
+
+def _host_tree(bufs, i: int, host_trees):
+    """Parse row i once (tolerant JSON), shared across all schema
+    nodes; None for invalid documents."""
+    from spark_rapids_tpu.ops import json_path as JP
+    if i not in host_trees:
+        offs, all_chars = bufs
+        doc = bytes(all_chars[offs[i]:offs[i + 1]]).decode(
+            "utf-8", errors="replace")
+        try:
+            host_trees[i] = JP._Parser(doc).parse()
+        except JP._Invalid:
+            host_trees[i] = None
+    return host_trees[i]
+
+
+def _tree_nav(tree, steps):
+    """Navigate a host parse tree along struct field names (duplicate
+    keys last-wins via dict()); None when missing or off-path."""
+    cur = tree
+    for name in steps:
+        if cur is None or cur[0] != "obj":
+            return None
+        cur = dict(cur[1]).get(name)
+    return cur
+
+
+def _field_strings(col: Column, steps, padded, host_trees):
+    """One leaf at struct path `steps` -> (raw string column
+    (pre-typing), doc-valid mask): device spans with per-row host
+    fallback.  `steps` is a list of struct field names; [] matches the
+    root value (used when recursing into list elements)."""
     from spark_rapids_tpu.ops import json_device as JD
     from spark_rapids_tpu.ops import json_path as JP
     from spark_rapids_tpu.ops.json_utils import _value_as_raw_string
@@ -64,7 +98,8 @@ def _field_strings(col: Column, name: str, padded, host_trees,
     rows = col.length
     (valid, mcount, mstart, mend, mkind, mfloat, mneg, f_ws, f_sq,
      f_escun, f_ctrl, f_anyesc, f_float, f_negz, fb) = \
-        JD._scan_column(col, [JP.Named(name)], padded=padded)
+        JD._scan_column(col, [JP.Named(n) for n in steps],
+                        padded=padded)
 
     in_valid = (np.ones(rows, bool) if col.validity is None
                 else np.asarray(col.validity).astype(bool)[:rows])
@@ -97,19 +132,10 @@ def _field_strings(col: Column, name: str, padded, host_trees,
     # host fallback rows: parse once, share the tree across fields
     fb_idx = np.nonzero(need_host)[0]
     fb_vals = {}
+    bufs = (offs, all_chars)   # already host-materialized above
     for i in fb_idx:
-        if i not in host_trees:
-            doc = bytes(all_chars[offs[i]:offs[i + 1]]).decode(
-                "utf-8", errors="replace")
-            try:
-                host_trees[i] = JP._Parser(doc).parse()
-            except JP._Invalid:
-                host_trees[i] = None
-        tree = host_trees[i]
-        if tree is None or tree[0] != "obj":
-            fb_vals[i] = None
-            continue
-        got = dict(tree[1]).get(name)
+        tree = _host_tree(bufs, i, host_trees)
+        got = _tree_nav(tree, steps)
         fb_vals[i] = (None if got is None or got == ("lit", "null")
                       else _value_as_raw_string(got))
 
@@ -122,18 +148,223 @@ def _field_strings(col: Column, name: str, padded, host_trees,
     return out, valid
 
 
+def _presence(col: Column, steps, want_kind, padded, host_trees,
+              host_tag: str):
+    """Bool array: value at struct path `steps` exists and has the
+    scan kind `want_kind` (K_OBJ for struct nodes, K_ARR for lists);
+    rows the scan can't judge resolve via the host tree."""
+    from spark_rapids_tpu.ops import json_device as JD
+    from spark_rapids_tpu.ops import json_path as JP
+
+    rows = col.length
+    (valid, mcount, mstart, mend, mkind, _mf, _mn, _fw, _fsq, _fe,
+     _fc, _fa, _ff, _fz, fb) = JD._scan_column(
+        col, [JP.Named(n) for n in steps], padded=padded)
+    in_valid = (np.ones(rows, bool) if col.validity is None
+                else np.asarray(col.validity).astype(bool)[:rows])
+    need_host = in_valid & (fb | (valid & (mcount > 1)))
+    present = (in_valid & ~need_host & valid & (mcount == 1)
+               & (mkind == want_kind))
+    host_idx = np.nonzero(need_host)[0]
+    bufs = _host_bufs(col) if len(host_idx) else None
+    for i in host_idx:
+        got = _tree_nav(_host_tree(bufs, i, host_trees), steps)
+        present[i] = got is not None and got[0] == host_tag
+    return present, valid
+
+
+def _list_column(col: Column, steps, elem_spec, padded, host_trees):
+    """LIST node at struct path `steps`: the array's verbatim span is
+    located by the scan, top-level elements are split with one
+    vectorized pass over the padded matrix (backslash-parity string
+    masking + bracket-depth cumsum — the TPU re-design of the
+    reference's per-thread nesting walk, from_json_to_structs.cu),
+    and the element texts become a CHILD string column the schema
+    recursion re-enters with an empty path.  Rows the split cannot
+    judge (single-quote strings, empty elements, multi-match) fall
+    back per-row to the host parser."""
+    from spark_rapids_tpu.ops import json_device as JD
+    from spark_rapids_tpu.ops import json_path as JP
+    from spark_rapids_tpu.ops.json_utils import _render_json
+    from spark_rapids_tpu.columns.strbuild import build_string_column
+
+    rows = col.length
+    (valid, mcount, mstart, mend, mkind, _mf, _mn, _fw, f_sq, _fe,
+     _fc, _fa, _ff, _fz, fb) = JD._scan_column(
+        col, [JP.Named(n) for n in steps], padded=padded)
+    chars = np.asarray(padded[0])
+    lens = np.asarray(padded[1])
+    R, L = chars.shape
+    in_valid = (np.ones(rows, bool) if col.validity is None
+                else np.asarray(col.validity).astype(bool)[:rows])
+    is_arr = mkind == JD._K_ARR
+    # single-quote (tolerant) strings break the double-quote parity
+    # masking below: host those rows
+    need_host = in_valid & (fb | (valid & ((mcount > 1) | f_sq)))
+    dev = in_valid & ~need_host & valid & (mcount == 1) & is_arr
+
+    idx = np.arange(L)[None, :]
+    # string masking: a quote is real unless preceded by an odd run of
+    # backslashes (vectorized run length via maximum.accumulate)
+    is_bs = chars == ord("\\")
+    last_nonbs = np.maximum.accumulate(
+        np.where(~is_bs, idx, -1), axis=1)
+    runlen = idx - last_nonbs
+    prev_run = np.concatenate(
+        [np.zeros((R, 1), runlen.dtype), runlen[:, :-1]], axis=1)
+    quote = (chars == ord('"')) & ((prev_run % 2) == 0)
+    inside = (np.cumsum(quote, axis=1) % 2) == 1
+    open_b = ((chars == ord("{")) | (chars == ord("["))) & ~inside
+    close_b = ((chars == ord("}")) | (chars == ord("]"))) & ~inside
+    depth = np.cumsum(open_b.astype(np.int32)
+                      - close_b.astype(np.int32), axis=1)
+    s = np.where(dev, mstart, 0).astype(np.int64)
+    e = np.where(dev, mend, 1).astype(np.int64)
+    depth_at_s = np.take_along_axis(depth, s[:, None], 1)[:, 0]
+    in_span = (idx > s[:, None]) & (idx < (e - 1)[:, None])
+    top = in_span & ~inside & (depth == depth_at_s[:, None])
+    comma_top = top & (chars == ord(","))
+
+    ws = np.zeros((R, L), bool)
+    for w in _WS:
+        ws |= chars == w
+    has_content = (in_span & ~ws).any(axis=1) & dev
+    ncommas = comma_top.sum(axis=1)
+    cnt = np.where(has_content, ncommas + 1, 0).astype(np.int64)
+
+    max_cnt = int(cnt.max()) if rows else 0
+    if max_cnt > 0:
+        width = max(max_cnt, 1)
+        cpos = np.sort(np.where(comma_top, idx, L + 1),
+                       axis=1)[:, :width].astype(np.int64)
+        karr = np.arange(max_cnt)[None, :]
+        cp_shift = np.concatenate(
+            [np.zeros((R, 1), np.int64), cpos[:, :max_cnt - 1]]
+            if max_cnt > 1 else [np.zeros((R, 1), np.int64)], axis=1)
+        start_m = np.where(karr == 0, (s + 1)[:, None], cp_shift + 1)
+        end_m = np.where(karr < (cnt - 1)[:, None], cpos[:, :max_cnt],
+                         (e - 1)[:, None])
+        elem_ok = karr < cnt[:, None]
+        # whitespace-only elements ("[1,,2]", trailing commas): not
+        # verbatim-splittable -> host verdict for the whole row
+        nws_cum = np.cumsum((~ws) & (idx < lens[:, None]), axis=1)
+
+        def _cum_at(pos):
+            p = np.clip(pos - 1, 0, L - 1)
+            v = np.take_along_axis(nws_cum, p, axis=1)
+            return np.where(pos > 0, v, 0)
+
+        empty_elem = (elem_ok & ((_cum_at(end_m) - _cum_at(start_m))
+                                 <= 0)).any(axis=1) & has_content
+        if empty_elem.any():
+            need_host |= empty_elem
+            dev &= ~empty_elem
+            cnt = np.where(empty_elem, 0, cnt)
+    else:
+        start_m = np.zeros((rows, 1), np.int64)
+        end_m = np.zeros((rows, 1), np.int64)
+
+    # host rows: element texts re-rendered from the parse tree
+    host_elems = {}
+    host_idx = np.nonzero(need_host)[0]
+    bufs = _host_bufs(col) if len(host_idx) else None
+    for i in host_idx:
+        got = _tree_nav(_host_tree(bufs, i, host_trees), steps)
+        if got is None or got[0] != "arr":
+            host_elems[i] = None
+        else:
+            host_elems[i] = [_render_json(it, normalize_numbers=False)
+                             for it in got[1]]
+
+    present = dev.copy()
+    for i, elems in host_elems.items():
+        if elems is not None:
+            present[i] = True
+            cnt[i] = len(elems)
+
+    offsets = np.concatenate([[0], np.cumsum(cnt)]).astype(np.int32)
+    total = int(offsets[-1])
+    row_ids = np.repeat(np.arange(rows), cnt)
+    k_of = np.arange(total) - np.repeat(offsets[:-1].astype(np.int64),
+                                        cnt)
+    if total:
+        k_idx = np.minimum(k_of, start_m.shape[1] - 1).astype(np.int64)
+        child_start = (start_m[row_ids, k_idx]
+                       + row_ids.astype(np.int64) * L)
+        child_len = end_m[row_ids, k_idx] - start_m[row_ids, k_idx]
+        dev_child = dev[row_ids]
+    else:
+        child_start = np.zeros(0, np.int64)
+        child_len = np.zeros(0, np.int64)
+        dev_child = np.zeros(0, bool)
+    host_patch = {}
+    for i, elems in host_elems.items():
+        if elems is not None:
+            base = int(offsets[i])
+            for j, text in enumerate(elems):
+                host_patch[base + j] = text
+    if total:
+        child_texts = build_string_column(
+            chars.reshape(-1), child_start, child_len, dev_child,
+            host_patch if host_patch else None)
+        elem_col, _ = _node_column(child_texts, [], elem_spec,
+                                   None, {})
+    else:
+        # all arrays empty/null: typed empty child via the host
+        # builder (the scan cannot run on zero rows)
+        from spark_rapids_tpu.ops.json_utils import _build_json_column
+        elem_col = _build_json_column([], elem_spec)
+    out = Column.make_list(
+        offsets, elem_col,
+        validity=None if present.all() else present.astype(np.uint8))
+    return out, valid
+
+
+def _node_column(col: Column, steps, spec, padded, host_trees):
+    """Schema recursion: leaf DType | ("struct", fields) |
+    ("list", spec) at struct path `steps` (json_utils.hpp:10-23
+    parallel-schema-vector analog: one scan per node, all rows at
+    once)."""
+    from spark_rapids_tpu.ops import json_device as JD
+    from spark_rapids_tpu.ops.json_utils import convert_from_strings
+
+    if padded is None:
+        padded = JD._padded_with_terminator(col)
+    if isinstance(spec, DType):
+        raw, valid = _field_strings(col, steps, padded, host_trees)
+        return convert_from_strings(raw, spec), valid
+    tag, arg = spec
+    if tag == "struct":
+        present, valid = _presence(col, steps, JD._K_OBJ, padded,
+                                   host_trees, "obj")
+        children = []
+        for name, child_spec in arg:
+            ch, _ = _node_column(col, list(steps) + [name], child_spec,
+                                 padded, host_trees)
+            children.append(ch)
+        out = Column.make_struct(
+            col.length, children,
+            validity=None if present.all()
+            else present.astype(np.uint8))
+        return out, valid
+    if tag == "list":
+        return _list_column(col, steps, arg, padded, host_trees)
+    raise ValueError(f"unknown schema node {tag!r}")
+
+
 def from_json_to_structs_device(
         col: Column, fields: Sequence[Tuple[str, DType]],
         allow_leading_zeros: bool = False) -> Optional[Column]:
-    """Flat-schema device from_json; None when the host path must run
-    (nested schemas, leading-zero tolerance, empty input)."""
+    """Device from_json for flat AND nested schemas; None when the
+    host path must run (leading-zero tolerance, empty input).  Nested
+    struct fields compose scan paths; list nodes split elements with a
+    vectorized pass and recurse on the derived child column
+    (from_json_to_structs.cu:1-959 re-designed for the one-scan TPU
+    engine)."""
     if allow_leading_zeros or col.length == 0 or not fields:
         return None
-    if not all(isinstance(spec, DType) for _n, spec in fields):
-        return None   # nested schema: host builder
 
     from spark_rapids_tpu.ops import json_device as JD
-    from spark_rapids_tpu.ops.json_utils import convert_from_strings
 
     padded = JD._padded_with_terminator(col)
     chars_np = np.asarray(padded[0])
@@ -144,10 +375,10 @@ def from_json_to_structs_device(
     raw_cols = []
     row_valid = None
     for name, spec in fields:
-        raw, valid = _field_strings(col, name, padded, host_trees,
-                                    chars_np)
+        child, valid = _node_column(col, [name], spec, padded,
+                                    host_trees)
         row_valid = valid if row_valid is None else row_valid
-        raw_cols.append(convert_from_strings(raw, spec))
+        raw_cols.append(child)
 
     # struct-level validity: tolerant-JSON valid AND root is an object;
     # rows the scan couldn't judge (fb) take the host parse's verdict

@@ -102,28 +102,9 @@ def from_json_to_structs(col: Column,
     reference json_utils.hpp:10-23).  Missing/mistyped fields are null;
     invalid rows null the whole struct.
 
-    Flat scalar schemas route to the device engine
-    (ops/from_json_device.py — the json_device scan with from_json
-    rendering rules); nested schemas and small columns run the host
-    builder below, which stays the differential oracle."""
-    import os
-
-    import jax
-
-    from spark_rapids_tpu.ops import from_json_device as FJ
-    min_rows = int(os.environ.get(
-        "SPARK_RAPIDS_TPU_FROM_JSON_DEVICE_MIN", "256"))
-    force = os.environ.get(
-        "SPARK_RAPIDS_TPU_FORCE_DEVICE_FROM_JSON") == "1"
-    # accelerator-gated like from_json_to_raw_map (ADVICE r4): the host
-    # builder beats the device scan on the single-core CPU backend
-    on_accel = jax.default_backend() != "cpu"
-    if force or (on_accel and col.length >= min_rows):
-        out = FJ.from_json_to_structs_device(col, list(fields))
-        if out is not None:
-            return out
-    # a flat schema is just a one-level nested schema: delegate so the
-    # null/leniency rules live in exactly one place
+    A flat schema is just a one-level nested schema: delegate so the
+    device routing gate and null/leniency rules live in exactly one
+    place (from_json_to_structs_nested)."""
     return from_json_to_structs_nested(col, ("struct", list(fields)))
 
 
@@ -250,9 +231,30 @@ def from_json_to_structs_nested(col: Column, schema,
                                 ) -> Column:
     """JSON rows -> arbitrarily nested STRUCT/LIST column
     (JSONUtils.fromJSONToStructs:188 with a nested Schema).  `schema`
-    must be a ("struct", ...) node; invalid JSON rows are null."""
+    must be a ("struct", ...) node; invalid JSON rows are null.
+
+    Nested schemas route to the device engine too (r5): struct fields
+    compose scan paths, list nodes split elements vectorized and
+    recurse (ops/from_json_device.py).  Same accelerator gate as the
+    flat router; this host tree-builder stays the oracle and the
+    per-row fallback."""
     assert col.dtype.is_string
     if not (isinstance(schema, tuple) and schema[0] == "struct"):
         raise ValueError("top-level schema must be a struct")
+    import os
+
+    import jax
+
+    from spark_rapids_tpu.ops import from_json_device as FJ
+    min_rows = int(os.environ.get(
+        "SPARK_RAPIDS_TPU_FROM_JSON_DEVICE_MIN", "256"))
+    force = os.environ.get(
+        "SPARK_RAPIDS_TPU_FORCE_DEVICE_FROM_JSON") == "1"
+    on_accel = jax.default_backend() != "cpu"
+    if force or (on_accel and col.length >= min_rows):
+        out = FJ.from_json_to_structs_device(
+            col, list(schema[1]), allow_leading_zeros)
+        if out is not None:
+            return out
     return _build_json_column(
         list(_parse_rows(col, allow_leading_zeros)), schema)

@@ -16,11 +16,15 @@ ftos / SHA / JSON / kudo):
   * steps run until every row is done or malformed — the trip count is
     the max field count per message, not the byte length.
 
-Scope of the device path (router below): FLAT schemas — scalar
+Scope of the device path (router below): scalar
 bool/int32/int64/float32/float64/string fields, DEFAULT/FIXED/ZIGZAG
-encodings, optional/required, non-string defaults.  Repeated fields,
-nested messages, and string defaults route to the host oracle
-(ops/protobuf.py), which stays the differential reference.
+encodings, optional/required, non-string defaults, and arbitrarily
+NESTED (non-repeated) messages — a nested message is a LEN capture
+whose payload spans become a child binary column the decode recurses
+on, the masked-scan re-design of the reference's
+nested_field_descriptor walk (protobuf.hpp:26-67).  Repeated fields
+and string defaults route to the host oracle (ops/protobuf.py), which
+stays the differential reference.
 
 Divergence note (shared with json_device): STRING payloads pass raw
 bytes through on device while the host oracle substitutes U+FFFD for
@@ -61,11 +65,22 @@ _VARINT, _I64BIT, _LEN, _I32BIT = 0, 1, 2, 5
 
 
 def supported_schema(fields) -> bool:
-    """True when the flat-schema device engine can decode this schema."""
+    """True when the device engine can decode this schema: scalar
+    leaves plus arbitrarily nested (non-repeated) messages — a nested
+    message is a LEN field whose span becomes a child binary column
+    the decode recurses on (protobuf.hpp:26-67 nested_field_descriptor
+    re-designed for the masked-scan engine).  Repeated fields stay on
+    the host oracle."""
     from spark_rapids_tpu.ops.protobuf import DEFAULT, FIXED, ZIGZAG
     for f in fields:
-        if f.is_message or f.repeated:
+        if f.repeated:
             return False
+        if f.field_number <= 0 or f.field_number >= (1 << 29):
+            return False
+        if f.is_message:
+            if not supported_schema(f.children):
+                return False
+            continue
         if f.dtype.kind not in (Kind.BOOL8, Kind.INT32, Kind.INT64,
                                 Kind.FLOAT32, Kind.FLOAT64,
                                 Kind.STRING):
@@ -74,13 +89,13 @@ def supported_schema(fields) -> bool:
             return False
         if f.dtype.is_string and f.default is not None:
             return False
-        if f.field_number <= 0 or f.field_number >= (1 << 29):
-            return False
     return True
 
 
 def _expected_wire(f) -> int:
     from spark_rapids_tpu.ops.protobuf import FIXED
+    if f.is_message:
+        return _LEN
     kind = f.dtype.kind
     if kind == Kind.STRING:
         return _LEN
@@ -192,8 +207,13 @@ def _decode_chunk(chars: jnp.ndarray, lens: jnp.ndarray, specs):
 
         new_vals = list(vals)
         new_seen = list(seen)
-        for k, (fnum, ewire) in enumerate(specs):
+        for k, (fnum, ewire, strict) in enumerate(specs):
             match = capture & (num == fnum) & (wire == ewire)
+            if strict:
+                # message fields: a wire-type mismatch malforms the
+                # row (host _decode_message raises; scalars skip)
+                new_malformed = new_malformed | (
+                    capture & (num == fnum) & (wire != ewire))
             if ewire == _VARINT:
                 v = pval
             elif ewire == _I64BIT:
@@ -294,7 +314,8 @@ def decode_protobuf_to_struct_device(col: Column,
     elif not col.dtype.is_string:
         return None
 
-    specs = tuple((f.field_number, _expected_wire(f)) for f in fields)
+    specs = tuple((f.field_number, _expected_wire(f), f.is_message)
+                  for f in fields)
     engine = _engine(specs)
 
     in_null = (np.zeros(rows, bool) if col.validity is None
@@ -329,29 +350,55 @@ def decode_protobuf_to_struct_device(col: Column,
     for k, f in enumerate(fields):
         if f.required:
             required_missing |= ~fseen[k]
-    rownull = in_null | malformed | required_missing
+
+    def span_column(k, keep):
+        """LEN capture k -> string/binary column of payload spans,
+        chunk-wise (char matrices have differing widths)."""
+        parts = []
+        off = 0
+        for ci, ch in enumerate(char_parts):
+            n = ch.shape[0]
+            parts.append(_finalize_string(
+                ch, len_parts[ci], val_parts[ci][k],
+                seen_parts[ci][k], ~keep[off:off + n]))
+            off += n
+        if len(parts) == 1:
+            return parts[0]
+        from spark_rapids_tpu.columns.table import Table
+        from spark_rapids_tpu.ops.copying import concat_tables
+        return concat_tables([Table([p]) for p in parts]).columns[0]
+
+    # nested messages first: a malformed/required-missing submessage
+    # nulls the WHOLE parent row (host _decode_message raises through)
+    sub_cols: dict = {}
+    sub_bad = np.zeros(rows, bool)
+    for k, f in enumerate(fields):
+        if not f.is_message:
+            continue
+        child_bytes = span_column(k, fseen[k])
+        sub = decode_protobuf_to_struct_device(child_bytes, f.children)
+        # child col has rows == parent rows > 0 and a pre-validated
+        # schema, so the recursion can never decline
+        assert sub is not None
+        sub_valid = (np.ones(rows, bool) if sub.validity is None
+                     else np.asarray(sub.validity).astype(bool))
+        sub_bad |= fseen[k] & ~sub_valid
+        sub_cols[k] = sub
+
+    rownull = in_null | malformed | required_missing | sub_bad
 
     children = []
     for k, f in enumerate(fields):
-        if f.dtype.is_string:
-            # per-chunk char matrices have differing widths; finalize
-            # chunk-wise and concatenate
-            parts = []
-            off = 0
-            for ci, ch in enumerate(char_parts):
-                n = ch.shape[0]
-                parts.append(_finalize_string(
-                    ch, len_parts[ci], val_parts[ci][k],
-                    seen_parts[ci][k], rownull[off:off + n]))
-                off += n
-            if len(parts) == 1:
-                children.append(parts[0])
-            else:
-                from spark_rapids_tpu.ops.copying import concat_tables
-                from spark_rapids_tpu.columns.table import Table
-                children.append(
-                    concat_tables([Table([p]) for p in parts])
-                    .columns[0])
+        if f.is_message:
+            sub = sub_cols[k]
+            keep = fseen[k] & ~rownull
+            children.append(Column(
+                sub.dtype, rows,
+                validity=None if keep.all()
+                else jnp.asarray(keep.astype(np.uint8)),
+                children=sub.children))
+        elif f.dtype.is_string:
+            children.append(span_column(k, fseen[k] & ~rownull))
         else:
             children.append(
                 _finalize_numeric(f, fvals[k], fseen[k], rownull))

@@ -64,11 +64,71 @@ def test_router_uses_device(monkeypatch):
     assert out.to_pylist() == [(3,)] * 4
 
 
-def test_nested_schema_routes_host():
-    col = Column.from_strings(['{"m": {"x": 1}}'])
-    out = FJ.from_json_to_structs_device(
-        col, [("m", ("struct", [("x", dtypes.INT64)]))])
-    assert out is None
+def test_nested_schema_runs_device():
+    """Nested schemas run the device engine (r5) — the marker is the
+    non-None return, host oracle must agree."""
+    col = Column.from_strings(['{"m": {"x": 1}}', '{"m": 2}', None])
+    fields = [("m", ("struct", [("x", dtypes.INT64)]))]
+    out = FJ.from_json_to_structs_device(col, fields)
+    assert out is not None
+    host = JU.from_json_to_structs_nested(col, ("struct", fields))
+    assert out.to_pylist() == host.to_pylist()
+
+
+NESTED_DOCS = [
+    '{"a": {"b": 7, "c": "x"}, "d": [1, 2, 3]}',
+    '{"a": {"b": null}, "d": []}',
+    '{"a": 5, "d": [10]}',                    # mistyped struct
+    '{"d": [[1, 2], [3]]}',                   # nested arrays
+    '{"d": [ {"e": "y"}, {"e": "z"} ]}',      # array of objects
+    '{"d": ["s1", "s2", null]}',              # strings + null elem
+    '{"a": {"b": 1, "b": 2}}',                # dup key inside nested
+    '{"a": {"deep": {"x": 1}}}',              # extra depth ignored
+    '{"d": [ 1 , 2 ]}',                       # ws inside array
+    '{"d": "[1,2]"}',                         # string, not array
+    '{"d": [1, [2, {"k": [3]}], "s"]}',       # heterogeneous
+    '{"d": [  ]}',                            # ws-only empty array
+    'null', 'not json', None, '{}',
+    '{"a": {"c": "q\\"uote"}}',               # escape in nested leaf
+    "{'a': {'b': 3}}",                        # single quotes(tolerant)
+]
+
+
+@pytest.mark.parametrize("fields", [
+    [("a", ("struct", [("b", dtypes.INT64), ("c", dtypes.STRING)])),
+     ("d", ("list", dtypes.INT64))],
+    [("d", ("list", ("list", dtypes.INT64)))],
+    [("d", ("list", ("struct", [("e", dtypes.STRING)])))],
+    [("d", ("list", dtypes.STRING))],
+    [("a", ("struct", [("deep", ("struct", [("x", dtypes.INT64)]))]))],
+    [("d", ("list", ("list", ("list", dtypes.INT32))))],
+])
+def test_nested_differential(fields):
+    col = Column.from_strings(NESTED_DOCS)
+    dev = FJ.from_json_to_structs_device(col, fields)
+    assert dev is not None
+    host = JU.from_json_to_structs_nested(col, ("struct", fields))
+    h, d = host.to_pylist(), dev.to_pylist()
+    for i, (hr, dr) in enumerate(zip(h, d)):
+        assert hr == dr, (f"row {i} ({NESTED_DOCS[i]!r}):\n"
+                          f"  host={hr!r}\n  dev ={dr!r}")
+
+
+def test_nested_router_uses_device(monkeypatch):
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_FORCE_DEVICE_FROM_JSON", "1")
+    called = {}
+    orig = FJ.from_json_to_structs_device
+
+    def spy(col, fields, allow_leading_zeros=False):
+        called["yes"] = True
+        return orig(col, fields, allow_leading_zeros)
+
+    monkeypatch.setattr(FJ, "from_json_to_structs_device", spy)
+    col = Column.from_strings(['{"m": {"x": 1}}'] * 4)
+    out = JU.from_json_to_structs_nested(
+        col, ("struct", [("m", ("struct", [("x", dtypes.INT64)]))]))
+    assert called.get("yes")
+    assert out.to_pylist() == [((1,),)] * 4
 
 
 def test_fuzz_differential():

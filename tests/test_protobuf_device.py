@@ -175,11 +175,88 @@ def test_router_uses_device(monkeypatch):
 
 
 def test_unsupported_schema_routes_host():
-    # nested message schema: device returns None, host handles it
-    inner = pb.Field(1, dtypes.INT64, name="x")
-    fields = [pb.Field(1, dtypes.STRUCT, children=(inner,), name="m")]
+    # repeated fields stay on the host oracle
+    fields = [pb.Field(1, dtypes.INT64, repeated=True, name="xs")]
     assert not pd.supported_schema(fields)
-    msg = ld(1, tag(1, 0) + varint(3))
+    msg = tag(1, 0) + varint(3) + tag(1, 0) + varint(4)
     col = Column.from_strings([msg])
     out = pb.decode_protobuf_to_struct(col, fields)
-    assert out.to_pylist() == [((3,),)]
+    assert out.to_pylist() == [([3, 4],)]
+
+
+# ------------------------------------------------- nested messages (r5)
+
+SUB = [pb.Field(1, dtypes.INT64, name="x"),
+       pb.Field(2, dtypes.STRING, name="y")]
+NESTED = [pb.Field(1, dtypes.INT64, name="a"),
+          pb.Field(2, dtypes.STRUCT, children=tuple(SUB), name="m")]
+
+
+def test_nested_message_supported():
+    """Nested (non-repeated) message schemas run on device (r5) —
+    the marker is supported_schema + a non-None device decode."""
+    assert pd.supported_schema(NESTED)
+
+
+def test_nested_message_differential():
+    sub1 = tag(1, 0) + varint(7) + ld(2, b"hi")
+    sub_bad = tag(1, 0) + b"\xff" * 11       # unterminated varint
+    msgs = [
+        tag(1, 0) + varint(5) + ld(2, sub1),
+        tag(1, 0) + varint(6),               # missing msg: null struct
+        ld(2, b"") + tag(1, 0) + varint(1),  # empty submessage
+        tag(1, 0) + varint(2) + ld(2, sub_bad),   # bad sub: row null
+        tag(1, 0) + varint(3) + tag(2, 0) + varint(1),  # wire mismatch
+        ld(2, sub1) + ld(2, tag(1, 0) + varint(9)),     # last wins
+        b"",
+    ]
+    _differential(msgs, NESTED)
+
+
+def test_deep_nested_message_differential():
+    inner_f = [pb.Field(1, dtypes.INT64, name="q")]
+    mid_f = [pb.Field(1, dtypes.STRUCT, children=tuple(inner_f),
+                      name="inner"),
+             pb.Field(2, dtypes.INT32, name="r")]
+    top_f = [pb.Field(1, dtypes.STRUCT, children=tuple(mid_f),
+                      name="mid")]
+    inner = tag(1, 0) + varint(42)
+    mid = ld(1, inner) + tag(2, 0) + varint(3)
+    msgs = [ld(1, mid), ld(1, tag(2, 0) + varint(8)), b""]
+    _differential(msgs, top_f)
+
+
+def test_nested_required_inside_submessage():
+    """A required leaf missing INSIDE a submessage nulls the whole
+    parent row (host _decode_message raises through)."""
+    sub_req = [pb.Field(1, dtypes.INT64, required=True, name="x")]
+    fields = [pb.Field(1, dtypes.INT64, name="a"),
+              pb.Field(2, dtypes.STRUCT, children=tuple(sub_req),
+                       name="m")]
+    msgs = [tag(1, 0) + varint(1) + ld(2, tag(1, 0) + varint(9)),
+            tag(1, 0) + varint(2) + ld(2, b"")]    # required missing
+    _differential(msgs, fields)
+
+
+def test_nested_fuzz_differential():
+    rng = np.random.default_rng(41)
+    msgs = []
+    for _ in range(60):
+        parts = []
+        if rng.random() < 0.8:
+            parts.append(tag(1, 0) + varint(int(rng.integers(0, 99))))
+        if rng.random() < 0.8:
+            sub = b""
+            if rng.random() < 0.8:
+                sub += tag(1, 0) + varint(int(rng.integers(0, 1000)))
+            if rng.random() < 0.6:
+                sub += ld(2, bytes(rng.integers(97, 122, 5,
+                                                dtype=np.uint8)))
+            if rng.random() < 0.2:
+                sub += tag(9, 0) + varint(4)      # unknown field
+            parts.append(ld(2, sub))
+        if rng.random() < 0.1:
+            parts.append(bytes([0xFF]))           # trailing garbage
+        rng.shuffle(parts)
+        msgs.append(b"".join(parts))
+    _differential(msgs, NESTED)
