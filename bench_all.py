@@ -292,6 +292,50 @@ def bench_oom_machine(ops=20_000):
     return {"alloc_dealloc_kops_per_sec": results}
 
 
+def bench_tpcds(rows=2_000_000):
+    """TPC-DS-shaped flagship pipelines (models/tpcds.py): per-query
+    wall time for one fully-jitted scan->join->group->order program,
+    warm (post-compile) timings."""
+    from spark_rapids_tpu.models import tpcds
+    out = {}
+
+    d5 = tpcds.gen_q5(rows=rows, stores=64, days=120)
+    q5 = tpcds.make_q5(64, join_capacity=1 << 19)
+    t0 = time.perf_counter()
+    res5 = q5(d5)
+    jax.block_until_ready(res5)
+    assert not bool(res5[-1]), "q5 bench overflowed its join capacity"
+    out["q5_compile_plus_run_s"] = round(time.perf_counter() - t0, 3)
+    t0 = time.perf_counter()
+    jax.block_until_ready(q5(d5))
+    warm = time.perf_counter() - t0
+    out["q5_warm_s"] = round(warm, 4)
+    out["q5_rows_per_s"] = round(rows / warm)
+
+    q, p, n = tpcds.gen_q9(rows=rows)
+    jax.block_until_ready(tpcds.run_q9(q, p, n))
+    t0 = time.perf_counter()
+    jax.block_until_ready(tpcds.run_q9(q, p, n))
+    warm = time.perf_counter() - t0
+    out["q9_warm_s"] = round(warm, 4)
+    out["q9_rows_per_s"] = round(rows / warm)
+
+    # fact-fact pair count ~ cs*inv/items: 250k*250k/16k ~ 3.8M < 2^22
+    d72 = tpcds.gen_q72(cs_rows=rows // 8, inv_rows=rows // 8,
+                        items=16384, days=70)
+    q72 = tpcds.make_q72(16384, 16, join_capacity=1 << 22,
+                         week0=11_000 // 7)
+    res = q72(d72)
+    jax.block_until_ready(res)
+    assert not bool(res[-1]), "q72 bench overflowed its join capacity"
+    t0 = time.perf_counter()
+    jax.block_until_ready(q72(d72))
+    warm = time.perf_counter() - t0
+    out["q72_warm_s"] = round(warm, 4)
+    out["q72_cs_rows_per_s"] = round(rows // 8 / warm)
+    return out
+
+
 def main():
     out = {
         "backend": jax.default_backend(),
@@ -302,6 +346,7 @@ def main():
         "decoders_1e6": bench_decoders(),
         "hash_1e7": bench_hash(),
         "oom_machine": bench_oom_machine(),
+        "tpcds_2e6": bench_tpcds(),
     }
     with open("BENCH_EXTRA.json", "w") as f:
         json.dump(out, f, indent=2)

@@ -1,0 +1,379 @@
+"""TPC-DS-shaped flagship pipelines (BASELINE.json configs[4] /
+north_star: "TPC-DS SF100 q5/q9/q72 end-to-end").
+
+Each pipeline is ONE jitted program over device arrays — scan ->
+join(s) -> filter -> group-by -> order-by — with the shapes the real
+queries have:
+
+  * q9-shape : CASE-WHEN bucketed aggregates over store_sales
+               (5 quantity ranges; count/avg per range) — pure
+               elementwise + masked reductions.
+  * q5-shape : sales & returns facts joined to a date-filtered
+               date_dim and to a store dim, grouped by store with
+               decimal sums, ordered by store — join -> join ->
+               group-by -> order-by.
+  * q72-shape: catalog_sales joined to inventory on item (fact-fact),
+               week-offset filter through date lookups, inventory
+               shortage filter, item dim join, group by (item, week),
+               count, order by count desc with a LIMIT — the long
+               multi-join chain.
+
+TPU-first design decisions (vs the reference's row-iterator operators):
+  * joins are the jittable padded-capacity inner join
+    (ops/device_join.inner_join_device): static shapes, validity
+    masks, int64 overflow accounting — XLA sees one fused program.
+  * group-bys ride jax.ops.segment_sum over dictionary-encoded keys
+    (dimension keys ARE small dictionaries after the dim join, the
+    same reason Spark dictionary-encodes parquet strings).
+  * order-by is lax.sort over the padded group table with sentinel
+    keys for invalid slots.
+  * strings never enter the jitted program: dimension attributes are
+    dictionary ids inside compute and materialize back to strings at
+    the presentation boundary (models/__init__ callers) — the
+    scan-side dictionary encode is where the reference pays its
+    string cost too.
+  * decimal sums are exact int64 scaled arithmetic (decimal64 cents),
+    promoted to f64 only for the avg presentation.
+
+The numpy oracles (oracle_q5/q9/q72) define correctness; tests drive
+both single-chip jit and the 8-device mesh variants against them.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from spark_rapids_tpu.ops.device_join import inner_join_device
+
+# ------------------------------------------------------------------ data
+
+
+class Q5Data(NamedTuple):
+    # store_sales-like fact
+    s_date: jnp.ndarray     # i32 days since epoch
+    s_store: jnp.ndarray    # i32 store key
+    s_price: jnp.ndarray    # i64 decimal64(2) cents
+    s_profit: jnp.ndarray   # i64 decimal64(2) cents
+    # store_returns-like fact
+    r_date: jnp.ndarray
+    r_store: jnp.ndarray
+    r_amt: jnp.ndarray
+    r_loss: jnp.ndarray
+    # date_dim filtered to the 14-day window, store dim (dense keys:
+    # store key k's attributes live at index k)
+    d_date: jnp.ndarray     # i32 days (pre-filtered window)
+    st_id: jnp.ndarray      # i32 dictionary id of s_store_id
+
+
+def gen_q5(rows: int = 50_000, stores: int = 32, days: int = 120,
+           seed: int = 5) -> Q5Data:
+    rng = np.random.default_rng(seed)
+    base = 11_000  # ~2000-02-14 in days-since-epoch
+    win0 = base + 40
+
+    def fact(n):
+        return (
+            jnp.asarray(rng.integers(base, base + days, n)
+                        .astype(np.int32)),
+            jnp.asarray(rng.integers(0, stores, n).astype(np.int32)),
+            jnp.asarray(rng.integers(100, 100_000, n)
+                        .astype(np.int64)),
+            jnp.asarray(rng.integers(-20_000, 50_000, n)
+                        .astype(np.int64)),
+        )
+
+    s = fact(rows)
+    r = fact(rows // 8)
+    d_date = jnp.asarray(np.arange(win0, win0 + 14, dtype=np.int32))
+    perm = rng.permutation(stores).astype(np.int32)
+    return Q5Data(*s, *r, d_date=d_date, st_id=jnp.asarray(perm))
+
+
+def _q5_kernel(stores: int, join_capacity: int, reduce_sum,
+               reduce_any):
+    """Shared per-shard q5 pipeline body (single-chip: identity
+    reduces; mesh: lax.psum reduces — ONE implementation so the two
+    variants cannot drift)."""
+
+    def compute(s_date, s_store, s_price, s_profit,
+                r_date, r_store, r_amt, r_loss, d_date, st_id):
+        def channel(date, store, amt_a, amt_b):
+            """fact JOIN date_window -> per-store (sum a, sum b)."""
+            pairs = inner_join_device(date, d_date, join_capacity)
+            li = pairs.left_indices
+            ok = pairs.valid
+            st = jnp.where(ok, store[li], 0)
+            sum_a = jax.ops.segment_sum(
+                jnp.where(ok, amt_a[li], 0), st, num_segments=stores)
+            sum_b = jax.ops.segment_sum(
+                jnp.where(ok, amt_b[li], 0), st, num_segments=stores)
+            seen = jax.ops.segment_sum(ok.astype(jnp.int64), st,
+                                       num_segments=stores)
+            return sum_a, sum_b, seen, pairs.total > join_capacity
+
+        s_sales, s_profit_s, s_seen, of1 = channel(
+            s_date, s_store, s_price, s_profit)
+        r_amt_s, r_loss_s, r_seen, of2 = channel(
+            r_date, r_store, r_amt, r_loss)
+        # global group table (mesh: one psum rides ICI)
+        s_sales = reduce_sum(s_sales)
+        r_amt_s = reduce_sum(r_amt_s)
+        profit = reduce_sum(s_profit_s - r_loss_s)
+        seen = reduce_sum(s_seen + r_seen)
+        # ORDER BY s_store_id: sort the group table by dictionary id
+        # (store dim join is a dense-key index; a sparse dim would
+        # ride the same inner join)
+        sentinel = jnp.int32(2**31 - 1)
+        key = jnp.where(seen > 0, st_id, sentinel)
+        key_s, sales_s, ret_s, profit_s = lax.sort(
+            (key, s_sales, r_amt_s, profit), num_keys=1)
+        return key_s, sales_s, ret_s, profit_s, reduce_any(of1 | of2)
+
+    return compute
+
+
+def make_q5(stores: int, join_capacity: int):
+    """q5-shape single-jit pipeline.  Returns fn(Q5Data) ->
+    (store_ids i32, sales i64, returns i64, profit i64, overflow
+    bool) with one output row per store id, ordered by store id
+    (invalid stores hold sentinel id 2^31-1)."""
+    kernel = _q5_kernel(stores, join_capacity,
+                        lambda x: x, lambda b: b)
+
+    @jax.jit
+    def run(d: Q5Data):
+        return kernel(*d)
+
+    return run
+
+
+def oracle_q5(d: Q5Data, stores: int):
+    # one host materialization per column up front: per-element jnp
+    # indexing would pay a device round-trip per row
+    h = Q5Data(*(np.asarray(x) for x in d))
+    dd = set(h.d_date.tolist())
+    out = {}
+    for i in range(len(h.s_date)):
+        if int(h.s_date[i]) in dd:
+            e = out.setdefault(int(h.s_store[i]), [0, 0, 0])
+            e[0] += int(h.s_price[i])
+            e[2] += int(h.s_profit[i])
+    for i in range(len(h.r_date)):
+        if int(h.r_date[i]) in dd:
+            e = out.setdefault(int(h.r_store[i]), [0, 0, 0])
+            e[1] += int(h.r_amt[i])
+            e[2] -= int(h.r_loss[i])
+    rows = sorted((int(h.st_id[st]), a, b, c)
+                  for st, (a, b, c) in out.items())
+    return rows
+
+
+# ------------------------------------------------------------------- q9
+
+
+def gen_q9(rows: int = 100_000, seed: int = 9):
+    rng = np.random.default_rng(seed)
+    return (jnp.asarray(rng.integers(1, 101, rows).astype(np.int32)),
+            jnp.asarray(rng.integers(100, 30_000, rows)
+                        .astype(np.int64)),
+            jnp.asarray(rng.integers(-5_000, 20_000, rows)
+                        .astype(np.int64)))
+
+
+_Q9_BUCKETS = ((1, 20), (21, 40), (41, 60), (61, 80), (81, 100))
+
+
+@jax.jit
+def run_q9(quantity: jnp.ndarray, price: jnp.ndarray,
+           profit: jnp.ndarray):
+    """q9-shape: per-bucket count / avg(price) / avg(profit); avgs in
+    f64 at the presentation edge, sums exact in int64."""
+    counts, avg_p, avg_n = [], [], []
+    for lo, hi in _Q9_BUCKETS:
+        m = (quantity >= lo) & (quantity <= hi)
+        c = jnp.sum(m.astype(jnp.int64))
+        sp = jnp.sum(jnp.where(m, price, 0))
+        sn = jnp.sum(jnp.where(m, profit, 0))
+        counts.append(c)
+        avg_p.append(sp.astype(jnp.float64)
+                     / jnp.maximum(c, 1).astype(jnp.float64))
+        avg_n.append(sn.astype(jnp.float64)
+                     / jnp.maximum(c, 1).astype(jnp.float64))
+    return (jnp.stack(counts), jnp.stack(avg_p), jnp.stack(avg_n))
+
+
+def oracle_q9(quantity, price, profit):
+    q = np.asarray(quantity)
+    p = np.asarray(price)
+    n = np.asarray(profit)
+    out = []
+    for lo, hi in _Q9_BUCKETS:
+        m = (q >= lo) & (q <= hi)
+        c = int(m.sum())
+        out.append((c, p[m].sum() / max(c, 1), n[m].sum() / max(c, 1)))
+    return out
+
+
+# ------------------------------------------------------------------ q72
+
+
+class Q72Data(NamedTuple):
+    cs_item: jnp.ndarray      # i32 item key
+    cs_date: jnp.ndarray      # i32 order date (days)
+    cs_qty: jnp.ndarray       # i32
+    inv_item: jnp.ndarray     # i32
+    inv_date: jnp.ndarray     # i32 inventory date (days)
+    inv_qty: jnp.ndarray      # i32
+    item_id: jnp.ndarray      # i32 dictionary id per item key (dense)
+
+
+def gen_q72(cs_rows: int = 30_000, inv_rows: int = 30_000,
+            items: int = 512, days: int = 70, seed: int = 72
+            ) -> Q72Data:
+    rng = np.random.default_rng(seed)
+    base = 11_000
+    return Q72Data(
+        jnp.asarray(rng.integers(0, items, cs_rows).astype(np.int32)),
+        jnp.asarray(rng.integers(base, base + days, cs_rows)
+                    .astype(np.int32)),
+        jnp.asarray(rng.integers(1, 100, cs_rows).astype(np.int32)),
+        jnp.asarray(rng.integers(0, items, inv_rows).astype(np.int32)),
+        jnp.asarray(rng.integers(base, base + days, inv_rows)
+                    .astype(np.int32)),
+        jnp.asarray(rng.integers(1, 60, inv_rows).astype(np.int32)),
+        jnp.asarray(rng.permutation(items).astype(np.int32)),
+    )
+
+
+def _q72_kernel(items: int, max_week: int, join_capacity: int,
+                limit: int, week0: int, reduce_sum, reduce_any):
+    """Shared per-shard q72 pipeline body (see _q5_kernel)."""
+    n_groups = items * max_week
+
+    def compute(cs_item, cs_date, cs_qty, inv_item, inv_date,
+                inv_qty, item_id):
+        pairs = inner_join_device(cs_item, inv_item, join_capacity)
+        li, ri, ok = (pairs.left_indices, pairs.right_indices,
+                      pairs.valid)
+        order_week = cs_date[li] // 7
+        inv_week = inv_date[ri] // 7
+        week = order_week - week0
+        keep = (ok & (inv_week == order_week + 1)
+                & (inv_qty[ri] < cs_qty[li])
+                & (week >= 0) & (week < max_week))
+        iid = item_id[cs_item[li]]
+        gid = jnp.where(keep, iid * max_week + week, 0)
+        # masked rows land on gid 0 but add 0 (the summand is `keep`)
+        counts = jax.ops.segment_sum(keep.astype(jnp.int64), gid,
+                                     num_segments=n_groups)
+        counts = reduce_sum(counts)
+        # ORDER BY count DESC, item ASC LIMIT k over the group table
+        gidx = jnp.arange(n_groups, dtype=jnp.int64)
+        sort_key = jnp.where(counts > 0, -counts, jnp.int64(2**62))
+        _k, gid_s, cnt_s = lax.sort((sort_key, gidx, counts),
+                                    num_keys=2)
+        return (gid_s[:limit] // max_week,
+                gid_s[:limit] % max_week + week0, cnt_s[:limit],
+                reduce_any(pairs.total > join_capacity))
+
+    return compute
+
+
+def make_q72(items: int, max_week: int, join_capacity: int,
+             limit: int = 100, week0: int = 0):
+    """q72-shape single-jit pipeline: cs JOIN inv ON item (fact-fact)
+    with inv_week == order_week + 1 and inv_qty < cs_qty filters,
+    item-dim join for the dictionary id, GROUP BY (item, week) COUNT,
+    ORDER BY count DESC, item_id ASC LIMIT `limit`.  The group space
+    is items x max_week with weeks rebased to week0 (the date_dim
+    window's first week) — the group table stays proportional to the
+    QUERY's domain, not the calendar's."""
+    kernel = _q72_kernel(items, max_week, join_capacity, limit,
+                         week0, lambda x: x, lambda b: b)
+
+    @jax.jit
+    def run(d: Q72Data):
+        return kernel(*d)
+
+    return run
+
+
+def oracle_q72(d: Q72Data, items: int, max_week: int,
+               limit: int = 100, week0: int = 0):
+    from collections import Counter, defaultdict
+    inv_by_item = defaultdict(list)
+    inv_item = np.asarray(d.inv_item)
+    inv_date = np.asarray(d.inv_date)
+    inv_qty = np.asarray(d.inv_qty)
+    for j in range(len(inv_item)):
+        inv_by_item[int(inv_item[j])].append(j)
+    counts: Counter = Counter()
+    cs_item = np.asarray(d.cs_item)
+    cs_date = np.asarray(d.cs_date)
+    cs_qty = np.asarray(d.cs_qty)
+    item_id = np.asarray(d.item_id)
+    for i in range(len(cs_item)):
+        ow = int(cs_date[i]) // 7
+        for j in inv_by_item.get(int(cs_item[i]), ()):
+            if (int(inv_date[j]) // 7 == ow + 1
+                    and int(inv_qty[j]) < int(cs_qty[i])
+                    and 0 <= ow - week0 < max_week):
+                counts[(int(item_id[cs_item[i]]), ow - week0)] += 1
+    rows = sorted(((-c, iid * max_week + wk)
+                   for (iid, wk), c in counts.items()))
+    return [(g // max_week, g % max_week + week0, -negc)
+            for negc, g in rows[:limit]]
+
+
+# ----------------------------------------------------------- multichip
+
+
+def make_q5_multichip(mesh: Mesh, stores: int, join_capacity: int):
+    """q5-shape on the mesh: facts sharded over the 'data' axis
+    (row-parallel scan), the date window and store dim replicated
+    (broadcast join — dims fit HBM, the same plan GpuBroadcastHashJoin
+    picks), per-shard partial group-by via the SHARED _q5_kernel, ONE
+    psum over ICI for the global group table, order-by replicated.
+    The whole step is a single jitted shard_map program."""
+    from jax import shard_map as smap
+
+    axis = mesh.axis_names[0]
+    kernel = _q5_kernel(
+        stores, join_capacity,
+        lambda x: lax.psum(x, axis),
+        lambda b: lax.psum(b.astype(jnp.int32), axis) > 0)
+    shard = P(axis)
+    rep = P()
+    fn = smap(kernel, mesh=mesh,
+              in_specs=(shard, shard, shard, shard,
+                        shard, shard, shard, shard, rep, rep),
+              out_specs=(rep, rep, rep, rep, rep))
+    return jax.jit(fn)
+
+
+def make_q72_multichip(mesh: Mesh, items: int, max_week: int,
+                       join_capacity: int, limit: int = 100,
+                       week0: int = 0):
+    """q72-shape on the mesh: catalog_sales sharded row-parallel,
+    inventory + item dim replicated (broadcast), per-shard join +
+    filters + partial (item, week) counts via the SHARED _q72_kernel,
+    psum for the global group table, top-k replicated."""
+    from jax import shard_map as smap
+
+    axis = mesh.axis_names[0]
+    kernel = _q72_kernel(
+        items, max_week, join_capacity, limit, week0,
+        lambda x: lax.psum(x, axis),
+        lambda b: lax.psum(b.astype(jnp.int32), axis) > 0)
+    shard = P(axis)
+    rep = P()
+    fn = smap(kernel, mesh=mesh,
+              in_specs=(shard, shard, shard, rep, rep, rep, rep),
+              out_specs=(rep, rep, rep, rep))
+    return jax.jit(fn)

@@ -1,0 +1,103 @@
+"""TPC-DS-shaped flagship pipelines vs numpy oracles — single-jit
+single-chip and 8-device-mesh variants (BASELINE.json configs[4]
+q5/q9/q72 shapes)."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from spark_rapids_tpu.models import tpcds
+
+STORES = 16
+ITEMS = 64
+MAX_WEEK = 16
+WEEK0 = 11_000 // 7
+
+
+def _q5_rows(outs):
+    key_s, sales, rets, profit, overflow = outs
+    assert not bool(overflow)
+    key = np.asarray(key_s)
+    live = key != 2**31 - 1
+    return [tuple(int(x) for x in row) for row in zip(
+        key[live], np.asarray(sales)[live], np.asarray(rets)[live],
+        np.asarray(profit)[live])]
+
+
+def test_q5_single_chip():
+    d = tpcds.gen_q5(rows=4000, stores=STORES, days=60)
+    run = tpcds.make_q5(STORES, join_capacity=1 << 13)
+    got = _q5_rows(run(d))
+    assert got == tpcds.oracle_q5(d, STORES)
+
+
+def test_q9_single_chip():
+    q, p, n = tpcds.gen_q9(rows=20_000)
+    counts, avg_p, avg_n = tpcds.run_q9(q, p, n)
+    want = tpcds.oracle_q9(q, p, n)
+    for i, (c, ap, an) in enumerate(want):
+        assert int(counts[i]) == c
+        assert np.isclose(float(avg_p[i]), ap)
+        assert np.isclose(float(avg_n[i]), an)
+
+
+def _q72_rows(outs):
+    items, weeks, cnts, overflow = outs
+    assert not bool(overflow)
+    cnts = np.asarray(cnts)
+    live = cnts > 0
+    return [tuple(int(x) for x in row) for row in zip(
+        np.asarray(items)[live], np.asarray(weeks)[live], cnts[live])]
+
+
+def test_q72_single_chip():
+    d = tpcds.gen_q72(cs_rows=3000, inv_rows=3000, items=ITEMS,
+                      days=35)
+    run = tpcds.make_q72(ITEMS, MAX_WEEK, join_capacity=1 << 18,
+                         week0=WEEK0)
+    got = _q72_rows(run(d))
+    want = tpcds.oracle_q72(d, ITEMS, MAX_WEEK, week0=WEEK0)
+    assert got == want
+
+
+def test_q72_overflow_flag():
+    d = tpcds.gen_q72(cs_rows=2000, inv_rows=2000, items=4, days=35)
+    run = tpcds.make_q72(4, MAX_WEEK, join_capacity=64, week0=WEEK0)
+    *_rest, overflow = run(d)
+    assert bool(overflow)
+
+
+@pytest.fixture
+def mesh8():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    return Mesh(np.array(devs[:8]), ("data",))
+
+
+def test_q5_multichip(mesh8):
+    rows = 4096   # divisible by 8
+    d = tpcds.gen_q5(rows=rows, stores=STORES, days=60)
+    d = d._replace(r_date=d.r_date[:rows // 8 * 8],
+                   r_store=d.r_store[:rows // 8 * 8],
+                   r_amt=d.r_amt[:rows // 8 * 8],
+                   r_loss=d.r_loss[:rows // 8 * 8])
+    step = tpcds.make_q5_multichip(mesh8, STORES,
+                                   join_capacity=1 << 11)
+    got = _q5_rows(step(d.s_date, d.s_store, d.s_price, d.s_profit,
+                        d.r_date, d.r_store, d.r_amt, d.r_loss,
+                        d.d_date, d.st_id))
+    assert got == tpcds.oracle_q5(d, STORES)
+
+
+def test_q72_multichip(mesh8):
+    d = tpcds.gen_q72(cs_rows=2048, inv_rows=2048, items=ITEMS,
+                      days=35)
+    step = tpcds.make_q72_multichip(mesh8, ITEMS, MAX_WEEK,
+                                    join_capacity=1 << 16,
+                                    week0=WEEK0)
+    got = _q72_rows(step(d.cs_item, d.cs_date, d.cs_qty, d.inv_item,
+                         d.inv_date, d.inv_qty, d.item_id))
+    want = tpcds.oracle_q72(d, ITEMS, MAX_WEEK, week0=WEEK0)
+    assert got == want
