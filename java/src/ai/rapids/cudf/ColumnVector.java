@@ -1,0 +1,41 @@
+package ai.rapids.cudf;
+
+import com.nvidia.spark.rapids.jni.TpuColumns;
+
+/**
+ * Owning device column, cudf-java-shaped: close() releases the
+ * runtime handle.  Factories mirror the cudf-java builders the
+ * plugin calls.
+ */
+public class ColumnVector extends ColumnView implements AutoCloseable {
+  private boolean closed = false;
+
+  public ColumnVector(long handle) {
+    super(handle);
+  }
+
+  public static ColumnVector fromLongs(long... values) {
+    return new ColumnVector(TpuColumns.fromLongs(values));
+  }
+
+  public static ColumnVector fromInts(int... values) {
+    return new ColumnVector(TpuColumns.fromInts(values));
+  }
+
+  public static ColumnVector fromDoubles(double... values) {
+    return new ColumnVector(TpuColumns.fromDoubles(values));
+  }
+
+  public static ColumnVector fromStrings(String... values) {
+    return new ColumnVector(TpuColumns.fromStrings(values));
+  }
+
+  @Override
+  public void close() {
+    if (!closed) {
+      closed = true;
+      TpuColumns.free(handle);
+      handle = 0;
+    }
+  }
+}

@@ -1,0 +1,25 @@
+package ai.rapids.cudf;
+
+import com.nvidia.spark.rapids.jni.TpuColumns;
+
+/**
+ * Non-owning view of a device column, cudf-java-shaped: wraps the
+ * jlong handle the JNI ops pass around (reference discipline:
+ * hash/HashJni.cpp:31-46 unwraps the same way).  The TPU runtime owns
+ * the memory; views never free.
+ */
+public class ColumnView {
+  protected long handle;
+
+  public ColumnView(long handle) {
+    this.handle = handle;
+  }
+
+  public final long getNativeView() {
+    return handle;
+  }
+
+  public final ColumnView getChildColumnView(int index) {
+    return new ColumnView(TpuColumns.getChild(handle, index));
+  }
+}

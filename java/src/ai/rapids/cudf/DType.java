@@ -1,0 +1,37 @@
+package ai.rapids.cudf;
+
+/**
+ * Column element types, cudf-java-shaped (reference consumer: the
+ * spark-rapids plugin passes ai.rapids.cudf types into the jni
+ * package; TPU runtime ids: spark_rapids_tpu/columns/dtypes.py).
+ */
+public final class DType {
+  public final String typeId;
+  public final int scale;
+
+  private DType(String typeId, int scale) {
+    this.typeId = typeId;
+    this.scale = scale;
+  }
+
+  public static final DType BOOL8 = new DType("bool8", 0);
+  public static final DType INT8 = new DType("int8", 0);
+  public static final DType INT16 = new DType("int16", 0);
+  public static final DType INT32 = new DType("int32", 0);
+  public static final DType INT64 = new DType("int64", 0);
+  public static final DType FLOAT32 = new DType("float32", 0);
+  public static final DType FLOAT64 = new DType("float64", 0);
+  public static final DType STRING = new DType("string", 0);
+  public static final DType TIMESTAMP_DAYS =
+      new DType("timestamp_days", 0);
+  public static final DType TIMESTAMP_MICROSECONDS =
+      new DType("timestamp_micros", 0);
+
+  public static DType decimal128(int scale) {
+    return new DType("decimal128", scale);
+  }
+
+  public static DType fromTypeId(String typeId, int scale) {
+    return new DType(typeId, scale);
+  }
+}

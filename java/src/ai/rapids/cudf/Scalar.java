@@ -1,0 +1,38 @@
+package ai.rapids.cudf;
+
+/**
+ * A single typed value, cudf-java-shaped — the plugin passes scalars
+ * for broadcast operands (e.g. query keys, literals).
+ */
+public final class Scalar implements AutoCloseable {
+  public final DType type;
+  private final Object value;
+
+  private Scalar(DType type, Object value) {
+    this.type = type;
+    this.value = value;
+  }
+
+  public static Scalar fromLong(long v) {
+    return new Scalar(DType.INT64, v);
+  }
+
+  public static Scalar fromInt(int v) {
+    return new Scalar(DType.INT32, v);
+  }
+
+  public static Scalar fromDouble(double v) {
+    return new Scalar(DType.FLOAT64, v);
+  }
+
+  public static Scalar fromString(String v) {
+    return new Scalar(DType.STRING, v);
+  }
+
+  public Object getValue() {
+    return value;
+  }
+
+  @Override
+  public void close() {}
+}

@@ -1,0 +1,37 @@
+package ai.rapids.cudf;
+
+/**
+ * A set of equal-length columns, cudf-java-shaped: the handle bundle
+ * GpuExec operators pass to the jni ops.  Owns its vectors.
+ */
+public final class Table implements AutoCloseable {
+  private final ColumnVector[] columns;
+
+  public Table(ColumnVector... columns) {
+    this.columns = columns;
+  }
+
+  public int getNumberOfColumns() {
+    return columns.length;
+  }
+
+  public ColumnVector getColumn(int index) {
+    return columns[index];
+  }
+
+  /** jlong handle array in column order — the JNI calling shape. */
+  public long[] getNativeHandles() {
+    long[] out = new long[columns.length];
+    for (int i = 0; i < columns.length; i++) {
+      out[i] = columns[i].getNativeView();
+    }
+    return out;
+  }
+
+  @Override
+  public void close() {
+    for (ColumnVector c : columns) {
+      c.close();
+    }
+  }
+}

@@ -1,0 +1,35 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Reflection helpers (reference FieldUtils.java): read a possibly
+ * non-public field from an object — used by the plugin to reach into
+ * Spark internals without compile-time dependencies.  Pure Java.
+ */
+public final class FieldUtils {
+  private FieldUtils() {}
+
+  public static Object readField(Object target, String fieldName) {
+    return readField(target, fieldName, false);
+  }
+
+  public static Object readField(Object target, String fieldName,
+                                 boolean forceAccess) {
+    Class<?> cls = target.getClass();
+    while (cls != null) {
+      try {
+        java.lang.reflect.Field f = cls.getDeclaredField(fieldName);
+        if (forceAccess) {
+          f.setAccessible(true);
+        }
+        return f.get(target);
+      } catch (NoSuchFieldException e) {
+        cls = cls.getSuperclass();
+      } catch (IllegalAccessException e) {
+        throw new RuntimeException(
+            "cannot access field " + fieldName, e);
+      }
+    }
+    throw new RuntimeException(
+        "no field " + fieldName + " on " + target.getClass());
+  }
+}

@@ -1,0 +1,43 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Spark slice(list, start, length) over column handles (reference
+ * GpuListSliceUtils.java over list_slice.hpp's four scalar/column
+ * overloads; TPU engine: ops/strings_misc.list_slice).  start is
+ * 1-based, negative counts from the end; a zero start (or negative
+ * length) raises ExceptionWithRowIndex when checked.
+ */
+public final class GpuListSliceUtils {
+  private GpuListSliceUtils() {}
+
+  public static long listSlice(long cv, int start, int length) {
+    return listSlice(cv, start, length, true);
+  }
+
+  public static native long listSlice(long cv, int start, int length,
+                                      boolean checkStartLength);
+
+  public static long listSlice(long cv, int start, long lengthCv) {
+    return listSliceSC(cv, start, lengthCv, true);
+  }
+
+  public static native long listSliceSC(long cv, int start,
+                                        long lengthCv,
+                                        boolean checkStartLength);
+
+  public static long listSlice(long cv, long startCv, int length) {
+    return listSliceCS(cv, startCv, length, true);
+  }
+
+  public static native long listSliceCS(long cv, long startCv,
+                                        int length,
+                                        boolean checkStartLength);
+
+  public static long listSlice(long cv, long startCv, long lengthCv) {
+    return listSliceCC(cv, startCv, lengthCv, true);
+  }
+
+  public static native long listSliceCC(long cv, long startCv,
+                                        long lengthCv,
+                                        boolean checkStartLength);
+}

@@ -1,0 +1,13 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * map_zip_with support: align two MAP columns on their key union
+ * (reference GpuMapZipWithUtils.java; TPU engine:
+ * ops/map_utils.map_zip_full).  Returns a STRUCT<key, value1, value2>
+ * list column handle.
+ */
+public final class GpuMapZipWithUtils {
+  private GpuMapZipWithUtils() {}
+
+  public static native long mapZip(long map1, long map2);
+}

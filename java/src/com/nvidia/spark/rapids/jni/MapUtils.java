@@ -1,0 +1,16 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * MAP column helpers (reference MapUtils.java; TPU engine:
+ * ops/map_utils).  mapFromEntries keeps the LAST value for duplicate
+ * keys (Spark semantics) and can throw on null keys.
+ */
+public final class MapUtils {
+  private MapUtils() {}
+
+  public static native boolean isValidMap(long listOfStructs,
+                                          boolean throwOnNullKey);
+
+  public static native long mapFromEntries(long listOfStructs,
+                                           boolean throwOnNullKey);
+}

@@ -1,0 +1,42 @@
+package com.nvidia.spark.rapids.jni;
+
+import java.util.ArrayList;
+import java.util.List;
+
+/**
+ * Extracts DST rules / transition tables for ORC timezone
+ * rectification (reference OrcDstRuleExtractor.java; TPU engine:
+ * ops/orc_timezones.get_orc_timezone_info over utils/tzdb.py TZif
+ * parsing).  The native entry returns the packed transition table;
+ * this class unpacks it into {@link OrcTimezoneInfo}.
+ */
+public final class OrcDstRuleExtractor {
+  private OrcDstRuleExtractor() {}
+
+  /** packed: [rawOffsetMillis, hasDst, n, trans_0.., offs_0..]. */
+  static native long[] timezoneInfoPacked(String zoneId);
+
+  static native String[] timezoneIds();
+
+  public static OrcTimezoneInfo extract(String zoneId) {
+    long[] p = timezoneInfoPacked(zoneId);
+    int n = (int) p[2];
+    long[] trans = new long[n];
+    int[] offs = new int[n];
+    for (int i = 0; i < n; i++) {
+      trans[i] = p[3 + i];
+      offs[i] = (int) p[3 + n + i];
+    }
+    return new OrcTimezoneInfo(zoneId, (int) p[0], p[1] != 0, trans,
+                               offs);
+  }
+
+  public static List<String> allTimezoneIds() {
+    String[] ids = timezoneIds();
+    List<String> out = new ArrayList<>(ids.length);
+    for (String s : ids) {
+      out.add(s);
+    }
+    return out;
+  }
+}

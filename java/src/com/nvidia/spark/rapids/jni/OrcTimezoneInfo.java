@@ -1,0 +1,37 @@
+package com.nvidia.spark.rapids.jni;
+
+import java.util.List;
+
+/**
+ * ORC writer-timezone rectification info (reference
+ * OrcTimezoneInfo.java; TPU engine: ops/orc_timezones.py over the
+ * TZif database in utils/tzdb.py).  Carries the raw (non-DST) offset
+ * and the DST transition table used to rectify ORC timestamps written
+ * under a different zone.
+ */
+public final class OrcTimezoneInfo {
+  public final String zoneId;
+  public final int rawOffsetMillis;
+  public final boolean hasDst;
+  /** transition instants (millis, UTC) — empty for fixed zones. */
+  public final long[] transitionsMillis;
+  /** offset in effect after each transition (millis). */
+  public final int[] offsetsMillis;
+
+  OrcTimezoneInfo(String zoneId, int rawOffsetMillis, boolean hasDst,
+                  long[] transitionsMillis, int[] offsetsMillis) {
+    this.zoneId = zoneId;
+    this.rawOffsetMillis = rawOffsetMillis;
+    this.hasDst = hasDst;
+    this.transitionsMillis = transitionsMillis;
+    this.offsetsMillis = offsetsMillis;
+  }
+
+  public static OrcTimezoneInfo get(String timezoneId) {
+    return OrcDstRuleExtractor.extract(timezoneId);
+  }
+
+  public static List<String> getAllTimezoneIds() {
+    return OrcDstRuleExtractor.allTimezoneIds();
+  }
+}

@@ -1,0 +1,83 @@
+package com.nvidia.spark.rapids.jni;
+
+import java.util.ArrayList;
+import java.util.List;
+
+/**
+ * Builder for the flat protobuf schema the decoder consumes
+ * (reference ProtobufSchemaDescriptor.java over protobuf.hpp:26-67
+ * nested_field_descriptor; TPU engine: ops/protobuf.py Field +
+ * ops/protobuf_device.py).  Fields are added depth-first pre-order —
+ * a message field's children immediately follow it — producing the
+ * parallel arrays {@link Protobuf} decode takes.
+ */
+public final class ProtobufSchemaDescriptor {
+  public static final int ENC_DEFAULT = 0;
+  public static final int ENC_FIXED = 1;
+  public static final int ENC_ZIGZAG = 2;
+
+  private final List<int[]> rows = new ArrayList<>();
+  private final List<String> names = new ArrayList<>();
+  private final List<String> typeIds = new ArrayList<>();
+
+  /**
+   * @param fieldNumber proto field number (> 0)
+   * @param typeId runtime dtype id ("int64", "string", "struct", ...)
+   * @param encoding ENC_DEFAULT / ENC_FIXED / ENC_ZIGZAG
+   * @param repeated repeated field (host-decoded)
+   * @param required proto2 required (missing nulls the row)
+   * @param numChildren child count for message fields, else 0
+   */
+  public ProtobufSchemaDescriptor addField(
+      String name, int fieldNumber, String typeId, int encoding,
+      boolean repeated, boolean required, int numChildren) {
+    if (fieldNumber <= 0) {
+      throw new IllegalArgumentException("fieldNumber must be > 0");
+    }
+    rows.add(new int[]{fieldNumber, encoding, repeated ? 1 : 0,
+                       required ? 1 : 0, numChildren});
+    names.add(name);
+    typeIds.add(typeId);
+    return this;
+  }
+
+  public int numFields() {
+    return rows.size();
+  }
+
+  public int[] fieldNumbers() {
+    return col(0);
+  }
+
+  public int[] encodings() {
+    return col(1);
+  }
+
+  public int[] repeatedFlags() {
+    return col(2);
+  }
+
+  public int[] requiredFlags() {
+    return col(3);
+  }
+
+  public int[] childCounts() {
+    return col(4);
+  }
+
+  public String[] names() {
+    return names.toArray(new String[0]);
+  }
+
+  public String[] typeIds() {
+    return typeIds.toArray(new String[0]);
+  }
+
+  private int[] col(int k) {
+    int[] out = new int[rows.size()];
+    for (int i = 0; i < out.length; i++) {
+      out[i] = rows.get(i)[k];
+    }
+    return out;
+  }
+}

@@ -1,0 +1,10 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Rounding modes for {@link Arithmetic#round} (reference
+ * RoundMode.java; TPU engine: ops/arithmetic.py HALF_UP/HALF_EVEN).
+ */
+public enum RoundMode {
+  HALF_UP,
+  HALF_EVEN;
+}

@@ -1,0 +1,57 @@
+package com.nvidia.spark.rapids.jni;
+
+/**
+ * Handle-level view of the per-executor resource adaptor state
+ * machine (reference SparkResourceAdaptor.java over
+ * SparkResourceAdaptorJni.cpp; TPU engine:
+ * memory/spark_resource_adaptor.py, differentially tested against the
+ * native C++ port).  {@link RmmSpark} is the static facade most
+ * callers use; this class exposes the same operations for code
+ * written against the reference's adaptor object.
+ */
+public class SparkResourceAdaptor implements AutoCloseable {
+  private boolean open = true;
+
+  public SparkResourceAdaptor(String logLoc) {
+    RmmSpark.setEventHandler(Long.MAX_VALUE, logLoc);
+  }
+
+  public void startDedicatedTaskThread(long threadId, long taskId) {
+    checkOpen();
+    RmmSpark.startDedicatedTaskThread(threadId, taskId);
+  }
+
+  public void taskDone(long taskId) {
+    checkOpen();
+    RmmSpark.taskDone(taskId);
+  }
+
+  public void forceRetryOOM(long threadId, int numOOMs) {
+    checkOpen();
+    RmmSpark.forceRetryOOM(threadId, numOOMs);
+  }
+
+  public void forceSplitAndRetryOOM(long threadId, int numOOMs) {
+    checkOpen();
+    RmmSpark.forceSplitAndRetryOOM(threadId, numOOMs);
+  }
+
+  public void blockThreadUntilReady() {
+    checkOpen();
+    RmmSpark.blockThreadUntilReady();
+  }
+
+  private void checkOpen() {
+    if (!open) {
+      throw new IllegalStateException("adaptor is closed");
+    }
+  }
+
+  @Override
+  public void close() {
+    if (open) {
+      open = false;
+      RmmSpark.clearEventHandler();
+    }
+  }
+}

@@ -1,0 +1,29 @@
+package com.nvidia.spark.rapids.jni.fileio;
+
+import java.io.IOException;
+
+/**
+ * Pluggable file IO SPI (reference fileio/RapidsFileIO.java; TPU
+ * twin: spark_rapids_tpu/io/fileio.py).  Implementations adapt
+ * cloud / HDFS / local storage; {@link #local()} returns the built-in
+ * local-filesystem implementation.
+ */
+public interface RapidsFileIO {
+  RapidsInputFile newInputFile(String path) throws IOException;
+
+  RapidsOutputFile newOutputFile(String path) throws IOException;
+
+  static RapidsFileIO local() {
+    return new RapidsFileIO() {
+      @Override
+      public RapidsInputFile newInputFile(String path) {
+        return RapidsInputFile.local(path);
+      }
+
+      @Override
+      public RapidsOutputFile newOutputFile(String path) {
+        return RapidsOutputFile.local(path);
+      }
+    };
+  }
+}

@@ -1,0 +1,55 @@
+package com.nvidia.spark.rapids.jni.fileio;
+
+import java.io.IOException;
+
+/**
+ * Readable file handle (reference fileio/RapidsInputFile.java).
+ */
+public interface RapidsInputFile {
+  long getLength() throws IOException;
+
+  SeekableInputStream open() throws IOException;
+
+  static RapidsInputFile local(String path) {
+    final java.io.File f = new java.io.File(path);
+    return new RapidsInputFile() {
+      @Override
+      public long getLength() {
+        return f.length();
+      }
+
+      @Override
+      public SeekableInputStream open() throws IOException {
+        final java.io.RandomAccessFile raf =
+            new java.io.RandomAccessFile(f, "r");
+        return new SeekableInputStream() {
+          @Override
+          public long getPos() throws IOException {
+            return raf.getFilePointer();
+          }
+
+          @Override
+          public void seek(long pos) throws IOException {
+            raf.seek(pos);
+          }
+
+          @Override
+          public int read() throws IOException {
+            return raf.read();
+          }
+
+          @Override
+          public int read(byte[] b, int off, int len)
+              throws IOException {
+            return raf.read(b, off, len);
+          }
+
+          @Override
+          public void close() throws IOException {
+            raf.close();
+          }
+        };
+      }
+    };
+  }
+}

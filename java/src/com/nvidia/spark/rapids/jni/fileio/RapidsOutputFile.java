@@ -1,0 +1,43 @@
+package com.nvidia.spark.rapids.jni.fileio;
+
+import java.io.FileOutputStream;
+import java.io.IOException;
+
+/**
+ * Writable file handle (reference fileio/RapidsOutputFile.java).
+ */
+public interface RapidsOutputFile {
+  RapidsOutputStream create() throws IOException;
+
+  static RapidsOutputFile local(String path) {
+    return () -> {
+      final FileOutputStream out = new FileOutputStream(path);
+      return new RapidsOutputStream() {
+        private long pos = 0;
+
+        @Override
+        public long getPos() {
+          return pos;
+        }
+
+        @Override
+        public void write(int b) throws IOException {
+          out.write(b);
+          pos += 1;
+        }
+
+        @Override
+        public void write(byte[] b, int off, int len)
+            throws IOException {
+          out.write(b, off, len);
+          pos += len;
+        }
+
+        @Override
+        public void close() throws IOException {
+          out.close();
+        }
+      };
+    };
+  }
+}

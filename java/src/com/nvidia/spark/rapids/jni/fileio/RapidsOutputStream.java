@@ -1,0 +1,11 @@
+package com.nvidia.spark.rapids.jni.fileio;
+
+import java.io.IOException;
+import java.io.OutputStream;
+
+/**
+ * Positioned output stream (reference fileio/RapidsOutputStream.java).
+ */
+public abstract class RapidsOutputStream extends OutputStream {
+  public abstract long getPos() throws IOException;
+}

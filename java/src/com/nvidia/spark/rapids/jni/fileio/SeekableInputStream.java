@@ -1,0 +1,31 @@
+package com.nvidia.spark.rapids.jni.fileio;
+
+import java.io.EOFException;
+import java.io.IOException;
+import java.io.InputStream;
+
+/**
+ * Positioned input stream (reference fileio/SeekableInputStream.java).
+ */
+public abstract class SeekableInputStream extends InputStream {
+  public abstract long getPos() throws IOException;
+
+  public abstract void seek(long pos) throws IOException;
+
+  public void readFully(byte[] buffer) throws IOException {
+    readFully(buffer, 0, buffer.length);
+  }
+
+  public void readFully(byte[] buffer, int offset, int length)
+      throws IOException {
+    int done = 0;
+    while (done < length) {
+      int n = read(buffer, offset + done, length - done);
+      if (n < 0) {
+        throw new EOFException(
+            "EOF after " + done + " of " + length + " bytes");
+      }
+      done += n;
+    }
+  }
+}

@@ -1,0 +1,39 @@
+package com.nvidia.spark.rapids.jni.kudo;
+
+import java.io.ByteArrayOutputStream;
+import java.io.IOException;
+
+/**
+ * DataWriter over a ByteArrayOutputStream (reference
+ * kudo/ByteArrayOutputStreamWriter.java).
+ */
+public final class ByteArrayOutputStreamWriter extends DataWriter {
+  private final ByteArrayOutputStream out;
+
+  public ByteArrayOutputStreamWriter(ByteArrayOutputStream out) {
+    this.out = out;
+  }
+
+  @Override
+  public void writeInt(int v) {
+    out.write((v >>> 24) & 0xFF);
+    out.write((v >>> 16) & 0xFF);
+    out.write((v >>> 8) & 0xFF);
+    out.write(v & 0xFF);
+  }
+
+  @Override
+  public void write(byte[] src, int offset, int len) {
+    out.write(src, offset, len);
+  }
+
+  @Override
+  public long getLength() {
+    return out.size();
+  }
+
+  @Override
+  public void flush() throws IOException {
+    out.flush();
+  }
+}

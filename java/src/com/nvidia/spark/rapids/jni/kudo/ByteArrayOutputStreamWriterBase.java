@@ -1,0 +1,36 @@
+package com.nvidia.spark.rapids.jni.kudo;
+
+import java.io.ByteArrayOutputStream;
+
+/**
+ * Shared body for byte-array DataWriters (this framework's
+ * factoring; the reference duplicates the stream body in
+ * ByteArrayOutputStreamWriter and OpenByteArrayOutputStreamWriter).
+ */
+public abstract class ByteArrayOutputStreamWriterBase
+    extends DataWriter {
+  private final ByteArrayOutputStream out;
+
+  protected ByteArrayOutputStreamWriterBase(
+      ByteArrayOutputStream out) {
+    this.out = out;
+  }
+
+  @Override
+  public void writeInt(int v) {
+    out.write((v >>> 24) & 0xFF);
+    out.write((v >>> 16) & 0xFF);
+    out.write((v >>> 8) & 0xFF);
+    out.write(v & 0xFF);
+  }
+
+  @Override
+  public void write(byte[] src, int offset, int len) {
+    out.write(src, offset, len);
+  }
+
+  @Override
+  public long getLength() {
+    return out.size();
+  }
+}

@@ -1,0 +1,51 @@
+package com.nvidia.spark.rapids.jni.kudo;
+
+/**
+ * Byte offsets of one column's buffers inside a merged host block
+ * (reference kudo/ColumnOffsetInfo.java): INVALID_OFFSET marks an
+ * absent buffer.
+ */
+public final class ColumnOffsetInfo {
+  public static final long INVALID_OFFSET = -1;
+
+  private final long validity;
+  private final long offset;
+  private final long data;
+  private final long dataLen;
+
+  public ColumnOffsetInfo(long validity, long offset, long data,
+                          long dataLen) {
+    this.validity = validity;
+    this.offset = offset;
+    this.data = data;
+    this.dataLen = dataLen;
+  }
+
+  public long getValidity() {
+    return validity;
+  }
+
+  public long getOffset() {
+    return offset;
+  }
+
+  public long getData() {
+    return data;
+  }
+
+  public long getDataLen() {
+    return dataLen;
+  }
+
+  public boolean hasValidity() {
+    return validity != INVALID_OFFSET;
+  }
+
+  public boolean hasOffset() {
+    return offset != INVALID_OFFSET;
+  }
+
+  public boolean hasData() {
+    return data != INVALID_OFFSET;
+  }
+}

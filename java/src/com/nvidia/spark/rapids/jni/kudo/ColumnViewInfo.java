@@ -1,0 +1,20 @@
+package com.nvidia.spark.rapids.jni.kudo;
+
+/**
+ * Logical view of one merged column: type, null count, row count and
+ * its {@link ColumnOffsetInfo} (reference kudo/ColumnViewInfo.java).
+ */
+public final class ColumnViewInfo {
+  public final String typeId;
+  public final ColumnOffsetInfo offsets;
+  public final long nullCount;
+  public final long rowCount;
+
+  public ColumnViewInfo(String typeId, ColumnOffsetInfo offsets,
+                        long nullCount, long rowCount) {
+    this.typeId = typeId;
+    this.offsets = offsets;
+    this.nullCount = nullCount;
+    this.rowCount = rowCount;
+  }
+}

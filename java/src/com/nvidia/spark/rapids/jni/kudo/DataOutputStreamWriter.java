@@ -1,0 +1,40 @@
+package com.nvidia.spark.rapids.jni.kudo;
+
+import java.io.DataOutputStream;
+import java.io.IOException;
+
+/**
+ * DataWriter over a DataOutputStream (reference
+ * kudo/DataOutputStreamWriter.java).
+ */
+public final class DataOutputStreamWriter extends DataWriter {
+  private final DataOutputStream out;
+  private long length = 0;
+
+  public DataOutputStreamWriter(DataOutputStream out) {
+    this.out = out;
+  }
+
+  @Override
+  public void writeInt(int v) throws IOException {
+    out.writeInt(v);
+    length += 4;
+  }
+
+  @Override
+  public void write(byte[] src, int offset, int len)
+      throws IOException {
+    out.write(src, offset, len);
+    length += len;
+  }
+
+  @Override
+  public long getLength() {
+    return length;
+  }
+
+  @Override
+  public void flush() throws IOException {
+    out.flush();
+  }
+}

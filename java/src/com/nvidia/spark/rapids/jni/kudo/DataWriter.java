@@ -1,0 +1,23 @@
+package com.nvidia.spark.rapids.jni.kudo;
+
+import java.io.IOException;
+
+/**
+ * Minimal big-endian writer the kudo serializer targets (reference
+ * kudo/DataWriter.java) — lets one writer body serve streams and
+ * byte arrays.
+ */
+public abstract class DataWriter implements AutoCloseable {
+  public abstract void writeInt(int v) throws IOException;
+
+  public abstract void write(byte[] src, int offset, int len)
+      throws IOException;
+
+  /** bytes written so far. */
+  public abstract long getLength();
+
+  public void flush() throws IOException {}
+
+  @Override
+  public void close() throws IOException {}
+}

@@ -1,0 +1,11 @@
+package com.nvidia.spark.rapids.jni.kudo;
+
+/**
+ * When to dump shuffle blocks to files for debugging (reference
+ * kudo/DumpOption.java; TPU twin: shuffle/kudo.py dump_tables).
+ */
+public enum DumpOption {
+  Never,
+  OnFailure,
+  Always;
+}

@@ -1,0 +1,36 @@
+package com.nvidia.spark.rapids.jni.kudo;
+
+/**
+ * Device-resident kudo split/assemble (reference
+ * kudo/KudoGpuSerializer.java over the GPU shuffle-split kernels;
+ * TPU engine: shuffle/device_split.py device_shuffle_split /
+ * device_shuffle_assemble, byte-differential against the host
+ * writer).  This JVM surface routes through the host-table path —
+ * splitAndSerializeToHost produces the same self-delimiting blocks
+ * the device engine emits.
+ */
+public final class KudoGpuSerializer {
+  private KudoGpuSerializer() {}
+
+  /**
+   * Serialize each split [splits[i], splits[i+1]) as one kudo block
+   * and return the concatenated blob.
+   */
+  public static byte[] splitAndSerializeToHost(long hostTable,
+                                               int[] splits) {
+    OpenByteArrayOutputStream out = new OpenByteArrayOutputStream();
+    for (int i = 0; i + 1 < splits.length; i++) {
+      byte[] block = com.nvidia.spark.rapids.jni.KudoSerializer
+          .writeHostTable(hostTable, splits[i],
+                          splits[i + 1] - splits[i]);
+      out.write(block, 0, block.length);
+    }
+    return out.toByteArray();
+  }
+
+  /** Merge a blob of blocks back into a host table handle. */
+  public static long assembleFromHost(byte[] blob, long schemaTable) {
+    return com.nvidia.spark.rapids.jni.KudoSerializer
+        .mergeToHostTable(blob, schemaTable);
+  }
+}

@@ -1,0 +1,38 @@
+package com.nvidia.spark.rapids.jni.kudo;
+
+import com.nvidia.spark.rapids.jni.KudoSerializer;
+
+/**
+ * The host table a merge produced (reference
+ * kudo/KudoHostMergeResult.java): owns the native host-table handle;
+ * {@link #toColumns} materializes runtime columns (one embedded
+ * crossing).
+ */
+public final class KudoHostMergeResult implements AutoCloseable {
+  private long hostTable;
+
+  public KudoHostMergeResult(long hostTable) {
+    this.hostTable = hostTable;
+  }
+
+  public long getHostTable() {
+    return hostTable;
+  }
+
+  public long getNumRows() {
+    return KudoSerializer.hostTableNumRows(hostTable);
+  }
+
+  /** Runtime column handles (caller frees via TpuColumns.free). */
+  public long[] toColumns() {
+    return KudoSerializer.hostTableToColumns(hostTable);
+  }
+
+  @Override
+  public void close() {
+    if (hostTable != 0) {
+      KudoSerializer.freeHostTable(hostTable);
+      hostTable = 0;
+    }
+  }
+}

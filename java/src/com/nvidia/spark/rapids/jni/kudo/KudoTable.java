@@ -1,0 +1,50 @@
+package com.nvidia.spark.rapids.jni.kudo;
+
+import java.io.IOException;
+import java.io.InputStream;
+import java.util.Optional;
+
+/**
+ * One serialized kudo block: header + body bytes (reference
+ * kudo/KudoTable.java).  Blocks are self-delimiting so a stream of
+ * them can be read back one at a time.
+ */
+public final class KudoTable implements AutoCloseable {
+  private final KudoTableHeader header;
+  private final byte[] buffer;
+
+  public KudoTable(KudoTableHeader header, byte[] buffer) {
+    this.header = header;
+    this.buffer = buffer;
+  }
+
+  public KudoTableHeader getHeader() {
+    return header;
+  }
+
+  public byte[] getBuffer() {
+    return buffer;
+  }
+
+  /** Empty optional on clean EOF. */
+  public static Optional<KudoTable> from(InputStream in)
+      throws IOException {
+    Optional<KudoTableHeader> h = KudoTableHeader.readFrom(in);
+    if (!h.isPresent()) {
+      return Optional.empty();
+    }
+    byte[] body = new byte[h.get().getTotalDataLen()];
+    int done = 0;
+    while (done < body.length) {
+      int n = in.read(body, done, body.length - done);
+      if (n < 0) {
+        throw new IOException("truncated kudo body");
+      }
+      done += n;
+    }
+    return Optional.of(new KudoTable(h.get(), body));
+  }
+
+  @Override
+  public void close() {}
+}

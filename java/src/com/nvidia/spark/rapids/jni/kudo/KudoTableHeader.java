@@ -1,0 +1,131 @@
+package com.nvidia.spark.rapids.jni.kudo;
+
+import java.io.EOFException;
+import java.io.IOException;
+import java.io.InputStream;
+import java.util.Optional;
+
+/**
+ * The kudo block header (reference kudo/KudoTableHeader.java;
+ * byte-exact spec in KudoSerializer.java:48-170 and the TPU engines
+ * shuffle/kudo.py + native/kudo_native.hpp): magic "KUD0", six
+ * 4-byte big-endian fields (rowOffset, numRows, validityLen,
+ * offsetLen, totalLen, numFlatColumns) and the hasValidity bitset
+ * (LSB-first, depth-first pre-order).
+ */
+public final class KudoTableHeader {
+  public static final byte[] MAGIC = {'K', 'U', 'D', '0'};
+
+  private final int offset;
+  private final int numRows;
+  private final int validityBufferLen;
+  private final int offsetBufferLen;
+  private final int totalDataLen;
+  private final int numColumns;
+  private final byte[] hasValidityBuffer;
+
+  public KudoTableHeader(int offset, int numRows,
+                         int validityBufferLen, int offsetBufferLen,
+                         int totalDataLen, int numColumns,
+                         byte[] hasValidityBuffer) {
+    this.offset = offset;
+    this.numRows = numRows;
+    this.validityBufferLen = validityBufferLen;
+    this.offsetBufferLen = offsetBufferLen;
+    this.totalDataLen = totalDataLen;
+    this.numColumns = numColumns;
+    this.hasValidityBuffer = hasValidityBuffer;
+  }
+
+  public int getOffset() {
+    return offset;
+  }
+
+  public int getNumRows() {
+    return numRows;
+  }
+
+  public int getValidityBufferLen() {
+    return validityBufferLen;
+  }
+
+  public int getOffsetBufferLen() {
+    return offsetBufferLen;
+  }
+
+  public int getTotalDataLen() {
+    return totalDataLen;
+  }
+
+  public int getNumColumns() {
+    return numColumns;
+  }
+
+  public boolean hasValidityBuffer(int columnIndex) {
+    return (hasValidityBuffer[columnIndex / 8]
+            >> (columnIndex % 8) & 1) != 0;
+  }
+
+  /** header + body size on the wire. */
+  public int getSerializedSize() {
+    return 4 + 6 * 4 + hasValidityBuffer.length;
+  }
+
+  public void writeTo(DataWriter out) throws IOException {
+    out.write(MAGIC, 0, 4);
+    out.writeInt(offset);
+    out.writeInt(numRows);
+    out.writeInt(validityBufferLen);
+    out.writeInt(offsetBufferLen);
+    out.writeInt(totalDataLen);
+    out.writeInt(numColumns);
+    out.write(hasValidityBuffer, 0, hasValidityBuffer.length);
+  }
+
+  /** Empty optional on clean EOF before the magic. */
+  public static Optional<KudoTableHeader> readFrom(InputStream in)
+      throws IOException {
+    byte[] magic = new byte[4];
+    int first = in.read();
+    if (first < 0) {
+      return Optional.empty();
+    }
+    magic[0] = (byte) first;
+    readFully(in, magic, 1, 3);
+    for (int i = 0; i < 4; i++) {
+      if (magic[i] != MAGIC[i]) {
+        throw new IllegalStateException("bad kudo magic");
+      }
+    }
+    int offset = readBe32(in);
+    int numRows = readBe32(in);
+    int vlen = readBe32(in);
+    int olen = readBe32(in);
+    int total = readBe32(in);
+    int ncols = readBe32(in);
+    byte[] bitset = new byte[(ncols + 7) / 8];
+    readFully(in, bitset, 0, bitset.length);
+    return Optional.of(new KudoTableHeader(
+        offset, numRows, vlen, olen, total, ncols, bitset));
+  }
+
+  private static int readBe32(InputStream in) throws IOException {
+    int a = in.read(), b = in.read(), c = in.read(), d = in.read();
+    if ((a | b | c | d) < 0) {
+      throw new EOFException("truncated kudo header");
+    }
+    return (a << 24) | (b << 16) | (c << 8) | d;
+  }
+
+  private static void readFully(InputStream in, byte[] buf, int off,
+                                int len) throws IOException {
+    int done = 0;
+    while (done < len) {
+      int n = in.read(buf, off + done, len - done);
+      if (n < 0) {
+        throw new EOFException("truncated kudo header");
+      }
+      done += n;
+    }
+  }
+}

@@ -1,0 +1,67 @@
+package com.nvidia.spark.rapids.jni.kudo;
+
+import com.nvidia.spark.rapids.jni.schema.SimpleSchemaVisitor;
+
+/**
+ * Computes a {@link KudoTableHeader} for a row slice from the flat
+ * schema + per-column validity presence (reference
+ * kudo/KudoTableHeaderCalc.java — a schema-visitor pass; the byte
+ * math mirrors the spec'd engines shuffle/kudo.py /
+ * native/kudo_native.hpp).  Section lengths must be supplied by the
+ * buffer serializer; this calc owns the bitset and padding rules.
+ */
+public final class KudoTableHeaderCalc implements SimpleSchemaVisitor {
+  private final SliceInfo slice;
+  private final boolean[] hasValidity;
+  private int flatCount = 0;
+
+  public KudoTableHeaderCalc(SliceInfo slice, int numFlatColumns) {
+    this.slice = slice;
+    this.hasValidity = new boolean[numFlatColumns];
+  }
+
+  public void setHasValidity(int flatIndex, boolean has) {
+    hasValidity[flatIndex] = has && slice.rowCount > 0;
+  }
+
+  @Override
+  public void visitStruct(int flatIndex, int numChildren) {
+    flatCount++;
+  }
+
+  @Override
+  public void visitList(int flatIndex) {
+    flatCount++;
+  }
+
+  @Override
+  public void visit(int flatIndex, String typeId) {
+    flatCount++;
+  }
+
+  private static int pad4(int n) {
+    return (n + 3) / 4 * 4;
+  }
+
+  /**
+   * @param validityBytes unpadded validity section length
+   * @param offsetBytes unpadded offset section length
+   * @param dataBytes unpadded data section length
+   */
+  public KudoTableHeader build(int validityBytes, int offsetBytes,
+                               int dataBytes) {
+    int n = hasValidity.length;
+    byte[] bitset = new byte[(n + 7) / 8];
+    for (int i = 0; i < n; i++) {
+      if (hasValidity[i]) {
+        bitset[i / 8] |= (byte) (1 << (i % 8));
+      }
+    }
+    int headerSize = 4 + 24 + bitset.length;
+    int vlen = pad4(validityBytes + headerSize) - headerSize;
+    int olen = pad4(offsetBytes);
+    int dlen = pad4(dataBytes);
+    return new KudoTableHeader(slice.rowOffset, slice.rowCount, vlen,
+                               olen, vlen + olen + dlen, n, bitset);
+  }
+}

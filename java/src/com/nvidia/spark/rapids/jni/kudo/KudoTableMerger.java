@@ -1,0 +1,47 @@
+package com.nvidia.spark.rapids.jni.kudo;
+
+import java.util.List;
+
+/**
+ * Merges serialized kudo blocks into one host table (reference
+ * kudo/KudoTableMerger.java).  The byte work runs in the pure-C++
+ * engine (native/kudo_native.hpp) — the same no-interpreter-in-the-
+ * loop property the reference gets from pure JVM code, so concurrent
+ * merges on executor threads never serialize on the embedded Python.
+ */
+public final class KudoTableMerger {
+  private KudoTableMerger() {}
+
+  /**
+   * @param tables blocks to merge (order = row order)
+   * @param schemaTable a native host table with the target schema
+   */
+  public static KudoHostMergeResult merge(List<KudoTable> tables,
+                                          long schemaTable) {
+    int total = 0;
+    for (KudoTable t : tables) {
+      total += t.getHeader().getSerializedSize()
+          + t.getHeader().getTotalDataLen();
+    }
+    byte[] blob = new byte[total];
+    int pos = 0;
+    for (KudoTable t : tables) {
+      OpenByteArrayOutputStream tmp =
+          new OpenByteArrayOutputStream(
+              t.getHeader().getSerializedSize());
+      try {
+        t.getHeader().writeTo(new OpenByteArrayOutputStreamWriter(tmp));
+      } catch (java.io.IOException e) {
+        throw new RuntimeException(e);
+      }
+      System.arraycopy(tmp.getBuf(), 0, blob, pos, tmp.size());
+      pos += tmp.size();
+      byte[] body = t.getBuffer();
+      System.arraycopy(body, 0, blob, pos, body.length);
+      pos += body.length;
+    }
+    long merged = com.nvidia.spark.rapids.jni.KudoSerializer
+        .mergeToHostTable(blob, schemaTable);
+    return new KudoHostMergeResult(merged);
+  }
+}

@@ -1,0 +1,48 @@
+package com.nvidia.spark.rapids.jni.kudo;
+
+/**
+ * Per-merge metrics (reference kudo/MergeMetrics.java; TPU twin:
+ * shuffle/kudo.py MergeMetrics).
+ */
+public final class MergeMetrics {
+  private final long calcHeaderTimeNs;
+  private final long mergeIntoHostBufferTimeNs;
+
+  public MergeMetrics(long calcHeaderTimeNs,
+                      long mergeIntoHostBufferTimeNs) {
+    this.calcHeaderTimeNs = calcHeaderTimeNs;
+    this.mergeIntoHostBufferTimeNs = mergeIntoHostBufferTimeNs;
+  }
+
+  public long getCalcHeaderTimeNs() {
+    return calcHeaderTimeNs;
+  }
+
+  public long getMergeIntoHostBufferTimeNs() {
+    return mergeIntoHostBufferTimeNs;
+  }
+
+  public static Builder builder() {
+    return new Builder();
+  }
+
+  public static final class Builder {
+    private long calcHeaderTimeNs;
+    private long mergeIntoHostBufferTimeNs;
+
+    public Builder calcHeaderTime(long ns) {
+      calcHeaderTimeNs = ns;
+      return this;
+    }
+
+    public Builder mergeIntoHostBufferTime(long ns) {
+      mergeIntoHostBufferTimeNs = ns;
+      return this;
+    }
+
+    public MergeMetrics build() {
+      return new MergeMetrics(calcHeaderTimeNs,
+                              mergeIntoHostBufferTimeNs);
+    }
+  }
+}

@@ -1,0 +1,23 @@
+package com.nvidia.spark.rapids.jni.kudo;
+
+/**
+ * Options for a merge (reference kudo/MergeOptions.java): dump
+ * behavior and the dump path prefix.
+ */
+public final class MergeOptions {
+  private final DumpOption dumpOption;
+  private final String dumpPrefix;
+
+  public MergeOptions(DumpOption dumpOption, String dumpPrefix) {
+    this.dumpOption = dumpOption;
+    this.dumpPrefix = dumpPrefix;
+  }
+
+  public DumpOption getDumpOption() {
+    return dumpOption;
+  }
+
+  public String getDumpPrefix() {
+    return dumpPrefix;
+  }
+}

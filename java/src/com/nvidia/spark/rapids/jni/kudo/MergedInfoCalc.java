@@ -1,0 +1,47 @@
+package com.nvidia.spark.rapids.jni.kudo;
+
+import java.util.List;
+
+/**
+ * Computes merged buffer geometry from a set of block headers
+ * (reference kudo/MergedInfoCalc.java): total rows and per-section
+ * byte totals — the allocation plan for a host merge.
+ */
+public final class MergedInfoCalc {
+  private final int totalRows;
+  private final long totalValidity;
+  private final long totalOffsets;
+  private final long totalData;
+
+  public MergedInfoCalc(List<KudoTableHeader> headers) {
+    int rows = 0;
+    long v = 0, o = 0, d = 0;
+    for (KudoTableHeader h : headers) {
+      rows += h.getNumRows();
+      v += h.getValidityBufferLen();
+      o += h.getOffsetBufferLen();
+      d += h.getTotalDataLen() - h.getValidityBufferLen()
+          - h.getOffsetBufferLen();
+    }
+    this.totalRows = rows;
+    this.totalValidity = v;
+    this.totalOffsets = o;
+    this.totalData = d;
+  }
+
+  public int getTotalRows() {
+    return totalRows;
+  }
+
+  public long getTotalValidityLen() {
+    return totalValidity;
+  }
+
+  public long getTotalOffsetsLen() {
+    return totalOffsets;
+  }
+
+  public long getTotalDataLen() {
+    return totalData;
+  }
+}

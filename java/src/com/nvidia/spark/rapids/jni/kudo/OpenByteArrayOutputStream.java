@@ -1,0 +1,24 @@
+package com.nvidia.spark.rapids.jni.kudo;
+
+import java.io.ByteArrayOutputStream;
+
+/**
+ * ByteArrayOutputStream exposing its internal buffer without the
+ * defensive copy (reference kudo/OpenByteArrayOutputStream.java) —
+ * shuffle blocks are written once and read once, so the copy is pure
+ * waste.
+ */
+public class OpenByteArrayOutputStream extends ByteArrayOutputStream {
+  public OpenByteArrayOutputStream() {
+    super();
+  }
+
+  public OpenByteArrayOutputStream(int size) {
+    super(size);
+  }
+
+  /** The live internal buffer; valid bytes are [0, size()). */
+  public byte[] getBuf() {
+    return buf;
+  }
+}

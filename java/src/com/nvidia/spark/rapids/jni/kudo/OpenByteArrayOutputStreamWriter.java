@@ -1,0 +1,22 @@
+package com.nvidia.spark.rapids.jni.kudo;
+
+/**
+ * DataWriter over an {@link OpenByteArrayOutputStream} (reference
+ * kudo/OpenByteArrayOutputStreamWriter.java): after writing, the
+ * caller reads the block straight out of {@code getBuf()} with no
+ * copy.
+ */
+public final class OpenByteArrayOutputStreamWriter
+    extends ByteArrayOutputStreamWriterBase {
+  private final OpenByteArrayOutputStream out;
+
+  public OpenByteArrayOutputStreamWriter(
+      OpenByteArrayOutputStream out) {
+    super(out);
+    this.out = out;
+  }
+
+  public OpenByteArrayOutputStream getStream() {
+    return out;
+  }
+}

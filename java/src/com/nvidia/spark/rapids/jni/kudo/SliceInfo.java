@@ -1,0 +1,29 @@
+package com.nvidia.spark.rapids.jni.kudo;
+
+/**
+ * A row slice of a table: [rowOffset, rowOffset + rowCount)
+ * (reference kudo/SliceInfo.java).  The validity slice it induces is
+ * computed by {@link SlicedValidityBufferInfo#calc}.
+ */
+public final class SliceInfo {
+  public final int rowOffset;
+  public final int rowCount;
+
+  public SliceInfo(int rowOffset, int rowCount) {
+    if (rowOffset < 0 || rowCount < 0) {
+      throw new IllegalArgumentException("negative slice");
+    }
+    this.rowOffset = rowOffset;
+    this.rowCount = rowCount;
+  }
+
+  public SlicedValidityBufferInfo getValidityBufferInfo() {
+    return SlicedValidityBufferInfo.calc(rowOffset, rowCount);
+  }
+
+  @Override
+  public String toString() {
+    return "SliceInfo{offset=" + rowOffset + ", rows=" + rowCount
+        + "}";
+  }
+}

@@ -1,0 +1,114 @@
+package com.nvidia.spark.rapids.jni.kudo;
+
+import com.nvidia.spark.rapids.jni.schema.HostColumnsVisitor;
+
+import java.io.ByteArrayOutputStream;
+
+/**
+ * Serializes the body sections of one row slice from host buffers
+ * (reference kudo/SlicedBufferSerializer.java): sloppy validity
+ * byte-slices, raw (un-rebased) int32 offsets, and payload slices —
+ * pure memcpy, all realignment deferred to merge.  Collects the
+ * three sections separately so the header calc can pad them.
+ */
+public final class SlicedBufferSerializer implements HostColumnsVisitor {
+  private final SliceInfo root;
+  private final KudoTableHeaderCalc headerCalc;
+  private final ByteArrayOutputStream validity =
+      new ByteArrayOutputStream();
+  private final ByteArrayOutputStream offsets =
+      new ByteArrayOutputStream();
+  private final ByteArrayOutputStream data =
+      new ByteArrayOutputStream();
+  // list children narrow the slice; this simple serializer handles
+  // the flat case where every column shares the root slice
+  private SliceInfo current;
+
+  public SlicedBufferSerializer(SliceInfo root,
+                                KudoTableHeaderCalc headerCalc) {
+    this.root = root;
+    this.headerCalc = headerCalc;
+    this.current = root;
+  }
+
+  private void writeValidity(int flatIndex, byte[] packed) {
+    boolean has = packed != null && current.rowCount > 0;
+    headerCalc.setHasValidity(flatIndex, has);
+    if (!has) {
+      return;
+    }
+    SlicedValidityBufferInfo v = current.getValidityBufferInfo();
+    for (int k = 0; k < v.bufferLength; k++) {
+      int idx = v.beginByte + k;
+      validity.write(idx < packed.length ? packed[idx] : 0);
+    }
+  }
+
+  @Override
+  public void visitStruct(int flatIndex, byte[] packedValidity,
+                          int numChildren) {
+    writeValidity(flatIndex, packedValidity);
+  }
+
+  @Override
+  public void visitList(int flatIndex, byte[] packedValidity,
+                        int[] rawOffsets) {
+    writeValidity(flatIndex, packedValidity);
+    writeOffsets(rawOffsets);
+    int start = rawOffsets[current.rowOffset];
+    int end = rawOffsets[current.rowOffset + current.rowCount];
+    current = new SliceInfo(start, end - start);
+  }
+
+  @Override
+  public void visitString(int flatIndex, byte[] packedValidity,
+                          int[] rawOffsets, byte[] chars) {
+    writeValidity(flatIndex, packedValidity);
+    if (current.rowCount > 0) {
+      writeOffsets(rawOffsets);
+      int start = rawOffsets[current.rowOffset];
+      int end = rawOffsets[current.rowOffset + current.rowCount];
+      data.write(chars, start, end - start);
+    }
+  }
+
+  @Override
+  public void visitFixed(int flatIndex, byte[] packedValidity,
+                         byte[] payload, int itemSize) {
+    writeValidity(flatIndex, packedValidity);
+    if (current.rowCount > 0) {
+      data.write(payload, current.rowOffset * itemSize,
+                 current.rowCount * itemSize);
+    }
+  }
+
+  private void writeOffsets(int[] rawOffsets) {
+    if (current.rowCount <= 0) {
+      return;
+    }
+    for (int i = current.rowOffset;
+         i <= current.rowOffset + current.rowCount; i++) {
+      int v = rawOffsets[i];           // little-endian on the wire
+      offsets.write(v & 0xFF);
+      offsets.write((v >>> 8) & 0xFF);
+      offsets.write((v >>> 16) & 0xFF);
+      offsets.write((v >>> 24) & 0xFF);
+    }
+  }
+
+  public byte[] validityBytes() {
+    return validity.toByteArray();
+  }
+
+  public byte[] offsetBytes() {
+    return offsets.toByteArray();
+  }
+
+  public byte[] dataBytes() {
+    return data.toByteArray();
+  }
+
+  public SliceInfo rootSlice() {
+    return root;
+  }
+}

@@ -1,0 +1,28 @@
+package com.nvidia.spark.rapids.jni.kudo;
+
+/**
+ * The sloppy byte-slice of a packed null mask a row slice touches
+ * (reference kudo/SlicedValidityBufferInfo.java): bytes
+ * [rowOffset/8, (rowOffset%8 + rowCount + 7)/8) with the leading bit
+ * offset resolved at merge time, so writes stay pure memcpy.
+ */
+public final class SlicedValidityBufferInfo {
+  public final int beginByte;
+  public final int bufferLength;
+  public final int beginBit;
+
+  private SlicedValidityBufferInfo(int beginByte, int bufferLength,
+                                   int beginBit) {
+    this.beginByte = beginByte;
+    this.bufferLength = bufferLength;
+    this.beginBit = beginBit;
+  }
+
+  public static SlicedValidityBufferInfo calc(int rowOffset,
+                                              int rowCount) {
+    int beginByte = rowOffset / 8;
+    int beginBit = rowOffset % 8;
+    int len = rowCount > 0 ? (beginBit + rowCount + 7) / 8 : 0;
+    return new SlicedValidityBufferInfo(beginByte, len, beginBit);
+  }
+}

@@ -1,0 +1,35 @@
+package com.nvidia.spark.rapids.jni.kudo;
+
+import com.nvidia.spark.rapids.jni.KudoSerializer;
+
+/**
+ * Builds a native kudo host table from runtime column handles
+ * (reference kudo/TableBuilder.java): ONE embedded crossing exports
+ * the buffers; every subsequent write on the result is pure C++.
+ */
+public final class TableBuilder implements AutoCloseable {
+  private long hostTable;
+
+  public TableBuilder(long[] columnHandles) {
+    this.hostTable = KudoSerializer.hostTableFromColumns(columnHandles);
+  }
+
+  public long getHostTable() {
+    return hostTable;
+  }
+
+  /** Transfers ownership to the caller. */
+  public long release() {
+    long h = hostTable;
+    hostTable = 0;
+    return h;
+  }
+
+  @Override
+  public void close() {
+    if (hostTable != 0) {
+      KudoSerializer.freeHostTable(hostTable);
+      hostTable = 0;
+    }
+  }
+}

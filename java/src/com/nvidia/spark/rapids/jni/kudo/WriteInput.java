@@ -1,0 +1,60 @@
+package com.nvidia.spark.rapids.jni.kudo;
+
+/**
+ * Bundled arguments for a kudo write (reference kudo/WriteInput.java):
+ * the table slice, target writer, and metric sink.
+ */
+public final class WriteInput {
+  public final long hostTable;
+  public final int rowOffset;
+  public final int numRows;
+  public final DataWriter writer;
+  public final WriteMetrics metrics;
+
+  private WriteInput(long hostTable, int rowOffset, int numRows,
+                     DataWriter writer, WriteMetrics metrics) {
+    this.hostTable = hostTable;
+    this.rowOffset = rowOffset;
+    this.numRows = numRows;
+    this.writer = writer;
+    this.metrics = metrics;
+  }
+
+  public static Builder builder() {
+    return new Builder();
+  }
+
+  public static final class Builder {
+    private long hostTable;
+    private int rowOffset;
+    private int numRows;
+    private DataWriter writer;
+    private WriteMetrics metrics = new WriteMetrics();
+
+    public Builder table(long hostTable) {
+      this.hostTable = hostTable;
+      return this;
+    }
+
+    public Builder slice(int rowOffset, int numRows) {
+      this.rowOffset = rowOffset;
+      this.numRows = numRows;
+      return this;
+    }
+
+    public Builder writer(DataWriter writer) {
+      this.writer = writer;
+      return this;
+    }
+
+    public Builder metrics(WriteMetrics metrics) {
+      this.metrics = metrics;
+      return this;
+    }
+
+    public WriteInput build() {
+      return new WriteInput(hostTable, rowOffset, numRows, writer,
+                            metrics);
+    }
+  }
+}

@@ -1,0 +1,26 @@
+package com.nvidia.spark.rapids.jni.kudo;
+
+/**
+ * Per-write metrics (reference kudo/WriteMetrics.java; TPU twin:
+ * shuffle/kudo.py WriteMetrics).
+ */
+public final class WriteMetrics {
+  private long writtenBytes = 0;
+  private long copyTimeNs = 0;
+
+  public void addWrittenBytes(long n) {
+    writtenBytes += n;
+  }
+
+  public void addCopyTimeNs(long n) {
+    copyTimeNs += n;
+  }
+
+  public long getWrittenBytes() {
+    return writtenBytes;
+  }
+
+  public long getCopyTimeNs() {
+    return copyTimeNs;
+  }
+}

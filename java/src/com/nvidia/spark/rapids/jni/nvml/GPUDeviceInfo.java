@@ -1,0 +1,17 @@
+package com.nvidia.spark.rapids.jni.nvml;
+
+/**
+ * Identity of one accelerator device (reference
+ * nvml/GPUDeviceInfo.java).
+ */
+public final class GPUDeviceInfo {
+  public final int index;
+  public final String name;
+  public final String uuid;
+
+  public GPUDeviceInfo(int index, String name, String uuid) {
+    this.index = index;
+    this.name = name;
+    this.uuid = uuid;
+  }
+}

@@ -1,0 +1,19 @@
+package com.nvidia.spark.rapids.jni.nvml;
+
+/**
+ * Static hardware description (reference nvml/GPUHardwareInfo.java).
+ */
+public final class GPUHardwareInfo {
+  public final String name;
+  public final String platform;
+  public final int deviceIndex;
+  public final GPUPCIeInfo pcie;
+
+  public GPUHardwareInfo(String name, String platform, int deviceIndex,
+                         GPUPCIeInfo pcie) {
+    this.name = name;
+    this.platform = platform;
+    this.deviceIndex = deviceIndex;
+    this.pcie = pcie;
+  }
+}

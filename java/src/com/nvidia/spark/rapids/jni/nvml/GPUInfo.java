@@ -1,0 +1,28 @@
+package com.nvidia.spark.rapids.jni.nvml;
+
+/**
+ * Full per-device snapshot (reference nvml/GPUInfo.java): composite
+ * of the individual info records, produced by {@link NVML#getGPUInfo}.
+ */
+public final class GPUInfo {
+  public final GPUDeviceInfo device;
+  public final GPUMemoryInfo memory;
+  public final GPUUtilizationInfo utilization;
+  public final GPUTemperatureInfo temperature;
+  public final GPUPowerInfo power;
+  public final GPUClockInfo clocks;
+  public final GPUECCInfo ecc;
+
+  public GPUInfo(GPUDeviceInfo device, GPUMemoryInfo memory,
+                 GPUUtilizationInfo utilization,
+                 GPUTemperatureInfo temperature, GPUPowerInfo power,
+                 GPUClockInfo clocks, GPUECCInfo ecc) {
+    this.device = device;
+    this.memory = memory;
+    this.utilization = utilization;
+    this.temperature = temperature;
+    this.power = power;
+    this.clocks = clocks;
+    this.ecc = ecc;
+  }
+}

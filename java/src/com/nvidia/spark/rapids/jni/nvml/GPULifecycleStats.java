@@ -1,0 +1,41 @@
+package com.nvidia.spark.rapids.jni.nvml;
+
+/**
+ * Aggregated device stats over a monitoring window (reference
+ * nvml/GPULifecycleStats.java): min/max/sum/count per metric, fed by
+ * {@link NVMLMonitor} samples.
+ */
+public final class GPULifecycleStats {
+  private long samples = 0;
+  private long maxUsedBytes = 0;
+  private double sumUtilization = 0;
+  private int maxUtilization = 0;
+
+  public synchronized void addSample(GPUInfo info) {
+    samples++;
+    if (info.memory != null) {
+      maxUsedBytes = Math.max(maxUsedBytes, info.memory.usedBytes);
+    }
+    if (info.utilization != null) {
+      sumUtilization += info.utilization.utilizationPercent;
+      maxUtilization = Math.max(maxUtilization,
+                                info.utilization.utilizationPercent);
+    }
+  }
+
+  public synchronized long getSampleCount() {
+    return samples;
+  }
+
+  public synchronized long getMaxUsedBytes() {
+    return maxUsedBytes;
+  }
+
+  public synchronized double getAvgUtilization() {
+    return samples == 0 ? 0 : sumUtilization / samples;
+  }
+
+  public synchronized int getMaxUtilization() {
+    return maxUtilization;
+  }
+}

@@ -1,0 +1,41 @@
+package com.nvidia.spark.rapids.jni.nvml;
+
+/**
+ * Static telemetry entry points (reference nvml/NVML.java over the
+ * separate libnvmljni.so; TPU analog: one JNI crossing into
+ * utils/telemetry.py, which reads accelerator metrics where the
+ * platform exposes them and host metrics otherwise).
+ */
+public final class NVML {
+  private NVML() {}
+
+  public static native int getDeviceCount();
+
+  /**
+   * Packed snapshot for one device:
+   * [memTotal, memUsed, memFree, utilPercent, powerWatts, clockMhz,
+   *  tempC] — negative entries mean NOT_SUPPORTED for that metric.
+   */
+  static native long[] getSnapshotPacked(int deviceIndex);
+
+  static native String getDeviceName(int deviceIndex);
+
+  public static GPUInfo getGPUInfo(int index) {
+    long[] p = getSnapshotPacked(index);
+    String name = getDeviceName(index);
+    GPUDeviceInfo dev = new GPUDeviceInfo(index, name,
+                                          name + "-" + index);
+    GPUMemoryInfo mem = p[0] < 0 ? null
+        : new GPUMemoryInfo(p[0], p[1], p[2]);
+    GPUUtilizationInfo util = p[3] < 0 ? null
+        : new GPUUtilizationInfo((int) p[3], (int) p[3]);
+    GPUPowerInfo power = p[4] < 0 ? null
+        : new GPUPowerInfo((int) p[4], (int) p[4]);
+    GPUClockInfo clocks = p[5] < 0 ? null
+        : new GPUClockInfo((int) p[5], (int) p[5]);
+    GPUTemperatureInfo temp = p[6] < 0 ? null
+        : new GPUTemperatureInfo((int) p[6], (int) p[6]);
+    return new GPUInfo(dev, mem, util, temp, power, clocks,
+                       new GPUECCInfo(0, 0));
+  }
+}

@@ -1,0 +1,13 @@
+package com.nvidia.spark.rapids.jni.nvml;
+
+/**
+ * Telemetry failure (reference nvml/NVMLException.java).
+ */
+public class NVMLException extends RuntimeException {
+  public final NVMLReturnCode code;
+
+  public NVMLException(String message, NVMLReturnCode code) {
+    super(message);
+    this.code = code;
+  }
+}

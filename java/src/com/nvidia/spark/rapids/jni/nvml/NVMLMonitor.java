@@ -1,0 +1,59 @@
+package com.nvidia.spark.rapids.jni.nvml;
+
+/**
+ * Background device-metrics monitor (reference
+ * nvml/NVMLMonitor.java:49): samples {@link NVML#getGPUInfo} on a
+ * fixed period into {@link GPULifecycleStats}.
+ */
+public final class NVMLMonitor implements AutoCloseable {
+  private final int deviceIndex;
+  private final long periodMillis;
+  private final GPULifecycleStats stats = new GPULifecycleStats();
+  private volatile boolean running = false;
+  private Thread thread;
+
+  public NVMLMonitor(int deviceIndex, long periodMillis) {
+    this.deviceIndex = deviceIndex;
+    this.periodMillis = periodMillis;
+  }
+
+  public synchronized void start() {
+    if (running) {
+      return;
+    }
+    running = true;
+    thread = new Thread(() -> {
+      while (running) {
+        try {
+          stats.addSample(NVML.getGPUInfo(deviceIndex));
+        } catch (RuntimeException e) {
+          // metric not supported on this platform: keep sampling
+        }
+        try {
+          Thread.sleep(periodMillis);
+        } catch (InterruptedException e) {
+          return;
+        }
+      }
+    }, "tpu-telemetry-monitor");
+    thread.setDaemon(true);
+    thread.start();
+  }
+
+  public synchronized void stop() {
+    running = false;
+    if (thread != null) {
+      thread.interrupt();
+      thread = null;
+    }
+  }
+
+  public GPULifecycleStats getStats() {
+    return stats;
+  }
+
+  @Override
+  public void close() {
+    stop();
+  }
+}

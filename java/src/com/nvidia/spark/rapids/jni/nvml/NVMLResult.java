@@ -1,0 +1,19 @@
+package com.nvidia.spark.rapids.jni.nvml;
+
+/**
+ * A telemetry call result: code + value (reference
+ * nvml/NVMLResult.java).
+ */
+public final class NVMLResult<T> {
+  public final NVMLReturnCode code;
+  public final T value;
+
+  public NVMLResult(NVMLReturnCode code, T value) {
+    this.code = code;
+    this.value = value;
+  }
+
+  public boolean isSuccess() {
+    return code == NVMLReturnCode.SUCCESS;
+  }
+}

@@ -1,0 +1,19 @@
+package com.nvidia.spark.rapids.jni.nvml;
+
+/**
+ * Result codes for telemetry calls (reference
+ * nvml/NVMLReturnCode.java — the NVML enum mapped onto the TPU
+ * telemetry shim's failure modes).
+ */
+public enum NVMLReturnCode {
+  SUCCESS,
+  NOT_SUPPORTED,
+  NO_DEVICE,
+  UNINITIALIZED,
+  UNKNOWN;
+
+  public static NVMLReturnCode fromInt(int code) {
+    NVMLReturnCode[] all = values();
+    return code >= 0 && code < all.length ? all[code] : UNKNOWN;
+  }
+}

@@ -1,0 +1,19 @@
+package com.nvidia.spark.rapids.jni.schema;
+
+/**
+ * Visitor over HOST column buffers in flat schema order (reference
+ * schema/HostColumnsVisitor.java): each callback receives the
+ * buffers the kudo writer slices.  Offsets are raw int32 values;
+ * validity is the packed LSB-first null mask.
+ */
+public interface HostColumnsVisitor {
+  void visitStruct(int flatIndex, byte[] validity, int numChildren);
+
+  void visitList(int flatIndex, byte[] validity, int[] offsets);
+
+  void visitString(int flatIndex, byte[] validity, int[] offsets,
+                   byte[] chars);
+
+  void visitFixed(int flatIndex, byte[] validity, byte[] data,
+                  int itemSize);
+}

@@ -1,0 +1,32 @@
+package com.nvidia.spark.rapids.jni.schema;
+
+import java.util.List;
+
+/**
+ * Depth-first schema walk where a struct/list column's own entry
+ * precedes its children (reference schema/SchemaVisitor.java:81; TPU
+ * twin: spark_rapids_tpu/shuffle/schema.py).  The walk drives kudo
+ * header calculation and table building.
+ *
+ * @param <T> per-column intermediate result
+ * @param <R> final result
+ */
+public interface SchemaVisitor<T, R> {
+  /** Called for a STRUCT column before its children. */
+  T preVisitStruct(int flatIndex, int numChildren);
+
+  /** Called for a STRUCT column after its children. */
+  T visitStruct(int flatIndex, List<T> children);
+
+  /** Called for a LIST column before its child. */
+  T preVisitList(int flatIndex);
+
+  /** Called for a LIST column after its child. */
+  T visitList(int flatIndex, T child);
+
+  /** Called for a leaf (fixed-width or string) column. */
+  T visit(int flatIndex, String typeId);
+
+  /** Called once with the top-level results. */
+  R visitTopSchema(List<T> roots);
+}

@@ -1,0 +1,14 @@
+package com.nvidia.spark.rapids.jni.schema;
+
+/**
+ * Flat pre-order schema walk without child aggregation (reference
+ * schema/SimpleSchemaVisitor.java) — for visitors that only need the
+ * column sequence, e.g. validity-bitset calculators.
+ */
+public interface SimpleSchemaVisitor {
+  void visitStruct(int flatIndex, int numChildren);
+
+  void visitList(int flatIndex);
+
+  void visit(int flatIndex, String typeId);
+}

@@ -1,0 +1,60 @@
+package com.nvidia.spark.rapids.jni.schema;
+
+import java.util.ArrayList;
+import java.util.List;
+
+/**
+ * Drivers for the schema visitors (reference schema/Visitors.java).
+ * A schema is described by parallel flat arrays in depth-first
+ * pre-order: typeIds ("struct"/"list"/leaf ids) and child counts —
+ * the same encoding the native kudo engine takes.
+ */
+public final class Visitors {
+  private Visitors() {}
+
+  public static <T, R> R visitSchema(String[] typeIds,
+                                     int[] numChildren,
+                                     SchemaVisitor<T, R> visitor) {
+    int[] pos = new int[]{0};
+    List<T> roots = new ArrayList<>();
+    while (pos[0] < typeIds.length) {
+      roots.add(visitOne(typeIds, numChildren, pos, visitor));
+    }
+    return visitor.visitTopSchema(roots);
+  }
+
+  private static <T, R> T visitOne(String[] typeIds, int[] numChildren,
+                                   int[] pos,
+                                   SchemaVisitor<T, R> visitor) {
+    int i = pos[0]++;
+    if ("struct".equals(typeIds[i])) {
+      int n = numChildren[i];
+      visitor.preVisitStruct(i, n);
+      List<T> children = new ArrayList<>(n);
+      for (int c = 0; c < n; c++) {
+        children.add(visitOne(typeIds, numChildren, pos, visitor));
+      }
+      return visitor.visitStruct(i, children);
+    }
+    if ("list".equals(typeIds[i])) {
+      visitor.preVisitList(i);
+      T child = visitOne(typeIds, numChildren, pos, visitor);
+      return visitor.visitList(i, child);
+    }
+    return visitor.visit(i, typeIds[i]);
+  }
+
+  public static void visitSimpleSchema(String[] typeIds,
+                                       int[] numChildren,
+                                       SimpleSchemaVisitor visitor) {
+    for (int i = 0; i < typeIds.length; i++) {
+      if ("struct".equals(typeIds[i])) {
+        visitor.visitStruct(i, numChildren[i]);
+      } else if ("list".equals(typeIds[i])) {
+        visitor.visitList(i);
+      } else {
+        visitor.visit(i, typeIds[i]);
+      }
+    }
+  }
+}

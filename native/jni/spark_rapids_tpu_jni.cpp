@@ -411,6 +411,29 @@ jstring as_jstring(JNIEnv* env, PyObject* r) {
   return js;
 }
 
+jobjectArray as_jstring_array(JNIEnv* env, PyObject* r) {
+  if (r == nullptr) return nullptr;
+  if (!PyList_Check(r)) {
+    Py_DECREF(r);
+    throw_java(env, "entry function did not return a list");
+    return nullptr;
+  }
+  jsize n = static_cast<jsize>(PyList_GET_SIZE(r));
+  jclass scls = env->FindClass("java/lang/String");
+  jobjectArray arr = env->NewObjectArray(n, scls, nullptr);
+  if (arr != nullptr) {
+    for (jsize i = 0; i < n; ++i) {
+      PyObject* item = PyList_GET_ITEM(r, i);
+      Py_INCREF(item);
+      jstring js = as_jstring(env, item);
+      env->SetObjectArrayElement(arr, i, js);
+      env->DeleteLocalRef(js);
+    }
+  }
+  Py_DECREF(r);
+  return arr;
+}
+
 }  // namespace
 
 #define JNI_FN(cls, name) \
@@ -1635,6 +1658,138 @@ jint JNI_FN(TestSupport, checkColumnsEqual)(JNIEnv* env, jclass,
   Gil gil;
   PyObject* args = Py_BuildValue("(LL)", (long long)a, (long long)b);
   return as_jint(env, call_entry(env, "check_columns_equal", args));
+}
+
+jlong JNI_FN(TestSupport, makeListOfInts)(JNIEnv* env, jclass,
+                                          jintArray offsets,
+                                          jlongArray values) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(NN)", ints_to_pylist(env, offsets),
+                                 longs_to_pylist(env, values));
+  return as_jlong(env, call_entry(env, "make_list_of_ints", args));
+}
+
+// ------------------------------------------------ list/map utilities
+
+static jlong list_slice_impl(JNIEnv* env, jlong cv, jlong start,
+                             jlong length, int start_is_col,
+                             int length_is_col, jboolean check) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(LLLiii)", (long long)cv, (long long)start, (long long)length,
+      start_is_col, length_is_col, (int)check);
+  return as_jlong(env, call_entry(env, "list_slice", args));
+}
+
+jlong JNI_FN(GpuListSliceUtils, listSlice)(JNIEnv* env, jclass,
+                                           jlong cv, jint start,
+                                           jint length,
+                                           jboolean check) {
+  return list_slice_impl(env, cv, start, length, 0, 0, check);
+}
+
+jlong JNI_FN(GpuListSliceUtils, listSliceSC)(JNIEnv* env, jclass,
+                                             jlong cv, jint start,
+                                             jlong length_cv,
+                                             jboolean check) {
+  return list_slice_impl(env, cv, start, length_cv, 0, 1, check);
+}
+
+jlong JNI_FN(GpuListSliceUtils, listSliceCS)(JNIEnv* env, jclass,
+                                             jlong cv, jlong start_cv,
+                                             jint length,
+                                             jboolean check) {
+  return list_slice_impl(env, cv, start_cv, length, 1, 0, check);
+}
+
+jlong JNI_FN(GpuListSliceUtils, listSliceCC)(JNIEnv* env, jclass,
+                                             jlong cv, jlong start_cv,
+                                             jlong length_cv,
+                                             jboolean check) {
+  return list_slice_impl(env, cv, start_cv, length_cv, 1, 1, check);
+}
+
+jboolean JNI_FN(MapUtils, isValidMap)(JNIEnv* env, jclass, jlong cv,
+                                      jboolean throw_on_null) {
+  if (!ensure_runtime(env)) return JNI_FALSE;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Li)", (long long)cv,
+                                 (int)throw_on_null);
+  return as_jint(env, call_entry(env, "map_is_valid", args))
+      ? JNI_TRUE : JNI_FALSE;
+}
+
+jlong JNI_FN(MapUtils, mapFromEntries)(JNIEnv* env, jclass, jlong cv,
+                                       jboolean throw_on_null) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(Li)", (long long)cv,
+                                 (int)throw_on_null);
+  return as_jlong(env, call_entry(env, "map_from_entries_jni", args));
+}
+
+jlong JNI_FN(GpuMapZipWithUtils, mapZip)(JNIEnv* env, jclass,
+                                         jlong m1, jlong m2) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(LL)", (long long)m1, (long long)m2);
+  return as_jlong(env, call_entry(env, "map_zip_jni", args));
+}
+
+// ------------------------------------------- ORC timezone extraction
+
+jlongArray JNI_FN(OrcDstRuleExtractor, timezoneInfoPacked)(
+    JNIEnv* env, jclass, jstring zone_id) {
+  if (!ensure_runtime(env)) return nullptr;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(N)", jstring_to_py(env, zone_id));
+  return as_jlong_array(env,
+                        call_entry(env, "orc_timezone_packed", args));
+}
+
+jobjectArray JNI_FN(OrcDstRuleExtractor, timezoneIds)(JNIEnv* env,
+                                                      jclass) {
+  if (!ensure_runtime(env)) return nullptr;
+  Gil gil;
+  return as_jstring_array(
+      env, call_entry(env, "all_timezone_ids", PyTuple_New(0)));
+}
+
+// --------------------------------------------- device telemetry (NVML)
+
+// nvml subpackage: symbol names spelled out (JNI_FN assumes the flat
+// package)
+
+JNIEXPORT jint JNICALL
+Java_com_nvidia_spark_rapids_jni_nvml_NVML_getDeviceCount(JNIEnv* env,
+                                                          jclass) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  return as_jint(env, call_entry(env, "telemetry_device_count",
+                                 PyTuple_New(0)));
+}
+
+JNIEXPORT jlongArray JNICALL
+Java_com_nvidia_spark_rapids_jni_nvml_NVML_getSnapshotPacked(
+    JNIEnv* env, jclass, jint index) {
+  if (!ensure_runtime(env)) return nullptr;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(i)", (int)index);
+  return as_jlong_array(
+      env, call_entry(env, "telemetry_snapshot_packed", args));
+}
+
+JNIEXPORT jstring JNICALL
+Java_com_nvidia_spark_rapids_jni_nvml_NVML_getDeviceName(JNIEnv* env,
+                                                         jclass,
+                                                         jint index) {
+  if (!ensure_runtime(env)) return nullptr;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(i)", (int)index);
+  return as_jstring(env, call_entry(env, "telemetry_device_name",
+                                    args));
 }
 
 }  // extern "C"

@@ -209,6 +209,28 @@ NATIVE_CLASSES = {
         ("toDeviceColumns", "(J)[J"),
         ("free", "(J)V"),
     ],
+    "GpuListSliceUtils": [
+        ("listSlice", "(JIIZ)J"),
+        ("listSliceSC", "(JIJZ)J"),
+        ("listSliceCS", "(JJIZ)J"),
+        ("listSliceCC", "(JJJZ)J"),
+    ],
+    "MapUtils": [
+        ("isValidMap", "(JZ)Z"),
+        ("mapFromEntries", "(JZ)J"),
+    ],
+    "GpuMapZipWithUtils": [
+        ("mapZip", "(JJ)J"),
+    ],
+    "OrcDstRuleExtractor": [
+        ("timezoneInfoPacked", "(Ljava/lang/String;)[J"),
+        ("timezoneIds", "()[Ljava/lang/String;"),
+    ],
+    "nvml/NVML": [
+        ("getDeviceCount", "()I"),
+        ("getSnapshotPacked", "(I)[J"),
+        ("getDeviceName", "(I)Ljava/lang/String;"),
+    ],
     "JoinPrimitives": [
         ("sortMergeInnerJoin", "([J[JZ)[J"),
     ],
@@ -252,6 +274,7 @@ NATIVE_CLASSES = {
         ("checkIntColumn", "(J[I)I"),
         ("checkStringColumn", "(J[Ljava/lang/String;)I"),
         ("checkColumnsEqual", "(JJ)I"),
+        ("makeListOfInts", "([I[J)J"),
     ],
 }
 
@@ -483,7 +506,7 @@ def build_smoke_test(outdir: str, xx_gold):
     """JniSmokeTest.main: straight-line bytecode (assertions throw from
     native TestSupport.assertTrue, so no branches / StackMapTable)."""
     cf = ClassFile(f"{PKG}/JniSmokeTest")
-    c = Code(cf.cp, max_locals=72)
+    c = Code(cf.cp, max_locals=80)
     J = f"{PKG}/"
 
     def assert_check(msg):
@@ -888,6 +911,46 @@ def build_smoke_test(outdir: str, xx_gold):
     c.invokestatic(J + "RmmSpark", "taskDone", "(J)V")
     c.invokestatic(J + "RmmSpark", "clearEventHandler", "()V")
     c.println("RmmSpark register/taskDone ok")
+
+    # --- list slice + ORC tz + device telemetry surface (r5) --------
+    LSTC, SLICED = 72, 74     # long slots 72-73, 74-75 (past all
+    #                            sections still live at hygiene time)
+    c.int_array([0, 3, 5])
+    c.long_array_consts([1, 2, 3, 4, 5])
+    c.invokestatic(J + "TestSupport", "makeListOfInts", "([I[J)J")
+    c.lstore(LSTC)
+    c.lload(LSTC)
+    c.iconst(1)                    # start (1-based)
+    c.iconst(2)                    # length
+    c.iconst(1)                    # checkStartLength = true
+    c.invokestatic(J + "GpuListSliceUtils", "listSlice", "(JIIZ)J")
+    c.lstore(SLICED)
+    c.lload(LSTC)
+    c.invokestatic(J + "TpuColumns", "free", "(J)V")
+    c.int_array([0, 2, 4])         # expected [[1,2],[4,5]]
+    c.long_array_consts([1, 2, 4, 5])
+    c.invokestatic(J + "TestSupport", "makeListOfInts", "([I[J)J")
+    c.lstore(LSTC)
+    c.lload(SLICED)
+    c.lload(LSTC)
+    c.invokestatic(J + "TestSupport", "checkColumnsEqual", "(JJ)I")
+    assert_check("GpuListSliceUtils.listSlice")
+    c.lload(LSTC)
+    c.invokestatic(J + "TpuColumns", "free", "(J)V")
+    c.lload(SLICED)
+    c.invokestatic(J + "TpuColumns", "free", "(J)V")
+    # ORC timezone rule extraction: UTC packs [raw=0, dst=0, n=0]
+    c.ldc_string("UTC")
+    c.invokestatic(J + "OrcDstRuleExtractor", "timezoneInfoPacked",
+                   "(Ljava/lang/String;)[J")
+    c.arraylength()
+    c.iconst(3)
+    c.idiv()                       # len/3: 0 for len<3, >=1 otherwise
+    assert_check("OrcDstRuleExtractor.timezoneInfoPacked")
+    # device telemetry: at least one device visible
+    c.invokestatic(J + "nvml/NVML", "getDeviceCount", "()I")
+    assert_check("NVML.getDeviceCount >= 1")
+    c.println("list/tz/telemetry surface ok")
 
     # --- handle hygiene ----------------------------------------------
     for h in [H_STR, 4, H_LONGS, 8, ROWS, BACK0, H_NUM, H_CAST,

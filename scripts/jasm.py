@@ -280,6 +280,10 @@ class Code:
         self._pop(2)
         self.b.append(0x65)
 
+    def idiv(self):
+        self._pop(1)
+        self.b.append(0x6C)
+
     def dup(self):
         self._push()
         self.b.append(0x59)

@@ -817,9 +817,135 @@ def rmm_dealloc(nbytes: int) -> None:
     rmm_spark.get_adaptor().deallocate(nbytes)
 
 
+# ------------------------------------------- list/map utils over JNI
+
+
+def list_slice(handle: int, start, length, start_is_col: bool,
+               length_is_col: bool, check: bool) -> int:
+    """GpuListSliceUtils.listSlice (4 scalar/column overloads folded
+    into one entry: *_is_col picks handle vs scalar operands)."""
+    from spark_rapids_tpu.ops.strings_misc import list_slice as LS
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    col = REGISTRY.get(handle)
+    s = REGISTRY.get(int(start)) if start_is_col else int(start)
+    ln = REGISTRY.get(int(length)) if length_is_col else (
+        None if length is None else int(length))
+    return REGISTRY.register(LS(col, s, ln, bool(check)))
+
+
+def map_is_valid(handle: int, throw_on_null_key: bool) -> bool:
+    from spark_rapids_tpu.ops.map_utils import is_valid_map
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return bool(is_valid_map(REGISTRY.get(handle),
+                             bool(throw_on_null_key)))
+
+
+def map_from_entries_jni(handle: int, throw_on_null_key: bool) -> int:
+    from spark_rapids_tpu.ops.map_utils import map_from_entries
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(map_from_entries(
+        REGISTRY.get(handle), bool(throw_on_null_key)))
+
+
+def map_zip_jni(h1: int, h2: int) -> int:
+    from spark_rapids_tpu.ops.map_utils import map_zip_full
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    return REGISTRY.register(map_zip_full(REGISTRY.get(h1),
+                                          REGISTRY.get(h2)))
+
+
+# --------------------------------------- ORC timezone info over JNI
+
+
+def orc_timezone_packed(zone_id: str) -> List[int]:
+    """OrcDstRuleExtractor packing: [rawOffsetMillis, hasDst, n,
+    transitions_ms.., offsets_ms..]."""
+    from spark_rapids_tpu.ops.orc_timezones import (
+        get_orc_timezone_info, has_daylight_saving_time)
+    info = get_orc_timezone_info(zone_id)
+    trans = ([] if info.transitions is None
+             else [int(x) for x in info.transitions])
+    offs = ([] if info.offsets is None
+            else [int(x) for x in info.offsets])
+    has_dst = 1 if has_daylight_saving_time(zone_id) else 0
+    return ([int(info.raw_offset), has_dst, len(trans)]
+            + trans + offs)
+
+
+def all_timezone_ids() -> List[str]:
+    import os
+
+    from spark_rapids_tpu.utils.tzdb import TZDIR
+    base = TZDIR   # honors $TZDIR like every other zone lookup
+    out = []
+    for root, _dirs, names in os.walk(base):
+        for n in names:
+            p = os.path.relpath(os.path.join(root, n), base)
+            if "/" in p or p[0].isupper():
+                if not p.endswith(".tab") and "posix" not in p \
+                        and "right" not in p:
+                    out.append(p)
+    return sorted(set(out))
+
+
+# ----------------------------------------- device telemetry over JNI
+
+
+def telemetry_device_count() -> int:
+    from spark_rapids_tpu.utils import telemetry
+    return telemetry.get_device_count()
+
+
+def telemetry_snapshot_packed(index: int) -> List[int]:
+    """NVML.getSnapshotPacked: [memTotal, memUsed, memFree, util%,
+    powerW, clockMhz, tempC]; -1 = metric not supported here."""
+    from spark_rapids_tpu.utils import telemetry
+    out = [-1] * 7
+    try:
+        mem = telemetry.get_memory_info(index)
+        out[0] = int(mem.get("total", -1))
+        out[1] = int(mem.get("used", -1))
+        out[2] = int(mem.get("free", -1))
+    except Exception:
+        pass
+    try:
+        # utilization is a [0,1] fraction; the packed slot is percent
+        out[3] = int(telemetry.get_device_utilization(index) * 100)
+    except Exception:
+        pass
+    for slot, fn in ((4, telemetry.get_power_usage_watts),
+                     (5, telemetry.get_clock_mhz)):
+        try:
+            out[slot] = int(fn(index))
+        except Exception:
+            pass
+    return out
+
+
+def telemetry_device_name(index: int) -> str:
+    from spark_rapids_tpu.utils import telemetry
+    info = telemetry.get_device_info(index)
+    return f"{info.platform}:{info.kind}"
+
+
 # ------------------------------------------------------- test support
 # (comparison happens Python-side so the emitted JVM test bytecode can
 # stay straight-line: a native assert throws on failure)
+
+
+def make_list_of_ints(offsets: Sequence[int],
+                      values: Sequence[int]) -> int:
+    """Test helper: LIST<INT64> column from offsets + flat values
+    (drives the GpuListSliceUtils smoke — the JVM has no list
+    builder of its own)."""
+    import numpy as np
+
+    from spark_rapids_tpu.columns import dtypes
+    from spark_rapids_tpu.columns.column import Column
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    child = Column.from_pylist(list(values), dtypes.INT64)
+    return REGISTRY.register(Column.make_list(
+        np.asarray(list(offsets), np.int32), child))
 
 
 def check_int_column(handle: int, expected: Sequence[int]) -> int:
