@@ -26,6 +26,23 @@ public final class TpuColumns {
   public static native long fromStrings(String[] values);
 
   /**
+   * Bulk STRING column ingest: one UTF-8 chars buffer + one int32
+   * offsets array (rows = offsets.length - 1) + optional LSB-first
+   * packed validity (null = all valid).  The whole payload crosses
+   * JNI as primitive arrays — the multi-MB path; {@link #fromStrings}
+   * boxes per element and is for small columns.
+   */
+  public static native long fromStringsBulk(byte[] utf8Chars,
+                                            int[] offsets,
+                                            byte[] packedValidity);
+
+  /** Bulk readback: the whole chars buffer as one byte[]. */
+  public static native byte[] getStringChars(long handle);
+
+  /** Bulk readback: int32 offsets as little-endian bytes. */
+  public static native byte[] getStringOffsets(long handle);
+
+  /**
    * Decimal column from unscaled values (cudf-java
    * ColumnVector.decimalFromLongs shape); typeId: "decimal32",
    * "decimal64", or "decimal128".

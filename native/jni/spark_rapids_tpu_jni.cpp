@@ -499,6 +499,53 @@ jlong JNI_FN(TpuColumns, fromStrings)(JNIEnv* env, jclass,
   return as_jlong(env, call_entry(env, "from_strings", args));
 }
 
+// Bulk string-column path: whole primitive arrays cross the boundary
+// (chars byte[], LE int32 offsets int[], optional packed validity) —
+// no per-element boxing (reference HashJni.cpp:31-46 discipline).
+
+jlong JNI_FN(TpuColumns, fromStringsBulk)(JNIEnv* env, jclass,
+                                          jbyteArray chars,
+                                          jintArray offsets,
+                                          jbyteArray validity) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* pchars = bytes_to_py(env, chars);
+  // int[] -> raw LE bytes in one copy (x86/ARM LE hosts)
+  jsize n_offs = env->GetArrayLength(offsets);
+  jint* oelems = env->GetIntArrayElements(offsets, nullptr);
+  PyObject* poffs = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(oelems),
+      static_cast<Py_ssize_t>(n_offs) * 4);
+  env->ReleaseIntArrayElements(offsets, oelems, JNI_ABORT);
+  PyObject* pvalid;
+  if (validity == nullptr) {
+    Py_INCREF(Py_None);
+    pvalid = Py_None;
+  } else {
+    pvalid = bytes_to_py(env, validity);
+  }
+  PyObject* args = Py_BuildValue("(NNN)", pchars, poffs, pvalid);
+  return as_jlong(env, call_entry(env, "from_strings_bulk", args));
+}
+
+jbyteArray JNI_FN(TpuColumns, getStringChars)(JNIEnv* env, jclass,
+                                              jlong handle) {
+  if (!ensure_runtime(env)) return nullptr;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(L)", (long long)handle);
+  return as_jbyte_array(env,
+                        call_entry(env, "string_column_chars", args));
+}
+
+jbyteArray JNI_FN(TpuColumns, getStringOffsets)(JNIEnv* env, jclass,
+                                                jlong handle) {
+  if (!ensure_runtime(env)) return nullptr;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(L)", (long long)handle);
+  return as_jbyte_array(
+      env, call_entry(env, "string_column_offsets", args));
+}
+
 void JNI_FN(TpuColumns, free)(JNIEnv* env, jclass, jlong handle) {
   if (!ensure_runtime(env)) return;
   Gil gil;

@@ -34,7 +34,7 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 from jasm import (ACC_FINAL, ACC_PRIVATE, ACC_PUBLIC, ClassFile, Code,
-                  Label, T_LONG)  # noqa: E402
+                  Label, T_INT, T_LONG)  # noqa: E402
 
 PKG = "com/nvidia/spark/rapids/jni"
 
@@ -78,6 +78,9 @@ NATIVE_CLASSES = {
         ("fromInts", "([I)J"),
         ("fromDoubles", "([D)J"),
         ("fromStrings", "([Ljava/lang/String;)J"),
+        ("fromStringsBulk", "([B[I[B)J"),
+        ("getStringChars", "(J)[B"),
+        ("getStringOffsets", "(J)[B"),
         ("fromDecimals", "([JILjava/lang/String;)J"),
         ("getChild", "(JI)J"),
         ("free", "(J)V"),
@@ -298,6 +301,50 @@ def _computed_goldens():
     return xxhash64([c], 42).to_pylist()
 
 
+
+def _emit_bulk_string_arrays(c, ch_slot, off_slot, i_slot, fill_byte,
+                             nbytes=10_000_000, rows=500_000,
+                             row_width=20):
+    """Emit the 10MB chars fill + int32 offsets (i*row_width) loops
+    shared by the smoke test and KudoBench bulk sections."""
+    loop, done = Label(), Label()
+    c.iconst(nbytes)
+    c.newarray(8)
+    c.astore(ch_slot)
+    c.iconst(0)
+    c.istore(i_slot)
+    c.place(loop)
+    c.iload(i_slot)
+    c.iconst(nbytes)
+    c.if_icmp("ge", done)
+    c.aload(ch_slot)
+    c.iload(i_slot)
+    c.iconst(fill_byte)
+    c.bastore()
+    c.iinc(i_slot, 1)
+    c.goto(loop)
+    c.place(done)
+    oloop, odone = Label(), Label()
+    c.iconst(rows + 1)
+    c.newarray(T_INT)
+    c.astore(off_slot)
+    c.iconst(0)
+    c.istore(i_slot)
+    c.place(oloop)
+    c.iload(i_slot)
+    c.iconst(rows + 1)
+    c.if_icmp("ge", odone)
+    c.aload(off_slot)
+    c.iload(i_slot)
+    c.iload(i_slot)
+    c.iconst(row_width)
+    c.imul()
+    c.iastore()
+    c.iinc(i_slot, 1)
+    c.goto(oloop)
+    c.place(odone)
+
+
 def build_natives(outdir: str):
     for cls, methods in NATIVE_CLASSES.items():
         cf = ClassFile(f"{PKG}/{cls}")
@@ -503,9 +550,11 @@ def build_oom_smoke_test(outdir: str):
 
 
 def build_smoke_test(outdir: str, xx_gold):
-    """JniSmokeTest.main: straight-line bytecode (assertions throw from
-    native TestSupport.assertTrue, so no branches / StackMapTable)."""
-    cf = ClassFile(f"{PKG}/JniSmokeTest")
+    """JniSmokeTest.main: mostly straight-line bytecode (assertions
+    throw from native TestSupport.assertTrue); the bulk-string section
+    carries fill loops, so the class is emitted at major 49 where
+    branches need no StackMapTable."""
+    cf = ClassFile(f"{PKG}/JniSmokeTest", major=49)
     c = Code(cf.cp, max_locals=80)
     J = f"{PKG}/"
 
@@ -952,6 +1001,51 @@ def build_smoke_test(outdir: str, xx_gold):
     assert_check("NVML.getDeviceCount >= 1")
     c.println("list/tz/telemetry surface ok")
 
+    # --- bulk string path: content parity with the boxed path, and a
+    # 10MB single-crossing round trip (VERDICT r4 weak #4) ----------
+    BCH, BOF, BH, BH2 = 76, 77, 78, 72   # 78-79 + reuse 72-73
+    # small: boxed vs bulk build of the same ["ab","c","","dd"]
+    c.string_array(["ab", "c", "", "dd"])
+    c.invokestatic(J + "TpuColumns", "fromStrings",
+                   "([Ljava/lang/String;)J")
+    c.lstore(BH2)
+    c.iconst(5)
+    c.newarray(8)                  # byte[] "abcdd"
+    c.astore(BCH)
+    for i, ch in enumerate(b"abcdd"):
+        c.aload(BCH)
+        c.iconst(i)
+        c.iconst(ch)
+        c.bastore()
+    c.aload(BCH)
+    c.int_array([0, 2, 3, 3, 5])
+    c.aconst_null()
+    c.invokestatic(J + "TpuColumns", "fromStringsBulk", "([B[I[B)J")
+    c.lstore(BH)
+    c.lload(BH2)
+    c.lload(BH)
+    c.invokestatic(J + "TestSupport", "checkColumnsEqual", "(JJ)I")
+    assert_check("bulk string build != boxed build")
+    c.lload(BH2)
+    c.invokestatic(J + "TpuColumns", "free", "(J)V")
+    c.lload(BH)
+    c.invokestatic(J + "TpuColumns", "free", "(J)V")
+    # big: 10MB chars, 500k rows of 20 bytes, one crossing each way
+    _emit_bulk_string_arrays(c, BCH, BOF, 71, 97)
+    c.aload(BCH)
+    c.aload(BOF)
+    c.aconst_null()
+    c.invokestatic(J + "TpuColumns", "fromStringsBulk", "([B[I[B)J")
+    c.lstore(BH)
+    c.lload(BH)
+    c.invokestatic(J + "TpuColumns", "getStringChars", "(J)[B")
+    c.aload(BCH)
+    c.invokestatic("java/util/Arrays", "equals", "([B[B)Z")
+    assert_check("10MB bulk chars round trip")
+    c.lload(BH)
+    c.invokestatic(J + "TpuColumns", "free", "(J)V")
+    c.println("bulk string path ok")
+
     # --- handle hygiene ----------------------------------------------
     for h in [H_STR, 4, H_LONGS, 8, ROWS, BACK0, H_NUM, H_CAST,
               H_JSON, H_JOUT, H_UUID, H_URI, H_HOST, MERGED0, NM0,
@@ -1120,6 +1214,48 @@ def build_kudo_bench(outdir: str):
     c.invokestatic(J + "TpuColumns", "free", "(J)V")
     c.lload(HS)
     c.invokestatic(J + "TpuColumns", "free", "(J)V")
+
+    # --- bulk string JNI path: MB/s for a 10MB single-crossing
+    # ingest and readback (VERDICT r4 weak #4 'done' criterion) ----
+    BCH, BOF, BH, I2 = 30, 31, 32, 34   # 32-33 long, 34 int
+    _emit_bulk_string_arrays(c, BCH, BOF, I2, 98)
+    # warm once, then timed ingest + readback
+    c.aload(BCH)
+    c.aload(BOF)
+    c.aconst_null()
+    c.invokestatic(J + "TpuColumns", "fromStringsBulk", "([B[I[B)J")
+    c.invokestatic(J + "TpuColumns", "free", "(J)V")
+    c.invokestatic("java/lang/System", "nanoTime", "()J")
+    c.lstore(TSTART)
+    c.aload(BCH)
+    c.aload(BOF)
+    c.aconst_null()
+    c.invokestatic(J + "TpuColumns", "fromStringsBulk", "([B[I[B)J")
+    c.lstore(BH)
+    c.invokestatic("java/lang/System", "nanoTime", "()J")
+    c.lstore(TEND)
+    c.println("bulk_ingest_10MB wall_ns:")
+    c.getstatic("java/lang/System", "out", "Ljava/io/PrintStream;")
+    c.lload(TEND)
+    c.lload(TSTART)
+    c.lsub()
+    c.invokevirtual("java/io/PrintStream", "println", "(J)V")
+    c.invokestatic("java/lang/System", "nanoTime", "()J")
+    c.lstore(TSTART)
+    c.lload(BH)
+    c.invokestatic(J + "TpuColumns", "getStringChars", "(J)[B")
+    c.pop_op()
+    c.invokestatic("java/lang/System", "nanoTime", "()J")
+    c.lstore(TEND)
+    c.println("bulk_readback_10MB wall_ns:")
+    c.getstatic("java/lang/System", "out", "Ljava/io/PrintStream;")
+    c.lload(TEND)
+    c.lload(TSTART)
+    c.lsub()
+    c.invokevirtual("java/io/PrintStream", "println", "(J)V")
+    c.lload(BH)
+    c.invokestatic(J + "TpuColumns", "free", "(J)V")
+
     c.invokestatic(J + "TpuRuntime", "shutdown", "()V")
     c.println("kudo bench done")
     c.return_void()

@@ -288,6 +288,14 @@ class Code:
         self._push()
         self.b.append(0x59)
 
+    def bastore(self):
+        self._pop(3)
+        self.b.append(0x54)
+
+    def aconst_null(self):
+        self._push()
+        self.b.append(0x01)
+
     def iastore(self):
         self._pop(3)
         self.b.append(0x4F)

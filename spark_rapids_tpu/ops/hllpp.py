@@ -187,12 +187,38 @@ def estimate_from_hll_sketches(sketch_col: Column,
     raw = alpha * m * m / s
     # empirical bias correction in the mid zone (raw <= 5m), paper
     # order: correct raw first, then the linear-counting decision.
-    # Table: ops/hllpp_bias.npz, measured with this repo's own register
-    # pipeline (scripts/gen_hllpp_bias.py) since the reference's table
-    # lives in its cuco dependency.
+    # ALGORITHM parity with Spark's HyperLogLogPlusPlusHelper: the
+    # bias at a raw estimate is the MEAN OF THE K=6 NEAREST knots'
+    # biases (Spark's kNN average over rawEstimateData/biasData), not
+    # a linear interpolation.  Table values: ops/hllpp_bias.npz,
+    # measured with this repo's own register pipeline
+    # (scripts/gen_hllpp_bias.py) since the reference's table lives in
+    # its cuco dependency and Spark's in its source constants — the
+    # small/large ranges below are table-free and exact; mid-range
+    # estimates can differ from Spark within measurement noise.
     raw_knots, bias_knots = _bias_table(precision)
-    corrected = raw - jnp.interp(raw, raw_knots, bias_knots,
-                                 left=bias_knots[0], right=0.0)
+    k = 6
+    nk = raw_knots.shape[0]
+    idx = jnp.searchsorted(raw_knots, raw)
+    # nearest-k knots BY DISTANCE: with sorted knots they form a
+    # contiguous window; among the k+1 candidate windows ending near
+    # idx, pick the one whose FARTHEST member is closest (Spark's
+    # estimateBias slides the window by exactly this criterion)
+    best_lo = None
+    best_far = None
+    for s in range(k + 1):
+        lo = jnp.clip(idx - k + s, 0, max(nk - k, 0))
+        far = jnp.maximum(jnp.abs(raw - raw_knots[lo]),
+                          jnp.abs(raw_knots[lo + k - 1] - raw))
+        if best_lo is None:
+            best_lo, best_far = lo, far
+        else:
+            take = far < best_far
+            best_lo = jnp.where(take, lo, best_lo)
+            best_far = jnp.where(take, far, best_far)
+    window = best_lo[:, None] + jnp.arange(k)[None, :]
+    bias = bias_knots[jnp.clip(window, 0, nk - 1)].mean(axis=1)
+    corrected = raw - bias
     e = jnp.where(raw <= 5.0 * m, corrected, raw)
     linear = m * jnp.log(m / jnp.maximum(zeroes, 1))
     # HLL++ linear-counting threshold per precision (paper appendix;

@@ -99,6 +99,123 @@ def test_histogram_validation_and_filtering():
             Column.from_pylist([None], dtypes.INT64))
 
 
+def _spark_estimate_oracle(sketch_children, precision):
+    """Independent reimplementation of Spark's
+    HyperLogLogPlusPlusHelper.query decision structure from the HLL++
+    paper: raw harmonic mean, kNN(6) bias subtraction in the mid zone,
+    linear counting below the per-precision threshold.  Table-free in
+    the small and large ranges — exact equality is asserted there."""
+    import numpy as np
+
+    m = 1 << precision
+    # unpack 6-bit registers, 10 per long
+    longs = np.stack([np.asarray(c) for c in sketch_children], axis=1)
+    regs = []
+    for r in range(m):
+        word = longs[:, r // 10].astype(np.uint64)
+        regs.append((word >> np.uint64(6 * (r % 10)))
+                    & np.uint64(0x3F))
+    regs = np.stack(regs, axis=1).astype(np.int64)
+    if m == 16:
+        alpha = 0.673
+    elif m == 32:
+        alpha = 0.697
+    elif m == 64:
+        alpha = 0.709
+    else:
+        alpha = 0.7213 / (1.0 + 1.079 / m)
+    s = (2.0 ** -regs).sum(axis=1)
+    zeroes = (regs == 0).sum(axis=1).astype(np.float64)
+    raw = alpha * m * m / s
+    linear = m * np.log(np.where(zeroes > 0, m / np.maximum(zeroes, 1),
+                                 1.0))
+    thresholds = {4: 10, 5: 20, 6: 40, 7: 80, 8: 220, 9: 400, 10: 900,
+                  11: 1800, 12: 3100, 13: 6500, 14: 11500, 15: 20000,
+                  16: 50000, 17: 120000, 18: 350000}
+    return regs, raw, linear, zeroes, thresholds[precision]
+
+
+@pytest.mark.parametrize("p,n", [(8, 30), (11, 200), (14, 1000)])
+def test_hllpp_linear_range_exact(p, n):
+    """Small range: linear counting is a closed-form function of the
+    zero-register count — table-free, so the estimate must EQUAL the
+    formula value bit-for-bit (the range where Spark parity is
+    provable without Spark's empirical constants)."""
+    import numpy as np
+
+    from spark_rapids_tpu.columns import dtypes
+
+    rng = np.random.default_rng(7 * p + n)
+    vals = np.unique(
+        rng.integers(-(1 << 62), 1 << 62, n, dtype=np.int64))
+    c = Column.from_pylist(list(vals), dtypes.INT64)
+    sk = hllpp.reduce_hllpp(c, p)
+    est = hllpp.estimate_from_hll_sketches(sk, p).to_pylist()[0]
+    _regs, _raw, linear, zeroes, thr = _spark_estimate_oracle(
+        [ch.data for ch in sk.children], p)
+    assert zeroes[0] > 0 and linear[0] <= thr, "not in linear range"
+    assert est == int(np.round(linear[0]))
+
+
+def test_hllpp_large_range_exact():
+    """Large range (raw > 5m): the raw harmonic-mean estimate is used
+    unmodified — table-free, exact equality required."""
+    import numpy as np
+
+    from spark_rapids_tpu.columns import dtypes
+
+    p = 4                     # m=16: large range reachable cheaply
+    rng = np.random.default_rng(99)
+    vals = np.unique(
+        rng.integers(-(1 << 62), 1 << 62, 5000, dtype=np.int64))
+    c = Column.from_pylist(list(vals), dtypes.INT64)
+    sk = hllpp.reduce_hllpp(c, p)
+    est = hllpp.estimate_from_hll_sketches(sk, p).to_pylist()[0]
+    _regs, raw, _linear, zeroes, thr = _spark_estimate_oracle(
+        [ch.data for ch in sk.children], p)
+    assert raw[0] > 5 * 16, "not in large range"
+    assert zeroes[0] == 0 or _linear_above(p, zeroes[0], thr)
+    assert est == int(np.round(raw[0]))
+
+
+def _linear_above(p, zeroes, thr):
+    import numpy as np
+
+    m = 1 << p
+    return m * np.log(m / zeroes) > thr
+
+
+def test_hllpp_knn_bias_matches_oracle_mid_range():
+    """Mid zone: the estimate must equal the oracle's kNN(6)-averaged
+    bias subtraction over the SAME table — proves the implementation
+    computes Spark's algorithm shape exactly (table values are this
+    repo's measurement; Spark's constants are not available offline)."""
+    import numpy as np
+
+    from spark_rapids_tpu.columns import dtypes
+    from spark_rapids_tpu.ops.hllpp import _bias_table
+
+    p, n = 11, 4000
+    m = 1 << p
+    rng = np.random.default_rng(1234)
+    vals = np.unique(
+        rng.integers(-(1 << 62), 1 << 62, n, dtype=np.int64))
+    c = Column.from_pylist(list(vals), dtypes.INT64)
+    sk = hllpp.reduce_hllpp(c, p)
+    est = hllpp.estimate_from_hll_sketches(sk, p).to_pylist()[0]
+    _regs, raw, linear, zeroes, thr = _spark_estimate_oracle(
+        [ch.data for ch in sk.children], p)
+    raw_knots = np.asarray(_bias_table(p)[0])
+    bias_knots = np.asarray(_bias_table(p)[1])
+    # INDEPENDENT nearest-6-by-distance selection (argsort, no window
+    # mechanics) — validates the implementation's sliding-window pick
+    nearest = np.argsort(np.abs(raw_knots - raw[0]), kind="stable")[:6]
+    bias = bias_knots[nearest].mean()
+    e = raw[0] - bias if raw[0] <= 5 * m else raw[0]
+    want = linear[0] if (zeroes[0] > 0 and linear[0] <= thr) else e
+    assert est == int(np.round(want))
+
+
 def test_hllpp_bias_correction_mid_range():
     """Mid-zone estimates (above the linear-counting threshold, below
     5m) use the empirical bias table: error must stay tight where the
