@@ -1717,6 +1717,26 @@ jlong JNI_FN(TestSupport, makeListOfInts)(JNIEnv* env, jclass,
   return as_jlong(env, call_entry(env, "make_list_of_ints", args));
 }
 
+void JNI_FN(RmmSpark, shuffleThreadWorkingOnTasks)(JNIEnv* env, jclass,
+                                                   jlongArray tasks) {
+  if (!ensure_runtime(env)) return;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(N)", longs_to_pylist(env, tasks));
+  PyObject* r = call_entry(env, "rmm_shuffle_thread_working_on_tasks",
+                           args);
+  Py_XDECREF(r);
+}
+
+void JNI_FN(RmmSpark, poolThreadFinishedForTasks)(JNIEnv* env, jclass,
+                                                  jlongArray tasks) {
+  if (!ensure_runtime(env)) return;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(N)", longs_to_pylist(env, tasks));
+  PyObject* r = call_entry(env, "rmm_pool_thread_finished_for_tasks",
+                           args);
+  Py_XDECREF(r);
+}
+
 // ------------------------------------------------ list/map utilities
 
 static jlong list_slice_impl(JNIEnv* env, jlong cv, jlong start,

@@ -33,8 +33,8 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
-from jasm import (ACC_FINAL, ACC_PRIVATE, ACC_PUBLIC, ClassFile, Code,
-                  Label, T_INT, T_LONG)  # noqa: E402
+from jasm import (ACC_FINAL, ACC_PRIVATE, ACC_PUBLIC, ACC_VOLATILE,
+                  ClassFile, Code, Label, T_INT, T_LONG)  # noqa: E402
 
 PKG = "com/nvidia/spark/rapids/jni"
 
@@ -162,6 +162,8 @@ NATIVE_CLASSES = {
         ("alloc", "(J)V"),
         ("dealloc", "(J)V"),
         ("getStateOf", "(J)Ljava/lang/String;"),
+        ("shuffleThreadWorkingOnTasks", "([J)V"),
+        ("poolThreadFinishedForTasks", "([J)V"),
     ],
     "StringUtils": [
         ("randomUUIDs", "(IJ)J"),
@@ -307,23 +309,12 @@ def _emit_bulk_string_arrays(c, ch_slot, off_slot, i_slot, fill_byte,
                              row_width=20):
     """Emit the 10MB chars fill + int32 offsets (i*row_width) loops
     shared by the smoke test and KudoBench bulk sections."""
-    loop, done = Label(), Label()
     c.iconst(nbytes)
     c.newarray(8)
     c.astore(ch_slot)
-    c.iconst(0)
-    c.istore(i_slot)
-    c.place(loop)
-    c.iload(i_slot)
-    c.iconst(nbytes)
-    c.if_icmp("ge", done)
     c.aload(ch_slot)
-    c.iload(i_slot)
     c.iconst(fill_byte)
-    c.bastore()
-    c.iinc(i_slot, 1)
-    c.goto(loop)
-    c.place(done)
+    c.invokestatic("java/util/Arrays", "fill", "([BB)V")
     oloop, odone = Label(), Label()
     c.iconst(rows + 1)
     c.newarray(T_INT)
@@ -1028,6 +1019,20 @@ def build_smoke_test(outdir: str, xx_gold):
     assert_check("bulk string build != boxed build")
     c.lload(BH2)
     c.invokestatic(J + "TpuColumns", "free", "(J)V")
+    # bulk offsets readback: little-endian bytes of [0,2,3,3,5]
+    c.lload(BH)
+    c.invokestatic(J + "TpuColumns", "getStringOffsets", "(J)[B")
+    c.iconst(20)
+    c.newarray(8)
+    c.astore(BCH)
+    for pos, val in ((4, 2), (8, 3), (12, 3), (16, 5)):
+        c.aload(BCH)
+        c.iconst(pos)
+        c.iconst(val)
+        c.bastore()
+    c.aload(BCH)
+    c.invokestatic("java/util/Arrays", "equals", "([B[B)Z")
+    assert_check("bulk offsets readback != expected LE bytes")
     c.lload(BH)
     c.invokestatic(J + "TpuColumns", "free", "(J)V")
     # big: 10MB chars, 500k rows of 20 bytes, one crossing each way
@@ -1061,6 +1066,255 @@ def build_smoke_test(outdir: str, xx_gold):
 
     path = os.path.join(outdir, PKG, "JniSmokeTest.class")
     os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(cf.serialize())
+
+
+
+def build_bufn_smoke_test(outdir: str):
+    """BufnSmokeTest: TWO REAL JVM THREADS driven into the BUFN
+    deadlock-break cycle through the JNI surface (reference
+    RmmSparkTest.testBasicBUFN:1002 / docs/memory_management.md flow;
+    Python spec: tests/test_rmm_spark.py test_bufn_and_split_full
+    _cycle).  Main = task 1 (higher priority), worker = task 2:
+
+      both hold/request 600 of a 1000-byte budget -> worker blocks ->
+      main blocks -> deadlock -> worker (lowest priority) rolls back
+      with GpuRetryOOM and parks BUFN -> main retries once, rolls back
+      with GpuRetryOOM, frees, parks -> all BUFN -> main (highest
+      priority) is the split-and-retry victim (GpuSplitAndRetryOOM)
+      and completes with two half allocations -> worker wakes and
+      finishes.
+
+    Plus the pool/shuffle thread registration path
+    (shuffleThreadWorkingOnTasks / poolThreadFinishedForTasks).
+    Emitted at major 49 (branches, try/catch without StackMapTable).
+    """
+    J = f"{PKG}/"
+    W = f"{PKG}/BufnWorker"
+
+    # ---- worker: extends Thread -------------------------------------
+    cf = ClassFile(W, super_name="java/lang/Thread", final=False,
+                   major=49)
+    cf.add_field("tid", "J", flags=ACC_PUBLIC | ACC_VOLATILE)
+    cf.add_field("mode", "I", flags=ACC_PUBLIC | ACC_VOLATILE)
+    cf.add_field("gotRetry", "I", flags=ACC_PUBLIC | ACC_VOLATILE)
+    cf.add_field("done", "I", flags=ACC_PUBLIC | ACC_VOLATILE)
+    c = Code(cf.cp, max_locals=1)
+    c.aload(0)
+    c.invokespecial("java/lang/Thread", "<init>", "()V")
+    c.return_void()
+    cf.add_code_method("<init>", "()V", c, flags=ACC_PUBLIC)
+
+    c = Code(cf.cp, max_locals=4)      # 0=this 1-2=tid 3=scratch
+    shuffle_mode, task_end = Label(), Label()
+    c.aload(0)
+    c.getfield(W, "mode", "I")
+    c.iconst(1)
+    c.if_icmp("eq", shuffle_mode)
+    # ---- mode 0: the BUFN task-2 side ----
+    c.invokestatic(J + "RmmSpark", "getCurrentThreadId", "()J")
+    c.lstore(1)
+    c.aload(0)
+    c.lload(1)
+    c.putfield(W, "tid", "J")
+    c.lload(1)
+    c.lconst(2)
+    c.invokestatic(J + "RmmSpark", "startDedicatedTaskThread",
+                   "(JJ)V")
+    t0, t1, hdl, after = Label(), Label(), Label(), Label()
+    c.place(t0)
+    c.lconst(600)
+    c.invokestatic(J + "RmmSpark", "alloc", "(J)V")
+    c.place(t1)
+    c.goto(after)
+    c.place(hdl)
+    c.handler_entry()
+    c.pop_op()                         # discard the exception ref
+    c.aload(0)
+    c.iconst(1)
+    c.putfield(W, "gotRetry", "I")
+    c.place(after)
+    c.try_catch(t0, t1, hdl, J + "GpuRetryOOM")
+    # retry framework: park BUFN until task 1 finishes, then complete
+    c.invokestatic(J + "RmmSpark", "blockThreadUntilReady", "()V")
+    c.lconst(600)
+    c.invokestatic(J + "RmmSpark", "alloc", "(J)V")
+    c.lconst(600)
+    c.invokestatic(J + "RmmSpark", "dealloc", "(J)V")
+    c.lconst(2)
+    c.invokestatic(J + "RmmSpark", "taskDone", "(J)V")
+    c.aload(0)
+    c.iconst(1)
+    c.putfield(W, "done", "I")
+    c.goto(task_end)
+    # ---- mode 1: pool/shuffle thread registration path ----
+    c.place(shuffle_mode)
+    c.long_array_consts([5])
+    c.invokestatic(J + "RmmSpark", "shuffleThreadWorkingOnTasks",
+                   "([J)V")
+    c.lconst(100)
+    c.invokestatic(J + "RmmSpark", "alloc", "(J)V")
+    c.lconst(100)
+    c.invokestatic(J + "RmmSpark", "dealloc", "(J)V")
+    c.long_array_consts([5])
+    c.invokestatic(J + "RmmSpark", "poolThreadFinishedForTasks",
+                   "([J)V")
+    c.aload(0)
+    c.iconst(1)
+    c.putfield(W, "done", "I")
+    c.place(task_end)
+    c.return_void()
+    c.max_stack = max(c.max_stack, 8)
+    cf.add_code_method("run", "()V", c, flags=ACC_PUBLIC)
+    path = os.path.join(outdir, PKG, "BufnWorker.class")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(cf.serialize())
+
+    # ---- driver -----------------------------------------------------
+    cf = ClassFile(f"{PKG}/BufnSmokeTest", major=49)
+    c = Code(cf.cp, max_locals=16)
+    # 0=args 1=w(ref) 2-3=tid1 4=flag 5=w2(ref)
+
+    def assert_check(msg):
+        c.ldc_string(msg)
+        c.invokestatic(J + "TestSupport", "assertTrue",
+                       "(ILjava/lang/String;)V")
+
+    c.aload(0)
+    c.iconst(0)
+    c.aaload()
+    c.invokestatic("java/lang/System", "load", "(Ljava/lang/String;)V")
+    c.invokestatic(J + "TpuRuntime", "initialize", "()V")
+    c.lconst(1000)
+    c.invokestatic(J + "RmmSpark", "setEventHandler", "(J)V")
+    c.invokestatic(J + "RmmSpark", "getCurrentThreadId", "()J")
+    c.lstore(2)
+    c.lload(2)
+    c.lconst(1)
+    c.invokestatic(J + "RmmSpark", "startDedicatedTaskThread",
+                   "(JJ)V")
+    c.lconst(600)
+    c.invokestatic(J + "RmmSpark", "alloc", "(J)V")
+    c.new_obj(f"{PKG}/BufnWorker")
+    c.dup()
+    c.invokespecial(f"{PKG}/BufnWorker", "<init>", "()V")
+    c.astore(1)
+    c.aload(1)
+    c.invokevirtual("java/lang/Thread", "start", "()V")
+    # wait for the worker to publish its thread id
+    pw, pw_sleep = Label(), Label()
+    c.place(pw)
+    c.aload(1)
+    c.getfield(f"{PKG}/BufnWorker", "tid", "J")
+    c.lconst(0)
+    c.lcmp()
+    c.ifeq_lbl(pw_sleep)
+    pws_done = Label()
+    c.goto(pws_done)
+    c.place(pw_sleep)
+    c.lconst(5)
+    c.invokestatic("java/lang/Thread", "sleep", "(J)V")
+    c.goto(pw)
+    c.place(pws_done)
+    # wait until the worker's alloc is THREAD_BLOCKED
+    ps, ps_sleep, ps_done = Label(), Label(), Label()
+    c.place(ps)
+    c.aload(1)
+    c.getfield(f"{PKG}/BufnWorker", "tid", "J")
+    c.invokestatic(J + "RmmSpark", "getStateOf",
+                   "(J)Ljava/lang/String;")
+    c.ldc_string("THREAD_BLOCKED")
+    c.invokevirtual("java/lang/String", "equals",
+                    "(Ljava/lang/Object;)Z")
+    c.ifeq_lbl(ps_sleep)
+    c.goto(ps_done)
+    c.place(ps_sleep)
+    c.lconst(5)
+    c.invokestatic("java/lang/Thread", "sleep", "(J)V")
+    c.goto(ps)
+    c.place(ps_done)
+    c.println("worker blocked; forcing the deadlock")
+    # main's alloc deadlocks; worker rolls back first, then main
+    c.iconst(0)
+    c.istore(4)
+    m0, m1, mh, ma = Label(), Label(), Label(), Label()
+    c.place(m0)
+    c.lconst(600)
+    c.invokestatic(J + "RmmSpark", "alloc", "(J)V")
+    c.place(m1)
+    c.goto(ma)
+    c.place(mh)
+    c.handler_entry()
+    c.pop_op()
+    c.iconst(1)
+    c.istore(4)
+    c.place(ma)
+    c.try_catch(m0, m1, mh, J + "GpuRetryOOM")
+    c.iload(4)
+    assert_check("main thread did not receive GpuRetryOOM")
+    c.println("main rolled back with GpuRetryOOM")
+    c.lconst(600)
+    c.invokestatic(J + "RmmSpark", "dealloc", "(J)V")
+    # all tasks BUFN: main is highest priority -> split victim
+    c.iconst(0)
+    c.istore(4)
+    s0, s1, sh, sa = Label(), Label(), Label(), Label()
+    c.place(s0)
+    c.invokestatic(J + "RmmSpark", "blockThreadUntilReady", "()V")
+    c.place(s1)
+    c.goto(sa)
+    c.place(sh)
+    c.handler_entry()
+    c.pop_op()
+    c.iconst(1)
+    c.istore(4)
+    c.place(sa)
+    c.try_catch(s0, s1, sh, J + "GpuSplitAndRetryOOM")
+    c.iload(4)
+    assert_check("main thread was not the split-and-retry victim")
+    c.println("main selected as split-and-retry victim")
+    # split: complete with two half allocations
+    c.lconst(300)
+    c.invokestatic(J + "RmmSpark", "alloc", "(J)V")
+    c.lconst(300)
+    c.invokestatic(J + "RmmSpark", "alloc", "(J)V")
+    c.lconst(600)
+    c.invokestatic(J + "RmmSpark", "dealloc", "(J)V")
+    c.lconst(1)
+    c.invokestatic(J + "RmmSpark", "taskDone", "(J)V")
+    c.aload(1)
+    c.invokevirtual("java/lang/Thread", "join", "()V")
+    c.aload(1)
+    c.getfield(f"{PKG}/BufnWorker", "gotRetry", "I")
+    assert_check("worker did not receive GpuRetryOOM")
+    c.aload(1)
+    c.getfield(f"{PKG}/BufnWorker", "done", "I")
+    assert_check("worker did not complete after BUFN wake")
+    c.println("BUFN deadlock-break cycle ok")
+    # pool/shuffle thread registration path
+    c.new_obj(f"{PKG}/BufnWorker")
+    c.dup()
+    c.invokespecial(f"{PKG}/BufnWorker", "<init>", "()V")
+    c.astore(5)
+    c.aload(5)
+    c.iconst(1)
+    c.putfield(f"{PKG}/BufnWorker", "mode", "I")
+    c.aload(5)
+    c.invokevirtual("java/lang/Thread", "start", "()V")
+    c.aload(5)
+    c.invokevirtual("java/lang/Thread", "join", "()V")
+    c.aload(5)
+    c.getfield(f"{PKG}/BufnWorker", "done", "I")
+    assert_check("shuffle-thread registration path failed")
+    c.println("shuffle thread registration ok")
+    c.invokestatic(J + "RmmSpark", "clearEventHandler", "()V")
+    c.println("BUFN smoke: ALL OK")
+    c.return_void()
+    c.max_stack = max(c.max_stack, 10)
+    cf.add_code_method("main", "([Ljava/lang/String;)V", c)
+    path = os.path.join(outdir, PKG, "BufnSmokeTest.class")
     with open(path, "wb") as f:
         f.write(cf.serialize())
 
@@ -1274,6 +1528,7 @@ def main():
     build_exceptions(outdir)
     build_smoke_test(outdir, _computed_goldens())
     build_oom_smoke_test(outdir)
+    build_bufn_smoke_test(outdir)
     build_kudo_bench(outdir)
     print(f"emitted classes under {outdir}")
 

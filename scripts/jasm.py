@@ -29,6 +29,7 @@ _UTF8, _INT, _LONG, _CLASS, _STRING, _FIELD, _METHOD, _NAT = \
 ACC_PUBLIC, ACC_STATIC, ACC_FINAL, ACC_SUPER, ACC_NATIVE = \
     0x0001, 0x0008, 0x0010, 0x0020, 0x0100
 ACC_PRIVATE = 0x0002
+ACC_VOLATILE = 0x0040
 
 T_INT, T_LONG = 10, 11
 

@@ -48,6 +48,11 @@ export SPARK_RAPIDS_TPU_ROOT="$REPO"
 # typed OOM exceptions across JNI (GpuRetryOOM / GpuSplitAndRetryOOM
 # caught by real JVM catch blocks; class file major 49 for try/catch
 # without StackMapTable)
-exec "$JAVA_BIN" -cp "$REPO/java/classes" \
+"$JAVA_BIN" -cp "$REPO/java/classes" \
     com.nvidia.spark.rapids.jni.OomSmokeTest \
+    "$REPO/native/jni/libspark_rapids_tpu_jni.so"
+# the BUFN deadlock-break cycle with two REAL concurrent JVM threads
+# (RmmSparkTest.testBasicBUFN analog through the JNI surface)
+exec timeout 300 "$JAVA_BIN" -cp "$REPO/java/classes" \
+    com.nvidia.spark.rapids.jni.BufnSmokeTest \
     "$REPO/native/jni/libspark_rapids_tpu_jni.so"

@@ -206,8 +206,8 @@ def estimate_from_hll_sketches(sketch_col: Column,
     # estimateBias slides the window by exactly this criterion)
     best_lo = None
     best_far = None
-    for s in range(k + 1):
-        lo = jnp.clip(idx - k + s, 0, max(nk - k, 0))
+    for shift in range(k + 1):
+        lo = jnp.clip(idx - k + shift, 0, max(nk - k, 0))
         far = jnp.maximum(jnp.abs(raw - raw_knots[lo]),
                           jnp.abs(raw_knots[lo + k - 1] - raw))
         if best_lo is None:

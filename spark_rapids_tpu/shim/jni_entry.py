@@ -91,26 +91,44 @@ def from_strings_bulk(chars: bytes, offsets_le: bytes,
     import jax.numpy as jnp
     import numpy as np
 
-    from spark_rapids_tpu.columns import dtypes
-    from spark_rapids_tpu.columns.column import Column
     from spark_rapids_tpu.shim.handles import REGISTRY
     offs = np.frombuffer(offsets_le, "<i4")
     if len(offs) == 0:
         raise ValueError(
             "offsets must hold at least one entry (the leading 0)")
     rows = len(offs) - 1
+    if offs[0] != 0 or (rows > 0 and (np.diff(offs) < 0).any()):
+        raise ValueError("offsets must start at 0 and be "
+                         "non-decreasing")
+    if int(offs[-1]) > len(chars):
+        raise ValueError(
+            f"last offset {int(offs[-1])} exceeds chars length "
+            f"{len(chars)}")
+    if validity is not None and len(validity) < (rows + 7) // 8:
+        raise ValueError("validity shorter than ceil(rows/8) bytes")
     # no host-side .copy(): jnp.asarray copies the read-only views
     # into device buffers anyway; an extra memcpy on a multi-MB
     # payload is pure waste on the path this entry exists to speed up
-    data = np.frombuffer(chars, np.uint8)
+    return REGISTRY.register(_string_column_from_buffers(
+        np.frombuffer(chars, np.uint8), offs, validity, rows))
+
+
+def _string_column_from_buffers(chars_np, offs_np, validity, rows):
+    """Shared STRING Column assembly from raw buffers (packed
+    LSB-first validity or None) — used by the bulk ingest above and
+    the kudo host-table import below."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from spark_rapids_tpu.columns import dtypes
+    from spark_rapids_tpu.columns.column import Column
     mask = None
     if validity is not None:
         bits = np.unpackbits(np.frombuffer(validity, np.uint8),
                              bitorder="little")[:rows]
         mask = jnp.asarray(bits.astype(np.uint8))
-    return REGISTRY.register(Column(
-        dtypes.STRING, rows, data=jnp.asarray(data), validity=mask,
-        offsets=jnp.asarray(offs)))
+    return Column(dtypes.STRING, rows, data=jnp.asarray(chars_np),
+                  validity=mask, offsets=jnp.asarray(offs_np))
 
 
 def string_column_chars(handle: int) -> bytes:
@@ -751,12 +769,12 @@ def columns_from_kudo_host(num_rows: int, flat: Sequence) -> List[int]:
             bits = np.unpackbits(np.frombuffer(validity, np.uint8),
                                  bitorder="little")[:rows]
             mask = jnp.asarray(bits.astype(np.uint8))
-        if kkind == 1:  # string
-            offs = np.frombuffer(offsets, "<i4").copy() if offsets \
+        if kkind == 1:  # string: shared buffer->Column assembly
+            offs = np.frombuffer(offsets, "<i4") if offsets \
                 is not None else np.zeros(rows + 1, np.int32)
-            chars = np.frombuffer(data or b"", np.uint8).copy()
-            return Column(dtype, rows, data=jnp.asarray(chars),
-                          validity=mask, offsets=jnp.asarray(offs))
+            return _string_column_from_buffers(
+                np.frombuffer(data or b"", np.uint8), offs, validity,
+                rows)
         if kkind == 2:  # list
             offs = np.frombuffer(offsets, "<i4").copy() if offsets \
                 is not None else np.zeros(rows + 1, np.int32)
@@ -869,6 +887,23 @@ def rmm_alloc(nbytes: int) -> None:
 def rmm_dealloc(nbytes: int) -> None:
     from spark_rapids_tpu.memory import rmm_spark
     rmm_spark.get_adaptor().deallocate(nbytes)
+
+
+def rmm_shuffle_thread_working_on_tasks(task_ids: Sequence[int]
+                                        ) -> None:
+    """RmmSpark.shuffleThreadWorkingOnTasks for the calling JVM
+    thread (pool/shuffle thread registration — shuffle threads take
+    priority in the BUFN victim selection)."""
+    from spark_rapids_tpu.memory import rmm_spark
+    rmm_spark.shuffle_thread_working_on_tasks(
+        [int(t) for t in task_ids])
+
+
+def rmm_pool_thread_finished_for_tasks(task_ids: Sequence[int]
+                                       ) -> None:
+    from spark_rapids_tpu.memory import rmm_spark
+    rmm_spark.pool_thread_finished_for_tasks(
+        rmm_spark.current_thread_id(), [int(t) for t in task_ids])
 
 
 # ------------------------------------------- list/map utils over JNI
