@@ -17,8 +17,16 @@ on this backend's tiling).
 Reference counterpart: row_conversion.cu:591 copy_to_rows (shared-memory
 tiled memcpy); the TPU shape is word-composition, not memcpy.
 
+Both directions live here (r5): `assemble_rows_pallas` builds row
+tiles (copy_to_rows), `disassemble_rows_pallas` streams the packed row
+matrix through VMEM once and slices every column field out in-register
+(copy_from_rows), and `paste_strings_pallas` gathers string payloads
+into row tiles (the string variants, row_conversion.cu:71-73) instead
+of scattering across the whole HBM matrix.
+
 Opt-in until profiled on real hardware: set
-SPARK_RAPIDS_TPU_PALLAS_ROWCONV=1 (row_conversion picks it up), or call
+SPARK_RAPIDS_TPU_PALLAS_ROWCONV=1 (row_conversion routes to-rows,
+from-rows, and the string paste through these kernels), or call
 directly.  `interpret=True` runs anywhere (tests use the CPU backend).
 """
 
@@ -86,3 +94,174 @@ def assemble_fixed_words_pallas(cols, starts, validity_offset, row_size,
     return assemble_rows_pallas(inputs, plan, rows, n_words,
                                 block_rows=block_rows,
                                 interpret=interpret)
+
+
+# ------------------------------------------------- from-rows direction
+
+
+def disassemble_rows_pallas(words: jnp.ndarray,
+                            extract_plan: Sequence[Tuple[int, int, int]],
+                            block_rows: int = 512,
+                            interpret: bool = False):
+    """Inverse tile kernel (row_conversion.cu:591 copy_from_rows
+    counterpart): the (rows, W) packed word matrix streams through
+    VMEM once per row tile and every extraction — (word, shift, nbits)
+    — slices its field out in-register.  Returns one (rows,) u32 array
+    per plan entry.
+
+    One HBM read of the row matrix feeds ALL column extractions (the
+    default gather path reads the byte buffer once per column)."""
+    import jax.experimental.pallas as pl
+
+    rows, n_words = words.shape
+    br = min(block_rows, max(8, rows))
+
+    def kernel(in_ref, *out_refs):
+        tile = in_ref[:, :]
+        for ref, (w, sh, nbits) in zip(out_refs, extract_plan):
+            v = tile[:, w]
+            if sh:
+                v = v >> _U32(sh)
+            if nbits < 32:
+                v = v & _U32((1 << nbits) - 1)
+            ref[:] = v
+
+    grid = (pl.cdiv(rows, br),)
+    outs = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((br, n_words), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((br,), lambda i: (i,))
+                   for _ in extract_plan],
+        out_shape=[jax.ShapeDtypeStruct((rows,), _U32)
+                   for _ in extract_plan],
+        interpret=interpret,
+    )(words)
+    return outs
+
+
+def build_extract_plan(schema, starts, validity_offset, n_words):
+    """Per-logical-field (word, shift, nbits) extraction entries for
+    a fixed-width JCUDF schema + per-column validity entries.  Field
+    coordinates come from row_conversion.field_word_slots — the SAME
+    layout source the assembly direction consumes."""
+    from spark_rapids_tpu.ops.row_conversion import field_word_slots
+
+    plan: List[Tuple[int, int, int]] = []
+    col_entries: List[List[int]] = []
+    for dt, st in zip(schema, starts):
+        entries = []
+        for slot in field_word_slots(dt, st):
+            entries.append(len(plan))
+            plan.append(slot)
+        col_entries.append(entries)
+    valid_entries: List[int] = []
+    for ci in range(len(schema)):
+        off = validity_offset + ci // 8
+        valid_entries.append(len(plan))
+        plan.append((off // 4, (off % 4) * 8 + (ci % 8), 1))
+    assert all(w < n_words for w, _sh, _nb in plan)
+    return plan, col_entries, valid_entries
+
+
+def convert_from_rows_pallas(list_col: Column, schema,
+                             block_rows: int = 512,
+                             interpret: bool = False):
+    """Fixed-width-schema from-rows over the tile kernel; returns a
+    Table matching row_conversion.convert_from_rows bit-for-bit.
+    Requires uniform row sizes (fixed-width schemas have them)."""
+    from spark_rapids_tpu.columns.dtypes import Kind
+    from spark_rapids_tpu.columns.table import Table
+    from spark_rapids_tpu.ops.row_conversion import (
+        _col_byte_size, compute_layout, _round_up, JCUDF_ROW_ALIGNMENT)
+
+    rows = list_col.length
+    starts, validity_offset, fixed_size = compute_layout(schema)
+    row_size = _round_up(fixed_size, JCUDF_ROW_ALIGNMENT)
+    n_words = row_size // 4
+    child = list_col.children[0]
+    words = child.data
+    assert words.dtype == _U32, "packed u32 word buffer expected"
+    if int(words.size) != rows * n_words:
+        raise ValueError(
+            f"row buffer holds {int(words.size)} words, schema needs "
+            f"{rows}x{n_words} uniform rows")
+    mat = words.reshape(rows, n_words)
+    plan, col_entries, valid_entries = build_extract_plan(
+        schema, starts, validity_offset, n_words)
+    pieces = disassemble_rows_pallas(mat, plan,
+                                     block_rows=block_rows,
+                                     interpret=interpret)
+    out_cols = []
+    for ci, dt in enumerate(schema):
+        es = [pieces[e] for e in col_entries[ci]]
+        kind = dt.kind
+        size = _col_byte_size(dt)
+        if kind == Kind.DECIMAL128:
+            data = lax.bitcast_convert_type(
+                jnp.stack(es, axis=1), jnp.int32)
+        elif size == 8:
+            u = (es[0].astype(jnp.uint64)
+                 | (es[1].astype(jnp.uint64) << jnp.uint64(32)))
+            # FLOAT64 stays raw-bits u64 (columns convention)
+            data = (u if kind == Kind.FLOAT64
+                    else lax.bitcast_convert_type(
+                        u, jnp.dtype(dt.np_dtype)))
+        elif size == 4:
+            data = lax.bitcast_convert_type(es[0],
+                                            jnp.dtype(dt.np_dtype))
+        elif size == 2:
+            data = lax.bitcast_convert_type(
+                es[0].astype(jnp.uint16), jnp.dtype(dt.np_dtype))
+        else:
+            data = lax.bitcast_convert_type(
+                es[0].astype(jnp.uint8), jnp.dtype(dt.np_dtype))
+        valid = pieces[valid_entries[ci]].astype(jnp.uint8)
+        out_cols.append(Column(dt, rows, data=data, validity=valid))
+    return Table(out_cols)
+
+
+# ------------------------------------------- string payload tiling
+
+
+def paste_strings_pallas(mat: jnp.ndarray, chars: jnp.ndarray,
+                         vstart: jnp.ndarray, lens: jnp.ndarray,
+                         block_rows: int = 256,
+                         interpret: bool = False) -> jnp.ndarray:
+    """Tile-resident string-payload paste for the variable-width
+    to-rows path (row_conversion.cu:71-73 string copy counterpart):
+    for each output byte position p of a row tile, the value is
+    chars[r, p - vstart[r]] when p falls in the row's payload span,
+    else the existing fixed-section byte.  The gather happens in VMEM
+    per tile — the XLA fallback (_masked_row_scatter) materializes a
+    scatter over the whole (rows, max_row) matrix in HBM."""
+    import jax.experimental.pallas as pl
+
+    rows, max_row = mat.shape
+    pad = chars.shape[1]
+    br = min(block_rows, max(8, rows))
+
+    def kernel(mat_ref, ch_ref, vs_ref, ln_ref, out_ref):
+        base = mat_ref[:, :]
+        ch = ch_ref[:, :]
+        vs = vs_ref[:]
+        ln = ln_ref[:]
+        p = lax.broadcasted_iota(jnp.int32, (br, max_row), 1)
+        src = p - vs[:, None]
+        in_span = (src >= 0) & (src < ln[:, None]) & (src < pad)
+        gathered = jnp.take_along_axis(
+            ch, jnp.clip(src, 0, pad - 1), axis=1)
+        out_ref[:, :] = jnp.where(in_span, gathered, base)
+
+    grid = (pl.cdiv(rows, br),)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec((br, max_row), lambda i: (i, 0)),
+                  pl.BlockSpec((br, pad), lambda i: (i, 0)),
+                  pl.BlockSpec((br,), lambda i: (i,)),
+                  pl.BlockSpec((br,), lambda i: (i,))],
+        out_specs=pl.BlockSpec((br, max_row), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, max_row), mat.dtype),
+        interpret=interpret,
+    )(mat, chars, vstart.astype(jnp.int32), lens.astype(jnp.int32))

@@ -153,51 +153,72 @@ def _validity_byte_vector(cols: Sequence[Column], b: int) -> jnp.ndarray:
     return byte
 
 
+def field_word_slots(dt: DType, st: int):
+    """[(word_index, shift_bits, nbits)] for the value pieces of one
+    fixed-width field at byte offset `st` — THE single source of the
+    JCUDF word layout.  Consumed by build_plan (assembly: piece arrays
+    zip with these coordinates) and by the Pallas from-rows extraction
+    plan (row_assembly_pallas.build_extract_plan), so the two
+    directions cannot drift."""
+    w = st // 4
+    size = _col_byte_size(dt)
+    if dt.kind == Kind.DECIMAL128:
+        return [(w + k, 0, 32) for k in range(4)]
+    if size == 8:
+        return [(w, 0, 32), (w + 1, 0, 32)]
+    if size == 4:
+        return [(w, 0, 32)]
+    return [(w, (st % 4) * 8, size * 8)]
+
+
 def build_plan(cols: Sequence[Column], starts: Sequence[int],
                validity_offset: int, n_words: int):
     """(inputs, plan): one (rows,) array per word contribution in its
     native width (u8/u16/u32; 8-byte columns split into u32 lo/hi —
     (rows, 2) u32 bitcasts are not tile-safe on this backend, see
     docs/tpu_design.md §2), and the (word_index, left_shift_bits) each
-    lands at.  THE single source of the JCUDF word layout: consumed by
-    the default stack assembly below and by the Pallas tile kernel
-    (ops/row_assembly_pallas.py)."""
+    lands at.  Word coordinates come from field_word_slots (the shared
+    layout source); this function supplies the matching piece arrays.
+    Consumed by the default stack assembly below and by the Pallas
+    tile kernel (ops/row_assembly_pallas.py)."""
     inputs = []
     plan = []
 
-    def add(arr, word, shift=0):
-        inputs.append(arr)
-        plan.append((word, shift))
+    def add(arrs, slots):
+        assert len(arrs) == len(slots)
+        for arr, (word, shift, _nbits) in zip(arrs, slots):
+            inputs.append(arr)
+            plan.append((word, shift))
 
     for c, st in zip(cols, starts):
         kind = c.dtype.kind
-        w = st // 4
         d = c.data
+        slots = field_word_slots(c.dtype, st)
         if kind == Kind.FLOAT32:
-            add(lax.bitcast_convert_type(d, _U32), w)
+            arrs = [lax.bitcast_convert_type(d, _U32)]
         elif kind == Kind.DECIMAL128:
             u = lax.bitcast_convert_type(d, _U32)
-            for k in range(4):
-                add(u[:, k], w + k)
+            arrs = [u[:, k] for k in range(4)]
         elif _col_byte_size(c.dtype) == 8:
             u = (d if d.dtype == jnp.uint64
                  else d.astype(jnp.int64).astype(_U64))
-            add((u & _U64(0xFFFFFFFF)).astype(_U32), w)
-            add((u >> _U64(32)).astype(_U32), w + 1)
+            arrs = [(u & _U64(0xFFFFFFFF)).astype(_U32),
+                    (u >> _U64(32)).astype(_U32)]
         elif _col_byte_size(c.dtype) == 4:
-            add(lax.bitcast_convert_type(d.astype(_I32), _U32), w)
+            arrs = [lax.bitcast_convert_type(d.astype(_I32), _U32)]
         else:
             size = _col_byte_size(c.dtype)
             native = jnp.uint8 if size == 1 else jnp.uint16
-            src = (d if d.dtype == native
-                   else lax.bitcast_convert_type(
-                       d.astype(jnp.int16 if size == 2 else jnp.int8),
-                       native))
-            add(src, w, (st % 4) * 8)
+            arrs = [d if d.dtype == native
+                    else lax.bitcast_convert_type(
+                        d.astype(jnp.int16 if size == 2 else jnp.int8),
+                        native)]
+        add(arrs, slots)
 
     for b in range((len(cols) + 7) // 8):
         off = validity_offset + b
-        add(_validity_byte_vector(cols, b), off // 4, (off % 4) * 8)
+        inputs.append(_validity_byte_vector(cols, b))
+        plan.append((off // 4, (off % 4) * 8))
 
     assert all(w < n_words for w, _ in plan)
     return inputs, plan
@@ -283,9 +304,21 @@ def convert_to_rows(table: Table) -> Column:
     mat = _assemble_fixed(cols, starts, validity_offset, max_row,
                           list(zip(var_starts, str_lens)), fixed_size)
     # paste string payloads into the padded matrix
+    use_pallas_paste = (
+        os.environ.get("SPARK_RAPIDS_TPU_PALLAS_ROWCONV") == "1"
+        and rows > 0)
     for c, vstart, lens in zip(str_cols, var_starts, str_lens):
         pad = max(1, c.max_string_length())
         chars, _ = c.to_padded_chars(pad_to=pad)
+        if use_pallas_paste:
+            # VMEM tile gather (row_assembly_pallas.py) instead of a
+            # whole-matrix HBM scatter; interpret mode on CPU
+            from spark_rapids_tpu.ops.row_assembly_pallas import \
+                paste_strings_pallas
+            mat = paste_strings_pallas(
+                mat, chars, vstart, lens,
+                interpret=jax.default_backend() == "cpu")
+            continue
         # scatter chars into mat[r, vstart[r]+j]
         j = jnp.arange(pad, dtype=_I32)
         dest = vstart[:, None] + j[None, :]
@@ -355,6 +388,21 @@ def convert_from_rows(list_col: Column, schema: Sequence[DType]) -> Table:
 
     rows = list_col.length
     starts, validity_offset, fixed_size = compute_layout(schema)
+    if (os.environ.get("SPARK_RAPIDS_TPU_PALLAS_ROWCONV") == "1"
+            and rows > 0
+            and not any(dt.is_string for dt in schema)
+            and list_col.children[0].data.dtype == jnp.uint32):
+        # single-pass tile disassembly (one HBM read of the row matrix
+        # feeds all column extractions); interpret mode on CPU.  The
+        # kernel needs uniform contiguous rows — any other buffer
+        # shape falls through to the per-row gather path below.
+        row_size = _round_up(fixed_size, JCUDF_ROW_ALIGNMENT)
+        if int(list_col.children[0].data.size) == rows * (row_size // 4):
+            from spark_rapids_tpu.ops.row_assembly_pallas import \
+                convert_from_rows_pallas
+            return convert_from_rows_pallas(
+                list_col, schema,
+                interpret=jax.default_backend() == "cpu")
     child = list_col.children[0]
     data = child.data  # flat byte buffer (u8 or packed u32 words)
     offs = list_col.offsets
