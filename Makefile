@@ -3,7 +3,8 @@
 
 PY ?= python
 
-.PHONY: test fuzz native sanitizers bench bench-all dryrun tpu-lower jni-test ci clean
+.PHONY: test fuzz native sanitizers bench bench-all dryrun tpu-lower \
+        jni-test kudo-bench nightly-artifacts ci ci-nightly clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -58,6 +59,28 @@ dryrun:
 # BENCH_FIGHT_SECONDS=1 for a quick local run.
 ci: test fuzz native sanitizers tpu-lower jni-test dryrun
 	$(PY) bench.py
+
+# multi-threaded GIL-free kudo write bench + bulk string path MB/s
+# (skips cleanly without a JVM, same contract as jni-test)
+kudo-bench:
+	@bash scripts/run_kudo_bench.sh; rc=$$?; \
+	if [ $$rc -eq 2 ]; then echo "kudo-bench: skipped (no JVM)"; \
+	elif [ $$rc -ne 0 ]; then exit $$rc; fi
+
+# nightly artifact bundle (reference nightly-build.sh deploy stage):
+# source tree snapshot + native libraries + benchmark/evidence JSON
+nightly-artifacts:
+	rm -rf dist && mkdir -p dist
+	git archive --format=tar.gz -o dist/spark-rapids-tpu-src.tar.gz HEAD
+	cp native/*.so native/jni/*.so dist/ 2>/dev/null || true
+	cp BENCH_EXTRA.json dist/ 2>/dev/null || true
+	ls -l dist/
+
+# one-command nightly gate (reference ci/nightly-build.sh:26-64):
+# the premerge set + the kudo/bulk JVM bench + the full benchmark
+# sweep + the artifact bundle.
+ci-nightly: ci kudo-bench bench-all nightly-artifacts
+	@echo "ci-nightly: all gates green"
 	@echo "ci: all gates green"
 
 clean:
