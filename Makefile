@@ -59,6 +59,7 @@ dryrun:
 # BENCH_FIGHT_SECONDS=1 for a quick local run.
 ci: test fuzz native sanitizers tpu-lower jni-test dryrun
 	$(PY) bench.py
+	@echo "ci: all gates green"
 
 # multi-threaded GIL-free kudo write bench + bulk string path MB/s
 # (skips cleanly without a JVM, same contract as jni-test)
@@ -81,7 +82,6 @@ nightly-artifacts:
 # sweep + the artifact bundle.
 ci-nightly: ci kudo-bench bench-all nightly-artifacts
 	@echo "ci-nightly: all gates green"
-	@echo "ci: all gates green"
 
 clean:
 	rm -rf native/build

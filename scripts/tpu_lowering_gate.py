@@ -77,7 +77,11 @@ def _specs():
 
     from spark_rapids_tpu.ops import (parse_uri_device, protobuf_device,
                                       raw_map_device)
-    pb_specs = ((1, 0), (2, 2), (3, 1), (4, 5))  # varint/len/f64/f32
+    # (fnum, wire, strict, repeated, cap): varint / len / f64 / f32 +
+    # a repeated varint field so the packed-mode state machine lowers
+    pb_specs = ((1, 0, False, False, 8), (2, 2, False, False, 8),
+                (3, 1, False, False, 8), (4, 5, False, False, 8),
+                (5, 0, False, True, 8))
 
     return [
         ("ftos_d2d", ftos_device._d2d, (bits64,)),

@@ -22,7 +22,11 @@ encodings, optional/required, non-string defaults, and arbitrarily
 NESTED (non-repeated) messages — a nested message is a LEN capture
 whose payload spans become a child binary column the decode recurses
 on, the masked-scan re-design of the reference's
-nested_field_descriptor walk (protobuf.hpp:26-67).  Repeated fields
+nested_field_descriptor walk (protobuf.hpp:26-67) — and REPEATED
+scalar/string fields: every occurrence lands in a per-row register
+bank (unpacked records one per step; PACKED payloads via a cursor
+state machine consuming one element per step), with rows exceeding
+the occurrence capacity falling back whole-column.  Repeated messages
 and string defaults route to the host oracle (ops/protobuf.py), which
 stays the differential reference.
 
@@ -66,19 +70,19 @@ _VARINT, _I64BIT, _LEN, _I32BIT = 0, 1, 2, 5
 
 def supported_schema(fields) -> bool:
     """True when the device engine can decode this schema: scalar
-    leaves plus arbitrarily nested (non-repeated) messages — a nested
-    message is a LEN field whose span becomes a child binary column
-    the decode recurses on (protobuf.hpp:26-67 nested_field_descriptor
-    re-designed for the masked-scan engine).  Repeated fields stay on
-    the host oracle."""
+    leaves (repeated included — packed or unpacked), strings, and
+    arbitrarily nested non-repeated messages — a nested message is a
+    LEN field whose span becomes a child binary column the decode
+    recurses on (protobuf.hpp:26-67 nested_field_descriptor
+    re-designed for the masked-scan engine).  Repeated MESSAGES stay
+    on the host oracle."""
     from spark_rapids_tpu.ops.protobuf import DEFAULT, FIXED, ZIGZAG
     for f in fields:
-        if f.repeated:
-            return False
         if f.field_number <= 0 or f.field_number >= (1 << 29):
             return False
         if f.is_message:
-            if not supported_schema(f.children):
+            # repeated messages stay on the host oracle
+            if f.repeated or not supported_schema(f.children):
                 return False
             continue
         if f.dtype.kind not in (Kind.BOOL8, Kind.INT32, Kind.INT64,
@@ -90,6 +94,13 @@ def supported_schema(fields) -> bool:
         if f.dtype.is_string and f.default is not None:
             return False
     return True
+
+
+# repeated-scalar occurrence capacity per row: rows exceeding it make
+# the whole decode fall back to the host oracle (rare; configurable)
+def _repeat_cap() -> int:
+    return int(os.environ.get(
+        "SPARK_RAPIDS_TPU_PROTOBUF_REPEAT_CAP", "32"))
 
 
 def _expected_wire(f) -> int:
@@ -157,31 +168,70 @@ def _read_fixed(chars: jnp.ndarray, pos: jnp.ndarray,
 def _decode_chunk(chars: jnp.ndarray, lens: jnp.ndarray, specs):
     """One jitted decode over a (R, L) padded byte chunk.
 
-    specs: static tuple of (field_number, expected_wire) per field.
-    Returns (malformed, [per-field (raw u64 value, seen)], and for LEN
-    fields the raw value packs (start << 32 | len))."""
+    specs: static tuple of (field_number, expected_wire, strict,
+    repeated, cap) per field.  strict fields (nested messages) malform
+    the row on a wire mismatch; repeated fields capture EVERY
+    occurrence into a (R, cap) register bank — unpacked records one
+    per step, PACKED payloads via a cursor state machine that consumes
+    one element per step inside the payload span (the host's
+    `while pos < end` loop, including its tolerated last-element
+    overrun).  Returns (malformed, per-field last-value captures,
+    seen, per-repeated-field counts, per-repeated-field value banks).
+    """
     R = chars.shape[0]
     L = chars.shape[1]
     F = len(specs)
-    max_steps = L // 2 + 2
+    rep_idx = [k for k, sp in enumerate(specs) if sp[3]]
+    any_rep = bool(rep_idx)
+    # packed varint elements can be 1 byte each: bound steps by L
+    max_steps = (L + 2) if any_rep else (L // 2 + 2)
+    cap = max([specs[k][4] for k in rep_idx], default=1)
+    lane = jnp.arange(cap, dtype=_I32)[None, :]
 
     def cond(state):
-        i, c, malformed, _vals, _seen = state
+        i, c, malformed = state[0], state[1], state[2]
         active = (~malformed) & (c < lens)
         return (i < max_steps) & jnp.any(active)
 
     def body(state):
-        i, c, malformed, vals, seen = state
+        (i, c, malformed, packed_end, packed_k, vals, seen, rcnt,
+         rvals) = state
         active = (~malformed) & (c < lens)
+        packed_now = active & (packed_end > 0)
+        norm = active & ~packed_now
 
+        # ---- packed-mode element read at c ----
+        pv_e, pn_e, pok_e = _read_varint_at(chars, c, lens)
+        f64_e = _read_fixed(chars, c, lens, 8)
+        f32_e = _read_fixed(chars, c, lens, 4)
+        elem_val = jnp.zeros(R, _U64)
+        elem_bytes = jnp.zeros(R, _I32)
+        elem_ok = jnp.zeros(R, _B)
+        for k in rep_idx:
+            ewire = specs[k][1]
+            if ewire == _LEN:
+                continue          # strings are never packed
+            mk = packed_now & (packed_k == k)
+            if ewire == _VARINT:
+                v, nb, ok = pv_e, pn_e, pok_e
+            elif ewire == _I64BIT:
+                v, nb, ok = f64_e, jnp.full(R, 8, _I32), c + 8 <= lens
+            else:
+                v, nb, ok = f32_e, jnp.full(R, 4, _I32), c + 4 <= lens
+            elem_val = jnp.where(mk, v, elem_val)
+            elem_bytes = jnp.where(mk, nb, elem_bytes)
+            elem_ok = jnp.where(mk, ok, elem_ok)
+        packed_c_new = c + elem_bytes
+        packed_exit = packed_now & (packed_c_new >= packed_end)
+        new_malformed = malformed | (packed_now & ~elem_ok)
+
+        # ---- normal tag parse (non-packed rows) ----
         tag, tlen, tag_ok = _read_varint_at(chars, c, lens)
         wire = (tag & _U64(7)).astype(_I32)
         num = (tag >> _U64(3)).astype(_I32)
         s = c + tlen
 
         pval, plen, p_ok = _read_varint_at(chars, s, lens)
-        # LEN payload length as i32 (cap: payload must fit in the row,
-        # so anything larger than L is malformed anyway)
         plen_bytes = jnp.minimum(pval, _U64(1 << 30)).astype(_I32)
 
         nxt = jnp.where(
@@ -192,13 +242,11 @@ def _decode_chunk(chars: jnp.ndarray, lens: jnp.ndarray, specs):
         wire_ok = ((wire == _VARINT) | (wire == _I64BIT)
                    | (wire == _I32BIT) | (wire == _LEN))
         need_pv = (wire == _VARINT) | (wire == _LEN)
-        # NB: field number 0 is skipped like any unknown field (host
-        # by_num.get miss), not treated as malformed
         step_ok = (tag_ok & wire_ok & (~need_pv | p_ok)
                    & (nxt <= lens))
 
-        new_malformed = malformed | (active & ~step_ok)
-        capture = active & step_ok
+        new_malformed = new_malformed | (norm & ~step_ok)
+        capture = norm & step_ok
 
         f64 = _read_fixed(chars, s, lens, 8)
         f32 = _read_fixed(chars, s, lens, 4)
@@ -207,11 +255,15 @@ def _decode_chunk(chars: jnp.ndarray, lens: jnp.ndarray, specs):
 
         new_vals = list(vals)
         new_seen = list(seen)
-        for k, (fnum, ewire, strict) in enumerate(specs):
-            match = capture & (num == fnum) & (wire == ewire)
+        new_rcnt = list(rcnt)
+        new_rvals = list(rvals)
+        new_packed_end = jnp.where(packed_exit, 0, packed_end)
+        new_packed_k = packed_k
+        c_norm = jnp.where(capture, jnp.maximum(nxt, c + 1), c)
+        for k, (fnum, ewire, strict, repeated, _cap) in \
+                enumerate(specs):
             if strict:
-                # message fields: a wire-type mismatch malforms the
-                # row (host _decode_message raises; scalars skip)
+                # message fields: wire mismatch malforms the row
                 new_malformed = new_malformed | (
                     capture & (num == fnum) & (wire != ewire))
             if ewire == _VARINT:
@@ -220,24 +272,57 @@ def _decode_chunk(chars: jnp.ndarray, lens: jnp.ndarray, specs):
                 v = f64
             elif ewire == _I32BIT:
                 v = f32
-            else:                      # LEN: start/len pack
+            else:
                 v = str_pack
-            new_vals[k] = jnp.where(match, v, vals[k])
-            new_seen[k] = seen[k] | match
+            if not repeated:
+                match = capture & (num == fnum) & (wire == ewire)
+                new_vals[k] = jnp.where(match, v, vals[k])
+                new_seen[k] = seen[k] | match
+                continue
+            r = rep_idx.index(k)
+            # occurrence capture: unpacked record OR packed element
+            rec = capture & (num == fnum) & (wire == ewire)
+            pel = packed_now & (packed_k == k) & elem_ok
+            occ = rec | pel
+            val = jnp.where(pel, elem_val, v)
+            write = (occ[:, None]
+                     & (lane == new_rcnt[r][:, None]))
+            new_rvals[r] = jnp.where(write, val[:, None],
+                                     new_rvals[r])
+            new_rcnt[r] = new_rcnt[r] + occ.astype(_I32)
+            new_seen[k] = seen[k] | occ
+            if ewire != _LEN:
+                # packed-record entry: step into the payload.  An
+                # EMPTY packed payload still marks the field seen
+                # (host: out.setdefault(num, []) runs for n=0), it
+                # just never enters the element state machine.
+                packed_rec = (capture & (num == fnum)
+                              & (wire == _LEN))
+                enter = packed_rec & (plen_bytes > 0)
+                new_packed_end = jnp.where(enter,
+                                           s + plen + plen_bytes,
+                                           new_packed_end)
+                new_packed_k = jnp.where(enter, k, new_packed_k)
+                c_norm = jnp.where(enter, s + plen, c_norm)
+                new_seen[k] = new_seen[k] | packed_rec
 
-        c_new = jnp.where(active & step_ok,
-                          jnp.maximum(nxt, c + 1), c)
-        return (i + 1, c_new, new_malformed, tuple(new_vals),
-                tuple(new_seen))
+        c_new = jnp.where(packed_now, packed_c_new, c_norm)
+        return (i + 1, c_new, new_malformed, new_packed_end,
+                new_packed_k, tuple(new_vals), tuple(new_seen),
+                tuple(new_rcnt), tuple(new_rvals))
 
     state0 = (jnp.int32(0), jnp.zeros(R, _I32), jnp.zeros(R, _B),
+              jnp.zeros(R, _I32), jnp.zeros(R, _I32),
               tuple(jnp.zeros(R, _U64) for _ in range(F)),
-              tuple(jnp.zeros(R, _B) for _ in range(F)))
-    _i, c, malformed, vals, seen = lax.while_loop(cond, body, state0)
+              tuple(jnp.zeros(R, _B) for _ in range(F)),
+              tuple(jnp.zeros(R, _I32) for _ in rep_idx),
+              tuple(jnp.zeros((R, cap), _U64) for _ in rep_idx))
+    (_i, c, malformed, _pe, _pk, vals, seen, rcnt,
+     rvals) = lax.while_loop(cond, body, state0)
     # a row that stopped before its end without being flagged is
     # impossible (cursor advances or malforms), but guard anyway
     malformed = malformed | (c < lens)
-    return malformed, vals, seen
+    return malformed, vals, seen, rcnt, rvals
 
 
 _ENGINE_CACHE = {}
@@ -250,28 +335,34 @@ def _engine(specs):
     return _ENGINE_CACHE[specs]
 
 
-def _finalize_numeric(f, raw: np.ndarray, seen: np.ndarray,
-                      rownull: np.ndarray) -> Column:
-    """Raw u64 capture -> typed column with defaults/validity."""
+def _convert_scalar_values(f, raw: np.ndarray) -> np.ndarray:
+    """Raw u64 captures -> typed numpy values (zigzag/width/sign rules
+    shared by the last-value and repeated finalizers)."""
     from spark_rapids_tpu.ops.protobuf import ZIGZAG
     kind = f.dtype.kind
     v = raw.astype(np.uint64)
     if f.encoding == ZIGZAG:
         v = (v >> np.uint64(1)) ^ (np.uint64(0) - (v & np.uint64(1)))
     if kind == Kind.BOOL8:
-        out = (v != 0).astype(np.uint8)
-    elif kind == Kind.INT32:
-        out = (v & np.uint64(0xFFFFFFFF)).astype(np.uint32) \
+        return (v != 0).astype(np.uint8)
+    if kind == Kind.INT32:
+        return (v & np.uint64(0xFFFFFFFF)).astype(np.uint32) \
             .view(np.int32)
-    elif kind == Kind.INT64:
-        out = v.view(np.int64)
-    elif kind == Kind.FLOAT32:      # payload is a 4-byte LE float
-        out = (v & np.uint64(0xFFFFFFFF)).astype(np.uint32) \
+    if kind == Kind.INT64:
+        return v.view(np.int64)
+    if kind == Kind.FLOAT32:        # payload is a 4-byte LE float
+        return (v & np.uint64(0xFFFFFFFF)).astype(np.uint32) \
             .view(np.float32)
-    elif kind == Kind.FLOAT64:
-        out = v.view(np.float64)
-    else:
-        raise AssertionError(kind)
+    if kind == Kind.FLOAT64:
+        return v.view(np.float64)
+    raise AssertionError(kind)
+
+
+def _finalize_numeric(f, raw: np.ndarray, seen: np.ndarray,
+                      rownull: np.ndarray) -> Column:
+    """Raw u64 capture -> typed column with defaults/validity."""
+    kind = f.dtype.kind
+    out = _convert_scalar_values(f, raw)
 
     has_default = f.default is not None
     if has_default:
@@ -314,8 +405,11 @@ def decode_protobuf_to_struct_device(col: Column,
     elif not col.dtype.is_string:
         return None
 
-    specs = tuple((f.field_number, _expected_wire(f), f.is_message)
+    cap = _repeat_cap()
+    specs = tuple((f.field_number, _expected_wire(f), f.is_message,
+                   f.repeated, cap)
                   for f in fields)
+    rep_idx = [k for k, f in enumerate(fields) if f.repeated]
     engine = _engine(specs)
 
     in_null = (np.zeros(rows, bool) if col.validity is None
@@ -324,6 +418,8 @@ def decode_protobuf_to_struct_device(col: Column,
     mal_parts: List[np.ndarray] = []
     val_parts: List[List[np.ndarray]] = []
     seen_parts: List[List[np.ndarray]] = []
+    rcnt_parts: List[List[np.ndarray]] = []
+    rval_parts: List[List[np.ndarray]] = []
     char_parts: List[np.ndarray] = []
     len_parts: List[np.ndarray] = []
     for c0 in range(0, rows, DEVICE_ROW_CHUNK):
@@ -333,10 +429,12 @@ def decode_protobuf_to_struct_device(col: Column,
                      offsets=col.offsets[c0:c1 + 1],
                      children=col.children)
         chars, lens = sub.to_padded_chars()
-        malformed, vals, seen = engine(chars, lens)
+        malformed, vals, seen, rcnt, rvals = engine(chars, lens)
         mal_parts.append(np.asarray(malformed))
         val_parts.append([np.asarray(v) for v in vals])
         seen_parts.append([np.asarray(s) for s in seen])
+        rcnt_parts.append([np.asarray(x) for x in rcnt])
+        rval_parts.append([np.asarray(x) for x in rvals])
         char_parts.append(np.asarray(chars))
         len_parts.append(np.asarray(lens))
 
@@ -345,15 +443,29 @@ def decode_protobuf_to_struct_device(col: Column,
              for k in range(len(fields))]
     fseen = [np.concatenate([p[k] for p in seen_parts])
              for k in range(len(fields))]
+    rcnts = [np.concatenate([p[r] for p in rcnt_parts])
+             for r in range(len(rep_idx))]
+    # occurrence-capacity overflow: the whole column falls back to the
+    # host oracle (the router treats None as "host path")
+    if any((c > cap).any() for c in rcnts):
+        return None
 
     required_missing = np.zeros(rows, bool)
     for k, f in enumerate(fields):
         if f.required:
             required_missing |= ~fseen[k]
 
+    def concat_string_parts(parts):
+        """Per-chunk string columns -> one column (char matrices have
+        differing widths, so spans resolve chunk-wise)."""
+        if len(parts) == 1:
+            return parts[0]
+        from spark_rapids_tpu.columns.table import Table
+        from spark_rapids_tpu.ops.copying import concat_tables
+        return concat_tables([Table([p]) for p in parts]).columns[0]
+
     def span_column(k, keep):
-        """LEN capture k -> string/binary column of payload spans,
-        chunk-wise (char matrices have differing widths)."""
+        """LEN capture k -> string/binary column of payload spans."""
         parts = []
         off = 0
         for ci, ch in enumerate(char_parts):
@@ -362,11 +474,7 @@ def decode_protobuf_to_struct_device(col: Column,
                 ch, len_parts[ci], val_parts[ci][k],
                 seen_parts[ci][k], ~keep[off:off + n]))
             off += n
-        if len(parts) == 1:
-            return parts[0]
-        from spark_rapids_tpu.columns.table import Table
-        from spark_rapids_tpu.ops.copying import concat_tables
-        return concat_tables([Table([p]) for p in parts]).columns[0]
+        return concat_string_parts(parts)
 
     # nested messages first: a malformed/required-missing submessage
     # nulls the WHOLE parent row (host _decode_message raises through)
@@ -377,9 +485,10 @@ def decode_protobuf_to_struct_device(col: Column,
             continue
         child_bytes = span_column(k, fseen[k])
         sub = decode_protobuf_to_struct_device(child_bytes, f.children)
-        # child col has rows == parent rows > 0 and a pre-validated
-        # schema, so the recursion can never decline
-        assert sub is not None
+        if sub is None:
+            # a nested repeated field overflowed its occurrence
+            # capacity: the whole column takes the host path
+            return None
         sub_valid = (np.ones(rows, bool) if sub.validity is None
                      else np.asarray(sub.validity).astype(bool))
         sub_bad |= fseen[k] & ~sub_valid
@@ -387,9 +496,51 @@ def decode_protobuf_to_struct_device(col: Column,
 
     rownull = in_null | malformed | required_missing | sub_bad
 
+    def repeated_column(k, f):
+        """Occurrence bank -> LIST column (host _build_column repeated
+        shape: null/malformed rows become EMPTY lists; struct-level
+        validity nulls the row)."""
+        r = rep_idx.index(k)
+        cnts = np.where(rownull, 0, rcnts[r]).astype(np.int64)
+        offsets = np.concatenate([[0], np.cumsum(cnts)]) \
+            .astype(np.int32)
+        total = int(offsets[-1])
+        row_ids = np.repeat(np.arange(rows), cnts)
+        k_of = (np.arange(total)
+                - np.repeat(offsets[:-1].astype(np.int64), cnts))
+        if f.dtype.is_string:
+            # spans are chunk-relative: resolve per chunk
+            parts = []
+            off = 0
+            for ci, ch in enumerate(char_parts):
+                n = ch.shape[0]
+                sel = (row_ids >= off) & (row_ids < off + n)
+                rid = row_ids[sel] - off
+                bank = rval_parts[ci][r]
+                packs = bank[rid, k_of[sel]]
+                starts = (packs >> np.uint64(32)).astype(np.int64)
+                slens = (packs & np.uint64(0xFFFFFFFF)) \
+                    .astype(np.int64)
+                Lc = ch.shape[1]
+                from spark_rapids_tpu.columns.strbuild import \
+                    build_string_column
+                parts.append(build_string_column(
+                    ch.reshape(-1), rid * Lc + starts, slens))
+                off += n
+            child = concat_string_parts(parts)
+        else:
+            bank = np.concatenate([p[r] for p in rval_parts])
+            flat = bank[row_ids, k_of] if total else \
+                np.zeros(0, np.uint64)
+            vals_np = _convert_scalar_values(f, flat)
+            child = Column.from_numpy(vals_np, dtype=f.dtype)
+        return Column.make_list(offsets, child)
+
     children = []
     for k, f in enumerate(fields):
-        if f.is_message:
+        if f.repeated:
+            children.append(repeated_column(k, f))
+        elif f.is_message:
             sub = sub_cols[k]
             keep = fseen[k] & ~rownull
             children.append(Column(

@@ -175,13 +175,16 @@ def test_router_uses_device(monkeypatch):
 
 
 def test_unsupported_schema_routes_host():
-    # repeated fields stay on the host oracle
-    fields = [pb.Field(1, dtypes.INT64, repeated=True, name="xs")]
+    # repeated MESSAGES stay on the host oracle (repeated scalars are
+    # device-decoded since r5)
+    inner = pb.Field(1, dtypes.INT64, name="x")
+    fields = [pb.Field(1, dtypes.STRUCT, repeated=True,
+                       children=(inner,), name="ms")]
     assert not pd.supported_schema(fields)
-    msg = tag(1, 0) + varint(3) + tag(1, 0) + varint(4)
+    msg = ld(1, tag(1, 0) + varint(3)) + ld(1, tag(1, 0) + varint(4))
     col = Column.from_strings([msg])
     out = pb.decode_protobuf_to_struct(col, fields)
-    assert out.to_pylist() == [([3, 4],)]
+    assert out.to_pylist() == [([(3,), (4,)],)]
 
 
 # ------------------------------------------------- nested messages (r5)
@@ -260,3 +263,90 @@ def test_nested_fuzz_differential():
         rng.shuffle(parts)
         msgs.append(b"".join(parts))
     _differential(msgs, NESTED)
+
+
+# ------------------------------------------ repeated fields (r5)
+
+import struct as _st
+
+REP_FIELDS = [pb.Field(1, dtypes.INT64, repeated=True, name="xs"),
+              pb.Field(2, dtypes.STRING, repeated=True, name="ss"),
+              pb.Field(3, dtypes.INT32, name="a")]
+
+
+def test_repeated_supported():
+    """Repeated scalars/strings now run on device (r5); repeated
+    messages stay host."""
+    assert pd.supported_schema(REP_FIELDS)
+    msg_rep = [pb.Field(1, dtypes.STRUCT, repeated=True,
+                        children=(pb.Field(1, dtypes.INT64, name="x"),),
+                        name="ms")]
+    assert not pd.supported_schema(msg_rep)
+
+
+def test_repeated_differential():
+    msgs = [
+        tag(1, 0) + varint(3) + tag(1, 0) + varint(4)
+        + tag(3, 0) + varint(9),                       # unpacked x2
+        ld(1, varint(1) + varint(2) + varint(300)),    # packed varint
+        ld(2, b"aa") + ld(2, b"bb") + ld(2, b""),      # rep strings
+        b"",
+        ld(1, b""),                                    # empty packed
+        tag(1, 0) + varint(7) + ld(1, varint(8) + varint(9)),  # mixed
+        tag(1, 0) + b"\xff" * 11,                      # malformed
+    ]
+    _differential(msgs, REP_FIELDS)
+
+
+def test_repeated_packed_fixed_zigzag_differential():
+    fields = [pb.Field(1, dtypes.INT64, encoding=pb.ZIGZAG,
+                       repeated=True, name="z"),
+              pb.Field(2, dtypes.FLOAT64, repeated=True, name="d"),
+              pb.Field(3, dtypes.FLOAT32, repeated=True, name="f")]
+    msgs = [
+        ld(1, varint(3) + varint(4)),
+        ld(2, _st.pack("<dd", 1.5, -2.5)),
+        ld(3, _st.pack("<ff", 0.5, 7.25)),
+        tag(2, 1) + _st.pack("<d", 9.0) + ld(2, _st.pack("<d", 3.0)),
+        ld(2, _st.pack("<d", 1.0) + b"\x01"),   # overrun: host-style
+    ]
+    _differential(msgs, fields)
+
+
+def test_repeated_capacity_overflow_falls_back(monkeypatch):
+    """A row with more occurrences than the register bank makes the
+    device decode decline (None) so the router takes the host path."""
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_PROTOBUF_REPEAT_CAP", "4")
+    pd._ENGINE_CACHE.clear()
+    msgs = [ld(1, b"".join(varint(i) for i in range(10)))]
+    col = Column.from_strings(msgs)
+    out = pd.decode_protobuf_to_struct_device(
+        col, [pb.Field(1, dtypes.INT64, repeated=True, name="xs")])
+    assert out is None
+    pd._ENGINE_CACHE.clear()
+    # host path still decodes it fully
+    host = pb.decode_protobuf_to_struct(
+        col, [pb.Field(1, dtypes.INT64, repeated=True, name="xs")])
+    assert host.to_pylist() == [(list(range(10)),)]
+
+
+def test_repeated_fuzz_differential():
+    rng = np.random.default_rng(77)
+    msgs = []
+    for _ in range(50):
+        parts = []
+        for _k in range(int(rng.integers(0, 4))):
+            parts.append(tag(1, 0) + varint(int(rng.integers(0, 500))))
+        if rng.random() < 0.5:
+            payload = b"".join(
+                varint(int(v))
+                for v in rng.integers(0, 1000, int(rng.integers(0, 6))))
+            parts.append(ld(1, payload))
+        for _k in range(int(rng.integers(0, 3))):
+            parts.append(ld(2, bytes(rng.integers(
+                97, 122, int(rng.integers(0, 6)), dtype=np.uint8))))
+        if rng.random() < 0.3:
+            parts.append(tag(3, 0) + varint(int(rng.integers(0, 99))))
+        rng.shuffle(parts)
+        msgs.append(b"".join(parts))
+    _differential(msgs, REP_FIELDS)
