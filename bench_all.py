@@ -333,6 +333,24 @@ def bench_tpcds(rows=2_000_000):
     warm = time.perf_counter() - t0
     out["q72_warm_s"] = round(warm, 4)
     out["q72_cs_rows_per_s"] = round(rows // 8 / warm)
+
+    d3 = tpcds.gen_q3(rows=rows, items=1024, days=730, brands=64)
+    q3 = tpcds.make_q3(10_957, years=3, brands=64, manufact=2)
+    jax.block_until_ready(q3(d3))
+    t0 = time.perf_counter()
+    jax.block_until_ready(q3(d3))
+    warm = time.perf_counter() - t0
+    out["q3_warm_s"] = round(warm, 4)
+    out["q3_rows_per_s"] = round(rows / warm)
+
+    d7 = tpcds.gen_q7(rows=rows, items=1024)
+    q7 = tpcds.make_q7(1024)
+    jax.block_until_ready(q7(d7))
+    t0 = time.perf_counter()
+    jax.block_until_ready(q7(d7))
+    warm = time.perf_counter() - t0
+    out["q7_warm_s"] = round(warm, 4)
+    out["q7_rows_per_s"] = round(rows / warm)
     return out
 
 

@@ -1,5 +1,6 @@
 """TPC-DS-shaped flagship pipelines (BASELINE.json configs[4] /
-north_star: "TPC-DS SF100 q5/q9/q72 end-to-end").
+north_star: "TPC-DS SF100 q5/q9/q72 end-to-end"; q3 and q7 shapes
+extend toward the q1-q10 target).
 
 Each pipeline is ONE jitted program over device arrays — scan ->
 join(s) -> filter -> group-by -> order-by — with the shapes the real
@@ -377,3 +378,175 @@ def make_q72_multichip(mesh: Mesh, items: int, max_week: int,
               in_specs=(shard, shard, shard, rep, rep, rep, rep),
               out_specs=(rep, rep, rep, rep))
     return jax.jit(fn)
+
+
+# ------------------------------------------------------------------- q3
+
+
+class Q3Data(NamedTuple):
+    s_date: jnp.ndarray    # i32 days (fact)
+    s_item: jnp.ndarray    # i32 item key
+    s_price: jnp.ndarray   # i64 decimal64(2) cents
+    d_moy: jnp.ndarray     # i32 month-of-year per day index (dense
+    #                         date dim: day d's row lives at d - base)
+    d_year: jnp.ndarray    # i32 year per day index
+    i_brand: jnp.ndarray   # i32 brand id per item key (dense item dim)
+    i_manufact: jnp.ndarray  # i32 manufacturer id per item key
+
+
+def gen_q3(rows: int = 50_000, items: int = 256, days: int = 730,
+           brands: int = 32, seed: int = 3) -> Q3Data:
+    rng = np.random.default_rng(seed)
+    base = 10_957  # 2000-01-01
+    day_idx = np.arange(days)
+    return Q3Data(
+        jnp.asarray(rng.integers(base, base + days, rows)
+                    .astype(np.int32)),
+        jnp.asarray(rng.integers(0, items, rows).astype(np.int32)),
+        jnp.asarray(rng.integers(100, 50_000, rows).astype(np.int64)),
+        jnp.asarray(((day_idx // 30) % 12 + 1).astype(np.int32)),
+        jnp.asarray((2000 + day_idx // 365).astype(np.int32)),
+        jnp.asarray(rng.integers(0, brands, items).astype(np.int32)),
+        jnp.asarray(rng.integers(0, 8, items).astype(np.int32)),
+    )
+
+
+def make_q3(base: int, years: int, brands: int, manufact: int,
+            month: int = 11, limit: int = 100):
+    """q3-shape single-jit pipeline: store_sales JOIN date_dim (dense
+    lookup, d_moy filter) JOIN item (dense lookup, manufacturer
+    filter) GROUP BY (d_year, brand) SUM(price) ORDER BY year ASC,
+    sum DESC, brand ASC LIMIT `limit`.  Rows outside the `years`-wide
+    window starting at d_year[0] are filtered (the date-dim join scope);
+    dead output slots carry the 2^31-1 year sentinel."""
+    n_groups = years * brands
+
+    @jax.jit
+    def run(d: Q3Data):
+        di = d.s_date - base
+        year_idx = d.d_year[di] - d.d_year[0]
+        keep = ((d.d_moy[di] == month)
+                & (d.i_manufact[d.s_item] == manufact)
+                & (year_idx >= 0) & (year_idx < years))
+        brand = d.i_brand[d.s_item]
+        gid = jnp.where(keep, year_idx * brands + brand, 0)
+        amt = jnp.where(keep, d.s_price, 0)
+        sums = jax.ops.segment_sum(amt, gid, num_segments=n_groups)
+        cnts = jax.ops.segment_sum(keep.astype(jnp.int64), gid,
+                                   num_segments=n_groups)
+        gidx = jnp.arange(n_groups, dtype=jnp.int64)
+        year_of_g = gidx // brands
+        brand_of_g = gidx % brands
+        sentinel = jnp.int64(2**62)
+        k1 = jnp.where(cnts > 0, year_of_g, sentinel)
+        # ORDER BY year, sum DESC, brand
+        _a, _b, _c, g_s, sum_s, cnt_s = lax.sort(
+            (k1, jnp.where(cnts > 0, -sums, sentinel), brand_of_g,
+             gidx, sums, cnts), num_keys=3)
+        live = cnt_s[:limit] > 0
+        # dead slots sentinel their year like q5/q7 (a zero-sum group
+        # is otherwise indistinguishable from padding)
+        return (jnp.where(live, g_s[:limit] // brands + d.d_year[0],
+                          jnp.int64(2**31 - 1)),
+                g_s[:limit] % brands, sum_s[:limit], jnp.sum(cnts))
+
+    return run
+
+
+def oracle_q3(d: Q3Data, base: int, brands: int, manufact: int,
+              month: int = 11, limit: int = 100):
+    h = Q3Data(*(np.asarray(x) for x in d))
+    agg = {}
+    for i in range(len(h.s_date)):
+        di = int(h.s_date[i]) - base
+        if int(h.d_moy[di]) != month:
+            continue
+        item = int(h.s_item[i])
+        if int(h.i_manufact[item]) != manufact:
+            continue
+        key = (int(h.d_year[di]), int(h.i_brand[item]))
+        agg[key] = agg.get(key, 0) + int(h.s_price[i])
+    rows = sorted(((y, -s, b) for (y, b), s in agg.items()))
+    return [(y, b, -negs) for y, negs, b in rows[:limit]]
+
+
+# ------------------------------------------------------------------- q7
+
+
+class Q7Data(NamedTuple):
+    s_item: jnp.ndarray     # i32
+    s_cdemo: jnp.ndarray    # i32 customer-demographics key
+    s_promo: jnp.ndarray    # i32 promotion key
+    s_qty: jnp.ndarray      # i64
+    s_list: jnp.ndarray     # i64 decimal64(2)
+    s_coupon: jnp.ndarray   # i64 decimal64(2)
+    s_sales: jnp.ndarray    # i64 decimal64(2)
+    cd_match: jnp.ndarray   # bool per cdemo key (gender/marital/edu)
+    p_match: jnp.ndarray    # bool per promo key (no email/event)
+    item_id: jnp.ndarray    # i32 dictionary id per item key
+
+
+def gen_q7(rows: int = 40_000, items: int = 128, demos: int = 512,
+           promos: int = 64, seed: int = 7) -> Q7Data:
+    rng = np.random.default_rng(seed)
+    return Q7Data(
+        jnp.asarray(rng.integers(0, items, rows).astype(np.int32)),
+        jnp.asarray(rng.integers(0, demos, rows).astype(np.int32)),
+        jnp.asarray(rng.integers(0, promos, rows).astype(np.int32)),
+        jnp.asarray(rng.integers(1, 100, rows).astype(np.int64)),
+        jnp.asarray(rng.integers(100, 20_000, rows).astype(np.int64)),
+        jnp.asarray(rng.integers(0, 5_000, rows).astype(np.int64)),
+        jnp.asarray(rng.integers(100, 18_000, rows).astype(np.int64)),
+        jnp.asarray((rng.random(demos) < 0.2)),
+        jnp.asarray((rng.random(promos) < 0.5)),
+        jnp.asarray(rng.permutation(items).astype(np.int32)),
+    )
+
+
+def make_q7(items: int, limit: int = 100):
+    """q7-shape single-jit pipeline: sales JOIN customer_demographics
+    (selective filter) JOIN promotion (filter) JOIN item; four AVGs
+    GROUP BY item dictionary id, ORDER BY item id LIMIT `limit` —
+    averages as exact int64 sums with one f64 divide at the edge."""
+
+    @jax.jit
+    def run(d: Q7Data):
+        keep = d.cd_match[d.s_cdemo] & d.p_match[d.s_promo]
+        iid = d.item_id[d.s_item]
+        gid = jnp.where(keep, iid, 0)
+        cnt = jax.ops.segment_sum(keep.astype(jnp.int64), gid,
+                                  num_segments=items)
+        sums = [jax.ops.segment_sum(jnp.where(keep, v, 0), gid,
+                                    num_segments=items)
+                for v in (d.s_qty, d.s_list, d.s_coupon, d.s_sales)]
+        denom = jnp.maximum(cnt, 1).astype(jnp.float64)
+        avgs = [s.astype(jnp.float64) / denom for s in sums]
+        sentinel = jnp.int64(2**62)
+        key = jnp.where(cnt > 0, jnp.arange(items, dtype=jnp.int64),
+                        sentinel)
+        key_s, c_s, a0, a1, a2, a3 = lax.sort(
+            (key, cnt, *avgs), num_keys=1)
+        return (key_s[:limit], c_s[:limit], a0[:limit], a1[:limit],
+                a2[:limit], a3[:limit])
+
+    return run
+
+
+def oracle_q7(d: Q7Data, items: int, limit: int = 100):
+    h = Q7Data(*(np.asarray(x) for x in d))
+    agg = {}
+    for i in range(len(h.s_item)):
+        if not (h.cd_match[h.s_cdemo[i]] and h.p_match[h.s_promo[i]]):
+            continue
+        iid = int(h.item_id[h.s_item[i]])
+        e = agg.setdefault(iid, [0, 0, 0, 0, 0])
+        e[0] += 1
+        e[1] += int(h.s_qty[i])
+        e[2] += int(h.s_list[i])
+        e[3] += int(h.s_coupon[i])
+        e[4] += int(h.s_sales[i])
+    out = []
+    for iid in sorted(agg)[:limit]:
+        c, q, l, cp, sl = agg[iid]
+        out.append((iid, c, q / c, l / c, cp / c, sl / c))
+    return out

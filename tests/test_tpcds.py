@@ -101,3 +101,40 @@ def test_q72_multichip(mesh8):
                          d.inv_date, d.inv_qty, d.item_id))
     want = tpcds.oracle_q72(d, ITEMS, MAX_WEEK, week0=WEEK0)
     assert got == want
+
+
+def test_q3_single_chip():
+    base = 10_957
+    d = tpcds.gen_q3(rows=6000, items=64, days=730, brands=8)
+    run = tpcds.make_q3(base, years=3, brands=8, manufact=2)
+    yrs, brands, sums, total = run(d)
+    want = tpcds.oracle_q3(d, base, brands=8, manufact=2)
+    got = [(int(y), int(b), int(s)) for y, b, s in
+           zip(np.asarray(yrs), np.asarray(brands), np.asarray(sums))
+           ][:len(want)]
+    assert got == want
+    assert (np.asarray(yrs)[len(want):] == 2**31 - 1).all()
+    h = tpcds.Q3Data(*(np.asarray(x) for x in d))  # hoist readbacks
+    assert int(total) == sum(
+        1 for i in range(6000)
+        if int(h.d_moy[int(h.s_date[i]) - base]) == 11
+        and int(h.i_manufact[int(h.s_item[i])]) == 2)
+
+
+def test_q7_single_chip():
+    d = tpcds.gen_q7(rows=8000, items=32)
+    run = tpcds.make_q7(32)
+    key, cnt, a0, a1, a2, a3 = run(d)
+    want = tpcds.oracle_q7(d, 32)
+    live = np.asarray(key) != 2**62
+    got = list(zip(np.asarray(key)[live].tolist(),
+                   np.asarray(cnt)[live].tolist(),
+                   np.asarray(a0)[live].tolist(),
+                   np.asarray(a1)[live].tolist(),
+                   np.asarray(a2)[live].tolist(),
+                   np.asarray(a3)[live].tolist()))
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        assert g[0] == w[0] and g[1] == w[1]
+        for x, y in zip(g[2:], w[2:]):
+            assert np.isclose(x, y)
