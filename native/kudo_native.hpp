@@ -88,13 +88,16 @@ inline void walk_write(const Table& t, size_t& idx, Slice sl,
     int64_t byte0 = sl.offset / 8;
     int64_t bit0 = sl.offset % 8;
     int64_t nbytes = (bit0 + sl.rows + 7) / 8;
-    for (int64_t k = 0; k < nbytes; ++k) {
-      // packed mask may be short of the sloppy slice; zero-extend
-      uint8_t b = (byte0 + k) < static_cast<int64_t>(c.validity.size())
-                      ? c.validity[byte0 + k]
-                      : 0;
-      validity.push_back(static_cast<char>(b));
+    // bulk-append the in-range slice; the packed mask may be short of
+    // the sloppy slice, so zero-extend the tail
+    int64_t avail = static_cast<int64_t>(c.validity.size()) - byte0;
+    int64_t n_in = avail < 0 ? 0 : (avail < nbytes ? avail : nbytes);
+    if (n_in > 0) {
+      validity.append(
+          reinterpret_cast<const char*>(c.validity.data()) + byte0,
+          static_cast<size_t>(n_in));
     }
+    validity.append(static_cast<size_t>(nbytes - n_in), '\0');
   }
   if (c.kind == STRING || c.kind == LIST) {
     Slice child{0, 0};

@@ -1051,6 +1051,53 @@ def build_smoke_test(outdir: str, xx_gold):
     c.invokestatic(J + "TpuColumns", "free", "(J)V")
     c.println("bulk string path ok")
 
+    # --- ai.rapids.cudf handle shapes (plugin calling convention) ---
+    CVEC = "ai/rapids/cudf/ColumnVector"
+    TBL = "ai/rapids/cudf/Table"
+    CUV, CUARR, CUT = 76, 77, 79   # vector ref / array ref / table
+    c.long_array_consts([1, 2, 3])
+    c.invokestatic(J + "TpuColumns", "fromLongs", "([J)J")
+    c.lstore(72)                 # expected column for equality
+    c.string_array(["1", "2", "3"])
+    c.invokestatic(CVEC, "fromStrings",
+                   "([Ljava/lang/String;)L" + CVEC + ";")
+    c.astore(CUV)
+    c.iconst(1)
+    c.anewarray(CVEC)
+    c.dup()
+    c.iconst(0)
+    c.aload(CUV)
+    c.aastore()
+    c.astore(CUARR)
+    c.new_obj(TBL)
+    c.dup()
+    c.aload(CUARR)
+    c.invokespecial(TBL, "<init>", "([L" + CVEC + ";)V")
+    c.astore(CUT)
+    # cast the table's column through a real op: the handle bundle is
+    # what GpuExec-shaped code passes into the jni classes
+    c.aload(CUT)
+    c.invokevirtual(TBL, "getNativeHandles", "()[J")
+    c.iconst(0)
+    c.laload()
+    c.iconst(0)                  # ansi=false
+    c.iconst(1)                  # strip=true
+    c.ldc_string("int64")
+    c.invokestatic(J + "CastStrings", "toInteger",
+                   "(JZZLjava/lang/String;)J")
+    c.lstore(74)
+    c.lload(74)
+    c.lload(72)
+    c.invokestatic(J + "TestSupport", "checkColumnsEqual", "(JJ)I")
+    assert_check("cudf Table handle bundle through CastStrings")
+    c.lload(74)
+    c.invokestatic(J + "TpuColumns", "free", "(J)V")
+    c.lload(72)
+    c.invokestatic(J + "TpuColumns", "free", "(J)V")
+    c.aload(CUT)
+    c.invokevirtual(TBL, "close", "()V")
+    c.println("cudf handle shapes ok")
+
     # --- handle hygiene ----------------------------------------------
     for h in [H_STR, 4, H_LONGS, 8, ROWS, BACK0, H_NUM, H_CAST,
               H_JSON, H_JOUT, H_UUID, H_URI, H_HOST, MERGED0, NM0,
@@ -1319,6 +1366,172 @@ def build_bufn_smoke_test(outdir: str):
         f.write(cf.serialize())
 
 
+
+def build_cudf_classes(outdir: str):
+    """Runnable ai.rapids.cudf handle classes (ColumnView /
+    ColumnVector / Table) so the plugin-facing call shapes are
+    drivable from the JVM smoke, not just documented in .java sources.
+    Emitted at major 49 (Table loops)."""
+    CV = "ai/rapids/cudf/ColumnView"
+    CVEC = "ai/rapids/cudf/ColumnVector"
+    TBL = "ai/rapids/cudf/Table"
+    J = f"{PKG}/"
+
+    # ---- ColumnView: handle field + accessor ----
+    cf = ClassFile(CV, final=False, major=49)
+    cf.add_field("handle", "J")
+    c = Code(cf.cp, max_locals=3)
+    c.aload(0)
+    c.invokespecial("java/lang/Object", "<init>", "()V")
+    c.aload(0)
+    c.lload(1)
+    c.putfield(CV, "handle", "J")
+    c.return_void()
+    cf.add_code_method("<init>", "(J)V", c, flags=ACC_PUBLIC)
+    c = Code(cf.cp, max_locals=1)
+    c.aload(0)
+    c.getfield(CV, "handle", "J")
+    c.lreturn()
+    cf.add_code_method("getNativeView", "()J", c, flags=ACC_PUBLIC)
+    path = os.path.join(outdir, CV + ".class")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(cf.serialize())
+
+    # ---- ColumnVector extends ColumnView: factories + close ----
+    cf = ClassFile(CVEC, super_name=CV, final=False, major=49)
+    c = Code(cf.cp, max_locals=3)
+    c.aload(0)
+    c.lload(1)
+    c.invokespecial(CV, "<init>", "(J)V")
+    c.return_void()
+    cf.add_code_method("<init>", "(J)V", c, flags=ACC_PUBLIC)
+    for fname, desc, native in (
+            ("fromLongs", "([J)L" + CVEC + ";", "fromLongs"),
+            ("fromStrings", "([Ljava/lang/String;)L" + CVEC + ";",
+             "fromStrings")):
+        arg = "[J" if fname == "fromLongs" else "[Ljava/lang/String;"
+        c = Code(cf.cp, max_locals=1)
+        c.new_obj(CVEC)
+        c.dup()
+        c.aload(0)
+        c.invokestatic(J + "TpuColumns", native, "(" + arg + ")J")
+        c.invokespecial(CVEC, "<init>", "(J)V")
+        c.areturn()
+        c.max_stack = max(c.max_stack, 6)
+        cf.add_code_method(fname, desc, c)
+    # close(): idempotent like the .java source (second close is a
+    # no-op, not a double release across JNI)
+    c = Code(cf.cp, max_locals=1)
+    already = Label()
+    c.aload(0)
+    c.getfield(CV, "handle", "J")
+    c.lconst(0)
+    c.lcmp()
+    c.ifeq_lbl(already)
+    c.aload(0)
+    c.getfield(CV, "handle", "J")
+    c.invokestatic(J + "TpuColumns", "free", "(J)V")
+    c.aload(0)
+    c.lconst(0)
+    c.putfield(CV, "handle", "J")
+    c.place(already)
+    c.return_void()
+    c.max_stack = max(c.max_stack, 6)
+    cf.add_code_method("close", "()V", c, flags=ACC_PUBLIC)
+    path = os.path.join(outdir, CVEC + ".class")
+    with open(path, "wb") as f:
+        f.write(cf.serialize())
+
+    # ---- Table: vector array + handle bundle ----
+    cf = ClassFile(TBL, final=False, major=49)
+    cf.add_field("columns", "[L" + CVEC + ";")
+    c = Code(cf.cp, max_locals=2)
+    c.aload(0)
+    c.invokespecial("java/lang/Object", "<init>", "()V")
+    c.aload(0)
+    c.aload(1)
+    c.putfield(TBL, "columns", "[L" + CVEC + ";")
+    c.return_void()
+    cf.add_code_method("<init>", "([L" + CVEC + ";)V", c,
+                       flags=ACC_PUBLIC)
+    c = Code(cf.cp, max_locals=2)
+    c.aload(0)
+    c.getfield(TBL, "columns", "[L" + CVEC + ";")
+    c.arraylength()
+    c.ireturn()
+    c.max_stack = max(c.max_stack, 2)
+    cf.add_code_method("getNumberOfColumns", "()I", c,
+                       flags=ACC_PUBLIC)
+    c = Code(cf.cp, max_locals=2)
+    c.aload(0)
+    c.getfield(TBL, "columns", "[L" + CVEC + ";")
+    c.iload(1)
+    c.aaload()
+    c.areturn()
+    c.max_stack = max(c.max_stack, 3)
+    cf.add_code_method("getColumn", "(I)L" + CVEC + ";", c,
+                       flags=ACC_PUBLIC)
+    # getNativeHandles: long[] of each column's view handle
+    c = Code(cf.cp, max_locals=4)  # 0=this 1=out 2=i 3=cols
+    c.aload(0)
+    c.getfield(TBL, "columns", "[L" + CVEC + ";")
+    c.astore(3)
+    c.aload(3)
+    c.arraylength()
+    c.newarray(T_LONG)
+    c.astore(1)
+    c.iconst(0)
+    c.istore(2)
+    loop, done = Label(), Label()
+    c.place(loop)
+    c.iload(2)
+    c.aload(3)
+    c.arraylength()
+    c.if_icmp("ge", done)
+    c.aload(1)
+    c.iload(2)
+    c.aload(3)
+    c.iload(2)
+    c.aaload()
+    c.invokevirtual(CV, "getNativeView", "()J")
+    c.lastore()
+    c.iinc(2, 1)
+    c.goto(loop)
+    c.place(done)
+    c.aload(1)
+    c.areturn()
+    c.max_stack = max(c.max_stack, 8)
+    cf.add_code_method("getNativeHandles", "()[J", c,
+                       flags=ACC_PUBLIC)
+    # close(): close every vector
+    c = Code(cf.cp, max_locals=4)
+    c.aload(0)
+    c.getfield(TBL, "columns", "[L" + CVEC + ";")
+    c.astore(3)
+    c.iconst(0)
+    c.istore(2)
+    loop2, done2 = Label(), Label()
+    c.place(loop2)
+    c.iload(2)
+    c.aload(3)
+    c.arraylength()
+    c.if_icmp("ge", done2)
+    c.aload(3)
+    c.iload(2)
+    c.aaload()
+    c.invokevirtual(CVEC, "close", "()V")
+    c.iinc(2, 1)
+    c.goto(loop2)
+    c.place(done2)
+    c.return_void()
+    c.max_stack = max(c.max_stack, 6)
+    cf.add_code_method("close", "()V", c, flags=ACC_PUBLIC)
+    path = os.path.join(outdir, TBL + ".class")
+    with open(path, "wb") as f:
+        f.write(cf.serialize())
+
+
 def build_kudo_bench(outdir: str):
     """KudoBench: the multi-threaded JVM shuffle-write bench over the
     GIL-free native kudo path (VERDICT r4 #1 'done' criterion: the
@@ -1529,6 +1742,7 @@ def main():
     build_smoke_test(outdir, _computed_goldens())
     build_oom_smoke_test(outdir)
     build_bufn_smoke_test(outdir)
+    build_cudf_classes(outdir)
     build_kudo_bench(outdir)
     print(f"emitted classes under {outdir}")
 

@@ -425,6 +425,9 @@ class Code:
     def ireturn(self):
         self.b.append(0xAC)
 
+    def areturn(self):
+        self.b.append(0xB0)
+
     def println(self, s: str):
         self.getstatic("java/lang/System", "out", "Ljava/io/PrintStream;")
         self.ldc_string(s)
