@@ -19,16 +19,18 @@ ftos / SHA / JSON / kudo):
 Scope of the device path (router below): scalar
 bool/int32/int64/float32/float64/string fields, DEFAULT/FIXED/ZIGZAG
 encodings, optional/required, non-string defaults, and arbitrarily
-NESTED (non-repeated) messages — a nested message is a LEN capture
-whose payload spans become a child binary column the decode recurses
-on, the masked-scan re-design of the reference's
-nested_field_descriptor walk (protobuf.hpp:26-67) — and REPEATED
+NESTED messages — a nested message is a LEN capture whose payload
+spans become a child binary column the decode recurses on, the
+masked-scan re-design of the reference's nested_field_descriptor
+walk (protobuf.hpp:26-67) — and REPEATED
 scalar/string fields: every occurrence lands in a per-row register
 bank (unpacked records one per step; PACKED payloads via a cursor
 state machine consuming one element per step), with rows exceeding
-the occurrence capacity falling back whole-column.  Repeated messages
-and string defaults route to the host oracle (ops/protobuf.py), which
-stays the differential reference.
+the occurrence capacity falling back whole-column.  Repeated
+MESSAGES recurse too: occurrence spans flatten into one child binary
+column, decode once, and wrap back as LIST<STRUCT>.  String defaults
+route to the host oracle (ops/protobuf.py), the differential
+reference for everything here.
 
 Divergence note (shared with json_device): STRING payloads pass raw
 bytes through on device while the host oracle substitutes U+FFFD for
@@ -71,18 +73,17 @@ _VARINT, _I64BIT, _LEN, _I32BIT = 0, 1, 2, 5
 def supported_schema(fields) -> bool:
     """True when the device engine can decode this schema: scalar
     leaves (repeated included — packed or unpacked), strings, and
-    arbitrarily nested non-repeated messages — a nested message is a
-    LEN field whose span becomes a child binary column the decode
-    recurses on (protobuf.hpp:26-67 nested_field_descriptor
-    re-designed for the masked-scan engine).  Repeated MESSAGES stay
-    on the host oracle."""
+    arbitrarily nested messages INCLUDING repeated ones — a nested
+    message is a LEN span (banked per occurrence when repeated) that
+    becomes a child binary column the decode recurses on
+    (protobuf.hpp:26-67 nested_field_descriptor re-designed for the
+    masked-scan engine)."""
     from spark_rapids_tpu.ops.protobuf import DEFAULT, FIXED, ZIGZAG
     for f in fields:
         if f.field_number <= 0 or f.field_number >= (1 << 29):
             return False
         if f.is_message:
-            # repeated messages stay on the host oracle
-            if f.repeated or not supported_schema(f.children):
+            if not supported_schema(f.children):
                 return False
             continue
         if f.dtype.kind not in (Kind.BOOL8, Kind.INT32, Kind.INT64,
@@ -476,12 +477,66 @@ def decode_protobuf_to_struct_device(col: Column,
             off += n
         return concat_string_parts(parts)
 
+    def occurrence_layout(r):
+        """Flat occurrence layout for repeated field r: counts keep
+        their raw values even for rows that later turn null — the
+        parent struct validity hides those lists, and a stable layout
+        lets spans/values resolve before rownull exists."""
+        cnts = rcnts[r].astype(np.int64)
+        offsets = np.concatenate([[0], np.cumsum(cnts)]) \
+            .astype(np.int32)
+        total = int(offsets[-1])
+        row_ids = np.repeat(np.arange(rows), cnts)
+        k_of = (np.arange(total)
+                - np.repeat(offsets[:-1].astype(np.int64), cnts))
+        return offsets, total, row_ids, k_of
+
+    def occurrence_strings(r, row_ids, k_of):
+        """LEN occurrence spans -> flat string/binary column
+        (chunk-relative spans resolve per chunk)."""
+        parts = []
+        off = 0
+        for ci, ch in enumerate(char_parts):
+            n = ch.shape[0]
+            sel = (row_ids >= off) & (row_ids < off + n)
+            rid = row_ids[sel] - off
+            bank = rval_parts[ci][r]
+            packs = bank[rid, k_of[sel]]
+            starts = (packs >> np.uint64(32)).astype(np.int64)
+            slens = (packs & np.uint64(0xFFFFFFFF)).astype(np.int64)
+            Lc = ch.shape[1]
+            from spark_rapids_tpu.columns.strbuild import \
+                build_string_column
+            parts.append(build_string_column(
+                ch.reshape(-1), rid * Lc + starts, slens))
+            off += n
+        return concat_string_parts(parts)
+
     # nested messages first: a malformed/required-missing submessage
-    # nulls the WHOLE parent row (host _decode_message raises through)
+    # (single or any repeated occurrence) nulls the WHOLE parent row
+    # (host _decode_message raises through)
     sub_cols: dict = {}
+    rep_msg: dict = {}
     sub_bad = np.zeros(rows, bool)
     for k, f in enumerate(fields):
         if not f.is_message:
+            continue
+        if f.repeated:
+            r = rep_idx.index(k)
+            offsets, total, row_ids, k_of = occurrence_layout(r)
+            texts = occurrence_strings(r, row_ids, k_of)
+            sub = decode_protobuf_to_struct_device(texts, f.children) \
+                if total else None
+            if total and sub is None:
+                return None    # nested occurrence-capacity overflow
+            if sub is not None:
+                occ_valid = (np.ones(total, bool)
+                             if sub.validity is None
+                             else np.asarray(sub.validity)
+                             .astype(bool))
+                bad_rows = np.unique(row_ids[~occ_valid])
+                sub_bad[bad_rows] = True
+            rep_msg[k] = (sub, offsets)
             continue
         child_bytes = span_column(k, fseen[k])
         sub = decode_protobuf_to_struct_device(child_bytes, f.children)
@@ -498,36 +553,11 @@ def decode_protobuf_to_struct_device(col: Column,
 
     def repeated_column(k, f):
         """Occurrence bank -> LIST column (host _build_column repeated
-        shape: null/malformed rows become EMPTY lists; struct-level
-        validity nulls the row)."""
+        shape: the parent struct's validity hides null rows' lists)."""
         r = rep_idx.index(k)
-        cnts = np.where(rownull, 0, rcnts[r]).astype(np.int64)
-        offsets = np.concatenate([[0], np.cumsum(cnts)]) \
-            .astype(np.int32)
-        total = int(offsets[-1])
-        row_ids = np.repeat(np.arange(rows), cnts)
-        k_of = (np.arange(total)
-                - np.repeat(offsets[:-1].astype(np.int64), cnts))
+        offsets, total, row_ids, k_of = occurrence_layout(r)
         if f.dtype.is_string:
-            # spans are chunk-relative: resolve per chunk
-            parts = []
-            off = 0
-            for ci, ch in enumerate(char_parts):
-                n = ch.shape[0]
-                sel = (row_ids >= off) & (row_ids < off + n)
-                rid = row_ids[sel] - off
-                bank = rval_parts[ci][r]
-                packs = bank[rid, k_of[sel]]
-                starts = (packs >> np.uint64(32)).astype(np.int64)
-                slens = (packs & np.uint64(0xFFFFFFFF)) \
-                    .astype(np.int64)
-                Lc = ch.shape[1]
-                from spark_rapids_tpu.columns.strbuild import \
-                    build_string_column
-                parts.append(build_string_column(
-                    ch.reshape(-1), rid * Lc + starts, slens))
-                off += n
-            child = concat_string_parts(parts)
+            child = occurrence_strings(r, row_ids, k_of)
         else:
             bank = np.concatenate([p[r] for p in rval_parts])
             flat = bank[row_ids, k_of] if total else \
@@ -536,9 +566,22 @@ def decode_protobuf_to_struct_device(col: Column,
             child = Column.from_numpy(vals_np, dtype=f.dtype)
         return Column.make_list(offsets, child)
 
+    def repeated_message_column(k, f):
+        """LIST<STRUCT> from the recursed occurrence decode."""
+        sub, offsets = rep_msg[k]
+        if sub is None:    # zero occurrences anywhere
+            # _build_column on the repeated field itself yields the
+            # correctly-typed 0-row STRUCT list child
+            from spark_rapids_tpu.ops.protobuf import _build_column
+            empty = _build_column(f, [None], 1).children[0]
+            return Column.make_list(offsets, empty)
+        return Column.make_list(offsets, sub)
+
     children = []
     for k, f in enumerate(fields):
-        if f.repeated:
+        if f.repeated and f.is_message:
+            children.append(repeated_message_column(k, f))
+        elif f.repeated:
             children.append(repeated_column(k, f))
         elif f.is_message:
             sub = sub_cols[k]

@@ -175,16 +175,12 @@ def test_router_uses_device(monkeypatch):
 
 
 def test_unsupported_schema_routes_host():
-    # repeated MESSAGES stay on the host oracle (repeated scalars are
-    # device-decoded since r5)
-    inner = pb.Field(1, dtypes.INT64, name="x")
-    fields = [pb.Field(1, dtypes.STRUCT, repeated=True,
-                       children=(inner,), name="ms")]
+    # string fields with a DEFAULT stay on the host oracle
+    fields = [pb.Field(1, dtypes.STRING, default="dflt", name="s")]
     assert not pd.supported_schema(fields)
-    msg = ld(1, tag(1, 0) + varint(3)) + ld(1, tag(1, 0) + varint(4))
-    col = Column.from_strings([msg])
+    col = Column.from_strings([b""])
     out = pb.decode_protobuf_to_struct(col, fields)
-    assert out.to_pylist() == [([(3,), (4,)],)]
+    assert out.to_pylist() == [("dflt",)]
 
 
 # ------------------------------------------------- nested messages (r5)
@@ -275,13 +271,13 @@ REP_FIELDS = [pb.Field(1, dtypes.INT64, repeated=True, name="xs"),
 
 
 def test_repeated_supported():
-    """Repeated scalars/strings now run on device (r5); repeated
-    messages stay host."""
+    """Repeated scalars/strings AND repeated messages run on device
+    (r5)."""
     assert pd.supported_schema(REP_FIELDS)
     msg_rep = [pb.Field(1, dtypes.STRUCT, repeated=True,
                         children=(pb.Field(1, dtypes.INT64, name="x"),),
                         name="ms")]
-    assert not pd.supported_schema(msg_rep)
+    assert pd.supported_schema(msg_rep)
 
 
 def test_repeated_differential():
@@ -350,3 +346,56 @@ def test_repeated_fuzz_differential():
         rng.shuffle(parts)
         msgs.append(b"".join(parts))
     _differential(msgs, REP_FIELDS)
+
+
+def test_repeated_message_differential():
+    """Repeated MESSAGES decode on device (r5): occurrence spans
+    flatten into one child column, recurse, wrap as LIST<STRUCT>."""
+    sub_f = [pb.Field(1, dtypes.INT64, name="x"),
+             pb.Field(2, dtypes.STRING, name="y")]
+    fields = [pb.Field(1, dtypes.INT64, name="a"),
+              pb.Field(2, dtypes.STRUCT, repeated=True,
+                       children=tuple(sub_f), name="ms")]
+    assert pd.supported_schema(fields)
+    sub1 = tag(1, 0) + varint(7) + ld(2, b"hi")
+    sub2 = tag(1, 0) + varint(9)
+    msgs = [
+        tag(1, 0) + varint(1) + ld(2, sub1) + ld(2, sub2),
+        tag(1, 0) + varint(2),               # none -> empty list
+        ld(2, b""),                          # one empty occurrence
+        ld(2, sub1) + tag(1, 0) + varint(3) + ld(2, sub2),
+        ld(2, tag(1, 0) + b"\xff" * 11),     # bad occurrence -> null
+        tag(2, 0) + varint(1),               # wire mismatch -> null
+        b"",
+    ]
+    _differential(msgs, fields)
+
+
+def test_repeated_message_nested_repeated_scalar():
+    """repeated message whose body holds a packed repeated scalar —
+    two recursion levels of the occurrence machinery."""
+    sub_f = [pb.Field(1, dtypes.INT64, repeated=True, name="xs")]
+    fields = [pb.Field(2, dtypes.STRUCT, repeated=True,
+                       children=tuple(sub_f), name="ms")]
+    inner1 = ld(1, varint(1) + varint(2))
+    inner2 = tag(1, 0) + varint(5)
+    msgs = [ld(2, inner1) + ld(2, inner2), ld(2, b""), b""]
+    _differential(msgs, fields)
+
+
+def test_repeated_message_all_empty():
+    """No occurrences anywhere: the LIST child must still be a 0-row
+    STRUCT of the sub-schema (not a mistyped scalar column)."""
+    sub_f = [pb.Field(1, dtypes.INT64, name="x")]
+    fields = [pb.Field(2, dtypes.STRUCT, repeated=True,
+                       children=tuple(sub_f), name="ms"),
+              pb.Field(3, dtypes.INT64, name="a")]
+    msgs = [tag(3, 0) + varint(1), b""]
+    col = Column.from_strings(msgs)
+    dev = pd.decode_protobuf_to_struct_device(col, fields)
+    assert dev is not None
+    lst = dev.children[0]
+    assert lst.dtype.kind == "list"
+    assert lst.children[0].dtype.kind == "struct"
+    assert lst.children[0].length == 0
+    _differential(msgs, fields)
