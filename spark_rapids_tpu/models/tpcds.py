@@ -419,21 +419,35 @@ def make_q3(base: int, years: int, brands: int, manufact: int,
     sum DESC, brand ASC LIMIT `limit`.  Rows outside the `years`-wide
     window starting at d_year[0] are filtered (the date-dim join scope);
     dead output slots carry the 2^31-1 year sentinel."""
-    n_groups = years * brands
+    kernel = _q3_kernel(base, years, brands, manufact, month, limit,
+                        lambda x: x)
 
     @jax.jit
     def run(d: Q3Data):
-        di = d.s_date - base
-        year_idx = d.d_year[di] - d.d_year[0]
-        keep = ((d.d_moy[di] == month)
-                & (d.i_manufact[d.s_item] == manufact)
+        return kernel(*d)
+
+    return run
+
+
+def _q3_kernel(base, years, brands, manufact, month, limit,
+               reduce_sum):
+    """Shared per-shard q3 body (see _q5_kernel)."""
+    n_groups = years * brands
+
+    def compute(s_date, s_item, s_price, d_moy, d_year, i_brand,
+                i_manufact):
+        di = s_date - base
+        year_idx = d_year[di] - d_year[0]
+        keep = ((d_moy[di] == month)
+                & (i_manufact[s_item] == manufact)
                 & (year_idx >= 0) & (year_idx < years))
-        brand = d.i_brand[d.s_item]
+        brand = i_brand[s_item]
         gid = jnp.where(keep, year_idx * brands + brand, 0)
-        amt = jnp.where(keep, d.s_price, 0)
-        sums = jax.ops.segment_sum(amt, gid, num_segments=n_groups)
-        cnts = jax.ops.segment_sum(keep.astype(jnp.int64), gid,
-                                   num_segments=n_groups)
+        amt = jnp.where(keep, s_price, 0)
+        sums = reduce_sum(jax.ops.segment_sum(
+            amt, gid, num_segments=n_groups))
+        cnts = reduce_sum(jax.ops.segment_sum(
+            keep.astype(jnp.int64), gid, num_segments=n_groups))
         gidx = jnp.arange(n_groups, dtype=jnp.int64)
         year_of_g = gidx // brands
         brand_of_g = gidx % brands
@@ -446,11 +460,29 @@ def make_q3(base: int, years: int, brands: int, manufact: int,
         live = cnt_s[:limit] > 0
         # dead slots sentinel their year like q5/q7 (a zero-sum group
         # is otherwise indistinguishable from padding)
-        return (jnp.where(live, g_s[:limit] // brands + d.d_year[0],
+        return (jnp.where(live, g_s[:limit] // brands + d_year[0],
                           jnp.int64(2**31 - 1)),
                 g_s[:limit] % brands, sum_s[:limit], jnp.sum(cnts))
 
-    return run
+    return compute
+
+
+def make_q3_multichip(mesh: Mesh, base: int, years: int, brands: int,
+                      manufact: int, month: int = 11,
+                      limit: int = 100):
+    """q3-shape on the mesh: fact sharded row-parallel, dense date and
+    item dims replicated, partial group tables psum'd over ICI."""
+    from jax import shard_map as smap
+
+    axis = mesh.axis_names[0]
+    kernel = _q3_kernel(base, years, brands, manufact, month, limit,
+                        lambda x: lax.psum(x, axis))
+    shard = P(axis)
+    rep = P()
+    fn = smap(kernel, mesh=mesh,
+              in_specs=(shard, shard, shard, rep, rep, rep, rep),
+              out_specs=(rep, rep, rep, rep))
+    return jax.jit(fn)
 
 
 def oracle_q3(d: Q3Data, base: int, brands: int, manufact: int,
@@ -509,16 +541,28 @@ def make_q7(items: int, limit: int = 100):
     GROUP BY item dictionary id, ORDER BY item id LIMIT `limit` —
     averages as exact int64 sums with one f64 divide at the edge."""
 
+    kernel = _q7_kernel(items, limit, lambda x: x)
+
     @jax.jit
     def run(d: Q7Data):
-        keep = d.cd_match[d.s_cdemo] & d.p_match[d.s_promo]
-        iid = d.item_id[d.s_item]
+        return kernel(*d)
+
+    return run
+
+
+def _q7_kernel(items, limit, reduce_sum):
+    """Shared per-shard q7 body (see _q5_kernel)."""
+
+    def compute(s_item, s_cdemo, s_promo, s_qty, s_list, s_coupon,
+                s_sales, cd_match, p_match, item_id):
+        keep = cd_match[s_cdemo] & p_match[s_promo]
+        iid = item_id[s_item]
         gid = jnp.where(keep, iid, 0)
-        cnt = jax.ops.segment_sum(keep.astype(jnp.int64), gid,
-                                  num_segments=items)
-        sums = [jax.ops.segment_sum(jnp.where(keep, v, 0), gid,
-                                    num_segments=items)
-                for v in (d.s_qty, d.s_list, d.s_coupon, d.s_sales)]
+        cnt = reduce_sum(jax.ops.segment_sum(
+            keep.astype(jnp.int64), gid, num_segments=items))
+        sums = [reduce_sum(jax.ops.segment_sum(
+            jnp.where(keep, v, 0), gid, num_segments=items))
+            for v in (s_qty, s_list, s_coupon, s_sales)]
         denom = jnp.maximum(cnt, 1).astype(jnp.float64)
         avgs = [s.astype(jnp.float64) / denom for s in sums]
         sentinel = jnp.int64(2**62)
@@ -529,7 +573,24 @@ def make_q7(items: int, limit: int = 100):
         return (key_s[:limit], c_s[:limit], a0[:limit], a1[:limit],
                 a2[:limit], a3[:limit])
 
-    return run
+    return compute
+
+
+def make_q7_multichip(mesh: Mesh, items: int, limit: int = 100):
+    """q7-shape on the mesh: facts row-sharded, filter/dictionary dims
+    replicated, partial counts/sums psum'd BEFORE the avg divide (a
+    mean of shard means would be wrong)."""
+    from jax import shard_map as smap
+
+    axis = mesh.axis_names[0]
+    kernel = _q7_kernel(items, limit, lambda x: lax.psum(x, axis))
+    shard = P(axis)
+    rep = P()
+    fn = smap(kernel, mesh=mesh,
+              in_specs=(shard, shard, shard, shard, shard, shard,
+                        shard, rep, rep, rep),
+              out_specs=(rep,) * 6)
+    return jax.jit(fn)
 
 
 def oracle_q7(d: Q7Data, items: int, limit: int = 100):

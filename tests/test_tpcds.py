@@ -138,3 +138,36 @@ def test_q7_single_chip():
         assert g[0] == w[0] and g[1] == w[1]
         for x, y in zip(g[2:], w[2:]):
             assert np.isclose(x, y)
+
+
+def test_q3_multichip(mesh8):
+    base = 10_957
+    d = tpcds.gen_q3(rows=4096, items=64, days=730, brands=8)
+    step = tpcds.make_q3_multichip(mesh8, base, years=3, brands=8,
+                                   manufact=2)
+    yrs, brands, sums, total = step(*d)
+    want = tpcds.oracle_q3(d, base, brands=8, manufact=2)
+    got = [(int(y), int(b), int(s)) for y, b, s in
+           zip(np.asarray(yrs), np.asarray(brands), np.asarray(sums))
+           ][:len(want)]
+    assert got == want
+    assert (np.asarray(yrs)[len(want):] == 2**31 - 1).all()
+    h = tpcds.Q3Data(*(np.asarray(x) for x in d))
+    assert int(total) == sum(
+        1 for i in range(4096)
+        if int(h.d_moy[int(h.s_date[i]) - base]) == 11
+        and int(h.i_manufact[int(h.s_item[i])]) == 2)
+
+
+def test_q7_multichip(mesh8):
+    d = tpcds.gen_q7(rows=4096, items=32)
+    step = tpcds.make_q7_multichip(mesh8, 32)
+    key, cnt, a0, a1, a2, a3 = step(*d)
+    want = tpcds.oracle_q7(d, 32)
+    live = np.asarray(key) != 2**62
+    assert list(np.asarray(key)[live]) == [w[0] for w in want]
+    assert list(np.asarray(cnt)[live]) == [w[1] for w in want]
+    for got_col, wi in zip((a0, a1, a2, a3), range(2, 6)):
+        for g, w in zip(np.asarray(got_col)[live],
+                        [x[wi] for x in want]):
+            assert np.isclose(g, w)
