@@ -23,7 +23,9 @@ def build_string_column(src: np.ndarray, starts: np.ndarray,
                         lens: np.ndarray,
                         valid: Optional[np.ndarray] = None,
                         host_patch: Optional[Dict[int, Optional[str]]]
-                        = None) -> Column:
+                        = None,
+                        fill_rows: Optional[np.ndarray] = None,
+                        fill_text: Optional[str] = None) -> Column:
     """STRING column from per-element spans into a flat u8 buffer.
 
     src:    flat uint8 source (flatten a padded matrix with
@@ -31,7 +33,11 @@ def build_string_column(src: np.ndarray, starts: np.ndarray,
     starts/lens: per-element spans; elements with valid=False (or a
             host_patch value of None) become null rows.
     host_patch: {index: str|None} — values produced by a host fallback
-            path, written directly into the output bytes.
+            path, written directly into the output bytes (per-row
+            Python; for RARE fallback rows).
+    fill_rows/fill_text: bool mask of rows that take the CONSTANT
+            fill_text (vectorized tile — for schema defaults that may
+            cover most of the column).
     """
     n = len(starts)
     lens = np.asarray(lens, np.int64)
@@ -40,6 +46,12 @@ def build_string_column(src: np.ndarray, starts: np.ndarray,
                 else np.asarray(valid).astype(bool).copy())
 
     byte_lens = np.where(validity, np.maximum(lens, 0), 0)
+    fill_b = None
+    if fill_rows is not None and fill_text is not None:
+        fill_rows = np.asarray(fill_rows).astype(bool)
+        fill_b = np.frombuffer(fill_text.encode("utf-8"), np.uint8)
+        validity = validity | fill_rows
+        byte_lens = np.where(fill_rows, len(fill_b), byte_lens)
     host_bytes: Dict[int, bytes] = {}
     if host_patch:
         for i, s in host_patch.items():
@@ -57,6 +69,8 @@ def build_string_column(src: np.ndarray, starts: np.ndarray,
     buf = np.zeros(total, np.uint8)
     if total:
         dev_mask = byte_lens > 0
+        if fill_b is not None:
+            dev_mask &= ~fill_rows
         for i in host_bytes:
             dev_mask[i] = False
         didx = np.nonzero(dev_mask)[0]
@@ -69,6 +83,13 @@ def build_string_column(src: np.ndarray, starts: np.ndarray,
             buf[offs[didx][seg] + within] = src[
                 np.minimum(starts[didx][seg] + within,
                            max(len(src) - 1, 0))]
+        if fill_b is not None and len(fill_b):
+            fidx = np.nonzero(fill_rows)[0]
+            if fidx.size:
+                pos = (np.repeat(offs[fidx].astype(np.int64),
+                                 len(fill_b))
+                       + np.tile(np.arange(len(fill_b)), fidx.size))
+                buf[pos] = np.tile(fill_b, fidx.size)
         for i, b in host_bytes.items():
             buf[offs[i]:offs[i] + len(b)] = np.frombuffer(b, np.uint8)
 

@@ -18,7 +18,7 @@ ftos / SHA / JSON / kudo):
 
 Scope of the device path (router below): scalar
 bool/int32/int64/float32/float64/string fields, DEFAULT/FIXED/ZIGZAG
-encodings, optional/required, non-string defaults, and arbitrarily
+encodings, optional/required, defaults (string included), and arbitrarily
 NESTED messages — a nested message is a LEN capture whose payload
 spans become a child binary column the decode recurses on, the
 masked-scan re-design of the reference's nested_field_descriptor
@@ -29,8 +29,8 @@ state machine consuming one element per step), with rows exceeding
 the occurrence capacity falling back whole-column.  Repeated
 MESSAGES recurse too: occurrence spans flatten into one child binary
 column, decode once, and wrap back as LIST<STRUCT>.  String defaults
-route to the host oracle (ops/protobuf.py), the differential
-reference for everything here.
+splice into unseen rows at finalize.  The host oracle
+(ops/protobuf.py) is the differential reference for everything here.
 
 Divergence note (shared with json_device): STRING payloads pass raw
 bytes through on device while the host oracle substitutes U+FFFD for
@@ -91,8 +91,6 @@ def supported_schema(fields) -> bool:
                                 Kind.STRING):
             return False
         if f.encoding not in (DEFAULT, FIXED, ZIGZAG):
-            return False
-        if f.dtype.is_string and f.default is not None:
             return False
     return True
 
@@ -379,15 +377,22 @@ def _finalize_numeric(f, raw: np.ndarray, seen: np.ndarray,
 
 def _finalize_string(chars: np.ndarray, lens: np.ndarray,
                      raw: np.ndarray, seen: np.ndarray,
-                     rownull: np.ndarray) -> Column:
+                     rownull: np.ndarray,
+                     default_rows: "np.ndarray | None" = None,
+                     default: "str | None" = None) -> Column:
     from spark_rapids_tpu.columns.strbuild import build_string_column
     starts = (raw >> np.uint64(32)).astype(np.int64)
     slens = (raw & np.uint64(0xFFFFFFFF)).astype(np.int64)
     L = chars.shape[1]
     rows_idx = np.arange(len(starts))
-    return build_string_column(chars.reshape(-1),
-                               rows_idx * L + starts, slens,
-                               seen & ~rownull)
+    # missing optional field with a schema default: the constant
+    # default tiles into unseen (non-null) rows — vectorized, no
+    # per-row Python even when most of the column is defaulted
+    return build_string_column(
+        chars.reshape(-1), rows_idx * L + starts, slens,
+        seen & ~rownull,
+        fill_rows=default_rows if default is not None else None,
+        fill_text=default)
 
 
 def decode_protobuf_to_struct_device(col: Column,
@@ -465,15 +470,19 @@ def decode_protobuf_to_struct_device(col: Column,
         from spark_rapids_tpu.ops.copying import concat_tables
         return concat_tables([Table([p]) for p in parts]).columns[0]
 
-    def span_column(k, keep):
-        """LEN capture k -> string/binary column of payload spans."""
+    def span_column(k, keep, default=None, default_rows=None):
+        """LEN capture k -> string/binary column of payload spans;
+        default splices into `default_rows` (unseen, non-null)."""
         parts = []
         off = 0
         for ci, ch in enumerate(char_parts):
             n = ch.shape[0]
             parts.append(_finalize_string(
                 ch, len_parts[ci], val_parts[ci][k],
-                seen_parts[ci][k], ~keep[off:off + n]))
+                seen_parts[ci][k], ~keep[off:off + n],
+                default_rows=None if default_rows is None
+                else default_rows[off:off + n],
+                default=default))
             off += n
         return concat_string_parts(parts)
 
@@ -592,7 +601,9 @@ def decode_protobuf_to_struct_device(col: Column,
                 else jnp.asarray(keep.astype(np.uint8)),
                 children=sub.children))
         elif f.dtype.is_string:
-            children.append(span_column(k, fseen[k] & ~rownull))
+            children.append(span_column(
+                k, fseen[k] & ~rownull, default=f.default,
+                default_rows=~fseen[k] & ~rownull))
         else:
             children.append(
                 _finalize_numeric(f, fvals[k], fseen[k], rownull))

@@ -175,12 +175,22 @@ def test_router_uses_device(monkeypatch):
 
 
 def test_unsupported_schema_routes_host():
-    # string fields with a DEFAULT stay on the host oracle
-    fields = [pb.Field(1, dtypes.STRING, default="dflt", name="s")]
+    # dtypes outside the device engine's set stay on the host oracle:
+    # the device decode declines (None) and the router still decodes
+    fields = [pb.Field(1, dtypes.INT64, encoding=99, name="weird")]
     assert not pd.supported_schema(fields)
-    col = Column.from_strings([b""])
-    out = pb.decode_protobuf_to_struct(col, fields)
-    assert out.to_pylist() == [("dflt",)]
+    col = Column.from_strings([tag(1, 0) + varint(7)])
+    assert pd.decode_protobuf_to_struct_device(col, fields) is None
+
+
+def test_string_default_differential():
+    """String defaults splice into unseen rows on device (r5)."""
+    fields = [pb.Field(1, dtypes.STRING, default="dflt", name="s"),
+              pb.Field(2, dtypes.INT64, name="a")]
+    assert pd.supported_schema(fields)
+    msgs = [ld(1, b"xx"), tag(2, 0) + varint(5), b"", ld(1, b""),
+            b"\xff" * 11]          # malformed: row null, no default
+    _differential(msgs, fields)
 
 
 # ------------------------------------------------- nested messages (r5)
