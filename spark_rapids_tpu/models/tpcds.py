@@ -209,6 +209,36 @@ def run_q9(quantity: jnp.ndarray, price: jnp.ndarray,
     return (jnp.stack(counts), jnp.stack(avg_p), jnp.stack(avg_n))
 
 
+def make_q9_multichip(mesh: Mesh):
+    """q9-shape on the mesh: rows sharded, the five bucket reductions
+    psum'd — sums cross ICI, the avg divide happens on the global
+    sums (a mean of shard means would be wrong)."""
+    from jax import shard_map as smap
+
+    axis = mesh.axis_names[0]
+
+    def shard_fn(quantity, price, profit):
+        counts, sp, sn = [], [], []
+        for lo, hi in _Q9_BUCKETS:
+            m = (quantity >= lo) & (quantity <= hi)
+            counts.append(lax.psum(jnp.sum(m.astype(jnp.int64)),
+                                   axis))
+            sp.append(lax.psum(jnp.sum(jnp.where(m, price, 0)),
+                               axis))
+            sn.append(lax.psum(jnp.sum(jnp.where(m, profit, 0)),
+                               axis))
+        c = jnp.stack(counts)
+        denom = jnp.maximum(c, 1).astype(jnp.float64)
+        return (c, jnp.stack(sp).astype(jnp.float64) / denom,
+                jnp.stack(sn).astype(jnp.float64) / denom)
+
+    shard = P(axis)
+    rep = P()
+    fn = smap(shard_fn, mesh=mesh, in_specs=(shard, shard, shard),
+              out_specs=(rep, rep, rep))
+    return jax.jit(fn)
+
+
 def oracle_q9(quantity, price, profit):
     q = np.asarray(quantity)
     p = np.asarray(price)

@@ -171,3 +171,14 @@ def test_q7_multichip(mesh8):
         for g, w in zip(np.asarray(got_col)[live],
                         [x[wi] for x in want]):
             assert np.isclose(g, w)
+
+
+def test_q9_multichip(mesh8):
+    q, p, n = tpcds.gen_q9(rows=4096)
+    step = tpcds.make_q9_multichip(mesh8)
+    counts, avg_p, avg_n = step(q, p, n)
+    want = tpcds.oracle_q9(q, p, n)
+    for i, (c, ap, an) in enumerate(want):
+        assert int(counts[i]) == c
+        assert np.isclose(float(avg_p[i]), ap)
+        assert np.isclose(float(avg_n[i]), an)
