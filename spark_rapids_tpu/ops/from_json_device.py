@@ -60,7 +60,7 @@ def _host_bufs(col):
     return np.asarray(col.offsets), np.asarray(col.data)
 
 
-def _host_tree(bufs, i: int, host_trees):
+def _host_tree(bufs, i: int, host_trees, allow_lz: bool = False):
     """Parse row i once (tolerant JSON), shared across all schema
     nodes; None for invalid documents."""
     from spark_rapids_tpu.ops import json_path as JP
@@ -69,7 +69,7 @@ def _host_tree(bufs, i: int, host_trees):
         doc = bytes(all_chars[offs[i]:offs[i + 1]]).decode(
             "utf-8", errors="replace")
         try:
-            host_trees[i] = JP._Parser(doc).parse()
+            host_trees[i] = JP._Parser(doc, allow_lz).parse()
         except JP._Invalid:
             host_trees[i] = None
     return host_trees[i]
@@ -86,7 +86,8 @@ def _tree_nav(tree, steps):
     return cur
 
 
-def _field_strings(col: Column, steps, padded, host_trees):
+def _field_strings(col: Column, steps, padded, host_trees,
+                   allow_lz: bool = False):
     """One leaf at struct path `steps` -> (raw string column
     (pre-typing), doc-valid mask): device spans with per-row host
     fallback.  `steps` is a list of struct field names; [] matches the
@@ -99,7 +100,7 @@ def _field_strings(col: Column, steps, padded, host_trees):
     (valid, mcount, mstart, mend, mkind, mfloat, mneg, f_ws, f_sq,
      f_escun, f_ctrl, f_anyesc, f_float, f_negz, fb) = \
         JD._scan_column(col, [JP.Named(n) for n in steps],
-                        padded=padded)
+                        padded=padded, allow_leading_zeros=allow_lz)
 
     in_valid = (np.ones(rows, bool) if col.validity is None
                 else np.asarray(col.validity).astype(bool)[:rows])
@@ -134,7 +135,7 @@ def _field_strings(col: Column, steps, padded, host_trees):
     fb_vals = {}
     bufs = (offs, all_chars)   # already host-materialized above
     for i in fb_idx:
-        tree = _host_tree(bufs, i, host_trees)
+        tree = _host_tree(bufs, i, host_trees, allow_lz)
         got = _tree_nav(tree, steps)
         fb_vals[i] = (None if got is None or got == ("lit", "null")
                       else _value_as_raw_string(got))
@@ -149,7 +150,7 @@ def _field_strings(col: Column, steps, padded, host_trees):
 
 
 def _presence(col: Column, steps, want_kind, padded, host_trees,
-              host_tag: str):
+              host_tag: str, allow_lz: bool = False):
     """Bool array: value at struct path `steps` exists and has the
     scan kind `want_kind` (K_OBJ for struct nodes, K_ARR for lists);
     rows the scan can't judge resolve via the host tree."""
@@ -159,7 +160,8 @@ def _presence(col: Column, steps, want_kind, padded, host_trees,
     rows = col.length
     (valid, mcount, mstart, mend, mkind, _mf, _mn, _fw, _fsq, _fe,
      _fc, _fa, _ff, _fz, fb) = JD._scan_column(
-        col, [JP.Named(n) for n in steps], padded=padded)
+        col, [JP.Named(n) for n in steps], padded=padded,
+        allow_leading_zeros=allow_lz)
     in_valid = (np.ones(rows, bool) if col.validity is None
                 else np.asarray(col.validity).astype(bool)[:rows])
     need_host = in_valid & (fb | (valid & (mcount > 1)))
@@ -168,12 +170,14 @@ def _presence(col: Column, steps, want_kind, padded, host_trees,
     host_idx = np.nonzero(need_host)[0]
     bufs = _host_bufs(col) if len(host_idx) else None
     for i in host_idx:
-        got = _tree_nav(_host_tree(bufs, i, host_trees), steps)
+        got = _tree_nav(_host_tree(bufs, i, host_trees, allow_lz),
+                        steps)
         present[i] = got is not None and got[0] == host_tag
     return present, valid
 
 
-def _list_column(col: Column, steps, elem_spec, padded, host_trees):
+def _list_column(col: Column, steps, elem_spec, padded, host_trees,
+                 allow_lz: bool = False):
     """LIST node at struct path `steps`: the array's verbatim span is
     located by the scan, top-level elements are split with one
     vectorized pass over the padded matrix (backslash-parity string
@@ -191,7 +195,8 @@ def _list_column(col: Column, steps, elem_spec, padded, host_trees):
     rows = col.length
     (valid, mcount, mstart, mend, mkind, _mf, _mn, _fw, f_sq, _fe,
      _fc, _fa, _ff, _fz, fb) = JD._scan_column(
-        col, [JP.Named(n) for n in steps], padded=padded)
+        col, [JP.Named(n) for n in steps], padded=padded,
+        allow_leading_zeros=allow_lz)
     chars = np.asarray(padded[0])
     lens = np.asarray(padded[1])
     R, L = chars.shape
@@ -269,7 +274,8 @@ def _list_column(col: Column, steps, elem_spec, padded, host_trees):
     host_idx = np.nonzero(need_host)[0]
     bufs = _host_bufs(col) if len(host_idx) else None
     for i in host_idx:
-        got = _tree_nav(_host_tree(bufs, i, host_trees), steps)
+        got = _tree_nav(_host_tree(bufs, i, host_trees, allow_lz),
+                        steps)
         if got is None or got[0] != "arr":
             host_elems[i] = None
         else:
@@ -308,7 +314,7 @@ def _list_column(col: Column, steps, elem_spec, padded, host_trees):
             chars.reshape(-1), child_start, child_len, dev_child,
             host_patch if host_patch else None)
         elem_col, _ = _node_column(child_texts, [], elem_spec,
-                                   None, {})
+                                   None, {}, allow_lz)
     else:
         # all arrays empty/null: typed empty child via the host
         # builder (the scan cannot run on zero rows)
@@ -320,7 +326,8 @@ def _list_column(col: Column, steps, elem_spec, padded, host_trees):
     return out, valid
 
 
-def _node_column(col: Column, steps, spec, padded, host_trees):
+def _node_column(col: Column, steps, spec, padded, host_trees,
+                 allow_lz: bool = False):
     """Schema recursion: leaf DType | ("struct", fields) |
     ("list", spec) at struct path `steps` (json_utils.hpp:10-23
     parallel-schema-vector analog: one scan per node, all rows at
@@ -331,16 +338,17 @@ def _node_column(col: Column, steps, spec, padded, host_trees):
     if padded is None:
         padded = JD._padded_with_terminator(col)
     if isinstance(spec, DType):
-        raw, valid = _field_strings(col, steps, padded, host_trees)
+        raw, valid = _field_strings(col, steps, padded, host_trees,
+                                    allow_lz)
         return convert_from_strings(raw, spec), valid
     tag, arg = spec
     if tag == "struct":
         present, valid = _presence(col, steps, JD._K_OBJ, padded,
-                                   host_trees, "obj")
+                                   host_trees, "obj", allow_lz)
         children = []
         for name, child_spec in arg:
             ch, _ = _node_column(col, list(steps) + [name], child_spec,
-                                 padded, host_trees)
+                                 padded, host_trees, allow_lz)
             children.append(ch)
         out = Column.make_struct(
             col.length, children,
@@ -348,20 +356,22 @@ def _node_column(col: Column, steps, spec, padded, host_trees):
             else present.astype(np.uint8))
         return out, valid
     if tag == "list":
-        return _list_column(col, steps, arg, padded, host_trees)
+        return _list_column(col, steps, arg, padded, host_trees,
+                            allow_lz)
     raise ValueError(f"unknown schema node {tag!r}")
 
 
 def from_json_to_structs_device(
         col: Column, fields: Sequence[Tuple[str, DType]],
         allow_leading_zeros: bool = False) -> Optional[Column]:
-    """Device from_json for flat AND nested schemas; None when the
-    host path must run (leading-zero tolerance, empty input).  Nested
+    """Device from_json for flat AND nested schemas; None only for
+    empty input (the host builder owns the zero-row shape).  Nested
     struct fields compose scan paths; list nodes split elements with a
     vectorized pass and recurse on the derived child column
     (from_json_to_structs.cu:1-959 re-designed for the one-scan TPU
-    engine)."""
-    if allow_leading_zeros or col.length == 0 or not fields:
+    engine).  allow_leading_zeros compiles a tolerant-number scan
+    variant (Spark allowNumericLeadingZeros)."""
+    if col.length == 0 or not fields:
         return None
 
     from spark_rapids_tpu.ops import json_device as JD
@@ -376,7 +386,7 @@ def from_json_to_structs_device(
     row_valid = None
     for name, spec in fields:
         child, valid = _node_column(col, [name], spec, padded,
-                                    host_trees)
+                                    host_trees, allow_leading_zeros)
         row_valid = valid if row_valid is None else row_valid
         raw_cols.append(child)
 

@@ -110,8 +110,10 @@ def _onehot_val(pv, vals, default=0) -> jnp.ndarray:
 
 
 @functools.lru_cache(maxsize=64)
-def _build_scan(path_key):
-    """jit-compiled scan specialized to one JSON path."""
+def _build_scan(path_key, allow_leading_zeros=False):
+    """jit-compiled scan specialized to one JSON path (and the
+    tolerant-number option: Spark allowNumericLeadingZeros keeps
+    `007` a valid number token — json_parser.cuh's option set)."""
     P, kinds, idxv, names = path_key
     D = MAX_NEST_TRACK
     named_f = [k == _INS_NAMED for k in kinds]
@@ -238,9 +240,12 @@ def _build_scan(path_key):
             ns = jnp.where(pstate == _N_SIGN,
                            jnp.where(c == _U8(48), _N_ZERO,
                                      jnp.where(digit, _N_DIG, _N_BAD)), ns)
+            after_zero = (jnp.where(digit, _N_DIG, _N_BAD)
+                          if allow_leading_zeros else _N_BAD)
             ns = jnp.where(pstate == _N_ZERO,
                            jnp.where(dot, _N_DOT,
-                                     jnp.where(ee, _N_E, _N_BAD)), ns)
+                                     jnp.where(ee, _N_E, after_zero)),
+                           ns)
             ns = jnp.where(pstate == _N_DIG,
                            jnp.where(digit, _N_DIG,
                                      jnp.where(dot, _N_DOT,
@@ -492,9 +497,11 @@ def _padded_with_terminator(col: Column):
     return chars, lens
 
 
-def _scan_column(col: Column, instructions, padded=None) -> List[np.ndarray]:
+def _scan_column(col: Column, instructions, padded=None,
+                 allow_leading_zeros=False) -> List[np.ndarray]:
     """Run the path-matching scan, chunked over rows; host-side results."""
-    fn = _build_scan(_compile_path(instructions))
+    fn = _build_scan(_compile_path(instructions),
+                     allow_leading_zeros)
     chars, lens = padded if padded is not None \
         else _padded_with_terminator(col)
     rows = chars.shape[0]

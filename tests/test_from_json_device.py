@@ -159,3 +159,23 @@ def test_fuzz_differential():
             doc = doc[:-1]          # truncate: invalid
         docs.append(doc)
     _differential(docs, FIELDS)
+
+
+def test_allow_leading_zeros_device():
+    """Spark allowNumericLeadingZeros compiles a tolerant-number scan
+    variant (r5) — the device path no longer declines the option."""
+    docs = ['{"a": 007}', '{"a": 7}', '{"a": 0}', '{"a": 00.5}',
+            '{"a": [01, 2]}', '{"m": {"b": 012}}', '{"a": 0x7}',
+            "bad", None]
+    col = Column.from_strings(docs)
+    for fields in [[("a", dtypes.INT64)],
+                   [("a", ("list", dtypes.INT64))],
+                   [("m", ("struct", [("b", dtypes.INT64)]))],
+                   [("a", dtypes.FLOAT64)]]:
+        for lz in (False, True):
+            dev = FJ.from_json_to_structs_device(col, fields, lz)
+            assert dev is not None
+            # public-router oracle: also exercises the lz forwarding
+            host = JU.from_json_to_structs_nested(
+                col, ("struct", fields), allow_leading_zeros=lz)
+            assert dev.to_pylist() == host.to_pylist(), (fields, lz)
