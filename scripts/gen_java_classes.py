@@ -1537,13 +1537,19 @@ def build_kudo_bench(outdir: str):
     GIL-free native kudo path (VERDICT r4 #1 'done' criterion: the
     Python route cannot scale past 1 thread; this one must).
 
-    Emits KudoBenchWorker (extends Thread; run() = writeHostTable loop,
-    NEVER entering the embedded interpreter) and KudoBench.main, which
-    builds a ~260k-row [int64, uuid-string] table, exports it once,
-    then times the SAME total number of partition writes split across
-    1/2/4/8 threads.  Output lines:
+    Emits KudoBenchWorker (extends Thread; mode 0 = writeHostTable
+    loop, mode 1 = mergeToHostTable+free loop — neither ever enters
+    the embedded interpreter) and KudoBench.main, which builds a
+    ~260k-row [int64, uuid-string] table, exports it once, then times
+    the SAME total number of partition writes split across 1/2/4/8
+    threads, a post-thread ordering-pin write, the SAME total number
+    of blob merges split across 1/8 threads, and the 10MB bulk string
+    crossing.  Output lines:
       kudo_bench bytes_per_write: <n>
       kudo_bench threads=<t> writes=<n> wall_ns: <ns>
+      post_thread_write bytes: <n>
+      kudo_merge threads=<t> merges=<n> wall_ns: <ns>
+      bulk_ingest_10MB wall_ns: <ns> / bulk_readback_10MB wall_ns: <ns>
     """
     J = f"{PKG}/"
     WORKER = f"{PKG}/KudoBenchWorker"
@@ -1559,8 +1565,11 @@ def build_kudo_bench(outdir: str):
     c.invokespecial("java/lang/Thread", "<init>", "()V")
     c.return_void()
     cf.add_code_method("<init>", "()V", c, flags=ACC_PUBLIC)
+    cf.add_field("blob", "[B")
+    cf.add_field("mode", "I")
     c = Code(cf.cp, max_locals=2)
-    loop, done = Label(), Label()
+    loop, done, merge_body, step_done = (Label(), Label(), Label(),
+                                         Label())
     c.iconst(0)
     c.istore(1)
     c.place(loop)
@@ -1569,6 +1578,11 @@ def build_kudo_bench(outdir: str):
     c.getfield(WORKER, "iters", "I")
     c.if_icmp("ge", done)
     c.aload(0)
+    c.getfield(WORKER, "mode", "I")
+    c.iconst(1)
+    c.if_icmp("eq", merge_body)
+    # mode 0: partition write
+    c.aload(0)
     c.getfield(WORKER, "table", "J")
     c.aload(0)
     c.getfield(WORKER, "off", "I")
@@ -1576,6 +1590,16 @@ def build_kudo_bench(outdir: str):
     c.getfield(WORKER, "cnt", "I")
     c.invokestatic(J + "KudoSerializer", "writeHostTable", "(JII)[B")
     c.pop_op()
+    c.goto(step_done)
+    # mode 1: merge the shared blob into a host table, free it
+    c.place(merge_body)
+    c.aload(0)
+    c.getfield(WORKER, "blob", "[B")
+    c.aload(0)
+    c.getfield(WORKER, "table", "J")
+    c.invokestatic(J + "KudoSerializer", "mergeToHostTable", "([BJ)J")
+    c.invokestatic(J + "KudoSerializer", "freeHostTable", "(J)V")
+    c.place(step_done)
     c.iinc(1, 1)
     c.goto(loop)
     c.place(done)
@@ -1675,6 +1699,65 @@ def build_kudo_bench(outdir: str):
         c.lload(TSTART)
         c.lsub()
         c.invokevirtual("java/io/PrintStream", "println", "(J)V")
+    # --- post-thread-config write: ordering pin.  Every section
+    # below MUST run before the handle cleanup at the end of main — a
+    # section pasted after the frees once produced a baffling
+    # use-after-free hunt (the "rogue free" was this bench's own
+    # freeHostTable) ------------------------------------------------
+    BLOB = 28
+    c.println("post_thread_write bytes:")
+    c.getstatic("java/lang/System", "out", "Ljava/io/PrintStream;")
+    c.lload(HT)
+    c.iconst(0)
+    c.iconst(PART)
+    c.invokestatic(J + "KudoSerializer", "writeHostTable", "(JII)[B")
+    c.arraylength()
+    c.invokevirtual("java/io/PrintStream", "println", "(I)V")
+
+    # --- merge scaling: same blob merged by 1 vs 8 threads ----------
+    MERGES = 64
+    c.lload(HT)
+    c.iconst(0)
+    c.iconst(N // 2)
+    c.invokestatic(J + "KudoSerializer", "writeHostTable", "(JII)[B")
+    c.astore(BLOB)
+    for nthreads in (1, 8):
+        m_iters = MERGES // nthreads
+        for w in range(nthreads):
+            c.new_obj(WORKER)
+            c.dup()
+            c.invokespecial(WORKER, "<init>", "()V")
+            c.dup()
+            c.iconst(1)
+            c.putfield(WORKER, "mode", "I")
+            c.dup()
+            c.aload(BLOB)
+            c.putfield(WORKER, "blob", "[B")
+            c.dup()
+            c.lload(HT)
+            c.putfield(WORKER, "table", "J")
+            c.dup()
+            c.iconst(m_iters)
+            c.putfield(WORKER, "iters", "I")
+            c.astore(WBASE + w)
+        c.invokestatic("java/lang/System", "nanoTime", "()J")
+        c.lstore(TSTART)
+        for w in range(nthreads):
+            c.aload(WBASE + w)
+            c.invokevirtual("java/lang/Thread", "start", "()V")
+        for w in range(nthreads):
+            c.aload(WBASE + w)
+            c.invokevirtual("java/lang/Thread", "join", "()V")
+        c.invokestatic("java/lang/System", "nanoTime", "()J")
+        c.lstore(TEND)
+        c.println(f"kudo_merge threads={nthreads} merges={MERGES} "
+                  "wall_ns:")
+        c.getstatic("java/lang/System", "out", "Ljava/io/PrintStream;")
+        c.lload(TEND)
+        c.lload(TSTART)
+        c.lsub()
+        c.invokevirtual("java/io/PrintStream", "println", "(J)V")
+
     c.lload(HT)
     c.invokestatic(J + "KudoSerializer", "freeHostTable", "(J)V")
     c.lload(HL)

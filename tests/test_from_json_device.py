@@ -48,6 +48,7 @@ def _differential(docs, fields):
     dev = FJ.from_json_to_structs_device(col, list(fields))
     assert dev is not None
     h, d = host.to_pylist(), dev.to_pylist()
+    assert len(h) == len(d)
     for i, (hr, dr) in enumerate(zip(h, d)):
         assert hr == dr, (f"row {i} ({docs[i]!r}):\n  host={hr!r}\n"
                           f"  dev ={dr!r}")
@@ -179,3 +180,53 @@ def test_allow_leading_zeros_device():
             host = JU.from_json_to_structs_nested(
                 col, ("struct", fields), allow_leading_zeros=lz)
             assert dev.to_pylist() == host.to_pylist(), (fields, lz)
+
+
+def test_nested_fuzz_differential():
+    """Randomized nested documents (objects/arrays to depth 3, mixed
+    leaf types, ws jitter, occasional truncation) against the host
+    oracle over three nested schemas."""
+    rng = np.random.default_rng(61)
+
+    def leaf():
+        r = rng.random()
+        if r < 0.3:
+            return str(rng.integers(-(10**6), 10**6))
+        if r < 0.5:
+            return f"{rng.normal():.4g}"
+        if r < 0.7:
+            return '"s%d"' % rng.integers(50)
+        return ["true", "false", "null"][rng.integers(3)]
+
+    def value(depth):
+        r = rng.random()
+        if depth >= 3 or r < 0.5:
+            return leaf()
+        if r < 0.75:
+            n = rng.integers(0, 4)
+            return "[" + ", ".join(value(depth + 1)
+                                   for _ in range(n)) + "]"
+        n = rng.integers(0, 3)
+        keys = ["b", "f", "g"]
+        return "{" + ", ".join(
+            '"%s": %s' % (keys[rng.integers(3)], value(depth + 1))
+            for _ in range(n)) + "}"
+
+    docs = []
+    for _ in range(120):
+        n = rng.integers(0, 4)
+        keys = ["a", "d", "e"]
+        doc = "{" + ", ".join(
+            '"%s": %s' % (keys[rng.integers(3)], value(1))
+            for _ in range(n)) + "}"
+        if rng.random() < 0.08:
+            doc = doc[:-1]
+        docs.append(doc)
+
+    for fields in [
+        [("a", ("struct", [("b", dtypes.INT64)])),
+         ("d", ("list", dtypes.INT64))],
+        [("e", ("list", ("struct", [("f", dtypes.STRING)])))],
+        [("d", ("list", ("list", dtypes.FLOAT64)))],
+    ]:
+        _differential(docs, fields)
