@@ -684,14 +684,18 @@ def kudo_write(handles: Sequence[int], row_offset: int,
                num_rows: int) -> bytes:
     """KudoSerializer.writeToStreamWithMetrics: serialize a row slice
     of a table to one kudo block (bytes cross the JNI boundary as
-    jbyteArray)."""
+    jbyteArray).  Routes through the byte-identical C++ engine when
+    built (the GIL releases for the duration of the native write);
+    the Python spec engine is the fallback and the oracle."""
     import io
 
     from spark_rapids_tpu.shim import jni_api
-    from spark_rapids_tpu.shuffle import kudo
+    from spark_rapids_tpu.shuffle import kudo, kudo_native
+    cols = jni_api._cols(handles)
+    if kudo_native.available():
+        return kudo_native.write_to_bytes(cols, row_offset, num_rows)
     out = io.BytesIO()
-    kudo.write_to_stream(jni_api._cols(handles), out, row_offset,
-                         num_rows)
+    kudo.write_to_stream(cols, out, row_offset, num_rows)
     return out.getvalue()
 
 

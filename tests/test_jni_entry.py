@@ -236,3 +236,40 @@ def test_parquet_footer_version_registry(tmp_path):
     assert 31337 in J.registry_known_threads()
     J.registry_remove_thread(31337)
     assert 31337 not in J.registry_known_threads()
+
+
+def test_export_import_kudo_host_nested_roundtrip():
+    """export_kudo_host <-> columns_from_kudo_host are exact inverses
+    for nested tables (the one-crossing marshalling the GIL-free JNI
+    host-table path rides)."""
+    import numpy as np
+
+    from spark_rapids_tpu.columns import dtypes
+    from spark_rapids_tpu.columns.column import Column
+    from spark_rapids_tpu.shim import jni_entry as je
+    from spark_rapids_tpu.shim.handles import REGISTRY
+
+    child = Column.from_pylist([1, 2, 3, 4, 5], dtypes.INT32)
+    lst = Column.make_list(np.array([0, 2, 2, 5]), child,
+                           validity=np.array([1, 0, 1]))
+    st = Column.make_struct(3, [
+        Column.from_pylist([7, None, 9], dtypes.INT64),
+        Column.from_strings(["a", None, "cc"]),
+    ], validity=np.array([1, 1, 0]))
+    dec = Column.from_pylist([10**20, None, -3],
+                             dtypes.decimal128(-1))
+    cols = [lst, st, dec]
+    handles = [REGISTRY.register(c) for c in cols]
+    try:
+        flat = je.export_kudo_host(handles)
+        assert flat[0] == 3
+        back = je.columns_from_kudo_host(flat[0], flat[2:])
+        try:
+            for h, orig in zip(back, cols):
+                assert REGISTRY.get(h).to_pylist() == orig.to_pylist()
+        finally:
+            for h in back:
+                REGISTRY.release(h)
+    finally:
+        for h in handles:
+            REGISTRY.release(h)
