@@ -641,3 +641,18 @@ def oracle_q7(d: Q7Data, items: int, limit: int = 100):
         c, q, l, cp, sl = agg[iid]
         out.append((iid, c, q / c, l / c, cp / c, sl / c))
     return out
+
+
+# --------------------------------------------------- capacity retry
+
+
+def run_with_capacity_retry(build, args, capacity: int,
+                            max_doublings: int = 16):
+    """Eager driver for the fixed-capacity pipelines: delegates to the
+    CENTRALIZED overflow-retry (parallel/exchange.with_capacity_retry
+    — per-capacity step memoization, typed CapacityExceeded, any-shape
+    overflow indicators).  The pipelines report overflow as their LAST
+    output.  Returns (outputs, capacity_used)."""
+    from spark_rapids_tpu.parallel.exchange import with_capacity_retry
+    return with_capacity_retry(build, capacity,
+                               max_doublings=max_doublings)(*args)

@@ -173,3 +173,28 @@ def test_concurrent_writes_correct():
     for th in threads:
         th.join()
     assert not errors
+
+
+def test_concurrent_merges_correct():
+    """8 threads each merging the same blob stream (ctypes releases
+    the GIL per call): every merged table must match the
+    single-threaded merge — the GIL-free merge contract."""
+    t = mk_nested_table()
+    nt = kudo_native.table_from_columns(t.columns)
+    blob = nt.write(0, 2) + nt.write(2, 2)
+    fields = schema_of_table(t)
+    want = kudo_native.merge_to_table(blob, fields).to_pylist()
+    errors = []
+
+    def worker():
+        for _ in range(10):
+            got = kudo_native.merge_to_table(blob, fields).to_pylist()
+            if got != want:
+                errors.append(got)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errors

@@ -182,3 +182,16 @@ def test_q9_multichip(mesh8):
         assert int(counts[i]) == c
         assert np.isclose(float(avg_p[i]), ap)
         assert np.isclose(float(avg_n[i]), an)
+
+
+def test_capacity_retry_driver():
+    """A deliberately tiny starting capacity grows by doubling until
+    the q72 overflow flag clears, and the result matches the oracle."""
+    d = tpcds.gen_q72(cs_rows=2000, inv_rows=2000, items=4, days=35)
+    out, cap = tpcds.run_with_capacity_retry(
+        lambda c: tpcds.make_q72(4, MAX_WEEK, join_capacity=c,
+                                 week0=WEEK0),
+        (d,), capacity=1 << 19)
+    assert cap > 1 << 19                 # it really had to grow
+    got = _q72_rows(out)
+    assert got == tpcds.oracle_q72(d, 4, MAX_WEEK, week0=WEEK0)
