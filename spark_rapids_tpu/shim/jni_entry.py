@@ -806,13 +806,19 @@ def columns_from_kudo_host(num_rows: int, flat: Sequence) -> List[int]:
 def kudo_merge(blob: bytes, type_ids: Sequence[str],
                scales: Sequence[int]) -> List[int]:
     """KudoSerializer.mergeToTable over a concatenated stream of kudo
-    blocks (flat schemas; the Python API handles nested)."""
+    blocks (flat schemas; the Python API handles nested).  Routes
+    through the C++ engine when built (GIL released for the native
+    merge); the Python spec engine is fallback and oracle."""
     import io
 
     from spark_rapids_tpu.columns.dtypes import DType
     from spark_rapids_tpu.shim.handles import REGISTRY
-    from spark_rapids_tpu.shuffle import kudo
+    from spark_rapids_tpu.shuffle import kudo, kudo_native
     from spark_rapids_tpu.shuffle.schema import Field
+    fields = [Field(DType(k, s)) for k, s in zip(type_ids, scales)]
+    if kudo_native.available():
+        table = kudo_native.merge_to_table(bytes(blob), fields)
+        return [REGISTRY.register(c) for c in table.columns]
     stream = io.BytesIO(bytes(blob))
     kts = []
     while True:
@@ -820,7 +826,6 @@ def kudo_merge(blob: bytes, type_ids: Sequence[str],
         if kt is None:
             break
         kts.append(kt)
-    fields = [Field(DType(k, s)) for k, s in zip(type_ids, scales)]
     table = kudo.merge_to_table(kts, fields)
     return [REGISTRY.register(c) for c in table.columns]
 
