@@ -51,6 +51,13 @@ public final class TpuColumns {
                                          String typeId);
 
   /**
+   * Take rows of `values` at `indices` (cudf-java Table.gather
+   * shape) — the composition primitive between a join's index
+   * columns and downstream ops.
+   */
+  public static native long gather(long values, long indices);
+
+  /**
    * Child column of a STRUCT/LIST handle (cudf-java
    * ColumnView.getChildColumnView shape); the child is a NEW handle.
    */

@@ -546,6 +546,15 @@ jbyteArray JNI_FN(TpuColumns, getStringOffsets)(JNIEnv* env, jclass,
       env, call_entry(env, "string_column_offsets", args));
 }
 
+jlong JNI_FN(TpuColumns, gather)(JNIEnv* env, jclass, jlong values,
+                                 jlong indices) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(LL)", (long long)values,
+                                 (long long)indices);
+  return as_jlong(env, call_entry(env, "gather", args));
+}
+
 void JNI_FN(TpuColumns, free)(JNIEnv* env, jclass, jlong handle) {
   if (!ensure_runtime(env)) return;
   Gil gil;

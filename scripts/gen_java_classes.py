@@ -83,6 +83,7 @@ NATIVE_CLASSES = {
         ("getStringOffsets", "(J)[B"),
         ("fromDecimals", "([JILjava/lang/String;)J"),
         ("getChild", "(JI)J"),
+        ("gather", "(JJ)J"),
         ("free", "(J)V"),
     ],
     "DecimalUtils": [
@@ -951,6 +952,48 @@ def build_smoke_test(outdir: str, xx_gold):
     c.invokestatic(J + "RmmSpark", "taskDone", "(J)V")
     c.invokestatic(J + "RmmSpark", "clearEventHandler", "()V")
     c.println("RmmSpark register/taskDone ok")
+
+    # --- GpuExec-shaped composition: join -> gather -> aggregate, all
+    # through JVM handles (the north-star calling pattern) ----------
+    MQPAIRS, MQL, MQLI, MQRI, MQGV = 71, 72, 74, 76, 78
+    # (past every section still live at hygiene time; reused later by
+    # the list/bulk/cudf sections after these frees)
+    c.long_array_consts([10, 20, 30])         # left values keyed 1,2,3
+    c.invokestatic(J + "TpuColumns", "fromLongs", "([J)J")
+    c.lstore(MQL)
+    # join left keys [1,2,3] (H_LONGS) with right keys [2,3,4] (H_RK)
+    c.long_array_locals([H_LONGS])
+    c.long_array_locals([H_RK])
+    c.iconst(0)
+    c.invokestatic(J + "JoinPrimitives", "sortMergeInnerJoin",
+                   "([J[JZ)[J")
+    c.astore(MQPAIRS)
+    c.aload(MQPAIRS)
+    c.iconst(0)
+    c.laload()
+    c.lstore(MQLI)
+    c.aload(MQPAIRS)
+    c.iconst(1)
+    c.laload()
+    c.lstore(MQRI)
+    # gather the left values at the join's left indices -> [20, 30]
+    c.lload(MQL)
+    c.lload(MQLI)
+    c.invokestatic(J + "TpuColumns", "gather", "(JJ)J")
+    c.lstore(MQGV)
+    c.lload(MQGV)
+    c.long_array_consts([20, 30])
+    c.invokestatic(J + "TestSupport", "checkLongColumn", "(J[J)I")
+    assert_check("join->gather composition")
+    c.lload(MQL)
+    c.invokestatic(J + "TpuColumns", "free", "(J)V")
+    c.lload(MQLI)
+    c.invokestatic(J + "TpuColumns", "free", "(J)V")
+    c.lload(MQRI)
+    c.invokestatic(J + "TpuColumns", "free", "(J)V")
+    c.lload(MQGV)
+    c.invokestatic(J + "TpuColumns", "free", "(J)V")
+    c.println("join->gather composition ok")
 
     # --- list slice + ORC tz + device telemetry surface (r5) --------
     LSTC, SLICED = 72, 74     # long slots 72-73, 74-75 (past all

@@ -158,6 +158,17 @@ def free(handle: int) -> None:
     jni_api.release_column(handle)
 
 
+def gather(values_handle: int, indices_handle: int) -> int:
+    """TpuColumns.gather: take rows of `values` at `indices` (the
+    composition primitive GpuExec-shaped plans use between a join's
+    index columns and downstream ops)."""
+    from spark_rapids_tpu.ops import copying
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    vals = REGISTRY.get(values_handle)
+    idx = REGISTRY.get(indices_handle)
+    return REGISTRY.register(copying.gather(vals, idx.data))
+
+
 def column_to_host(handle: int):
     from spark_rapids_tpu.shim import jni_api
     return jni_api.column_to_host(handle)
