@@ -146,10 +146,15 @@ class NativeKudoTable:
         self.fields = fields
 
     def __del__(self):
-        lib = _load()
-        if lib is not None and self._handle:
-            lib.kudo_table_free(self._handle)
-            self._handle = 0
+        # interpreter teardown may have cleared module globals; the
+        # OS reclaims the native memory then anyway
+        try:
+            lib = _lib
+            if lib is not None and self._handle:
+                lib.kudo_table_free(self._handle)
+        except Exception:
+            pass
+        self._handle = 0
 
     @property
     def num_rows(self) -> int:
