@@ -9,13 +9,15 @@ mkdir -p build
 echo "== ASAN+UBSAN =="
 g++ -std=c++17 -g -O1 -fsanitize=address,undefined \
     -fno-sanitize-recover=all \
-    sanitizer_check.cpp spark_resource_adaptor.cpp columnar_native.cpp \
+    sanitizer_check.cpp kudo_sanitizer_check.cpp kudo_cabi.cpp \
+    spark_resource_adaptor.cpp columnar_native.cpp \
     -o build/sanitizer_check_asan -lpthread
 ./build/sanitizer_check_asan
 
 echo "== TSAN =="
 g++ -std=c++17 -g -O1 -fsanitize=thread \
-    sanitizer_check.cpp spark_resource_adaptor.cpp columnar_native.cpp \
+    sanitizer_check.cpp kudo_sanitizer_check.cpp kudo_cabi.cpp \
+    spark_resource_adaptor.cpp columnar_native.cpp \
     -o build/sanitizer_check_tsan -lpthread
 ./build/sanitizer_check_tsan
 

@@ -83,10 +83,13 @@ static void check_adaptor_cross_thread() {
   sra_destroy(h);
 }
 
+int run_kudo_sanitizer_check();   // kudo_sanitizer_check.cpp
+
 int main() {
   check_rank_strings();
   check_adaptor_single();
   for (int i = 0; i < 20; ++i) check_adaptor_cross_thread();
+  if (run_kudo_sanitizer_check() != 0) return 1;
   std::puts("sanitizer_check: OK");
   return 0;
 }
