@@ -1148,6 +1148,16 @@ def build_smoke_test(outdir: str, xx_gold):
               H_MP0, H_DA, H_DB, H_DR0, H_DR1]:
         c.lload(h)
         c.invokestatic(J + "TpuColumns", "free", "(J)V")
+    # leak check: every handle any section created must be freed
+    no_leak = Label()
+    c.invokestatic(J + "TpuRuntime", "liveHandles", "()I")
+    c.ifeq_lbl(no_leak)
+    c.iconst(0)
+    c.ldc_string("handle leak: liveHandles != 0 before shutdown")
+    c.invokestatic(J + "TestSupport", "assertTrue",
+                   "(ILjava/lang/String;)V")
+    c.place(no_leak)
+    c.println("handle hygiene: zero leaks")
     c.invokestatic(J + "TpuRuntime", "shutdown", "()V")
 
     c.println("JNI smoke: ALL OK")
