@@ -995,6 +995,37 @@ def build_smoke_test(outdir: str, xx_gold):
     c.invokestatic(J + "TpuColumns", "free", "(J)V")
     c.println("join->gather composition ok")
 
+    # --- HLL++ sketch reduce/estimate over JNI (golden from the
+    # Python engine at emission time — deterministic) ---------------
+    from spark_rapids_tpu.columns import dtypes as _dt
+    from spark_rapids_tpu.columns.column import Column as _Col
+    from spark_rapids_tpu.ops import hllpp as _hll
+    _hcol = _Col.from_pylist(list(range(200)), _dt.INT64)
+    _est = int(_hll.estimate_from_hll_sketches(
+        _hll.reduce_hllpp(_hcol, 9), 9).to_pylist()[0])
+    HLC, HLS, HLE = 72, 74, 76
+    c.long_array_consts(list(range(200)))
+    c.invokestatic(J + "TpuColumns", "fromLongs", "([J)J")
+    c.lstore(HLC)
+    c.lload(HLC)
+    c.iconst(9)
+    c.invokestatic(J + "HyperLogLogPlusPlusHostUDF", "reduce",
+                   "(JI)J")
+    c.lstore(HLS)
+    c.lload(HLS)
+    c.iconst(9)
+    c.invokestatic(J + "HyperLogLogPlusPlusHostUDF", "estimate",
+                   "(JI)J")
+    c.lstore(HLE)
+    c.lload(HLE)
+    c.long_array_consts([_est])
+    c.invokestatic(J + "TestSupport", "checkLongColumn", "(J[J)I")
+    assert_check("HLL++ estimate golden")
+    for slot in (HLC, HLS, HLE):
+        c.lload(slot)
+        c.invokestatic(J + "TpuColumns", "free", "(J)V")
+    c.println("hllpp reduce/estimate ok (golden %d)" % _est)
+
     # --- list slice + ORC tz + device telemetry surface (r5) --------
     LSTC, SLICED = 72, 74     # long slots 72-73, 74-75 (past all
     #                            sections still live at hygiene time)
