@@ -1716,6 +1716,18 @@ jint JNI_FN(TestSupport, checkColumnsEqual)(JNIEnv* env, jclass,
   return as_jint(env, call_entry(env, "check_columns_equal", args));
 }
 
+jlong JNI_FN(TestSupport, makeMapColumn)(JNIEnv* env, jclass,
+                                         jintArray offsets,
+                                         jobjectArray keys,
+                                         jobjectArray values) {
+  if (!ensure_runtime(env)) return 0;
+  Gil gil;
+  PyObject* args = Py_BuildValue(
+      "(NNN)", ints_to_pylist(env, offsets),
+      strings_to_pylist(env, keys), strings_to_pylist(env, values));
+  return as_jlong(env, call_entry(env, "make_map_column", args));
+}
+
 jlong JNI_FN(TestSupport, makeListOfInts)(JNIEnv* env, jclass,
                                           jintArray offsets,
                                           jlongArray values) {

@@ -281,6 +281,8 @@ NATIVE_CLASSES = {
         ("checkStringColumn", "(J[Ljava/lang/String;)I"),
         ("checkColumnsEqual", "(JJ)I"),
         ("makeListOfInts", "([I[J)J"),
+        ("makeMapColumn",
+         "([I[Ljava/lang/String;[Ljava/lang/String;)J"),
     ],
 }
 
@@ -1026,6 +1028,9 @@ def build_smoke_test(outdir: str, xx_gold):
         c.invokestatic(J + "TpuColumns", "free", "(J)V")
     c.println("hllpp reduce/estimate ok (golden %d)" % _est)
 
+    _emit_surface_sweep(c, J, assert_check, H_LONGS, H_NUM, H_STR,
+                        H_URI, H_DA, H_DB, BF, BF2)
+
     # --- list slice + ORC tz + device telemetry surface (r5) --------
     LSTC, SLICED = 72, 74     # long slots 72-73, 74-75 (past all
     #                            sections still live at hygiene time)
@@ -1614,6 +1619,851 @@ def build_cudf_classes(outdir: str):
     path = os.path.join(outdir, TBL + ".class")
     with open(path, "wb") as f:
         f.write(cf.serialize())
+
+
+
+def _emit_surface_sweep(c, J, assert_check, H_LONGS, H_NUM, H_STR,
+                        H_URI, H_DA, H_DB, BF, BF2):
+    """Drive every remaining declared native once, with goldens
+    computed AT EMISSION TIME by the same runtime engines the JVM
+    call reaches (the xxhash-golden pattern, generalized).  Temp
+    handles live in slots 71-79 and are freed per block."""
+    from spark_rapids_tpu.shim import jni_entry as _je
+    from spark_rapids_tpu.shim.handles import REGISTRY as _R
+
+    def _vals(h, release=True):
+        v = _R.get(h).to_pylist()
+        if release:
+            _R.release(h)
+        return v
+
+    T1, T2, T3, T4 = 72, 74, 76, 78   # long slots
+    REF = 71
+
+    def free(slot):
+        c.lload(slot)
+        c.invokestatic(J + "TpuColumns", "free", "(J)V")
+
+    # mirror handles for the live smoke columns
+    m_longs = _je.from_longs([1, 2, 3])
+    m_num = _je.from_strings(["123", "-45", "999"])
+    m_uri = _je.from_strings(["https://h.example.com/p?a=1"])
+
+    # -- fromInts round trip --
+    c.int_array([7, -8])
+    c.invokestatic(J + "TpuColumns", "fromInts", "([I)J")
+    c.lstore(T1)
+    c.lload(T1)
+    c.int_array([7, -8])
+    c.invokestatic(J + "TestSupport", "checkIntColumn", "(J[I)I")
+    assert_check("fromInts round trip")
+    free(T1)
+
+    # -- fromDoubles -> Arithmetic.round -> fromFloat chain --
+    m_d = _je.from_doubles([1.25, -2.675, 3.14159])
+    m_r = _je.arithmetic_round(m_d, 1, "HALF_UP")
+    m_s = _je.float_to_string(m_r)
+    gold_round = _vals(m_s)
+    _R.release(m_d)
+    _R.release(m_r)
+    # emit double[] constants: jasm lacks a double-array helper, so
+    # store raw bits through long array + Double.longBitsToDouble is
+    # overkill — build via newarray double + dastore with ldc2_w bits
+    c.double_array([1.25, -2.675, 3.14159])
+    c.invokestatic(J + "TpuColumns", "fromDoubles", "([D)J")
+    c.lstore(T1)
+    c.lload(T1)
+    c.iconst(1)
+    c.ldc_string("HALF_UP")
+    c.invokestatic(J + "Arithmetic", "round",
+                   "(JILjava/lang/String;)J")
+    c.lstore(T2)
+    c.lload(T2)
+    c.invokestatic(J + "CastStrings", "fromFloat", "(J)J")
+    c.lstore(T3)
+    c.lload(T3)
+    c.string_array(gold_round)
+    c.invokestatic(J + "TestSupport", "checkStringColumn",
+                   "(J[Ljava/lang/String;)I")
+    assert_check("fromDoubles->round->fromFloat")
+    free(T1)
+    free(T2)
+    free(T3)
+
+    # -- hiveHash --
+    gold_hive = _vals(_je.hive_hash([m_longs]))
+    c.long_array_locals([H_LONGS])
+    c.invokestatic(J + "Hash", "hiveHash", "([J)J")
+    c.lstore(T1)
+    c.lload(T1)
+    c.int_array(gold_hive)
+    c.invokestatic(J + "TestSupport", "checkIntColumn", "(J[I)I")
+    assert_check("Hash.hiveHash")
+    free(T1)
+
+    # -- toFloat -> fromFloat --
+    m_f = _je.string_to_float(m_num, "float64", False)
+    gold_tf = _vals(_je.float_to_string(m_f))
+    _R.release(m_f)
+    c.lload(H_NUM)
+    c.iconst(0)
+    c.ldc_string("float64")
+    c.invokestatic(J + "CastStrings", "toFloat",
+                   "(JZLjava/lang/String;)J")
+    c.lstore(T1)
+    c.lload(T1)
+    c.invokestatic(J + "CastStrings", "fromFloat", "(J)J")
+    c.lstore(T2)
+    c.lload(T2)
+    c.string_array(gold_tf)
+    c.invokestatic(J + "TestSupport", "checkStringColumn",
+                   "(J[Ljava/lang/String;)I")
+    assert_check("toFloat->fromFloat")
+    free(T1)
+    free(T2)
+
+    # -- toDate --
+    m_ds = _je.from_strings(["2020-01-02", "1999-12-31"])
+    gold_date = _vals(_je.cast_strings_to_date(m_ds, False))
+    _R.release(m_ds)
+    gold_date_days = [v if isinstance(v, int) else
+                      (v.toordinal() - 719163) for v in gold_date]
+    c.string_array(["2020-01-02", "1999-12-31"])
+    c.invokestatic(J + "TpuColumns", "fromStrings",
+                   "([Ljava/lang/String;)J")
+    c.lstore(T1)
+    c.lload(T1)
+    c.iconst(0)
+    c.invokestatic(J + "CastStrings", "toDate", "(JZ)J")
+    c.lstore(T2)
+    c.lload(T2)
+    c.int_array(gold_date_days)
+    c.invokestatic(J + "TestSupport", "checkIntColumn", "(J[I)I")
+    assert_check("CastStrings.toDate")
+    free(T1)
+    free(T2)
+
+    # -- fromLongToBinary + formatNumber --
+    gold_bin = _vals(_je.long_to_binary_string(m_longs))
+    c.lload(H_LONGS)
+    c.invokestatic(J + "CastStrings", "fromLongToBinary", "(J)J")
+    c.lstore(T1)
+    c.lload(T1)
+    c.string_array(gold_bin)
+    c.invokestatic(J + "TestSupport", "checkStringColumn",
+                   "(J[Ljava/lang/String;)I")
+    assert_check("CastStrings.fromLongToBinary")
+    free(T1)
+    gold_fmt = _vals(_je.format_number(m_longs, 2))
+    c.lload(H_LONGS)
+    c.iconst(2)
+    c.invokestatic(J + "CastStrings", "formatNumber", "(JI)J")
+    c.lstore(T1)
+    c.lload(T1)
+    c.string_array(gold_fmt)
+    c.invokestatic(J + "TestSupport", "checkStringColumn",
+                   "(J[Ljava/lang/String;)I")
+    assert_check("CastStrings.formatNumber")
+    free(T1)
+
+    # -- histogram create + percentile (through fromFloat) --
+    m_v = _je.from_longs([10, 20, 30])
+    m_fq = _je.from_longs([1, 2, 1])
+    m_h = _je.histogram_create(m_v, m_fq)
+    m_p = _je.histogram_percentile(m_h, [0.5])   # LIST<FLOAT64>
+    m_pc = _je.struct_child(m_p, 0)
+    gold_pct = _vals(_je.float_to_string(m_pc))
+    for h in (m_v, m_fq, m_h, m_p, m_pc):
+        _R.release(h)
+    c.long_array_consts([10, 20, 30])
+    c.invokestatic(J + "TpuColumns", "fromLongs", "([J)J")
+    c.lstore(T1)
+    c.long_array_consts([1, 2, 1])
+    c.invokestatic(J + "TpuColumns", "fromLongs", "([J)J")
+    c.lstore(T2)
+    c.lload(T1)
+    c.lload(T2)
+    c.invokestatic(J + "Histogram", "createHistogramIfValid",
+                   "(JJ)J")
+    c.lstore(T3)
+    c.lload(T3)
+    c.double_array([0.5])
+    c.invokestatic(J + "Histogram", "percentileFromHistogram",
+                   "(J[D)J")
+    c.lstore(T4)
+    free(T1)
+    free(T2)                       # inputs done; reuse T1/T2 below
+    c.lload(T4)
+    c.iconst(0)
+    c.invokestatic(J + "TpuColumns", "getChild", "(JI)J")
+    c.lstore(T2)
+    c.lload(T2)
+    c.invokestatic(J + "CastStrings", "fromFloat", "(J)J")
+    c.lstore(T1)
+    c.lload(T1)
+    c.string_array(gold_pct)
+    c.invokestatic(J + "TestSupport", "checkStringColumn",
+                   "(J[Ljava/lang/String;)I")
+    assert_check("Histogram percentile")
+    free(T3)
+    free(T4)
+    free(T2)
+    free(T1)
+    c.println("surface sweep 1 ok")
+
+
+    # ================= sweep part 2 =================
+    # -- ParseURI remaining extractors --
+    for meth, entry_args, gold in [
+            ("parseProtocol", ("protocol",), None),
+            ("parseQuery", ("query",), None),
+            ("parsePath", ("path",), None)]:
+        g = _vals(_je.parse_uri(m_uri, entry_args[0], False))
+        c.lload(H_URI)
+        c.iconst(0)
+        c.invokestatic(J + "ParseURI", meth, "(JZ)J")
+        c.lstore(T1)
+        c.lload(T1)
+        c.string_array(g)
+        c.invokestatic(J + "TestSupport", "checkStringColumn",
+                       "(J[Ljava/lang/String;)I")
+        assert_check("ParseURI." + meth)
+        free(T1)
+    g = _vals(_je.parse_uri_query_with_key(m_uri, "a", False))
+    c.lload(H_URI)
+    c.ldc_string("a")
+    c.iconst(0)
+    c.invokestatic(J + "ParseURI", "parseQueryWithKey",
+                   "(JLjava/lang/String;Z)J")
+    c.lstore(T1)
+    c.lload(T1)
+    c.string_array(g)
+    c.invokestatic(J + "TestSupport", "checkStringColumn",
+                   "(J[Ljava/lang/String;)I")
+    assert_check("ParseURI.parseQueryWithKey")
+    free(T1)
+
+    # -- substringIndex / NumberConverter / RegexRewriteUtils on the
+    # murmur string column --
+    m_str = _je.from_strings(MURMUR_IN)
+    g = _vals(_je.substring_index(m_str, "a", 1))
+    c.lload(H_STR)
+    c.ldc_string("a")
+    c.iconst(1)
+    c.invokestatic(J + "GpuSubstringIndexUtils", "substringIndex",
+                   "(JLjava/lang/String;I)J")
+    c.lstore(T1)
+    c.lload(T1)
+    c.string_array(g)
+    c.invokestatic(J + "TestSupport", "checkStringColumn",
+                   "(J[Ljava/lang/String;)I")
+    assert_check("GpuSubstringIndexUtils.substringIndex")
+    free(T1)
+    g = _vals(_je.number_converter_convert(m_num, 10, 16))
+    c.lload(H_NUM)
+    c.iconst(10)
+    c.iconst(16)
+    c.invokestatic(J + "NumberConverter", "convertCvCv", "(JII)J")
+    c.lstore(T1)
+    c.lload(T1)
+    c.string_array(g)
+    c.invokestatic(J + "TestSupport", "checkStringColumn",
+                   "(J[Ljava/lang/String;)I")
+    assert_check("NumberConverter.convertCvCv")
+    free(T1)
+    m_lr = _je.literal_range_pattern(m_str, "a", 1, ord("a"), ord("z"))
+    g = _vals(m_lr, release=False)
+    _R.release(m_lr)
+    gold_bool = [1 if v else 0 for v in g]
+    c.lload(H_STR)
+    c.ldc_string("a")
+    c.iconst(1)
+    c.iconst(ord("a"))
+    c.iconst(ord("z"))
+    c.invokestatic(J + "RegexRewriteUtils", "literalRangePattern",
+                   "(JLjava/lang/String;III)J")
+    c.lstore(T1)
+    c.lload(T1)
+    c.int_array(gold_bool)
+    c.invokestatic(J + "TestSupport", "checkIntColumn", "(J[I)I")
+    assert_check("RegexRewriteUtils.literalRangePattern")
+    free(T1)
+
+    # -- GBK charset decode via the bulk string path --
+    texts = ["\u4f60\u597d", "abc"]
+    gbk = b"".join(t.encode("gbk") for t in texts)
+    gbk_offs = [0, len(texts[0].encode("gbk")), len(gbk)]
+    m_g = _je.from_strings_bulk(gbk, __import__("numpy").asarray(
+        gbk_offs, "<i4").tobytes(), None)
+    g = _vals(_je.charset_decode_to_utf8(m_g, "GBK", "replace"))
+    _R.release(m_g)
+    c.iconst(len(gbk))
+    c.newarray(8)
+    c.astore(REF)
+    for i, b in enumerate(gbk):
+        c.aload(REF)
+        c.iconst(i)
+        c.iconst(b if b < 128 else b - 256)
+        c.bastore()
+    c.aload(REF)
+    c.int_array(gbk_offs)
+    c.aconst_null()
+    c.invokestatic(J + "TpuColumns", "fromStringsBulk", "([B[I[B)J")
+    c.lstore(T1)
+    c.lload(T1)
+    c.ldc_string("GBK")
+    c.ldc_string("replace")
+    c.invokestatic(J + "CharsetDecode", "decodeToUTF8",
+                   "(JLjava/lang/String;Ljava/lang/String;)J")
+    c.lstore(T2)
+    c.lload(T2)
+    c.string_array(g)
+    c.invokestatic(J + "TestSupport", "checkStringColumn",
+                   "(J[Ljava/lang/String;)I")
+    assert_check("CharsetDecode GBK")
+    free(T1)
+    free(T2)
+
+    # -- Iceberg transforms --
+    g = _vals(_je.iceberg_bucket(m_longs, 16))
+    c.lload(H_LONGS)
+    c.iconst(16)
+    c.invokestatic(J + "IcebergBucket", "bucket", "(JI)J")
+    c.lstore(T1)
+    c.lload(T1)
+    c.int_array(g)
+    c.invokestatic(J + "TestSupport", "checkIntColumn", "(J[I)I")
+    assert_check("IcebergBucket.bucket")
+    free(T1)
+    g = _vals(_je.iceberg_truncate(m_longs, 10))
+    c.lload(H_LONGS)
+    c.iconst(10)
+    c.invokestatic(J + "IcebergTruncate", "truncate", "(JI)J")
+    c.lstore(T1)
+    c.lload(T1)
+    c.long_array_consts(g)
+    c.invokestatic(J + "TestSupport", "checkLongColumn", "(J[J)I")
+    assert_check("IcebergTruncate.truncate")
+    free(T1)
+
+    # -- ZOrder --
+    m_i1 = _je.from_ints([1, 2])
+    m_i2 = _je.from_ints([3, 1])
+    g_h = _vals(_je.hilbert_index(4, [m_i1, m_i2]))
+    c.int_array([1, 2])
+    c.invokestatic(J + "TpuColumns", "fromInts", "([I)J")
+    c.lstore(T1)
+    c.int_array([3, 1])
+    c.invokestatic(J + "TpuColumns", "fromInts", "([I)J")
+    c.lstore(T2)
+    c.iconst(4)
+    c.long_array_locals([T1, T2])
+    c.invokestatic(J + "ZOrder", "hilbertIndex", "(I[J)J")
+    c.lstore(T3)
+    c.lload(T3)
+    c.long_array_consts(g_h)
+    c.invokestatic(J + "TestSupport", "checkLongColumn", "(J[J)I")
+    assert_check("ZOrder.hilbertIndex")
+    free(T3)
+    m_z = _je.interleave_bits([m_i1, m_i2])
+    g_z = _vals(m_z)
+    z_offs = [0]
+    z_vals = []
+    for row in g_z:
+        z_vals.extend(int(b) for b in row)
+        z_offs.append(len(z_vals))
+    c.long_array_locals([T1, T2])
+    c.invokestatic(J + "ZOrder", "interleaveBits", "([J)J")
+    c.lstore(T3)
+    c.int_array(z_offs)
+    c.long_array_consts(z_vals)
+    c.invokestatic(J + "TestSupport", "makeListOfInts", "([I[J)J")
+    c.lstore(67)
+    c.lload(T3)
+    c.lload(67)
+    c.invokestatic(J + "TestSupport", "checkColumnsEqual", "(JJ)I")
+    assert_check("ZOrder.interleaveBits golden")
+    free(67)
+    _R.release(m_i1)
+    _R.release(m_i2)
+    free(T1)
+    free(T2)
+    free(T3)
+
+    # -- Aggregation64Utils --
+    m_lo = _je.extract_chunk32_from_64bit(m_longs, "int64", 0)
+    m_hi = _je.extract_chunk32_from_64bit(m_longs, "int64", 1)
+    g_lo = _vals(m_lo, release=False)
+    asm = _je.assemble64_from_sum(m_lo, m_hi, "int64")
+    g_asm = _vals(asm[0] if isinstance(asm, (list, tuple)) else asm)
+    c.lload(H_LONGS)
+    c.ldc_string("int64")
+    c.iconst(0)
+    c.invokestatic(J + "Aggregation64Utils", "extractChunk32From64bit",
+                   "(JLjava/lang/String;I)J")
+    c.lstore(T1)
+    c.lload(T1)
+    c.int_array(g_lo)
+    c.invokestatic(J + "TestSupport", "checkIntColumn", "(J[I)I")
+    assert_check("Aggregation64Utils.extractChunk32From64bit")
+    c.lload(H_LONGS)
+    c.ldc_string("int64")
+    c.iconst(1)
+    c.invokestatic(J + "Aggregation64Utils", "extractChunk32From64bit",
+                   "(JLjava/lang/String;I)J")
+    c.lstore(T2)
+    c.lload(T1)
+    c.lload(T2)
+    c.ldc_string("int64")
+    c.invokestatic(J + "Aggregation64Utils", "assemble64FromSum",
+                   "(JJLjava/lang/String;)[J")
+    c.astore(REF)
+    c.aload(REF)
+    c.iconst(0)
+    c.laload()
+    c.lstore(T3)
+    c.lload(T3)
+    c.long_array_consts(g_asm)
+    c.invokestatic(J + "TestSupport", "checkLongColumn", "(J[J)I")
+    assert_check("Aggregation64Utils.assemble64FromSum")
+    # free every element the native returned (mirror knows the count)
+    n_asm = len(asm) if isinstance(asm, (list, tuple)) else 1
+    for k in range(1, n_asm):
+        c.aload(REF)
+        c.iconst(k)
+        c.laload()
+        c.invokestatic(J + "TpuColumns", "free", "(J)V")
+    for h in (m_lo, m_hi):
+        _R.release(h)
+    if isinstance(asm, (list, tuple)):
+        for h in asm[1:]:
+            _R.release(h)
+    free(T1)
+    free(T2)
+    free(T3)
+    c.println("surface sweep 2 ok")
+
+    # ================= sweep part 3 =================
+    # -- BloomFilter merge/serialize/deserialize (on live BF, BF2) --
+    c.long_array_locals([BF, BF2])
+    c.invokestatic(J + "BloomFilter", "merge", "([J)J")
+    c.lstore(T1)
+    c.lload(T1)
+    c.invokestatic(J + "BloomFilter", "serialize", "(J)[B")
+    c.astore(REF)
+    c.aload(REF)
+    c.invokestatic(J + "BloomFilter", "deserialize", "([B)J")
+    c.lstore(T2)
+    c.lload(T2)
+    c.lload(H_LONGS)
+    c.invokestatic(J + "BloomFilter", "probe", "(JJ)J")
+    c.lstore(T3)
+    c.lload(T3)
+    c.int_array([1, 1, 1])
+    c.invokestatic(J + "TestSupport", "checkIntColumn", "(J[I)I")
+    assert_check("BloomFilter merge/serialize/deserialize/probe")
+    free(T1)
+    free(T2)
+    free(T3)
+
+    # -- listSlice scalar/column operand variants --
+    LSLC, LSST, LSLN = 72, 74, 76   # reuse T-slots as named inputs
+    m_lst = _je.make_list_of_ints([0, 3, 5], [1, 2, 3, 4, 5])
+    m_st = _je.from_ints([1, 2])
+    m_ln = _je.from_ints([2, 1])
+    c.int_array([0, 3, 5])
+    c.long_array_consts([1, 2, 3, 4, 5])
+    c.invokestatic(J + "TestSupport", "makeListOfInts", "([I[J)J")
+    c.lstore(LSLC)
+    c.int_array([1, 2])
+    c.invokestatic(J + "TpuColumns", "fromInts", "([I)J")
+    c.lstore(LSST)
+    c.int_array([2, 1])
+    c.invokestatic(J + "TpuColumns", "fromInts", "([I)J")
+    c.lstore(LSLN)
+    combos = [
+        ("listSliceSC", "(JIJZ)J", 1, "COL"),
+        ("listSliceCS", "(JJIZ)J", "COL", 1),
+        ("listSliceCC", "(JJJZ)J", "COL", "COL"),
+    ]
+    for meth, desc, a_st, a_ln in combos:
+        start_is_col = a_st == "COL"
+        len_is_col = a_ln == "COL"
+        g_h = _je.list_slice(m_lst, m_st if start_is_col else a_st,
+                             m_ln if len_is_col else a_ln,
+                             start_is_col, len_is_col, True)
+        gl = _vals(g_h, release=False)
+        exp_offs = [0]
+        exp_vals = []
+        for row in gl:
+            exp_vals.extend(row if row is not None else [])
+            exp_offs.append(len(exp_vals))
+        _R.release(g_h)
+        c.lload(LSLC)
+        if start_is_col:
+            c.lload(LSST)
+        else:
+            c.iconst(a_st)
+        if len_is_col:
+            c.lload(LSLN)
+        else:
+            c.iconst(a_ln)
+        c.iconst(1)
+        c.invokestatic(J + "GpuListSliceUtils", meth, desc)
+        c.lstore(78)
+        c.int_array(exp_offs)
+        c.long_array_consts(exp_vals)
+        c.invokestatic(J + "TestSupport", "makeListOfInts", "([I[J)J")
+        c.lstore(67)               # 67-68 dead since the kudo block
+        c.lload(78)
+        c.lload(67)
+        c.invokestatic(J + "TestSupport", "checkColumnsEqual",
+                       "(JJ)I")
+        assert_check("GpuListSliceUtils." + meth)
+        free(78)
+        free(67)
+
+    for h in (m_lst, m_st, m_ln):
+        _R.release(h)
+    free(LSLC)
+    free(LSST)
+    free(LSLN)
+
+    # -- MapUtils / GpuMapZipWithUtils --
+    m_map = _je.make_map_column([0, 2, 3], ["a", "b", "c"],
+                                ["1", "2", "3"])
+    assert _je.map_is_valid(m_map, False)
+    c.int_array([0, 2, 3])
+    c.string_array(["a", "b", "c"])
+    c.string_array(["1", "2", "3"])
+    c.invokestatic(J + "TestSupport", "makeMapColumn",
+                   "([I[Ljava/lang/String;[Ljava/lang/String;)J")
+    c.lstore(T1)
+    c.lload(T1)
+    c.iconst(0)
+    c.invokestatic(J + "MapUtils", "isValidMap", "(JZ)Z")
+    assert_check("MapUtils.isValidMap")
+    c.lload(T1)
+    c.iconst(1)
+    c.invokestatic(J + "MapUtils", "mapFromEntries", "(JZ)J")
+    c.lstore(T2)
+    c.lload(T1)
+    c.lload(T1)
+    c.invokestatic(J + "GpuMapZipWithUtils", "mapZip", "(JJ)J")
+    c.lstore(T3)
+    c.lload(T1)
+    c.iconst(0)
+    c.invokestatic(J + "Map", "sortMapColumn", "(JZ)J")
+    c.lstore(T4)
+    free(T1)
+    free(T2)
+    free(T3)
+    free(T4)
+    _R.release(m_map)
+
+    # -- Protobuf.decodeToStruct + getChild --
+    pmsgs = ["\x08\x05", "\x08\x2a"]
+    m_pb = _je.from_strings(pmsgs)
+    m_ps = _je.protobuf_decode_to_struct(
+        m_pb, [1], ["int64"], [0], [False])
+    m_pc = _je.struct_child(m_ps, 0)
+    g_pb = _vals(m_pc, release=False)
+    for h in (m_pb, m_ps, m_pc):
+        _R.release(h)
+    c.string_array(pmsgs)
+    c.invokestatic(J + "TpuColumns", "fromStrings",
+                   "([Ljava/lang/String;)J")
+    c.lstore(T1)
+    c.lload(T1)
+    c.int_array([1])
+    c.string_array(["int64"])
+    c.int_array([0])
+    c.iconst(1)
+    c.newarray(4)                  # boolean[1]{false}
+    c.invokestatic(J + "Protobuf", "decodeToStruct",
+                   "(J[I[Ljava/lang/String;[I[Z)J")
+    c.lstore(T2)
+    c.lload(T2)
+    c.iconst(0)
+    c.invokestatic(J + "TpuColumns", "getChild", "(JI)J")
+    c.lstore(T3)
+    c.lload(T3)
+    c.long_array_consts(g_pb)
+    c.invokestatic(J + "TestSupport", "checkLongColumn", "(J[J)I")
+    assert_check("Protobuf.decodeToStruct")
+    free(T1)
+    free(T2)
+    free(T3)
+
+    # -- DecimalUtils add/subtract/divide on live H_DA/H_DB --
+    m_da = _je.from_decimals([125, 250], -2, "decimal128")
+    m_db = _je.from_decimals([200, 400], -2, "decimal128")
+    for meth, scale in (("add128", -2), ("subtract128", -2),
+                        ("divide128", -6)):
+        pyname = {"add128": "add", "subtract128": "sub",
+                  "divide128": "divide"}[meth]
+        res = _je.decimal128_binop(pyname, m_da, m_db, scale)
+        g_flags = _vals(res[0], release=False)
+        g_res = _vals(res[1], release=False)
+        for h in res:
+            _R.release(h)
+        c.lload(H_DA)
+        c.lload(H_DB)
+        c.iconst(scale)
+        c.invokestatic(J + "DecimalUtils", meth, "(JJI)[J")
+        c.astore(REF)
+        c.aload(REF)
+        c.iconst(1)
+        c.laload()
+        c.lstore(T1)
+        c.lload(T1)
+        c.long_array_consts(g_res)   # unscaled ints (to_pylist)
+        c.invokestatic(J + "TestSupport", "checkLongColumn", "(J[J)I")
+        assert_check("DecimalUtils." + meth)
+        c.aload(REF)
+        c.iconst(0)
+        c.laload()
+        c.lstore(67)
+        c.lload(67)
+        c.int_array([1 if f else 0 for f in g_flags])
+        c.invokestatic(J + "TestSupport", "checkIntColumn", "(J[I)I")
+        assert_check("DecimalUtils." + meth + " overflow flags")
+        free(67)
+        free(T1)
+    for h in (m_da, m_db):
+        _R.release(h)
+    c.println("surface sweep 3 ok")
+
+    # ================= sweep part 4 =================
+    # -- typed timestamp column via convertFromRows, then the
+    # datetime natives (rebase both ways, truncate, tz convert) --
+    micros = [1577836800000000, 946684800000000]   # 2020/2000 UTC
+    m_tsrc = _je.from_longs(micros)
+    m_rows = _je.convert_to_rows([m_tsrc])
+    ts_handles = _je.convert_from_rows(m_rows, ["timestamp_micros"],
+                                       [0])
+    m_ts = ts_handles[0]
+    m_j = _je.datetime_rebase(m_ts, True)
+    g_j = _vals(m_j, release=False)
+    g_back = _vals(_je.datetime_rebase(m_j, False))
+    _R.release(m_j)
+    g_trunc = _vals(_je.datetime_truncate(m_ts, "month"))
+    g_tz = _vals(_je.timezone_convert(m_ts, "America/Los_Angeles",
+                                      False))
+    m_tz = _je.timezone_convert(m_ts, "America/Los_Angeles", False)
+    g_tz_back = _vals(_je.timezone_convert(m_tz,
+                                           "America/Los_Angeles",
+                                           True))
+    g_year = _vals(_je.iceberg_datetime(m_ts, "year"))
+    _R.release(m_tz)
+    _R.release(m_ts)
+    _R.release(m_rows)
+    _R.release(m_tsrc)
+
+    c.long_array_consts(micros)
+    c.invokestatic(J + "TpuColumns", "fromLongs", "([J)J")
+    c.lstore(T1)
+    c.long_array_locals([T1])
+    c.invokestatic(J + "RowConversion", "convertToRows", "([J)J")
+    c.lstore(T2)
+    c.lload(T2)
+    c.string_array(["timestamp_micros"])
+    c.int_array([0])
+    c.invokestatic(J + "RowConversion", "convertFromRows",
+                   "(J[Ljava/lang/String;[I)[J")
+    c.astore(REF)
+    c.aload(REF)
+    c.iconst(0)
+    c.laload()
+    c.lstore(T3)                   # typed timestamp column
+    c.lload(T3)
+    c.invokestatic(J + "DateTimeRebase", "rebaseGregorianToJulian",
+                   "(J)J")
+    c.lstore(T4)
+    c.lload(T4)
+    c.long_array_consts(g_j)
+    c.invokestatic(J + "TestSupport", "checkLongColumn", "(J[J)I")
+    assert_check("DateTimeRebase.rebaseGregorianToJulian")
+    c.lload(T4)
+    c.invokestatic(J + "DateTimeRebase", "rebaseJulianToGregorian",
+                   "(J)J")
+    c.lstore(67)
+    c.lload(67)
+    c.long_array_consts(g_back)
+    c.invokestatic(J + "TestSupport", "checkLongColumn", "(J[J)I")
+    assert_check("DateTimeRebase.rebaseJulianToGregorian")
+    free(67)
+    free(T4)
+    c.lload(T3)
+    c.ldc_string("month")
+    c.invokestatic(J + "DateTimeUtils", "truncate",
+                   "(JLjava/lang/String;)J")
+    c.lstore(T4)
+    c.lload(T4)
+    c.long_array_consts(g_trunc)
+    c.invokestatic(J + "TestSupport", "checkLongColumn", "(J[J)I")
+    assert_check("DateTimeUtils.truncate")
+    free(T4)
+    c.lload(T3)
+    c.ldc_string("America/Los_Angeles")
+    c.invokestatic(J + "GpuTimeZoneDB",
+                   "convertUTCTimestampToTimeZone",
+                   "(JLjava/lang/String;)J")
+    c.lstore(T4)
+    c.lload(T4)
+    c.long_array_consts(g_tz)
+    c.invokestatic(J + "TestSupport", "checkLongColumn", "(J[J)I")
+    assert_check("GpuTimeZoneDB.convertUTCTimestampToTimeZone")
+    c.lload(T4)
+    c.ldc_string("America/Los_Angeles")
+    c.invokestatic(J + "GpuTimeZoneDB", "convertTimestampToUTC",
+                   "(JLjava/lang/String;)J")
+    c.lstore(67)
+    c.lload(67)
+    c.long_array_consts(g_tz_back)
+    c.invokestatic(J + "TestSupport", "checkLongColumn", "(J[J)I")
+    assert_check("GpuTimeZoneDB.convertTimestampToUTC")
+    free(67)
+    free(T4)
+
+    # -- IcebergDateTimeUtil.transform on the typed timestamp --
+    c.lload(T3)
+    c.ldc_string("year")
+    c.invokestatic(J + "IcebergDateTimeUtil", "transform",
+                   "(JLjava/lang/String;)J")
+    c.lstore(T4)
+    c.lload(T4)
+    c.int_array(g_year)
+    c.invokestatic(J + "TestSupport", "checkIntColumn", "(J[I)I")
+    assert_check("IcebergDateTimeUtil.transform(year)")
+    free(T4)
+    free(T1)
+    free(T2)
+    free(T3)
+
+    # -- Version / registry / priority / host-table scalars --
+    assert _je.version_is_vanilla_320(0, 3, 2, 0)
+    c.iconst(0)
+    c.iconst(3)
+    c.iconst(2)
+    c.iconst(0)
+    c.invokestatic(J + "Version", "isVanilla320", "(IIII)Z")
+    assert_check("Version.isVanilla320(0,3,2,0)")
+    c.lconst(424242)
+    c.invokestatic(J + "ThreadStateRegistry", "addThread", "(J)V")
+    c.invokestatic(J + "ThreadStateRegistry", "knownThreads", "()[J")
+    c.arraylength()
+    assert_check("ThreadStateRegistry.knownThreads non-empty")
+    c.lconst(424242)
+    c.invokestatic(J + "ThreadStateRegistry", "removeThread", "(J)V")
+    g_pri = _je.task_priority_get(7)
+    ok_pri = Label()
+    c.lconst(7)
+    c.invokestatic(J + "TaskPriority", "getTaskPriority", "(J)J")
+    c.lconst(g_pri)
+    c.lcmp()
+    c.ifeq_lbl(ok_pri)
+    c.iconst(0)
+    c.ldc_string("TaskPriority.getTaskPriority mismatch")
+    c.invokestatic(J + "TestSupport", "assertTrue",
+                   "(ILjava/lang/String;)V")
+    c.place(ok_pri)
+    c.lconst(7)
+    c.invokestatic(J + "TaskPriority", "taskDone", "(J)V")
+    # hostTableNumRows on a fresh host table
+    ok_rows = Label()
+    c.long_array_locals([H_LONGS])
+    c.invokestatic(J + "KudoSerializer", "hostTableFromColumns",
+                   "([J)J")
+    c.lstore(T1)
+    c.lload(T1)
+    c.invokestatic(J + "KudoSerializer", "hostTableNumRows", "(J)J")
+    c.lconst(3)
+    c.lcmp()
+    c.ifeq_lbl(ok_rows)
+    c.iconst(0)
+    c.ldc_string("hostTableNumRows != 3")
+    c.invokestatic(J + "TestSupport", "assertTrue",
+                   "(ILjava/lang/String;)V")
+    c.place(ok_rows)
+    c.lload(T1)
+    c.invokestatic(J + "KudoSerializer", "freeHostTable", "(J)V")
+    # HostTable.sizeBytes > 0
+    ok_sz = Label()
+    c.long_array_locals([H_LONGS])
+    c.invokestatic(J + "HostTable", "fromTable", "([J)J")
+    c.lstore(T1)
+    c.lload(T1)
+    c.invokestatic(J + "HostTable", "sizeBytes", "(J)J")
+    c.lconst(0)
+    c.lcmp()
+    c.iconst(1)
+    c.if_icmp("eq", ok_sz)
+    c.iconst(0)
+    c.ldc_string("HostTable.sizeBytes not positive")
+    c.invokestatic(J + "TestSupport", "assertTrue",
+                   "(ILjava/lang/String;)V")
+    c.place(ok_sz)
+    c.lload(T1)
+    c.invokestatic(J + "HostTable", "free", "(J)V")
+
+
+    # -- CaseWhen.selectFirstTrueIndex over BOOL8 columns (produced
+    # by literalRangePattern) --
+    m_b1 = _je.literal_range_pattern(m_str, "a", 1, ord("a"),
+                                     ord("z"))
+    m_b2 = _je.literal_range_pattern(m_str, "z", 1, ord("a"),
+                                     ord("z"))
+    g_cw = _vals(_je.select_first_true_index([m_b1, m_b2]))
+    _R.release(m_b1)
+    _R.release(m_b2)
+    c.lload(H_STR)
+    c.ldc_string("a")
+    c.iconst(1)
+    c.iconst(ord("a"))
+    c.iconst(ord("z"))
+    c.invokestatic(J + "RegexRewriteUtils", "literalRangePattern",
+                   "(JLjava/lang/String;III)J")
+    c.lstore(T1)
+    c.lload(H_STR)
+    c.ldc_string("z")
+    c.iconst(1)
+    c.iconst(ord("a"))
+    c.iconst(ord("z"))
+    c.invokestatic(J + "RegexRewriteUtils", "literalRangePattern",
+                   "(JLjava/lang/String;III)J")
+    c.lstore(T2)
+    c.long_array_locals([T1, T2])
+    c.invokestatic(J + "CaseWhen", "selectFirstTrueIndex", "([J)J")
+    c.lstore(T3)
+    c.lload(T3)
+    c.int_array(g_cw)
+    c.invokestatic(J + "TestSupport", "checkIntColumn", "(J[I)I")
+    assert_check("CaseWhen.selectFirstTrueIndex")
+    free(T1)
+    free(T2)
+    free(T3)
+    # -- telemetry + timezone enumeration --
+    c.iconst(0)
+    c.invokestatic(J + "nvml/NVML", "getSnapshotPacked", "(I)[J")
+    c.arraylength()
+    c.iconst(7)
+    c.idiv()
+    assert_check("NVML.getSnapshotPacked 7 slots")
+    c.iconst(0)
+    c.invokestatic(J + "nvml/NVML", "getDeviceName",
+                   "(I)Ljava/lang/String;")
+    c.invokevirtual("java/lang/String", "length", "()I")
+    assert_check("NVML.getDeviceName non-empty")
+    c.invokestatic(J + "OrcDstRuleExtractor", "timezoneIds",
+                   "()[Ljava/lang/String;")
+    c.arraylength()
+    assert_check("OrcDstRuleExtractor.timezoneIds non-empty")
+    c.println("surface sweep 4 ok")
+
+    _R.release(m_str)
+    for h in (m_longs, m_num, m_uri):
+        _R.release(h)
 
 
 def build_kudo_bench(outdir: str):

@@ -25,6 +25,7 @@ from typing import Dict, List, Tuple
 # constant-pool tags
 _UTF8, _INT, _LONG, _CLASS, _STRING, _FIELD, _METHOD, _NAT = \
     1, 3, 5, 7, 8, 9, 10, 12
+_DOUBLE = 6
 
 ACC_PUBLIC, ACC_STATIC, ACC_FINAL, ACC_SUPER, ACC_NATIVE = \
     0x0001, 0x0008, 0x0010, 0x0020, 0x0100
@@ -46,7 +47,7 @@ class ConstPool:
         self.entries.append(key)
         idx = self._next
         self._index[key] = idx
-        self._next += 2 if key[0] == _LONG else 1
+        self._next += 2 if key[0] in (_LONG, _DOUBLE) else 1
         return idx
 
     def utf8(self, s: str) -> int:
@@ -57,6 +58,10 @@ class ConstPool:
 
     def long_(self, v: int) -> int:
         return self._add((_LONG, v))
+
+    def double_(self, v: float) -> int:
+        # key by bit pattern: 0.0 vs -0.0 (and NaNs) must not collapse
+        return self._add((_DOUBLE, struct.pack(">d", v)))
 
     def cls(self, name: str) -> int:
         return self._add((_CLASS, self.utf8(name)))
@@ -84,6 +89,8 @@ class ConstPool:
                 out.append(struct.pack(">Bi", tag, e[1]))
             elif tag == _LONG:
                 out.append(struct.pack(">Bq", tag, e[1]))
+            elif tag == _DOUBLE:
+                out.append(struct.pack(">B", tag) + e[1])
             elif tag in (_CLASS, _STRING):
                 out.append(struct.pack(">BH", tag, e[1]))
             elif tag in (_FIELD, _METHOD, _NAT):
@@ -228,6 +235,10 @@ class Code:
         else:
             self.b += struct.pack(">BH", 0x14, self.cp.long_(v))  # ldc2_w
 
+    def dconst(self, v: float):
+        self._push(2)
+        self.b += struct.pack(">BH", 0x14, self.cp.double_(v))  # ldc2_w
+
     def ldc_string(self, s: str):
         self._push()
         self._ldc_idx(self.cp.string(s))
@@ -301,6 +312,10 @@ class Code:
         self._pop(3)
         self.b.append(0x4F)
 
+    def dastore(self):
+        self._pop(4)
+        self.b.append(0x52)
+
     def lastore(self):
         self._pop(4)
         self.b.append(0x50)
@@ -348,6 +363,17 @@ class Code:
             self.iconst(i)
             self.lload(li)
             self.lastore()
+
+    def double_array(self, values):
+        self.iconst(len(values))
+        self._pop()
+        self._push()
+        self.b += bytes([0xBC, 7])     # newarray T_DOUBLE
+        for i, v in enumerate(values):
+            self.dup()
+            self.iconst(i)
+            self.dconst(v)
+            self.dastore()
 
     def string_array(self, values):
         self.iconst(len(values))

@@ -1057,6 +1057,21 @@ def make_list_of_ints(offsets: Sequence[int],
         np.asarray(list(offsets), np.int32), child))
 
 
+def make_map_column(offsets: Sequence[int], keys: Sequence[str],
+                    values: Sequence[str]) -> int:
+    """Test helper: MAP-shaped LIST<STRUCT<key,value>> column (drives
+    the MapUtils / GpuMapZipWithUtils smoke)."""
+    import numpy as np
+
+    from spark_rapids_tpu.columns.column import Column
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    n = len(keys)
+    entry = Column.make_struct(n, [Column.from_strings(list(keys)),
+                                   Column.from_strings(list(values))])
+    return REGISTRY.register(Column.make_list(
+        np.asarray(list(offsets), np.int32), entry))
+
+
 def check_int_column(handle: int, expected: Sequence[int]) -> int:
     from spark_rapids_tpu.shim.handles import REGISTRY
     got = REGISTRY.get(handle).to_pylist()
