@@ -4,8 +4,13 @@
 # deadlock-recovery config, against BOTH the python and native adaptors.
 set -e
 cd "$(dirname "$0")/.."
+# SPARK_RAPIDS_TPU_FUZZ_REPEATS: extra repeat rounds (nightly depth;
+# ci/nightly.yaml sets it higher than the premerge default of 5)
+REPEATS="${SPARK_RAPIDS_TPU_FUZZ_REPEATS:-5}"
 python -m pytest tests/test_rmm_monte_carlo.py -q -p no:randomly
-for i in 1 2 3 4 5; do
+i=0
+while [ "$i" -lt "$REPEATS" ]; do
   python -m pytest tests/test_rmm_monte_carlo.py -q >/dev/null || exit 1
+  i=$((i + 1))
 done
-echo "fuzz: 6x monte-carlo clean"
+echo "fuzz: $((REPEATS + 1))x monte-carlo clean"
