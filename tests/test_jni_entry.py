@@ -273,3 +273,32 @@ def test_export_import_kudo_host_nested_roundtrip():
     finally:
         for h in handles:
             REGISTRY.release(h)
+
+
+def test_from_strings_bulk_boundary_validation():
+    """Malformed bulk payloads fail AT the boundary (not as corrupt
+    columns downstream)."""
+    import numpy as np
+    import pytest
+
+    from spark_rapids_tpu.shim import jni_entry as je
+
+    def offs(*vals):
+        return np.asarray(vals, "<i4").tobytes()
+
+    with pytest.raises(ValueError, match="at least one"):
+        je.from_strings_bulk(b"abc", b"", None)
+    with pytest.raises(ValueError, match="non-decreasing"):
+        je.from_strings_bulk(b"abc", offs(0, 3, 1), None)
+    with pytest.raises(ValueError, match="start at 0"):
+        je.from_strings_bulk(b"abc", offs(1, 3), None)
+    with pytest.raises(ValueError, match="exceeds chars"):
+        je.from_strings_bulk(b"abc", offs(0, 9), None)
+    with pytest.raises(ValueError, match="validity shorter"):
+        je.from_strings_bulk(b"abcdefghij" * 2, offs(*range(0, 21)),
+                             b"\xff")
+    # and the happy path still round-trips
+    h = je.from_strings_bulk(b"abc", offs(0, 1, 3), None)
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    assert REGISTRY.get(h).to_pylist() == ["a", "bc"]
+    REGISTRY.release(h)
