@@ -44,6 +44,7 @@ def initialize() -> None:
 def shutdown() -> None:
     from spark_rapids_tpu.shim.handles import REGISTRY
     from spark_rapids_tpu.utils.profiler import Profiler
+    _KUDO_WRITE_CACHE.clear()
     REGISTRY.clear()
     _HOST_TABLES.clear()   # spilled buffers are handles too
     Profiler.shutdown()    # stops the flusher, closes file sinks
@@ -155,6 +156,7 @@ def string_column_offsets(handle: int) -> bytes:
 
 def free(handle: int) -> None:
     from spark_rapids_tpu.shim import jni_api
+    _kudo_cache_purge(handle)
     jni_api.release_column(handle)
 
 
@@ -691,6 +693,20 @@ def host_table_free(handle: int) -> None:
 # ----------------------------------------------------- kudo over JNI
 
 
+# per-handle-tuple memo for the legacy write path: partition loops
+# call kudo_write repeatedly on the SAME handles; one export serves
+# them all.  Entries are PURGED when any of their handles is released
+# (free() below) and on shutdown — the memo never outlives the
+# columns' ownership (handles.py: every handle released exactly once).
+_KUDO_WRITE_CACHE: dict = {}
+_KUDO_WRITE_CACHE_MAX = 4
+
+
+def _kudo_cache_purge(handle: int) -> None:
+    for key in [k for k in _KUDO_WRITE_CACHE if handle in k]:
+        del _KUDO_WRITE_CACHE[key]
+
+
 def kudo_write(handles: Sequence[int], row_offset: int,
                num_rows: int) -> bytes:
     """KudoSerializer.writeToStreamWithMetrics: serialize a row slice
@@ -704,7 +720,14 @@ def kudo_write(handles: Sequence[int], row_offset: int,
     from spark_rapids_tpu.shuffle import kudo, kudo_native
     cols = jni_api._cols(handles)
     if kudo_native.available():
-        return kudo_native.write_to_bytes(cols, row_offset, num_rows)
+        key = tuple(handles)
+        nt = _KUDO_WRITE_CACHE.get(key)
+        if nt is None:
+            nt = kudo_native.table_from_columns(cols)
+            _KUDO_WRITE_CACHE[key] = nt
+            while len(_KUDO_WRITE_CACHE) > _KUDO_WRITE_CACHE_MAX:
+                del _KUDO_WRITE_CACHE[next(iter(_KUDO_WRITE_CACHE))]
+        return nt.write(row_offset, num_rows)
     out = io.BytesIO()
     kudo.write_to_stream(cols, out, row_offset, num_rows)
     return out.getvalue()

@@ -278,7 +278,10 @@ def _field_of_view(v: HostColumnView) -> Field:
 
 def write_to_bytes(columns: Sequence[Column], row_offset: int,
                    num_rows: int) -> bytes:
-    """Convenience one-shot: export + single partition write."""
+    """Convenience one-shot: export + single partition write.  For
+    per-partition loops, hold a NativeKudoTable (or go through the
+    JNI path, whose handle-keyed memo amortizes the export and is
+    purged when handles are released)."""
     return table_from_columns(columns).write(row_offset, num_rows)
 
 
