@@ -656,3 +656,31 @@ def run_with_capacity_retry(build, args, capacity: int,
     from spark_rapids_tpu.parallel.exchange import with_capacity_retry
     return with_capacity_retry(build, capacity,
                                max_doublings=max_doublings)(*args)
+
+
+# ----------------------------------------------------- presentation
+
+
+def present_q5(outs, store_ids: "Sequence[str]"):
+    """Decode q5 outputs at the presentation boundary: dictionary ids
+    map back to store id STRINGS here — strings never entered the
+    jitted program (module docstring).  Returns
+    [(store_id_str, sales, returns, profit), ...] for live rows."""
+    key_s, sales, rets, profit, _overflow = outs
+    key = np.asarray(key_s)
+    live = key != 2**31 - 1
+    return [(store_ids[int(k)], int(a), int(b), int(c))
+            for k, a, b, c in zip(key[live], np.asarray(sales)[live],
+                                  np.asarray(rets)[live],
+                                  np.asarray(profit)[live])]
+
+
+def present_q72(outs, item_ids: "Sequence[str]"):
+    """Decode q72 outputs: item dictionary ids -> item id strings."""
+    items, weeks, cnts, _overflow = outs
+    cnts_np = np.asarray(cnts)
+    live = cnts_np > 0
+    return [(item_ids[int(i)], int(w), int(c))
+            for i, w, c in zip(np.asarray(items)[live],
+                               np.asarray(weeks)[live],
+                               cnts_np[live])]

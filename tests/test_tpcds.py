@@ -195,3 +195,12 @@ def test_capacity_retry_driver():
     assert cap > 1 << 19                 # it really had to grow
     got = _q72_rows(out)
     assert got == tpcds.oracle_q72(d, 4, MAX_WEEK, week0=WEEK0)
+
+
+def test_presentation_helpers():
+    d = tpcds.gen_q5(rows=2000, stores=8, days=60)
+    run = tpcds.make_q5(8, join_capacity=1 << 12)
+    names = ["S%02d" % i for i in range(8)]
+    rows = tpcds.present_q5(run(d), names)
+    want = tpcds.oracle_q5(d, 8)
+    assert rows == [(names[w[0]], w[1], w[2], w[3]) for w in want]
