@@ -2459,6 +2459,28 @@ def _emit_surface_sweep(c, J, assert_check, H_LONGS, H_NUM, H_STR,
                    "()[Ljava/lang/String;")
     c.arraylength()
     assert_check("OrcDstRuleExtractor.timezoneIds non-empty")
+    # JSON path variants: wildcard + array index through JNI
+    m_jv = _je.from_strings(['{"a": [1, 2, 3]}', '{"a": []}'])
+    g_w = _vals(_je.get_json_object(m_jv, "$.a[*]"))
+    g_i = _vals(_je.get_json_object(m_jv, "$.a[1]"))
+    _R.release(m_jv)
+    c.string_array(['{"a": [1, 2, 3]}', '{"a": []}'])
+    c.invokestatic(J + "TpuColumns", "fromStrings",
+                   "([Ljava/lang/String;)J")
+    c.lstore(T1)
+    for path, gold in (("$.a[*]", g_w), ("$.a[1]", g_i)):
+        c.lload(T1)
+        c.ldc_string(path)
+        c.invokestatic(J + "JSONUtils", "getJsonObject",
+                       "(JLjava/lang/String;)J")
+        c.lstore(T2)
+        c.lload(T2)
+        c.string_array(gold)
+        c.invokestatic(J + "TestSupport", "checkStringColumn",
+                       "(J[Ljava/lang/String;)I")
+        assert_check("getJsonObject " + path)
+        free(T2)
+    free(T1)
     c.println("surface sweep 4 ok")
 
     _R.release(m_str)

@@ -379,6 +379,8 @@ class Code:
         self.iconst(len(values))
         self.anewarray("java/lang/String")
         for i, v in enumerate(values):
+            if v is None:
+                continue           # slots default to null
             self.dup()
             self.iconst(i)
             self.ldc_string(v)
