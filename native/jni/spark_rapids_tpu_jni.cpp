@@ -454,6 +454,18 @@ void JNI_FN(TpuRuntime, shutdown)(JNIEnv* env, jclass) {
   Py_XDECREF(r);
 }
 
+jlongArray JNI_FN(TpuRuntime, runDistributedQ5)(JNIEnv* env, jclass,
+                                                jint n_devices,
+                                                jint rows,
+                                                jint stores) {
+  if (!ensure_runtime(env)) return nullptr;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(iii)", (int)n_devices, (int)rows,
+                                 (int)stores);
+  return as_jlong_array(env,
+                        call_entry(env, "flagship_q5_mesh", args));
+}
+
 jint JNI_FN(TpuRuntime, liveHandles)(JNIEnv* env, jclass) {
   if (!ensure_runtime(env)) return -1;
   Gil gil;

@@ -72,6 +72,7 @@ NATIVE_CLASSES = {
         ("initialize", "()V"),
         ("shutdown", "()V"),
         ("liveHandles", "()I"),
+        ("runDistributedQ5", "(III)[J"),
     ],
     "TpuColumns": [
         ("fromLongs", "([J)J"),
@@ -2481,6 +2482,45 @@ def _emit_surface_sweep(c, J, assert_check, H_LONGS, H_NUM, H_STR,
         assert_check("getJsonObject " + path)
         free(T2)
     free(T1)
+
+    # -- multi-device SPMD query driven from the JVM ---------------
+    # (4 virtual CPU devices via SPARK_RAPIDS_TPU_CPU_DEVICES; the
+    # oracle runs at emission time over the same seeded data)
+    from spark_rapids_tpu.models import tpcds as _tp
+    _d5 = _tp.q5_mesh_data(256, 6, 4)   # SAME prep the entry runs
+    _q5_gold = []
+    for row in _tp.oracle_q5(_d5, 6):
+        _q5_gold.extend(int(x) for x in row)
+    c.iconst(4)
+    c.iconst(256)
+    c.iconst(6)
+    c.invokestatic(J + "TpuRuntime", "runDistributedQ5", "(III)[J")
+    c.astore(REF)
+    jl_ok = Label()
+    c.aload(REF)
+    c.arraylength()
+    c.iconst(len(_q5_gold))
+    c.if_icmp("eq", jl_ok)
+    c.iconst(0)
+    c.ldc_string("distributed q5 row count mismatch")
+    c.invokestatic(J + "TestSupport", "assertTrue",
+                   "(ILjava/lang/String;)V")
+    c.place(jl_ok)
+    for _k, _v in enumerate(_q5_gold):
+        ok_k = Label()
+        c.aload(REF)
+        c.iconst(_k)
+        c.laload()
+        c.lconst(_v)
+        c.lcmp()
+        c.ifeq_lbl(ok_k)
+        c.iconst(0)
+        c.ldc_string("distributed q5 value mismatch @%d" % _k)
+        c.invokestatic(J + "TestSupport", "assertTrue",
+                       "(ILjava/lang/String;)V")
+        c.place(ok_k)
+    c.println("distributed q5 from the JVM ok (%d values)"
+              % len(_q5_gold))
     c.println("surface sweep 4 ok")
 
     _R.release(m_str)

@@ -42,6 +42,9 @@ python scripts/gen_java_classes.py java/classes
 export JAX_PLATFORMS=cpu
 export SPARK_RAPIDS_TPU_PLATFORM=cpu
 export SPARK_RAPIDS_TPU_ROOT="$REPO"
+# 4 virtual CPU devices: the smoke drives a multi-device SPMD query
+# (shard_map q5) from the JVM
+export SPARK_RAPIDS_TPU_CPU_DEVICES=4
 "$JAVA_BIN" -cp "$REPO/java/classes" \
     com.nvidia.spark.rapids.jni.JniSmokeTest \
     "$REPO/native/jni/libspark_rapids_tpu_jni.so"

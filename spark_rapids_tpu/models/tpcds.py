@@ -658,6 +658,19 @@ def run_with_capacity_retry(build, args, capacity: int,
                                max_doublings=max_doublings)(*args)
 
 
+def q5_mesh_data(rows: int, stores: int, n_devices: int,
+                 days: int = 60) -> Q5Data:
+    """Seeded q5 data shaped for an n-device mesh (row counts rounded
+    to shard evenly) — shared by the JVM-driven mesh entry and its
+    emission-time oracle so the two cannot drift."""
+    rows = max(int(rows) // n_devices, 1) * n_devices
+    d = gen_q5(rows=rows, stores=stores, days=days)
+    rrows = max(len(np.asarray(d.r_date)) // n_devices, 1) * n_devices
+    return d._replace(r_date=d.r_date[:rrows],
+                      r_store=d.r_store[:rrows],
+                      r_amt=d.r_amt[:rrows], r_loss=d.r_loss[:rrows])
+
+
 # ----------------------------------------------------- presentation
 
 

@@ -37,6 +37,15 @@ def initialize() -> None:
     platform = os.environ.get("SPARK_RAPIDS_TPU_PLATFORM", "")
     if platform:
         jax.config.update("jax_platforms", platform)
+    # virtual CPU device count for mesh programs driven from the JVM
+    # (must be set before the backend initializes)
+    ndev = os.environ.get("SPARK_RAPIDS_TPU_CPU_DEVICES", "")
+    if ndev:
+        n = int(ndev)              # malformed values must FAIL loudly
+        try:
+            jax.config.update("jax_num_cpu_devices", n)
+        except RuntimeError:
+            pass   # backend already up: device count locked
     jax.config.update("jax_enable_x64", True)
     _INITIALIZED = True
 
@@ -862,6 +871,53 @@ def kudo_merge(blob: bytes, type_ids: Sequence[str],
         kts.append(kt)
     table = kudo.merge_to_table(kts, fields)
     return [REGISTRY.register(c) for c in table.columns]
+
+
+# compiled mesh steps are cached so repeated JVM calls never re-jit
+_Q5_MESH_STEPS: dict = {}
+
+
+def flagship_q5_mesh(n_devices: int, rows: int,
+                     stores: int) -> List[int]:
+    """Run the q5-shape flagship as ONE shard_map program over an
+    n-device mesh and return the live group rows flattened as
+    [store_id, sales, returns, profit, ...] — the multi-chip SPMD
+    path driven END TO END from the JVM (north star: GpuExec-shaped
+    callers reach distributed execution through this binding).
+    Raises when fewer devices exist than requested: a silent
+    single-device run would fake the distribution being proven."""
+    import jax as _jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from spark_rapids_tpu.models import tpcds
+    devs = _jax.devices()
+    n = int(n_devices)
+    if len(devs) < n:
+        raise RuntimeError(
+            f"mesh wants {n} devices, backend has {len(devs)} "
+            f"(set SPARK_RAPIDS_TPU_CPU_DEVICES before init)")
+    mesh = Mesh(np.array(devs[:n]), ("data",))
+    d = tpcds.q5_mesh_data(int(rows), int(stores), n)
+    key = (n, int(stores))
+    step = _Q5_MESH_STEPS.get(key)
+    if step is None:
+        step = tpcds.make_q5_multichip(mesh, int(stores),
+                                       join_capacity=1 << 12)
+        _Q5_MESH_STEPS[key] = step
+    key_s, sales, rets, profit, overflow = step(
+        d.s_date, d.s_store, d.s_price, d.s_profit, d.r_date,
+        d.r_store, d.r_amt, d.r_loss, d.d_date, d.st_id)
+    if bool(np.asarray(overflow)):
+        raise RuntimeError("q5 mesh overflow")
+    key = np.asarray(key_s)
+    live = key != 2**31 - 1
+    out: List[int] = []
+    for k, a, b, c in zip(key[live], np.asarray(sales)[live],
+                          np.asarray(rets)[live],
+                          np.asarray(profit)[live]):
+        out.extend([int(k), int(a), int(b), int(c)])
+    return out
 
 
 # ---------------------------------------------------------- RmmSpark
