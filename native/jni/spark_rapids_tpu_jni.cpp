@@ -466,6 +466,18 @@ jlongArray JNI_FN(TpuRuntime, runDistributedQ5)(JNIEnv* env, jclass,
                         call_entry(env, "flagship_q5_mesh", args));
 }
 
+jlongArray JNI_FN(TpuRuntime, runDistributedQ72)(JNIEnv* env, jclass,
+                                                 jint n_devices,
+                                                 jint cs_rows,
+                                                 jint items) {
+  if (!ensure_runtime(env)) return nullptr;
+  Gil gil;
+  PyObject* args = Py_BuildValue("(iii)", (int)n_devices,
+                                 (int)cs_rows, (int)items);
+  return as_jlong_array(env,
+                        call_entry(env, "flagship_q72_mesh", args));
+}
+
 jint JNI_FN(TpuRuntime, liveHandles)(JNIEnv* env, jclass) {
   if (!ensure_runtime(env)) return -1;
   Gil gil;

@@ -73,6 +73,7 @@ NATIVE_CLASSES = {
         ("shutdown", "()V"),
         ("liveHandles", "()I"),
         ("runDistributedQ5", "(III)[J"),
+        ("runDistributedQ72", "(III)[J"),
     ],
     "TpuColumns": [
         ("fromLongs", "([J)J"),
@@ -2521,6 +2522,42 @@ def _emit_surface_sweep(c, J, assert_check, H_LONGS, H_NUM, H_STR,
         c.place(ok_k)
     c.println("distributed q5 from the JVM ok (%d values)"
               % len(_q5_gold))
+
+    # -- and the q72 fact-fact join chain on the same mesh --
+    _d72 = _tp.q72_mesh_data(192, 12, 4)
+    _q72_gold = []
+    for row in _tp.oracle_q72(_d72, 12, 16, week0=11_000 // 7):
+        _q72_gold.extend(int(x) for x in row)
+    c.iconst(4)
+    c.iconst(192)
+    c.iconst(12)
+    c.invokestatic(J + "TpuRuntime", "runDistributedQ72", "(III)[J")
+    c.astore(REF)
+    j72_ok = Label()
+    c.aload(REF)
+    c.arraylength()
+    c.iconst(len(_q72_gold))
+    c.if_icmp("eq", j72_ok)
+    c.iconst(0)
+    c.ldc_string("distributed q72 row count mismatch")
+    c.invokestatic(J + "TestSupport", "assertTrue",
+                   "(ILjava/lang/String;)V")
+    c.place(j72_ok)
+    for _k, _v in enumerate(_q72_gold):
+        ok_k = Label()
+        c.aload(REF)
+        c.iconst(_k)
+        c.laload()
+        c.lconst(_v)
+        c.lcmp()
+        c.ifeq_lbl(ok_k)
+        c.iconst(0)
+        c.ldc_string("distributed q72 value mismatch @%d" % _k)
+        c.invokestatic(J + "TestSupport", "assertTrue",
+                       "(ILjava/lang/String;)V")
+        c.place(ok_k)
+    c.println("distributed q72 from the JVM ok (%d values)"
+              % len(_q72_gold))
     c.println("surface sweep 4 ok")
 
     _R.release(m_str)

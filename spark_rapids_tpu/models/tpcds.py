@@ -671,6 +671,16 @@ def q5_mesh_data(rows: int, stores: int, n_devices: int,
                       r_amt=d.r_amt[:rrows], r_loss=d.r_loss[:rrows])
 
 
+def q72_mesh_data(cs_rows: int, items: int, n_devices: int,
+                  days: int = 35) -> Q72Data:
+    """Seeded q72 data shaped for an n-device mesh (cs rows rounded to
+    shard evenly; inventory replicated) — shared by the JVM mesh entry
+    and its emission-time oracle."""
+    cs_rows = max(int(cs_rows) // n_devices, 1) * n_devices
+    return gen_q72(cs_rows=cs_rows, inv_rows=64, items=items,
+                   days=days)
+
+
 # ----------------------------------------------------- presentation
 
 

@@ -920,6 +920,46 @@ def flagship_q5_mesh(n_devices: int, rows: int,
     return out
 
 
+_Q72_MESH_STEPS: dict = {}
+
+
+def flagship_q72_mesh(n_devices: int, cs_rows: int,
+                      items: int) -> List[int]:
+    """q72-shape (fact-fact join chain) over an n-device mesh from
+    the JVM; returns live (item, week, count) triples flattened."""
+    import jax as _jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from spark_rapids_tpu.models import tpcds
+    devs = _jax.devices()
+    n = int(n_devices)
+    if len(devs) < n:
+        raise RuntimeError(
+            f"mesh wants {n} devices, backend has {len(devs)}")
+    mesh = Mesh(np.array(devs[:n]), ("data",))
+    week0 = 11_000 // 7
+    d = tpcds.q72_mesh_data(int(cs_rows), int(items), n)
+    key = (n, int(items))
+    step = _Q72_MESH_STEPS.get(key)
+    if step is None:
+        step = tpcds.make_q72_multichip(mesh, int(items), 16,
+                                        join_capacity=1 << 12,
+                                        week0=week0)
+        _Q72_MESH_STEPS[key] = step
+    ti, tw, tc, ovf = step(d.cs_item, d.cs_date, d.cs_qty, d.inv_item,
+                           d.inv_date, d.inv_qty, d.item_id)
+    if bool(np.asarray(ovf)):
+        raise RuntimeError("q72 mesh overflow")
+    cnts = np.asarray(tc)
+    live = cnts > 0
+    out: List[int] = []
+    for i, w, c in zip(np.asarray(ti)[live], np.asarray(tw)[live],
+                       cnts[live]):
+        out.extend([int(i), int(w), int(c)])
+    return out
+
+
 # ---------------------------------------------------------- RmmSpark
 
 
