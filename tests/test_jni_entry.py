@@ -302,3 +302,28 @@ def test_from_strings_bulk_boundary_validation():
     from spark_rapids_tpu.shim.handles import REGISTRY
     assert REGISTRY.get(h).to_pylist() == ["a", "bc"]
     REGISTRY.release(h)
+
+
+def test_flagship_mesh_entries():
+    """The JVM-facing distributed-query entries (runDistributedQ5/Q72
+    natives) match the oracles over the shared mesh data prep."""
+    import jax
+
+    from spark_rapids_tpu.models import tpcds
+    from spark_rapids_tpu.shim import jni_entry as je
+
+    n = min(8, len(jax.devices()))
+    if n < 2:
+        import pytest
+        pytest.skip("needs a multi-device backend")
+    flat5 = je.flagship_q5_mesh(n, 256, 6)
+    gold5 = []
+    for row in tpcds.oracle_q5(tpcds.q5_mesh_data(256, 6, n), 6):
+        gold5.extend(int(x) for x in row)
+    assert flat5 == gold5
+    flat72 = je.flagship_q72_mesh(n, 192, 12)
+    gold72 = []
+    for row in tpcds.oracle_q72(tpcds.q72_mesh_data(192, 12, n), 12,
+                                16, week0=11_000 // 7):
+        gold72.extend(int(x) for x in row)
+    assert flat72 == gold72
