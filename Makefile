@@ -4,7 +4,8 @@
 PY ?= python
 
 .PHONY: test fuzz native sanitizers bench bench-all dryrun tpu-lower \
-        jni-test kudo-bench nightly-artifacts ci ci-nightly clean
+        jni-test kudo-bench metrics-smoke nightly-artifacts ci \
+        ci-nightly clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -42,22 +43,35 @@ jni-test:
 	if [ $$rc -eq 2 ]; then echo "jni-test: skipped (no JVM)"; \
 	elif [ $$rc -ne 0 ]; then exit $$rc; fi
 
+# observability spine gate: tiny TPC-DS model query with metrics
+# enabled must light up the whole spine — non-empty Prometheus
+# exposition with per-op latency histograms and shuffle byte counters,
+# an OOM-retry journal event under force_retry_oom, and a
+# metrics_report rendering of the journal dump
+metrics-smoke:
+	$(PY) scripts/metrics_smoke.py
+
 # NOTE: jax.config.update, not the env var — this image's sitecustomize
-# pre-imports jax with the axon backend, so JAX_PLATFORMS=cpu is too late
+# pre-imports jax with the axon backend, so JAX_PLATFORMS=cpu is too
+# late.  XLA_FLAGS still works (read at backend init, which happens
+# after the config updates) and is the only 8-device knob on
+# jax<0.4.38, where jax_num_cpu_devices does not exist
+# (dryrun_multichip tries it and falls back to the flag).
 dryrun:
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	$(PY) -c "import jax; \
 	jax.config.update('jax_platforms', 'cpu'); \
-	jax.config.update('jax_num_cpu_devices', 8); \
 	import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"
 
 # one-command premerge gate (reference ci/Jenkinsfile.premerge:196-232):
 # unit tests + OOM fuzz (python AND native adaptors differentially) +
-# sanitizer builds + TPU lowering gate + multichip dryrun + bench.
+# sanitizer builds + TPU lowering gate + multichip dryrun +
+# observability smoke + bench.
 # Fails loudly on the first red step.  bench.py never hangs, but when
 # the relay is down it FIGHTS for the chip up to BENCH_FIGHT_SECONDS
 # (default 1500s) before emitting the CPU-fallback line — export
 # BENCH_FIGHT_SECONDS=1 for a quick local run.
-ci: test fuzz native sanitizers tpu-lower jni-test dryrun
+ci: test fuzz native sanitizers tpu-lower jni-test dryrun metrics-smoke
 	$(PY) bench.py
 	@echo "ci: all gates green"
 

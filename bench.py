@@ -22,6 +22,10 @@ Env knobs:
   BENCH_PROBE_TIMEOUT  per-probe subprocess timeout (default 600 — a >390s
                        wedge has been observed; 150s was too short)
   BENCH_PROBE_PAUSE    sleep between failed probes (default 20)
+  BENCH_METRICS_SIDECAR  path: run with the observability spine enabled
+                       and write its JSON snapshot (registry + per-task
+                       rollup + journal stats) there, next to the
+                       BENCH_*.json the driver captures from stdout
 
 Note: each probe waits at least ~10s even when the remaining window is
 smaller (the quick-smoke BENCH_FIGHT_SECONDS=1 run still takes ~10s).
@@ -110,6 +114,12 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", True)
 
+    sidecar = os.environ.get("BENCH_METRICS_SIDECAR", "")
+    if sidecar:
+        from spark_rapids_tpu import observability as obs
+        obs.enable()
+        obs.reset()
+
     from bench_impl import run
     result = run()
     if backend == "cpu_fallback":
@@ -117,6 +127,10 @@ def main():
     elif backend == "cpu_pinned":
         result["metric"] += "_CPU_pinned"
     result["attempts"] = attempts
+    if sidecar:
+        with open(sidecar, "w") as f:
+            json.dump(obs.snapshot(), f, sort_keys=True, indent=2)
+        result["metrics_sidecar"] = sidecar
     print(json.dumps(result))
 
 

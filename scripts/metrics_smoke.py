@@ -1,0 +1,121 @@
+"""Metrics smoke gate (`make metrics-smoke`, ISSUE 1 acceptance):
+run a tiny TPC-DS model query with observability enabled and assert the
+whole spine lights up — a non-empty Prometheus exposition containing
+per-op latency histograms and shuffle byte counters, at least one
+OOM-retry event under force_retry_oom, and a metrics_report rendering
+of the journal dump.  Exits non-zero on the first missing signal."""
+
+import io
+import os
+import sys
+import tempfile
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+
+def fail(msg: str) -> "NoReturn":  # noqa: F821
+    print(f"metrics-smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> int:
+    from spark_rapids_tpu import observability as obs
+
+    obs.enable()
+    obs.reset()
+
+    from spark_rapids_tpu.memory import rmm_spark
+    from spark_rapids_tpu.memory.exceptions import GpuRetryOOM
+    from spark_rapids_tpu.utils.profiler import op_range
+
+    # -- flagship model query under a task association ------------------
+    rmm_spark.set_event_handler(64 << 20)
+    tid = threading.get_ident()
+    rmm_spark.current_thread_is_dedicated_to_task(1)
+
+    from spark_rapids_tpu.models import tpcds
+
+    d = tpcds.gen_q5(rows=2048, stores=8)
+    q5 = tpcds.make_q5(stores=8, join_capacity=4096)
+    with op_range("tpcds_q5_model"):
+        outs = q5(d)
+        jax.block_until_ready(outs)
+
+    # an eager instrumented op entry point (traced -> op_range bracket)
+    from spark_rapids_tpu.columns.column import Column
+    from spark_rapids_tpu.ops import murmur3_32
+
+    col = Column.from_strings(["tpc", "ds", "q5", "metrics"])
+    murmur3_32([col], 42)
+
+    # -- shuffle write (kudo WriteMetrics -> registry) ------------------
+    from spark_rapids_tpu.shuffle import kudo
+
+    buf = io.BytesIO()
+    wm = kudo.write_to_stream_with_metrics([col], buf, 0, 4)
+    if wm.written_bytes <= 0:
+        fail("kudo write produced no bytes")
+
+    # -- forced OOM retry through the state machine ---------------------
+    rmm_spark.force_retry_oom(tid, 1)
+    adaptor = rmm_spark.get_adaptor()
+    try:
+        adaptor.allocate(1024)
+    except GpuRetryOOM:
+        pass
+    else:
+        fail("force_retry_oom did not raise GpuRetryOOM")
+    adaptor.allocate(1024)
+    adaptor.deallocate(1024)
+    rmm_spark.task_done(1)
+
+    # -- assertions on the exposition -----------------------------------
+    text = obs.expose_text()
+    if not text.strip():
+        fail("Prometheus exposition is empty")
+    for needle in ("srt_op_latency_ns_bucket", 'op="tpcds_q5_model"',
+                   "srt_shuffle_write_bytes_total",
+                   "srt_oom_retry_total"):
+        if needle not in text:
+            fail(f"exposition missing {needle!r}")
+    if not obs.JOURNAL.records("oom_retry"):
+        fail("journal has no oom_retry event")
+
+    snap = obs.snapshot()
+    if "1" not in snap["tasks"]:
+        fail("task 1 missing from per-task rollup")
+    if snap["tasks"]["1"]["retry_oom"] < 1:
+        fail("task 1 rollup did not fold the retry count")
+
+    # -- journal dump -> metrics_report ---------------------------------
+    from spark_rapids_tpu.tools import metrics_report
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "journal.jsonl")
+        n = obs.dump_journal_jsonl(path)
+        if n <= 0:
+            fail("journal dump wrote no records")
+        rollups, registry, events = metrics_report.split_records(
+            metrics_report.load_jsonl([path]))
+        if 1 not in rollups:
+            fail("metrics_report found no rollup for task 1")
+        if registry is None:
+            fail("metrics_report found no registry snapshot")
+        metrics_report.main([path])
+
+    rmm_spark.clear_event_handler()
+    exposition_lines = len(text.splitlines())
+    print(f"metrics-smoke: OK ({exposition_lines} exposition lines, "
+          f"{len(obs.JOURNAL)} journal events)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,6 +9,7 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from spark_rapids_tpu import observability as _obs
 from spark_rapids_tpu.memory.resource import LimitingMemoryResource
 from spark_rapids_tpu.memory.spark_resource_adaptor import (
     GPU, CPU, CPU_OR_GPU, SparkResourceAdaptor)
@@ -28,10 +29,17 @@ def set_event_handler(limit_bytes: int,
         _adaptor = SparkResourceAdaptor(LimitingMemoryResource(limit_bytes),
                                         log_path=log_path)
         # native-side adaptor -> managed-side thread registry callback
-        # (reference SparkResourceAdaptorJni.cpp:66-80 removeThread)
+        # (reference SparkResourceAdaptorJni.cpp:66-80 removeThread);
+        # the observability task table unbinds on the same signal so the
+        # two thread->task maps cannot drift
         from spark_rapids_tpu.memory.thread_state_registry import \
             REGISTRY as _TSR
-        _adaptor.on_thread_removed = _TSR.remove_thread
+
+        def _on_removed(thread_id: int):
+            _TSR.remove_thread(thread_id)
+            _obs.TASKS.unbind_thread(thread_id)
+
+        _adaptor.on_thread_removed = _on_removed
         return _adaptor
 
 
@@ -68,6 +76,7 @@ def start_dedicated_task_thread(thread_id: int, task_id: int):
     except BaseException:
         REGISTRY.remove_thread(thread_id)
         raise
+    _obs.TASKS.bind_thread(thread_id, (task_id,))
 
 
 def current_thread_is_dedicated_to_task(task_id: int):
@@ -76,26 +85,46 @@ def current_thread_is_dedicated_to_task(task_id: int):
 
 
 def shuffle_thread_working_on_tasks(task_ids):
-    get_adaptor().pool_thread_working_on_tasks(True, current_thread_id(),
-                                               task_ids)
+    pool_thread_working_on_tasks(True, current_thread_id(), task_ids)
 
 
 def pool_thread_working_on_tasks(is_for_shuffle: bool, thread_id: int,
                                  task_ids):
     get_adaptor().pool_thread_working_on_tasks(is_for_shuffle, thread_id,
                                                task_ids)
+    _obs.TASKS.bind_thread(thread_id, task_ids)
 
 
 def pool_thread_finished_for_tasks(thread_id: int, task_ids):
     get_adaptor().pool_thread_finished_for_tasks(thread_id, task_ids)
+    _obs.TASKS.unbind_thread(thread_id, task_ids)
 
 
 def remove_current_thread_association():
     get_adaptor().remove_thread_association(current_thread_id(), -1)
+    _obs.TASKS.unbind_thread(current_thread_id())
 
 
 def task_done(task_id: int):
-    return get_adaptor().task_done(task_id)
+    adaptor = get_adaptor()
+    ret = adaptor.task_done(task_id)
+    if _obs.is_enabled():
+        # pull the state machine's per-task counters (the
+        # getAndResetNumRetryThrow / getTotalBlockedOrLostTime analogs)
+        # into the observability rollup, then release the bookkeeping
+        _obs.TASKS.fold_rmm_task(
+            task_id,
+            retry_oom=adaptor.get_and_reset_num_retry_throw(task_id),
+            split_retry_oom=adaptor.get_and_reset_num_split_retry_throw(
+                task_id),
+            blocked_time_ns=adaptor.get_and_reset_block_time(task_id),
+            lost_time_ns=adaptor.get_and_reset_compute_time_lost_to_retry(
+                task_id),
+            max_device_memory=adaptor.get_and_reset_gpu_max_memory_allocated(
+                task_id))
+        adaptor.remove_task_metrics(task_id)
+        _obs.JOURNAL.emit("task_done", task=task_id)
+    return ret
 
 
 def force_retry_oom(thread_id: int, num_ooms: int = 1,

@@ -36,6 +36,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Set
 
+from spark_rapids_tpu import observability as _obs
 from spark_rapids_tpu.memory import exceptions as exc
 from spark_rapids_tpu.memory.resource import (AllocationFailed,
                                               MemoryResource)
@@ -135,12 +136,19 @@ class _ThreadState:
 
     def before_block(self):
         self._block_start = time.monotonic()
+        _obs.record_oom_event("thread_blocked", thread_id=self.thread_id,
+                              task_id=self.task_id,
+                              is_cpu=self.is_cpu_alloc)
 
     def after_block(self):
         if self._block_start is not None:
-            self.metrics.time_blocked_nanos += int(
-                (time.monotonic() - self._block_start) * 1e9)
+            blocked_ns = int((time.monotonic() - self._block_start) * 1e9)
+            self.metrics.time_blocked_nanos += blocked_ns
             self._block_start = None
+            _obs.record_oom_event("thread_unblocked",
+                                  thread_id=self.thread_id,
+                                  task_id=self.task_id,
+                                  blocked_ns=blocked_ns)
 
     def record_failed_retry_time(self):
         now = time.monotonic()
@@ -433,6 +441,8 @@ class SparkResourceAdaptor:
 
     def _throw_retry_oom(self, t: _ThreadState):
         t.metrics.num_times_retry_throw += 1
+        _obs.record_oom_event("oom_retry", thread_id=t.thread_id,
+                              task_id=t.task_id, is_cpu=t.is_cpu_alloc)
         self._check_before_oom(t)
         t.record_failed_retry_time()
         if t.is_cpu_alloc:
@@ -441,6 +451,8 @@ class SparkResourceAdaptor:
 
     def _throw_split_and_retry_oom(self, t: _ThreadState):
         t.metrics.num_times_split_retry_throw += 1
+        _obs.record_oom_event("oom_split_retry", thread_id=t.thread_id,
+                              task_id=t.task_id, is_cpu=t.is_cpu_alloc)
         self._check_before_oom(t)
         t.record_failed_retry_time()
         if t.is_cpu_alloc:
@@ -648,6 +660,9 @@ class SparkResourceAdaptor:
                 self._log_status(
                     "INJECTED_RETRY_OOM_" + ("CPU" if is_for_cpu else "GPU"),
                     thread_id, t.task_id, t.state)
+                _obs.record_oom_event("oom_retry", thread_id=thread_id,
+                                      task_id=t.task_id, is_cpu=is_for_cpu,
+                                      injected=True)
                 t.record_failed_retry_time()
                 raise (exc.CpuRetryOOM("injected RetryOOM") if is_for_cpu
                        else exc.GpuRetryOOM("injected RetryOOM"))
@@ -667,6 +682,10 @@ class SparkResourceAdaptor:
                     "INJECTED_SPLIT_AND_RETRY_OOM_"
                     + ("CPU" if is_for_cpu else "GPU"),
                     thread_id, t.task_id, t.state)
+                _obs.record_oom_event("oom_split_retry",
+                                      thread_id=thread_id,
+                                      task_id=t.task_id, is_cpu=is_for_cpu,
+                                      injected=True)
                 t.record_failed_retry_time()
                 raise (exc.CpuSplitAndRetryOOM("injected SplitAndRetryOOM")
                        if is_for_cpu
@@ -710,6 +729,7 @@ class SparkResourceAdaptor:
                 t.metrics.gpu_max_memory_allocated = max(
                     t.metrics.gpu_max_memory_allocated,
                     self.gpu_memory_allocated_bytes)
+                _obs.record_device_memory(self.gpu_memory_allocated_bytes)
         self._wake_next_highest_priority_blocked(is_for_cpu)
 
     def _post_alloc_failed_core(self, thread_id: int, is_for_cpu: bool,
@@ -750,6 +770,7 @@ class SparkResourceAdaptor:
                 if not t.is_in_spilling:
                     t.metrics.gpu_memory_active_footprint -= num_bytes
                 self.gpu_memory_allocated_bytes -= num_bytes
+                _obs.record_device_memory(self.gpu_memory_allocated_bytes)
         for other in self._threads.values():
             if other.thread_id != tid and other.state == THREAD_ALLOC \
                     and other.is_cpu_alloc == is_for_cpu:

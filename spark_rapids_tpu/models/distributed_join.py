@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from spark_rapids_tpu.ops.device_join import inner_join_device
+from spark_rapids_tpu.utils.jax_compat import shard_map
 from spark_rapids_tpu.parallel.exchange import exchange
 
 
@@ -62,7 +63,7 @@ def make_distributed_join(mesh: Mesh, exch_cap: int, pair_cap: int):
     ax = mesh.axis_names[0]
     body = partial(_local_step, axis_name=ax, n_parts=n,
                    exch_cap=exch_cap, pair_cap=pair_cap)
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body, mesh=mesh,
         in_specs=(P(ax), P(ax), P(ax), P(ax)),
         out_specs=(P(ax), P(ax), P(ax), P(ax), P(ax), P(ax)))

@@ -1,0 +1,244 @@
+"""Unified observability spine: metrics registry + per-task metrics +
+structured event journal (ISSUE 1 tentpole).
+
+The reference library explains *why* a query was slow through three
+disconnected surfaces — the CUPTI profiler stream, the NVML monitor,
+and RmmSpark's per-task retry/blocked-time accounting.  This package is
+the spine that connects our analogs of those islands:
+
+  * ``METRICS``  — process-wide :class:`MetricsRegistry` (counters,
+    gauges, histograms; Prometheus text + JSON exposition);
+  * ``TASKS``    — :class:`TaskMetricsTable` keyed by the task ids the
+    OOM runtime tracks (memory/rmm_spark.py registrations feed it);
+  * ``JOURNAL``  — ring-buffered :class:`EventJournal` for OOM
+    retry/split/block events, shuffle writes/merges, and exchange
+    capacity-doublings.
+
+Everything is OFF by default; ``enable()`` (or env
+``SPARK_RAPIDS_TPU_METRICS=1`` at import) flips one shared bool that
+every hook reads first, so the disabled op path costs a single
+attribute check.  Instrumented layers (utils/profiler.py op_range,
+shuffle/kudo.py, parallel/exchange.py, memory/) call the ``record_*``
+helpers below; they must never import back into those layers.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from spark_rapids_tpu.observability.journal import EventJournal
+from spark_rapids_tpu.observability.registry import (
+    DEFAULT_LATENCY_BUCKETS_NS, MetricsRegistry)
+from spark_rapids_tpu.observability.task_metrics import (
+    UNATTRIBUTED, TaskMetricsTable)
+
+
+class _Switch:
+    """The one shared enable flag (an object so the journal and task
+    table can hold a reference instead of importing this module)."""
+
+    __slots__ = ("enabled",)
+
+    def __init__(self):
+        self.enabled = False
+
+
+_SWITCH = _Switch()
+
+METRICS = MetricsRegistry(enabled=False)
+JOURNAL = EventJournal(capacity=8192, enabled_ref=_SWITCH)
+TASKS = TaskMetricsTable(enabled_ref=_SWITCH)
+
+
+def enable() -> None:
+    METRICS.enabled = True
+    _SWITCH.enabled = True
+
+
+def disable() -> None:
+    METRICS.enabled = False
+    _SWITCH.enabled = False
+
+
+def is_enabled() -> bool:
+    return _SWITCH.enabled
+
+
+def reset() -> None:
+    """Zero all registry series, journal records, and task rows (the
+    families and instrument handles stay valid)."""
+    METRICS.reset()
+    JOURNAL.clear()
+    TASKS.reset()
+
+
+# --------------------------------------------------------------- instruments
+# Named families created once at import; mutators on them are no-ops
+# while the registry is disabled.
+
+OP_LATENCY = METRICS.histogram(
+    "srt_op_latency_ns", "Host-side op bracket latency (op_range)",
+    labels=("op",), buckets=DEFAULT_LATENCY_BUCKETS_NS, max_series=256)
+SHUFFLE_WRITE_BYTES = METRICS.counter(
+    "srt_shuffle_write_bytes_total", "Kudo shuffle bytes serialized")
+SHUFFLE_WRITE_TIME = METRICS.counter(
+    "srt_shuffle_write_time_ns_total", "Kudo shuffle write copy time")
+SHUFFLE_MERGE_ROWS = METRICS.counter(
+    "srt_shuffle_merge_rows_total", "Rows concatenated by kudo merges")
+SHUFFLE_MERGE_TIME = METRICS.counter(
+    "srt_shuffle_merge_time_ns_total",
+    "Kudo merge parse+concat time")
+OOM_RETRY = METRICS.counter(
+    "srt_oom_retry_total", "GpuRetryOOM/CpuRetryOOM throws",
+    labels=("device",))
+OOM_SPLIT_RETRY = METRICS.counter(
+    "srt_oom_split_retry_total",
+    "GpuSplitAndRetryOOM/CpuSplitAndRetryOOM throws", labels=("device",))
+THREAD_BLOCKED_TIME = METRICS.counter(
+    "srt_thread_blocked_time_ns_total",
+    "Time threads spent BLOCKED/BUFN in the OOM state machine")
+DEVICE_MEM_ALLOCATED = METRICS.gauge(
+    "srt_device_memory_allocated_bytes",
+    "Device bytes currently reserved through the adaptor")
+HBM_BYTES_IN_USE = METRICS.gauge(
+    "srt_hbm_bytes_in_use", "Backend-reported HBM bytes in use",
+    labels=("device",), max_series=128)
+EXCHANGE_DOUBLINGS = METRICS.counter(
+    "srt_exchange_capacity_doublings_total",
+    "ICI exchange capacity-retry doublings")
+JOURNAL_DROPPED = METRICS.gauge(
+    "srt_journal_dropped_events",
+    "Journal events lost to ring overwrite")
+
+
+# ------------------------------------------------------------ record helpers
+# Called from the instrumented layers.  Each starts with the switch
+# check so a disabled run pays one attribute read.
+
+
+def record_op(op: str, dur_ns: int) -> None:
+    """utils/profiler.op_range close hook."""
+    if not _SWITCH.enabled:
+        return
+    OP_LATENCY.observe(dur_ns, labels=(op,))
+    TASKS.note_op(op, dur_ns)
+
+
+def record_shuffle_write(num_bytes: int, dur_ns: int, rows: int) -> None:
+    if not _SWITCH.enabled:
+        return
+    SHUFFLE_WRITE_BYTES.inc(num_bytes)
+    SHUFFLE_WRITE_TIME.inc(dur_ns)
+    TASKS.note_shuffle_write(num_bytes, dur_ns)
+    JOURNAL.emit("shuffle_write", bytes=num_bytes, rows=rows,
+                 dur_ns=dur_ns, thread=threading.get_ident())
+
+
+def record_shuffle_merge(rows: int, parse_ns: int, concat_ns: int,
+                         tables: int) -> None:
+    if not _SWITCH.enabled:
+        return
+    SHUFFLE_MERGE_ROWS.inc(rows)
+    SHUFFLE_MERGE_TIME.inc(parse_ns + concat_ns)
+    TASKS.note_shuffle_merge(rows, parse_ns + concat_ns)
+    JOURNAL.emit("shuffle_merge", rows=rows, tables=tables,
+                 parse_ns=parse_ns, concat_ns=concat_ns,
+                 thread=threading.get_ident())
+
+
+def record_oom_event(kind: str, *, thread_id: int,
+                     task_id: Optional[int], is_cpu: bool = False,
+                     injected: bool = False, **extra) -> None:
+    """OOM state machine hook: kind in {'oom_retry', 'oom_split_retry',
+    'thread_blocked', 'thread_unblocked', 'thread_removed'}."""
+    if not _SWITCH.enabled:
+        return
+    device = "cpu" if is_cpu else "device"
+    if kind == "oom_retry":
+        OOM_RETRY.inc(labels=(device,))
+    elif kind == "oom_split_retry":
+        OOM_SPLIT_RETRY.inc(labels=(device,))
+    elif kind == "thread_unblocked":
+        THREAD_BLOCKED_TIME.inc(extra.get("blocked_ns", 0))
+    TASKS.note_event(thread_id)
+    JOURNAL.emit(kind, thread=thread_id,
+                 task=task_id if task_id is not None else UNATTRIBUTED,
+                 injected=injected, device=device, **extra)
+
+
+def record_exchange_doubling(from_capacity: int, to_capacity: int,
+                             attempt: int) -> None:
+    if not _SWITCH.enabled:
+        return
+    EXCHANGE_DOUBLINGS.inc()
+    JOURNAL.emit("exchange_capacity_doubling", from_capacity=from_capacity,
+                 to_capacity=to_capacity, attempt=attempt)
+
+
+def record_device_memory(allocated_bytes: int) -> None:
+    if not _SWITCH.enabled:
+        return
+    DEVICE_MEM_ALLOCATED.set(allocated_bytes)
+
+
+def record_hbm_sample(device_index: int, bytes_in_use: int) -> None:
+    if not _SWITCH.enabled:
+        return
+    HBM_BYTES_IN_USE.set(bytes_in_use, labels=(str(device_index),))
+
+
+# ------------------------------------------------------------------- dumping
+
+
+def expose_text() -> str:
+    """Prometheus text exposition of the process registry."""
+    JOURNAL_DROPPED.set(JOURNAL.dropped)
+    return METRICS.expose_text()
+
+
+def snapshot() -> dict:
+    """JSON-able state: registry + per-task rollup + journal stats."""
+    JOURNAL_DROPPED.set(JOURNAL.dropped)
+    return {
+        "registry": METRICS.snapshot(),
+        "tasks": {str(t): d for t, d in TASKS.rollup().items()},
+        "journal": {"events": len(JOURNAL),
+                    "dropped": JOURNAL.dropped,
+                    "by_kind": JOURNAL.counts_by_kind()},
+    }
+
+
+def dump_journal_jsonl(path_or_file) -> int:
+    """Journal ring + one ``task_rollup`` record per task + one
+    ``registry_snapshot`` record, as JSON Lines — the input format of
+    tools/metrics_report.py (and accepted by tools/profile_converter).
+    Returns the number of records written."""
+    import json as _json
+
+    recs = JOURNAL.records()
+    n = len(recs)
+
+    def _write(f):
+        nonlocal n
+        for r in recs:
+            f.write(_json.dumps(r) + "\n")
+        for task_id, d in TASKS.rollup().items():
+            f.write(_json.dumps(
+                {"kind": "task_rollup", "task": task_id, **d}) + "\n")
+            n += 1
+        f.write(_json.dumps({"kind": "registry_snapshot",
+                             "registry": METRICS.snapshot()}) + "\n")
+        n += 1
+
+    if hasattr(path_or_file, "write"):
+        _write(path_or_file)
+    else:
+        with open(path_or_file, "w") as f:
+            _write(f)
+    return n
+
+
+if os.environ.get("SPARK_RAPIDS_TPU_METRICS", "") not in ("", "0"):
+    enable()

@@ -1,0 +1,101 @@
+"""Structured event journal: append-only, ring-buffered, JSONL-dumpable.
+
+The reference's explain-why-it-was-slow surface is event-shaped, not
+gauge-shaped: RmmSpark logs every OOM retry/split/block transition to a
+CSV state log, the CUPTI profiler streams activity records, kudo counts
+writes/merges.  This journal is the unified host for those discrete
+events here: OOM retry/split/block/remove, shuffle writes/merges,
+exchange capacity-doublings, task completion rollups.
+
+Records are plain dicts with the same ``kind``/``t_ns`` envelope as the
+profiler's DataWriter records (utils/profiler.py), so
+tools/profile_converter.py can interleave a journal dump with a
+profiler stream on one timeline.  The buffer is a bounded ring — a
+long-lived executor can emit forever; readers get the most recent
+`capacity` events plus a count of how many were overwritten.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+
+class EventJournal:
+    def __init__(self, capacity: int = 8192, enabled_ref=None):
+        """`enabled_ref`: object with a truthy `.enabled` attribute
+        consulted on every emit (the shared observability switch);
+        None means always-on (tests)."""
+        self.capacity = capacity
+        self._enabled_ref = enabled_ref
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=capacity)
+        self._seq = 0
+
+    # ------------------------------------------------------------- write
+
+    def emit(self, kind: str, **fields) -> None:
+        """Append one event.  Near-zero cost when the shared switch is
+        off: a single attribute read and return."""
+        ref = self._enabled_ref
+        if ref is not None and not ref.enabled:
+            return
+        rec = {"kind": kind, "t_ns": time.monotonic_ns(), **fields}
+        with self._lock:
+            self._seq += 1
+            rec["seq"] = self._seq
+            self._ring.append(rec)
+
+    # -------------------------------------------------------------- read
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    @property
+    def total_emitted(self) -> int:
+        with self._lock:
+            return self._seq
+
+    @property
+    def dropped(self) -> int:
+        """Events overwritten by ring wrap-around."""
+        with self._lock:
+            return self._seq - len(self._ring)
+
+    def records(self, kind: Optional[str] = None) -> List[Dict]:
+        with self._lock:
+            recs = list(self._ring)
+        if kind is not None:
+            recs = [r for r in recs if r.get("kind") == kind]
+        return recs
+
+    def counts_by_kind(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for r in self.records():
+            k = r.get("kind", "?")
+            out[k] = out.get(k, 0) + 1
+        return out
+
+    # -------------------------------------------------------------- dump
+
+    def dump_jsonl(self, path_or_file) -> int:
+        """Write the current ring as JSON Lines; returns record count.
+        Accepts a path or an open text file object."""
+        recs = self.records()
+        if hasattr(path_or_file, "write"):
+            for r in recs:
+                path_or_file.write(json.dumps(r) + "\n")
+        else:
+            with open(path_or_file, "w") as f:
+                for r in recs:
+                    f.write(json.dumps(r) + "\n")
+        return len(recs)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._seq = 0

@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from spark_rapids_tpu import observability as _obs
+
 _I32 = jnp.int32
 
 
@@ -119,7 +121,10 @@ def with_capacity_retry(make_step: Callable[[int], Callable],
             if not bool(np.any(np.asarray(out[overflow_index]))):
                 return out, cap
             if attempt < max_doublings:
+                _obs.record_exchange_doubling(cap, cap * 2, attempt)
                 cap *= 2
+        _obs.JOURNAL.emit("exchange_capacity_exceeded", capacity=cap,
+                          doublings=max_doublings)
         raise CapacityExceeded(cap, max_doublings)
 
     return run

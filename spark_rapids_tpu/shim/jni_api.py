@@ -116,3 +116,49 @@ def sort_merge_inner_join(left_handles: Sequence[int],
         lc = Column(dtypes.INT32, int(li.shape[0]), data=li)
         rc = Column(dtypes.INT32, int(ri.shape[0]), data=ri)
         return [REGISTRY.register(lc), REGISTRY.register(rc)]
+
+
+# --------------------------------------------------------- observability
+# (reference: RmmSpark getAndReset* + Profiler control surface; here the
+# unified registry/journal is exported to the JVM as text/JSON blobs so
+# the binding needs no schema compiler)
+
+
+def metrics_set_enabled(enabled: bool) -> bool:
+    """Flip the process-wide observability switch; returns prior state."""
+    from spark_rapids_tpu import observability as obs
+    prior = obs.is_enabled()
+    (obs.enable if enabled else obs.disable)()
+    return prior
+
+
+def metrics_enabled() -> bool:
+    from spark_rapids_tpu import observability as obs
+    return obs.is_enabled()
+
+
+def metrics_expose_text() -> str:
+    """Prometheus text-format exposition of the process registry."""
+    from spark_rapids_tpu import observability as obs
+    return obs.expose_text()
+
+
+def metrics_snapshot_json() -> str:
+    """JSON snapshot (registry + per-task rollup + journal stats) for
+    the JVM shim."""
+    import json
+
+    from spark_rapids_tpu import observability as obs
+    return json.dumps(obs.snapshot(), sort_keys=True)
+
+
+def metrics_journal_dump(path: str) -> int:
+    """Dump the event journal (+ task rollups + registry snapshot) as
+    JSONL; returns records written."""
+    from spark_rapids_tpu import observability as obs
+    return obs.dump_journal_jsonl(path)
+
+
+def metrics_reset() -> None:
+    from spark_rapids_tpu import observability as obs
+    obs.reset()

@@ -46,7 +46,19 @@ def initialize() -> None:
             jax.config.update("jax_num_cpu_devices", n)
         except RuntimeError:
             pass   # backend already up: device count locked
+        except AttributeError:
+            # jax<0.4.38: no such option — the XLA_FLAGS path below is
+            # the only pre-backend-init knob there
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags
+                    + f" --xla_force_host_platform_device_count={n}"
+                ).strip()
     jax.config.update("jax_enable_x64", True)
+    from spark_rapids_tpu.utils.jax_compat import \
+        ensure_partitionable_threefry
+    ensure_partitionable_threefry()
     _INITIALIZED = True
 
 
@@ -660,6 +672,42 @@ def profiler_stop() -> None:
 def profiler_shutdown() -> None:
     from spark_rapids_tpu.utils.profiler import Profiler
     Profiler.shutdown()
+
+
+# ------------------------------------------------------- observability
+# (primitive-only twins of jni_api's metrics entries: the JVM pulls the
+# registry as a Prometheus text blob or a JSON string and dumps the
+# journal to a path it owns)
+
+
+def metrics_set_enabled(enabled: bool) -> bool:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.metrics_set_enabled(bool(enabled))
+
+
+def metrics_enabled() -> bool:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.metrics_enabled()
+
+
+def metrics_expose_text() -> str:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.metrics_expose_text()
+
+
+def metrics_snapshot_json() -> str:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.metrics_snapshot_json()
+
+
+def metrics_journal_dump(path: str) -> int:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.metrics_journal_dump(path)
+
+
+def metrics_reset() -> None:
+    from spark_rapids_tpu.shim import jni_api
+    jni_api.metrics_reset()
 
 
 # --------------------------------------------------------- HostTable

@@ -30,6 +30,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from spark_rapids_tpu import observability as _obs
 from spark_rapids_tpu.columns.column import Column
 from spark_rapids_tpu.columns.dtypes import Kind
 from spark_rapids_tpu.columns.table import Table
@@ -428,8 +429,11 @@ def write_to_stream_with_metrics(columns, out, row_offset: int,
     import time as _time
     t0 = _time.monotonic_ns()
     n = write_to_stream(columns, out, row_offset, num_rows)
-    return WriteMetrics(written_bytes=n,
-                        copy_time_ns=_time.monotonic_ns() - t0)
+    dur = _time.monotonic_ns() - t0
+    # fold into the process metrics spine (shuffle byte counters +
+    # per-task attribution + journal event); no-op when disabled
+    _obs.record_shuffle_write(n, dur, num_rows)
+    return WriteMetrics(written_bytes=n, copy_time_ns=dur)
 
 
 def merge_to_table_with_metrics(kudo_tables, fields):
@@ -441,6 +445,8 @@ def merge_to_table_with_metrics(kudo_tables, fields):
             for i, f in enumerate(fields)]
     t2 = _time.monotonic_ns()
     table = Table(cols)
+    _obs.record_shuffle_merge(table.num_rows, t1 - t0, t2 - t1,
+                              len(kudo_tables))
     return table, MergeMetrics(parse_time_ns=t1 - t0,
                                concat_time_ns=t2 - t1,
                                total_rows=table.num_rows)

@@ -1,0 +1,179 @@
+"""Render a per-task / per-op summary from an observability journal
+dump (the analyst-facing half of ISSUE 1's exposition story; the
+reference's counterpart is the profile converter's text report mode
+plus the task-level numbers Spark pulls through RmmSpark.getAndReset*).
+
+Input: JSONL files written by
+``spark_rapids_tpu.observability.dump_journal_jsonl`` (or the shim's
+``metrics_journal_dump``): raw journal events interleaved with one
+``task_rollup`` record per task and a final ``registry_snapshot``.
+Unknown kinds are counted, never fatal — the journal schema is allowed
+to grow ahead of this tool.
+
+Usage:
+    python -m spark_rapids_tpu.tools.metrics_report journal.jsonl
+    python -m spark_rapids_tpu.tools.metrics_report journal.jsonl --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, Iterable, List
+
+
+def load_jsonl(paths: Iterable[str]) -> List[dict]:
+    records: List[dict] = []
+    for p in paths:
+        with open(p) as f:
+            for i, line in enumerate(f):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    print(f"{p}:{i + 1}: skipping unparseable line",
+                          file=sys.stderr)
+                    continue
+                if isinstance(rec, dict):
+                    records.append(rec)
+    return records
+
+
+def split_records(records: List[dict]):
+    """(task_rollups, registry_snapshot, events)."""
+    rollups: Dict[int, dict] = {}
+    registry = None
+    events: List[dict] = []
+    for r in records:
+        kind = r.get("kind")
+        if kind == "task_rollup":
+            rollups[int(r.get("task", -1))] = r
+        elif kind == "registry_snapshot":
+            registry = r.get("registry")
+        else:
+            events.append(r)
+    return rollups, registry, events
+
+
+def _ms(ns: int) -> str:
+    return f"{ns / 1e6:.3f}"
+
+
+def render_task_table(rollups: Dict[int, dict]) -> List[str]:
+    out = ["per-task summary", ""]
+    hdr = (f"{'task':>6}  {'op_calls':>8}  {'op_ms':>10}  "
+           f"{'shuf_wr_B':>10}  {'mrg_rows':>8}  {'retry':>5}  "
+           f"{'split':>5}  {'blocked_ms':>10}  {'max_mem_B':>10}")
+    out.append(hdr)
+    out.append("-" * len(hdr))
+    for task in sorted(rollups):
+        r = rollups[task]
+        ops = r.get("ops", {})
+        calls = sum(o.get("calls", 0) for o in ops.values())
+        op_ns = sum(o.get("time_ns", 0) for o in ops.values())
+        name = "driver" if task == -1 else str(task)
+        out.append(
+            f"{name:>6}  {calls:>8}  {_ms(op_ns):>10}  "
+            f"{r.get('shuffle_write_bytes', 0):>10}  "
+            f"{r.get('shuffle_merge_rows', 0):>8}  "
+            f"{r.get('retry_oom', 0):>5}  "
+            f"{r.get('split_retry_oom', 0):>5}  "
+            f"{_ms(r.get('blocked_time_ns', 0)):>10}  "
+            f"{r.get('max_device_memory', 0):>10}")
+    return out
+
+
+def render_op_table(rollups: Dict[int, dict]) -> List[str]:
+    """Per-op rows aggregated across tasks, busiest first."""
+    agg: Dict[str, dict] = {}
+    for r in rollups.values():
+        for op, o in r.get("ops", {}).items():
+            a = agg.setdefault(op, {"calls": 0, "time_ns": 0})
+            a["calls"] += o.get("calls", 0)
+            a["time_ns"] += o.get("time_ns", 0)
+    out = ["", "per-op summary (all tasks)", ""]
+    if not agg:
+        out.append("(no op activity recorded)")
+        return out
+    w = max(len(op) for op in agg)
+    out.append(f"{'op':<{w}}  {'calls':>6}  {'total_ms':>10}  {'avg_us':>8}")
+    for op, a in sorted(agg.items(), key=lambda kv: -kv[1]["time_ns"]):
+        avg_us = a["time_ns"] / max(a["calls"], 1) / 1e3
+        out.append(f"{op:<{w}}  {a['calls']:>6}  "
+                   f"{_ms(a['time_ns']):>10}  {avg_us:>8.1f}")
+    return out
+
+
+def render_event_table(events: List[dict]) -> List[str]:
+    counts: Dict[str, int] = {}
+    for e in events:
+        k = e.get("kind", "?")
+        counts[k] = counts.get(k, 0) + 1
+    out = ["", "journal events", ""]
+    if not counts:
+        out.append("(journal empty)")
+        return out
+    w = max(len(k) for k in counts)
+    for k in sorted(counts, key=lambda k: -counts[k]):
+        out.append(f"{k:<{w}}  {counts[k]}")
+    ooms = [e for e in events
+            if e.get("kind") in ("oom_retry", "oom_split_retry")]
+    if ooms:
+        out.append("")
+        out.append("oom events (most recent last):")
+        for e in ooms[-10:]:
+            out.append(
+                f"  {e.get('kind')}: task={e.get('task')} "
+                f"thread={e.get('thread')} device={e.get('device')}"
+                f"{' injected' if e.get('injected') else ''}")
+    return out
+
+
+def build_report(records: List[dict]) -> dict:
+    """Machine-readable report (the --json output)."""
+    rollups, registry, events = split_records(records)
+    counts: Dict[str, int] = {}
+    for e in events:
+        k = e.get("kind", "?")
+        counts[k] = counts.get(k, 0) + 1
+    return {
+        "tasks": {str(t): {k: v for k, v in r.items() if k != "kind"}
+                  for t, r in rollups.items()},
+        "event_counts": counts,
+        "has_registry_snapshot": registry is not None,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Per-task/per-op report from an observability "
+                    "journal dump")
+    ap.add_argument("inputs", nargs="+", help="journal JSONL files")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON instead of tables")
+    args = ap.parse_args(argv)
+
+    records = load_jsonl(args.inputs)
+    if args.json:
+        print(json.dumps(build_report(records), indent=2, sort_keys=True))
+        return 0
+    rollups, registry, events = split_records(records)
+    lines: List[str] = []
+    if rollups:
+        lines += render_task_table(rollups)
+        lines += render_op_table(rollups)
+    else:
+        lines.append("(no task_rollup records in input)")
+    lines += render_event_table(events)
+    if registry is not None:
+        lines.append("")
+        lines.append(f"registry snapshot: {len(registry)} metric families")
+    print("\n".join(lines))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

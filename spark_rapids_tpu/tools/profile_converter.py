@@ -28,11 +28,47 @@ from typing import Dict, Iterable, List
 from spark_rapids_tpu.utils.profiler import iter_records
 
 
+def _iter_jsonl(blob: bytes):
+    for line in blob.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # a journal dump may be torn mid-write
+        if isinstance(rec, dict):
+            yield rec
+
+
+def _looks_like_jsonl(blob: bytes) -> bool:
+    """A journal dump's first line is a complete JSON object; a
+    DataWriter stream's first 'line' starts with a binary length prefix
+    (which can itself look like '{' — 123 == 0x7b — so sniffing a byte
+    is not enough) and never parses."""
+    first = blob.split(b"\n", 1)[0].strip()
+    if not first:
+        return False
+    try:
+        return isinstance(json.loads(first), dict)
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        return False
+
+
 def load_records(paths: Iterable[str]) -> List[dict]:
+    """Load profiler DataWriter streams AND observability journal JSONL
+    dumps (spark_rapids_tpu.observability.dump_journal_jsonl) onto one
+    timeline.  Format is sniffed per file by parsing the first line.
+    Unknown record kinds pass through — downstream renderers skip or
+    mark them instead of raising."""
     records: List[dict] = []
     for p in paths:
         with open(p, "rb") as f:
-            records.extend(iter_records(f.read()))
+            blob = f.read()
+        if _looks_like_jsonl(blob):
+            records.extend(_iter_jsonl(blob))
+        else:
+            records.extend(iter_records(blob))
     records.sort(key=lambda r: r.get("t_ns", 0))
     return records
 
@@ -62,6 +98,18 @@ def to_chrome_trace(records: List[dict]) -> dict:
             events.append({
                 "name": kind, "ph": "i", "ts": ts_us, "pid": 1,
                 "tid": 0, "s": "g",
+            })
+        elif kind in ("task_rollup", "registry_snapshot"):
+            pass  # journal-dump summary records: no timeline point
+        elif "t_ns" in r:
+            # journal events (oom_retry, shuffle_write, exchange
+            # doublings, future kinds): instant events on the emitting
+            # thread's track
+            events.append({
+                "name": kind or "?", "ph": "i", "ts": ts_us, "pid": 1,
+                "tid": r.get("thread", 0), "s": "t",
+                "args": {k: v for k, v in r.items()
+                         if k not in ("kind", "t_ns", "thread")},
             })
     return {"traceEvents": events, "displayTimeUnit": "ns"}
 
@@ -127,6 +175,16 @@ def main(argv=None) -> int:
         if a["allocs"]:
             print(f"allocs: {a['allocs']}  peak: {a['peak_bytes']}B  "
                   f"leaked: {a['leaked_bytes']}B")
+        known = {"op_range", "alloc", "free", "profiler_start",
+                 "profiler_stop", "task_rollup", "registry_snapshot"}
+        other: Dict[str, int] = {}
+        for r in records:
+            k = r.get("kind", "?")
+            if k not in known:
+                other[k] = other.get(k, 0) + 1
+        if other:
+            print("journal events: " + "  ".join(
+                f"{k}={n}" for k, n in sorted(other.items())))
     return 0
 
 

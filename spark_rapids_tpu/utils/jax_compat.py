@@ -1,0 +1,46 @@
+"""Version-compatibility shims for the jax API surface this library
+uses across the jax versions it runs on.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` to a top-level
+``jax.shard_map`` export; importing through here works on both sides of
+that move (this image ships 0.4.37, where only the experimental path
+exists).
+
+``ensure_partitionable_threefry`` pins the partitionable threefry
+implementation, which newer jax enables by default and which this
+library's generators rely on: with it, drawing N rows then the first
+M < N rows from the same seed yields the same prefix (ops/uuid_gen.py's
+deterministic-per-seed contract).  Classic threefry pairs counters by
+splitting the flat range in half, so the prefix property does not hold
+there.
+"""
+
+from __future__ import annotations
+
+def ensure_partitionable_threefry() -> None:
+    """Make seeded draws shape-prefix-stable on every jax version.
+
+    jax >= 0.4.36 defaults ``jax_threefry_partitionable`` on (and much
+    later removes the option entirely, partitionable being the only
+    implementation); 0.4.37 in this image still defaults it off."""
+    import jax
+    try:
+        if not jax.config.jax_threefry_partitionable:
+            jax.config.update("jax_threefry_partitionable", True)
+    except AttributeError:
+        pass    # option gone: partitionable is the only implementation
+
+
+try:                                    # jax >= 0.4.38 top-level export
+    from jax import shard_map           # type: ignore[attr-defined]
+except ImportError:                     # jax <= 0.4.37
+    import functools
+
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    @functools.wraps(_shard_map)
+    def shard_map(f, *args, check_rep: bool = False, **kwargs):
+        # check_rep defaults OFF: 0.4.37's replication checker lacks
+        # rules for several collectives these programs use (and the
+        # top-level export dropped the argument entirely)
+        return _shard_map(f, *args, check_rep=check_rep, **kwargs)

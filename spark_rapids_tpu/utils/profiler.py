@@ -20,6 +20,8 @@ from typing import Callable, List, Optional
 
 import jax
 
+from spark_rapids_tpu import observability as _obs
+
 
 class Config:
     """Profiler.Config.Builder analog (Profiler.java:133-145)."""
@@ -171,12 +173,16 @@ def op_range(name: str, **attrs):
     finally:
         if outer:
             s.discard(name)
+            dur_ns = time.monotonic_ns() - t0
             if prof is not None:
                 prof.record("op_range",
                             {"name": name,
-                             "dur_ns": time.monotonic_ns() - t0,
+                             "dur_ns": dur_ns,
                              "thread": threading.get_ident(),
                              **attrs})
+            # observability spine: per-op latency histogram + per-task
+            # attribution (no-op behind one bool when disabled)
+            _obs.record_op(name, dur_ns)
 
 
 _active_ranges = threading.local()

@@ -14,6 +14,8 @@ from typing import Callable, Dict, List, Optional
 
 import jax
 
+from spark_rapids_tpu import observability as _obs
+
 
 @dataclass
 class DeviceInfo:
@@ -99,6 +101,10 @@ def get_host_cpu_times() -> Dict[str, int]:
     except OSError as e:
         raise TelemetryNotSupported(f"/proc/stat unreadable: {e}")
     v = [int(x) for x in parts[1:8]]
+    if not any(v):
+        # gVisor-style sandboxes expose /proc/stat with every jiffy
+        # counter zero; that carries no signal, same as no counters
+        raise TelemetryNotSupported("/proc/stat reports zero jiffies")
     return {"user": v[0] + v[1], "system": v[2], "idle": v[3],
             "iowait": v[4]}
 
@@ -138,19 +144,27 @@ class Monitor:
         self.last_cpu_utilization: Optional[float] = None
         self._running = False
         self._thread: Optional[threading.Thread] = None
+        self._lifecycle = threading.Lock()
 
     def start(self):
-        if self._running:
-            return
-        self._running = True
-        self._thread = threading.Thread(target=self._loop, daemon=True)
-        self._thread.start()
+        with self._lifecycle:
+            if self._running:
+                return
+            self._running = True
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
 
-    def stop(self):
-        self._running = False
-        if self._thread is not None:
-            self._thread.join(self.period * 4 + 1)
-            self._thread = None
+    def stop(self, timeout: Optional[float] = None):
+        """Idempotent shutdown: safe to call repeatedly, concurrently,
+        before start, and even from the listener callback (the sampler
+        thread never joins itself).  Joins with a bounded timeout so a
+        wedged backend query can never hang the caller."""
+        with self._lifecycle:
+            self._running = False
+            t, self._thread = self._thread, None
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout if timeout is not None
+                   else self.period * 4 + 1)
 
     def _report(self, exc: Exception):
         self.error_count += 1
@@ -161,7 +175,12 @@ class Monitor:
                 pass  # an error-handler bug must not kill the monitor
 
     def _loop(self):
-        while self._running:
+        # `me` check: stop() clears _thread before (maybe) joining, so a
+        # stop/start pair that beats this loop's next _running read still
+        # terminates the old sampler — only the thread start() installed
+        # may keep looping, never two at once
+        me = threading.current_thread()
+        while self._running and self._thread is me:
             try:
                 infos = [get_device_info(i)
                          for i in range(get_device_count())]
@@ -169,6 +188,12 @@ class Monitor:
                 self._report(e)
                 time.sleep(self.period)
                 continue
+            # HBM occupancy -> observability gauge (NVML-monitor role in
+            # the reference's metrics pipeline); no-op when disabled
+            for info in infos:
+                b = info.memory_stats.get("bytes_in_use")
+                if b is not None:
+                    _obs.record_hbm_sample(info.index, b)
             try:
                 # host CPU is best-effort: an unreadable /proc/stat
                 # (non-Linux) must not starve the device listener

@@ -16,8 +16,20 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # jax<0.4.38 has no jax_num_cpu_devices; the XLA_FLAGS fallback
+    # above provides the 8-device virtual mesh there
+    pass
 jax.config.update("jax_enable_x64", True)
+
+# seeded draws must be shape-prefix-stable (newer jax's default;
+# 0.4.37 in this image still defaults the old implementation)
+from spark_rapids_tpu.utils.jax_compat import \
+    ensure_partitionable_threefry  # noqa: E402
+
+ensure_partitionable_threefry()
 
 
 def make_oom_adaptor(impl: str, limit: int = 1000):

@@ -1,0 +1,359 @@
+"""Observability spine tests (ISSUE 1 tentpole coverage): registry
+counter/histogram semantics under concurrent writers, per-task rollup
+across dedicated + pool threads, journal ring-buffer overflow,
+Prometheus/JSON exposition golden output, and the disabled fast path
+(no registry/journal growth when the switch is off)."""
+
+import io
+import json
+import threading
+
+import pytest
+
+from spark_rapids_tpu import observability as obs
+from spark_rapids_tpu.memory import exceptions as exc
+from spark_rapids_tpu.memory import rmm_spark
+from spark_rapids_tpu.observability.journal import EventJournal
+from spark_rapids_tpu.observability.registry import MetricsRegistry
+from spark_rapids_tpu.observability.task_metrics import TaskMetricsTable
+from spark_rapids_tpu.utils import telemetry
+
+
+@pytest.fixture
+def obs_enabled():
+    """Process observability on + clean, restored after the test."""
+    prior = obs.is_enabled()
+    obs.enable()
+    obs.reset()
+    yield
+    obs.reset()
+    if not prior:
+        obs.disable()
+
+
+@pytest.fixture
+def adaptor():
+    try:
+        rmm_spark.clear_event_handler()
+    except Exception:
+        pass
+    a = rmm_spark.set_event_handler(1 << 20)
+    yield a
+    rmm_spark.clear_event_handler()
+
+
+# ------------------------------------------------------------- registry
+
+
+def test_counter_concurrent_threads_exact():
+    reg = MetricsRegistry(enabled=True)
+    c = reg.counter("hits_total", "hits", labels=("kind",))
+    n_threads, n_incs = 8, 10_000
+
+    def worker(kind):
+        for _ in range(n_incs):
+            c.inc(labels=(kind,))
+
+    threads = [threading.Thread(target=worker, args=("even" if i % 2 else
+                                                     "odd",))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = reg.snapshot()["hits_total"]
+    total = {tuple(s["labels"]): s["value"] for s in snap["series"]}
+    assert total[("even",)] == 4 * n_incs
+    assert total[("odd",)] == 4 * n_incs
+
+
+def test_histogram_concurrent_threads_exact():
+    reg = MetricsRegistry(enabled=True)
+    h = reg.histogram("lat", "latency", buckets=(10, 100, 1000))
+    per_thread = list(range(1, 1001))
+
+    def worker():
+        for v in per_thread:
+            h.observe(v)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    s = reg.snapshot()["lat"]["series"][0]
+    assert s["count"] == 8 * len(per_thread)
+    assert s["sum"] == 8 * sum(per_thread)
+    assert sum(s["bucket_counts"]) == s["count"]
+    # values 1..10 land at-or-under the 10 bucket, per thread
+    assert s["bucket_counts"][0] == 8 * 10
+
+
+def test_label_cardinality_is_bounded():
+    reg = MetricsRegistry(enabled=True)
+    c = reg.counter("cap_total", "", labels=("op",), max_series=4)
+    for i in range(10):
+        c.inc(labels=(f"op{i}",))
+    snap = reg.snapshot()["cap_total"]
+    keys = {tuple(s["labels"]) for s in snap["series"]}
+    assert len(keys) == 5                       # 4 real + __other__
+    assert ("__other__",) in keys
+    other = next(s["value"] for s in snap["series"]
+                 if s["labels"] == ["__other__"])
+    assert other == 6
+    assert c.dropped_series == 6
+
+
+def test_family_registration_idempotent_and_kind_checked():
+    reg = MetricsRegistry(enabled=True)
+    a = reg.counter("x_total", "")
+    assert reg.counter("x_total", "") is a
+    with pytest.raises(ValueError):
+        reg.gauge("x_total", "")
+
+
+def test_disabled_registry_materializes_nothing():
+    reg = MetricsRegistry(enabled=False)
+    c = reg.counter("c_total", "", labels=("k",))
+    h = reg.histogram("h", "")
+    c.inc(labels=("a",))
+    h.observe(5)
+    assert reg.snapshot()["c_total"]["series"] == []
+    assert reg.snapshot()["h"]["series"] == []
+
+
+# ----------------------------------------------------------- exposition
+
+
+def test_expose_text_golden():
+    reg = MetricsRegistry(enabled=True)
+    c = reg.counter("req_total", "requests", labels=("op",))
+    c.inc(3, labels=("scan",))
+    c.inc(labels=("join",))
+    g = reg.gauge("mem_bytes", "bytes")
+    g.set(1024)
+    h = reg.histogram("lat_ns", "latency", buckets=(10, 100))
+    h.observe(5)
+    h.observe(50)
+    h.observe(500)
+    assert reg.expose_text() == (
+        "# HELP lat_ns latency\n"
+        "# TYPE lat_ns histogram\n"
+        'lat_ns_bucket{le="10"} 1\n'
+        'lat_ns_bucket{le="100"} 2\n'
+        'lat_ns_bucket{le="+Inf"} 3\n'
+        "lat_ns_sum 555\n"
+        "lat_ns_count 3\n"
+        "# HELP mem_bytes bytes\n"
+        "# TYPE mem_bytes gauge\n"
+        "mem_bytes 1024\n"
+        "# HELP req_total requests\n"
+        "# TYPE req_total counter\n"
+        'req_total{op="join"} 1\n'
+        'req_total{op="scan"} 3\n')
+
+
+def test_expose_text_escapes_label_values():
+    reg = MetricsRegistry(enabled=True)
+    c = reg.counter("esc_total", "", labels=("name",))
+    c.inc(labels=('a"b\\c\nd',))
+    assert 'esc_total{name="a\\"b\\\\c\\nd"} 1' in reg.expose_text()
+
+
+def test_snapshot_json_golden():
+    reg = MetricsRegistry(enabled=True)
+    reg.counter("n_total", "things", labels=("k",)).inc(2, labels=("v",))
+    assert json.loads(reg.snapshot_json()) == {
+        "n_total": {"kind": "counter", "help": "things",
+                    "labels": ["k"],
+                    "series": [{"labels": ["v"], "value": 2}]}}
+
+
+# -------------------------------------------------------------- journal
+
+
+def test_journal_ring_overflow_keeps_most_recent():
+    j = EventJournal(capacity=4)
+    for i in range(10):
+        j.emit("e", i=i)
+    assert len(j) == 4
+    assert j.total_emitted == 10
+    assert j.dropped == 6
+    recs = j.records()
+    assert [r["i"] for r in recs] == [6, 7, 8, 9]
+    assert [r["seq"] for r in recs] == [7, 8, 9, 10]
+
+
+def test_journal_kind_filter_and_dump():
+    j = EventJournal(capacity=16)
+    j.emit("a", x=1)
+    j.emit("b", x=2)
+    j.emit("a", x=3)
+    assert [r["x"] for r in j.records("a")] == [1, 3]
+    assert j.counts_by_kind() == {"a": 2, "b": 1}
+    buf = io.StringIO()
+    assert j.dump_jsonl(buf) == 3
+    lines = [json.loads(line) for line in buf.getvalue().splitlines()]
+    assert [r["kind"] for r in lines] == ["a", "b", "a"]
+
+
+def test_journal_respects_shared_switch():
+    class Ref:
+        enabled = False
+
+    j = EventJournal(capacity=4, enabled_ref=Ref())
+    j.emit("e")
+    assert len(j) == 0 and j.total_emitted == 0
+
+
+# --------------------------------------------------------- task metrics
+
+
+def test_task_table_rollup_dedicated_and_pool_bindings():
+    t = TaskMetricsTable()
+    t.bind_thread(100, (1,))          # dedicated task thread
+    t.bind_thread(200, (1, 2))        # pool thread shared by two tasks
+    t.note_op("scan", 1000, thread_id=100)
+    t.note_op("shuffle", 500, thread_id=200)
+    t.note_shuffle_write(4096, 50, thread_id=200)
+    t.note_op("orphan", 7, thread_id=999)   # unbound -> task -1
+    roll = t.rollup()
+    assert roll[1]["ops"]["scan"]["calls"] == 1
+    assert roll[1]["ops"]["shuffle"]["time_ns"] == 500
+    assert roll[2]["ops"]["shuffle"]["calls"] == 1
+    assert roll[1]["shuffle_write_bytes"] == 4096
+    assert roll[2]["shuffle_write_bytes"] == 4096
+    assert roll[-1]["ops"]["orphan"]["calls"] == 1
+    t.unbind_thread(200, (2,))
+    t.note_op("late", 1, thread_id=200)
+    roll = t.rollup()
+    assert "late" in roll[1]["ops"] and "late" not in roll[2]["ops"]
+
+
+def test_rmm_spark_rollup_across_threads(obs_enabled, adaptor):
+    """Dedicated + pool threads bound through the RmmSpark facade roll
+    up into one per-task row, including the OOM machine's fold at
+    task_done (the getAndReset* analogs)."""
+
+    def dedicated():
+        tid = threading.get_ident()
+        rmm_spark.start_dedicated_task_thread(tid, 7)
+        obs.record_op("scan", 1_000_000)
+        rmm_spark.force_retry_oom(tid, 1)
+        try:
+            adaptor.allocate(64)
+        except exc.GpuRetryOOM:
+            pass
+        adaptor.allocate(64)
+        adaptor.deallocate(64)
+        rmm_spark.task_done(7)
+
+    def pool():
+        tid = threading.get_ident()
+        rmm_spark.pool_thread_working_on_tasks(False, tid, [7, 8])
+        obs.record_op("shuffle_read", 500_000)
+        rmm_spark.pool_thread_finished_for_tasks(tid, [7, 8])
+
+    for target in (dedicated, pool):
+        th = threading.Thread(target=target)
+        th.start()
+        th.join(10)
+        assert not th.is_alive()
+
+    tasks = obs.snapshot()["tasks"]
+    assert tasks["7"]["retry_oom"] == 1
+    assert tasks["7"]["ops"]["scan"]["calls"] == 1
+    assert tasks["7"]["ops"]["shuffle_read"]["calls"] == 1
+    assert tasks["8"]["ops"]["shuffle_read"]["calls"] == 1
+    assert "scan" not in tasks["8"]["ops"]
+    kinds = obs.JOURNAL.counts_by_kind()
+    assert kinds.get("oom_retry", 0) >= 1
+    assert kinds.get("task_done", 0) == 1
+    # the registry side saw the same retry
+    assert 'srt_oom_retry_total{device="device"} 1' in obs.expose_text()
+
+
+# ---------------------------------------------------- disabled fast path
+
+
+def test_disabled_fast_path_no_growth():
+    """Acceptance: with observability off, the instrumented paths leave
+    no trace — no journal records, no registry series, no task rows."""
+    prior = obs.is_enabled()
+    obs.disable()
+    obs.reset()
+    try:
+        before = obs.METRICS.snapshot()
+        from spark_rapids_tpu.utils.profiler import op_range
+        with op_range("noop_bracket"):
+            pass
+        obs.record_op("x", 10)
+        obs.record_shuffle_write(100, 5, 2)
+        obs.record_shuffle_merge(10, 1, 2, 3)
+        obs.record_oom_event("oom_retry", thread_id=1, task_id=2)
+        obs.record_exchange_doubling(1, 2, 0)
+        obs.record_device_memory(123)
+        obs.record_hbm_sample(0, 456)
+        assert len(obs.JOURNAL) == 0
+        assert obs.JOURNAL.total_emitted == 0
+        assert obs.TASKS.rollup() == {}
+        assert obs.METRICS.snapshot() == before
+    finally:
+        if prior:
+            obs.enable()
+
+
+# ------------------------------------------------ journal dump round-trip
+
+
+def test_dump_journal_jsonl_feeds_metrics_report(obs_enabled, tmp_path):
+    obs.record_op("scan", 2_000_000)
+    obs.record_shuffle_write(8192, 100, 16)
+    obs.TASKS.fold_rmm_task(3, retry_oom=2, blocked_time_ns=5_000_000)
+    path = tmp_path / "journal.jsonl"
+    n = obs.dump_journal_jsonl(str(path))
+    assert n == len(obs.JOURNAL) + len(obs.TASKS.rollup()) + 1
+
+    from spark_rapids_tpu.tools import metrics_report
+    records = metrics_report.load_jsonl([str(path)])
+    rollups, registry, events = metrics_report.split_records(records)
+    assert rollups[3]["retry_oom"] == 2
+    assert registry is not None and "srt_op_latency_ns" in registry
+    report = metrics_report.build_report(records)
+    assert report["event_counts"]["shuffle_write"] == 1
+    assert report["has_registry_snapshot"]
+
+
+# ------------------------------------------------------- shim + telemetry
+
+
+def test_shim_metrics_entries(obs_enabled):
+    from spark_rapids_tpu.shim import jni_entry
+    obs.record_op("shim_op", 42)
+    assert jni_entry.metrics_enabled()
+    assert 'op="shim_op"' in jni_entry.metrics_expose_text()
+    snap = json.loads(jni_entry.metrics_snapshot_json())
+    assert "registry" in snap and "journal" in snap
+    prior = jni_entry.metrics_set_enabled(False)
+    assert prior is True and not obs.is_enabled()
+    jni_entry.metrics_set_enabled(True)
+    jni_entry.metrics_reset()
+    assert len(obs.JOURNAL) == 0
+
+
+def test_monitor_stop_idempotent():
+    m = telemetry.Monitor(10, listener=lambda infos: None)
+    m.stop()                      # before start: no-op
+    m.start()
+    m.start()                     # second start: no-op
+    m.stop(timeout=5)
+    m.stop(timeout=5)             # repeated stop: no-op
+    assert m._thread is None
+
+
+def test_hbm_sample_feeds_gauge(obs_enabled):
+    obs.record_hbm_sample(0, 1 << 30)
+    obs.record_hbm_sample(1, 2 << 30)
+    text = obs.expose_text()
+    assert 'srt_hbm_bytes_in_use{device="0"} 1073741824' in text
+    assert 'srt_hbm_bytes_in_use{device="1"} 2147483648' in text

@@ -58,3 +58,23 @@ def test_summary_rows():
     rows = pc.summarize(recs)
     assert rows[0] == {"op": "a", "calls": 2, "total_ns": 400,
                        "max_ns": 300, "avg_ns": 200}
+
+
+def test_load_records_sniffs_jsonl_vs_binary(tmp_path):
+    """A DataWriter stream whose first record is exactly 123 bytes has a
+    length prefix starting with 0x7b == '{' — the sniff must still route
+    it to the binary decoder, and a journal JSONL dump to the JSONL one."""
+    import struct
+
+    rec = {"kind": "op_range", "name": "x" * 60, "dur_ns": 5, "t_ns": 9}
+    payload = json.dumps(rec).encode()
+    payload += b" " * (123 - len(payload))        # pad to length 0x7b
+    assert len(payload) == 123
+    binary = tmp_path / "prof.bin"
+    binary.write_bytes(struct.pack("<I", len(payload)) + payload)
+
+    jsonl = tmp_path / "journal.jsonl"
+    jsonl.write_text(json.dumps({"kind": "oom_retry", "t_ns": 3}) + "\n")
+
+    recs = pc.load_records([str(binary), str(jsonl)])
+    assert [r["kind"] for r in recs] == ["oom_retry", "op_range"]
