@@ -4,8 +4,8 @@
 PY ?= python
 
 .PHONY: test fuzz native sanitizers bench bench-all dryrun tpu-lower \
-        jni-test kudo-bench metrics-smoke nightly-artifacts ci \
-        ci-nightly clean
+        jni-test kudo-bench metrics-smoke trace-smoke nightly-artifacts \
+        ci ci-nightly clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -51,6 +51,14 @@ jni-test:
 metrics-smoke:
 	$(PY) scripts/metrics_smoke.py
 
+# structured tracing gate: a TPC-DS model query with span tracing on
+# must produce a CONNECTED query->stage->op span tree, a kudo
+# write->merge trace-context round trip (KTRX header extension), a
+# loadable Perfetto/Chrome JSON via tools/trace_export, and
+# span-duration histograms in the Prometheus exposition
+trace-smoke:
+	$(PY) scripts/trace_smoke.py
+
 # NOTE: jax.config.update, not the env var — this image's sitecustomize
 # pre-imports jax with the axon backend, so JAX_PLATFORMS=cpu is too
 # late.  XLA_FLAGS still works (read at backend init, which happens
@@ -66,12 +74,13 @@ dryrun:
 # one-command premerge gate (reference ci/Jenkinsfile.premerge:196-232):
 # unit tests + OOM fuzz (python AND native adaptors differentially) +
 # sanitizer builds + TPU lowering gate + multichip dryrun +
-# observability smoke + bench.
+# observability + tracing smokes + bench.
 # Fails loudly on the first red step.  bench.py never hangs, but when
 # the relay is down it FIGHTS for the chip up to BENCH_FIGHT_SECONDS
 # (default 1500s) before emitting the CPU-fallback line — export
 # BENCH_FIGHT_SECONDS=1 for a quick local run.
-ci: test fuzz native sanitizers tpu-lower jni-test dryrun metrics-smoke
+ci: test fuzz native sanitizers tpu-lower jni-test dryrun metrics-smoke \
+    trace-smoke
 	$(PY) bench.py
 	@echo "ci: all gates green"
 

@@ -1,0 +1,173 @@
+"""Tracing smoke gate (`make trace-smoke`, ISSUE 2 acceptance): run a
+TPC-DS model query with span tracing enabled and assert the whole
+causality story holds end to end —
+
+  * a connected span tree: every op span walks parent links up to a
+    query- or stage-kind root (nothing is flat or orphaned),
+  * shuffle-carried context: a kudo stream written under a span and
+    merged on a thread with NO open span re-parents the merge span into
+    the WRITER's trace (the "KTRX" header extension round trip),
+  * exports: the span dump renders to a loadable Perfetto/Chrome JSON
+    via tools/trace_export, span records ride the journal JSONL, and
+    span-duration histograms appear in the Prometheus exposition.
+
+Exits non-zero on the first missing signal."""
+
+import io
+import json
+import os
+import sys
+import tempfile
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+
+def fail(msg: str) -> "NoReturn":  # noqa: F821
+    print(f"trace-smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> int:
+    from spark_rapids_tpu import observability as obs
+
+    obs.enable()
+    obs.enable_tracing()
+    obs.reset()
+
+    from spark_rapids_tpu.memory import rmm_spark
+
+    rmm_spark.set_event_handler(64 << 20)
+    rmm_spark.current_thread_is_dedicated_to_task(1)
+
+    # -- TPC-DS model query: query-root span + eager op child spans ----
+    from spark_rapids_tpu.columns import dtypes
+    from spark_rapids_tpu.columns.column import Column
+    from spark_rapids_tpu.columns.table import Table
+    from spark_rapids_tpu.models import query as Q
+    from spark_rapids_tpu.models import tpcds
+
+    fact = Table([Column.from_pylist([1, 2, 1, 3, 2, 1], dtypes.INT32),
+                  Column.from_pylist([10, 20, 30, 40, 50, 60],
+                                     dtypes.INT64)])
+    dim = Table([Column.from_pylist([1, 2, 3], dtypes.INT32),
+                 Column.from_pylist([7, 8, 9], dtypes.INT32)])
+    Q.simple_star_join_agg(fact, dim)
+
+    d5 = tpcds.gen_q5(rows=2048, stores=8)
+    q5 = tpcds.make_q5(stores=8, join_capacity=4096)
+    jax.block_until_ready(q5(d5))
+
+    # -- kudo write -> merge: shuffle-carried trace context ------------
+    from spark_rapids_tpu.shuffle import kudo
+    from spark_rapids_tpu.shuffle.schema import Field
+
+    col = Column.from_pylist([1, 2, 3, 4], dtypes.INT32)
+    buf = io.BytesIO()
+    with obs.TRACER.span("shuffle_stage", kind="stage") as wsp:
+        kudo.write_to_stream_with_metrics([col], buf, 0, 4)
+        writer_trace = f"{wsp.trace_id:016x}"
+    if kudo.TRACE_MAGIC not in buf.getvalue():
+        fail("kudo stream carries no KTRX trace extension")
+
+    merge_rec = {}
+
+    def remote_read():  # fresh thread: no open span -> must re-parent
+        kt = kudo.read_one_table(io.BytesIO(buf.getvalue()))
+        kudo.merge_to_table_with_metrics([kt], [Field(dtypes.INT32)])
+        for r in obs.TRACER.records():
+            if r["name"] == "kudo_merge":
+                merge_rec.update(r)
+
+    t = threading.Thread(target=remote_read)
+    t.start()
+    t.join()
+    if not merge_rec:
+        fail("no kudo_merge span recorded")
+    if merge_rec["trace_id"] != writer_trace:
+        fail("merge span did not adopt the writer's trace_id "
+             f"({merge_rec['trace_id']} != {writer_trace})")
+    if not merge_rec.get("links"):
+        fail("merge span carries no link to the writer span")
+
+    # -- forced OOM: memory runtime emits spans ------------------------
+    from spark_rapids_tpu.memory.exceptions import GpuRetryOOM
+
+    tid = threading.get_ident()
+    rmm_spark.force_retry_oom(tid, 1)
+    adaptor = rmm_spark.get_adaptor()
+    try:
+        adaptor.allocate(1024)
+    except GpuRetryOOM:
+        pass
+    adaptor.allocate(1024)
+    adaptor.deallocate(1024)
+    rmm_spark.task_done(1)
+
+    spans = obs.TRACER.records()
+    if not any(r["span_kind"] == "oom" for r in spans):
+        fail("no oom-kind span from the forced retry")
+
+    # -- tree connectivity: every op span under a query/stage root -----
+    from spark_rapids_tpu.tools import trace_export
+
+    idx = trace_export.build_index(spans)
+    ops = [r for r in spans if r["span_kind"] == "op"]
+    if not ops:
+        fail("no op spans recorded")
+    for r in ops:
+        root = trace_export.root_of(r, idx)
+        if root is None:
+            fail(f"op span {r['name']} has a broken parent chain")
+        if root["span_kind"] not in ("query", "stage"):
+            fail(f"op span {r['name']} roots at {root['span_kind']} "
+                 f"span {root['name']}, not a query/stage root")
+    queries = [r for r in spans if r["span_kind"] == "query"]
+    if not any(r["name"] == "tpcds_q5" for r in queries):
+        fail("no tpcds_q5 query-root span")
+    if trace_export.find_orphans(spans):
+        fail("orphan spans (parent missing from the dump)")
+
+    # -- task attribution rode the RmmSpark binding --------------------
+    if not any(r.get("task") == 1 for r in spans):
+        fail("no span attributed to task 1")
+
+    # -- exports -------------------------------------------------------
+    text = obs.expose_text()
+    for needle in ("srt_span_duration_ns_bucket", 'span_kind="op"',
+                   'span_kind="query"'):
+        if needle not in text:
+            fail(f"exposition missing {needle!r}")
+    if not obs.JOURNAL.records("span"):
+        fail("journal carries no span records")
+
+    with tempfile.TemporaryDirectory() as td:
+        spath = os.path.join(td, "spans.jsonl")
+        n = obs.dump_spans_jsonl(spath)
+        if n <= 0:
+            fail("span dump wrote no records")
+        out = os.path.join(td, "trace.json")
+        trace_export.main([spath, "-o", out, "--stats"])
+        with open(out) as f:
+            trace = json.load(f)
+        evs = trace.get("traceEvents", [])
+        if not any(e.get("ph") == "X" for e in evs):
+            fail("Perfetto JSON has no complete ('X') span events")
+        if not any(e.get("ph") == "s" for e in evs):
+            fail("Perfetto JSON has no flow start for the shuffle link")
+
+    rmm_spark.clear_event_handler()
+    print(f"trace-smoke: OK ({len(spans)} spans, "
+          f"{len(queries)} query roots, "
+          f"{len(obs.JOURNAL.records('span'))} journal span records)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,6 +21,7 @@ import numpy as np
 from spark_rapids_tpu.utils.jax_compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from spark_rapids_tpu import observability as _obs
 from spark_rapids_tpu.columns import dtypes
 from spark_rapids_tpu.columns.column import Column
 from spark_rapids_tpu.columns.table import Table
@@ -34,13 +35,18 @@ def simple_star_join_agg(fact: Table, dim: Table,
                          dim_key: int = 0, dim_attr: int = 1) -> Table:
     """SELECT d.attr, sum(f.value), count(*) FROM fact f JOIN dim d
     ON f.key = d.key GROUP BY d.attr — the minimum end-to-end slice."""
-    li, ri = joins.hash_inner_join(
-        Table([fact.columns[fact_key]]), Table([dim.columns[dim_key]]))
-    value = copying.gather(fact.columns[fact_value], li)
-    attr = copying.gather(dim.columns[dim_attr], ri)
-    return groupby.groupby_aggregate(
-        Table([attr], names=["attr"]), [value, value],
-        [groupby.SUM, groupby.COUNT])
+    # query-root span: the eagerly composed op kernels below each open
+    # child op spans under it, so a trace export shows the whole query
+    # as one tree
+    with _obs.TRACER.span("simple_star_join_agg", kind="query"):
+        li, ri = joins.hash_inner_join(
+            Table([fact.columns[fact_key]]),
+            Table([dim.columns[dim_key]]))
+        value = copying.gather(fact.columns[fact_value], li)
+        attr = copying.gather(dim.columns[dim_attr], ri)
+        return groupby.groupby_aggregate(
+            Table([attr], names=["attr"]), [value, value],
+            [groupby.SUM, groupby.COUNT])
 
 
 def make_distributed_hash_aggregate(mesh: Mesh, n_parts: int,
@@ -68,8 +74,16 @@ def make_distributed_hash_aggregate(mesh: Mesh, n_parts: int,
             valid.astype(jnp.int32), bucket, num_buckets + 1)
         return sums[:num_buckets], counts[:num_buckets], send_counts
 
-    step = jax.jit(shard_map(
+    jitted = jax.jit(shard_map(
         local, mesh=mesh,
         in_specs=(P("data"), P("data")),
         out_specs=(P("data"), P("data"), P("data"))))
+
+    def step(keys, vals):
+        # stage-level span around the jitted multi-chip step (the
+        # exchange itself runs inside XLA; the span brackets dispatch)
+        with _obs.TRACER.span("distributed_hash_aggregate",
+                              kind="stage"):
+            return jitted(keys, vals)
+
     return step, NamedSharding(mesh, P("data"))

@@ -42,6 +42,7 @@ both single-chip jit and the 8-device mesh variants against them.
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax
@@ -50,7 +51,22 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from spark_rapids_tpu import observability as _obs
 from spark_rapids_tpu.ops.device_join import inner_join_device
+
+
+def _traced_query(name: str, fn):
+    """Wrap a pipeline's jitted run fn in a query-root span: every
+    eager op bracket, shuffle span, and OOM episode recorded while the
+    query executes parents under this root, so a trace export shows one
+    connected query -> stage -> op tree per invocation."""
+
+    @functools.wraps(fn)
+    def run(*args, **kwargs):
+        with _obs.TRACER.span(name, kind="query"):
+            return fn(*args, **kwargs)
+
+    return run
 
 # ------------------------------------------------------------------ data
 
@@ -151,7 +167,7 @@ def make_q5(stores: int, join_capacity: int):
     def run(d: Q5Data):
         return kernel(*d)
 
-    return run
+    return _traced_query("tpcds_q5", run)
 
 
 def oracle_q5(d: Q5Data, stores: int):
@@ -191,10 +207,8 @@ _Q9_BUCKETS = ((1, 20), (21, 40), (41, 60), (61, 80), (81, 100))
 
 
 @jax.jit
-def run_q9(quantity: jnp.ndarray, price: jnp.ndarray,
-           profit: jnp.ndarray):
-    """q9-shape: per-bucket count / avg(price) / avg(profit); avgs in
-    f64 at the presentation edge, sums exact in int64."""
+def _run_q9_jit(quantity: jnp.ndarray, price: jnp.ndarray,
+                profit: jnp.ndarray):
     counts, avg_p, avg_n = [], [], []
     for lo, hi in _Q9_BUCKETS:
         m = (quantity >= lo) & (quantity <= hi)
@@ -207,6 +221,15 @@ def run_q9(quantity: jnp.ndarray, price: jnp.ndarray,
         avg_n.append(sn.astype(jnp.float64)
                      / jnp.maximum(c, 1).astype(jnp.float64))
     return (jnp.stack(counts), jnp.stack(avg_p), jnp.stack(avg_n))
+
+
+def run_q9(quantity: jnp.ndarray, price: jnp.ndarray,
+           profit: jnp.ndarray):
+    """q9-shape: per-bucket count / avg(price) / avg(profit); avgs in
+    f64 at the presentation edge, sums exact in int64.  Query-root
+    span around the jitted program (see _traced_query)."""
+    with _obs.TRACER.span("tpcds_q9", kind="query"):
+        return _run_q9_jit(quantity, price, profit)
 
 
 def make_q9_multichip(mesh: Mesh):
@@ -236,7 +259,7 @@ def make_q9_multichip(mesh: Mesh):
     rep = P()
     fn = smap(shard_fn, mesh=mesh, in_specs=(shard, shard, shard),
               out_specs=(rep, rep, rep))
-    return jax.jit(fn)
+    return _traced_query("tpcds_q9_multichip", jax.jit(fn))
 
 
 def oracle_q9(quantity, price, profit):
@@ -332,7 +355,7 @@ def make_q72(items: int, max_week: int, join_capacity: int,
     def run(d: Q72Data):
         return kernel(*d)
 
-    return run
+    return _traced_query("tpcds_q72", run)
 
 
 def oracle_q72(d: Q72Data, items: int, max_week: int,
@@ -385,7 +408,7 @@ def make_q5_multichip(mesh: Mesh, stores: int, join_capacity: int):
               in_specs=(shard, shard, shard, shard,
                         shard, shard, shard, shard, rep, rep),
               out_specs=(rep, rep, rep, rep, rep))
-    return jax.jit(fn)
+    return _traced_query("tpcds_q5_multichip", jax.jit(fn))
 
 
 def make_q72_multichip(mesh: Mesh, items: int, max_week: int,
@@ -407,7 +430,7 @@ def make_q72_multichip(mesh: Mesh, items: int, max_week: int,
     fn = smap(kernel, mesh=mesh,
               in_specs=(shard, shard, shard, rep, rep, rep, rep),
               out_specs=(rep, rep, rep, rep))
-    return jax.jit(fn)
+    return _traced_query("tpcds_q72_multichip", jax.jit(fn))
 
 
 # ------------------------------------------------------------------- q3
@@ -456,7 +479,7 @@ def make_q3(base: int, years: int, brands: int, manufact: int,
     def run(d: Q3Data):
         return kernel(*d)
 
-    return run
+    return _traced_query("tpcds_q3", run)
 
 
 def _q3_kernel(base, years, brands, manufact, month, limit,
@@ -512,7 +535,7 @@ def make_q3_multichip(mesh: Mesh, base: int, years: int, brands: int,
     fn = smap(kernel, mesh=mesh,
               in_specs=(shard, shard, shard, rep, rep, rep, rep),
               out_specs=(rep, rep, rep, rep))
-    return jax.jit(fn)
+    return _traced_query("tpcds_q3_multichip", jax.jit(fn))
 
 
 def oracle_q3(d: Q3Data, base: int, brands: int, manufact: int,
@@ -577,7 +600,7 @@ def make_q7(items: int, limit: int = 100):
     def run(d: Q7Data):
         return kernel(*d)
 
-    return run
+    return _traced_query("tpcds_q7", run)
 
 
 def _q7_kernel(items, limit, reduce_sum):
@@ -620,7 +643,7 @@ def make_q7_multichip(mesh: Mesh, items: int, limit: int = 100):
               in_specs=(shard, shard, shard, shard, shard, shard,
                         shard, rep, rep, rep),
               out_specs=(rep,) * 6)
-    return jax.jit(fn)
+    return _traced_query("tpcds_q7_multichip", jax.jit(fn))
 
 
 def oracle_q7(d: Q7Data, items: int, limit: int = 100):

@@ -33,6 +33,8 @@ from spark_rapids_tpu.observability.registry import (
     DEFAULT_LATENCY_BUCKETS_NS, MetricsRegistry)
 from spark_rapids_tpu.observability.task_metrics import (
     UNATTRIBUTED, TaskMetricsTable)
+from spark_rapids_tpu.observability.tracing import (  # noqa: F401
+    NOOP_SPAN, SpanContext, Tracer)
 
 
 class _Switch:
@@ -48,7 +50,8 @@ class _Switch:
 _SWITCH = _Switch()
 
 METRICS = MetricsRegistry(enabled=False)
-JOURNAL = EventJournal(capacity=8192, enabled_ref=_SWITCH)
+JOURNAL = EventJournal(capacity=8192, enabled_ref=_SWITCH,
+                       on_drop=lambda n: JOURNAL_DROPPED_TOTAL.inc(n))
 TASKS = TaskMetricsTable(enabled_ref=_SWITCH)
 
 
@@ -66,12 +69,34 @@ def is_enabled() -> bool:
     return _SWITCH.enabled
 
 
+def enable_tracing() -> None:
+    """Turn on structured span tracing (independent of the metrics
+    switch: spans cost more than counters, so a metrics-on run does not
+    silently pay for them).  Span->journal and span->histogram fan-out
+    additionally requires the metrics switch."""
+    TRACER.enabled = True
+
+
+def disable_tracing() -> None:
+    TRACER.enabled = False
+
+
+def is_tracing_enabled() -> bool:
+    return TRACER.enabled
+
+
 def reset() -> None:
-    """Zero all registry series, journal records, and task rows (the
-    families and instrument handles stay valid)."""
+    """Zero all registry series, journal records, task rows, and
+    finished spans (the families and instrument handles stay valid).
+    Parked OOM block-episode spans are discarded too: a stale span
+    ended by a post-reset unblock would otherwise record a pre-reset
+    trace_id and a bogus multi-run duration into the fresh ring."""
     METRICS.reset()
     JOURNAL.clear()
     TASKS.reset()
+    with _BLOCK_SPANS_LOCK:
+        _BLOCK_SPANS.clear()
+    TRACER.reset()
 
 
 # --------------------------------------------------------------- instruments
@@ -108,9 +133,37 @@ HBM_BYTES_IN_USE = METRICS.gauge(
 EXCHANGE_DOUBLINGS = METRICS.counter(
     "srt_exchange_capacity_doublings_total",
     "ICI exchange capacity-retry doublings")
-JOURNAL_DROPPED = METRICS.gauge(
-    "srt_journal_dropped_events",
-    "Journal events lost to ring overwrite")
+JOURNAL_DROPPED_TOTAL = METRICS.counter(
+    "srt_journal_dropped_total",
+    "Journal events overwritten by ring wrap-around (counted at emit)")
+SPAN_DURATION = METRICS.histogram(
+    "srt_span_duration_ns", "Span durations by span kind and name",
+    labels=("span_kind", "name"),
+    buckets=DEFAULT_LATENCY_BUCKETS_NS, max_series=512)
+SPANS_FINISHED = METRICS.counter(
+    "srt_spans_finished_total", "Spans finished", labels=("span_kind",))
+
+
+# ------------------------------------------------------------------ tracer
+# Built AFTER the instrument families: the finish hook folds span
+# durations into SPAN_DURATION and appends span records to the journal
+# so one JSONL dump carries events AND spans on one timeline.
+
+
+def _on_span_finish(rec: dict) -> None:
+    if not _SWITCH.enabled:
+        return
+    SPAN_DURATION.observe(rec["dur_ns"],
+                          labels=(rec["span_kind"], rec["name"]))
+    SPANS_FINISHED.inc(labels=(rec["span_kind"],))
+    # the span record keeps its own start t_ns (emit's now-stamp is
+    # overridden by the explicit field)
+    JOURNAL.emit("span", **{k: v for k, v in rec.items() if k != "kind"})
+
+
+TRACER = Tracer(capacity=65536,
+                task_lookup=lambda: TASKS.tasks_for(),
+                on_finish=_on_span_finish)
 
 
 # ------------------------------------------------------------ record helpers
@@ -148,11 +201,24 @@ def record_shuffle_merge(rows: int, parse_ns: int, concat_ns: int,
                  thread=threading.get_ident())
 
 
+# open OOM block-episode spans keyed by thread id (blocked/unblocked
+# arrive as separate hook calls on the same thread; attach=False keeps
+# them off the context stack so an out-of-order unblock cannot corrupt
+# span nesting)
+_BLOCK_SPANS: dict = {}
+_BLOCK_SPANS_LOCK = threading.Lock()
+
+
 def record_oom_event(kind: str, *, thread_id: int,
                      task_id: Optional[int], is_cpu: bool = False,
                      injected: bool = False, **extra) -> None:
     """OOM state machine hook: kind in {'oom_retry', 'oom_split_retry',
     'thread_blocked', 'thread_unblocked', 'thread_removed'}."""
+    # the unblock/removed kinds must reach the span layer even with
+    # tracing off: a block-episode span opened while tracing was on
+    # would otherwise leak open in _BLOCK_SPANS forever
+    if TRACER.enabled or kind in ("thread_unblocked", "thread_removed"):
+        _record_oom_span(kind, thread_id, task_id, is_cpu, injected)
     if not _SWITCH.enabled:
         return
     device = "cpu" if is_cpu else "device"
@@ -166,6 +232,30 @@ def record_oom_event(kind: str, *, thread_id: int,
     JOURNAL.emit(kind, thread=thread_id,
                  task=task_id if task_id is not None else UNATTRIBUTED,
                  injected=injected, device=device, **extra)
+
+
+def _record_oom_span(kind: str, thread_id: int, task_id, is_cpu: bool,
+                     injected: bool) -> None:
+    """Memory-runtime span emission: retry/split throws become instant
+    spans; a blocked->unblocked episode becomes one span covering the
+    whole wait."""
+    attrs = {"device": "cpu" if is_cpu else "device",
+             "injected": injected}
+    if task_id is not None:
+        attrs["task_id"] = task_id
+    if kind in ("oom_retry", "oom_split_retry"):
+        TRACER.start_span(kind, kind="oom", attrs=attrs,
+                          attach=False).end()
+    elif kind == "thread_blocked":
+        span = TRACER.start_span("oom_blocked", kind="oom", attrs=attrs,
+                                 attach=False)
+        with _BLOCK_SPANS_LOCK:
+            _BLOCK_SPANS[thread_id] = span
+    elif kind in ("thread_unblocked", "thread_removed"):
+        with _BLOCK_SPANS_LOCK:
+            span = _BLOCK_SPANS.pop(thread_id, None)
+        if span is not None:
+            span.end()
 
 
 def record_exchange_doubling(from_capacity: int, to_capacity: int,
@@ -194,13 +284,11 @@ def record_hbm_sample(device_index: int, bytes_in_use: int) -> None:
 
 def expose_text() -> str:
     """Prometheus text exposition of the process registry."""
-    JOURNAL_DROPPED.set(JOURNAL.dropped)
     return METRICS.expose_text()
 
 
 def snapshot() -> dict:
     """JSON-able state: registry + per-task rollup + journal stats."""
-    JOURNAL_DROPPED.set(JOURNAL.dropped)
     return {
         "registry": METRICS.snapshot(),
         "tasks": {str(t): d for t, d in TASKS.rollup().items()},
@@ -208,6 +296,12 @@ def snapshot() -> dict:
                     "dropped": JOURNAL.dropped,
                     "by_kind": JOURNAL.counts_by_kind()},
     }
+
+
+def dump_spans_jsonl(path_or_file) -> int:
+    """Finished-span ring as JSON Lines — one process's input file for
+    ``tools/trace_export.py``.  Returns records written."""
+    return TRACER.dump_jsonl(path_or_file)
 
 
 def dump_journal_jsonl(path_or_file) -> int:
@@ -242,3 +336,5 @@ def dump_journal_jsonl(path_or_file) -> int:
 
 if os.environ.get("SPARK_RAPIDS_TPU_METRICS", "") not in ("", "0"):
     enable()
+if os.environ.get("SPARK_RAPIDS_TPU_TRACE", "") not in ("", "0"):
+    enable_tracing()

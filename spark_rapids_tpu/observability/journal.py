@@ -25,12 +25,18 @@ from typing import Dict, List, Optional
 
 
 class EventJournal:
-    def __init__(self, capacity: int = 8192, enabled_ref=None):
+    def __init__(self, capacity: int = 8192, enabled_ref=None,
+                 on_drop=None):
         """`enabled_ref`: object with a truthy `.enabled` attribute
         consulted on every emit (the shared observability switch);
-        None means always-on (tests)."""
+        None means always-on (tests).  `on_drop(n)`: called (outside
+        the ring lock) each time `n` events are overwritten by ring
+        wrap-around — observability points it at the
+        ``srt_journal_dropped_total`` counter so drops are no longer
+        silent."""
         self.capacity = capacity
         self._enabled_ref = enabled_ref
+        self._on_drop = on_drop
         self._lock = threading.Lock()
         self._ring: deque = deque(maxlen=capacity)
         self._seq = 0
@@ -47,7 +53,13 @@ class EventJournal:
         with self._lock:
             self._seq += 1
             rec["seq"] = self._seq
+            dropping = len(self._ring) == self._ring.maxlen
             self._ring.append(rec)
+        if dropping and self._on_drop is not None:
+            try:
+                self._on_drop(1)
+            except Exception:
+                pass  # accounting must never break the emitting layer
 
     # -------------------------------------------------------------- read
 

@@ -113,18 +113,27 @@ def with_capacity_retry(make_step: Callable[[int], Callable],
     steps = {}
 
     def run(*args):
-        cap = int(initial_capacity)
-        for attempt in range(max_doublings + 1):
-            if cap not in steps:
-                steps[cap] = make_step(cap)
-            out = steps[cap](*args)
-            if not bool(np.any(np.asarray(out[overflow_index]))):
-                return out, cap
-            if attempt < max_doublings:
-                _obs.record_exchange_doubling(cap, cap * 2, attempt)
-                cap *= 2
-        _obs.JOURNAL.emit("exchange_capacity_exceeded", capacity=cap,
-                          doublings=max_doublings)
-        raise CapacityExceeded(cap, max_doublings)
+        # stage-level span: one per driver invocation, covering every
+        # capacity attempt (per-attempt sub-spans would double-count
+        # the final successful run's time)
+        with _obs.TRACER.span("exchange_capacity_retry",
+                              kind="stage") as sp:
+            cap = int(initial_capacity)
+            for attempt in range(max_doublings + 1):
+                if cap not in steps:
+                    steps[cap] = make_step(cap)
+                out = steps[cap](*args)
+                if not bool(np.any(np.asarray(out[overflow_index]))):
+                    sp.set_attr("capacity", cap)
+                    sp.set_attr("attempts", attempt + 1)
+                    return out, cap
+                if attempt < max_doublings:
+                    _obs.record_exchange_doubling(cap, cap * 2, attempt)
+                    cap *= 2
+            sp.set_attr("capacity", cap)
+            sp.set_attr("overflowed", True)
+            _obs.JOURNAL.emit("exchange_capacity_exceeded", capacity=cap,
+                              doublings=max_doublings)
+            raise CapacityExceeded(cap, max_doublings)
 
     return run

@@ -162,3 +162,53 @@ def metrics_journal_dump(path: str) -> int:
 def metrics_reset() -> None:
     from spark_rapids_tpu import observability as obs
     obs.reset()
+
+
+# -------------------------------------------------------------- tracing
+# (span tracing control surface: the JVM enables tracing around a query
+# and flushes finished spans to a JSONL file it owns — the per-process
+# input of tools/trace_export.py)
+
+
+def tracing_set_enabled(enabled: bool) -> bool:
+    """Flip structured span tracing; returns prior state."""
+    from spark_rapids_tpu import observability as obs
+    prior = obs.is_tracing_enabled()
+    (obs.enable_tracing if enabled else obs.disable_tracing)()
+    return prior
+
+
+def tracing_enabled() -> bool:
+    from spark_rapids_tpu import observability as obs
+    return obs.is_tracing_enabled()
+
+
+def tracing_dump(path: str) -> int:
+    """Write the finished-span ring as JSONL; returns spans written."""
+    from spark_rapids_tpu import observability as obs
+    return obs.dump_spans_jsonl(path)
+
+
+def tracing_flush(path: str) -> int:
+    """Like tracing_dump but DRAINS the ring (repeated flushes between
+    export intervals never re-export a span)."""
+    import json
+
+    from spark_rapids_tpu import observability as obs
+    recs = obs.TRACER.drain()
+    try:
+        with open(path, "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+    except BaseException:
+        # an unwritable path OR a mid-write failure (disk full, quota)
+        # must not lose the drained spans: put them back so a corrected
+        # retry re-exports everything
+        obs.TRACER.requeue(recs)
+        raise
+    return len(recs)
+
+
+def tracing_reset() -> None:
+    from spark_rapids_tpu import observability as obs
+    obs.TRACER.reset()

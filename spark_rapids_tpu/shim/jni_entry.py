@@ -710,6 +710,31 @@ def metrics_reset() -> None:
     jni_api.metrics_reset()
 
 
+def tracing_set_enabled(enabled: bool) -> bool:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.tracing_set_enabled(bool(enabled))
+
+
+def tracing_enabled() -> bool:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.tracing_enabled()
+
+
+def tracing_dump(path: str) -> int:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.tracing_dump(path)
+
+
+def tracing_flush(path: str) -> int:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.tracing_flush(path)
+
+
+def tracing_reset() -> None:
+    from spark_rapids_tpu.shim import jni_api
+    jni_api.tracing_reset()
+
+
 # --------------------------------------------------------- HostTable
 
 

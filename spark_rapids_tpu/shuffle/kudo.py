@@ -37,6 +37,15 @@ from spark_rapids_tpu.columns.table import Table
 from spark_rapids_tpu.shuffle.schema import Field
 
 MAGIC = b"KUD0"
+# Optional trace-context header extension: when span tracing is on, the
+# writer prefixes a table with "KTRX" + big-endian u64 trace_id + u64
+# span_id (20 bytes) so the read side can re-parent its merge spans
+# under the writing task's span.  The extension precedes the standard
+# "KUD0" header, so the byte-compatible format is untouched whenever
+# tracing is off (golden-file and native interop tests see identical
+# streams) and readers need no look-ahead: the next 4 bytes of a stream
+# are always EOF, "KUD0", or "KTRX".
+TRACE_MAGIC = b"KTRX"
 
 
 def _pad4(n: int) -> int:
@@ -65,6 +74,10 @@ class KudoTableHeader:
     total_len: int
     num_columns: int
     has_validity: bytes
+    # (trace_id, span_id) carried by a "KTRX" extension, else None;
+    # never serialized by `write` (the extension is the WRITER's
+    # concern, see write_to_stream) so header bytes stay golden
+    trace_ctx: Optional[Tuple[int, int]] = None
 
     @property
     def serialized_size(self) -> int:
@@ -86,6 +99,15 @@ class KudoTableHeader:
         magic = stream.read(4)
         if len(magic) == 0:
             return None  # clean EOF
+        trace_ctx = None
+        if magic == TRACE_MAGIC:
+            raw = stream.read(16)
+            if len(raw) != 16:
+                raise EOFError("truncated kudo trace extension")
+            trace_ctx = struct.unpack(">QQ", raw)
+            magic = stream.read(4)
+            if len(magic) == 0:
+                raise EOFError("kudo trace extension without a table")
         if magic != MAGIC:
             raise ValueError(f"bad kudo magic {magic!r}")
         raw = stream.read(24)
@@ -96,7 +118,7 @@ class KudoTableHeader:
         bitset = stream.read(nbitset)
         if len(bitset) != nbitset:
             raise EOFError("truncated kudo header bitset")
-        return KudoTableHeader(*fields, bitset)
+        return KudoTableHeader(*fields, bitset, trace_ctx)
 
 
 @dataclass
@@ -182,6 +204,7 @@ def write_to_stream(columns: Sequence[Column], out, row_offset: int,
     bytes written (header + body)."""
     if num_rows < 0 or row_offset < 0:
         raise ValueError("row_offset/num_rows must be non-negative")
+    ntrace = _write_trace_extension(out)
     views = list(columns)
     if views and isinstance(views[0], Column):
         views = prepare_host_columns(views)
@@ -242,13 +265,30 @@ def write_to_stream(columns: Sequence[Column], out, row_offset: int,
     out.write(b"\0" * (olen - len(offsets_b)))
     out.write(data_b)
     out.write(b"\0" * (dlen - len(data_b)))
-    return header.serialized_size + header.total_len
+    return ntrace + header.serialized_size + header.total_len
+
+
+def _write_trace_extension(out) -> int:
+    """Prefix the next table with the active trace context when span
+    tracing is on (see TRACE_MAGIC).  Returns bytes written (0 when
+    tracing is off or no span is open — the stream stays reference
+    byte-compatible)."""
+    tracer = _obs.TRACER
+    if not tracer.enabled:
+        return 0
+    ctx = tracer.current_context()
+    if ctx is None:
+        return 0
+    out.write(TRACE_MAGIC)
+    out.write(struct.pack(">QQ", ctx.trace_id, ctx.span_id))
+    return 20
 
 
 def write_row_count_only(out, num_rows: int) -> int:
     """Degenerate zero-column table (KudoSerializer rows-only path)."""
+    ntrace = _write_trace_extension(out)
     header = KudoTableHeader(0, num_rows, 0, 0, 0, 0, b"")
-    return header.write(out)
+    return ntrace + header.write(out)
 
 
 def read_one_table(stream) -> Optional[KudoTable]:
@@ -425,11 +465,16 @@ class MergeMetrics:
 
 def write_to_stream_with_metrics(columns, out, row_offset: int,
                                  num_rows: int) -> "WriteMetrics":
-    """writeToStreamWithMetrics (KudoSerializer.java:249)."""
+    """writeToStreamWithMetrics (KudoSerializer.java:249).  Opens a
+    shuffle_write span; its context is what the trace extension embeds
+    in the wire bytes, so the read side links back to THIS write."""
     import time as _time
-    t0 = _time.monotonic_ns()
-    n = write_to_stream(columns, out, row_offset, num_rows)
-    dur = _time.monotonic_ns() - t0
+    with _obs.TRACER.span("kudo_write", kind="shuffle_write",
+                          attrs={"rows": num_rows}) as sp:
+        t0 = _time.monotonic_ns()
+        n = write_to_stream(columns, out, row_offset, num_rows)
+        dur = _time.monotonic_ns() - t0
+        sp.set_attr("bytes", n)
     # fold into the process metrics spine (shuffle byte counters +
     # per-task attribution + journal event); no-op when disabled
     _obs.record_shuffle_write(n, dur, num_rows)
@@ -438,18 +483,51 @@ def write_to_stream_with_metrics(columns, out, row_offset: int,
 
 def merge_to_table_with_metrics(kudo_tables, fields):
     import time as _time
-    t0 = _time.monotonic_ns()
-    parsed = [_parse_table(kt, fields) for kt in kudo_tables]
-    t1 = _time.monotonic_ns()
-    cols = [_concat_host_cols([p[i] for p in parsed], f)
-            for i, f in enumerate(fields)]
-    t2 = _time.monotonic_ns()
-    table = Table(cols)
+    span = _open_merge_span(kudo_tables)
+    try:
+        t0 = _time.monotonic_ns()
+        parsed = [_parse_table(kt, fields) for kt in kudo_tables]
+        t1 = _time.monotonic_ns()
+        cols = [_concat_host_cols([p[i] for p in parsed], f)
+                for i, f in enumerate(fields)]
+        t2 = _time.monotonic_ns()
+        table = Table(cols)
+        span.set_attr("rows", table.num_rows)
+    finally:
+        span.end()
     _obs.record_shuffle_merge(table.num_rows, t1 - t0, t2 - t1,
                               len(kudo_tables))
     return table, MergeMetrics(parse_time_ns=t1 - t0,
                                concat_time_ns=t2 - t1,
                                total_rows=table.num_rows)
+
+
+def _open_merge_span(kudo_tables):
+    """Open the shuffle_merge span with writer-side causality: every
+    distinct trace context carried by the incoming tables' "KTRX"
+    extensions becomes a span link, and when the merging thread has no
+    open span of its own (a remote reader), the span is RE-PARENTED
+    under the first writer's context so the read side joins the writing
+    task's trace instead of starting an orphan one."""
+    tracer = _obs.TRACER
+    if not tracer.enabled:
+        return _obs.NOOP_SPAN
+    ctxs = []
+    seen = set()
+    for kt in kudo_tables:
+        ctx = kt.header.trace_ctx
+        if ctx is not None and ctx not in seen:
+            seen.add(ctx)
+            ctxs.append(_obs.SpanContext(*ctx))
+    parent = None
+    if ctxs and tracer.current_context() is None:
+        parent = ctxs[0]
+    span = tracer.start_span("kudo_merge", kind="shuffle_merge",
+                             attrs={"tables": len(kudo_tables)},
+                             parent=parent)
+    for c in ctxs:
+        span.add_link(c)
+    return span
 
 
 def dump_tables(kudo_tables, path_prefix: str) -> List[str]:

@@ -20,7 +20,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-from typing import Dict, Iterable, List
+from typing import Dict, Iterable, List, Optional
 
 
 def load_jsonl(paths: Iterable[str]) -> List[dict]:
@@ -60,6 +60,83 @@ def split_records(records: List[dict]):
 
 def _ms(ns: int) -> str:
     return f"{ns / 1e6:.3f}"
+
+
+def histogram_quantile(buckets: List[float], bucket_counts: List[int],
+                       q: float) -> float:
+    """Estimate the q-quantile (0..1) from PER-BUCKET (non-cumulative)
+    counts, the registry snapshot's `bucket_counts` format — NOT the
+    cumulative `_bucket` values of Prometheus text exposition.  Same
+    estimation rule as `histogram_quantile`: linear interpolation
+    within the target bucket; the +Inf bucket clamps to the largest
+    finite bound, an underestimate by construction."""
+    total = sum(bucket_counts)
+    if total <= 0:
+        return 0.0
+    target = q * total
+    cum = 0.0
+    for i, n in enumerate(bucket_counts):
+        if cum + n >= target and n > 0:
+            if i >= len(buckets):          # +Inf bucket
+                return float(buckets[-1]) if buckets else 0.0
+            lo = float(buckets[i - 1]) if i > 0 else 0.0
+            hi = float(buckets[i])
+            return lo + (hi - lo) * (target - cum) / n
+        cum += n
+    return float(buckets[-1]) if buckets else 0.0
+
+
+def histogram_rows(registry: Optional[dict]) -> List[dict]:
+    """Flatten every histogram family in a registry snapshot into rows
+    with count/sum and p50/p95/p99 estimates (ns)."""
+    rows: List[dict] = []
+    for name, fam in sorted((registry or {}).items()):
+        if fam.get("kind") != "histogram":
+            continue
+        buckets = fam.get("buckets", [])
+        for s in fam.get("series", []):
+            if not s.get("count"):
+                continue
+            bc = s.get("bucket_counts", [])
+            rows.append({
+                "family": name,
+                "labels": dict(zip(fam.get("labels", []),
+                                   s.get("labels", []))),
+                "count": s["count"],
+                "sum_ns": s.get("sum", 0),
+                "p50_ns": histogram_quantile(buckets, bc, 0.50),
+                "p95_ns": histogram_quantile(buckets, bc, 0.95),
+                "p99_ns": histogram_quantile(buckets, bc, 0.99),
+            })
+    return rows
+
+
+def render_histogram_table(registry: Optional[dict]) -> List[str]:
+    """Latency-distribution table: one row per histogram series —
+    op-latency and the span-duration families both land here."""
+    rows = histogram_rows(registry)
+    out = ["", "latency histograms (p50/p95/p99 estimated from buckets)",
+           ""]
+    if not rows:
+        out.append("(no histogram series recorded)")
+        return out
+    names = ["{}{{{}}}".format(
+        r["family"],
+        ",".join(f"{k}={v}" for k, v in r["labels"].items()))
+        if r["labels"] else r["family"] for r in rows]
+    w = max(len(n) for n in names)
+    out.append(f"{'series':<{w}}  {'count':>7}  {'p50_us':>9}  "
+               f"{'p95_us':>9}  {'p99_us':>9}  {'total_ms':>10}")
+    order = sorted(range(len(rows)),
+                   key=lambda i: -rows[i]["sum_ns"])
+    for i in order:
+        r = rows[i]
+        out.append(f"{names[i]:<{w}}  {r['count']:>7}  "
+                   f"{r['p50_ns'] / 1e3:>9.1f}  "
+                   f"{r['p95_ns'] / 1e3:>9.1f}  "
+                   f"{r['p99_ns'] / 1e3:>9.1f}  "
+                   f"{_ms(r['sum_ns']):>10}")
+    return out
 
 
 def render_task_table(rollups: Dict[int, dict]) -> List[str]:
@@ -144,6 +221,7 @@ def build_report(records: List[dict]) -> dict:
                   for t, r in rollups.items()},
         "event_counts": counts,
         "has_registry_snapshot": registry is not None,
+        "histograms": histogram_rows(registry),
     }
 
 
@@ -169,6 +247,7 @@ def main(argv=None) -> int:
         lines.append("(no task_rollup records in input)")
     lines += render_event_table(events)
     if registry is not None:
+        lines += render_histogram_table(registry)
         lines.append("")
         lines.append(f"registry snapshot: {len(registry)} metric families")
     print("\n".join(lines))

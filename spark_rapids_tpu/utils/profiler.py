@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import json
 import struct
+import sys
 import threading
 import time
 from contextlib import contextmanager
@@ -156,46 +157,73 @@ class Profiler:
 @contextmanager
 def op_range(name: str, **attrs):
     """NVTX3_FUNC_RANGE analog (nvtx_ranges.hpp): wraps an op in a
-    jax.profiler annotation + emits a range record to the in-process
-    profiler when one is running.  Same-name nesting records once (the
-    outermost bracket): the shim and the op-layer `traced` decorator
-    both bracket the same op, and double inject/record would skew fault
-    probabilities and op counts."""
-    s = active_op_names()
-    outer = name not in s
-    if outer:
-        s.add(name)
+    jax.profiler annotation, emits a range record to the in-process
+    profiler when one is running, and opens a child span on the process
+    tracer when tracing is enabled (the span parents under the
+    innermost open query/stage/op span on this thread).
+
+    Every bracket records its own range/span — the old same-name-
+    nesting suppression is gone because its only source (the shim's
+    bracket plus the op layer's `traced` wrapper around ONE logical
+    call) is now skipped at the `traced` layer, keyed by the owning
+    frame; a genuinely recursive op call is a real nested range and is
+    recorded as such."""
+    owner = sys._getframe(2)  # frame containing the `with` statement
+    stack = _bracket_stack()
+    stack.append((name, id(owner)))
     prof = Profiler.get()
+    tracer = _obs.TRACER
+    span = (tracer.start_span(name, kind="op", attrs=attrs or None)
+            if tracer.enabled else None)
     t0 = time.monotonic_ns()
     try:
         with jax.profiler.TraceAnnotation(name):
             yield
     finally:
-        if outer:
-            s.discard(name)
-            dur_ns = time.monotonic_ns() - t0
-            if prof is not None:
-                prof.record("op_range",
-                            {"name": name,
-                             "dur_ns": dur_ns,
-                             "thread": threading.get_ident(),
-                             **attrs})
-            # observability spine: per-op latency histogram + per-task
-            # attribution (no-op behind one bool when disabled)
-            _obs.record_op(name, dur_ns)
+        stack.pop()
+        dur_ns = time.monotonic_ns() - t0
+        if span is not None:
+            span.end()
+        if prof is not None:
+            prof.record("op_range",
+                        {"name": name,
+                         "dur_ns": dur_ns,
+                         "thread": threading.get_ident(),
+                         **attrs})
+        # observability spine: per-op latency histogram + per-task
+        # attribution (no-op behind one bool when disabled)
+        _obs.record_op(name, dur_ns)
 
 
 _active_ranges = threading.local()
 
 
-def active_op_names() -> set:
-    """Thread-local set of op names currently inside an op_range (used
-    by utils/tracing.traced to skip duplicate brackets)."""
-    s = getattr(_active_ranges, "s", None)
+def _bracket_stack() -> list:
+    """Thread-local stack of (op name, owner frame id) for brackets
+    currently open on this thread."""
+    s = getattr(_active_ranges, "stack", None)
     if s is None:
-        s = set()
-        _active_ranges.s = s
+        s = []
+        _active_ranges.stack = s
     return s
+
+
+def active_op_names() -> set:
+    """Op names currently inside an op_range on this thread."""
+    return {n for n, _ in _bracket_stack()}
+
+
+def bracket_owned_by(name: str, frame_id: int) -> bool:
+    """True when an open bracket for `name` on this thread was entered
+    by the frame with id `frame_id` — i.e. the caller asking IS the
+    code lexically inside that bracket's `with` statement.  This is the
+    shim-over-op double-bracket signature `utils/tracing.traced` must
+    suppress (and the ONLY thing it suppresses: a recursive call to the
+    same op from a different frame brackets normally)."""
+    for n, fid in _bracket_stack():
+        if n == name and fid == frame_id:
+            return True
+    return False
 
 
 def iter_records(blob: bytes):

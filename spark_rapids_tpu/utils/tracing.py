@@ -26,7 +26,15 @@ def traced(fn=None, *, name: Optional[str] = None):
     """Decorator: fault-injection point + profiler/NVTX-style range
     around an eager op entry point.  Idempotent (re-wrapping is a
     no-op).  Do NOT apply to functions called inside jit traces — the
-    bracket is a host-side, per-eager-call construct."""
+    bracket is a host-side, per-eager-call construct.
+
+    Double-bracket suppression is keyed by FRAME, not by name: the only
+    duplicate to suppress is the shim-over-op shape, where jni_api opens
+    ``with op_range("x")`` and calls the traced op from that same frame
+    — one logical call, two brackets.  A name-keyed guard (the old
+    ``active_op_names`` check) also swallowed genuinely recursive calls
+    to the same op (e.g. a join entry point composing another join),
+    hiding the inner call from injection and the profiler entirely."""
 
     def deco(f):
         if getattr(f, _WRAPPED_FLAG, False):
@@ -35,11 +43,12 @@ def traced(fn=None, *, name: Optional[str] = None):
 
         @functools.wraps(f)
         def wrapper(*args, **kwargs):
-            from spark_rapids_tpu.utils.profiler import active_op_names
+            from spark_rapids_tpu.utils.profiler import bracket_owned_by
 
-            if opname in active_op_names():
-                # an outer bracket (e.g. the shim's) already covers this
-                # op on this thread: don't inject or record twice
+            if bracket_owned_by(opname, id(sys._getframe(1))):
+                # the CALLER's frame opened an op_range for this very
+                # op (the shim bracketing the op it is about to call):
+                # same logical call — don't inject or record twice
                 return f(*args, **kwargs)
             maybe_inject(opname)
             with op_range(opname):

@@ -1,0 +1,505 @@
+"""Structured tracing tests (ISSUE 2): span lifecycle (nesting,
+thread-local context, task attribution), the frame-keyed traced
+re-entrancy guard, the kudo write->merge trace-context round trip,
+journal drop accounting, histogram quantiles, and a Perfetto-export
+golden-file check."""
+
+import io
+import json
+import os
+import threading
+
+import pytest
+
+from spark_rapids_tpu import observability as obs
+from spark_rapids_tpu.observability.tracing import (
+    NOOP_SPAN, SpanContext, Tracer)
+
+
+@pytest.fixture
+def tracing_on():
+    """Process tracing + metrics on + clean, restored after the test."""
+    prior_m, prior_t = obs.is_enabled(), obs.is_tracing_enabled()
+    obs.enable()
+    obs.enable_tracing()
+    obs.reset()
+    yield
+    obs.reset()
+    if not prior_m:
+        obs.disable()
+    if not prior_t:
+        obs.disable_tracing()
+
+
+# ------------------------------------------------------------- lifecycle
+
+
+def test_span_nesting_parents_and_trace_identity():
+    tr = Tracer()
+    tr.enabled = True
+    with tr.span("root", kind="query") as root:
+        with tr.span("mid", kind="stage") as mid:
+            with tr.span("leaf") as leaf:
+                assert leaf.trace_id == root.trace_id
+                assert leaf.parent_id == mid.span_id
+            assert mid.parent_id == root.span_id
+        assert root.parent_id == 0
+    recs = tr.records()
+    assert [r["name"] for r in recs] == ["leaf", "mid", "root"]
+    assert recs[2]["parent_id"] is None
+    assert len({r["trace_id"] for r in recs}) == 1
+    # sibling roots start fresh traces
+    with tr.span("other_root"):
+        pass
+    assert tr.records()[-1]["trace_id"] != recs[0]["trace_id"]
+
+
+def test_span_disabled_is_noop_singleton():
+    tr = Tracer()
+    span = tr.start_span("x")
+    assert span is NOOP_SPAN
+    span.set_attr("a", 1).add_link(SpanContext(1, 2)).end()
+    with tr.span("y"):
+        pass
+    assert len(tr) == 0 and tr.depth() == 0
+
+
+def test_span_thread_local_context_isolated():
+    tr = Tracer()
+    tr.enabled = True
+    out = {}
+
+    def worker():
+        with tr.span("worker_root") as s:
+            out["trace"] = s.trace_id
+            out["parent"] = s.parent_id
+
+    with tr.span("main_root") as main_span:
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        # the other thread saw ITS stack, not ours: fresh root
+        assert out["parent"] == 0
+        assert out["trace"] != main_span.trace_id
+
+
+def test_span_remote_context_activation():
+    tr = Tracer()
+    tr.enabled = True
+    with tr.span("writer") as w:
+        ctx = w.context
+    done = {}
+
+    def worker():
+        with tr.activate(ctx):
+            with tr.span("adopted") as s:
+                done["trace"] = s.trace_id
+                done["parent"] = s.parent_id
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert done["trace"] == ctx.trace_id
+    assert done["parent"] == ctx.span_id
+    # the remote placeholder itself is never recorded
+    assert [r["name"] for r in tr.records()] == ["writer", "adopted"]
+
+
+def test_span_out_of_order_end_tolerated():
+    tr = Tracer()
+    tr.enabled = True
+    a = tr.start_span("a")
+    b = tr.start_span("b")
+    a.end()  # ends before its child: stack must not corrupt
+    b.end()
+    b.end()  # idempotent
+    assert tr.depth() == 0
+    assert {r["name"] for r in tr.records()} == {"a", "b"}
+
+
+def test_span_cross_thread_end_pops_origin_stack():
+    """A span started on thread A and ended on thread B must leave A's
+    context stack — otherwise every later span on A parents under the
+    dead span and A's stack grows without bound."""
+    tr = Tracer()
+    tr.enabled = True
+    handed = tr.start_span("handed_off")
+
+    t = threading.Thread(target=handed.end)
+    t.start()
+    t.join()
+    assert tr.depth() == 0
+    with tr.span("after") as s:
+        assert s.parent_id == 0            # fresh root, not a child
+        assert s.trace_id != handed.trace_id
+
+
+def test_span_bounded_attributes():
+    tr = Tracer()
+    tr.enabled = True
+    with tr.span("big", attrs={f"k{i}": i for i in range(40)}):
+        pass
+    attrs = tr.records()[0]["attrs"]
+    assert attrs["__attrs_dropped__"] == 40 - 16
+    with tr.span("long", attrs={"v": "x" * 1000}):
+        pass
+    assert len(tr.records()[-1]["attrs"]["v"]) < 300
+
+
+def test_set_attr_at_cap_evicts_oldest_not_newest():
+    """A late write (the automatic 'error' marker, end-of-write byte
+    counts) must survive on a span already at MAX_ATTRS."""
+    tr = Tracer()
+    tr.enabled = True
+    try:
+        with tr.span("full", attrs={f"k{i}": i for i in range(16)}):
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    attrs = tr.records()[0]["attrs"]
+    assert attrs["error"] == "RuntimeError"
+    assert "k0" not in attrs               # oldest evicted
+    assert attrs["__attrs_dropped__"] == 1
+
+
+def test_tracing_flush_failure_requeues_spans(tracing_on, tmp_path):
+    from spark_rapids_tpu.shim import jni_api
+
+    with obs.TRACER.span("precious"):
+        pass
+    with pytest.raises(OSError):
+        jni_api.tracing_flush(str(tmp_path / "no" / "such" / "dir.jsonl"))
+    # the failed flush lost nothing: a corrected retry exports the span
+    ok = tmp_path / "spans.jsonl"
+    assert jni_api.tracing_flush(str(ok)) == 1
+    assert json.loads(ok.read_text())["name"] == "precious"
+    assert len(obs.TRACER) == 0            # and the retry DID drain
+
+
+def test_span_task_attribution_via_rmm_bindings(tracing_on):
+    tid = threading.get_ident()
+    obs.TASKS.bind_thread(tid, (42,))
+    try:
+        with obs.TRACER.span("attributed"):
+            pass
+    finally:
+        obs.TASKS.unbind_thread(tid)
+    rec = [r for r in obs.TRACER.records()
+           if r["name"] == "attributed"][0]
+    assert rec["task"] == 42
+
+
+def test_span_feeds_histogram_and_journal(tracing_on):
+    with obs.TRACER.span("fed", kind="stage"):
+        pass
+    text = obs.expose_text()
+    assert 'srt_span_duration_ns_bucket' in text
+    assert 'span_kind="stage",name="fed"' in text
+    names = [r["name"] for r in obs.JOURNAL.records("span")]
+    assert "fed" in names
+
+
+# ------------------------------------------------- traced re-entrancy
+
+
+def test_traced_shim_shape_brackets_once(tracing_on):
+    from spark_rapids_tpu.columns import dtypes
+    from spark_rapids_tpu.shim import jni_api
+
+    h = jni_api.make_column_from_host([1, 2, 3], dtypes.INT32)
+    jni_api.murmur_hash3_32(42, [h])
+    jni_api.release_column(h)
+    names = [r["name"] for r in obs.TRACER.records()]
+    assert names.count("murmur3_32") == 1
+
+
+def test_traced_recursion_brackets_each_call(tracing_on):
+    """A recursive call to the SAME op from a different frame is a real
+    nested range (the old name-keyed guard swallowed it)."""
+    from spark_rapids_tpu.utils.tracing import traced
+
+    calls = []
+
+    @traced(name="recur_op")
+    def recur(n):
+        calls.append(n)
+        if n > 0:
+            return recur(n - 1)
+        return 0
+
+    recur(2)
+    recs = [r for r in obs.TRACER.records() if r["name"] == "recur_op"]
+    assert len(recs) == 3  # one span per logical call
+    # and they nest: two of them have a recur_op parent
+    ids = {r["span_id"] for r in recs}
+    assert sum(1 for r in recs if r["parent_id"] in ids) == 2
+
+
+def test_op_range_direct_same_frame_suppression(tracing_on):
+    """The shim shape reduced to its essence: an op_range plus a traced
+    call from the frame that opened it."""
+    from spark_rapids_tpu.utils.profiler import op_range
+    from spark_rapids_tpu.utils.tracing import traced
+
+    @traced(name="essence")
+    def essence():
+        return 1
+
+    with op_range("essence"):
+        essence()
+    recs = [r for r in obs.TRACER.records() if r["name"] == "essence"]
+    assert len(recs) == 1
+
+
+# ------------------------------------------------- kudo trace context
+
+
+def _int32_col(values):
+    from spark_rapids_tpu.columns import dtypes
+    from spark_rapids_tpu.columns.column import Column
+    return Column.from_pylist(values, dtypes.INT32), dtypes.INT32
+
+
+def test_kudo_stream_bytes_unchanged_when_tracing_off():
+    from spark_rapids_tpu.shuffle import kudo
+
+    col, _ = _int32_col([1, 2, 3, 4])
+    assert not obs.is_tracing_enabled()
+    buf = io.BytesIO()
+    n = kudo.write_to_stream([col], buf, 0, 4)
+    assert kudo.TRACE_MAGIC not in buf.getvalue()
+    assert n == len(buf.getvalue())
+
+
+def test_kudo_trace_context_round_trip(tracing_on):
+    from spark_rapids_tpu.columns import dtypes
+    from spark_rapids_tpu.shuffle import kudo
+    from spark_rapids_tpu.shuffle.schema import Field
+
+    col, _ = _int32_col([1, 2, 3, 4])
+    buf = io.BytesIO()
+    with obs.TRACER.span("write_stage", kind="stage") as wsp:
+        n = kudo.write_to_stream_with_metrics([col], buf, 0, 4)
+        writer_trace, writer_span = wsp.trace_id, wsp.span_id
+    raw = buf.getvalue()
+    assert raw.startswith(kudo.TRACE_MAGIC)
+    assert n.written_bytes == len(raw)  # extension counted
+
+    kt = kudo.read_one_table(io.BytesIO(raw))
+    assert kt.header.trace_ctx is not None
+    trace_id, span_id = kt.header.trace_ctx
+    assert trace_id == writer_trace
+    # the embedded span is the kudo_write span, a CHILD of write_stage
+    write_rec = [r for r in obs.TRACER.records()
+                 if r["name"] == "kudo_write"][0]
+    assert write_rec["span_id"] == f"{span_id:016x}"
+    assert write_rec["parent_id"] == f"{writer_span:016x}"
+
+    merged = {}
+
+    def remote_merge():  # no open span here: must adopt writer's trace
+        table, _m = kudo.merge_to_table_with_metrics(
+            [kt], [Field(dtypes.INT32)])
+        merged["rows"] = table.num_rows
+
+    t = threading.Thread(target=remote_merge)
+    t.start()
+    t.join()
+    assert merged["rows"] == 4
+    merge_rec = [r for r in obs.TRACER.records()
+                 if r["name"] == "kudo_merge"][0]
+    assert merge_rec["trace_id"] == f"{writer_trace:016x}"
+    assert merge_rec["parent_id"] == f"{span_id:016x}"
+    assert merge_rec["links"][0]["span_id"] == f"{span_id:016x}"
+
+
+def test_kudo_local_merge_keeps_local_parent_but_links(tracing_on):
+    """A reader that already HAS an open span keeps its local parent;
+    the writer context still arrives as a link."""
+    from spark_rapids_tpu.columns import dtypes
+    from spark_rapids_tpu.shuffle import kudo
+    from spark_rapids_tpu.shuffle.schema import Field
+
+    col, _ = _int32_col([5, 6])
+    buf = io.BytesIO()
+    with obs.TRACER.span("writer_q", kind="query"):
+        kudo.write_to_stream_with_metrics([col], buf, 0, 2)
+    kt = kudo.read_one_table(io.BytesIO(buf.getvalue()))
+    with obs.TRACER.span("reader_q", kind="query") as rq:
+        kudo.merge_to_table(
+            [kt], [Field(dtypes.INT32)])
+        reader_trace = rq.trace_id
+    merge_rec = [r for r in obs.TRACER.records()
+                 if r["name"] == "kudo_merge"][0]
+    assert merge_rec["trace_id"] == f"{reader_trace:016x}"
+    assert merge_rec["links"]  # writer causality preserved as a link
+
+
+def test_kudo_row_count_only_carries_context(tracing_on):
+    from spark_rapids_tpu.shuffle import kudo
+
+    buf = io.BytesIO()
+    with obs.TRACER.span("rows_only", kind="stage"):
+        kudo.write_row_count_only(buf, 7)
+    h = kudo.KudoTableHeader.read(io.BytesIO(buf.getvalue()))
+    assert h.num_rows == 7
+    assert h.trace_ctx is not None
+
+
+# --------------------------------------------------- journal dropping
+
+
+def test_journal_overflow_counts_dropped_total(tracing_on):
+    overflow = 25
+    for i in range(obs.JOURNAL.capacity + overflow):
+        obs.JOURNAL.emit("filler", i=i)
+    assert obs.JOURNAL.dropped == overflow
+    text = obs.expose_text()
+    assert f"srt_journal_dropped_total {overflow}" in text
+
+
+def test_journal_on_drop_hook_unit():
+    from spark_rapids_tpu.observability.journal import EventJournal
+
+    drops = []
+    j = EventJournal(capacity=4, on_drop=lambda n: drops.append(n))
+    for i in range(10):
+        j.emit("e", i=i)
+    assert sum(drops) == 6 == j.dropped
+
+
+# ------------------------------------------------ histogram quantiles
+
+
+def test_histogram_quantile_interpolation():
+    from spark_rapids_tpu.tools.metrics_report import histogram_quantile
+
+    buckets = [10.0, 100.0, 1000.0]
+    # 100 obs uniformly in the (10, 100] bucket
+    assert histogram_quantile(buckets, [0, 100, 0, 0], 0.5) == \
+        pytest.approx(55.0)
+    assert histogram_quantile(buckets, [0, 100, 0, 0], 1.0) == \
+        pytest.approx(100.0)
+    # +Inf bucket clamps to the largest finite bound
+    assert histogram_quantile(buckets, [0, 0, 0, 5], 0.99) == 1000.0
+    assert histogram_quantile(buckets, [0, 0, 0, 0], 0.5) == 0.0
+
+
+def test_metrics_report_renders_span_histograms(tracing_on, tmp_path):
+    from spark_rapids_tpu.tools import metrics_report
+
+    with obs.TRACER.span("report_me", kind="query"):
+        pass
+    path = tmp_path / "journal.jsonl"
+    obs.dump_journal_jsonl(str(path))
+    records = metrics_report.load_jsonl([str(path)])
+    report = metrics_report.build_report(records)
+    fams = {h["family"] for h in report["histograms"]}
+    assert "srt_span_duration_ns" in fams
+    row = [h for h in report["histograms"]
+           if h["family"] == "srt_span_duration_ns"
+           and h["labels"].get("name") == "report_me"][0]
+    assert row["count"] == 1
+    assert row["p99_ns"] >= row["p50_ns"] >= 0
+    # table path renders without raising
+    rollups, registry, events = metrics_report.split_records(records)
+    lines = metrics_report.render_histogram_table(registry)
+    assert any("srt_span_duration_ns" in ln for ln in lines)
+
+
+# ------------------------------------------------------ OOM episodes
+
+
+def test_oom_block_episode_becomes_one_span(tracing_on):
+    obs.record_oom_event("thread_blocked", thread_id=777, task_id=3)
+    assert not [r for r in obs.TRACER.records()
+                if r["name"] == "oom_blocked"]  # still open
+    obs.record_oom_event("thread_unblocked", thread_id=777, task_id=3,
+                         blocked_ns=5)
+    recs = [r for r in obs.TRACER.records()
+            if r["name"] == "oom_blocked"]
+    assert len(recs) == 1
+    assert recs[0]["span_kind"] == "oom"
+    assert recs[0]["attrs"]["task_id"] == 3
+
+
+def test_oom_retry_instant_span(tracing_on):
+    obs.record_oom_event("oom_retry", thread_id=1, task_id=9,
+                         injected=True)
+    recs = [r for r in obs.TRACER.records() if r["name"] == "oom_retry"]
+    assert len(recs) == 1
+    assert recs[0]["attrs"]["injected"] is True
+
+
+# ------------------------------------------------- Perfetto export
+
+
+GOLDEN_PATH = os.path.join(os.path.dirname(__file__), "data",
+                           "trace_export_golden.json")
+
+
+def _golden_span_records():
+    """Deterministic hand-built spans: a query root with one op child
+    plus a merge span in a second 'process' linking back."""
+    return (
+        [  # process 1: writer side
+            {"kind": "span", "name": "q", "span_kind": "query",
+             "trace_id": "00000000000000aa", "span_id": "0000000000000001",
+             "parent_id": None, "t_ns": 1000, "dur_ns": 900,
+             "thread": 10, "task": 1},
+            {"kind": "span", "name": "write", "span_kind": "shuffle_write",
+             "trace_id": "00000000000000aa", "span_id": "0000000000000002",
+             "parent_id": "0000000000000001", "t_ns": 1100, "dur_ns": 300,
+             "thread": 10, "task": 1, "attrs": {"bytes": 64}},
+        ],
+        [  # process 2: reader side, re-parented + linked
+            {"kind": "span", "name": "merge", "span_kind": "shuffle_merge",
+             "trace_id": "00000000000000aa", "span_id": "0000000000000003",
+             "parent_id": "0000000000000002", "t_ns": 2000, "dur_ns": 500,
+             "thread": 20,
+             "links": [{"trace_id": "00000000000000aa",
+                        "span_id": "0000000000000002"}]},
+        ],
+    )
+
+
+def test_trace_export_golden_file():
+    """The exporter's Chrome JSON for a fixed span set must match the
+    checked-in golden byte for byte (sorted keys) — format drift in the
+    Perfetto export is a breaking change for saved traces."""
+    from spark_rapids_tpu.tools import trace_export
+
+    p1, p2 = _golden_span_records()
+    trace = trace_export.to_chrome_trace([("proc1.jsonl", p1),
+                                          ("proc2.jsonl", p2)])
+    got = json.dumps(trace, indent=2, sort_keys=True)
+    with open(GOLDEN_PATH) as f:
+        want = f.read().rstrip("\n")
+    assert got == want
+
+
+def test_trace_export_cli_and_tree_checks(tmp_path):
+    from spark_rapids_tpu.tools import trace_export
+
+    p1, p2 = _golden_span_records()
+    f1, f2 = tmp_path / "p1.jsonl", tmp_path / "p2.jsonl"
+    for f, recs in ((f1, p1), (f2, p2)):
+        f.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    out = tmp_path / "trace.json"
+    trace_export.main([str(f1), str(f2), "-o", str(out), "--stats"])
+    trace = json.loads(out.read_text())
+    assert any(e["ph"] == "X" for e in trace["traceEvents"])
+    assert any(e["ph"] == "s" for e in trace["traceEvents"])
+
+    spans = p1 + p2
+    assert trace_export.find_orphans(spans) == []
+    idx = trace_export.build_index(spans)
+    assert trace_export.root_of(spans[2], idx)["name"] == "q"
+    summary = trace_export.trace_summary(spans)
+    assert summary["00000000000000aa"]["spans"] == 3
+    assert summary["00000000000000aa"]["roots"] == ["q"]
+    # a broken chain is reported
+    orphan = dict(spans[2], parent_id="00000000000000ff",
+                  span_id="0000000000000004")
+    assert trace_export.find_orphans(spans + [orphan]) == [orphan]
+    assert trace_export.root_of(orphan, idx) is None
