@@ -4,8 +4,8 @@
 PY ?= python
 
 .PHONY: test fuzz native sanitizers bench bench-all dryrun tpu-lower \
-        jni-test kudo-bench metrics-smoke trace-smoke nightly-artifacts \
-        ci ci-nightly clean
+        jni-test kudo-bench metrics-smoke trace-smoke chaos-smoke \
+        nightly-artifacts ci ci-nightly clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -59,6 +59,14 @@ metrics-smoke:
 trace-smoke:
 	$(PY) scripts/trace_smoke.py
 
+# robustness gate: TPC-DS model queries under a seeded, hot-reloaded
+# fault-injection config (forced GpuRetryOOM + GpuSplitAndRetryOOM) and
+# a CRC-corrupted kudo shuffle table must recover to byte-identical
+# results through the retry runtime, with retry metrics/spans recorded;
+# a corrupted stream with CRC disabled must still fail loudly
+chaos-smoke:
+	$(PY) scripts/chaos_smoke.py
+
 # NOTE: jax.config.update, not the env var — this image's sitecustomize
 # pre-imports jax with the axon backend, so JAX_PLATFORMS=cpu is too
 # late.  XLA_FLAGS still works (read at backend init, which happens
@@ -80,7 +88,7 @@ dryrun:
 # (default 1500s) before emitting the CPU-fallback line — export
 # BENCH_FIGHT_SECONDS=1 for a quick local run.
 ci: test fuzz native sanitizers tpu-lower jni-test dryrun metrics-smoke \
-    trace-smoke
+    trace-smoke chaos-smoke
 	$(PY) bench.py
 	@echo "ci: all gates green"
 

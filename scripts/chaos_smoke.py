@@ -1,0 +1,303 @@
+"""Chaos soak gate (`make chaos-smoke`, ISSUE 3 acceptance): run the
+TPC-DS model queries and a kudo mini-shuffle under a SEEDED, hot-
+reloaded fault-injection config and assert the robustness runtime
+recovers to byte-identical results —
+
+  * a config-injected ``GpuRetryOOM`` mid-q5 and a
+    ``GpuSplitAndRetryOOM`` mid-q72 (added by a mid-run config
+    rewrite, proving the hot-reload watcher) both recover through the
+    retry drivers,
+  * a kudo table corrupted mid-stream is caught by the KCRC trailer,
+    salvaged by resync, and healed by a shuffle-style re-fetch — the
+    merged result matches the fault-free run exactly,
+  * a corrupted stream with CRC DISABLED still fails loudly
+    (magic/length checks), never silently,
+  * retry metrics (``srt_retry_*``), ``retry_episode`` journal events,
+    retry-kind spans, and the metrics_report retry table all light up.
+
+Exits non-zero on the first missing signal.  ``run_chaos(seed)`` is
+importable and returns a digest so tests can assert determinism."""
+
+import hashlib
+import io
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+STORES = 8
+ITEMS = 64
+MAX_WEEK = 16
+WEEK0 = 11_000 // 7
+
+
+def fail(msg: str) -> "NoReturn":  # noqa: F821
+    print(f"chaos-smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def _np_rows(*arrays):
+    import numpy as np
+    return [tuple(int(v) for v in row)
+            for row in zip(*(np.asarray(a).reshape(-1) for a in arrays))]
+
+
+def _build_queries(rows: int):
+    from spark_rapids_tpu.models import tpcds
+    d5 = tpcds.gen_q5(rows=rows, stores=STORES, days=60)
+    q5 = tpcds.make_q5(STORES, join_capacity=1 << 12)
+    d72 = tpcds.gen_q72(cs_rows=rows, inv_rows=rows // 2, items=ITEMS,
+                        days=35)
+    q72 = tpcds.make_q72(ITEMS, MAX_WEEK, join_capacity=1 << 17,
+                         week0=WEEK0)
+    return d5, q5, d72, q72
+
+
+def _run_q5(d5, q5):
+    import numpy as np
+    k5, sales, rets, profit, of5 = q5(d5)
+    if bool(np.asarray(of5)):
+        fail("q5 join capacity overflow (enlarge join_capacity)")
+    return _np_rows(k5, sales, rets, profit)
+
+
+def _run_q72(d72, q72):
+    import numpy as np
+    i72, w72, c72, of72 = q72(d72)
+    if bool(np.asarray(of72)):
+        fail("q72 join capacity overflow")
+    return _np_rows(i72, w72, c72)
+
+
+def _run_queries(d5, q5, d72, q72):
+    return {"q5": _run_q5(d5, q5), "q72": _run_q72(d72, q72)}
+
+
+def _kudo_shuffle_blobs(seed: int):
+    """Three kudo 'shuffle partitions' of one seeded column, written
+    with the KCRC trailer on."""
+    import numpy as np
+
+    from spark_rapids_tpu.columns import dtypes
+    from spark_rapids_tpu.columns.column import Column
+    from spark_rapids_tpu.shuffle import kudo
+    rng = np.random.default_rng(seed)
+    values = rng.integers(-1_000_000, 1_000_000, 300).astype(np.int64)
+    col = Column.from_pylist([int(v) for v in values], dtypes.INT64)
+    blobs = []
+    for lo, n in ((0, 100), (100, 100), (200, 100)):
+        buf = io.BytesIO()
+        kudo.write_to_stream([col], buf, lo, n)
+        blobs.append(buf.getvalue())
+    return blobs
+
+
+def _merge_with_refetch(blobs, corrupt_idx=None):
+    """Shuffle-reader model: fetch each blob, verify, RE-FETCH on a CRC
+    failure (Spark's re-fetch-from-mapper recovery), then merge — the
+    merge itself runs under the split-and-retry driver."""
+    from spark_rapids_tpu.columns import dtypes
+    from spark_rapids_tpu.shuffle import kudo
+    from spark_rapids_tpu.shuffle.schema import Field
+    refetched = 0
+    kts = []
+    for i, blob in enumerate(blobs):
+        if corrupt_idx is not None and i == corrupt_idx:
+            bad = bytearray(blob)
+            bad[len(bad) // 2] ^= 0xFF    # flip one body byte
+            blob_try = bytes(bad)
+        else:
+            blob_try = blob
+        try:
+            kts.append(kudo.read_one_table(io.BytesIO(blob_try)))
+        except kudo.KudoCorruptException:
+            refetched += 1
+            kts.append(kudo.read_one_table(io.BytesIO(blob)))
+    table = kudo.merge_to_table(kts, [Field(dtypes.INT64)])
+    total = sum(v[0] for v in table.to_pylist())
+    return {"rows": table.num_rows, "sum": total,
+            "refetched": refetched}
+
+
+def run_chaos(seed: int = 7, rows: int = 2048, verbose: bool = True):
+    """One full chaos soak; returns (digest, report) — digest is a
+    sha256 over every recovered result, so two runs with the same seed
+    must match."""
+    import numpy as np  # noqa: F401
+
+    from spark_rapids_tpu import observability as obs
+    from spark_rapids_tpu.memory import rmm_spark
+    from spark_rapids_tpu.shuffle import kudo
+    from spark_rapids_tpu.tools import metrics_report
+    from spark_rapids_tpu.utils import fault_injection as fi
+
+    def say(msg):
+        if verbose:
+            print(f"chaos-smoke: {msg}")
+
+    # ---- fault-free baseline --------------------------------------
+    fi.uninstall()
+    obs.disable()
+    obs.disable_tracing()
+    crc_prior = kudo.set_crc_enabled(True)
+    d5, q5, d72, q72 = _build_queries(rows)
+    baseline = _run_queries(d5, q5, d72, q72)
+    blobs = _kudo_shuffle_blobs(seed)
+    baseline["shuffle"] = _merge_with_refetch(blobs)
+    say(f"baseline: q5={len(baseline['q5'])} rows, "
+        f"q72={len(baseline['q72'])} rows, "
+        f"shuffle sum={baseline['shuffle']['sum']}")
+
+    # ---- chaos run ------------------------------------------------
+    obs.enable()
+    obs.enable_tracing()
+    obs.reset()
+    rmm_spark.set_event_handler(256 << 20)
+    rmm_spark.current_thread_is_dedicated_to_task(1)
+    tmp = tempfile.mkdtemp(prefix="chaos_smoke_")
+    cfg_path = os.path.join(tmp, "faults.json")
+    cfg = {"seed": seed,
+           "faults": [
+               {"match": "tpcds_q5", "exception": "GpuRetryOOM",
+                "repeat": 1},
+               {"match": "kudo_merge", "exception": "GpuRetryOOM",
+                "repeat": 1},
+           ]}
+    with open(cfg_path, "w") as f:
+        json.dump(cfg, f)
+    inj = fi.install(cfg_path, watch=True, interval_ms=25)
+    if len(inj.active_rules()) != 2:
+        fail("injector did not load the seeded config")
+    try:
+        chaos = {}
+        chaos["q5"] = _run_q5(d5, q5)
+
+        # hot reload mid-run: add the split-and-retry rule for q72 and
+        # wait for the watcher to pick it up
+        cfg["faults"].append({"match": "tpcds_q72",
+                              "exception": "GpuSplitAndRetryOOM",
+                              "repeat": 1})
+        time.sleep(0.05)  # mtime granularity
+        with open(cfg_path, "w") as f:
+            json.dump(cfg, f)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if any(r["match"] == "tpcds_q72"
+                   for r in inj.active_rules()):
+                break
+            time.sleep(0.02)
+        else:
+            fail("hot reload never picked up the q72 rule")
+        say("hot reload applied the mid-run q72 split rule")
+
+        chaos["q72"] = _run_q72(d72, q72)
+
+        # corrupted kudo table mid-"query": CRC catches it, resync
+        # salvages the stream, the re-fetch heals it, and the injected
+        # kudo_merge OOM retries the merge
+        bad = bytearray(blobs[1])
+        bad[40] ^= 0xFF                   # one body byte of table 2
+        stream = io.BytesIO(blobs[0] + bytes(bad) + blobs[2])
+        salvaged = kudo.read_tables(stream, resync=True)
+        if len(salvaged) != 2:
+            fail(f"resync salvaged {len(salvaged)} tables, wanted the "
+                 f"2 uncorrupted ones")
+        chaos["shuffle"] = _merge_with_refetch(blobs, corrupt_idx=1)
+        if chaos["shuffle"].pop("refetched") != 1:
+            fail("corrupted blob was not re-fetched exactly once")
+        baseline["shuffle"].pop("refetched", None)
+
+        # CRC disabled: corruption must still fail LOUDLY via the
+        # magic/length checks, never silently parse
+        kudo.set_crc_enabled(False)
+        buf = io.BytesIO()
+        from spark_rapids_tpu.columns import dtypes as _dt
+        from spark_rapids_tpu.columns.column import Column as _Col
+        kudo.write_to_stream(
+            [_Col.from_pylist([1, 2, 3], _dt.INT64)], buf, 0, 3)
+        raw = bytearray(buf.getvalue())
+        raw[0] ^= 0xFF  # smash the magic
+        try:
+            kudo.read_tables(io.BytesIO(bytes(raw)))
+            fail("corrupted magic parsed silently with CRC disabled")
+        except (ValueError, EOFError):
+            pass
+        kudo.set_crc_enabled(True)
+
+        # ---- byte-identical results -------------------------------
+        for key in ("q5", "q72", "shuffle"):
+            if chaos[key] != baseline[key]:
+                fail(f"{key} diverged from the fault-free baseline:\n"
+                     f"  base={baseline[key]!r}\n"
+                     f"  chaos={chaos[key]!r}")
+        say("all chaos results byte-identical to the fault-free run")
+
+        # ---- signals ----------------------------------------------
+        episodes = obs.JOURNAL.records("retry_episode")
+        errs = [e for ep in episodes for e in ep.get("errors", ())]
+        if "GpuRetryOOM" not in errs:
+            fail("no GpuRetryOOM retry episode recorded")
+        if "GpuSplitAndRetryOOM" not in errs:
+            fail("no GpuSplitAndRetryOOM retry episode recorded")
+        if not any(ep["outcome"] == "success" for ep in episodes):
+            fail("no successful retry episode recorded")
+        if not obs.JOURNAL.records("kudo_corrupt"):
+            fail("no kudo_corrupt journal event")
+        spans = [r for r in obs.TRACER.records()
+                 if r["span_kind"] == "retry"]
+        if not spans:
+            fail("no retry-kind spans recorded")
+        text = obs.expose_text()
+        for needle in ("srt_retry_attempts_total",
+                       "srt_retry_episodes_total",
+                       "srt_kudo_corrupt_total"):
+            if needle not in text:
+                fail(f"exposition missing {needle!r}")
+        jpath = os.path.join(tmp, "journal.jsonl")
+        obs.dump_journal_jsonl(jpath)
+        report = metrics_report.build_report(
+            metrics_report.load_jsonl([jpath]))
+        if not report["retry_episodes"]:
+            fail("metrics_report carries no retry-episode summary")
+        say(f"{len(episodes)} retry episodes, {len(spans)} retry "
+            f"spans, report sections ok")
+
+        digest = hashlib.sha256(
+            repr(sorted((k, repr(v))
+                        for k, v in chaos.items())).encode()
+        ).hexdigest()
+        return digest, {"episodes": len(episodes),
+                        "retry_spans": len(spans),
+                        "chaos": chaos}
+    finally:
+        fi.uninstall()
+        try:
+            rmm_spark.task_done(1)
+        except Exception:
+            pass
+        rmm_spark.clear_event_handler()
+        kudo.set_crc_enabled(crc_prior)
+        obs.disable_tracing()
+        obs.disable()
+
+
+def main() -> int:
+    digest, report = run_chaos()
+    print(f"chaos-smoke: OK (digest {digest[:16]}, "
+          f"{report['episodes']} retry episodes, "
+          f"{report['retry_spans']} retry spans)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,6 +57,13 @@ def get_adaptor() -> SparkResourceAdaptor:
     return _adaptor
 
 
+def installed_adaptor() -> Optional[SparkResourceAdaptor]:
+    """The installed adaptor or None — the retry drivers
+    (robustness/retry.py) poll this on every attempt and must stay
+    cheap and exception-free when no memory runtime exists."""
+    return _adaptor
+
+
 def current_thread_id() -> int:
     return threading.get_ident()
 

@@ -640,6 +640,29 @@ class SparkResourceAdaptor:
 
     # ---------------------------------------------------------- alloc flow
 
+    def check_injected_oom(self, thread_id: Optional[int] = None):
+        """Consume pending forced-OOM / CudfException injections for a
+        thread OUTSIDE the alloc path — the retry drivers
+        (robustness/retry.py) poll this at every attempt start, so
+        ``force_retry_oom``/``force_split_and_retry_oom`` fire even
+        for compute-only sections that never allocate (reference
+        RmmSpark.forceRetryOOM semantics).  Device-filtered
+        injections are consumed first, then STRICTLY-CPU-filtered
+        ones (a compute-only section has no alloc flavor of its own;
+        at most ONE injection fires per call since consumption
+        raises, and the CPU pass skips CPU_OR_GPU injections — the
+        device pass already serviced them, including their
+        skip_count).  No-op for unregistered threads."""
+        if thread_id is None:
+            thread_id = threading.get_ident()
+        with self._lock:
+            t = self._threads.get(thread_id)
+            if t is None:
+                return
+            self._consume_injected_oom(t, thread_id, False)
+            self._consume_injected_oom(t, thread_id, True,
+                                       skip_unfiltered=True)
+
     def _pre_alloc_core(self, thread_id: int, is_for_cpu: bool,
                         blocking: bool) -> bool:
         t = self._threads.get(thread_id)
@@ -651,7 +674,33 @@ class SparkResourceAdaptor:
                     f"thread {thread_id} is trying to do a blocking "
                     f"allocate while already in the state {t.state}")
             return True  # recursive allocation (spill path)
-        if t.retry_oom.matches(is_for_cpu):
+        self._consume_injected_oom(t, thread_id, is_for_cpu)
+        if blocking:
+            self._block_thread_until_ready(thread_id)
+        t = self._threads.get(thread_id)
+        if t is None:
+            return False
+        if t.state == THREAD_RUNNING:
+            self._transition(t, THREAD_ALLOC)
+            t.is_cpu_alloc = is_for_cpu
+        else:
+            raise ValueError(
+                f"thread {thread_id} in unexpected state pre alloc "
+                f"{t.state}")
+        return False
+
+    def _consume_injected_oom(self, t: _ThreadState, thread_id: int,
+                              is_for_cpu: bool,
+                              skip_unfiltered: bool = False):
+        """The forced-injection consumption shared by the alloc
+        bracket and the retry drivers' check hook (caller holds the
+        lock).  Order matches the reference: retry OOM, then
+        CudfException, then split-and-retry OOM.  ``skip_unfiltered``
+        limits the pass to injections whose filter REQUIRES this
+        flavor (check_injected_oom's second pass — a CPU_OR_GPU
+        injection must not burn a second skip in one poll)."""
+        if t.retry_oom.matches(is_for_cpu) and not (
+                skip_unfiltered and t.retry_oom.filter == CPU_OR_GPU):
             if t.retry_oom.skip_count > 0:
                 t.retry_oom.skip_count -= 1
             elif t.retry_oom.hit_count > 0:
@@ -666,13 +715,15 @@ class SparkResourceAdaptor:
                 t.record_failed_retry_time()
                 raise (exc.CpuRetryOOM("injected RetryOOM") if is_for_cpu
                        else exc.GpuRetryOOM("injected RetryOOM"))
-        if t.cudf_exception_injected > 0:
+        if t.cudf_exception_injected > 0 and not skip_unfiltered:
             t.cudf_exception_injected -= 1
             self._log_status("INJECTED_CUDF_EXCEPTION", thread_id,
                              t.task_id, t.state)
             t.record_failed_retry_time()
             raise exc.CudfException("injected CudfException")
-        if t.split_and_retry_oom.matches(is_for_cpu):
+        if t.split_and_retry_oom.matches(is_for_cpu) and not (
+                skip_unfiltered
+                and t.split_and_retry_oom.filter == CPU_OR_GPU):
             if t.split_and_retry_oom.skip_count > 0:
                 t.split_and_retry_oom.skip_count -= 1
             elif t.split_and_retry_oom.hit_count > 0:
@@ -691,19 +742,6 @@ class SparkResourceAdaptor:
                        if is_for_cpu
                        else exc.GpuSplitAndRetryOOM(
                            "injected SplitAndRetryOOM"))
-        if blocking:
-            self._block_thread_until_ready(thread_id)
-        t = self._threads.get(thread_id)
-        if t is None:
-            return False
-        if t.state == THREAD_RUNNING:
-            self._transition(t, THREAD_ALLOC)
-            t.is_cpu_alloc = is_for_cpu
-        else:
-            raise ValueError(
-                f"thread {thread_id} in unexpected state pre alloc "
-                f"{t.state}")
-        return False
 
     def _post_alloc_success_core(self, thread_id: int, is_for_cpu: bool,
                                  was_recursive: bool, num_bytes: int):

@@ -35,10 +35,9 @@ def simple_star_join_agg(fact: Table, dim: Table,
                          dim_key: int = 0, dim_attr: int = 1) -> Table:
     """SELECT d.attr, sum(f.value), count(*) FROM fact f JOIN dim d
     ON f.key = d.key GROUP BY d.attr — the minimum end-to-end slice."""
-    # query-root span: the eagerly composed op kernels below each open
-    # child op spans under it, so a trace export shows the whole query
-    # as one tree
-    with _obs.TRACER.span("simple_star_join_agg", kind="query"):
+    from spark_rapids_tpu.robustness import retry as _retry
+
+    def _run():
         li, ri = joins.hash_inner_join(
             Table([fact.columns[fact_key]]),
             Table([dim.columns[dim_key]]))
@@ -47,6 +46,13 @@ def simple_star_join_agg(fact: Table, dim: Table,
         return groupby.groupby_aggregate(
             Table([attr], names=["attr"]), [value, value],
             [groupby.SUM, groupby.COUNT])
+
+    # query-root span: the eagerly composed op kernels below each open
+    # child op spans under it, so a trace export shows the whole query
+    # as one tree; the retry driver recomputes the (pure) composition
+    # on a mid-query OOM
+    with _obs.TRACER.span("simple_star_join_agg", kind="query"):
+        return _retry.with_retry(_run, name="simple_star_join_agg")
 
 
 def make_distributed_hash_aggregate(mesh: Mesh, n_parts: int,
@@ -79,11 +85,15 @@ def make_distributed_hash_aggregate(mesh: Mesh, n_parts: int,
         in_specs=(P("data"), P("data")),
         out_specs=(P("data"), P("data"), P("data"))))
 
+    from spark_rapids_tpu.robustness import retry as _retry
+
     def step(keys, vals):
         # stage-level span around the jitted multi-chip step (the
-        # exchange itself runs inside XLA; the span brackets dispatch)
+        # exchange itself runs inside XLA; the span brackets dispatch);
+        # retry driver: a mid-dispatch OOM re-runs the pure step
         with _obs.TRACER.span("distributed_hash_aggregate",
                               kind="stage"):
-            return jitted(keys, vals)
+            return _retry.with_retry(
+                jitted, keys, vals, name="distributed_hash_aggregate")
 
     return step, NamedSharding(mesh, P("data"))

@@ -56,15 +56,26 @@ from spark_rapids_tpu.ops.device_join import inner_join_device
 
 
 def _traced_query(name: str, fn):
-    """Wrap a pipeline's jitted run fn in a query-root span: every
-    eager op bracket, shuffle span, and OOM episode recorded while the
-    query executes parents under this root, so a trace export shows one
-    connected query -> stage -> op tree per invocation."""
+    """Wrap a pipeline's jitted run fn in a query-root span AND the
+    task-level retry driver: every eager op bracket, shuffle span, and
+    OOM episode recorded while the query executes parents under this
+    root, and a GpuRetryOOM / GpuSplitAndRetryOOM / CudfException
+    raised mid-query (real or injected — the driver polls the forced-
+    OOM and fault-injector hooks under the query's name at every
+    attempt) recomputes the pipeline instead of killing it.  The
+    pipelines are pure functions of their argument arrays, so the
+    recompute needs no checkpoint and a "split" degrades soundly to a
+    full re-run."""
+    from spark_rapids_tpu.robustness import retry as _retry
 
     @functools.wraps(fn)
     def run(*args, **kwargs):
         with _obs.TRACER.span(name, kind="query"):
-            return fn(*args, **kwargs)
+            # close over the call instead of forwarding kwargs: a
+            # pipeline kwarg named like a driver control parameter
+            # (policy, checkpoint, ...) must reach fn, not the driver
+            return _retry.with_retry(lambda: fn(*args, **kwargs),
+                                     name=name)
 
     return run
 
@@ -223,13 +234,10 @@ def _run_q9_jit(quantity: jnp.ndarray, price: jnp.ndarray,
     return (jnp.stack(counts), jnp.stack(avg_p), jnp.stack(avg_n))
 
 
-def run_q9(quantity: jnp.ndarray, price: jnp.ndarray,
-           profit: jnp.ndarray):
-    """q9-shape: per-bucket count / avg(price) / avg(profit); avgs in
-    f64 at the presentation edge, sums exact in int64.  Query-root
-    span around the jitted program (see _traced_query)."""
-    with _obs.TRACER.span("tpcds_q9", kind="query"):
-        return _run_q9_jit(quantity, price, profit)
+# q9-shape: per-bucket count / avg(price) / avg(profit); avgs in f64
+# at the presentation edge, sums exact in int64.  Same query-root
+# span + retry contract as every other pipeline.
+run_q9 = _traced_query("tpcds_q9", _run_q9_jit)
 
 
 def make_q9_multichip(mesh: Mesh):

@@ -136,6 +136,28 @@ EXCHANGE_DOUBLINGS = METRICS.counter(
 JOURNAL_DROPPED_TOTAL = METRICS.counter(
     "srt_journal_dropped_total",
     "Journal events overwritten by ring wrap-around (counted at emit)")
+RETRY_EPISODES = METRICS.counter(
+    "srt_retry_episodes_total",
+    "Retry-driver episodes that saw at least one failure, by outcome",
+    labels=("outcome",))
+RETRY_ATTEMPTS = METRICS.counter(
+    "srt_retry_attempts_total",
+    "Attempts started by retry-driver episodes that saw a failure")
+RETRY_SPLITS = METRICS.counter(
+    "srt_retry_splits_total",
+    "Batch halvings performed by split-and-retry drivers")
+RETRY_TIME_LOST = METRICS.counter(
+    "srt_retry_time_lost_ns_total",
+    "Compute time burned by failed retry-driver attempts")
+KUDO_CORRUPT = METRICS.counter(
+    "srt_kudo_corrupt_total",
+    "Kudo stream integrity events by kind (crc = trailer mismatch, "
+    "resync = skip-to-next-magic recovery)",
+    labels=("reason",))
+KUDO_RESYNC_BYTES = METRICS.counter(
+    "srt_kudo_resync_skipped_bytes_total",
+    "Bytes skipped while resyncing corrupted kudo streams to the "
+    "next magic")
 SPAN_DURATION = METRICS.histogram(
     "srt_span_duration_ns", "Span durations by span kind and name",
     labels=("span_kind", "name"),
@@ -256,6 +278,40 @@ def _record_oom_span(kind: str, thread_id: int, task_id, is_cpu: bool,
             span = _BLOCK_SPANS.pop(thread_id, None)
         if span is not None:
             span.end()
+
+
+def record_retry_episode(name: str, *, attempts: int, retries: int,
+                         splits: int, max_split_depth: int,
+                         lost_ns: int, outcome: str,
+                         errors=()) -> None:
+    """Retry-driver episode hook (robustness/retry.py) — called only
+    for episodes that saw at least one failure."""
+    if not _SWITCH.enabled:
+        return
+    RETRY_EPISODES.inc(labels=(outcome,))
+    RETRY_ATTEMPTS.inc(attempts)
+    RETRY_SPLITS.inc(splits)
+    RETRY_TIME_LOST.inc(lost_ns)
+    JOURNAL.emit("retry_episode", name=name, attempts=attempts,
+                 retries=retries, splits=splits,
+                 max_split_depth=max_split_depth, lost_ns=lost_ns,
+                 outcome=outcome, errors=list(errors)[:16],
+                 thread=threading.get_ident())
+
+
+def record_kudo_corruption(reason: str, *, skipped_bytes: int = 0,
+                           detail: str = "") -> None:
+    """Kudo stream integrity hook: reason 'crc' for a trailer
+    mismatch at the verify site, 'resync' for a skip-to-next-magic
+    recovery (skipped_bytes > 0)."""
+    if not _SWITCH.enabled:
+        return
+    KUDO_CORRUPT.inc(labels=(reason,))
+    if skipped_bytes:
+        KUDO_RESYNC_BYTES.inc(skipped_bytes)
+    JOURNAL.emit("kudo_corrupt", reason=reason,
+                 skipped_bytes=skipped_bytes, detail=detail[:200],
+                 thread=threading.get_ident())
 
 
 def record_exchange_doubling(from_capacity: int, to_capacity: int,

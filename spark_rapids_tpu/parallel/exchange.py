@@ -25,6 +25,7 @@ hand-roll the check.
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Sequence
 
 import jax
@@ -86,31 +87,67 @@ def exchange(arrays: Sequence[jnp.ndarray], part: jnp.ndarray,
 
 class CapacityExceeded(RuntimeError):
     """Raised when a budgeted SPMD program still overflows at the retry
-    ceiling (the analog of GpuSplitAndRetryOOM escaping the retries)."""
+    ceiling (the analog of GpuSplitAndRetryOOM escaping the retries).
 
-    def __init__(self, capacity: int, doublings: int):
+    ``send_counts`` carries the observed overflow indicator from the
+    last attempt — for the raw exchange that is the TRUE per-destination
+    row counts, so the caller (and the journal) can see HOW FAR over
+    budget the exchange was, not just that it overflowed."""
+
+    def __init__(self, capacity: int, doublings: int,
+                 send_counts=None, reason: str = "overflowed"):
         super().__init__(
-            f"exchange capacity {capacity} still overflowed after "
-            f"{doublings} doublings")
+            f"exchange capacity {capacity} still {reason} after "
+            f"{doublings} doublings"
+            + (f" (observed counts {send_counts})"
+               if send_counts is not None else ""))
         self.capacity = capacity
+        self.doublings = doublings
+        self.send_counts = send_counts
+
+
+def _observed_counts(indicator: np.ndarray):
+    """The true per-destination sizes carried into CapacityExceeded
+    when the caller opted into a counts indicator (bounded: the first
+    64 entries)."""
+    if indicator.size:
+        return [int(x) for x in indicator.reshape(-1)[:64]]
+    return None
 
 
 def with_capacity_retry(make_step: Callable[[int], Callable],
                         initial_capacity: int, *,
                         max_doublings: int = 6,
-                        overflow_index: int = -1):
+                        overflow_index: int = -1,
+                        policy=None,
+                        counts_indicator: bool = False):
     """Centralized overflow retry for fixed-capacity SPMD programs.
 
     make_step(capacity) must return a callable whose output tuple
-    carries a boolean overflow indicator at `overflow_index` (any shape;
-    any True element means rows were dropped).  The wrapper runs the
-    program, checks the indicator on the host, and re-builds at double
-    the capacity until clean — compilation per capacity is cached by
-    jit, so steady-state workloads pay the retry only while the budget
-    is learning.
+    carries an overflow indicator at `overflow_index`.  By default it
+    is a truthiness flag (any shape; any true/non-zero element means
+    rows were dropped).  With ``counts_indicator=True`` the indicator
+    is instead the RAW send_counts array: the driver compares it
+    against the current capacity itself, and a terminal
+    CapacityExceeded reports the true per-destination sizes.  (The
+    interpretation is an explicit opt-in — an integer 0/1 flag under
+    the default stays a flag.)  The wrapper runs the program, checks
+    the indicator on the host, and re-builds at double the capacity
+    until clean — compilation per capacity is cached by jit, so
+    steady-state workloads pay the retry only while the budget is
+    learning.
+
+    The attempt loop rides the SAME RetryPolicy the task-level retry
+    drivers use (robustness/retry.py): `policy` bounds attempts
+    (default ``max_doublings + 1``), applies its backoff between
+    rebuilds, and its wall-clock deadline — a deadline hit raises
+    CapacityExceeded early instead of compiling ever-larger programs.
 
     Returns run(*args) -> (outputs, capacity_used)."""
+    from spark_rapids_tpu.robustness.retry import RetryPolicy
     steps = {}
+    pol = policy or RetryPolicy(max_attempts=max_doublings + 1,
+                                base_backoff_s=0.0)
 
     def run(*args):
         # stage-level span: one per driver invocation, covering every
@@ -119,21 +156,57 @@ def with_capacity_retry(make_step: Callable[[int], Callable],
         with _obs.TRACER.span("exchange_capacity_retry",
                               kind="stage") as sp:
             cap = int(initial_capacity)
-            for attempt in range(max_doublings + 1):
+            t0 = pol.clock()
+            attempt = 0
+            lost_ns = 0
+            while True:
+                attempt_t0 = time.monotonic_ns()
                 if cap not in steps:
                     steps[cap] = make_step(cap)
                 out = steps[cap](*args)
-                if not bool(np.any(np.asarray(out[overflow_index]))):
+                indicator = np.asarray(out[overflow_index])
+                if counts_indicator:
+                    overflowed = bool(np.any(indicator > cap))
+                else:
+                    overflowed = bool(np.any(indicator))
+                if not overflowed:
                     sp.set_attr("capacity", cap)
                     sp.set_attr("attempts", attempt + 1)
+                    if attempt:
+                        _obs.record_retry_episode(
+                            "exchange_capacity", attempts=attempt + 1,
+                            retries=attempt, splits=0,
+                            max_split_depth=0, lost_ns=lost_ns,
+                            outcome="success",
+                            errors=["CapacityOverflow"] * attempt)
                     return out, cap
-                if attempt < max_doublings:
-                    _obs.record_exchange_doubling(cap, cap * 2, attempt)
-                    cap *= 2
-            sp.set_attr("capacity", cap)
-            sp.set_attr("overflowed", True)
-            _obs.JOURNAL.emit("exchange_capacity_exceeded", capacity=cap,
-                              doublings=max_doublings)
-            raise CapacityExceeded(cap, max_doublings)
+                attempt += 1
+                lost_ns += time.monotonic_ns() - attempt_t0
+                deadline_hit = (pol.deadline_s is not None
+                                and pol.clock() - t0 >= pol.deadline_s)
+                if attempt >= pol.max_attempts or deadline_hit:
+                    counts = (_observed_counts(indicator)
+                              if counts_indicator else None)
+                    sp.set_attr("capacity", cap)
+                    sp.set_attr("overflowed", True)
+                    _obs.JOURNAL.emit("exchange_capacity_exceeded",
+                                      capacity=cap,
+                                      doublings=attempt - 1,
+                                      send_counts=counts)
+                    _obs.record_retry_episode(
+                        "exchange_capacity", attempts=attempt,
+                        retries=attempt, splits=0, max_split_depth=0,
+                        lost_ns=lost_ns, outcome="exhausted:deadline"
+                        if deadline_hit else "exhausted:attempts",
+                        errors=["CapacityOverflow"] * attempt)
+                    raise CapacityExceeded(
+                        cap, attempt - 1, send_counts=counts,
+                        reason="over deadline" if deadline_hit
+                        else "overflowed")
+                _obs.record_exchange_doubling(cap, cap * 2, attempt - 1)
+                backoff = pol.backoff_for(attempt)
+                if backoff > 0:
+                    pol.sleep(backoff)
+                cap *= 2
 
     return run

@@ -1,0 +1,18 @@
+"""Robustness runtime: the *catch* side of the OOM story (ISSUE 3
+tentpole).
+
+The reference stack splits resilience across two processes: the JNI
+library supplies the throw side (``SparkResourceAdaptor`` raising
+``GpuRetryOOM``/``GpuSplitAndRetryOOM``, the ``faultinj`` injector)
+while the plugin supplies the ``withRetry``/``withRestoreOnRetry``/
+split-and-retry drivers that actually recover.  This package is our
+plugin half: task-level retry drivers with checkpoint/restore,
+bounded attempts + exponential backoff + deadline, halving
+split-and-retry down to a one-element floor, forced-OOM polling for
+compute-only sections, and metric/span folding into the
+observability spine (docs/robustness.md).
+"""
+
+from spark_rapids_tpu.robustness.retry import (  # noqa: F401
+    Attempt, RetryExhausted, RetryPolicy, check_injected_oom,
+    halve_batch, split_and_retry, with_retry, with_retry_no_split)

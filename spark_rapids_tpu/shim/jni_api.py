@@ -212,3 +212,62 @@ def tracing_flush(path: str) -> int:
 def tracing_reset() -> None:
     from spark_rapids_tpu import observability as obs
     obs.TRACER.reset()
+
+
+# ------------------------------------------------------ fault injection
+# (reference: libcufaultinj loaded via CUDA_INJECTION64_PATH with a
+# FAULT_INJECTOR_CONFIG_PATH JSON; here the JVM drives the same
+# hot-reloadable injector through the shim)
+
+
+def fault_injection_install(config_path: str = "", watch: bool = True,
+                            interval_ms: int = 0) -> int:
+    """Install the process-global injector; an empty path falls back
+    to $FAULT_INJECTOR_CONFIG_PATH.  interval_ms <= 0 keeps the
+    default watch poll.  Returns the active rule count (a missing
+    config is tolerated: 0 rules, watcher retrying)."""
+    from spark_rapids_tpu.utils import fault_injection as fi
+    interval_ms = int(interval_ms)
+    inj = fi.install(config_path or None, watch=bool(watch),
+                     interval_ms=interval_ms if interval_ms > 0
+                     else None)
+    return len(inj.active_rules())
+
+
+def fault_injection_uninstall() -> None:
+    from spark_rapids_tpu.utils import fault_injection as fi
+    fi.uninstall()
+
+
+def fault_injection_config_path() -> str:
+    """The installed injector's config path ('' when no injector or no
+    path is installed)."""
+    from spark_rapids_tpu.utils import fault_injection as fi
+    inj = fi.installed()
+    return (inj.config_path or "") if inj is not None else ""
+
+
+def fault_injection_rules_json() -> str:
+    """Live rule snapshot as JSON (match/probability/remaining/
+    exception per rule) — the JVM-side hot-reload assertion surface."""
+    import json
+
+    from spark_rapids_tpu.utils import fault_injection as fi
+    inj = fi.installed()
+    return json.dumps(inj.active_rules() if inj is not None else [])
+
+
+# ------------------------------------------------------------ kudo crc
+
+
+def kudo_set_crc_enabled(enabled: bool) -> bool:
+    """Flip KCRC-trailer writing for the Python kudo engine; returns
+    the prior setting.  Read-side verification is always on when a
+    trailer is present."""
+    from spark_rapids_tpu.shuffle import kudo
+    return kudo.set_crc_enabled(bool(enabled))
+
+
+def kudo_crc_enabled() -> bool:
+    from spark_rapids_tpu.shuffle import kudo
+    return kudo.crc_enabled()

@@ -735,6 +735,39 @@ def tracing_reset() -> None:
     jni_api.tracing_reset()
 
 
+def fault_injection_install(config_path: str = "", watch: bool = True,
+                            interval_ms: int = 0) -> int:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.fault_injection_install(str(config_path),
+                                           bool(watch),
+                                           int(interval_ms))
+
+
+def fault_injection_uninstall() -> None:
+    from spark_rapids_tpu.shim import jni_api
+    jni_api.fault_injection_uninstall()
+
+
+def fault_injection_config_path() -> str:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.fault_injection_config_path()
+
+
+def fault_injection_rules_json() -> str:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.fault_injection_rules_json()
+
+
+def kudo_set_crc_enabled(enabled: bool) -> bool:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.kudo_set_crc_enabled(bool(enabled))
+
+
+def kudo_crc_enabled() -> bool:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.kudo_crc_enabled()
+
+
 # --------------------------------------------------------- HostTable
 
 
@@ -801,7 +834,9 @@ def kudo_write(handles: Sequence[int], row_offset: int,
     from spark_rapids_tpu.shim import jni_api
     from spark_rapids_tpu.shuffle import kudo, kudo_native
     cols = jni_api._cols(handles)
-    if kudo_native.available():
+    # KCRC trailers are a Python-engine feature: with CRC on, write AND
+    # merge stay on the spec engine so the trailer round-trips
+    if kudo_native.available() and not kudo.crc_enabled():
         key = tuple(handles)
         nt = _KUDO_WRITE_CACHE.get(key)
         if nt is None:
@@ -932,16 +967,16 @@ def kudo_merge(blob: bytes, type_ids: Sequence[str],
     from spark_rapids_tpu.shuffle import kudo, kudo_native
     from spark_rapids_tpu.shuffle.schema import Field
     fields = [Field(DType(k, s)) for k, s in zip(type_ids, scales)]
-    if kudo_native.available():
-        table = kudo_native.merge_to_table(bytes(blob), fields)
+    blob = bytes(blob)
+    # the native engine doesn't understand KCRC trailers, and a PEER
+    # process may have written them regardless of the local CRC
+    # setting — gate on stream STRUCTURE (record-walk, so payload
+    # bytes containing "KCRC" can't misroute the fast path)
+    if kudo_native.available() and not kudo.crc_enabled() \
+            and not kudo.stream_has_crc_trailers(blob):
+        table = kudo_native.merge_to_table(blob, fields)
         return [REGISTRY.register(c) for c in table.columns]
-    stream = io.BytesIO(bytes(blob))
-    kts = []
-    while True:
-        kt = kudo.read_one_table(stream)
-        if kt is None:
-            break
-        kts.append(kt)
+    kts = kudo.read_tables(io.BytesIO(blob))
     table = kudo.merge_to_table(kts, fields)
     return [REGISTRY.register(c) for c in table.columns]
 

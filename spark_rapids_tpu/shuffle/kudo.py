@@ -24,7 +24,9 @@ reference's write-cheap/merge-once design.
 
 from __future__ import annotations
 
+import os
 import struct
+import zlib
 from dataclasses import dataclass
 from typing import List, Optional, Sequence, Tuple
 
@@ -46,6 +48,43 @@ MAGIC = b"KUD0"
 # streams) and readers need no look-ahead: the next 4 bytes of a stream
 # are always EOF, "KUD0", or "KTRX".
 TRACE_MAGIC = b"KTRX"
+# Optional integrity trailer: when CRC mode is on, every table is
+# FOLLOWED by "KCRC" + big-endian u32 CRC32 of (header bytes + body).
+# The stream is byte-compatible when disabled (golden fixtures and the
+# native engine see identical bytes); readers verify any trailer they
+# encounter regardless of the local write-side setting, so a CRC'd
+# stream is checked even by a process that writes without CRC.  The
+# KTRX trace extension is NOT covered — corrupting it already fails
+# loudly at magic dispatch.
+CRC_MAGIC = b"KCRC"
+CRC_TRAILER_LEN = 8
+
+_CRC_ENABLED = [os.environ.get("SPARK_RAPIDS_TPU_KUDO_CRC", "")
+                not in ("", "0")]
+
+
+class KudoCorruptException(ValueError):
+    """A kudo table failed integrity verification (CRC mismatch or a
+    structurally impossible record).  Carries enough to drive a
+    re-fetch or a resync: ``reason`` in {'crc', 'magic',
+    'truncated'}."""
+
+    def __init__(self, msg: str, reason: str = "crc"):
+        super().__init__(msg)
+        self.reason = reason
+
+
+def set_crc_enabled(enabled: bool) -> bool:
+    """Flip CRC-trailer writing for this process; returns the prior
+    setting.  Read-side verification is always on when a trailer is
+    present."""
+    prior = _CRC_ENABLED[0]
+    _CRC_ENABLED[0] = bool(enabled)
+    return prior
+
+
+def crc_enabled() -> bool:
+    return _CRC_ENABLED[0]
 
 
 def _pad4(n: int) -> int:
@@ -55,6 +94,32 @@ def _pad4(n: int) -> int:
 def _pad_validity(n: int, header_size: int) -> int:
     """Pad validity section so header+validity is 4-byte aligned."""
     return _pad4(n + header_size) - header_size
+
+
+def _stream_read(stream, n: int) -> bytes:
+    """stream.read honoring any pushback left by a trailer peek."""
+    buf = getattr(stream, "_kudo_pushback", b"")
+    if buf:
+        take = buf[:n]
+        stream._kudo_pushback = buf[n:]
+        if len(take) < n:
+            take += stream.read(n - len(take))
+        return take
+    return stream.read(n)
+
+
+def _stream_unread(stream, data: bytes) -> None:
+    """Give peeked bytes back: seek when possible, else stash them on
+    the stream object (read_one_table must peek past a table to see
+    whether a CRC trailer follows)."""
+    if not data:
+        return
+    try:
+        stream.seek(-len(data), 1)
+        return
+    except (OSError, ValueError, AttributeError):
+        pass  # unseekable (or mid-pushback): fall through to the stash
+    stream._kudo_pushback = data + getattr(stream, "_kudo_pushback", b"")
 
 
 def _validity_slice(row_offset: int, num_rows: int) -> Tuple[int, int]:
@@ -86,36 +151,68 @@ class KudoTableHeader:
     def has_validity_buffer(self, col_idx: int) -> bool:
         return (self.has_validity[col_idx // 8] >> (col_idx % 8)) & 1 != 0
 
-    def write(self, out) -> int:
-        out.write(MAGIC)
-        out.write(struct.pack(">iiiiii", self.offset, self.num_rows,
+    def to_bytes(self) -> bytes:
+        """The exact wire bytes `write` emits — also what the KCRC
+        trailer's checksum covers on both sides."""
+        return (MAGIC
+                + struct.pack(">iiiiii", self.offset, self.num_rows,
                               self.validity_len, self.offset_len,
-                              self.total_len, self.num_columns))
-        out.write(self.has_validity)
+                              self.total_len, self.num_columns)
+                + self.has_validity)
+
+    def write(self, out) -> int:
+        out.write(self.to_bytes())
         return self.serialized_size
 
     @staticmethod
     def read(stream) -> Optional["KudoTableHeader"]:
-        magic = stream.read(4)
+        magic = _stream_read(stream, 4)
+        while magic == CRC_MAGIC:
+            # a trailer the previous read could not peek at (non-
+            # seekable stream): verify it now against the checksum
+            # read_one_table stashed for exactly this moment; without
+            # a stash (C stream that refuses attributes) skip it
+            raw = _stream_read(stream, 4)
+            if len(raw) != 4:
+                raise EOFError("truncated kudo crc trailer")
+            pending = getattr(stream, "_kudo_pending_crc", None)
+            if pending is not None:
+                stream._kudo_pending_crc = None
+                want = struct.unpack(">I", raw)[0]
+                if want != pending:
+                    _obs.record_kudo_corruption(
+                        "crc", detail=f"deferred: want {want:08x} "
+                                      f"got {pending:08x}")
+                    raise KudoCorruptException(
+                        f"kudo crc mismatch (want {want:08x} got "
+                        f"{pending:08x})")
+            magic = _stream_read(stream, 4)
         if len(magic) == 0:
             return None  # clean EOF
         trace_ctx = None
         if magic == TRACE_MAGIC:
-            raw = stream.read(16)
+            raw = _stream_read(stream, 16)
             if len(raw) != 16:
                 raise EOFError("truncated kudo trace extension")
             trace_ctx = struct.unpack(">QQ", raw)
-            magic = stream.read(4)
+            magic = _stream_read(stream, 4)
             if len(magic) == 0:
                 raise EOFError("kudo trace extension without a table")
         if magic != MAGIC:
             raise ValueError(f"bad kudo magic {magic!r}")
-        raw = stream.read(24)
+        raw = _stream_read(stream, 24)
         if len(raw) != 24:
             raise EOFError("truncated kudo header")
         fields = struct.unpack(">iiiiii", raw)
+        off, rows, vlen, olen, tlen, ncols = fields
+        if (min(off, rows, vlen, olen, tlen, ncols) < 0
+                or vlen + olen > tlen):
+            raise KudoCorruptException(
+                f"impossible kudo header (offset={off} rows={rows} "
+                f"validity_len={vlen} offset_len={olen} "
+                f"total_len={tlen} cols={ncols})", reason="magic")
         nbitset = (fields[5] + 7) // 8
-        bitset = stream.read(nbitset)
+        bitset = _stream_read(stream, nbitset)
         if len(bitset) != nbitset:
             raise EOFError("truncated kudo header bitset")
         return KudoTableHeader(*fields, bitset, trace_ctx)
@@ -258,14 +355,28 @@ def write_to_stream(columns: Sequence[Column], out, row_offset: int,
     dlen = _pad4(len(data_b))
     header = KudoTableHeader(row_offset, num_rows, vlen, olen,
                              vlen + olen + dlen, nflat, bytes(bitset))
-    header.write(out)
-    out.write(validity)
-    out.write(b"\0" * (vlen - len(validity)))
-    out.write(offsets_b)
-    out.write(b"\0" * (olen - len(offsets_b)))
-    out.write(data_b)
-    out.write(b"\0" * (dlen - len(data_b)))
-    return ntrace + header.serialized_size + header.total_len
+    hb = header.to_bytes()
+    body = (validity, b"\0" * (vlen - len(validity)),
+            offsets_b, b"\0" * (olen - len(offsets_b)),
+            data_b, b"\0" * (dlen - len(data_b)))
+    out.write(hb)
+    for part in body:
+        out.write(part)
+    n = ntrace + header.serialized_size + header.total_len
+    return n + _write_crc_trailer(out, hb, body)
+
+
+def _write_crc_trailer(out, header_bytes: bytes, body_parts) -> int:
+    """Append the KCRC trailer when CRC mode is on; returns the bytes
+    written (0 when off — the stream stays reference
+    byte-compatible)."""
+    if not _CRC_ENABLED[0]:
+        return 0
+    crc = zlib.crc32(header_bytes)
+    for part in body_parts:
+        crc = zlib.crc32(part, crc)
+    out.write(CRC_MAGIC + struct.pack(">I", crc & 0xFFFFFFFF))
+    return CRC_TRAILER_LEN
 
 
 def _write_trace_extension(out) -> int:
@@ -288,17 +399,153 @@ def write_row_count_only(out, num_rows: int) -> int:
     """Degenerate zero-column table (KudoSerializer rows-only path)."""
     ntrace = _write_trace_extension(out)
     header = KudoTableHeader(0, num_rows, 0, 0, 0, 0, b"")
-    return ntrace + header.write(out)
+    hb = header.to_bytes()
+    out.write(hb)
+    return ntrace + header.serialized_size + _write_crc_trailer(
+        out, hb, ())
 
 
 def read_one_table(stream) -> Optional[KudoTable]:
+    """Read one table; when a KCRC trailer follows it is consumed and
+    VERIFIED (a mismatch raises :class:`KudoCorruptException`) —
+    regardless of the local write-side CRC setting.  On a
+    NON-seekable stream (a live socket/pipe) the trailer peek is
+    skipped so an incremental reader never blocks waiting for bytes
+    past the table; verification is DEFERRED instead — the table's
+    checksum is stashed on the stream and checked when the next
+    header read encounters the trailer (a C stream that refuses
+    attribute stashes skips verification)."""
     header = KudoTableHeader.read(stream)
     if header is None:
         return None
-    body = stream.read(header.total_len)
+    body = _stream_read(stream, header.total_len)
     if len(body) != header.total_len:
         raise EOFError("truncated kudo body")
+    seekable = getattr(stream, "seekable", None)
+    if seekable is not None and not seekable():
+        try:
+            stream._kudo_pending_crc = zlib.crc32(
+                body, zlib.crc32(header.to_bytes())) & 0xFFFFFFFF
+        except AttributeError:
+            pass
+        return KudoTable(header, body)
+    peek = _stream_read(stream, 4)
+    if peek == CRC_MAGIC:
+        raw = _stream_read(stream, 4)
+        if len(raw) != 4:
+            raise EOFError("truncated kudo crc trailer")
+        want = struct.unpack(">I", raw)[0]
+        got = zlib.crc32(body, zlib.crc32(header.to_bytes())) \
+            & 0xFFFFFFFF
+        if got != want:
+            _obs.record_kudo_corruption(
+                "crc", detail=f"want {want:08x} got {got:08x} "
+                              f"rows={header.num_rows}")
+            raise KudoCorruptException(
+                f"kudo crc mismatch (want {want:08x} got {got:08x})")
+    else:
+        _stream_unread(stream, peek)
     return KudoTable(header, body)
+
+
+def stream_has_crc_trailers(blob: bytes) -> bool:
+    """Structured scan of a concatenated table stream: walk records by
+    their header lengths and report whether any KCRC trailer is
+    present.  Payload bytes are never pattern-matched, so a payload
+    that happens to contain b"KCRC" cannot false-positive (the shim
+    uses this to decide whether the trailer-unaware native engine may
+    parse the blob).  An unparseable structure returns False — the
+    real reader will raise its precise error."""
+    pos, n = 0, len(blob)
+    while pos + 4 <= n:
+        magic = blob[pos:pos + 4]
+        if magic == CRC_MAGIC:
+            return True
+        if magic == TRACE_MAGIC:
+            pos += 20
+            continue
+        if magic != MAGIC or pos + 28 > n:
+            return False
+        tlen = int.from_bytes(blob[pos + 20:pos + 24], "big",
+                              signed=True)
+        ncols = int.from_bytes(blob[pos + 24:pos + 28], "big",
+                               signed=True)
+        if tlen < 0 or ncols < 0:
+            return False
+        pos += 28 + (ncols + 7) // 8 + tlen
+    return False
+
+
+def resync_to_magic(stream, chunk_size: int = 1 << 16) -> int:
+    """Scan forward to the next table magic ("KUD0"/"KTRX"), leaving
+    the stream positioned AT it; returns the bytes skipped.  At EOF
+    the stream is left there (the caller's next read sees a clean
+    EOF).  Requires a seekable stream.  Chunked bytes.find scan (a
+    3-byte carry covers magics straddling chunk edges) — a multi-MB
+    corrupt partition resyncs at memchr speed, not per-byte Python."""
+    carry = b""
+    consumed = 0          # bytes read from the stream by this scan
+    while True:
+        chunk = stream.read(chunk_size)
+        if not chunk:
+            return consumed
+        buf = carry + chunk
+        consumed += len(chunk)
+        hits = [p for p in (buf.find(MAGIC), buf.find(TRACE_MAGIC))
+                if p >= 0]
+        if hits:
+            pos = min(hits)
+            back = len(chunk) + len(carry) - pos
+            stream.seek(-back, 1)
+            return consumed - back
+        carry = buf[-3:]
+
+
+def read_tables(stream, *, resync: bool = False) -> List[KudoTable]:
+    """Read every table in a stream.  With ``resync=False`` any
+    detected corruption raises: CRC mismatch, bad magic, truncation,
+    or a structurally impossible header — without CRC those
+    magic/length/structure checks are the loud-failure floor, while
+    payload bit-flips (the silent kind) need the CRC trailer.  With
+    ``resync=True`` the reader skips to the next table magic after a
+    corrupt record and keeps going — the multi-table salvage mode for
+    streams whose remaining tables are still good.  Resync requires a
+    seekable stream."""
+    tables: List[KudoTable] = []
+    while True:
+        start = stream.tell() if resync else None
+        try:
+            kt = read_one_table(stream)
+        except (ValueError, EOFError) as e:
+            if not resync:
+                raise
+            if isinstance(e, KudoCorruptException):
+                reason = e.reason
+            elif isinstance(e, EOFError):
+                reason = "truncated"
+            else:
+                reason = "magic"
+            if reason == "crc" and stream.tell() > start:
+                # the record's full extent is known (header, body, and
+                # trailer were all consumed before the mismatch):
+                # resume AFTER it — rescanning the corrupt body could
+                # resurrect a phantom table from payload bytes that
+                # merely look like a kudo record
+                skipped = stream.tell() - start
+            else:
+                # rewind to one past the failed record's start and
+                # scan; progress is monotonic, so a corrupt tail
+                # terminates at EOF instead of looping
+                stream.seek(start + 1)
+                skipped = 1 + resync_to_magic(stream)
+            # one "resync" record per skip (the crc mismatch itself
+            # was already counted at the verify site)
+            _obs.record_kudo_corruption("resync", skipped_bytes=skipped,
+                                        detail=f"{reason}: {e}")
+            continue
+        if kt is None:
+            return tables
+        tables.append(kt)
 
 
 # ------------------------------------------------------------------ merge
@@ -483,10 +730,21 @@ def write_to_stream_with_metrics(columns, out, row_offset: int,
 
 def merge_to_table_with_metrics(kudo_tables, fields):
     import time as _time
+
+    from spark_rapids_tpu.robustness import retry as _retry
     span = _open_merge_span(kudo_tables)
     try:
         t0 = _time.monotonic_ns()
-        parsed = [_parse_table(kt, fields) for kt in kudo_tables]
+        # split-and-retry over the TABLE LIST: a GpuSplitAndRetryOOM
+        # mid-parse halves the batch and parses the halves (down to a
+        # one-table floor); per-half results flatten back in order, so
+        # the split merge is byte-identical to the unsplit one
+        parsed = _retry.split_and_retry(
+            lambda kts: [_parse_table(kt, fields) for kt in kts],
+            list(kudo_tables),
+            combine=lambda chunks: [p for chunk in chunks
+                                    for p in chunk],
+            name="kudo_merge")
         t1 = _time.monotonic_ns()
         cols = [_concat_host_cols([p[i] for p in parsed], f)
                 for i, f in enumerate(fields)]

@@ -184,6 +184,53 @@ def render_op_table(rollups: Dict[int, dict]) -> List[str]:
     return out
 
 
+def retry_episode_rows(events: List[dict]) -> List[dict]:
+    """Aggregate retry_episode journal events per driver name:
+    episodes, attempts, splits, max split depth, time lost, and the
+    outcome breakdown."""
+    agg: Dict[str, dict] = {}
+    for e in events:
+        if e.get("kind") != "retry_episode":
+            continue
+        name = str(e.get("name", "?"))
+        a = agg.setdefault(name, {
+            "name": name, "episodes": 0, "attempts": 0, "splits": 0,
+            "max_split_depth": 0, "lost_ns": 0, "outcomes": {}})
+        a["episodes"] += 1
+        a["attempts"] += int(e.get("attempts", 0))
+        a["splits"] += int(e.get("splits", 0))
+        a["max_split_depth"] = max(a["max_split_depth"],
+                                   int(e.get("max_split_depth", 0)))
+        a["lost_ns"] += int(e.get("lost_ns", 0))
+        out = str(e.get("outcome", "?"))
+        a["outcomes"][out] = a["outcomes"].get(out, 0) + 1
+    return sorted(agg.values(), key=lambda a: -a["lost_ns"])
+
+
+def render_retry_table(events: List[dict]) -> List[str]:
+    """Retry-episode summary (robustness/retry.py drivers): how often
+    sections retried/split, how deep, and what the failures cost."""
+    rows = retry_episode_rows(events)
+    out = ["", "retry episodes", ""]
+    if not rows:
+        out.append("(no retry episodes recorded)")
+        return out
+    w = max(len(r["name"]) for r in rows)
+    hdr = (f"{'section':<{w}}  {'episodes':>8}  {'attempts':>8}  "
+           f"{'splits':>6}  {'depth':>5}  {'lost_ms':>10}  outcomes")
+    out.append(hdr)
+    out.append("-" * len(hdr))
+    for r in rows:
+        outcomes = ",".join(f"{k}={v}"
+                            for k, v in sorted(r["outcomes"].items()))
+        out.append(
+            f"{r['name']:<{w}}  {r['episodes']:>8}  "
+            f"{r['attempts']:>8}  {r['splits']:>6}  "
+            f"{r['max_split_depth']:>5}  {_ms(r['lost_ns']):>10}  "
+            f"{outcomes}")
+    return out
+
+
 def render_event_table(events: List[dict]) -> List[str]:
     counts: Dict[str, int] = {}
     for e in events:
@@ -222,6 +269,7 @@ def build_report(records: List[dict]) -> dict:
         "event_counts": counts,
         "has_registry_snapshot": registry is not None,
         "histograms": histogram_rows(registry),
+        "retry_episodes": retry_episode_rows(events),
     }
 
 
@@ -246,6 +294,7 @@ def main(argv=None) -> int:
     else:
         lines.append("(no task_rollup records in input)")
     lines += render_event_table(events)
+    lines += render_retry_table(events)
     if registry is not None:
         lines += render_histogram_table(registry)
         lines.append("")

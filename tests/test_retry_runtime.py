@@ -1,0 +1,844 @@
+"""Robustness runtime tests (ISSUE 3): retry/checkpoint-restore
+drivers, forced-OOM check hook, kudo CRC trailer + resync, capacity
+retry unification, fault-injector hardening, chaos-smoke determinism,
+and the retry metrics/span story."""
+
+import io
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import observability as obs
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.memory import exceptions as exc
+from spark_rapids_tpu.robustness import retry as R
+from spark_rapids_tpu.shuffle import kudo
+from spark_rapids_tpu.shuffle.schema import Field
+from spark_rapids_tpu.utils import fault_injection as fi
+
+
+def quick_policy(**kw):
+    kw.setdefault("base_backoff_s", 0.0)
+    return R.RetryPolicy(**kw)
+
+
+@pytest.fixture
+def clean_runtime():
+    """Isolate global state: injector, adaptor, obs switches, CRC."""
+    from spark_rapids_tpu.memory import rmm_spark
+    fi.uninstall()
+    crc = kudo.crc_enabled()
+    yield
+    fi.uninstall()
+    if rmm_spark.installed_adaptor() is not None:
+        rmm_spark.clear_event_handler()
+    kudo.set_crc_enabled(crc)
+    obs.disable_tracing()
+    obs.disable()
+    obs.reset()
+
+
+# ------------------------------------------------------- with_retry
+
+
+def test_with_retry_attempts_backoff_restore():
+    calls, sleeps, restores = [], [], []
+    state = {"v": 0}
+
+    def fn():
+        state["v"] += 1
+        calls.append(state["v"])
+        if len(calls) < 4:
+            raise exc.GpuRetryOOM(f"fail {len(calls)}")
+        return state["v"]
+
+    pol = R.RetryPolicy(base_backoff_s=0.01, backoff_multiplier=2.0,
+                        max_backoff_s=0.025, sleep=sleeps.append)
+    out = R.with_retry(fn, checkpoint=lambda: dict(state),
+                       restore=lambda s: (restores.append(1),
+                                          state.update(s)),
+                       policy=pol)
+    # checkpoint/restore invariant: every failed attempt rolled the
+    # state back, so each attempt saw v == 0 at entry
+    assert calls == [1, 1, 1, 1]
+    assert out == 1
+    assert len(restores) == 3
+    # exponential backoff with cap: 10ms, 20ms, 25ms
+    assert sleeps == [0.01, 0.02, 0.025]
+
+
+def test_with_retry_exhausted_carries_history():
+    def fn():
+        raise exc.CudfException("kernel went sideways")
+
+    with pytest.raises(R.RetryExhausted) as ei:
+        R.with_retry(fn, name="doomed",
+                     policy=quick_policy(max_attempts=3))
+    e = ei.value
+    assert e.name == "doomed" and e.reason == "attempts"
+    assert [a.error for a in e.attempts] == ["CudfException"] * 3
+    assert [a.index for a in e.attempts] == [0, 1, 2]
+    assert all(a.elapsed_ns >= 0 for a in e.attempts)
+
+
+def test_with_retry_deadline():
+    clock = {"t": 0.0}
+
+    def fake_sleep(s):
+        clock["t"] += s
+
+    def fn():
+        clock["t"] += 1.0
+        raise exc.GpuRetryOOM("slow fail")
+
+    pol = R.RetryPolicy(max_attempts=100, base_backoff_s=0.1,
+                        deadline_s=2.5, sleep=fake_sleep,
+                        clock=lambda: clock["t"])
+    with pytest.raises(R.RetryExhausted) as ei:
+        R.with_retry(fn, policy=pol)
+    assert ei.value.reason == "deadline"
+    assert len(ei.value.attempts) < 100
+    # the failure that ate the budget survives for triage
+    assert isinstance(ei.value.last, exc.GpuRetryOOM)
+    assert isinstance(ei.value.__cause__, exc.GpuRetryOOM)
+
+
+def test_with_retry_degrades_split_to_recompute():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        if len(calls) == 1:
+            raise exc.GpuSplitAndRetryOOM("split me")
+        return "ok"
+
+    assert R.with_retry(fn, policy=quick_policy()) == "ok"
+    assert len(calls) == 2
+
+
+def test_with_retry_no_split_escalates():
+    def fn():
+        raise exc.GpuSplitAndRetryOOM("needs a splitter")
+
+    with pytest.raises(exc.GpuSplitAndRetryOOM):
+        R.with_retry_no_split(fn, policy=quick_policy())
+
+
+def test_with_retry_terminal_errors_pass_through():
+    def fn():
+        raise exc.GpuOOM("really out")
+
+    with pytest.raises(exc.GpuOOM):
+        R.with_retry(fn, policy=quick_policy())
+
+
+# --------------------------------------------------- split_and_retry
+
+
+def test_split_and_retry_halves_in_order():
+    state = {"fails": 0}
+    seen = []
+
+    def fn(part):
+        if len(part) > 2:
+            state["fails"] += 1
+            raise exc.GpuSplitAndRetryOOM("too big")
+        seen.append(list(part))
+        return sum(part)
+
+    out = R.split_and_retry(fn, [1, 2, 3, 4, 5, 6, 7],
+                            policy=quick_policy())
+    # order-preserving: concatenating the parts reproduces the batch
+    assert [x for p in seen for x in p] == [1, 2, 3, 4, 5, 6, 7]
+    assert sum(out) == 28
+
+
+def test_split_and_retry_combine_and_retryable():
+    calls = []
+
+    def fn(part):
+        calls.append(list(part))
+        if len(calls) == 1:
+            raise exc.GpuRetryOOM("transient")   # same part re-runs
+        return list(part)
+
+    out = R.split_and_retry(
+        fn, ["a", "b"], policy=quick_policy(),
+        combine=lambda parts: [x for p in parts for x in p])
+    assert out == ["a", "b"]
+    assert calls == [["a", "b"], ["a", "b"]]
+
+
+def test_split_and_retry_one_element_floor():
+    def fn(part):
+        raise exc.GpuSplitAndRetryOOM("always")
+
+    with pytest.raises(R.RetryExhausted) as ei:
+        R.split_and_retry(fn, [10, 20], policy=quick_policy())
+    e = ei.value
+    assert e.reason == "split_floor"
+    assert any(a.kind == "split" for a in e.attempts)
+    assert max(a.split_depth for a in e.attempts) >= 1
+
+
+def test_split_and_retry_attempt_budget_per_part():
+    def fn(part):
+        raise exc.GpuRetryOOM("never works")
+
+    with pytest.raises(R.RetryExhausted) as ei:
+        R.split_and_retry(fn, [1], policy=quick_policy(max_attempts=4))
+    assert ei.value.reason == "attempts"
+    assert len(ei.value.attempts) == 4
+
+
+# ------------------------------------------- forced-OOM check hook
+
+
+def test_forced_oom_fires_in_compute_only_section(clean_runtime):
+    from spark_rapids_tpu.memory import rmm_spark
+    rmm_spark.set_event_handler(64 << 20)
+    rmm_spark.current_thread_is_dedicated_to_task(11)
+    tid = threading.get_ident()
+    rmm_spark.force_retry_oom(tid, 2)
+    calls = []
+    out = R.with_retry(lambda: calls.append(1) or "done",
+                       policy=quick_policy())
+    # two forced OOMs consumed by the check hook, then fn ran ONCE
+    assert out == "done" and len(calls) == 1
+    ad = rmm_spark.get_adaptor()
+    assert ad.get_and_reset_num_retry_throw(11) == 2
+
+
+def test_forced_split_oom_drives_splitter(clean_runtime):
+    from spark_rapids_tpu.memory import rmm_spark
+    rmm_spark.set_event_handler(64 << 20)
+    rmm_spark.current_thread_is_dedicated_to_task(12)
+    rmm_spark.force_split_and_retry_oom(threading.get_ident(), 1)
+    out = R.split_and_retry(lambda p: list(p), [1, 2, 3, 4],
+                            policy=quick_policy())
+    assert out == [[1, 2], [3, 4]]
+    ad = rmm_spark.get_adaptor()
+    assert ad.get_and_reset_num_split_retry_throw(12) == 1
+
+
+def test_forced_cpu_filtered_oom_fires_through_hook(clean_runtime):
+    from spark_rapids_tpu.memory import rmm_spark
+    from spark_rapids_tpu.memory.spark_resource_adaptor import CPU
+    rmm_spark.set_event_handler(64 << 20)
+    rmm_spark.current_thread_is_dedicated_to_task(13)
+    rmm_spark.force_retry_oom(threading.get_ident(), 1, oom_filter=CPU)
+    calls = []
+    out = R.with_retry(lambda: calls.append(1) or "done",
+                       policy=quick_policy())
+    assert out == "done" and len(calls) == 1
+    ad = rmm_spark.get_adaptor()
+    assert ad.get_and_reset_num_retry_throw(13) == 1
+
+
+def test_forced_oom_skip_count_single_consume_per_poll(clean_runtime):
+    """A CPU_OR_GPU-filtered injection's skip_count burns exactly ONE
+    skip per check-hook poll (the CPU pass must not re-service it)."""
+    from spark_rapids_tpu.memory import rmm_spark
+    from spark_rapids_tpu.memory.spark_resource_adaptor import \
+        CPU_OR_GPU
+    rmm_spark.set_event_handler(64 << 20)
+    rmm_spark.current_thread_is_dedicated_to_task(14)
+    rmm_spark.force_retry_oom(threading.get_ident(), 1,
+                              oom_filter=CPU_OR_GPU, skip_count=1)
+    # first episode: the single poll burns the skip, fn runs clean
+    calls = []
+    assert R.with_retry(lambda: calls.append(1) or "a",
+                        policy=quick_policy()) == "a"
+    assert len(calls) == 1
+    ad = rmm_spark.get_adaptor()
+    assert ad.get_and_reset_num_retry_throw(14) == 0
+    # second episode: the staged OOM fires on its promised attempt
+    assert R.with_retry(lambda: calls.append(1) or "b",
+                        policy=quick_policy()) == "b"
+    assert ad.get_and_reset_num_retry_throw(14) == 1
+
+
+def test_adaptor_check_hook_noop_for_unregistered_thread(clean_runtime):
+    from spark_rapids_tpu.memory import rmm_spark
+    rmm_spark.set_event_handler(64 << 20)
+    rmm_spark.get_adaptor().check_injected_oom()  # must not raise
+
+
+# ------------------------------------------------ fault injection
+
+
+def test_fault_injector_tolerates_missing_config(tmp_path):
+    path = tmp_path / "missing.json"
+    inj = fi.FaultInjector(str(path), watch=False)
+    inj.maybe_inject("anything")          # empty rules, no raise
+    assert inj.active_rules() == []
+    path.write_text(json.dumps({"faults": [
+        {"match": "op", "exception": "CudfException"}]}))
+    assert inj.reload() is True
+    with pytest.raises(exc.CudfException):
+        inj.maybe_inject("op")
+
+
+def test_fault_injector_bad_json_keeps_rules(tmp_path):
+    path = tmp_path / "f.json"
+    path.write_text(json.dumps({"faults": [
+        {"match": "op", "exception": "GpuRetryOOM"}]}))
+    inj = fi.FaultInjector(str(path), watch=False)
+    path.write_text("{not json")
+    assert inj.reload() is False
+    with pytest.raises(exc.GpuRetryOOM):
+        inj.maybe_inject("op")            # live rules survived
+
+
+def test_fault_injector_bad_rule_spec_tolerated(tmp_path):
+    """Valid JSON with a garbled rule (bad probability, non-dict
+    entry) must neither crash install nor drop the live rules."""
+    path = tmp_path / "f.json"
+    path.write_text(json.dumps({"faults": [
+        {"match": "op", "probability": "high"}]}))
+    inj = fi.FaultInjector(str(path), watch=False)   # must not raise
+    assert inj.active_rules() == []
+    path.write_text(json.dumps({"faults": [
+        {"match": "op", "exception": "CudfException"}]}))
+    assert inj.reload() is True
+    path.write_text(json.dumps({"faults": ["not-a-dict"]}))
+    assert inj.reload() is False
+    with pytest.raises(exc.CudfException):
+        inj.maybe_inject("op")            # live rules survived
+
+
+def test_fault_injector_restored_config_with_preserved_mtime(tmp_path):
+    """Delete-then-restore with an identical mtime (mv of a backup)
+    must still reload: clearing on a missing file forgets the applied
+    mtime."""
+    path = tmp_path / "f.json"
+    path.write_text(json.dumps({"faults": [
+        {"match": "op", "exception": "CudfException"}]}))
+    os.utime(path, (1_000_000, 1_000_000))
+    inj = fi.FaultInjector(str(path), watch=False)
+    assert inj.active_rules()
+    backup = path.read_bytes()
+    path.unlink()
+    assert inj.reload() is False and inj.active_rules() == []
+    path.write_bytes(backup)
+    os.utime(path, (1_000_000, 1_000_000))   # preserved mtime
+    assert inj.reload() is True
+    assert inj.active_rules()
+
+
+def test_fault_injector_interval_knob(tmp_path):
+    path = tmp_path / "f.json"
+    path.write_text(json.dumps({"faults": []}))
+    inj = fi.FaultInjector(str(path), watch=True, interval_ms=10)
+    try:
+        time.sleep(0.05)                  # ensure mtime tick
+        path.write_text(json.dumps({"faults": [
+            {"match": "hot", "exception": "CudfException"}]}))
+        os.utime(path)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if inj.active_rules():
+                break
+            time.sleep(0.01)
+        assert inj.active_rules(), "10ms watcher never reloaded"
+    finally:
+        inj.stop()
+
+
+def test_fault_injector_deleted_config_clears_rules(tmp_path):
+    path = tmp_path / "f.json"
+    path.write_text(json.dumps({"faults": [
+        {"match": "op", "exception": "CudfException"}]}))
+    inj = fi.FaultInjector(str(path), watch=True, interval_ms=10)
+    try:
+        assert inj.active_rules()
+        path.unlink()                     # the operator's off switch
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if not inj.active_rules():
+                break
+            time.sleep(0.01)
+        assert inj.active_rules() == [], \
+            "deleting the config never cleared the live rules"
+        inj.maybe_inject("op")            # no longer raises
+    finally:
+        inj.stop()
+
+
+def test_shim_fault_injection_surface(tmp_path, clean_runtime):
+    from spark_rapids_tpu.shim import jni_entry
+    cfg = tmp_path / "f.json"
+    cfg.write_text(json.dumps({"faults": [
+        {"match": "x", "exception": "GpuRetryOOM"}]}))
+    n = jni_entry.fault_injection_install(str(cfg), watch=False)
+    assert n == 1
+    assert jni_entry.fault_injection_config_path() == str(cfg)
+    rules = json.loads(jni_entry.fault_injection_rules_json())
+    assert rules[0]["match"] == "x"
+    jni_entry.fault_injection_uninstall()
+    assert jni_entry.fault_injection_config_path() == ""
+    prior = jni_entry.kudo_set_crc_enabled(True)
+    assert jni_entry.kudo_crc_enabled() is True
+    jni_entry.kudo_set_crc_enabled(prior)
+
+
+# ------------------------------------------------------- kudo CRC
+
+
+def _col(values):
+    return Column.from_pylist(values, dtypes.INT64)
+
+
+def test_kudo_crc_roundtrip(clean_runtime):
+    kudo.set_crc_enabled(True)
+    buf = io.BytesIO()
+    n1 = kudo.write_to_stream([_col([1, 2, 3, None, 5, 6])], buf, 0, 3)
+    n2 = kudo.write_to_stream([_col([1, 2, 3, None, 5, 6])], buf, 3, 3)
+    assert len(buf.getvalue()) == n1 + n2
+    assert kudo.CRC_MAGIC in buf.getvalue()
+    buf.seek(0)
+    kts = kudo.read_tables(buf)
+    assert len(kts) == 2
+    t = kudo.merge_to_table(kts, [Field(dtypes.INT64)])
+    assert t.to_pylist() == [(1,), (2,), (3,), (None,), (5,), (6,)]
+
+
+def test_kudo_crc_disabled_stream_is_byte_identical(clean_runtime):
+    col = _col([7, 8, 9])
+    kudo.set_crc_enabled(True)
+    on = io.BytesIO()
+    kudo.write_to_stream([col], on, 0, 3)
+    kudo.set_crc_enabled(False)
+    off = io.BytesIO()
+    kudo.write_to_stream([col], off, 0, 3)
+    assert on.getvalue()[:-kudo.CRC_TRAILER_LEN] == off.getvalue()
+    assert kudo.CRC_MAGIC not in off.getvalue()
+    # a plain reader consumes the trailer transparently
+    kt = kudo.read_one_table(io.BytesIO(on.getvalue()))
+    assert kt.header.num_rows == 3
+
+
+def test_kudo_crc_detects_body_corruption(clean_runtime):
+    kudo.set_crc_enabled(True)
+    buf = io.BytesIO()
+    kudo.write_to_stream([_col(list(range(32)))], buf, 0, 32)
+    raw = bytearray(buf.getvalue())
+    raw[-12] ^= 0x40                       # body byte (before trailer)
+    with pytest.raises(kudo.KudoCorruptException):
+        kudo.read_one_table(io.BytesIO(bytes(raw)))
+
+
+def test_kudo_crc_row_count_only(clean_runtime):
+    kudo.set_crc_enabled(True)
+    buf = io.BytesIO()
+    kudo.write_row_count_only(buf, 17)
+    buf.seek(0)
+    kt = kudo.read_one_table(buf)
+    assert kt.header.num_rows == 17
+    assert kudo.read_one_table(buf) is None
+
+
+def test_kudo_resync_salvages_multi_table_stream(clean_runtime):
+    kudo.set_crc_enabled(True)
+    col = _col(list(range(60)))
+    blobs = []
+    for lo in (0, 20, 40):
+        b = io.BytesIO()
+        kudo.write_to_stream([col], b, lo, 20)
+        blobs.append(bytearray(b.getvalue()))
+    blobs[1][len(blobs[1]) // 2] ^= 0xFF   # corrupt the middle table
+    stream = io.BytesIO(b"".join(bytes(b) for b in blobs))
+    with pytest.raises(kudo.KudoCorruptException):
+        kudo.read_tables(io.BytesIO(stream.getvalue()))
+    got = kudo.read_tables(stream, resync=True)
+    assert len(got) == 2
+    t = kudo.merge_to_table(got, [Field(dtypes.INT64)])
+    assert t.to_pylist() == [(v,) for v in
+                             list(range(20)) + list(range(40, 60))]
+
+
+def test_kudo_resync_magic_straddles_chunk_boundary(clean_runtime):
+    kudo.set_crc_enabled(False)
+    buf = io.BytesIO()
+    kudo.write_to_stream([_col([7, 8, 9])], buf, 0, 3)
+    table = buf.getvalue()
+    for junk_len in (6, 7, 8, 9):      # magic lands across 8B chunks
+        s = io.BytesIO(b"\xee" * junk_len + table)
+        assert kudo.resync_to_magic(s, chunk_size=8) == junk_len
+        assert kudo.read_one_table(s).header.num_rows == 3
+
+
+class _PipeStream:
+    """Non-seekable incremental stream: read() past the fed bytes
+    raises instead of blocking, modeling a socket with no more data."""
+
+    def __init__(self, data):
+        self._data = data
+        self._pos = 0
+
+    def seekable(self):
+        return False
+
+    def read(self, n):
+        if self._pos + n > len(self._data):
+            raise AssertionError(
+                "over-read past the fed bytes (would block a socket)")
+        out = self._data[self._pos:self._pos + n]
+        self._pos += n
+        return out
+
+
+def test_kudo_nonseekable_reader_never_overreads(clean_runtime):
+    """An incremental reader on a live (non-seekable) stream must not
+    peek past the table it was fed; a trailer that arrives later is
+    skipped unverified by the next header read."""
+    kudo.set_crc_enabled(False)
+    one = io.BytesIO()
+    kudo.write_to_stream([_col([1, 2, 3])], one, 0, 3)
+    kt = kudo.read_one_table(_PipeStream(one.getvalue()))
+    assert kt.header.num_rows == 3
+    # CRC'd tables on the same live stream: trailers skipped, tables
+    # still parse in sequence
+    kudo.set_crc_enabled(True)
+    two = io.BytesIO()
+    kudo.write_to_stream([_col([1, 2, 3])], two, 0, 2)
+    kudo.write_to_stream([_col([1, 2, 3])], two, 2, 1)
+    pipe = _PipeStream(two.getvalue())
+    assert kudo.read_one_table(pipe).header.num_rows == 2
+    assert kudo.read_one_table(pipe).header.num_rows == 1
+
+
+def test_kudo_nonseekable_crc_verified_deferred(clean_runtime):
+    """On a live stream the trailer is verified one record late (at
+    the next header read) — corruption is still caught, never
+    silently merged."""
+    kudo.set_crc_enabled(True)
+    buf = io.BytesIO()
+    kudo.write_to_stream([_col([1, 2, 3])], buf, 0, 2)
+    kudo.write_to_stream([_col([1, 2, 3])], buf, 2, 1)
+    raw = bytearray(buf.getvalue())
+    raw[33] ^= 0xFF                        # first table's body
+    pipe = _PipeStream(bytes(raw))
+    kudo.read_one_table(pipe)              # verification deferred
+    with pytest.raises(kudo.KudoCorruptException):
+        kudo.read_one_table(pipe)          # caught at the trailer
+
+
+def test_kudo_resync_no_phantom_from_corrupt_record(clean_runtime):
+    """A corrupt CRC'd record whose payload embeds a genuine kudo
+    serialization must not resurrect it as a phantom table: resync
+    resumes AFTER the failed record, never rescanning its body."""
+    from spark_rapids_tpu.shim.jni_entry import \
+        _string_column_from_buffers
+    kudo.set_crc_enabled(False)
+    inner = io.BytesIO()
+    kudo.write_to_stream([_col([777])], inner, 0, 1)
+    ib = inner.getvalue()
+    # STRING column whose chars buffer IS the inner table's bytes
+    host = _string_column_from_buffers(
+        np.frombuffer(ib, np.uint8),
+        np.array([0, len(ib)], np.int32), None, 1)
+    kudo.set_crc_enabled(True)
+    buf = io.BytesIO()
+    n1 = kudo.write_to_stream([host], buf, 0, 1)
+    kudo.write_to_stream([_col([1, 2, 3])], buf, 0, 3)
+    raw = bytearray(buf.getvalue())
+    raw[n1 - 9] ^= 0xFF            # last body byte before the trailer
+    got = kudo.read_tables(io.BytesIO(bytes(raw)), resync=True)
+    from spark_rapids_tpu.shuffle.schema import Field as _F
+    assert len(got) == 1
+    t = kudo.merge_to_table(got, [_F(dtypes.INT64)])
+    assert t.to_pylist() == [(1,), (2,), (3,)]
+
+
+def test_stream_has_crc_trailers_structured(clean_runtime):
+    # payload containing the literal b"KCRC" must NOT read as a
+    # trailer; a real trailer must
+    kudo.set_crc_enabled(False)
+    buf = io.BytesIO()
+    col = Column.from_strings(["xxKCRCyy", "plain"])
+    kudo.write_to_stream([col], buf, 0, 2)
+    assert kudo.CRC_MAGIC in buf.getvalue()
+    assert not kudo.stream_has_crc_trailers(buf.getvalue())
+    kudo.set_crc_enabled(True)
+    buf2 = io.BytesIO()
+    kudo.write_to_stream([col], buf2, 0, 2)
+    assert kudo.stream_has_crc_trailers(buf2.getvalue())
+
+
+def test_kudo_corruption_loud_without_crc(clean_runtime):
+    kudo.set_crc_enabled(False)
+    buf = io.BytesIO()
+    kudo.write_to_stream([_col([1, 2, 3])], buf, 0, 3)
+    raw = bytearray(buf.getvalue())
+    raw[1] ^= 0xFF                         # smash the magic
+    with pytest.raises(ValueError):
+        kudo.read_one_table(io.BytesIO(bytes(raw)))
+    with pytest.raises(EOFError):          # truncation is loud too
+        kudo.read_one_table(io.BytesIO(buf.getvalue()[:-4]))
+    # structurally impossible header lengths are loud too: blow
+    # validity_len (bytes 12..15, after magic+offset+num_rows) past
+    # total_len
+    raw = bytearray(buf.getvalue())
+    raw[12:16] = (1 << 24).to_bytes(4, "big")
+    with pytest.raises(kudo.KudoCorruptException):
+        kudo.read_one_table(io.BytesIO(bytes(raw)))
+
+
+def test_shim_kudo_merge_handles_peer_crc_blob(clean_runtime):
+    """A CRC'd blob from a peer process must merge correctly even when
+    the local CRC setting is off (the native engine doesn't understand
+    KCRC trailers, so content gates the engine choice)."""
+    from spark_rapids_tpu.shim import jni_entry
+    kudo.set_crc_enabled(True)
+    buf = io.BytesIO()
+    kudo.write_to_stream([_col([4, 5, 6])], buf, 0, 3)
+    kudo.set_crc_enabled(False)            # reader-side setting
+    out = jni_entry.kudo_merge(buf.getvalue(), ["int64"], [0])
+    assert jni_entry.column_to_host(out[0]) == [4, 5, 6]
+    for h in out:
+        jni_entry.free(h)
+
+
+def test_kudo_merge_split_retry_equivalence(clean_runtime, tmp_path):
+    """An injected GpuSplitAndRetryOOM mid-merge halves the table list
+    and still produces the identical merged table."""
+    kudo.set_crc_enabled(False)
+    col = _col(list(range(40)))
+    kts = []
+    for lo in (0, 10, 20, 30):
+        b = io.BytesIO()
+        kudo.write_to_stream([col], b, lo, 10)
+        b.seek(0)
+        kts.append(kudo.read_one_table(b))
+    want = kudo.merge_to_table(kts, [Field(dtypes.INT64)]).to_pylist()
+    cfg = tmp_path / "f.json"
+    cfg.write_text(json.dumps({"faults": [
+        {"match": "kudo_merge", "exception": "GpuSplitAndRetryOOM",
+         "repeat": 1}]}))
+    fi.install(str(cfg), watch=False)
+    got = kudo.merge_to_table(kts, [Field(dtypes.INT64)]).to_pylist()
+    assert got == want == [(v,) for v in range(40)]
+
+
+# ------------------------------------------------- capacity retry
+
+
+def test_capacity_exceeded_carries_send_counts():
+    from spark_rapids_tpu.parallel.exchange import (CapacityExceeded,
+                                                    with_capacity_retry)
+    observed = np.array([3, 11, 0, 7], np.int32)
+    run = with_capacity_retry(lambda cap: (lambda: ("out", observed)),
+                              2, max_doublings=2,
+                              counts_indicator=True)
+    with pytest.raises(CapacityExceeded) as ei:
+        run()
+    e = ei.value
+    assert e.send_counts == [3, 11, 0, 7]
+    assert e.capacity == 8 and e.doublings == 2
+
+
+def test_capacity_retry_deadline_policy():
+    from spark_rapids_tpu.parallel.exchange import (CapacityExceeded,
+                                                    with_capacity_retry)
+    clock = {"t": 0.0}
+
+    def make(cap):
+        def step():
+            clock["t"] += 1.0
+            return ("out", np.array([True]))
+        return step
+
+    pol = R.RetryPolicy(max_attempts=50, base_backoff_s=0.0,
+                        deadline_s=2.5, clock=lambda: clock["t"])
+    run = with_capacity_retry(make, 2, max_doublings=49, policy=pol)
+    with pytest.raises(CapacityExceeded, match="deadline"):
+        run()
+    assert clock["t"] < 10  # stopped long before 50 attempts
+
+
+def test_capacity_retry_success_unchanged():
+    from spark_rapids_tpu.parallel.exchange import with_capacity_retry
+
+    def make(cap):
+        return lambda: (cap, np.array([cap < 8]))
+
+    out, cap = with_capacity_retry(make, 2, max_doublings=4)()
+    assert out[0] == 8 and cap == 8
+
+
+def test_capacity_retry_int_flag_keeps_truthiness_semantics():
+    """Without the counts_indicator opt-in, an integer 0/1 flag keeps
+    the pre-existing any-truthy contract (never compared to cap)."""
+    from spark_rapids_tpu.parallel.exchange import with_capacity_retry
+
+    def make(cap):
+        return lambda: (cap, np.array([0 if cap >= 8 else 1],
+                                      np.int32))
+
+    out, cap = with_capacity_retry(make, 2, max_doublings=4)()
+    assert out[0] == 8 and cap == 8
+
+
+# ------------------------------------------- metrics/span folding
+
+
+def test_retry_episode_metrics_and_spans(clean_runtime):
+    obs.enable()
+    obs.enable_tracing()
+    obs.reset()
+    calls = []
+
+    def fn():
+        calls.append(1)
+        if len(calls) < 3:
+            raise exc.GpuRetryOOM("transient")
+        return "ok"
+
+    assert R.with_retry(fn, name="ep_test",
+                        policy=quick_policy()) == "ok"
+    eps = obs.JOURNAL.records("retry_episode")
+    assert len(eps) == 1
+    ep = eps[0]
+    assert ep["name"] == "ep_test" and ep["outcome"] == "success"
+    assert ep["attempts"] == 3 and ep["retries"] == 2
+    assert ep["errors"] == ["GpuRetryOOM", "GpuRetryOOM"]
+    spans = [r for r in obs.TRACER.records()
+             if r["span_kind"] == "retry"]
+    assert len(spans) == 1
+    assert spans[0]["name"] == "retry_episode:ep_test"
+    assert spans[0]["attrs"]["attempts"] == 3
+    assert spans[0]["attrs"]["outcome"] == "success"
+    text = obs.expose_text()
+    assert "srt_retry_attempts_total 3" in text
+    assert 'srt_retry_episodes_total{outcome="success"} 1' in text
+
+
+def test_episode_recorded_when_terminal_error_follows_retry(
+        clean_runtime):
+    """A non-retryable escape AFTER retry activity must still fold
+    the episode into the spine (outcome 'error'); a clean
+    first-attempt crash records nothing."""
+    obs.enable()
+    obs.reset()
+    calls = []
+
+    def fn():
+        calls.append(1)
+        if len(calls) == 1:
+            raise exc.GpuRetryOOM("transient")
+        raise TypeError("bug after retry")
+
+    with pytest.raises(TypeError):
+        R.with_retry(fn, name="crashy", policy=quick_policy())
+    eps = obs.JOURNAL.records("retry_episode")
+    assert len(eps) == 1 and eps[0]["outcome"] == "error"
+    assert eps[0]["errors"] == ["GpuRetryOOM", "TypeError"]
+    obs.reset()
+    with pytest.raises(TypeError):
+        R.with_retry(lambda: (_ for _ in ()).throw(TypeError("x")),
+                     policy=quick_policy())
+    assert not obs.JOURNAL.records("retry_episode")
+
+
+def test_split_episode_recorded_on_splitter_bug(clean_runtime):
+    obs.enable()
+    obs.reset()
+
+    def fn(part):
+        if len(part) > 1:
+            raise exc.GpuSplitAndRetryOOM("too big")
+        return list(part)
+
+    def bad_splitter(part):
+        raise RuntimeError("splitter bug")
+
+    with pytest.raises(RuntimeError, match="splitter bug"):
+        R.split_and_retry(fn, [1, 2], batch_splitter=bad_splitter,
+                          name="splitbug", policy=quick_policy())
+    eps = obs.JOURNAL.records("retry_episode")
+    assert len(eps) == 1 and eps[0]["outcome"] == "error"
+
+
+def test_fault_injector_interval_env_tolerant(tmp_path, monkeypatch):
+    path = tmp_path / "f.json"
+    path.write_text(json.dumps({"faults": []}))
+    for bad in ("abc", "0", "-5"):
+        monkeypatch.setenv(fi.INTERVAL_ENV, bad)
+        inj = fi.FaultInjector(str(path), watch=False)
+        assert inj.interval_ms == fi.DEFAULT_INTERVAL_MS, bad
+    monkeypatch.setenv(fi.INTERVAL_ENV, "50")
+    assert fi.FaultInjector(str(path),
+                            watch=False).interval_ms == 50
+
+
+def test_zero_failure_episode_records_nothing(clean_runtime):
+    obs.enable()
+    obs.enable_tracing()
+    obs.reset()
+    assert R.with_retry(lambda: 1, policy=quick_policy()) == 1
+    assert not obs.JOURNAL.records("retry_episode")
+    assert not [r for r in obs.TRACER.records()
+                if r["span_kind"] == "retry"]
+
+
+def test_metrics_report_retry_section(clean_runtime, tmp_path):
+    from spark_rapids_tpu.tools import metrics_report
+    obs.enable()
+    obs.reset()
+    state = {"n": 0}
+
+    def fn(part):
+        state["n"] += 1
+        if state["n"] == 1:
+            raise exc.GpuSplitAndRetryOOM("big")
+        return list(part)
+
+    R.split_and_retry(fn, [1, 2, 3, 4], name="report_test",
+                      policy=quick_policy())
+    path = tmp_path / "j.jsonl"
+    obs.dump_journal_jsonl(str(path))
+    report = metrics_report.build_report(
+        metrics_report.load_jsonl([str(path)]))
+    rows = report["retry_episodes"]
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["name"] == "report_test" and r["splits"] == 1
+    assert r["max_split_depth"] == 1
+    assert r["outcomes"] == {"success": 1}
+    text = "\n".join(metrics_report.render_retry_table(
+        obs.JOURNAL.records()))
+    assert "report_test" in text and "retry episodes" in text
+
+
+# --------------------------------------------------- chaos smoke
+
+
+def test_chaos_smoke_deterministic_under_seed(clean_runtime):
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts"))
+    import chaos_smoke
+    d1, _ = chaos_smoke.run_chaos(seed=5, rows=512, verbose=False)
+    d2, _ = chaos_smoke.run_chaos(seed=5, rows=512, verbose=False)
+    assert d1 == d2
+
+
+def test_query_pipeline_recovers_from_injected_oom(clean_runtime,
+                                                   tmp_path):
+    from spark_rapids_tpu.models import tpcds
+    d = tpcds.gen_q9(rows=512)
+    want = [tuple(np.asarray(x).tolist()) for x in tpcds.run_q9(*d)]
+    cfg = tmp_path / "f.json"
+    cfg.write_text(json.dumps({"faults": [
+        {"match": "tpcds_q9", "exception": "GpuRetryOOM",
+         "repeat": 2}]}))
+    fi.install(str(cfg), watch=False)
+    got = [tuple(np.asarray(x).tolist()) for x in tpcds.run_q9(*d)]
+    assert got == want
