@@ -5,7 +5,7 @@ PY ?= python
 
 .PHONY: test fuzz native sanitizers bench bench-all dryrun tpu-lower \
         jni-test kudo-bench metrics-smoke trace-smoke chaos-smoke \
-        nightly-artifacts ci ci-nightly clean
+        perf-smoke nightly-artifacts ci ci-nightly clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -67,6 +67,14 @@ trace-smoke:
 chaos-smoke:
 	$(PY) scripts/chaos_smoke.py
 
+# compile-cache gate: a two-batch 64-column conversion must hit the
+# kernel compile cache on the second batch (zero new XLA executables
+# for to-rows / from-rows / row-hash), stay under a generous wall-time
+# threshold, match the cache-disabled eager bytes, and surface
+# srt_jit_cache_* through the exposition + metrics_report cache table
+perf-smoke:
+	$(PY) scripts/perf_smoke.py
+
 # NOTE: jax.config.update, not the env var — this image's sitecustomize
 # pre-imports jax with the axon backend, so JAX_PLATFORMS=cpu is too
 # late.  XLA_FLAGS still works (read at backend init, which happens
@@ -88,7 +96,7 @@ dryrun:
 # (default 1500s) before emitting the CPU-fallback line — export
 # BENCH_FIGHT_SECONDS=1 for a quick local run.
 ci: test fuzz native sanitizers tpu-lower jni-test dryrun metrics-smoke \
-    trace-smoke chaos-smoke
+    trace-smoke chaos-smoke perf-smoke
 	$(PY) bench.py
 	@echo "ci: all gates green"
 

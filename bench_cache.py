@@ -1,0 +1,52 @@
+"""Tiny shared JSON verdict cache for the bench entry points.
+
+Used by bench.py (TPU probe verdicts) and bench_impl.py (rowconv
+calibration verdicts) so the two don't grow divergent load/store/TTL
+logic.  Deliberately imports NOTHING heavy — bench.py must stay
+importable before any jax backend decision is made.
+"""
+
+import json
+import os
+import time
+
+
+def load_json(path: str):
+    """Parsed dict at ``path``, or None (missing/unreadable/not a
+    dict — a corrupt cache must never break a bench run)."""
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            d = json.load(f)
+        return d if isinstance(d, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def store_json(path: str, obj: dict) -> None:
+    if not path:
+        return
+    try:
+        with open(path, "w") as f:
+            json.dump(obj, f)
+    except OSError:
+        pass
+
+
+def fresh(rec, ttl_s: float) -> bool:
+    """True when ``rec`` carries a 't' epoch newer than ttl_s ago.
+    Every stored verdict expires — a stale (possibly transient) verdict
+    must eventually be re-earned, never pinned forever."""
+    try:
+        return (rec is not None
+                and time.time() - float(rec.get("t", 0)) < ttl_s)
+    except (TypeError, ValueError):
+        return False
+
+
+def env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default

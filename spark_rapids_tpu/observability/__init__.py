@@ -158,6 +158,22 @@ KUDO_RESYNC_BYTES = METRICS.counter(
     "srt_kudo_resync_skipped_bytes_total",
     "Bytes skipped while resyncing corrupted kudo streams to the "
     "next magic")
+JIT_CACHE_HITS = METRICS.counter(
+    "srt_jit_cache_hits_total",
+    "Kernel compile-cache hits (perf/jit_cache.py)", labels=("kernel",))
+JIT_CACHE_MISSES = METRICS.counter(
+    "srt_jit_cache_misses_total",
+    "Kernel compile-cache misses (each one compiled an executable)",
+    labels=("kernel",))
+JIT_CACHE_EVICTIONS = METRICS.counter(
+    "srt_jit_cache_evictions_total",
+    "Kernel compile-cache LRU evictions (entry/byte budget)",
+    labels=("kernel",))
+JIT_COMPILE_TIME = METRICS.histogram(
+    "srt_jit_compile_ns",
+    "Kernel lower+compile wall time on compile-cache misses",
+    labels=("kernel",), buckets=DEFAULT_LATENCY_BUCKETS_NS,
+    max_series=128)
 SPAN_DURATION = METRICS.histogram(
     "srt_span_duration_ns", "Span durations by span kind and name",
     labels=("span_kind", "name"),
@@ -312,6 +328,22 @@ def record_kudo_corruption(reason: str, *, skipped_bytes: int = 0,
     JOURNAL.emit("kudo_corrupt", reason=reason,
                  skipped_bytes=skipped_bytes, detail=detail[:200],
                  thread=threading.get_ident())
+
+
+def record_jit_cache(event: str, kernel: str, *,
+                     compile_ns: int = 0) -> None:
+    """Compile-cache hook (perf/jit_cache.py): event in
+    {'hit', 'miss', 'eviction'}.  Misses carry the lower+compile wall
+    time observed for the new executable."""
+    if not _SWITCH.enabled:
+        return
+    if event == "hit":
+        JIT_CACHE_HITS.inc(labels=(kernel,))
+    elif event == "miss":
+        JIT_CACHE_MISSES.inc(labels=(kernel,))
+        JIT_COMPILE_TIME.observe(compile_ns, labels=(kernel,))
+    elif event == "eviction":
+        JIT_CACHE_EVICTIONS.inc(labels=(kernel,))
 
 
 def record_exchange_doubling(from_capacity: int, to_capacity: int,

@@ -250,8 +250,21 @@ def _xx_update8(h, k64):
     return _rotl64(h, 27) * _XXP1 + _XXP4
 
 
+def _u64_lo32(k32) -> jnp.ndarray:
+    """Zero-extend a u32 block to u64 — with an explicit low-32 mask.
+
+    The mask is semantically a no-op on a real u32, but it is
+    load-bearing under jit: XLA's algebraic simplifier collapses
+    convert chains like i16->i32->u32->u64 into one i16->u64 convert,
+    turning the intermediate unsigned truncation into a 64-bit SIGN
+    extension (observed miscompiling xxhash64 of negative narrow ints
+    on this backend's CPU pipeline).  The mask pins the zero-extension
+    whatever the converts collapse to."""
+    return k32.astype(_U64) & _U64(0xFFFFFFFF)
+
+
 def _xx_update4(h, k32):
-    h = h ^ (k32.astype(_U64) * _XXP1)
+    h = h ^ (_u64_lo32(k32) * _XXP1)
     return _rotl64(h, 23) * _XXP2 + _XXP3
 
 
@@ -291,8 +304,8 @@ class _XXHash64:
         i = 0
         rem = nbytes
         while rem >= 8:
-            k64 = blocks[i].astype(_U64) | (blocks[i + 1].astype(_U64)
-                                            << _U64(32))
+            k64 = _u64_lo32(blocks[i]) | (blocks[i + 1].astype(_U64)
+                                          << _U64(32))
             h = _xx_update8(h, k64)
             i += 2
             rem -= 8
@@ -526,12 +539,67 @@ def _hash_list_column(algo, h, col: Column, max_str_len: Optional[int]):
     return h2
 
 
+def _hash_cacheable(cols: Sequence[Column]) -> bool:
+    """Fixed-width non-nested schemas hash through the compile cache;
+    strings/lists/structs/decimal128 need host-derived pads or concrete
+    offsets, and tracer inputs mean we are already inside someone
+    else's jit (the models' step builders) — both stay eager."""
+    for c in cols:
+        if c.dtype.kind in (Kind.STRING, Kind.LIST, Kind.STRUCT,
+                            Kind.DECIMAL128):
+            return False
+        if isinstance(c.data, jax.core.Tracer):
+            return False
+        if c.validity is not None and \
+                isinstance(c.validity, jax.core.Tracer):
+            return False
+    return True
+
+
+def _run_row_hash_cached(algo, cols: Sequence[Column], seed: int,
+                         rows: int) -> Column:
+    """Row hash through perf/jit_cache: one executable per (algo,
+    schema digest, row bucket).  The seed travels as a traced scalar so
+    re-seeding never recompiles; padded tail rows hash to garbage and
+    are sliced off."""
+    from spark_rapids_tpu.perf import jit_cache as _jc
+
+    name = ("hash.murmur3_32" if algo is _Murmur32 else "hash.xxhash64")
+    nullable = tuple(c.validity is not None for c in cols)
+    schema_t = tuple(c.dtype for c in cols)
+    digest = _jc.schema_digest(schema_t, nullable, extra=name)
+    bucket = _jc.bucket_rows(rows)
+    datas = tuple(_jc.pad_axis0(c.data, bucket) for c in cols)
+    valids = tuple(None if c.validity is None
+                   else _jc.pad_axis0(c.validity, bucket) for c in cols)
+    if algo.htype == _U32:
+        seed_arr = jnp.asarray(np.uint32(seed & 0xFFFFFFFF))
+    else:
+        seed_arr = jnp.asarray(np.uint64(seed & 0xFFFFFFFFFFFFFFFF))
+
+    def kernel(datas, valids, seed_arr):
+        kcols = [Column(dt, bucket, data=d, validity=v)
+                 for dt, d, v in zip(schema_t, datas, valids)]
+        h = jnp.broadcast_to(seed_arr, (bucket,))
+        for c in kcols:
+            h = _hash_element_column(algo, h, c, None)
+        return algo.finish(h)
+
+    out = _jc.CACHE.cached_call(name, digest, kernel,
+                                (datas, valids, seed_arr),
+                                bucket=bucket, donate_argnums=(0,))
+    return Column(algo.out_dtype, rows, data=out[:rows])
+
+
 def _run_row_hash(algo, table_or_cols, seed: int,
                   max_str_len: Optional[int]) -> Column:
     cols = _cols(table_or_cols)
     if not cols:
         raise ValueError("need at least one column to hash")
     rows = cols[0].length
+    from spark_rapids_tpu.perf import jit_cache as _jc
+    if _jc.cache_enabled() and rows > 0 and _hash_cacheable(cols):
+        return _run_row_hash_cached(algo, cols, seed, rows)
     h = algo.seed_array(rows, seed)
     for c in cols:
         h = _hash_element_column(algo, h, c, max_str_len)

@@ -85,15 +85,49 @@ def assemble_rows_pallas(inputs: Sequence[jnp.ndarray],
 def assemble_fixed_words_pallas(cols, starts, validity_offset, row_size,
                                 block_rows: int = 512,
                                 interpret: bool = False) -> jnp.ndarray:
-    """Drop-in replacement for row_conversion._assemble_fixed_words."""
+    """Drop-in replacement for row_conversion._assemble_fixed_words.
+
+    Routes through the process compile cache (perf/jit_cache.py) when
+    enabled: column operands pad to the power-of-two row bucket,
+    build_plan + the tile kernel trace once per (schema digest, bucket)
+    and later batches in the same bucket reuse the executable."""
     from spark_rapids_tpu.ops.row_conversion import build_plan
+    from spark_rapids_tpu.perf import jit_cache as _jc
 
     rows = cols[0].length
     n_words = row_size // 4
-    inputs, plan = build_plan(cols, starts, validity_offset, n_words)
-    return assemble_rows_pallas(inputs, plan, rows, n_words,
-                                block_rows=block_rows,
-                                interpret=interpret)
+    traced = any(isinstance(c.data, jax.core.Tracer) for c in cols)
+    if not _jc.cache_enabled() or rows == 0 or traced:
+        inputs, plan = build_plan(cols, starts, validity_offset, n_words)
+        return assemble_rows_pallas(inputs, plan, rows, n_words,
+                                    block_rows=block_rows,
+                                    interpret=interpret)
+
+    from spark_rapids_tpu.columns.column import Column as _Col
+    nullable = tuple(c.validity is not None for c in cols)
+    schema_t = tuple(c.dtype for c in cols)
+    starts_t = tuple(starts)
+    digest = _jc.schema_digest(
+        schema_t, nullable,
+        extra=f"pallas_to:{row_size}:{block_rows}:{int(interpret)}")
+    bucket = _jc.bucket_rows(rows)
+    datas = tuple(_jc.pad_axis0(c.data, bucket) for c in cols)
+    valids = tuple(None if c.validity is None
+                   else _jc.pad_axis0(c.validity, bucket) for c in cols)
+
+    def kernel(datas, valids):
+        kcols = [_Col(dt, bucket, data=d, validity=v)
+                 for dt, d, v in zip(schema_t, datas, valids)]
+        inputs, plan = build_plan(kcols, starts_t, validity_offset,
+                                  n_words)
+        return assemble_rows_pallas(inputs, plan, bucket, n_words,
+                                    block_rows=block_rows,
+                                    interpret=interpret)
+
+    out = _jc.CACHE.cached_call("pallas.to_rows", digest, kernel,
+                                (datas, valids), bucket=bucket,
+                                donate_argnums=(0,))
+    return out[: rows * n_words]
 
 
 # ------------------------------------------------- from-rows direction
@@ -189,9 +223,29 @@ def convert_from_rows_pallas(list_col: Column, schema,
     mat = words.reshape(rows, n_words)
     plan, col_entries, valid_entries = build_extract_plan(
         schema, starts, validity_offset, n_words)
-    pieces = disassemble_rows_pallas(mat, plan,
-                                     block_rows=block_rows,
-                                     interpret=interpret)
+    from spark_rapids_tpu.perf import jit_cache as _jc
+    if (_jc.cache_enabled() and rows > 0
+            and not isinstance(mat, jax.core.Tracer)):
+        # bucketed + compile-cached tile disassembly: pad the row
+        # matrix (padded rows decode to garbage sliced off below)
+        bucket = _jc.bucket_rows(rows)
+        mat_p = _jc.pad_axis0(mat, bucket)
+        digest = _jc.schema_digest(
+            schema,
+            extra=f"pallas_from:{row_size}:{block_rows}:{int(interpret)}")
+
+        def kernel(mat_p):
+            return tuple(disassemble_rows_pallas(
+                mat_p, plan, block_rows=block_rows, interpret=interpret))
+
+        pieces_b = _jc.CACHE.cached_call(
+            "pallas.from_rows", digest, kernel, (mat_p,),
+            bucket=bucket, donate_argnums=(0,))
+        pieces = [p[:rows] for p in pieces_b]
+    else:
+        pieces = disassemble_rows_pallas(mat, plan,
+                                         block_rows=block_rows,
+                                         interpret=interpret)
     out_cols = []
     for ci, dt in enumerate(schema):
         es = [pieces[e] for e in col_entries[ci]]

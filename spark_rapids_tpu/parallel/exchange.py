@@ -144,10 +144,27 @@ def with_capacity_retry(make_step: Callable[[int], Callable],
     CapacityExceeded early instead of compiling ever-larger programs.
 
     Returns run(*args) -> (outputs, capacity_used)."""
+    from spark_rapids_tpu.perf import jit_cache as _jc
     from spark_rapids_tpu.robustness.retry import RetryPolicy
     steps = {}
     pol = policy or RetryPolicy(max_attempts=max_doublings + 1,
                                 base_backoff_s=0.0)
+
+    def _step_for(cap: int):
+        """Capacity-parameterized programs live in the process compile
+        cache (perf/jit_cache.py): one entry per (factory, capacity),
+        so steady-state budgets survive across driver instances, show
+        up in srt_jit_cache_* stats, and participate in LRU eviction.
+        The factory object itself is the entry owner — identity-checked
+        on hits, so a recycled id() can never resurrect a stale step."""
+        if not _jc.CACHE.enabled():
+            if cap not in steps:
+                steps[cap] = make_step(cap)
+            return steps[cap]
+        return _jc.CACHE.get_or_build(
+            "exchange.step", f"factory@{id(make_step)}", cap,
+            lambda: make_step(cap), owner=make_step,
+            counts_compile=False)
 
     def run(*args):
         # stage-level span: one per driver invocation, covering every
@@ -161,9 +178,7 @@ def with_capacity_retry(make_step: Callable[[int], Callable],
             lost_ns = 0
             while True:
                 attempt_t0 = time.monotonic_ns()
-                if cap not in steps:
-                    steps[cap] = make_step(cap)
-                out = steps[cap](*args)
+                out = _step_for(cap)(*args)
                 indicator = np.asarray(out[overflow_index])
                 if counts_indicator:
                     overflowed = bool(np.any(indicator > cap))

@@ -257,6 +257,28 @@ def fault_injection_rules_json() -> str:
     return json.dumps(inj.active_rules() if inj is not None else [])
 
 
+# ----------------------------------------------------------- jit cache
+# (compile-cache control surface: the JVM polls hit rates between
+# stages and clears the cache around schema migrations)
+
+
+def jit_cache_stats() -> str:
+    """JSON stats of the process kernel compile cache (perf/jit_cache):
+    entries/bytes, hit/miss/eviction/compile totals, and per-kernel
+    breakdowns."""
+    import json
+
+    from spark_rapids_tpu.perf import jit_cache
+    return json.dumps(jit_cache.CACHE.stats(), sort_keys=True)
+
+
+def jit_cache_clear(reset_stats: bool = False) -> int:
+    """Drop every cached executable; returns the number dropped.
+    ``reset_stats`` additionally zeroes the cumulative counters."""
+    from spark_rapids_tpu.perf import jit_cache
+    return jit_cache.CACHE.clear(reset_stats=bool(reset_stats))
+
+
 # ------------------------------------------------------------ kudo crc
 
 

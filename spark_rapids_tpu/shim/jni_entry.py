@@ -758,6 +758,16 @@ def fault_injection_rules_json() -> str:
     return jni_api.fault_injection_rules_json()
 
 
+def jit_cache_stats() -> str:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.jit_cache_stats()
+
+
+def jit_cache_clear(reset_stats: bool = False) -> int:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.jit_cache_clear(bool(reset_stats))
+
+
 def kudo_set_crc_enabled(enabled: bool) -> bool:
     from spark_rapids_tpu.shim import jni_api
     return jni_api.kudo_set_crc_enabled(bool(enabled))

@@ -184,6 +184,51 @@ def render_op_table(rollups: Dict[int, dict]) -> List[str]:
     return out
 
 
+def jit_cache_rows(registry: Optional[dict]) -> List[dict]:
+    """Per-kernel compile-cache counters (srt_jit_cache_*) from a
+    registry snapshot, busiest kernel first, with a derived hit rate.
+    Compile-time distributions live in the srt_jit_compile_ns rows of
+    the histogram table."""
+    agg: Dict[str, dict] = {}
+    for metric, field in (("srt_jit_cache_hits_total", "hits"),
+                          ("srt_jit_cache_misses_total", "misses"),
+                          ("srt_jit_cache_evictions_total", "evictions")):
+        fam = (registry or {}).get(metric)
+        if not fam:
+            continue
+        for s in fam.get("series", []):
+            kernel = s["labels"][0] if s.get("labels") else "?"
+            a = agg.setdefault(kernel, {"kernel": kernel, "hits": 0,
+                                        "misses": 0, "evictions": 0})
+            a[field] = int(s.get("value", 0))
+    rows = []
+    for a in agg.values():
+        total = a["hits"] + a["misses"]
+        a["hit_rate"] = a["hits"] / total if total else 0.0
+        rows.append(a)
+    return sorted(rows, key=lambda a: -(a["hits"] + a["misses"]))
+
+
+def render_jit_cache_table(registry: Optional[dict]) -> List[str]:
+    """Kernel compile-cache summary: a cold cache (hit rate ~0) on a
+    steady workload is the shape-bucketing regression signal."""
+    rows = jit_cache_rows(registry)
+    out = ["", "jit compile cache (srt_jit_cache_*)", ""]
+    if not rows:
+        out.append("(no compile-cache activity recorded)")
+        return out
+    w = max(len(r["kernel"]) for r in rows)
+    hdr = (f"{'kernel':<{w}}  {'hits':>7}  {'misses':>7}  "
+           f"{'evict':>6}  {'hit_rate':>8}")
+    out.append(hdr)
+    out.append("-" * len(hdr))
+    for r in rows:
+        out.append(f"{r['kernel']:<{w}}  {r['hits']:>7}  "
+                   f"{r['misses']:>7}  {r['evictions']:>6}  "
+                   f"{r['hit_rate']:>8.2f}")
+    return out
+
+
 def retry_episode_rows(events: List[dict]) -> List[dict]:
     """Aggregate retry_episode journal events per driver name:
     episodes, attempts, splits, max split depth, time lost, and the
@@ -270,6 +315,7 @@ def build_report(records: List[dict]) -> dict:
         "has_registry_snapshot": registry is not None,
         "histograms": histogram_rows(registry),
         "retry_episodes": retry_episode_rows(events),
+        "jit_cache": jit_cache_rows(registry),
     }
 
 
@@ -296,6 +342,7 @@ def main(argv=None) -> int:
     lines += render_event_table(events)
     lines += render_retry_table(events)
     if registry is not None:
+        lines += render_jit_cache_table(registry)
         lines += render_histogram_table(registry)
         lines.append("")
         lines.append(f"registry snapshot: {len(registry)} metric families")
