@@ -5,7 +5,7 @@ PY ?= python
 
 .PHONY: test fuzz native sanitizers bench bench-all dryrun tpu-lower \
         jni-test kudo-bench metrics-smoke trace-smoke chaos-smoke \
-        perf-smoke nightly-artifacts ci ci-nightly clean
+        perf-smoke doctor-smoke nightly-artifacts ci ci-nightly clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -75,6 +75,13 @@ chaos-smoke:
 perf-smoke:
 	$(PY) scripts/perf_smoke.py
 
+# flight-recorder gate: a chaos-injected retry exhaustion must freeze
+# exactly ONE rate-limited incident bundle under the byte budget, and
+# srt-doctor on that bundle must name the injected fault rule as root
+# cause and the task id holding device memory at incident time
+doctor-smoke:
+	$(PY) scripts/doctor_smoke.py
+
 # NOTE: jax.config.update, not the env var — this image's sitecustomize
 # pre-imports jax with the axon backend, so JAX_PLATFORMS=cpu is too
 # late.  XLA_FLAGS still works (read at backend init, which happens
@@ -96,7 +103,7 @@ dryrun:
 # (default 1500s) before emitting the CPU-fallback line — export
 # BENCH_FIGHT_SECONDS=1 for a quick local run.
 ci: test fuzz native sanitizers tpu-lower jni-test dryrun metrics-smoke \
-    trace-smoke chaos-smoke perf-smoke
+    trace-smoke chaos-smoke perf-smoke doctor-smoke
 	$(PY) bench.py
 	@echo "ci: all gates green"
 

@@ -1,0 +1,151 @@
+"""Flight-recorder gate (`make doctor-smoke`, ISSUE 5 acceptance):
+a chaos-injected retry exhaustion must freeze EXACTLY ONE rate-limited
+incident bundle under the byte budget, and `srt-doctor` on that bundle
+must name the injected fault rule as root cause and the task id that
+was holding device memory when the query died.
+
+Flow: arm the recorder into a temp dir -> register a task thread that
+allocates (and keeps) 1 MiB -> install a fault-injection rule that
+makes section 'doctor_probe' fail every attempt -> with_retry exhausts
+-> assert one complete bundle (a second exhaustion inside the
+rate-limit window must NOT add another) -> run the doctor and grep its
+diagnosis.  Exits non-zero on the first missing signal."""
+
+import io
+import json
+import os
+import shutil
+import sys
+import tempfile
+from contextlib import redirect_stdout
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TASK_ID = 7
+HELD_BYTES = 1 << 20
+
+
+def fail(msg: str) -> "NoReturn":  # noqa: F821
+    print(f"doctor-smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> int:
+    from spark_rapids_tpu import observability as obs
+    from spark_rapids_tpu.memory import rmm_spark
+    from spark_rapids_tpu.robustness import retry
+    from spark_rapids_tpu.tools import doctor
+    from spark_rapids_tpu.utils import fault_injection as fi
+
+    tmp = tempfile.mkdtemp(prefix="doctor_smoke_")
+    bundles = os.path.join(tmp, "incidents")
+    max_bytes = 8 << 20
+    fi.uninstall()
+    obs.enable()
+    obs.enable_tracing()
+    obs.reset()
+    obs.enable_flight_recorder(out_dir=bundles, max_bytes=max_bytes,
+                               min_interval_s=300.0)
+    rmm_spark.set_event_handler(256 << 20)
+    rmm_spark.current_thread_is_dedicated_to_task(TASK_ID)
+    adaptor = rmm_spark.get_adaptor()
+    try:
+        # the evidence the doctor must surface: this thread holds 1 MiB
+        # when the query dies
+        adaptor.allocate(HELD_BYTES)
+
+        cfg_path = os.path.join(tmp, "faults.json")
+        with open(cfg_path, "w") as f:
+            json.dump({"faults": [{"match": "doctor_probe",
+                                   "exception": "GpuRetryOOM",
+                                   "repeat": -1}]}, f)
+        fi.install(cfg_path, watch=False)
+
+        policy = retry.RetryPolicy(max_attempts=3, base_backoff_s=0.0)
+
+        def exhaust():
+            try:
+                retry.with_retry(lambda: None, name="doctor_probe",
+                                 policy=policy)
+            except retry.RetryExhausted:
+                return True
+            return False
+
+        if not exhaust():
+            fail("injected fault did not exhaust the retry budget")
+        incidents = obs.FLIGHT.incident_list()
+        if len(incidents) != 1:
+            fail(f"expected exactly one bundle, found {len(incidents)}")
+
+        # a second exhaustion inside the rate-limit window must be
+        # suppressed, not dumped
+        if not exhaust():
+            fail("second injected exhaustion did not fire")
+        incidents = obs.FLIGHT.incident_list()
+        if len(incidents) != 1:
+            fail(f"rate limit failed: {len(incidents)} bundles after "
+                 f"two triggers")
+        if obs.FLIGHT.stats()["suppressed"].get("rate_limit", 0) < 1:
+            fail("suppression counter did not record the rate limit")
+
+        bundle = incidents[0]
+        if bundle["kind"] != "retry_exhausted":
+            fail(f"bundle trigger kind {bundle['kind']!r}, wanted "
+                 f"retry_exhausted")
+        if bundle["total_bytes"] > max_bytes:
+            fail(f"bundle {bundle['total_bytes']} bytes exceeds the "
+                 f"{max_bytes} budget")
+        for fname in ("MANIFEST.json", "trigger.json", "journal.jsonl",
+                      "memory_ledger.json", "fault_rules.json"):
+            if not os.path.isfile(os.path.join(bundle["path"], fname)):
+                fail(f"bundle missing {fname}")
+
+        # the frozen ledger must show this task still holding bytes
+        with open(os.path.join(bundle["path"],
+                               "memory_ledger.json")) as f:
+            ledger = json.load(f)
+        task_row = (ledger.get("tasks") or {}).get(str(TASK_ID))
+        if not task_row or task_row["active_bytes"] != HELD_BYTES:
+            fail(f"ledger does not show task {TASK_ID} holding "
+                 f"{HELD_BYTES} bytes: {task_row}")
+
+        # srt-doctor: the diagnosis must name the injected fault rule
+        # as root cause and the task id holding memory
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = doctor.main([bundle["path"]])
+        out = buf.getvalue()
+        if rc != 0:
+            fail(f"srt-doctor exited {rc}")
+        for needle, why in (
+                ("root cause: fault-injection rule", "root cause line"),
+                ("'doctor_probe'", "injected fault rule name"),
+                ("GpuRetryOOM", "injected exception type"),
+                (f"task {TASK_ID}", "failing task id"),
+                ("1.0 MiB", "held device memory")):
+            if needle not in out:
+                fail(f"doctor output missing {why} ({needle!r}):\n"
+                     f"{out}")
+        print(f"doctor-smoke: OK (1 bundle, "
+              f"{bundle['total_bytes']} bytes, "
+              f"diagnosis: {out.splitlines()[-1]})")
+        return 0
+    finally:
+        fi.uninstall()
+        try:
+            adaptor.deallocate(HELD_BYTES)
+        except Exception:
+            pass
+        try:
+            rmm_spark.task_done(TASK_ID)
+        except Exception:
+            pass
+        rmm_spark.clear_event_handler()
+        obs.disable_flight_recorder()
+        obs.disable_tracing()
+        obs.disable()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

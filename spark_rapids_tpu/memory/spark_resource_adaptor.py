@@ -101,6 +101,11 @@ class TaskMetrics:
         self.time_lost_nanos += other.time_lost_nanos
         self.gpu_max_memory_allocated = max(self.gpu_max_memory_allocated,
                                             other.gpu_max_memory_allocated)
+        # active footprint SUMS across checkpoints: bytes a removed
+        # thread still held must survive into the task bucket, or the
+        # task_done leak detector goes blind to exactly the leaks that
+        # matter (thread died holding memory)
+        self.gpu_memory_active_footprint += other.gpu_memory_active_footprint
         self.gpu_memory_max_footprint = max(self.gpu_memory_max_footprint,
                                             other.gpu_memory_max_footprint)
 
@@ -122,6 +127,10 @@ class _ThreadState:
         self.split_and_retry_oom = _Injection()
         self.cudf_exception_injected = 0
         self.metrics = TaskMetrics()
+        # ledger counters (survive metric checkpointing: they describe
+        # the THREAD, not the task)
+        self.alloc_count = 0
+        self.dealloc_count = 0
         self.wake = threading.Condition(lock)
         self._block_start: Optional[float] = None
         self._retry_point: float = time.monotonic()
@@ -285,7 +294,36 @@ class SparkResourceAdaptor:
         return ret
 
     def task_done(self, task_id: int):
+        leaked = 0
+        holders: List[dict] = []
         with self._lock:
+            # leak detection BEFORE the associations unwind: device
+            # bytes still attributed to the finishing task are exactly
+            # the evidence the flight recorder wants frozen.  The sum
+            # includes NEGATIVE footprints — a checkpointed +X whose
+            # frees landed on the live thread after the checkpoint
+            # shows up as thread -X, and only the net is a leak.
+            # Pool threads serving several tasks still attribute their
+            # held bytes to each finishing task (shared-accounting
+            # noise the leak detector's byte floor filters).
+            cp = self._checkpointed.get(task_id)
+            if cp is not None and cp.gpu_memory_active_footprint != 0:
+                leaked += cp.gpu_memory_active_footprint
+                if cp.gpu_memory_active_footprint > 0:
+                    holders.append({
+                        "thread": -1, "state": "CHECKPOINTED",
+                        "bytes":
+                        int(cp.gpu_memory_active_footprint)})
+            for t in self._threads.values():
+                if (t.task_id == task_id
+                        or task_id in t.pool_task_ids) \
+                        and t.metrics.gpu_memory_active_footprint != 0:
+                    leaked += t.metrics.gpu_memory_active_footprint
+                    if t.metrics.gpu_memory_active_footprint > 0:
+                        holders.append({
+                            "thread": t.thread_id, "state": t.state,
+                            "bytes":
+                            int(t.metrics.gpu_memory_active_footprint)})
             woke_any = False
             for thread_id in list(self._threads.keys()):
                 t = self._threads.get(thread_id)
@@ -297,7 +335,11 @@ class SparkResourceAdaptor:
                     if self._remove_thread_association(thread_id, task_id):
                         woke_any = True
             self._wake_up_threads_after_task_finishes()
-            return woke_any
+        if leaked > 0:
+            # outside the lock: the leak hook may freeze a bundle,
+            # which reads this adaptor's ledger (non-reentrant lock)
+            _obs.record_task_leak(task_id, int(leaked), holders)
+        return woke_any
 
     def _checkpoint_metrics(self, t: _ThreadState):
         """Merge a thread's metrics into its task-level checkpoints."""
@@ -398,6 +440,86 @@ class SparkResourceAdaptor:
         get_and_reset_* first, then release the bookkeeping."""
         with self._lock:
             self._checkpointed.pop(task_id, None)
+
+    # ------------------------------------------------------ memory ledger
+
+    def memory_ledger(self, timeline: int = 200) -> dict:
+        """Flight-recorder export (reference: RmmSpark's thread-state
+        dump): per-thread and per-task allocation totals and
+        watermarks, plus the tail of the OOM-state transition log.
+        Per-task rows fold live threads AND the checkpointed buckets
+        of threads that already unwound, so a task's held bytes are
+        visible even after its threads died."""
+        with self._lock:
+            threads: Dict[str, dict] = {}
+            tasks: Dict[int, dict] = {}
+
+            def task_row(task_id: int) -> dict:
+                return tasks.setdefault(task_id, {
+                    "active_bytes": 0, "watermark_bytes": 0,
+                    "max_allocated_bytes": 0, "retry_oom": 0,
+                    "split_retry_oom": 0, "blocked_ns": 0,
+                    "lost_ns": 0, "threads": []})
+
+            def fold(row: dict, m: TaskMetrics):
+                row["active_bytes"] += int(m.gpu_memory_active_footprint)
+                row["watermark_bytes"] = max(
+                    row["watermark_bytes"],
+                    int(m.gpu_memory_max_footprint))
+                row["max_allocated_bytes"] = max(
+                    row["max_allocated_bytes"],
+                    int(m.gpu_max_memory_allocated))
+                row["retry_oom"] += m.num_times_retry_throw
+                row["split_retry_oom"] += m.num_times_split_retry_throw
+                row["blocked_ns"] += m.time_blocked_nanos
+                row["lost_ns"] += m.time_lost_nanos
+
+            for t in self._threads.values():
+                m = t.metrics
+                threads[str(t.thread_id)] = {
+                    "task": t.task_id,
+                    "pool_tasks": sorted(t.pool_task_ids),
+                    "state": t.state,
+                    "shuffle": t.is_for_shuffle,
+                    "active_bytes": int(m.gpu_memory_active_footprint),
+                    "watermark_bytes": int(m.gpu_memory_max_footprint),
+                    "max_allocated_bytes":
+                        int(m.gpu_max_memory_allocated),
+                    "allocs": t.alloc_count,
+                    "frees": t.dealloc_count,
+                    "retry_oom": m.num_times_retry_throw,
+                    "split_retry_oom": m.num_times_split_retry_throw,
+                    "blocked_ns": m.time_blocked_nanos,
+                    "lost_ns": m.time_lost_nanos,
+                }
+                task_ids = ([t.task_id] if t.task_id is not None
+                            else sorted(t.pool_task_ids))
+                for task_id in task_ids:
+                    row = task_row(task_id)
+                    fold(row, m)
+                    row["threads"].append(t.thread_id)
+            for task_id, cp in self._checkpointed.items():
+                fold(task_row(task_id), cp)
+            limit = getattr(self.resource, "limit", None)
+            return {
+                "allocated_bytes": int(self.gpu_memory_allocated_bytes),
+                "limit_bytes": int(limit) if limit is not None else None,
+                "threads": threads,
+                "tasks": {str(k): v for k, v in sorted(tasks.items())},
+                "oom_state_timeline": (list(self._log_rows)[-timeline:]
+                                       if timeline else []),
+            }
+
+    def thread_state_dump(self) -> List[dict]:
+        """Flat per-thread state list (the RmmSpark state-dump shape
+        the incident bundle's threads.json carries)."""
+        with self._lock:
+            return [{"thread": t.thread_id, "task": t.task_id,
+                     "pool_tasks": sorted(t.pool_task_ids),
+                     "state": t.state, "shuffle": t.is_for_shuffle,
+                     "active_bytes":
+                         int(t.metrics.gpu_memory_active_footprint)}
+                    for t in self._threads.values()]
 
     # ----------------------------------------------------------- spilling
 
@@ -758,6 +880,7 @@ class SparkResourceAdaptor:
             t.is_cpu_alloc = False
             t.record_progress()
             if not is_for_cpu:
+                t.alloc_count += 1
                 if not t.is_in_spilling:
                     t.metrics.gpu_memory_active_footprint += num_bytes
                     t.metrics.gpu_memory_max_footprint = max(
@@ -805,6 +928,7 @@ class SparkResourceAdaptor:
         if t is not None:
             self._log_status("DEALLOC", tid, t.task_id, t.state)
             if not is_for_cpu:
+                t.dealloc_count += 1
                 if not t.is_in_spilling:
                     t.metrics.gpu_memory_active_footprint -= num_bytes
                 self.gpu_memory_allocated_bytes -= num_bytes

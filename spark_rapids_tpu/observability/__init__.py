@@ -26,8 +26,11 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Optional
 
+from spark_rapids_tpu.observability import flight_recorder as _fr
+from spark_rapids_tpu.observability.dumpio import dump_via
 from spark_rapids_tpu.observability.journal import EventJournal
 from spark_rapids_tpu.observability.registry import (
     DEFAULT_LATENCY_BUCKETS_NS, MetricsRegistry)
@@ -35,6 +38,12 @@ from spark_rapids_tpu.observability.task_metrics import (
     UNATTRIBUTED, TaskMetricsTable)
 from spark_rapids_tpu.observability.tracing import (  # noqa: F401
     NOOP_SPAN, SpanContext, Tracer)
+
+# process start anchors: snapshots carry wall-clock + uptime so offline
+# consumers (srt-doctor, Perfetto export) can place a dump in real time
+# instead of guessing from per-process monotonic stamps
+_START_MONO = time.monotonic()
+_START_UNIX = time.time()
 
 
 class _Switch:
@@ -174,6 +183,20 @@ JIT_COMPILE_TIME = METRICS.histogram(
     "Kernel lower+compile wall time on compile-cache misses",
     labels=("kernel",), buckets=DEFAULT_LATENCY_BUCKETS_NS,
     max_series=128)
+INCIDENTS_TOTAL = METRICS.counter(
+    "srt_incidents_total",
+    "Flight-recorder incident bundles written, by trigger kind",
+    labels=("trigger",))
+INCIDENTS_SUPPRESSED = METRICS.counter(
+    "srt_incidents_suppressed_total",
+    "Flight-recorder triggers suppressed (rate_limit, byte_budget, "
+    "error)", labels=("reason",))
+MEMORY_LEAK_EVENTS = METRICS.counter(
+    "srt_memory_leak_total",
+    "Tasks that finished still holding device memory")
+MEMORY_LEAKED_BYTES = METRICS.counter(
+    "srt_memory_leaked_bytes_total",
+    "Device bytes still held when their task finished")
 SPAN_DURATION = METRICS.histogram(
     "srt_span_duration_ns", "Span durations by span kind and name",
     labels=("span_kind", "name"),
@@ -189,6 +212,10 @@ SPANS_FINISHED = METRICS.counter(
 
 
 def _on_span_finish(rec: dict) -> None:
+    # flight-recorder feed first (independent switch: the straggler
+    # detector watches stage spans whether or not metrics are on)
+    if FLIGHT.enabled:
+        FLIGHT.observe_span(rec)
     if not _SWITCH.enabled:
         return
     SPAN_DURATION.observe(rec["dur_ns"],
@@ -202,6 +229,44 @@ def _on_span_finish(rec: dict) -> None:
 TRACER = Tracer(capacity=65536,
                 task_lookup=lambda: TASKS.tasks_for(),
                 on_finish=_on_span_finish)
+
+
+# -------------------------------------------------------- flight recorder
+# The black box (ISSUE 5 tentpole): anomaly detectors fed by the
+# record helpers below, freezing the rings above into incident bundles.
+# Independent switch — always-on capture is cheap, bundle dumps are
+# not, so the recorder arms separately from metrics/tracing.
+
+FLIGHT = _fr.FlightRecorder.from_env()
+
+
+def enable_flight_recorder(out_dir: Optional[str] = None,
+                           max_bytes: Optional[int] = None,
+                           min_interval_s: Optional[float] = None
+                           ) -> None:
+    FLIGHT.configure(out_dir=out_dir, max_bytes=max_bytes,
+                     min_interval_s=min_interval_s)
+    FLIGHT.enabled = True
+
+
+def disable_flight_recorder() -> None:
+    FLIGHT.enabled = False
+
+
+def is_flight_recorder_enabled() -> bool:
+    return FLIGHT.enabled
+
+
+def trigger_incident(kind: str, cause: Optional[BaseException] = None,
+                     severity: str = "error", **detail) -> Optional[str]:
+    """Explicit incident trigger for the instrumented layers
+    (RetryExhausted in robustness/retry.py, KudoCorruptException in
+    shuffle/kudo.py, task-end leaks in the OOM state machine).  One
+    attribute read when the recorder is off."""
+    if not FLIGHT.enabled:
+        return None
+    return FLIGHT.trigger(kind, cause=cause, severity=severity,
+                          **detail)
 
 
 # ------------------------------------------------------------ record helpers
@@ -302,6 +367,8 @@ def record_retry_episode(name: str, *, attempts: int, retries: int,
                          errors=()) -> None:
     """Retry-driver episode hook (robustness/retry.py) — called only
     for episodes that saw at least one failure."""
+    if FLIGHT.enabled:
+        FLIGHT.observe_retry_episode(name, outcome)
     if not _SWITCH.enabled:
         return
     RETRY_EPISODES.inc(labels=(outcome,))
@@ -362,9 +429,27 @@ def record_device_memory(allocated_bytes: int) -> None:
 
 
 def record_hbm_sample(device_index: int, bytes_in_use: int) -> None:
+    if FLIGHT.enabled:
+        FLIGHT.observe_hbm(device_index, bytes_in_use)
     if not _SWITCH.enabled:
         return
     HBM_BYTES_IN_USE.set(bytes_in_use, labels=(str(device_index),))
+
+
+def record_task_leak(task_id: int, leaked_bytes: int,
+                     holders=()) -> None:
+    """Memory-ledger leak hook: ``task_done`` saw device bytes still
+    attributed to the finishing task (the leak detector's feed, and a
+    journal event so a later bundle still shows the history)."""
+    if FLIGHT.enabled:
+        FLIGHT.observe_task_leak(task_id, leaked_bytes, holders)
+    if not _SWITCH.enabled:
+        return
+    MEMORY_LEAK_EVENTS.inc()
+    MEMORY_LEAKED_BYTES.inc(leaked_bytes)
+    JOURNAL.emit("memory_leak", task=task_id,
+                 leaked_bytes=leaked_bytes,
+                 holders=list(holders)[:8])
 
 
 # ------------------------------------------------------------------- dumping
@@ -376,14 +461,52 @@ def expose_text() -> str:
 
 
 def snapshot() -> dict:
-    """JSON-able state: registry + per-task rollup + journal stats."""
+    """JSON-able state: registry + per-task rollup + journal stats.
+    Wall-clock anchored (``snapshot_unix_ms`` + ``uptime_s``): offline
+    consumers place the per-process monotonic stamps in real time."""
     return {
+        "snapshot_unix_ms": int(time.time() * 1000),
+        "uptime_s": round(time.monotonic() - _START_MONO, 3),
         "registry": METRICS.snapshot(),
         "tasks": {str(t): d for t, d in TASKS.rollup().items()},
         "journal": {"events": len(JOURNAL),
                     "dropped": JOURNAL.dropped,
                     "by_kind": JOURNAL.counts_by_kind()},
     }
+
+
+def health() -> dict:
+    """One-call process health rollup for the JVM shim's
+    ``health_json``: switches, ring fill/drops, recorder stats, and a
+    memory-ledger summary when the OOM runtime is installed."""
+    h = {
+        "snapshot_unix_ms": int(time.time() * 1000),
+        "start_unix_ms": int(_START_UNIX * 1000),
+        "uptime_s": round(time.monotonic() - _START_MONO, 3),
+        "pid": os.getpid(),
+        "metrics_enabled": _SWITCH.enabled,
+        "tracing_enabled": TRACER.enabled,
+        "journal": {"events": len(JOURNAL), "dropped": JOURNAL.dropped},
+        "spans": {"finished": len(TRACER), "dropped": TRACER.dropped},
+        "flight_recorder": FLIGHT.stats(),
+    }
+    try:
+        from spark_rapids_tpu.memory import rmm_spark
+        from spark_rapids_tpu.memory import spark_resource_adaptor as sra
+        adaptor = rmm_spark.installed_adaptor()
+        if adaptor is not None:
+            states = adaptor.thread_state_dump()
+            h["memory"] = {
+                "allocated_bytes": adaptor.gpu_memory_allocated_bytes,
+                "threads": len(states),
+                "blocked_threads": sum(
+                    1 for s in states
+                    if s["state"] in (sra.THREAD_BLOCKED,
+                                      sra.THREAD_BUFN)),
+            }
+    except Exception:
+        pass
+    return h
 
 
 def dump_spans_jsonl(path_or_file) -> int:
@@ -396,14 +519,14 @@ def dump_journal_jsonl(path_or_file) -> int:
     """Journal ring + one ``task_rollup`` record per task + one
     ``registry_snapshot`` record, as JSON Lines — the input format of
     tools/metrics_report.py (and accepted by tools/profile_converter).
-    Returns the number of records written."""
+    Path writes are atomic (tmp + rename via dumpio): a crash mid-dump
+    never leaves a truncated JSONL.  Returns records written."""
     import json as _json
 
     recs = JOURNAL.records()
-    n = len(recs)
 
     def _write(f):
-        nonlocal n
+        n = len(recs)
         for r in recs:
             f.write(_json.dumps(r) + "\n")
         for task_id, d in TASKS.rollup().items():
@@ -412,14 +535,9 @@ def dump_journal_jsonl(path_or_file) -> int:
             n += 1
         f.write(_json.dumps({"kind": "registry_snapshot",
                              "registry": METRICS.snapshot()}) + "\n")
-        n += 1
+        return n + 1
 
-    if hasattr(path_or_file, "write"):
-        _write(path_or_file)
-    else:
-        with open(path_or_file, "w") as f:
-            _write(f)
-    return n
+    return dump_via(path_or_file, _write)
 
 
 if os.environ.get("SPARK_RAPIDS_TPU_METRICS", "") not in ("", "0"):

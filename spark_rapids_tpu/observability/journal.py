@@ -96,16 +96,19 @@ class EventJournal:
 
     def dump_jsonl(self, path_or_file) -> int:
         """Write the current ring as JSON Lines; returns record count.
-        Accepts a path or an open text file object."""
+        Accepts a path or an open text file object; path writes are
+        atomic (tmp + rename) so a crash mid-dump never leaves a
+        truncated file."""
+        from spark_rapids_tpu.observability.dumpio import dump_via
+
         recs = self.records()
-        if hasattr(path_or_file, "write"):
+
+        def _write(f):
             for r in recs:
-                path_or_file.write(json.dumps(r) + "\n")
-        else:
-            with open(path_or_file, "w") as f:
-                for r in recs:
-                    f.write(json.dumps(r) + "\n")
-        return len(recs)
+                f.write(json.dumps(r) + "\n")
+            return len(recs)
+
+        return dump_via(path_or_file, _write)
 
     def clear(self) -> None:
         with self._lock:

@@ -395,13 +395,15 @@ class Tracer:
 
     def dump_jsonl(self, path_or_file) -> int:
         """Write the finished-span ring as JSON Lines (one process's
-        input file for tools/trace_export.py).  Returns record count."""
+        input file for tools/trace_export.py).  Path writes are atomic
+        (tmp + rename).  Returns record count."""
+        from spark_rapids_tpu.observability.dumpio import dump_via
+
         recs = self.records()
-        if hasattr(path_or_file, "write"):
+
+        def _write(f):
             for r in recs:
-                path_or_file.write(json.dumps(r) + "\n")
-        else:
-            with open(path_or_file, "w") as f:
-                for r in recs:
-                    f.write(json.dumps(r) + "\n")
-        return len(recs)
+                f.write(json.dumps(r) + "\n")
+            return len(recs)
+
+        return dump_via(path_or_file, _write)

@@ -191,7 +191,19 @@ class _Episode:
     def exhausted(self, reason: str,
                   last: Optional[BaseException] = None) -> RetryExhausted:
         ex = RetryExhausted(self.name, reason, self.history, last)
+        if last is not None and ex.__cause__ is None:
+            # the driver raises `ex from last`, but the flight
+            # recorder serializes the chain BEFORE that binding —
+            # pre-link so the bundle's cause chain is complete
+            ex.__cause__ = last
         self.finish("exhausted:" + reason)
+        # black-box trigger: an exhausted budget is terminal for the
+        # query — freeze the evidence while it is still in the rings
+        _obs.trigger_incident(
+            "retry_exhausted", cause=ex, name=self.name, reason=reason,
+            attempts=self.attempts,
+            lost_ns=sum(a.elapsed_ns for a in self.history),
+            errors=[a.error for a in self.history[-16:]])
         return ex
 
     def finish(self, outcome: str) -> None:

@@ -191,15 +191,21 @@ def tracing_dump(path: str) -> int:
 
 def tracing_flush(path: str) -> int:
     """Like tracing_dump but DRAINS the ring (repeated flushes between
-    export intervals never re-export a span)."""
+    export intervals never re-export a span).  The write is atomic
+    (tmp + rename): a failure mid-write leaves any previous flush file
+    intact AND requeues the drained spans."""
     import json
 
     from spark_rapids_tpu import observability as obs
+    from spark_rapids_tpu.observability.dumpio import atomic_write
     recs = obs.TRACER.drain()
+
+    def _write(f):
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+
     try:
-        with open(path, "w") as f:
-            for r in recs:
-                f.write(json.dumps(r) + "\n")
+        atomic_write(path, _write)
     except BaseException:
         # an unwritable path OR a mid-write failure (disk full, quota)
         # must not lose the drained spans: put them back so a corrected
@@ -212,6 +218,66 @@ def tracing_flush(path: str) -> int:
 def tracing_reset() -> None:
     from spark_rapids_tpu import observability as obs
     obs.TRACER.reset()
+
+
+# ------------------------------------------------------ flight recorder
+# (reference: the CUPTI profiler dump + RmmSpark state dump the JVM
+# pulls on failure; here the JVM arms the recorder, forces bundles,
+# and lists/fetches what the anomaly detectors froze)
+
+
+def flight_recorder_set_enabled(enabled: bool) -> bool:
+    """Arm/disarm the flight recorder; returns prior state."""
+    from spark_rapids_tpu import observability as obs
+    prior = obs.is_flight_recorder_enabled()
+    (obs.enable_flight_recorder if enabled
+     else obs.disable_flight_recorder)()
+    return prior
+
+
+def flight_recorder_enabled() -> bool:
+    from spark_rapids_tpu import observability as obs
+    return obs.is_flight_recorder_enabled()
+
+
+def flight_recorder_configure(out_dir: str = "", max_bytes: int = 0,
+                              min_interval_s: float = -1.0) -> None:
+    """Set bundle directory / byte budget / rate-limit interval;
+    zero/negative/empty values leave the current setting."""
+    from spark_rapids_tpu import observability as obs
+    obs.FLIGHT.configure(
+        out_dir=out_dir or None,
+        max_bytes=int(max_bytes) if max_bytes > 0 else None,
+        min_interval_s=(float(min_interval_s)
+                        if min_interval_s >= 0 else None))
+
+
+def incident_dump(reason: str = "manual") -> str:
+    """Force an incident bundle NOW (bypasses the enabled flag and the
+    rate limit; still honors the byte budget).  Returns the bundle
+    path, or '' when the byte budget suppressed it."""
+    from spark_rapids_tpu import observability as obs
+    path = obs.FLIGHT.trigger("manual", force=True, severity="info",
+                              reason=str(reason))
+    return path or ""
+
+
+def incident_list() -> str:
+    """JSON list of complete bundles in the recorder's directory
+    (path, trigger kind, severity, wall-clock, bytes)."""
+    import json
+
+    from spark_rapids_tpu import observability as obs
+    return json.dumps(obs.FLIGHT.incident_list())
+
+
+def health_json() -> str:
+    """One-call process health rollup (switches, ring fill/drops,
+    recorder stats, memory-ledger summary) as JSON."""
+    import json
+
+    from spark_rapids_tpu import observability as obs
+    return json.dumps(obs.health(), sort_keys=True, default=str)
 
 
 # ------------------------------------------------------ fault injection

@@ -735,6 +735,38 @@ def tracing_reset() -> None:
     jni_api.tracing_reset()
 
 
+def flight_recorder_set_enabled(enabled: bool) -> bool:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.flight_recorder_set_enabled(bool(enabled))
+
+
+def flight_recorder_enabled() -> bool:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.flight_recorder_enabled()
+
+
+def flight_recorder_configure(out_dir: str = "", max_bytes: int = 0,
+                              min_interval_s: float = -1.0) -> None:
+    from spark_rapids_tpu.shim import jni_api
+    jni_api.flight_recorder_configure(str(out_dir), int(max_bytes),
+                                      float(min_interval_s))
+
+
+def incident_dump(reason: str = "manual") -> str:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.incident_dump(str(reason))
+
+
+def incident_list() -> str:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.incident_list()
+
+
+def health_json() -> str:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.health_json()
+
+
 def fault_injection_install(config_path: str = "", watch: bool = True,
                             interval_ms: int = 0) -> int:
     from spark_rapids_tpu.shim import jni_api

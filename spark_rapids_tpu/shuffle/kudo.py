@@ -183,6 +183,10 @@ class KudoTableHeader:
                     _obs.record_kudo_corruption(
                         "crc", detail=f"deferred: want {want:08x} "
                                       f"got {pending:08x}")
+                    _obs.trigger_incident(
+                        "kudo_corrupt", reason="crc",
+                        detail=f"deferred trailer mismatch want "
+                               f"{want:08x} got {pending:08x}")
                     raise KudoCorruptException(
                         f"kudo crc mismatch (want {want:08x} got "
                         f"{pending:08x})")
@@ -207,6 +211,10 @@ class KudoTableHeader:
         off, rows, vlen, olen, tlen, ncols = fields
         if (min(off, rows, vlen, olen, tlen, ncols) < 0
                 or vlen + olen > tlen):
+            _obs.trigger_incident(
+                "kudo_corrupt", reason="magic",
+                detail=f"impossible header rows={rows} "
+                       f"total_len={tlen} cols={ncols}")
             raise KudoCorruptException(
                 f"impossible kudo header (offset={off} rows={rows} "
                 f"validity_len={vlen} offset_len={olen} "
@@ -441,6 +449,10 @@ def read_one_table(stream) -> Optional[KudoTable]:
             _obs.record_kudo_corruption(
                 "crc", detail=f"want {want:08x} got {got:08x} "
                               f"rows={header.num_rows}")
+            _obs.trigger_incident(
+                "kudo_corrupt", reason="crc",
+                detail=f"trailer mismatch want {want:08x} got "
+                       f"{got:08x} rows={header.num_rows}")
             raise KudoCorruptException(
                 f"kudo crc mismatch (want {want:08x} got {got:08x})")
     else:

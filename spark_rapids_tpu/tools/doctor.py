@@ -1,0 +1,423 @@
+"""``srt-doctor``: offline triage of flight-recorder incident bundles.
+
+Loads a bundle written by ``observability/flight_recorder.py``,
+cross-references its three evidence planes — spans (where time went),
+journal (what happened), memory ledger (who holds what) — and prints a
+ranked diagnosis, e.g.::
+
+    1. [95] root cause: fault-injection rule match='exchange.step'
+            (GpuRetryOOM) matches the exhausted section
+    2. [90] task 7 exhausted retries in 'exchange.step' (attempts)
+            after 4 failed attempts [GpuRetryOOM x4]
+    3. [70] thread 3 (task 7, THREAD_BLOCKED) holds 1.2 GiB device
+            memory (watermark 1.5 GiB)
+    4. [60] stage 'exchange.step' p99 9.8x p50 over 42 tasks
+
+Output is purely bundle-derived (no "now" stamps), so the same bundle
+always prints the same diagnosis — the golden-output test in
+tests/test_flight_recorder.py holds the CLI to that.
+
+Usage:
+    python -m spark_rapids_tpu.tools.doctor BUNDLE_DIR [--json]
+
+``BUNDLE_DIR`` may also be the recorder's output directory (the one
+holding ``incident-*`` subdirectories): the most recent complete
+bundle is diagnosed and the rest are listed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from datetime import datetime, timezone
+from typing import Dict, List, Optional
+
+MANIFEST = "MANIFEST.json"
+
+# how journal retry activity is judged a storm offline (mirrors the
+# live RetryStormDetector defaults)
+STORM_THRESHOLD = 10
+STRAGGLER_RATIO = 5.0
+STRAGGLER_MIN_SAMPLES = 8
+
+
+def _fmt_bytes(n) -> str:
+    n = int(n)
+    for unit, width in (("GiB", 1 << 30), ("MiB", 1 << 20),
+                        ("KiB", 1 << 10)):
+        if n >= width:
+            return f"{n / width:.1f} {unit}"
+    return f"{n} B"
+
+
+def _fmt_unix_ms(ms) -> str:
+    return datetime.fromtimestamp(int(ms) / 1000.0, tz=timezone.utc) \
+        .strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def _load_json(path: str, default):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return default
+
+
+def _load_jsonl(path: str) -> List[dict]:
+    out: List[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict):
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+class Bundle:
+    """One loaded incident bundle; every file is optional (a partial
+    bundle still gets a best-effort diagnosis)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.manifest = _load_json(os.path.join(path, MANIFEST), {})
+        self.trigger = _load_json(os.path.join(path, "trigger.json"), {})
+        self.metrics = _load_json(os.path.join(path, "metrics.json"), {})
+        self.ledger = _load_json(
+            os.path.join(path, "memory_ledger.json"), {})
+        self.threads = _load_json(os.path.join(path, "threads.json"), {})
+        self.fault_rules = _load_json(
+            os.path.join(path, "fault_rules.json"), [])
+        self.env = _load_json(os.path.join(path, "env.json"), {})
+        records = _load_jsonl(os.path.join(path, "journal.jsonl"))
+        self.journal = [r for r in records
+                        if r.get("kind") not in ("task_rollup",
+                                                 "registry_snapshot")]
+        self.task_rollups = {r.get("task"): r for r in records
+                             if r.get("kind") == "task_rollup"}
+        self.spans = _load_jsonl(os.path.join(path, "spans.jsonl"))
+        if not self.spans:  # fall back to span records in the journal
+            self.spans = [r for r in self.journal
+                          if r.get("kind") == "span"]
+
+
+def is_bundle_dir(path: str) -> bool:
+    return os.path.isfile(os.path.join(path, MANIFEST)) \
+        or os.path.isfile(os.path.join(path, "trigger.json"))
+
+
+def find_bundles(root: str) -> List[str]:
+    """Complete (manifest-bearing) bundle dirs under a recorder output
+    directory, oldest first."""
+    try:
+        names = sorted(os.listdir(root))
+    except OSError:
+        return []
+    return [os.path.join(root, n) for n in names
+            if not n.endswith(".tmp")  # half-written crash leftovers
+            and os.path.isfile(os.path.join(root, n, MANIFEST))]
+
+
+# ------------------------------------------------------------- analysis
+
+
+def _retry_span_for(bundle: Bundle, name: str) -> Optional[dict]:
+    for r in reversed(bundle.spans):
+        if r.get("span_kind") == "retry" \
+                and r.get("name") == f"retry_episode:{name}":
+            return r
+    return None
+
+
+def _task_of_exhausted(bundle: Bundle, name: str):
+    """Task attribution for an exhausted section: its retry span's
+    task, else the task on the most recent OOM journal event, else the
+    busiest task in the ledger."""
+    span = _retry_span_for(bundle, name)
+    if span is not None and span.get("task") is not None:
+        return span["task"]
+    for r in reversed(bundle.journal):
+        if r.get("kind") in ("oom_retry", "oom_split_retry") \
+                and r.get("task", -1) >= 0:
+            return r["task"]
+    tasks = bundle.ledger.get("tasks") or {}
+    best = None
+    for tid, row in tasks.items():
+        if best is None or row.get("retry_oom", 0) > \
+                tasks[best].get("retry_oom", 0):
+            best = tid
+    return best
+
+
+def _err_counts(errors: List[str]) -> str:
+    counts: Dict[str, int] = {}
+    for e in errors:
+        counts[e] = counts.get(e, 0) + 1
+    return ", ".join(f"{e} x{n}" for e, n in sorted(counts.items()))
+
+
+def analyze(bundle: Bundle) -> List[dict]:
+    """Ranked findings (most severe first); each is
+    {severity, kind, message}."""
+    findings: List[dict] = []
+    trig = bundle.trigger
+    kind = trig.get("kind", "?")
+    detail = trig.get("detail") or {}
+    ledger_threads = bundle.ledger.get("threads") or {}
+
+    # ---- the trigger itself, cross-referenced -----------------------
+    if kind == "retry_exhausted":
+        name = detail.get("name", "?")
+        errors = [e for e in detail.get("errors", [])]
+        task = _task_of_exhausted(bundle, name)
+        task_txt = f"task {task}" if task is not None else "unknown task"
+        msg = (f"{task_txt} exhausted retries in {name!r} "
+               f"({detail.get('reason', '?')}) after "
+               f"{len(errors) or detail.get('attempts', '?')} failed "
+               f"attempts [{_err_counts(errors) or 'no history'}]")
+        holders = [(tid, row) for tid, row in sorted(
+            ledger_threads.items())
+            if row.get("active_bytes", 0) > 0]
+        if holders:
+            tid, row = max(holders,
+                           key=lambda kv: kv[1]["active_bytes"])
+            msg += (f"; thread {tid} held "
+                    f"{_fmt_bytes(row['active_bytes'])} at incident "
+                    f"time")
+        findings.append({"severity": 90, "kind": "retry_exhausted",
+                         "message": msg})
+        injected = [r for r in bundle.fault_rules
+                    if r.get("match") in (name, "*")
+                    or r.get("exception") in errors]
+        for rule in injected:
+            findings.append({
+                "severity": 95, "kind": "fault_injection",
+                "message": (f"root cause: fault-injection rule "
+                            f"match={rule.get('match')!r} "
+                            f"({rule.get('exception')}, "
+                            f"remaining={rule.get('remaining')}) "
+                            f"matches the exhausted section "
+                            f"{name!r}")})
+    elif kind == "memory_leak":
+        findings.append({
+            "severity": 88, "kind": "memory_leak",
+            "message": (f"task {detail.get('task')} finished still "
+                        f"holding "
+                        f"{_fmt_bytes(detail.get('leaked_bytes', 0))} "
+                        f"device memory")})
+    elif kind == "kudo_corrupt":
+        findings.append({
+            "severity": 85, "kind": "kudo_corrupt",
+            "message": (f"kudo stream corruption "
+                        f"({detail.get('reason', '?')}): "
+                        f"{detail.get('detail', '')}")})
+    elif kind == "straggler":
+        findings.append({
+            "severity": 80, "kind": "straggler",
+            "message": (f"stage {detail.get('stage')!r} task "
+                        f"{detail.get('task')} ran "
+                        f"{detail.get('dur_ns', 0) / 1e6:.1f} ms vs "
+                        f"median "
+                        f"{detail.get('median_ns', 0) / 1e6:.1f} ms "
+                        f"(robust z {detail.get('robust_z')})")})
+    elif kind == "retry_storm":
+        findings.append({
+            "severity": 80, "kind": "retry_storm",
+            "message": (f"retry storm: "
+                        f"{detail.get('episodes_in_window')} failed "
+                        f"episodes in {detail.get('window_s')}s "
+                        f"(sections: "
+                        f"{', '.join(detail.get('recent_sections', []))}"
+                        f")")})
+    elif kind == "hbm_pressure":
+        findings.append({
+            "severity": 78, "kind": "hbm_pressure",
+            "message": (f"device {detail.get('device')} HBM held "
+                        f"{_fmt_bytes(detail.get('bytes_in_use', 0))} "
+                        f">= threshold "
+                        f"{_fmt_bytes(detail.get('threshold_bytes', 0))}"
+                        f" for {detail.get('sustained_s')}s")})
+    elif kind == "manual":
+        findings.append({
+            "severity": 10, "kind": "manual",
+            "message": (f"manual dump "
+                        f"({detail.get('reason', 'no reason given')}) "
+                        f"— no failure trigger")})
+
+    # ---- memory-leak journal history --------------------------------
+    for r in bundle.journal:
+        if r.get("kind") == "memory_leak" and kind != "memory_leak":
+            findings.append({
+                "severity": 85, "kind": "memory_leak",
+                "message": (f"task {r.get('task')} finished still "
+                            f"holding "
+                            f"{_fmt_bytes(r.get('leaked_bytes', 0))} "
+                            f"device memory")})
+
+    # ---- blocked threads + held memory from the ledger --------------
+    for tid, row in sorted(ledger_threads.items()):
+        if row.get("state") in ("THREAD_BLOCKED", "THREAD_BUFN"):
+            task = row.get("task")
+            findings.append({
+                "severity": 75, "kind": "blocked_thread",
+                "message": (f"thread {tid} (task {task}) is "
+                            f"{row['state']} in the OOM state machine "
+                            f"holding "
+                            f"{_fmt_bytes(row.get('active_bytes', 0))}"
+                            )})
+    held = [(tid, row) for tid, row in sorted(ledger_threads.items())
+            if row.get("active_bytes", 0) > 0
+            and row.get("state") not in ("THREAD_BLOCKED",
+                                         "THREAD_BUFN")]
+    for tid, row in sorted(held, key=lambda kv:
+                           -kv[1]["active_bytes"])[:4]:
+        findings.append({
+            "severity": 70, "kind": "held_memory",
+            "message": (f"thread {tid} (task {row.get('task')}, "
+                        f"{row.get('state')}) holds "
+                        f"{_fmt_bytes(row['active_bytes'])} device "
+                        f"memory (watermark "
+                        f"{_fmt_bytes(row.get('watermark_bytes', 0))}, "
+                        f"{row.get('allocs', 0)} allocs / "
+                        f"{row.get('frees', 0)} frees)")})
+
+    # ---- kudo corruption history ------------------------------------
+    corrupt = [r for r in bundle.journal
+               if r.get("kind") == "kudo_corrupt"]
+    if corrupt and kind != "kudo_corrupt":
+        skipped = sum(r.get("skipped_bytes", 0) for r in corrupt)
+        findings.append({
+            "severity": 65, "kind": "kudo_corrupt",
+            "message": (f"{len(corrupt)} kudo corruption event(s) in "
+                        f"the journal ({_fmt_bytes(skipped)} resync-"
+                        f"skipped)")})
+
+    # ---- stage stragglers from the span ring ------------------------
+    stages: Dict[str, List[int]] = {}
+    for r in bundle.spans:
+        if r.get("span_kind") == "stage":
+            stages.setdefault(r.get("name", "?"), []).append(
+                int(r.get("dur_ns", 0)))
+    for name, durs in sorted(stages.items()):
+        if len(durs) < STRAGGLER_MIN_SAMPLES:
+            continue
+        xs = sorted(durs)
+        p50 = xs[len(xs) // 2]
+        p99 = xs[min(len(xs) - 1, int(len(xs) * 0.99))]
+        if p50 > 0 and p99 / p50 >= STRAGGLER_RATIO:
+            findings.append({
+                "severity": 60, "kind": "straggler_stage",
+                "message": (f"stage {name!r} p99 {p99 / p50:.1f}x p50 "
+                            f"({p99 / 1e6:.1f} ms vs "
+                            f"{p50 / 1e6:.1f} ms over {len(xs)} "
+                            f"spans)")})
+
+    # ---- retry pressure short of the trigger ------------------------
+    episodes = [r for r in bundle.journal
+                if r.get("kind") == "retry_episode"]
+    if len(episodes) >= STORM_THRESHOLD and kind != "retry_storm":
+        sections = sorted({str(r.get("name", "?")) for r in episodes})
+        findings.append({
+            "severity": 50, "kind": "retry_pressure",
+            "message": (f"{len(episodes)} failed retry episodes in "
+                        f"the journal window (sections: "
+                        f"{', '.join(sections[:6])})")})
+
+    # ---- evidence-quality notes -------------------------------------
+    jstats = (bundle.metrics or {}).get("journal") or {}
+    if jstats.get("dropped", 0) > 0:
+        findings.append({
+            "severity": 15, "kind": "evidence",
+            "message": (f"journal dropped {jstats['dropped']} events "
+                        f"before the freeze — earliest history is "
+                        f"incomplete")})
+
+    findings.sort(key=lambda f: (-f["severity"], f["kind"],
+                                 f["message"]))
+    return findings
+
+
+# -------------------------------------------------------------- render
+
+
+def render(bundle: Bundle, findings: List[dict]) -> List[str]:
+    out: List[str] = []
+    trig = bundle.trigger
+    out.append(f"srt-doctor: bundle {os.path.basename(bundle.path)}")
+    t = trig.get("t_unix_ms")
+    out.append(
+        f"trigger : {trig.get('kind', '?')} "
+        f"severity={trig.get('severity', '?')} "
+        f"seq={trig.get('seq', '?')}"
+        + (f" at {_fmt_unix_ms(t)}" if t else "")
+        + (f" (pid {trig['pid']})" if trig.get("pid") else ""))
+    chain = trig.get("cause_chain") or []
+    for i, c in enumerate(chain):
+        prefix = "cause   : " if i == 0 else "          <- "
+        out.append(f"{prefix}{c.get('type')}: {c.get('message')}")
+    files = bundle.manifest.get("files") or {}
+    if files:
+        out.append(f"files   : {len(files)} files, "
+                   f"{bundle.manifest.get('total_bytes', 0)} bytes")
+    out.append("")
+    if not findings:
+        out.append("diagnosis: nothing anomalous in this bundle")
+        return out
+    out.append("diagnosis (most severe first):")
+    for i, f in enumerate(findings, 1):
+        out.append(f"  {i}. [{f['severity']:>2}] {f['message']}")
+    out.append("")
+    out.append(f"summary: {findings[0]['message']}")
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="srt-doctor",
+        description="Diagnose a flight-recorder incident bundle")
+    ap.add_argument("bundle",
+                    help="incident bundle directory (or the recorder "
+                         "output directory holding incident-* dirs)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit machine-readable findings")
+    args = ap.parse_args(argv)
+
+    path = args.bundle
+    if not os.path.isdir(path):
+        print(f"srt-doctor: {path}: not a directory", file=sys.stderr)
+        return 2
+    if not is_bundle_dir(path):
+        bundles = find_bundles(path)
+        if not bundles:
+            print(f"srt-doctor: {path}: no incident bundles found",
+                  file=sys.stderr)
+            return 2
+        if len(bundles) > 1 and not args.json:
+            print(f"({len(bundles)} bundles in {path}; diagnosing the "
+                  f"most recent)")
+        path = bundles[-1]
+
+    bundle = Bundle(path)
+    findings = analyze(bundle)
+    if args.json:
+        print(json.dumps({"bundle": path,
+                          "trigger": bundle.trigger,
+                          "findings": findings},
+                         indent=2, sort_keys=True))
+    else:
+        print("\n".join(render(bundle, findings)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,21 +24,14 @@ from typing import Dict, Iterable, List, Optional
 
 
 def load_jsonl(paths: Iterable[str]) -> List[dict]:
+    from spark_rapids_tpu.tools import expand_bundle_input, read_jsonl
+
     records: List[dict] = []
-    for p in paths:
-        with open(p) as f:
-            for i, line in enumerate(f):
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    print(f"{p}:{i + 1}: skipping unparseable line",
-                          file=sys.stderr)
-                    continue
-                if isinstance(rec, dict):
-                    records.append(rec)
+    for p0 in paths:
+        # a flight-recorder incident bundle directory stands in for
+        # its journal.jsonl — frozen incidents feed the same report
+        for p in expand_bundle_input(p0, "journal"):
+            records.extend(read_jsonl(p))
     return records
 
 

@@ -41,24 +41,15 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 
 def load_files(paths: Iterable[str]) -> List[Tuple[str, List[dict]]]:
-    """[(path, records)] — one entry per input file (= per process)."""
+    """[(path, records)] — one entry per input file (= per process).
+    A flight-recorder incident bundle directory stands in for its
+    spans.jsonl, so existing viewers load frozen incidents as-is."""
+    from spark_rapids_tpu.tools import expand_bundle_input, read_jsonl
+
     out = []
-    for p in paths:
-        records: List[dict] = []
-        with open(p) as f:
-            for i, line in enumerate(f):
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    print(f"{p}:{i + 1}: skipping unparseable line",
-                          file=sys.stderr)
-                    continue
-                if isinstance(rec, dict):
-                    records.append(rec)
-        out.append((p, records))
+    for p0 in paths:
+        for p in expand_bundle_input(p0, "spans"):
+            out.append((p, read_jsonl(p)))
     return out
 
 

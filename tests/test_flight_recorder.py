@@ -1,0 +1,615 @@
+"""ISSUE 5 suite: anomaly detectors (synthetic clocks), flight-recorder
+bundle writer (rate limit + byte budget), RetryExhausted end-to-end
+under the fault injector, memory ledger + leak detection, atomic dump
+helpers, snapshot wall-clock anchoring, bundle-dir tool inputs, and the
+srt-doctor golden-output test on the checked-in mini bundle."""
+
+import io
+import json
+import os
+import threading
+
+import pytest
+
+from spark_rapids_tpu import observability as obs
+from spark_rapids_tpu.observability import anomaly
+from spark_rapids_tpu.observability import flight_recorder as fr
+from spark_rapids_tpu.observability.dumpio import atomic_write
+
+DATA = os.path.join(os.path.dirname(__file__), "data")
+MINI_BUNDLE = os.path.join(
+    DATA, "mini_bundle", "incident-1754200000000-retry_exhausted-001")
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+# ------------------------------------------------------------ detectors
+
+
+def test_straggler_fires_on_outlier():
+    det = anomaly.StragglerDetector(threshold=6.0, min_samples=8,
+                                    clock=FakeClock())
+    for _ in range(10):
+        assert det.observe("stage_a", 10_000_000) is None
+    fire = det.observe("stage_a", 500_000_000, task=7)
+    assert fire is not None
+    assert fire["stage"] == "stage_a" and fire["task"] == 7
+    assert fire["robust_z"] >= 6.0
+    assert fire["median_ns"] == 10_000_000
+
+
+def test_straggler_quiet_on_uniform_jitter():
+    det = anomaly.StragglerDetector(threshold=6.0, min_samples=8)
+    for i in range(100):
+        assert det.observe("s", 10_000_000 + (i % 7) * 100_000) is None
+
+
+def test_straggler_needs_min_samples():
+    det = anomaly.StragglerDetector(threshold=6.0, min_samples=8)
+    for _ in range(7):
+        det.observe("s", 10_000_000)
+    # 8th observation arrives with only 7 priors: cannot judge yet
+    assert det.observe("s", 10_000_000_000) is None
+
+
+def test_straggler_cooldown():
+    clock = FakeClock()
+    det = anomaly.StragglerDetector(threshold=6.0, min_samples=8,
+                                    cooldown_s=60.0, clock=clock)
+    for _ in range(10):
+        det.observe("s", 10_000_000)
+    assert det.observe("s", 900_000_000) is not None
+    assert det.observe("s", 900_000_000) is None  # inside cooldown
+    clock.advance(61.0)
+    assert det.observe("s", 900_000_000) is not None
+
+
+def test_retry_storm_fires_at_threshold():
+    clock = FakeClock()
+    det = anomaly.RetryStormDetector(threshold=5, window_s=10.0,
+                                     clock=clock)
+    for i in range(4):
+        assert det.observe(f"s{i}") is None
+        clock.advance(1.0)
+    fire = det.observe("s4")
+    assert fire is not None and fire["episodes_in_window"] == 5
+    assert "s0" in fire["recent_sections"]
+
+
+def test_retry_storm_quiet_when_spread_out():
+    clock = FakeClock()
+    det = anomaly.RetryStormDetector(threshold=5, window_s=10.0,
+                                     clock=clock)
+    for _ in range(20):
+        assert det.observe("s") is None
+        clock.advance(11.0)  # every episode ages out of the window
+
+
+def test_hbm_pressure_sustained_fire_and_dip_reset():
+    clock = FakeClock()
+    det = anomaly.HbmPressureDetector(threshold_bytes=1000,
+                                      sustain_s=5.0, clock=clock)
+    assert det.observe("0", 1500) is None          # just crossed
+    clock.advance(3.0)
+    assert det.observe("0", 1500) is None          # not sustained yet
+    clock.advance(1.0)
+    assert det.observe("0", 500) is None           # dip resets the arm
+    clock.advance(10.0)
+    assert det.observe("0", 1500) is None          # re-armed fresh
+    clock.advance(6.0)
+    fire = det.observe("0", 1500)
+    assert fire is not None and fire["sustained_s"] >= 5.0
+
+
+def test_hbm_pressure_disarmed_without_threshold():
+    det = anomaly.HbmPressureDetector(threshold_bytes=None)
+    assert det.observe("0", 1 << 60) is None
+
+
+def test_leak_detector_floor():
+    det = anomaly.LeakDetector(min_bytes=1024)
+    assert det.observe(7, 512) is None
+    fire = det.observe(7, 4096, holders=[{"thread": 3, "bytes": 4096}])
+    assert fire == {"task": 7, "leaked_bytes": 4096,
+                    "holders": [{"thread": 3, "bytes": 4096}]}
+    # the default floor filters pool-thread shared-accounting noise
+    det = anomaly.LeakDetector()
+    assert det.observe(7, anomaly.DEFAULT_LEAK_FLOOR_BYTES - 1) is None
+    assert det.observe(7, anomaly.DEFAULT_LEAK_FLOOR_BYTES) is not None
+
+
+# ------------------------------------------------------ bundle writer
+
+
+def make_recorder(tmp_path, **kw):
+    clock = kw.pop("clock", FakeClock())
+    wall = kw.pop("wallclock", FakeClock(1_754_200_000.0))
+    kw.setdefault("enabled", True)
+    kw.setdefault("max_bytes", 8 << 20)
+    kw.setdefault("min_interval_s", 30.0)
+    rec = fr.FlightRecorder(out_dir=str(tmp_path / "inc"),
+                            clock=clock, wallclock=wall, **kw)
+    return rec, clock, wall
+
+
+def test_trigger_writes_complete_bundle(tmp_path):
+    rec, _, _ = make_recorder(tmp_path)
+    path = rec.trigger("unit_test", cause=ValueError("boom"), note="x")
+    assert path is not None and os.path.isdir(path)
+    names = sorted(os.listdir(path))
+    for required in ("MANIFEST.json", "trigger.json", "metrics.json",
+                     "journal.jsonl", "spans.jsonl",
+                     "memory_ledger.json", "threads.json",
+                     "jit_cache.json", "fault_rules.json", "env.json"):
+        assert required in names
+    trig = json.load(open(os.path.join(path, "trigger.json")))
+    assert trig["kind"] == "unit_test"
+    assert trig["detail"] == {"note": "x"}
+    assert trig["cause_chain"] == [{"type": "ValueError",
+                                    "message": "boom"}]
+    manifest = json.load(open(os.path.join(path, "MANIFEST.json")))
+    assert manifest["bundle_version"] == fr.BUNDLE_VERSION
+    assert manifest["total_bytes"] == sum(manifest["files"].values())
+    # metrics.json carries the wall-clock anchors
+    met = json.load(open(os.path.join(path, "metrics.json")))
+    assert "snapshot_unix_ms" in met and "uptime_s" in met
+    # no stray tmp litter
+    assert not [n for n in os.listdir(rec.out_dir)
+                if n.endswith(".tmp")]
+    assert rec.incident_list()[0]["path"] == path
+
+
+def test_rate_limit_one_bundle_per_window(tmp_path):
+    rec, clock, _ = make_recorder(tmp_path, min_interval_s=30.0)
+    assert rec.trigger("a") is not None
+    assert rec.trigger("a") is None                # suppressed
+    assert rec.stats()["suppressed"]["rate_limit"] == 1
+    clock.advance(31.0)
+    assert rec.trigger("a") is not None
+    assert len(rec.incident_list()) == 2
+
+
+def test_force_bypasses_rate_limit_and_disabled(tmp_path):
+    rec, _, _ = make_recorder(tmp_path, enabled=False)
+    assert rec.trigger("quiet") is None            # disabled
+    p1 = rec.trigger("manual", force=True)
+    p2 = rec.trigger("manual", force=True)         # inside the window
+    assert p1 is not None and p2 is not None
+    assert len(rec.incident_list()) == 2
+
+
+def test_byte_budget_suppresses(tmp_path):
+    rec, _, _ = make_recorder(tmp_path, max_bytes=512)
+    assert rec.trigger("big") is None
+    assert rec.stats()["suppressed"]["byte_budget"] == 1
+    assert rec.incident_list() == []
+
+
+def test_byte_budget_counts_existing_bundles(tmp_path):
+    rec, clock, _ = make_recorder(tmp_path, max_bytes=16 << 10)
+    first = rec.trigger("a")
+    assert first is not None
+    used = json.load(open(os.path.join(
+        first, "MANIFEST.json")))["total_bytes"]
+    # shrink the budget to below what is already on disk: the next
+    # trigger must be suppressed even though the recorder restarted
+    rec2 = fr.FlightRecorder(enabled=True, out_dir=rec.out_dir,
+                             max_bytes=used, min_interval_s=0.0,
+                             clock=clock, wallclock=FakeClock(2e9))
+    assert rec2.trigger("b") is None
+    assert rec2.stats()["suppressed"]["byte_budget"] == 1
+
+
+def test_trigger_failure_never_escapes(tmp_path, monkeypatch):
+    rec, clock, _ = make_recorder(tmp_path)
+    boom = {"on": True}
+    real = rec._collect_fixed_files
+
+    def flaky(*a, **k):
+        if boom["on"]:
+            raise OSError("disk full")
+        return real(*a, **k)
+
+    monkeypatch.setattr(rec, "_collect_fixed_files", flaky)
+    assert rec.trigger("broken") is None
+    assert rec.stats()["suppressed"]["error"] == 1
+    # a TRANSIENT dump failure must not consume the rate-limit slot:
+    # the next genuine incident (well inside the window) still dumps
+    boom["on"] = False
+    clock.advance(1.0)
+    assert rec.trigger("broken") is not None
+
+
+def test_warn_bundle_never_shadows_error_bundle(tmp_path):
+    """A retry-storm (warn) bundle fired moments before the terminal
+    retry_exhausted (error) must not eat its rate-limit slot — the
+    error bundle is the one with the cause chain."""
+    rec, clock, _ = make_recorder(tmp_path, min_interval_s=30.0)
+    assert rec.trigger("retry_storm", severity="warn") is not None
+    clock.advance(0.001)
+    assert rec.trigger("retry_exhausted", severity="error") is not None
+    # errors still rate-limit themselves, and warns are limited by all
+    assert rec.trigger("retry_exhausted", severity="error") is None
+    assert rec.trigger("straggler", severity="warn") is None
+    assert [i["kind"] for i in rec.incident_list()] == \
+        ["retry_storm", "retry_exhausted"]
+
+
+def test_stale_tmp_dir_ignored_by_budget_and_listing(tmp_path):
+    """A crash between manifest write and the directory rename leaves
+    a *.tmp dir with a MANIFEST inside: it must not count against the
+    byte budget, show up in listings, or be picked by the doctor."""
+    from spark_rapids_tpu.tools import doctor
+    rec, _, _ = make_recorder(tmp_path, max_bytes=64 << 10,
+                              min_interval_s=0.0)
+    stale = os.path.join(rec.out_dir, "incident-1-dead-001.tmp")
+    os.makedirs(stale)
+    with open(os.path.join(stale, "MANIFEST.json"), "w") as f:
+        json.dump({"trigger_kind": "dead",
+                   "total_bytes": 1 << 30}, f)
+    path = rec.trigger("alive")          # budget must not be eaten
+    assert path is not None
+    assert [i["kind"] for i in rec.incident_list()] == ["alive"]
+    assert doctor.find_bundles(rec.out_dir) == [path]
+
+
+# ----------------------------------------------- end-to-end triggers
+
+
+@pytest.fixture
+def armed_flight(tmp_path):
+    """Arm the process-global recorder into a temp dir (fast clock
+    path left real); restore the disabled state afterwards."""
+    prior = obs.FLIGHT.stats()
+    obs.enable_flight_recorder(out_dir=str(tmp_path / "inc"),
+                               max_bytes=8 << 20, min_interval_s=0.0)
+    try:
+        yield obs.FLIGHT
+    finally:
+        obs.disable_flight_recorder()
+        obs.FLIGHT.configure(out_dir=prior["dir"],
+                             max_bytes=prior["max_bytes"],
+                             min_interval_s=prior["min_interval_s"])
+
+
+def test_retry_exhausted_triggers_bundle(tmp_path, armed_flight):
+    from spark_rapids_tpu.robustness import retry
+    from spark_rapids_tpu.utils import fault_injection as fi
+
+    cfg = tmp_path / "faults.json"
+    cfg.write_text(json.dumps({"faults": [
+        {"match": "fr_probe", "exception": "GpuRetryOOM",
+         "repeat": -1}]}))
+    fi.install(str(cfg), watch=False)
+    try:
+        with pytest.raises(retry.RetryExhausted):
+            retry.with_retry(
+                lambda: None, name="fr_probe",
+                policy=retry.RetryPolicy(max_attempts=3,
+                                         base_backoff_s=0.0))
+    finally:
+        fi.uninstall()
+    incidents = armed_flight.incident_list()
+    assert len(incidents) == 1
+    assert incidents[0]["kind"] == "retry_exhausted"
+    trig = json.load(open(os.path.join(incidents[0]["path"],
+                                       "trigger.json")))
+    assert trig["detail"]["name"] == "fr_probe"
+    assert trig["detail"]["errors"] == ["GpuRetryOOM"] * 3
+    chain = trig["cause_chain"]
+    assert chain[0]["type"] == "RetryExhausted"
+    assert len(chain[0]["attempts"]) == 3
+    assert chain[1]["type"] == "GpuRetryOOM"
+    # the injected rule is frozen alongside the failure
+    rules = json.load(open(os.path.join(incidents[0]["path"],
+                                        "fault_rules.json")))
+    assert rules and rules[0]["match"] == "fr_probe"
+
+
+def test_kudo_corruption_triggers_bundle(tmp_path, armed_flight):
+    from spark_rapids_tpu.columns import dtypes
+    from spark_rapids_tpu.columns.column import Column
+    from spark_rapids_tpu.shuffle import kudo
+
+    prior = kudo.set_crc_enabled(True)
+    try:
+        buf = io.BytesIO()
+        kudo.write_to_stream(
+            [Column.from_pylist([1, 2, 3], dtypes.INT64)], buf, 0, 3)
+        raw = bytearray(buf.getvalue())
+        raw[-10] ^= 0xFF  # body bit-flip caught by the KCRC trailer
+        with pytest.raises(kudo.KudoCorruptException):
+            kudo.read_one_table(io.BytesIO(bytes(raw)))
+    finally:
+        kudo.set_crc_enabled(prior)
+    incidents = armed_flight.incident_list()
+    assert [i["kind"] for i in incidents] == ["kudo_corrupt"]
+
+
+def test_straggler_span_feed_triggers_bundle(armed_flight):
+    obs.enable_tracing()
+    try:
+        for _ in range(12):
+            obs.TRACER.start_span("t_stage", kind="stage").end()
+        slow = obs.TRACER.start_span("t_stage", kind="stage")
+        slow.t0_ns -= 10_000_000_000  # make it a 10s outlier
+        slow.end()
+    finally:
+        obs.disable_tracing()
+        obs.TRACER.reset()
+    kinds = [i["kind"] for i in armed_flight.incident_list()]
+    assert "straggler" in kinds
+
+
+# ------------------------------------------- memory ledger + leaks
+
+
+def make_adaptor(limit=1 << 20):
+    from spark_rapids_tpu.memory.resource import LimitingMemoryResource
+    from spark_rapids_tpu.memory.spark_resource_adaptor import \
+        SparkResourceAdaptor
+    return SparkResourceAdaptor(LimitingMemoryResource(limit))
+
+
+def test_memory_ledger_shape():
+    adaptor = make_adaptor()
+    tid = threading.get_ident()
+    adaptor.start_dedicated_task_thread(tid, 5)
+    adaptor.allocate(1000)
+    led = adaptor.memory_ledger()
+    assert led["allocated_bytes"] == 1000
+    assert led["limit_bytes"] == 1 << 20
+    row = led["threads"][str(tid)]
+    assert row["task"] == 5 and row["state"] == "THREAD_RUNNING"
+    assert row["active_bytes"] == 1000
+    assert row["watermark_bytes"] == 1000
+    assert row["allocs"] == 1 and row["frees"] == 0
+    assert led["tasks"]["5"]["active_bytes"] == 1000
+    assert led["tasks"]["5"]["threads"] == [tid]
+    assert led["oom_state_timeline"]  # transitions recorded
+    states = adaptor.thread_state_dump()
+    assert states == [{"thread": tid, "task": 5, "pool_tasks": [],
+                       "state": "THREAD_RUNNING", "shuffle": False,
+                       "active_bytes": 1000}]
+    adaptor.deallocate(1000)
+    led = adaptor.memory_ledger()
+    assert led["threads"][str(tid)]["active_bytes"] == 0
+    assert led["threads"][str(tid)]["frees"] == 1
+    adaptor.task_done(5)
+
+
+def test_task_done_leak_fires_journal_and_recorder(tmp_path,
+                                                  armed_flight):
+    was_enabled = obs.is_enabled()
+    obs.enable()
+    obs.JOURNAL.clear()
+    adaptor = make_adaptor(limit=4 << 20)
+    tid = threading.get_ident()
+    adaptor.start_dedicated_task_thread(tid, 11)
+    adaptor.allocate(1 << 20)
+    try:
+        adaptor.task_done(11)  # finishes still holding 1 MiB
+        leaks = obs.JOURNAL.records("memory_leak")
+        assert len(leaks) == 1
+        assert leaks[0]["task"] == 11
+        assert leaks[0]["leaked_bytes"] == 1 << 20
+        assert leaks[0]["holders"][0]["thread"] == tid
+        kinds = [i["kind"] for i in armed_flight.incident_list()]
+        assert kinds == ["memory_leak"]
+        assert f"srt_memory_leaked_bytes_total {1 << 20}" in \
+            obs.expose_text()
+        # a sub-floor residue (shared pool accounting noise) still
+        # journals but must NOT freeze another bundle
+        adaptor2 = make_adaptor()
+        adaptor2.start_dedicated_task_thread(tid, 12)
+        adaptor2.allocate(4096)
+        adaptor2.task_done(12)
+        assert len(obs.JOURNAL.records("memory_leak")) == 2
+        assert len(armed_flight.incident_list()) == 1
+    finally:
+        if not was_enabled:
+            obs.disable()
+        obs.JOURNAL.clear()
+        obs.METRICS.reset()
+
+
+def test_task_done_no_leak_no_event():
+    was_enabled = obs.is_enabled()
+    obs.enable()
+    obs.JOURNAL.clear()
+    adaptor = make_adaptor()
+    tid = threading.get_ident()
+    adaptor.start_dedicated_task_thread(tid, 12)
+    adaptor.allocate(4096)
+    adaptor.deallocate(4096)
+    try:
+        adaptor.task_done(12)
+        assert obs.JOURNAL.records("memory_leak") == []
+    finally:
+        if not was_enabled:
+            obs.disable()
+        obs.JOURNAL.clear()
+
+
+def test_leak_survives_thread_checkpoint():
+    """Bytes held by a thread that unwound BEFORE task_done must still
+    be seen by the leak check (active footprint sums across
+    checkpoints)."""
+    from spark_rapids_tpu.memory.spark_resource_adaptor import \
+        TaskMetrics
+    a = TaskMetrics()
+    a.gpu_memory_active_footprint = 1000
+    b = TaskMetrics()
+    b.add(a)
+    b.add(a)
+    assert b.gpu_memory_active_footprint == 2000
+
+
+# --------------------------------------------------- atomic dumps
+
+
+def test_atomic_write_failure_keeps_original(tmp_path):
+    path = str(tmp_path / "out.jsonl")
+    atomic_write(path, lambda f: f.write("good\n"))
+    with pytest.raises(RuntimeError):
+        def bad(f):
+            f.write("partial")
+            raise RuntimeError("disk died")
+        atomic_write(path, bad)
+    assert open(path).read() == "good\n"          # original intact
+    assert os.listdir(tmp_path) == ["out.jsonl"]  # no tmp litter
+
+
+def test_journal_and_span_dumps_leave_no_tmp(tmp_path):
+    was_enabled = obs.is_enabled()
+    obs.enable()
+    obs.JOURNAL.emit("unit_probe", x=1)
+    jpath = str(tmp_path / "journal.jsonl")
+    n = obs.dump_journal_jsonl(jpath)
+    assert n >= 2  # probe + registry snapshot at least
+    spath = str(tmp_path / "spans.jsonl")
+    obs.dump_spans_jsonl(spath)
+    assert sorted(os.listdir(tmp_path)) == ["journal.jsonl",
+                                            "spans.jsonl"]
+    if not was_enabled:
+        obs.disable()
+    obs.JOURNAL.clear()
+
+
+def test_tracing_flush_failure_requeues_and_keeps_file(tmp_path):
+    from spark_rapids_tpu.shim import jni_api
+    obs.enable_tracing()
+    try:
+        obs.TRACER.start_span("flush_probe").end()
+        path = str(tmp_path / "flush.jsonl")
+        assert jni_api.tracing_flush(path) == 1
+        assert len(obs.TRACER) == 0
+        obs.TRACER.start_span("flush_probe2").end()
+        with pytest.raises(OSError):
+            jni_api.tracing_flush(str(tmp_path / "no_dir" / "x.jsonl"))
+        # drained spans were requeued; the prior flush file is intact
+        assert len(obs.TRACER) == 1
+        assert "flush_probe" in open(path).read()
+    finally:
+        obs.disable_tracing()
+        obs.TRACER.reset()
+
+
+# ---------------------------------------------- snapshot anchoring
+
+
+def test_snapshot_wall_clock_fields():
+    import time
+    snap = obs.snapshot()
+    assert abs(snap["snapshot_unix_ms"] - time.time() * 1000) < 60_000
+    assert 0 <= snap["uptime_s"]
+    from spark_rapids_tpu.shim import jni_entry
+    js = json.loads(jni_entry.metrics_snapshot_json())
+    assert "snapshot_unix_ms" in js and "uptime_s" in js
+
+
+def test_health_json_shape():
+    from spark_rapids_tpu.shim import jni_entry
+    h = json.loads(jni_entry.health_json())
+    for key in ("snapshot_unix_ms", "uptime_s", "pid",
+                "metrics_enabled", "tracing_enabled", "journal",
+                "spans", "flight_recorder"):
+        assert key in h
+    assert h["flight_recorder"]["enabled"] in (True, False)
+
+
+def test_shim_incident_surface(tmp_path):
+    from spark_rapids_tpu.shim import jni_entry
+    prior_dir = obs.FLIGHT.out_dir
+    prior_iv = obs.FLIGHT.min_interval_s
+    prior_max = obs.FLIGHT.max_bytes
+    try:
+        jni_entry.flight_recorder_configure(
+            out_dir=str(tmp_path / "inc"), max_bytes=8 << 20,
+            min_interval_s=0.0)
+        assert jni_entry.flight_recorder_enabled() is False
+        path = jni_entry.incident_dump("jvm asked")
+        assert path and os.path.isdir(path)
+        listed = json.loads(jni_entry.incident_list())
+        assert listed[0]["path"] == path
+        assert listed[0]["kind"] == "manual"
+    finally:
+        obs.FLIGHT.configure(out_dir=prior_dir, max_bytes=prior_max,
+                             min_interval_s=prior_iv)
+
+
+# ------------------------------------------------- tools on bundles
+
+
+def test_metrics_report_accepts_bundle_dir(capsys):
+    from spark_rapids_tpu.tools import metrics_report
+    records = metrics_report.load_jsonl([MINI_BUNDLE])
+    rollups, registry, events = metrics_report.split_records(records)
+    assert 7 in rollups
+    assert registry is not None
+    assert any(e["kind"] == "retry_episode" for e in events)
+    assert metrics_report.main([MINI_BUNDLE]) == 0
+    assert "retry episodes" in capsys.readouterr().out
+
+
+def test_trace_export_accepts_bundle_dir(tmp_path, capsys):
+    from spark_rapids_tpu.tools import trace_export
+    out = str(tmp_path / "trace.json")
+    assert trace_export.main([MINI_BUNDLE, "-o", out, "--stats"]) == 0
+    trace = json.load(open(out))
+    names = {e.get("name") for e in trace["traceEvents"]}
+    assert "exchange.step" in names
+
+
+def test_bundle_input_rejects_random_dir(tmp_path):
+    from spark_rapids_tpu.tools import expand_bundle_input
+    with pytest.raises(FileNotFoundError):
+        expand_bundle_input(str(tmp_path), "spans")
+    assert expand_bundle_input("x.jsonl", "spans") == ["x.jsonl"]
+    # spans consumer may fall back to the journal (spans ride it);
+    # the journal consumer must NOT fall back to a spans-only file
+    # it would silently render as an empty report
+    (tmp_path / "spans.jsonl").write_text("")
+    assert expand_bundle_input(str(tmp_path), "spans") == \
+        [str(tmp_path / "spans.jsonl")]
+    with pytest.raises(FileNotFoundError):
+        expand_bundle_input(str(tmp_path), "journal")
+    (tmp_path / "journal.jsonl").write_text("")
+    assert expand_bundle_input(str(tmp_path), "journal") == \
+        [str(tmp_path / "journal.jsonl")]
+
+
+# ----------------------------------------------------- srt-doctor
+
+
+def test_doctor_golden_output(capsys):
+    from spark_rapids_tpu.tools import doctor
+    assert doctor.main([MINI_BUNDLE]) == 0
+    got = capsys.readouterr().out
+    golden = open(os.path.join(DATA, "doctor_golden.txt")).read()
+    assert got == golden
+
+
+def test_doctor_json_and_root_dir(capsys):
+    from spark_rapids_tpu.tools import doctor
+    root = os.path.dirname(MINI_BUNDLE)
+    assert doctor.main([root, "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["findings"][0]["kind"] == "fault_injection"
+    severities = [f["severity"] for f in out["findings"]]
+    assert severities == sorted(severities, reverse=True)
+
+
+def test_doctor_rejects_non_bundle(tmp_path, capsys):
+    from spark_rapids_tpu.tools import doctor
+    assert doctor.main([str(tmp_path)]) == 2
