@@ -5,7 +5,8 @@ PY ?= python
 
 .PHONY: test fuzz native sanitizers bench bench-all dryrun tpu-lower \
         jni-test kudo-bench metrics-smoke trace-smoke chaos-smoke \
-        perf-smoke doctor-smoke nightly-artifacts ci ci-nightly clean
+        perf-smoke doctor-smoke server-smoke nightly-artifacts ci \
+        ci-nightly clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -82,6 +83,15 @@ perf-smoke:
 doctor-smoke:
 	$(PY) scripts/doctor_smoke.py
 
+# query-server gate: 8+ interleaved TPC-DS model queries from four
+# competing tenants through the multi-tenant server, under the fault
+# injector, must finish byte-identical to their serial runs with
+# fair-share evidence in the metrics journal (per-tenant accounting,
+# no tenant starved) and an over-quota tenant receiving the typed
+# ServerOverloaded backpressure response instead of crashing neighbors
+server-smoke:
+	$(PY) scripts/server_soak.py
+
 # NOTE: jax.config.update, not the env var — this image's sitecustomize
 # pre-imports jax with the axon backend, so JAX_PLATFORMS=cpu is too
 # late.  XLA_FLAGS still works (read at backend init, which happens
@@ -103,7 +113,7 @@ dryrun:
 # (default 1500s) before emitting the CPU-fallback line — export
 # BENCH_FIGHT_SECONDS=1 for a quick local run.
 ci: test fuzz native sanitizers tpu-lower jni-test dryrun metrics-smoke \
-    trace-smoke chaos-smoke perf-smoke doctor-smoke
+    trace-smoke chaos-smoke perf-smoke doctor-smoke server-smoke
 	$(PY) bench.py
 	@echo "ci: all gates green"
 

@@ -1,0 +1,229 @@
+"""Query-server soak gate (`make server-smoke`, ISSUE 6 acceptance):
+run 8+ interleaved TPC-DS model queries from four competing tenants
+through the multi-tenant query server UNDER the PR-3 fault injector
+and assert —
+
+  * every interleaved result is byte-identical to its serial run
+    (admission, fair-share scheduling, and injected OOM retries must
+    not perturb a single byte),
+  * fair-share evidence lands in the metrics journal: per-tenant
+    ``server_admit``/``server_complete`` accounting, every tenant
+    finishes (no starvation), and the scheduler deficit map covers
+    all tenants,
+  * an over-quota tenant receives the typed ``ServerOverloaded``
+    backpressure response (``tenant_inflight``) while its neighbors
+    complete unharmed — and is admitted normally once its backlog
+    drains,
+  * the injected faults actually fired: ``retry_episode`` journal
+    events recovered inside the served queries,
+  * ``srt_server_*`` exposition + the metrics_report server table
+    render from a journal dump.
+
+Exits non-zero on the first missing signal.  ``run_soak(seed)`` is
+importable and returns (digest, report) so tests can assert
+determinism."""
+
+import hashlib
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+# four tenants x (2-3 queries each) = 10 interleaved submissions over
+# five distinct TPC-DS model pipelines
+MIX = [
+    ("alpha", "tpcds_q9", {"rows": 2048, "seed": 1}),
+    ("alpha", "tpcds_q5", {"rows": 1024, "stores": 8, "seed": 21}),
+    ("alpha", "tpcds_q3", {"rows": 1024, "seed": 31}),
+    ("bravo", "tpcds_q72", {"rows": 1024, "items": 64, "seed": 41}),
+    ("bravo", "tpcds_q9", {"rows": 2048, "seed": 2}),
+    ("charlie", "tpcds_q7", {"rows": 1024, "items": 64, "seed": 51}),
+    ("charlie", "tpcds_q5", {"rows": 1024, "stores": 8, "seed": 22}),
+    ("charlie", "tpcds_q9", {"rows": 2048, "seed": 3}),
+    ("delta", "tpcds_q72", {"rows": 1024, "items": 64, "seed": 42}),
+    ("delta", "tpcds_q3", {"rows": 1024, "seed": 32}),
+]
+
+
+def fail(msg: str) -> "NoReturn":  # noqa: F821
+    print(f"server-smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def run_soak(seed: int = 6, verbose: bool = True):
+    from spark_rapids_tpu import models
+    from spark_rapids_tpu import observability as obs
+    from spark_rapids_tpu.memory import rmm_spark
+    from spark_rapids_tpu.server import (QueryServer, ServerConfig,
+                                         ServerOverloaded)
+    from spark_rapids_tpu.tools import metrics_report
+    from spark_rapids_tpu.utils import fault_injection as fi
+
+    def say(msg):
+        if verbose:
+            print(f"server-smoke: {msg}")
+
+    # ---- serial baseline (fault-free, metrics off) ----------------
+    fi.uninstall()
+    obs.disable()
+    obs.disable_tracing()
+    serial = [models.run_catalog_query(q, dict(p))
+              for _t, q, p in MIX]
+    say(f"serial baseline: {len(serial)} queries")
+
+    # ---- concurrent run under fault injection ---------------------
+    obs.enable()
+    obs.enable_tracing()
+    obs.reset()
+    rmm_spark.clear_event_handler()
+    rmm_spark.set_event_handler(256 << 20)
+    tmp = tempfile.mkdtemp(prefix="server_soak_")
+    cfg_path = os.path.join(tmp, "faults.json")
+    with open(cfg_path, "w") as f:
+        json.dump({"seed": seed, "faults": [
+            {"match": "tpcds_q5", "exception": "GpuRetryOOM",
+             "repeat": 2},
+            {"match": "tpcds_q72",
+             "exception": "GpuSplitAndRetryOOM", "repeat": 2},
+            {"match": "tpcds_q7", "exception": "CudfException",
+             "repeat": 1},
+        ]}, f)
+    inj = fi.install(cfg_path, watch=False)
+    if len(inj.active_rules()) != 3:
+        fail("fault injector did not load the seeded config")
+
+    server = QueryServer(ServerConfig(
+        max_concurrency=3, max_queue=32, stall_ms=0)).start()
+    server.set_tenant_quota("greedy", max_inflight=1)
+    try:
+        ids = [(server.submit(t, q, dict(p)), i)
+               for i, (t, q, p) in enumerate(MIX)]
+        say(f"submitted {len(ids)} interleaved queries from 4 tenants")
+
+        # over-quota tenant: one admitted, the rest typed-bounced
+        greedy_first = server.submit("greedy", "tpcds_q9",
+                                     {"rows": 2048, "seed": 4})
+        rejections = []
+        for _ in range(2):
+            try:
+                server.submit("greedy", "tpcds_q9",
+                              {"rows": 2048, "seed": 4})
+            except ServerOverloaded as e:
+                rejections.append(e)
+        if not rejections:
+            fail("over-quota tenant was never rejected")
+        if any(e.reason != "tenant_inflight" for e in rejections):
+            fail(f"wrong rejection reason: "
+                 f"{[e.reason for e in rejections]}")
+        if any(e.retry_after_s <= 0 for e in rejections):
+            fail("rejection carried no retry-after hint")
+        say(f"greedy tenant typed-rejected x{len(rejections)} "
+            f"(tenant_inflight), neighbors unaffected")
+
+        # ---- drain + byte-identity --------------------------------
+        for qid, i in ids:
+            r = server.poll(qid, timeout_s=300)
+            if r["state"] != "done":
+                fail(f"{MIX[i]} finished {r['state']}: "
+                     f"{r.get('error')}")
+            if r["result"] != serial[i]:
+                fail(f"{MIX[i]} diverged from its serial run")
+        if server.poll(greedy_first, timeout_s=300)["state"] != "done":
+            fail("greedy tenant's admitted query did not finish")
+        say("all interleaved results byte-identical to serial runs")
+
+        # once the backlog drained, greedy is admitted like anyone
+        retry_qid = server.submit("greedy", "tpcds_q9",
+                                  {"rows": 2048, "seed": 4})
+        if server.poll(retry_qid, timeout_s=300)["state"] != "done":
+            fail("greedy resubmission after drain did not finish")
+
+        # ---- fault + fairness evidence ----------------------------
+        episodes = obs.JOURNAL.records("retry_episode")
+        recovered = {e.get("name") for e in episodes
+                     if e.get("outcome") == "success"}
+        for name in ("tpcds_q5", "tpcds_q72", "tpcds_q7"):
+            if name not in recovered:
+                fail(f"no recovered retry episode for {name} — "
+                     f"injected faults did not fire inside the "
+                     f"server")
+        say(f"{len(episodes)} retry episodes recovered under load")
+
+        tenants = {t for t, _q, _p in MIX}
+        completes = obs.JOURNAL.records("server_complete")
+        done_by = {}
+        for e in completes:
+            if e.get("outcome") == "success":
+                done_by[e["tenant"]] = done_by.get(e["tenant"], 0) + 1
+        for t in tenants:
+            expected = sum(1 for tt, _q, _p in MIX if tt == t)
+            if done_by.get(t, 0) != expected:
+                fail(f"tenant {t} finished {done_by.get(t, 0)}/"
+                     f"{expected} — starved or lost")
+        stats = server.stats()
+        deficit = stats["scheduler"]["deficit"]
+        missing = tenants - set(deficit)
+        if missing:
+            fail(f"scheduler deficit map missing tenants {missing}")
+        if stats["task_priority"]["registered_total"] < len(MIX):
+            fail("task_priority registry saw fewer attempts than "
+                 "admissions")
+        say(f"fair share: completions per tenant "
+            f"{dict(sorted(done_by.items()))}, deficit "
+            f"{ {t: round(v, 3) for t, v in sorted(deficit.items())} }")
+
+        # ---- exposition + report ----------------------------------
+        text = obs.expose_text()
+        for needle in ("srt_server_admitted_total",
+                       "srt_server_rejected_total",
+                       "srt_server_completed_total",
+                       "srt_server_queue_wait_ns"):
+            if needle not in text:
+                fail(f"exposition missing {needle!r}")
+        jpath = os.path.join(tmp, "journal.jsonl")
+        obs.dump_journal_jsonl(jpath)
+        report = metrics_report.build_report(
+            metrics_report.load_jsonl([jpath]))
+        srows = {(r["tenant"], r["query"]) for r in report["server"]}
+        if ("alpha", "*") not in srows \
+                or ("greedy", "*") not in srows:
+            fail("metrics_report server table missing tenant rows")
+        say("journal dump renders the per-tenant server table")
+
+        results = [server.poll(qid)["result"] for qid, _ in ids]
+        digest = hashlib.sha256(
+            repr(results).encode()).hexdigest()
+        return digest, {"episodes": len(episodes),
+                        "rejections": len(rejections),
+                        "done_by": done_by}
+    finally:
+        server.stop()
+        fi.uninstall()
+        rmm_spark.clear_event_handler()
+        obs.disable_tracing()
+        obs.disable()
+
+
+def main() -> int:
+    t0 = time.monotonic()
+    digest, report = run_soak()
+    print(f"server-smoke: OK (digest {digest[:16]}, "
+          f"{report['episodes']} retry episodes, "
+          f"{report['rejections']} typed rejections, "
+          f"completions {report['done_by']}, "
+          f"{time.monotonic() - t0:.1f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -203,6 +203,45 @@ SPAN_DURATION = METRICS.histogram(
     buckets=DEFAULT_LATENCY_BUCKETS_NS, max_series=512)
 SPANS_FINISHED = METRICS.counter(
     "srt_spans_finished_total", "Spans finished", labels=("span_kind",))
+SERVER_ADMITTED = METRICS.counter(
+    "srt_server_admitted_total",
+    "Query-server submissions admitted, by tenant", labels=("tenant",),
+    max_series=128)
+SERVER_REJECTED = METRICS.counter(
+    "srt_server_rejected_total",
+    "Query-server submissions rejected with a typed ServerOverloaded "
+    "(queue_full, tenant_inflight, tenant_bytes, shutdown)",
+    labels=("tenant", "reason"), max_series=256)
+SERVER_COMPLETED = METRICS.counter(
+    "srt_server_completed_total",
+    "Query-server jobs finished, by tenant and outcome "
+    "(success, failed, cancelled, shed)",
+    labels=("tenant", "outcome"), max_series=256)
+SERVER_REQUEUED = METRICS.counter(
+    "srt_server_requeued_total",
+    "Jobs re-queued at lower priority by the load-shedding path "
+    "(an attempt OOMed against quota instead of killing neighbors)",
+    labels=("tenant", "reason"), max_series=128)
+SERVER_QUEUED = METRICS.gauge(
+    "srt_server_queued", "Queued (admitted, not yet running) jobs",
+    labels=("tenant",), max_series=128)
+SERVER_RUNNING = METRICS.gauge(
+    "srt_server_running", "Jobs currently executing on pool threads",
+    labels=("tenant",), max_series=128)
+SERVER_TENANT_BYTES = METRICS.gauge(
+    "srt_server_tenant_device_bytes",
+    "Device bytes currently attributed to a tenant's live tasks "
+    "(memory-ledger fold)", labels=("tenant",), max_series=128)
+SERVER_FAIR_DEFICIT = METRICS.gauge(
+    "srt_server_fair_share_deficit",
+    "Weighted service a tenant is behind the most-served tenant "
+    "(scheduler vruntime delta, seconds)", labels=("tenant",),
+    max_series=128)
+SERVER_QUEUE_WAIT = METRICS.histogram(
+    "srt_server_queue_wait_ns",
+    "Admission-to-dispatch queue wait per tenant",
+    labels=("tenant",), buckets=DEFAULT_LATENCY_BUCKETS_NS,
+    max_series=128)
 
 
 # ------------------------------------------------------------------ tracer
@@ -450,6 +489,74 @@ def record_task_leak(task_id: int, leaked_bytes: int,
     JOURNAL.emit("memory_leak", task=task_id,
                  leaked_bytes=leaked_bytes,
                  holders=list(holders)[:8])
+
+
+# ------------------------------------------------------- query server hooks
+# (server/ calls these; per the layering rule the server imports this
+# package, never the reverse)
+
+
+def record_server_admit(tenant: str, query: str, query_id: str,
+                        queue_depth: int) -> None:
+    if not _SWITCH.enabled:
+        return
+    SERVER_ADMITTED.inc(labels=(tenant,))
+    JOURNAL.emit("server_admit", tenant=tenant, query=query,
+                 query_id=query_id, queue_depth=queue_depth)
+
+
+def record_server_reject(tenant: str, query: str, reason: str,
+                         retry_after_s: float = 0.0) -> None:
+    if not _SWITCH.enabled:
+        return
+    SERVER_REJECTED.inc(labels=(tenant, reason))
+    JOURNAL.emit("server_reject", tenant=tenant, query=query,
+                 reason=reason, retry_after_s=retry_after_s)
+
+
+def record_server_dequeue(tenant: str, query_id: str,
+                          wait_ns: int) -> None:
+    if not _SWITCH.enabled:
+        return
+    SERVER_QUEUE_WAIT.observe(wait_ns, labels=(tenant,))
+    JOURNAL.emit("server_dequeue", tenant=tenant, query_id=query_id,
+                 wait_ns=wait_ns)
+
+
+def record_server_requeue(tenant: str, query_id: str, reason: str,
+                          demotions: int) -> None:
+    if not _SWITCH.enabled:
+        return
+    SERVER_REQUEUED.inc(labels=(tenant, reason))
+    JOURNAL.emit("server_requeue", tenant=tenant, query_id=query_id,
+                 reason=reason, demotions=demotions)
+
+
+def record_server_complete(tenant: str, query: str, query_id: str,
+                           outcome: str, dur_ns: int,
+                           wait_ns: int) -> None:
+    if not _SWITCH.enabled:
+        return
+    SERVER_COMPLETED.inc(labels=(tenant, outcome))
+    JOURNAL.emit("server_complete", tenant=tenant, query=query,
+                 query_id=query_id, outcome=outcome, dur_ns=dur_ns,
+                 wait_ns=wait_ns)
+
+
+def set_server_tenant_gauges(queued: dict, running: dict,
+                             deficit: dict, device_bytes: dict) -> None:
+    """Per-tenant gauge refresh (the server calls this after every
+    state transition with its current per-tenant snapshot)."""
+    if not _SWITCH.enabled:
+        return
+    for tenant, v in queued.items():
+        SERVER_QUEUED.set(v, labels=(tenant,))
+    for tenant, v in running.items():
+        SERVER_RUNNING.set(v, labels=(tenant,))
+    for tenant, v in deficit.items():
+        SERVER_FAIR_DEFICIT.set(round(float(v), 6), labels=(tenant,))
+    for tenant, v in device_bytes.items():
+        SERVER_TENANT_BYTES.set(int(v), labels=(tenant,))
 
 
 # ------------------------------------------------------------------- dumping

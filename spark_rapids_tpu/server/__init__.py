@@ -1,0 +1,81 @@
+"""Multi-tenant concurrent query server (ISSUE 6).
+
+Public surface:
+
+  * :class:`QueryServer` / :class:`ServerConfig` — the resident
+    executor front door (``submit`` / ``poll`` / ``cancel`` /
+    ``stats``), fair-share scheduled, quota-admitted, RmmSpark-
+    arbitrated (server.py);
+  * :class:`ServerOverloaded` / :class:`TenantQuota` — the typed
+    backpressure response and per-tenant limits (admission.py);
+  * :class:`SocketFrontDoor` — JSON-lines over a local unix socket
+    (protocol.py);
+  * :func:`start_server` / :func:`get_server` / :func:`stop_server` —
+    the process-global instance the JVM shim drives.
+
+See docs/server.md for architecture, knobs, and failure modes.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from spark_rapids_tpu.server.admission import (AdmissionController,  # noqa: F401
+                                               ServerOverloaded,
+                                               TenantQuota)
+from spark_rapids_tpu.server.protocol import SocketFrontDoor  # noqa: F401
+from spark_rapids_tpu.server.scheduler import (FairShareScheduler,  # noqa: F401
+                                               Job)
+from spark_rapids_tpu.server.server import (QueryServer,  # noqa: F401
+                                            ServerConfig)
+
+_SERVER: Optional[QueryServer] = None
+_DOOR: Optional[SocketFrontDoor] = None
+_LOCK = threading.Lock()
+
+
+def ensure_server(config: Optional[ServerConfig] = None,
+                  socket_path: Optional[str] = None
+                  ) -> "tuple[QueryServer, bool]":
+    """Start (or return) the process-global server; the bool is
+    whether THIS call created it (decided under the lock — two
+    racing callers cannot both be told they started it).  An already-
+    running server gains the socket front door if ``socket_path`` (or
+    ``SPARK_RAPIDS_TPU_SERVER_SOCKET``) names one and none is open;
+    a config passed after creation is ignored (idempotent start)."""
+    global _SERVER, _DOOR
+    with _LOCK:
+        created = _SERVER is None
+        if created:
+            _SERVER = QueryServer(config or ServerConfig.from_env())
+            _SERVER.start()
+        path = socket_path or os.environ.get(
+            "SPARK_RAPIDS_TPU_SERVER_SOCKET", "")
+        if path and _DOOR is None:
+            _DOOR = SocketFrontDoor(_SERVER, path).start()
+        return _SERVER, created
+
+
+def start_server(config: Optional[ServerConfig] = None,
+                 socket_path: Optional[str] = None) -> QueryServer:
+    """Start (or return) the process-global server.  ``socket_path``
+    (or ``SPARK_RAPIDS_TPU_SERVER_SOCKET``) additionally opens the
+    local-socket front door."""
+    return ensure_server(config, socket_path)[0]
+
+
+def get_server() -> Optional[QueryServer]:
+    return _SERVER
+
+
+def stop_server(timeout_s: float = 30.0) -> None:
+    global _SERVER, _DOOR
+    with _LOCK:
+        door, _DOOR = _DOOR, None
+        server, _SERVER = _SERVER, None
+    if door is not None:
+        door.stop()
+    if server is not None:
+        server.stop(timeout_s=timeout_s)

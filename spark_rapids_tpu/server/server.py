@@ -1,0 +1,603 @@
+"""The resident multi-tenant query server (ISSUE 6 tentpole).
+
+One process, N pool threads, many competing tenants — the per-executor
+shape of the reference design (PAPER.md §L3b: many Spark task threads
+competing for device memory through RmmSpark/SparkResourceAdaptor),
+with this repo's existing subsystems composed as the control plane:
+
+  * **admission**   — ``admission.AdmissionController``: queue-depth
+    backpressure + per-tenant in-flight / device-byte quotas, every
+    refusal a typed :class:`ServerOverloaded`;
+  * **scheduling**  — ``scheduler.FairShareScheduler`` (weighted
+    virtual time) picks WHICH admitted job runs next;
+    ``memory/task_priority`` orders attempts WITHIN the run: each
+    admission registers a task-priority attempt id, so the OOM
+    deadlock breaker's victim selection and the shuffle path see the
+    same earlier-admitted-wins ordering the scheduler enforces;
+  * **memory arbitration** — every job runs on a pool thread
+    registered with RmmSpark as a distinct task, so competing tenants
+    block/BUFN/split through the SparkResourceAdaptor state machine
+    exactly like competing Spark tasks;
+  * **load shedding** — a job whose attempt escapes the robustness
+    retry drivers with an OOM-flavored failure (``RetryExhausted``,
+    ``*RetryOOM``, ``GpuOOM``) is NOT allowed to kill neighbors: it is
+    re-queued at a strictly lower task priority (release + re-register
+    in ``task_priority``) up to ``max_requeues`` times, then fails
+    alone with a typed error;
+  * **accounting**  — ``srt_server_*`` metrics, ``server_*`` journal
+    events, a query-root span per job tagged with tenant/query ids,
+    and an ``admission_stall`` flight-recorder trigger when a job's
+    queue wait crosses the stall threshold.
+
+Knobs (all ``SPARK_RAPIDS_TPU_SERVER_*`` env, overridable in code):
+``MAX_CONCURRENCY``, ``MAX_QUEUE``, ``TENANT_MAX_INFLIGHT``,
+``TENANT_MAX_BYTES``, ``MAX_REQUEUES``, ``STALL_MS``.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional
+
+from spark_rapids_tpu import observability as _obs
+from spark_rapids_tpu.memory import exceptions as exc
+from spark_rapids_tpu.memory import task_priority
+from spark_rapids_tpu.models import (QueryCancelled, QueryContext,
+                                     UnknownQueryError, has_query,
+                                     run_catalog_query)
+from spark_rapids_tpu.robustness.retry import RetryExhausted
+from spark_rapids_tpu.server.admission import (REASON_SHUTDOWN,
+                                               AdmissionController,
+                                               ServerOverloaded,
+                                               TenantQuota)
+from spark_rapids_tpu.server.scheduler import (STATE_CANCELLED,
+                                               STATE_DONE, STATE_FAILED,
+                                               STATE_QUEUED,
+                                               STATE_RUNNING,
+                                               FairShareScheduler, Job)
+
+# what the load-shedding path absorbs: OOM-flavored failures that the
+# in-query retry drivers could not recover (everything else is a real
+# query error and fails the job immediately)
+SHED_ERRORS = (RetryExhausted, exc.RetryOOMBase,
+               exc.SplitAndRetryOOMBase, exc.GpuOOM)
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+@dataclass
+class ServerConfig:
+    max_concurrency: int = 4
+    max_queue: int = 16
+    tenant_max_inflight: int = 8
+    tenant_max_bytes: int = 0          # 0 = unlimited
+    max_requeues: int = 1              # load-shed demotions per job
+    stall_ms: int = 5000               # admission-stall trigger; 0=off
+    finished_keep: int = 1024          # finished jobs pollable before
+    #                                    eviction (resident server:
+    #                                    results must not accrete)
+
+    @classmethod
+    def from_env(cls) -> "ServerConfig":
+        p = "SPARK_RAPIDS_TPU_SERVER_"
+        return cls(
+            max_concurrency=_env_int(p + "MAX_CONCURRENCY", 4),
+            max_queue=_env_int(p + "MAX_QUEUE", 16),
+            tenant_max_inflight=_env_int(p + "TENANT_MAX_INFLIGHT", 8),
+            tenant_max_bytes=_env_int(p + "TENANT_MAX_BYTES", 0),
+            max_requeues=_env_int(p + "MAX_REQUEUES", 1),
+            stall_ms=_env_int(p + "STALL_MS", 5000),
+            finished_keep=_env_int(p + "FINISHED_KEEP", 1024),
+        )
+
+
+class QueryServer:
+    """Front door + pool.  ``runner`` defaults to the models catalog;
+    tests inject stubs.  ``device_bytes_fn(tenant)`` overrides the
+    memory-ledger fold (tests again)."""
+
+    def __init__(self, config: Optional[ServerConfig] = None,
+                 runner: Optional[Callable] = None,
+                 device_bytes_fn: Optional[Callable[[str], int]] = None):
+        self.config = config or ServerConfig.from_env()
+        self._runner = runner or run_catalog_query
+        self._device_bytes_fn = device_bytes_fn
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._sched = FairShareScheduler()
+        self._admission = AdmissionController(
+            self.config.max_queue,
+            TenantQuota(self.config.tenant_max_inflight,
+                        self.config.tenant_max_bytes))
+        self._jobs: Dict[str, Job] = {}
+        # finished jobs stay pollable for a bounded window, then
+        # evict oldest-first — a resident server must not accrete
+        # every result payload it ever produced
+        self._finished: collections.deque = collections.deque()
+        self._running: Dict[str, int] = {}
+        self._task_tenant: Dict[int, str] = {}   # live task -> tenant
+        self._tenant_stats: Dict[str, dict] = {}
+        self._seq = itertools.count()
+        # task ids live in their own high range so they never collide
+        # with Spark-shaped task ids tests drive through RmmSpark
+        self._task_ids = itertools.count(1_000_001)
+        self._qid = itertools.count(1)
+        self._workers: list = []
+        self._started = False
+        self._stopping = False
+        # bumped by stop(): a worker that outlives a timed-out join
+        # (job longer than the stop timeout) sees a stale generation
+        # and exits instead of rejoining a restarted pool as an
+        # untracked extra thread
+        self._generation = 0
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "QueryServer":
+        with self._lock:
+            if self._started:
+                return self
+            self._started = True
+            self._stopping = False
+        for i in range(self.config.max_concurrency):
+            t = threading.Thread(target=self._worker_loop,
+                                 args=(self._generation,),
+                                 name=f"srt-server-{i}", daemon=True)
+            t.start()
+            self._workers.append(t)
+        return self
+
+    def stop(self, timeout_s: float = 30.0) -> None:
+        """Stop accepting work, cancel everything still queued, let
+        running jobs finish, join the pool."""
+        with self._work:
+            if not self._started:
+                return
+            self._stopping = True
+            while True:
+                job = self._sched.pick(self._running,
+                                       self._admission.weight_for)
+                if job is None:
+                    break
+                self._finalize_locked(job, STATE_CANCELLED,
+                                      outcome="cancelled")
+            self._work.notify_all()
+        deadline = time.monotonic() + timeout_s
+        for t in self._workers:
+            t.join(max(deadline - time.monotonic(), 0.1))
+        with self._lock:
+            self._generation += 1   # orphan any join-timeout survivor
+            self._workers = []
+            self._started = False
+
+    # ------------------------------------------------------------ admission
+
+    def set_tenant_quota(self, tenant: str, *, max_inflight: int = -1,
+                         max_device_bytes: int = -1,
+                         weight: float = -1.0) -> TenantQuota:
+        return self._admission.set_quota(
+            tenant, max_inflight=max_inflight,
+            max_device_bytes=max_device_bytes, weight=weight)
+
+    def submit(self, tenant: str, query: str,
+               params: Optional[dict] = None) -> str:
+        """Admit a query; returns its query id or raises the typed
+        :class:`ServerOverloaded` backpressure response."""
+        tenant = str(tenant)
+        if self._runner is run_catalog_query \
+                and not has_query(str(query)):
+            # catalog-backed servers validate the name at the front
+            # door: a typo answers typed immediately instead of
+            # burning a pool slot to fail at run time
+            raise UnknownQueryError(str(query))
+        # the memory-ledger fold (adaptor lock, O(live tasks)) runs
+        # BEFORE the server lock is taken — _task_tenant is only
+        # point-read, so a slightly stale byte count is fine and the
+        # fold never serializes dispatch behind the adaptor
+        tenant_bytes = (self._tenant_device_bytes(tenant)
+                        if self._bytes_tracked(tenant) else None)
+        try:
+            with self._work:
+                if not self._started or self._stopping:
+                    raise ServerOverloaded(REASON_SHUTDOWN, tenant,
+                                           "server is not accepting "
+                                           "work")
+                queued_total = self._sched.queued_total()
+                inflight = (self._sched.queued_for(tenant)
+                            + self._running.get(tenant, 0))
+                # cheapest-first (admission.py contract): counts
+                # first, the pre-computed byte fold only for tenants
+                # whose bytes anyone actually tracks
+                self._admission.check(
+                    tenant, queued_total=queued_total,
+                    tenant_inflight=inflight,
+                    tenant_device_bytes=tenant_bytes or 0)
+                task_id = next(self._task_ids)
+                job = Job(
+                    query_id=f"q-{next(self._qid):06d}",
+                    tenant=tenant, query=str(query),
+                    params=dict(params or {}), seq=next(self._seq),
+                    task_id=task_id,
+                    priority=task_priority.get_task_priority(task_id),
+                    submit_ns=time.monotonic_ns())
+                self._jobs[job.query_id] = job
+                self._task_tenant[task_id] = tenant
+                self._sched.enqueue(job, self._running)
+                self._stat(tenant, "admitted")
+                _obs.record_server_admit(tenant, job.query,
+                                         job.query_id,
+                                         queued_total + 1)
+                self._publish_gauges_locked(
+                    tenant,
+                    bytes_for={tenant: tenant_bytes}
+                    if tenant_bytes is not None else {})
+                self._work.notify()
+                return job.query_id
+        except ServerOverloaded as e:
+            with self._lock:   # _tenant_stats writes stay serialized
+                self._stat(tenant, "rejected")
+            _obs.record_server_reject(tenant, str(query), e.reason,
+                                      e.retry_after_s)
+            raise
+
+    # -------------------------------------------------------------- queries
+
+    def poll(self, query_id: str,
+             timeout_s: Optional[float] = None) -> dict:
+        job = self._jobs.get(query_id)
+        if job is None:
+            return {"query_id": query_id, "state": "unknown"}
+        if timeout_s is not None:
+            job.done_event.wait(timeout_s)
+        with self._lock:
+            return job.status()
+
+    def wait(self, query_id: str, timeout_s: float = 60.0) -> dict:
+        """Poll that blocks until the job leaves the queue/run states
+        (or the timeout passes)."""
+        return self.poll(query_id, timeout_s=timeout_s)
+
+    def cancel(self, query_id: str) -> bool:
+        """Cancel a query: queued jobs unwind immediately; running
+        jobs get their cooperative flag set (runners that poll it stop
+        early; a non-cooperative runner's result is discarded)."""
+        with self._work:
+            job = self._jobs.get(query_id)
+            if job is None or job.done_event.is_set():
+                return False
+            job.cancel_event.set()
+            if job.state == STATE_QUEUED and self._sched.remove(job):
+                self._finalize_locked(job, STATE_CANCELLED,
+                                      outcome="cancelled")
+            _obs.JOURNAL.emit("server_cancel", tenant=job.tenant,
+                              query_id=query_id, state=job.state)
+            return True
+
+    def stats(self) -> dict:
+        # ledger fold outside the server lock (see submit)
+        ledger_map = (None if self._device_bytes_fn is not None
+                      else self._ledger_tenant_bytes())
+        with self._lock:
+            tenants = {}
+            for tenant, st in sorted(self._tenant_stats.items()):
+                row = dict(st)
+                row["queued"] = self._sched.queued_for(tenant)
+                row["running"] = self._running.get(tenant, 0)
+                row["device_bytes"] = self._tenant_device_bytes(
+                    tenant, ledger_map)
+                q = self._admission.quota_for(tenant)
+                row["quota"] = {"max_inflight": q.max_inflight,
+                                "max_device_bytes": q.max_device_bytes,
+                                "weight": q.weight}
+                tenants[tenant] = row
+            return {
+                "config": {
+                    "max_concurrency": self.config.max_concurrency,
+                    "max_queue": self.config.max_queue,
+                    "max_requeues": self.config.max_requeues,
+                    "stall_ms": self.config.stall_ms,
+                },
+                "started": self._started,
+                "queued_total": self._sched.queued_total(),
+                "running_total": sum(self._running.values()),
+                "jobs_total": len(self._jobs),
+                "tenants": tenants,
+                "scheduler": self._sched.snapshot(),
+                # fair-share evidence satellite: the priority
+                # registry's live view rides the stats endpoint
+                "task_priority": task_priority.stats(),
+            }
+
+    # -------------------------------------------------------------- workers
+
+    def _worker_loop(self, generation: int) -> None:
+        while True:
+            with self._work:
+                job = None
+                while not self._stopping \
+                        and self._generation == generation:
+                    job = self._sched.pick(self._running,
+                                           self._admission.weight_for)
+                    if job is not None:
+                        break
+                    self._work.wait()
+                if job is None:       # stopping/orphaned, queue drained
+                    return
+                job.state = STATE_RUNNING
+                job.wait_ns = time.monotonic_ns() - job.submit_ns
+                self._running[job.tenant] = \
+                    self._running.get(job.tenant, 0) + 1
+                queue_depth = self._sched.queued_total()
+                self._publish_gauges_locked(job.tenant)
+            self._execute(job, queue_depth)
+
+    def _execute(self, job: Job, queue_depth: int) -> None:
+        cfg = self.config
+        _obs.record_server_dequeue(job.tenant, job.query_id,
+                                   job.wait_ns)
+        if cfg.stall_ms > 0 and job.wait_ns > cfg.stall_ms * 1_000_000 \
+                and _obs.FLIGHT.enabled:
+            # black box: a stalled admission is the "who is hogging the
+            # device" moment — freeze the ledger with tenant
+            # attribution.  The recorder-enabled check comes FIRST:
+            # the per-tenant snapshot (server lock + full ledger
+            # fold) must not be built for a bundle that is never
+            # written
+            _obs.trigger_incident(
+                "admission_stall", severity="warn",
+                tenant=job.tenant, query_id=job.query_id,
+                queue_wait_ms=job.wait_ns // 1_000_000,
+                queue_depth=queue_depth,
+                tenant_device_bytes=self._tenant_bytes_snapshot())
+        if job.cancel_event.is_set():
+            with self._work:
+                # charge=True: the worker loop already incremented
+                # this tenant's running count — skipping the
+                # decrement would leave a phantom in-flight job that
+                # eventually wedges the tenant's admission quota
+                # (dur_ns is 0, so the vruntime charge is zero)
+                self._finalize_locked(job, STATE_CANCELLED,
+                                      outcome="cancelled",
+                                      charge=True)
+            return
+        self._register_rmm_task(job)
+        ctx = QueryContext(job.query_id, job.tenant, job.cancel_event)
+        t0 = time.monotonic_ns()
+        outcome, state, result, error = "success", STATE_DONE, None, None
+        try:
+            with _obs.TRACER.span(
+                    f"server_query:{job.query}", kind="query",
+                    attrs={"tenant": job.tenant,
+                           "query_id": job.query_id,
+                           "server_task_id": job.task_id,
+                           "demotions": job.demotions}):
+                result = self._runner(job.query, job.params, ctx)
+        except QueryCancelled:
+            outcome, state = "cancelled", STATE_CANCELLED
+        except SHED_ERRORS as e:
+            if job.cancel_event.is_set():
+                # cancel dominates: a cancelled job whose runner then
+                # tripped an OOM must report "cancelled", not a bogus
+                # quota-exhaustion failure
+                outcome, state = "cancelled", STATE_CANCELLED
+            elif job.demotions < cfg.max_requeues:
+                # the failed attempt's pool time still gets charged
+                # (in _requeue_demoted) — an OOM-ing tenant must not
+                # ride free vruntime while burning worker wall-clock
+                job.dur_ns = time.monotonic_ns() - t0
+                self._release_rmm_task(job)
+                self._requeue_demoted(job, e)
+                return
+            else:
+                outcome, state = "shed", STATE_FAILED
+                error = {"type": type(e).__name__,
+                         "message": str(e)[:300],
+                         "reason": "oom_quota_exhausted"}
+        except BaseException as e:  # noqa: BLE001 — job isolation:
+            # one tenant's bug must never take the pool thread down
+            if job.cancel_event.is_set():
+                outcome, state = "cancelled", STATE_CANCELLED
+            else:
+                outcome, state = "failed", STATE_FAILED
+                error = {"type": type(e).__name__,
+                         "message": str(e)[:300]}
+        job.dur_ns = time.monotonic_ns() - t0
+        # (a cancel racing the finish is rechecked inside
+        # _finalize_locked, under the lock)
+        self._release_rmm_task(job)
+        with self._work:
+            self._finalize_locked(job, state, outcome=outcome,
+                                  result=result, error=error,
+                                  charge=True)
+        # the byte-gauge refresh pays a full memory-ledger fold (the
+        # adaptor lock) — run it AFTER the server lock is released,
+        # like the stall-trigger snapshot, and only for tenants whose
+        # bytes anyone tracks
+        if self._bytes_tracked(job.tenant):
+            _obs.set_server_tenant_gauges(
+                {}, {}, {},
+                {job.tenant: self._tenant_device_bytes(job.tenant)})
+
+    def _requeue_demoted(self, job: Job, cause: BaseException) -> None:
+        """Load-shed: release the attempt's priority and re-register —
+        the re-registered id gets a strictly LOWER priority (newer
+        value, see task_priority.py docs) — then back of the queue."""
+        task_priority.task_done(job.task_id)
+        job.demotions += 1
+        job.priority = task_priority.get_task_priority(job.task_id)
+        job.state = STATE_QUEUED
+        job.submit_ns = time.monotonic_ns()
+        _obs.record_server_requeue(job.tenant, job.query_id,
+                                   type(cause).__name__, job.demotions)
+        with self._work:
+            self._stat(job.tenant, "requeued")
+            self._dec_running(job.tenant)
+            # charge the burned attempt now; the job's clock restarts
+            # for the next attempt (each attempt is charged once)
+            self._sched.charge(job.tenant, job.dur_ns / 1e9,
+                               self._admission.weight_for(job.tenant))
+            job.dur_ns = 0
+            if self._stopping:
+                # stop() already drained the queue; a job demoted
+                # mid-shutdown must not be stranded in it forever
+                self._finalize_locked(job, STATE_CANCELLED,
+                                      outcome="cancelled")
+                return
+            self._sched.enqueue(job, self._running)
+            self._publish_gauges_locked(job.tenant)
+            self._work.notify()
+
+    def _dec_running(self, tenant: str) -> None:
+        """Decrement, DELETING the zero entry — a resident server
+        must not keep one dict row per tenant name ever seen."""
+        n = self._running.get(tenant, 0) - 1
+        if n > 0:
+            self._running[tenant] = n
+        else:
+            self._running.pop(tenant, None)
+
+    def _finalize_locked(self, job: Job, state: str, *, outcome: str,
+                         result=None, error=None,
+                         charge: bool = False) -> None:
+        """Terminal transition; caller holds the lock."""
+        if state == STATE_DONE and job.cancel_event.is_set():
+            # the racing-cancel recheck must happen UNDER the lock:
+            # cancel() returning True guarantees the result is
+            # discarded, even when the flag landed between the
+            # worker's last check and this finalize
+            state, outcome, result = STATE_CANCELLED, "cancelled", None
+        if charge:
+            self._dec_running(job.tenant)
+            self._sched.charge(job.tenant, job.dur_ns / 1e9,
+                               self._admission.weight_for(job.tenant))
+        job.state = state
+        job.result = result
+        job.error = error
+        self._task_tenant.pop(job.task_id, None)
+        task_priority.task_done(job.task_id)
+        self._stat(job.tenant, outcome)
+        _obs.record_server_complete(job.tenant, job.query,
+                                    job.query_id, outcome, job.dur_ns,
+                                    job.wait_ns)
+        self._publish_gauges_locked(job.tenant)  # bytes refresh
+        #                          outside the lock (_execute's tail)
+        self._finished.append(job.query_id)
+        while len(self._finished) > max(self.config.finished_keep, 1):
+            self._jobs.pop(self._finished.popleft(), None)
+        job.done_event.set()
+
+    # ------------------------------------------------------- rmm plumbing
+
+    def _register_rmm_task(self, job: Job) -> None:
+        """Register this pool thread with the OOM state machine as a
+        distinct task, so tenants arbitrate device memory exactly like
+        competing Spark tasks.  No-op without an installed adaptor."""
+        from spark_rapids_tpu.memory import rmm_spark
+        if rmm_spark.installed_adaptor() is None:
+            return
+        try:
+            rmm_spark.pool_thread_working_on_tasks(
+                False, rmm_spark.current_thread_id(), [job.task_id])
+        except Exception:
+            pass   # adaptor torn down mid-flight: run unregistered
+
+    def _release_rmm_task(self, job: Job) -> None:
+        from spark_rapids_tpu.memory import rmm_spark
+        if rmm_spark.installed_adaptor() is None:
+            return
+        try:
+            rmm_spark.pool_thread_finished_for_tasks(
+                rmm_spark.current_thread_id(), [job.task_id])
+            rmm_spark.task_done(job.task_id)
+        except Exception:
+            pass
+
+    # ----------------------------------------------------------- accounting
+
+    # bounded per-tenant accounting: a socket client looping fresh
+    # tenant strings (every one of which reaches _stat, rejected or
+    # not) must not grow resident state or per-transition gauge work
+    # without limit — past the cap, new tenants fold into one
+    # "__other__" row, the metrics registry's bounded-labels rule
+    _MAX_TENANT_ROWS = 256
+    _OTHER = "__other__"
+
+    def _stat(self, tenant: str, key: str) -> None:
+        if tenant not in self._tenant_stats \
+                and len(self._tenant_stats) >= self._MAX_TENANT_ROWS:
+            tenant = self._OTHER
+        row = self._tenant_stats.setdefault(tenant, {
+            "admitted": 0, "rejected": 0, "requeued": 0, "success": 0,
+            "failed": 0, "cancelled": 0, "shed": 0})
+        row[key] = row.get(key, 0) + 1
+
+    def _bytes_tracked(self, tenant: str) -> bool:
+        """Whether anyone pays attention to this tenant's device
+        bytes: a byte quota is set, or a custom fold is injected.
+        Untracked tenants skip the memory-ledger fold entirely."""
+        return (self._device_bytes_fn is not None
+                or self._admission.quota_for(tenant).max_device_bytes
+                > 0)
+
+    def _ledger_tenant_bytes(self) -> Dict[str, int]:
+        """ONE memory-ledger fold → tenant -> held device bytes for
+        live server tasks (PR-5 ledger).  Callers that need several
+        tenants reuse the map instead of re-folding per tenant."""
+        from spark_rapids_tpu.memory import rmm_spark
+        out: Dict[str, int] = {}
+        adaptor = rmm_spark.installed_adaptor()
+        if adaptor is None:
+            return out
+        ledger = adaptor.memory_ledger(timeline=0)
+        for task_str, row in (ledger.get("tasks") or {}).items():
+            try:
+                owner = self._task_tenant.get(int(task_str))
+            except ValueError:
+                continue
+            if owner is not None:
+                out[owner] = (out.get(owner, 0)
+                              + max(int(row.get("active_bytes", 0)),
+                                    0))
+        return out
+
+    def _tenant_device_bytes(self, tenant: str,
+                             ledger_map: Optional[Dict[str, int]]
+                             = None) -> int:
+        """Device bytes currently attributed to the tenant's live
+        server tasks."""
+        if self._device_bytes_fn is not None:
+            return int(self._device_bytes_fn(tenant))
+        if ledger_map is None:
+            ledger_map = self._ledger_tenant_bytes()
+        return ledger_map.get(tenant, 0)
+
+    def _tenant_bytes_snapshot(self) -> Dict[str, int]:
+        # ledger fold outside the server lock (see submit)
+        ledger_map = (None if self._device_bytes_fn is not None
+                      else self._ledger_tenant_bytes())
+        with self._lock:
+            tenants = sorted(set(self._task_tenant.values())
+                             | set(self._tenant_stats))
+        return {t: self._tenant_device_bytes(t, ledger_map)
+                for t in tenants}
+
+    def _publish_gauges_locked(self, tenant: str,
+                               bytes_for: Optional[dict] = None) -> None:
+        """Refresh ONE tenant's gauges — per-transition gauge work
+        must not scale with every tenant the server ever saw."""
+        _obs.set_server_tenant_gauges(
+            queued={tenant: self._sched.queued_for(tenant)},
+            running={tenant: self._running.get(tenant, 0)},
+            deficit={tenant:
+                     self._sched.deficit().get(tenant, 0.0)},
+            device_bytes=bytes_for or {})

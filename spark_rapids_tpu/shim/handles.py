@@ -5,13 +5,23 @@ round-trip them without marshalling data.
 
 Mirrors the reference ownership rules: every handle returned to the
 caller must be released exactly once (ColumnVector.close); leaks are
-observable via live_count for tests/sanitizers."""
+observable via live_count for tests/sanitizers.
+
+Concurrency contract (audited for the multi-tenant query server,
+ISSUE 6): every operation holds the registry lock, ids are issued by
+a monotonically increasing counter and NEVER reused, and releasing a
+handle twice (or releasing a handle that never existed) raises
+``ValueError`` cleanly without touching any other entry — concurrent
+callers can race register/get/release freely and the worst outcome is
+that typed error on the loser."""
 
 from __future__ import annotations
 
 import itertools
 import threading
 from typing import Any, Dict, Optional
+
+_MISSING = object()   # registered objects may legitimately be falsy
 
 
 class HandleRegistry:
@@ -33,11 +43,20 @@ class HandleRegistry:
             except KeyError:
                 raise ValueError(f"invalid or released handle {handle}")
 
-    def release(self, handle: int) -> None:
+    def release(self, handle: int) -> Any:
+        """Release exactly once; returns the released object so
+        callers can run post-release cleanup on it.  A second release
+        of the same handle raises — it never corrupts the table."""
         with self._lock:
-            if self._objects.pop(handle, None) is None:
+            obj = self._objects.pop(handle, _MISSING)
+            if obj is _MISSING:
                 raise ValueError(
                     f"double release or invalid handle {handle}")
+            return obj
+
+    def is_live(self, handle: int) -> bool:
+        with self._lock:
+            return handle in self._objects
 
     def live_count(self) -> int:
         with self._lock:

@@ -345,6 +345,106 @@ def jit_cache_clear(reset_stats: bool = False) -> int:
     return jit_cache.CACHE.clear(reset_stats=bool(reset_stats))
 
 
+# --------------------------------------------------------- query server
+# (the resident multi-tenant front door, server/: the JVM starts the
+# pool once per executor, then every Spark task thread submits through
+# these flat entries; backpressure crosses as a JSON error payload so
+# the binding needs no exception-class plumbing)
+
+
+def server_start(max_concurrency: int = 0, max_queue: int = 0,
+                 socket_path: str = "") -> bool:
+    """Start the process-global query server (idempotent; returns
+    True when this call started it).  Zero values take the
+    SPARK_RAPIDS_TPU_SERVER_* env defaults."""
+    from spark_rapids_tpu import server as srv
+    cfg = srv.ServerConfig.from_env()
+    if max_concurrency > 0:
+        cfg.max_concurrency = int(max_concurrency)
+    if max_queue > 0:
+        cfg.max_queue = int(max_queue)
+    # created-flag decided under the singleton lock: two racing JVM
+    # threads cannot both be told they started the server
+    _server, created = srv.ensure_server(
+        cfg, socket_path=socket_path or None)
+    return created
+
+
+def server_stop() -> None:
+    from spark_rapids_tpu import server as srv
+    srv.stop_server()
+
+
+def server_set_tenant_quota(tenant: str, max_inflight: int = -1,
+                            max_device_bytes: int = -1,
+                            weight: float = -1.0) -> None:
+    from spark_rapids_tpu import server as srv
+    s = srv.get_server()
+    if s is None:
+        raise RuntimeError("query server is not running")
+    s.set_tenant_quota(str(tenant), max_inflight=int(max_inflight),
+                       max_device_bytes=int(max_device_bytes),
+                       weight=float(weight))
+
+
+def server_submit(tenant: str, query: str,
+                  params_json: str = "") -> str:
+    """Submit; returns JSON — {"ok": true, "query_id": ...} or the
+    typed backpressure payload {"ok": false, "error": {...,
+    "reason": "queue_full"|...}}."""
+    import json
+
+    from spark_rapids_tpu import server as srv
+    from spark_rapids_tpu.models import UnknownQueryError
+    s = srv.get_server()
+    if s is None:
+        raise RuntimeError("query server is not running")
+    params = json.loads(params_json) if params_json else {}
+    try:
+        qid = s.submit(str(tenant), str(query), params)
+        return json.dumps({"ok": True, "query_id": qid})
+    except srv.ServerOverloaded as e:
+        return json.dumps({"ok": False, "error": e.to_dict()})
+    except UnknownQueryError as e:
+        return json.dumps({"ok": False,
+                           "error": {"type": "UnknownQuery",
+                                     "message": str(e)}})
+
+
+def server_poll(query_id: str, timeout_s: float = -1.0) -> str:
+    """Job status as JSON (state queued|running|done|failed|cancelled
+    |unknown, result when done, typed error when failed)."""
+    import json
+
+    from spark_rapids_tpu import server as srv
+    s = srv.get_server()
+    if s is None:
+        raise RuntimeError("query server is not running")
+    return json.dumps(s.poll(
+        str(query_id),
+        timeout_s=float(timeout_s) if timeout_s >= 0 else None))
+
+
+def server_cancel(query_id: str) -> bool:
+    from spark_rapids_tpu import server as srv
+    s = srv.get_server()
+    if s is None:
+        return False
+    return s.cancel(str(query_id))
+
+
+def server_stats_json() -> str:
+    """Per-tenant accounting + scheduler fair-share evidence + the
+    task-priority registry snapshot, as JSON."""
+    import json
+
+    from spark_rapids_tpu import server as srv
+    s = srv.get_server()
+    if s is None:
+        return json.dumps({"started": False})
+    return json.dumps(s.stats(), sort_keys=True)
+
+
 # ------------------------------------------------------------ kudo crc
 
 

@@ -18,6 +18,7 @@ scripts/gen_java_classes.py.
 
 from __future__ import annotations
 
+import threading
 from typing import List, Optional, Sequence
 
 _INITIALIZED = False
@@ -63,8 +64,19 @@ def initialize() -> None:
 
 
 def shutdown() -> None:
+    import sys
+
     from spark_rapids_tpu.shim.handles import REGISTRY
     from spark_rapids_tpu.utils.profiler import Profiler
+    # stop the query server first (its pool threads hold handles);
+    # sys.modules check: shutdown must not IMPORT the server package
+    # into a process that never used it
+    srv = sys.modules.get("spark_rapids_tpu.server")
+    if srv is not None:
+        try:
+            srv.stop_server(timeout_s=5)
+        except Exception:
+            pass
     _KUDO_WRITE_CACHE.clear()
     REGISTRY.clear()
     _HOST_TABLES.clear()   # spilled buffers are handles too
@@ -176,9 +188,15 @@ def string_column_offsets(handle: int) -> bytes:
 
 
 def free(handle: int) -> None:
+    """Release a column handle (exactly once — a double free raises
+    ``ValueError`` from the registry without corrupting the table).
+    Release happens FIRST: once it succeeds this caller owns the
+    cleanup, and a concurrent ``kudo_write`` can no longer resolve the
+    handle, so it cannot re-insert a memo entry for freed columns
+    after the purge below (the purge-first order had that race)."""
     from spark_rapids_tpu.shim import jni_api
-    _kudo_cache_purge(handle)
     jni_api.release_column(handle)
+    _kudo_cache_purge(handle)
 
 
 def gather(values_handle: int, indices_handle: int) -> int:
@@ -810,11 +828,71 @@ def kudo_crc_enabled() -> bool:
     return jni_api.kudo_crc_enabled()
 
 
+# --------------------------------------------------------- query server
+# (primitive-only twins of jni_api's server entries)
+
+
+def server_start(max_concurrency: int = 0, max_queue: int = 0,
+                 socket_path: str = "") -> bool:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.server_start(int(max_concurrency), int(max_queue),
+                                str(socket_path))
+
+
+def server_stop() -> None:
+    from spark_rapids_tpu.shim import jni_api
+    jni_api.server_stop()
+
+
+def server_set_tenant_quota(tenant: str, max_inflight: int = -1,
+                            max_device_bytes: int = -1,
+                            weight: float = -1.0) -> None:
+    from spark_rapids_tpu.shim import jni_api
+    jni_api.server_set_tenant_quota(str(tenant), int(max_inflight),
+                                    int(max_device_bytes),
+                                    float(weight))
+
+
+def server_submit(tenant: str, query: str,
+                  params_json: str = "") -> str:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.server_submit(str(tenant), str(query),
+                                 str(params_json))
+
+
+def server_poll(query_id: str, timeout_s: float = -1.0) -> str:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.server_poll(str(query_id), float(timeout_s))
+
+
+def server_cancel(query_id: str) -> bool:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.server_cancel(str(query_id))
+
+
+def server_stats_json() -> str:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.server_stats_json()
+
+
 # --------------------------------------------------------- HostTable
+# (spilled buffers are handles too: same lock-protected allocate/free
+# discipline as the column registry — concurrent query-server callers
+# must not be able to race the id counter or double-free an entry)
 
 
 _HOST_TABLES = {}
 _HOST_TABLE_NEXT = [1]
+_HOST_TABLES_LOCK = threading.Lock()
+
+
+def _host_table_get(handle: int):
+    with _HOST_TABLES_LOCK:
+        try:
+            return _HOST_TABLES[handle]
+        except KeyError:
+            raise ValueError(
+                f"invalid or released host-table handle {handle}")
 
 
 def host_table_from_table(handles: Sequence[int]) -> int:
@@ -825,26 +903,32 @@ def host_table_from_table(handles: Sequence[int]) -> int:
     from spark_rapids_tpu.memory.host_table import HostTable
     from spark_rapids_tpu.shim import jni_api
     ht = HostTable.from_table(Table(jni_api._cols(handles)))
-    h = _HOST_TABLE_NEXT[0]
-    _HOST_TABLE_NEXT[0] += 1
-    _HOST_TABLES[h] = ht
+    with _HOST_TABLES_LOCK:
+        h = _HOST_TABLE_NEXT[0]
+        _HOST_TABLE_NEXT[0] += 1
+        _HOST_TABLES[h] = ht
     return h
 
 
 def host_table_size_bytes(handle: int) -> int:
-    return _HOST_TABLES[handle].size_bytes
+    return _host_table_get(handle).size_bytes
 
 
 def host_table_to_device(handle: int) -> List[int]:
     """HostTable.toDeviceColumnViews: upload back; returns column
     handles."""
     from spark_rapids_tpu.shim.handles import REGISTRY
-    table = _HOST_TABLES[handle].to_table()
+    table = _host_table_get(handle).to_table()
     return [REGISTRY.register(c) for c in table.columns]
 
 
 def host_table_free(handle: int) -> None:
-    _HOST_TABLES.pop(handle, None)
+    """Free exactly once; a double free raises cleanly like the
+    column registry's (HandleRegistry.release contract)."""
+    with _HOST_TABLES_LOCK:
+        if _HOST_TABLES.pop(handle, None) is None:
+            raise ValueError(
+                f"double free or invalid host-table handle {handle}")
 
 
 # ----------------------------------------------------- kudo over JNI
@@ -853,15 +937,21 @@ def host_table_free(handle: int) -> None:
 # per-handle-tuple memo for the legacy write path: partition loops
 # call kudo_write repeatedly on the SAME handles; one export serves
 # them all.  Entries are PURGED when any of their handles is released
-# (free() below) and on shutdown — the memo never outlives the
+# (free() above) and on shutdown — the memo never outlives the
 # columns' ownership (handles.py: every handle released exactly once).
+# All access is under _KUDO_CACHE_LOCK, and an insert re-validates
+# that every handle is still live: a free() racing a kudo_write can
+# therefore never park an export of already-released columns in the
+# memo (free releases FIRST, so this liveness check is authoritative).
 _KUDO_WRITE_CACHE: dict = {}
 _KUDO_WRITE_CACHE_MAX = 4
+_KUDO_CACHE_LOCK = threading.Lock()
 
 
 def _kudo_cache_purge(handle: int) -> None:
-    for key in [k for k in _KUDO_WRITE_CACHE if handle in k]:
-        del _KUDO_WRITE_CACHE[key]
+    with _KUDO_CACHE_LOCK:
+        for key in [k for k in _KUDO_WRITE_CACHE if handle in k]:
+            del _KUDO_WRITE_CACHE[key]
 
 
 def kudo_write(handles: Sequence[int], row_offset: int,
@@ -879,13 +969,22 @@ def kudo_write(handles: Sequence[int], row_offset: int,
     # KCRC trailers are a Python-engine feature: with CRC on, write AND
     # merge stay on the spec engine so the trailer round-trips
     if kudo_native.available() and not kudo.crc_enabled():
+        from spark_rapids_tpu.shim.handles import REGISTRY
         key = tuple(handles)
-        nt = _KUDO_WRITE_CACHE.get(key)
+        with _KUDO_CACHE_LOCK:
+            nt = _KUDO_WRITE_CACHE.get(key)
         if nt is None:
             nt = kudo_native.table_from_columns(cols)
-            _KUDO_WRITE_CACHE[key] = nt
-            while len(_KUDO_WRITE_CACHE) > _KUDO_WRITE_CACHE_MAX:
-                del _KUDO_WRITE_CACHE[next(iter(_KUDO_WRITE_CACHE))]
+            with _KUDO_CACHE_LOCK:
+                # only memoize while every handle is still live: a
+                # concurrent free() has already purged this key and
+                # must not have a stale export re-inserted behind it
+                if all(REGISTRY.is_live(h) for h in key):
+                    _KUDO_WRITE_CACHE[key] = nt
+                    while len(_KUDO_WRITE_CACHE) > \
+                            _KUDO_WRITE_CACHE_MAX:
+                        del _KUDO_WRITE_CACHE[
+                            next(iter(_KUDO_WRITE_CACHE))]
         return nt.write(row_offset, num_rows)
     out = io.BytesIO()
     kudo.write_to_stream(cols, out, row_offset, num_rows)

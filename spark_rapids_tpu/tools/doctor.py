@@ -247,6 +247,43 @@ def analyze(bundle: Bundle) -> List[dict]:
                         f">= threshold "
                         f"{_fmt_bytes(detail.get('threshold_bytes', 0))}"
                         f" for {detail.get('sustained_s')}s")})
+    elif kind == "admission_stall":
+        tenant = detail.get("tenant", "?")
+        findings.append({
+            "severity": 82, "kind": "admission_stall",
+            "message": (f"query server admission stalled: tenant "
+                        f"{tenant!r} query {detail.get('query_id')} "
+                        f"waited {detail.get('queue_wait_ms', 0)} ms "
+                        f"in queue (depth "
+                        f"{detail.get('queue_depth', '?')})")})
+        # name the tenant holding the device while others wait — the
+        # per-tenant byte fold frozen at trigger time, else the ledger
+        tenant_bytes = {str(t): int(b) for t, b in
+                        (detail.get("tenant_device_bytes")
+                         or {}).items() if int(b) > 0}
+        if tenant_bytes:
+            holder = max(tenant_bytes, key=lambda t: tenant_bytes[t])
+            qualifier = "the stalled tenant itself" \
+                if holder == tenant else f"while {tenant!r} waits"
+            findings.append({
+                "severity": 80, "kind": "tenant_memory",
+                "message": (f"tenant {holder!r} holds "
+                            f"{_fmt_bytes(tenant_bytes[holder])} "
+                            f"device memory ({qualifier})")})
+        else:
+            held_tasks = [(tid, row) for tid, row in sorted(
+                (bundle.ledger.get("tasks") or {}).items())
+                if row.get("active_bytes", 0) > 0]
+            if held_tasks:
+                tid, row = max(held_tasks,
+                               key=lambda kv: kv[1]["active_bytes"])
+                findings.append({
+                    "severity": 80, "kind": "tenant_memory",
+                    "message": (f"task {tid} holds "
+                                f"{_fmt_bytes(row['active_bytes'])} "
+                                f"device memory while {tenant!r} "
+                                f"admission stalls (no tenant map in "
+                                f"bundle)")})
     elif kind == "manual":
         findings.append({
             "severity": 10, "kind": "manual",

@@ -269,6 +269,95 @@ def render_retry_table(events: List[dict]) -> List[str]:
     return out
 
 
+def server_rows(events: List[dict],
+                registry: Optional[dict]) -> List[dict]:
+    """Per-(tenant, query) query-server accounting from the
+    ``server_*`` journal events, enriched with the registry's
+    per-tenant queue-wait p95 and device-byte gauges.  A row with
+    query '*' is the tenant rollup."""
+    agg: Dict[tuple, dict] = {}
+
+    def row(tenant: str, query: str) -> dict:
+        return agg.setdefault((tenant, query), {
+            "tenant": tenant, "query": query, "admitted": 0,
+            "rejected": 0, "requeued": 0, "success": 0, "failed": 0,
+            "cancelled": 0, "shed": 0, "dur_ns": 0, "wait_ns": 0})
+
+    for e in events:
+        kind = e.get("kind")
+        if kind not in ("server_admit", "server_reject",
+                        "server_requeue", "server_complete"):
+            continue
+        tenant = str(e.get("tenant", "?"))
+        query = str(e.get("query", "?"))
+        targets = [row(tenant, "*")]
+        if kind != "server_requeue":   # requeues carry no query name
+            targets.append(row(tenant, query))
+        for a in targets:
+            if kind == "server_admit":
+                a["admitted"] += 1
+            elif kind == "server_reject":
+                a["rejected"] += 1
+            elif kind == "server_requeue":
+                a["requeued"] += 1
+            elif kind == "server_complete":
+                outcome = str(e.get("outcome", "?"))
+                if outcome in a:
+                    a[outcome] += 1
+                a["dur_ns"] += int(e.get("dur_ns", 0))
+                a["wait_ns"] += int(e.get("wait_ns", 0))
+    # registry enrichment: queue-wait p95 + live gauges per tenant
+    reg = registry or {}
+    waits = reg.get("srt_server_queue_wait_ns") or {}
+    buckets = waits.get("buckets", [])
+    for s in waits.get("series", []):
+        tenant = s["labels"][0] if s.get("labels") else "?"
+        a = row(tenant, "*")
+        a["p95_wait_ns"] = histogram_quantile(
+            buckets, s.get("bucket_counts", []), 0.95)
+    for metric, field in (("srt_server_tenant_device_bytes",
+                           "device_bytes"),
+                          ("srt_server_running", "running"),
+                          ("srt_server_queued", "queued")):
+        fam = reg.get(metric) or {}
+        for s in fam.get("series", []):
+            tenant = s["labels"][0] if s.get("labels") else "?"
+            row(tenant, "*")[field] = int(s.get("value", 0))
+    return sorted(agg.values(),
+                  key=lambda a: (a["tenant"], a["query"] != "*",
+                                 a["query"]))
+
+
+def render_server_table(events: List[dict],
+                        registry: Optional[dict]) -> List[str]:
+    """Query-server tenancy table: admission outcomes, fair-share
+    wait, and held device bytes per tenant (rollup row '*') and per
+    query — the 'is anyone starved / hogging' one-pager."""
+    rows = server_rows(events, registry)
+    out = ["", "query server (per tenant / per query)", ""]
+    if not rows:
+        out.append("(no server activity recorded)")
+        return out
+    w = max(len(f"{r['tenant']}:{r['query']}") for r in rows)
+    hdr = (f"{'tenant:query':<{w}}  {'admit':>5}  {'rej':>4}  "
+           f"{'requ':>4}  {'ok':>4}  {'fail':>4}  {'cncl':>4}  "
+           f"{'shed':>4}  {'run':>3}  {'p95_wait_ms':>11}  "
+           f"{'dev_bytes':>10}")
+    out.append(hdr)
+    out.append("-" * len(hdr))
+    for r in rows:
+        name = f"{r['tenant']}:{r['query']}"
+        p95 = r.get("p95_wait_ns")
+        out.append(
+            f"{name:<{w}}  {r['admitted']:>5}  {r['rejected']:>4}  "
+            f"{r['requeued']:>4}  {r['success']:>4}  "
+            f"{r['failed']:>4}  {r['cancelled']:>4}  {r['shed']:>4}  "
+            f"{r.get('running', 0):>3}  "
+            f"{(p95 / 1e6 if p95 is not None else 0.0):>11.3f}  "
+            f"{r.get('device_bytes', 0):>10}")
+    return out
+
+
 def render_event_table(events: List[dict]) -> List[str]:
     counts: Dict[str, int] = {}
     for e in events:
@@ -309,6 +398,7 @@ def build_report(records: List[dict]) -> dict:
         "histograms": histogram_rows(registry),
         "retry_episodes": retry_episode_rows(events),
         "jit_cache": jit_cache_rows(registry),
+        "server": server_rows(events, registry),
     }
 
 
@@ -334,6 +424,9 @@ def main(argv=None) -> int:
         lines.append("(no task_rollup records in input)")
     lines += render_event_table(events)
     lines += render_retry_table(events)
+    if any(e.get("kind", "").startswith("server_") for e in events) \
+            or (registry or {}).get("srt_server_queue_wait_ns"):
+        lines += render_server_table(events, registry)
     if registry is not None:
         lines += render_jit_cache_table(registry)
         lines += render_histogram_table(registry)

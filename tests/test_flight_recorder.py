@@ -196,7 +196,12 @@ def test_byte_budget_suppresses(tmp_path):
 
 
 def test_byte_budget_counts_existing_bundles(tmp_path):
-    rec, clock, _ = make_recorder(tmp_path, max_bytes=16 << 10)
+    # budget must comfortably fit ONE bundle (the registry snapshot
+    # inside metrics.json grows as instrument families are added —
+    # the PR-6 srt_server_* families pushed a polluted-ring bundle
+    # past the old 16 KiB), while the restart below shrinks it to
+    # exactly the first bundle's size to prove cross-restart counting
+    rec, clock, _ = make_recorder(tmp_path, max_bytes=32 << 10)
     first = rec.trigger("a")
     assert first is not None
     used = json.load(open(os.path.join(
