@@ -5,8 +5,8 @@ PY ?= python
 
 .PHONY: test fuzz native sanitizers bench bench-all dryrun tpu-lower \
         jni-test kudo-bench metrics-smoke trace-smoke chaos-smoke \
-        perf-smoke doctor-smoke server-smoke nightly-artifacts ci \
-        ci-nightly clean
+        perf-smoke doctor-smoke server-smoke lifeguard-smoke \
+        nightly-artifacts ci ci-nightly clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -92,6 +92,17 @@ doctor-smoke:
 server-smoke:
 	$(PY) scripts/server_soak.py
 
+# query-lifeguard gate: under an injected hang + forced OOM
+# exhaustion, the poison (tenant, query, schema-digest) signature must
+# be quarantined (typed refusal) while 8+ interleaved neighbor queries
+# finish byte-identical to serial; the hang must freeze a query_hang
+# flight-recorder bundle that srt-doctor can triage (hung query + op +
+# quarantined signature); server_drain must finish in-flight work,
+# refuse new submits typed, flush via dumpio, and a restart must serve
+# same-bucket batches with zero new jit-cache compiles
+lifeguard-smoke:
+	$(PY) scripts/lifeguard_smoke.py
+
 # NOTE: jax.config.update, not the env var — this image's sitecustomize
 # pre-imports jax with the axon backend, so JAX_PLATFORMS=cpu is too
 # late.  XLA_FLAGS still works (read at backend init, which happens
@@ -113,7 +124,8 @@ dryrun:
 # (default 1500s) before emitting the CPU-fallback line — export
 # BENCH_FIGHT_SECONDS=1 for a quick local run.
 ci: test fuzz native sanitizers tpu-lower jni-test dryrun metrics-smoke \
-    trace-smoke chaos-smoke perf-smoke doctor-smoke server-smoke
+    trace-smoke chaos-smoke perf-smoke doctor-smoke server-smoke \
+    lifeguard-smoke
 	$(PY) bench.py
 	@echo "ci: all gates green"
 

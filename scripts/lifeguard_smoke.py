@@ -1,0 +1,328 @@
+"""Query-lifeguard gate (`make lifeguard-smoke`, ISSUE 7 acceptance):
+under an injected hang AND forced OOM exhaustion, the resident server
+must evict the misbehaving query without touching its neighbors —
+
+  * a poison (tenant, query, schema-digest) signature that dies twice
+    (once OOM-exhausted through the retry drivers, once HUNG past the
+    hang threshold) is quarantined: the next submit answers the typed
+    ``ServerOverloaded{reason="quarantined", retry_after_s}`` refusal,
+  * the hang freezes a ``query_hang`` flight-recorder bundle and
+    ``srt-doctor`` names the hung query, the op it was stuck in, and
+    the quarantined signature,
+  * 8+ interleaved queries from OTHER tenants complete byte-identical
+    to their serial runs throughout,
+  * ``server_drain`` (through the shim entries) finishes in-flight
+    work, refuses new submits with a typed ``draining`` error, flushes
+    journal/spans/metrics via dumpio, and a restarted server serves
+    the same-bucket batch with ZERO new jit-cache compiles.
+
+Exits non-zero on the first missing signal."""
+
+import hashlib
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("SPARK_RAPIDS_TPU_JIT_CACHE", "1")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+
+# eight interleaved queries from tenants that must ride out the chaos
+MIX = [
+    ("alpha", "tpcds_q9", {"rows": 1024, "seed": 1}),
+    ("alpha", "tpcds_q3", {"rows": 1024, "seed": 31}),
+    ("bravo", "tpcds_q9", {"rows": 1024, "seed": 2}),
+    ("bravo", "tpcds_q7", {"rows": 1024, "items": 64, "seed": 51}),
+    ("charlie", "tpcds_q9", {"rows": 1024, "seed": 3}),
+    ("charlie", "tpcds_q3", {"rows": 1024, "seed": 32}),
+    ("delta", "tpcds_q7", {"rows": 1024, "items": 64, "seed": 52}),
+    ("delta", "tpcds_q9", {"rows": 1024, "seed": 4}),
+]
+
+
+def fail(msg: str) -> "NoReturn":  # noqa: F821
+    print(f"lifeguard-smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def say(msg: str) -> None:
+    print(f"lifeguard-smoke: {msg}")
+
+
+def _rowconv_table(rows: int, seed: int):
+    from spark_rapids_tpu.columns import dtypes
+    from spark_rapids_tpu.columns.column import Column
+    from spark_rapids_tpu.columns.table import Table
+    rng = np.random.default_rng(seed)
+    cols = [
+        Column.from_numpy(
+            rng.integers(-1 << 40, 1 << 40, rows).astype(np.int64),
+            dtype=dtypes.INT64),
+        Column.from_numpy(rng.normal(size=rows), dtype=dtypes.FLOAT64),
+        Column.from_numpy(
+            rng.integers(-1 << 20, 1 << 20, rows).astype(np.int32),
+            validity=rng.integers(0, 2, rows), dtype=dtypes.INT32),
+    ]
+    return Table(cols)
+
+
+def _run_rowconv(params, ctx):
+    """Catalog query over the jit-cache-backed row-conversion path:
+    deterministic per params, digestable for byte-identity, and the
+    restart-warm probe (same bucket => zero new compiles)."""
+    from spark_rapids_tpu.ops import row_conversion as RC
+    ctx.check_cancel()
+    rows = int(params.get("rows", 4096))
+    seed = int(params.get("seed", 7))
+    out = RC.convert_to_rows(_rowconv_table(rows, seed))
+    data = np.asarray(out.children[0].data)
+    return [int(rows),
+            hashlib.sha256(data.tobytes()).hexdigest()]
+
+
+def main() -> int:  # noqa: C901 — one linear gate script
+    t_start = time.monotonic()
+    from spark_rapids_tpu import models
+    from spark_rapids_tpu import observability as obs
+    from spark_rapids_tpu import server as srv
+    from spark_rapids_tpu.memory import rmm_spark
+    from spark_rapids_tpu.perf.jit_cache import CACHE, bucket_rows
+    from spark_rapids_tpu.robustness import retry as R
+    from spark_rapids_tpu.server import QueryServer, ServerConfig
+    from spark_rapids_tpu.server.admission import ServerOverloaded
+    from spark_rapids_tpu.shim import jni_entry as J
+    from spark_rapids_tpu.tools import doctor
+    from spark_rapids_tpu.utils import fault_injection as fi
+
+    tmp = tempfile.mkdtemp(prefix="lifeguard_smoke_")
+    incidents = os.path.join(tmp, "incidents")
+    drain_dir = os.path.join(tmp, "drain")
+
+    models.register_query("lg_rowconv", _run_rowconv)
+
+    hang_release = threading.Event()
+    poison_mode = {"n": 0}
+
+    def _poison(params, ctx):
+        n = poison_mode["n"] = poison_mode["n"] + 1
+        if n <= 2:
+            # death 1 (and the shed re-attempt): forced OOMs from the
+            # fault injector exhaust the retry driver's budget
+            def _section():
+                return 1
+            return R.with_retry(
+                _section, name="lg_poison_section",
+                policy=R.RetryPolicy(max_attempts=2,
+                                     base_backoff_s=0.0))
+        # death 2: HANG — no heartbeat, no cancel polling
+        hang_release.wait(60)
+        return ["late"]
+
+    models.register_query("lg_poison", _poison)
+
+    # ---- serial baselines (fault-free, metrics off) ----------------
+    fi.uninstall()
+    obs.disable()
+    obs.disable_tracing()
+    serial = [models.run_catalog_query(q, dict(p))
+              for _t, q, p in MIX]
+    # also pre-compile the rowconv bucket here so the in-server runs
+    # below are pure cache hits (and give the restart-warm baseline)
+    rowconv_serial = models.run_catalog_query(
+        "lg_rowconv", {"rows": 4096, "seed": 7})
+    say(f"serial baseline: {len(serial)} tenant queries + rowconv")
+
+    # ---- chaos phase ----------------------------------------------
+    obs.enable()
+    obs.enable_tracing()
+    obs.reset()
+    obs.enable_flight_recorder(out_dir=incidents, min_interval_s=0.0)
+    rmm_spark.clear_event_handler()
+    rmm_spark.set_event_handler(256 << 20)
+    cfg_path = os.path.join(tmp, "faults.json")
+    with open(cfg_path, "w") as f:
+        json.dump({"seed": 7, "faults": [
+            {"match": "lg_poison_section",
+             "exception": "GpuRetryOOM", "repeat": 99}]}, f)
+    fi.install(cfg_path, watch=False)
+
+    server = QueryServer(ServerConfig(
+        max_concurrency=3, max_queue=32, stall_ms=0, max_requeues=1,
+        hang_s=1.0, watchdog_interval_s=0.05,
+        quarantine_failures=2, quarantine_cooldown_s=30.0)).start()
+    poison_sig = None
+    try:
+        ids = [(server.submit(t, q, dict(p)), i)
+               for i, (t, q, p) in enumerate(MIX)]
+        say(f"submitted {len(ids)} interleaved queries from 4 tenants")
+
+        # death 1: OOM exhaustion (shed after one demotion)
+        p1 = server.submit("mallory", "lg_poison", {"rows": 64})
+        r1 = server.poll(p1, timeout_s=120)
+        if r1["state"] != "failed" or r1.get("error", {}).get(
+                "reason") != "oom_quota_exhausted":
+            fail(f"poison death 1 should shed on OOM exhaustion: {r1}")
+        say("poison death 1: OOM-exhausted (typed shed)")
+
+        # death 2: hang -> watchdog eviction -> quarantine opens
+        p2 = server.submit("mallory", "lg_poison", {"rows": 64})
+        r2 = server.poll(p2, timeout_s=120)
+        if r2["state"] != "failed" or r2.get("error", {}).get(
+                "type") != "QueryHung":
+            fail(f"poison death 2 should be evicted as hung: {r2}")
+        poison_sig = server._jobs[p2].signature
+        say(f"poison death 2: hung, evicted by the watchdog "
+            f"(signature {poison_sig})")
+
+        # quarantined: typed refusal with a retry-after hint
+        try:
+            server.submit("mallory", "lg_poison", {"rows": 64})
+            fail("third poison submit was admitted — quarantine "
+                 "never opened")
+        except ServerOverloaded as e:
+            if e.reason != "quarantined":
+                fail(f"wrong refusal reason {e.reason!r}")
+            if e.retry_after_s <= 0:
+                fail("quarantine refusal carried no retry-after hint")
+        say("poison quarantined: typed ServerOverloaded"
+            "{reason=quarantined}")
+
+        # jit-warm probe through the server (also the drain-restart
+        # baseline): populates the row-conversion bucket
+        warm = server.submit("echo", "lg_rowconv",
+                             {"rows": 4096, "seed": 7})
+        warm_result = server.poll(warm, timeout_s=300)
+        if warm_result["state"] != "done":
+            fail(f"rowconv warm query failed: {warm_result}")
+        if warm_result["result"] != rowconv_serial:
+            fail("in-server rowconv diverged from its serial run")
+
+        # neighbors: byte-identical to serial, every tenant finishes
+        for qid, i in ids:
+            r = server.poll(qid, timeout_s=300)
+            if r["state"] != "done":
+                fail(f"{MIX[i]} finished {r['state']}: "
+                     f"{r.get('error')}")
+            if r["result"] != serial[i]:
+                fail(f"{MIX[i]} diverged from its serial run")
+        say("all 8 interleaved tenant queries byte-identical to "
+            "serial despite the hang + forced OOMs")
+    finally:
+        hang_release.set()
+        server.stop()
+        fi.uninstall()
+
+    # ---- query_hang bundle + doctor --------------------------------
+    bundles = [b for b in doctor.find_bundles(incidents)
+               if doctor.Bundle(b).trigger.get("kind") == "query_hang"]
+    if not bundles:
+        fail("no query_hang flight-recorder bundle was written")
+    b = doctor.Bundle(bundles[-1])
+    detail = b.trigger.get("detail") or {}
+    if detail.get("query") != "lg_poison":
+        fail(f"bundle does not name the hung query: {detail}")
+    if not (detail.get("quarantine") or {}).get("quarantined"):
+        fail("bundle's quarantine detail does not show the open "
+             "circuit")
+    findings = doctor.analyze(b)
+    text = "\n".join(doctor.render(b, findings))
+    if "lg_poison" not in text:
+        fail("srt-doctor does not name the hung query")
+    if poison_sig not in text:
+        fail("srt-doctor does not name the quarantined signature")
+    kinds = {f["kind"] for f in findings}
+    if "query_hang" not in kinds or "poison_query" not in kinds:
+        fail(f"doctor findings missing lifeguard kinds: {kinds}")
+    say(f"srt-doctor names the hung query + quarantined signature "
+        f"({os.path.basename(b.path)})")
+
+    # ---- drain + warm restart through the shim ---------------------
+    os.environ["SPARK_RAPIDS_TPU_SERVER_DRAIN_DIR"] = drain_dir
+    if not J.server_start(max_concurrency=2, max_queue=16):
+        fail("shim server_start did not start a fresh server")
+    slow_gate = threading.Event()
+
+    def _slow(params, ctx):
+        while not slow_gate.wait(0.02):
+            ctx.check_cancel()
+        return ["slow-done"]
+
+    models.register_query("lg_slow", _slow)
+    sub = json.loads(J.server_submit("echo", "lg_slow", "{}"))
+    if not sub.get("ok"):
+        fail(f"pre-drain submit refused: {sub}")
+    st = srv.get_server()
+    report_box = {}
+
+    def _drain():
+        report_box["r"] = json.loads(J.server_drain(30.0))
+
+    dr = threading.Thread(target=_drain)
+    dr.start()
+    deadline = time.monotonic() + 10
+    while not st._draining and time.monotonic() < deadline:
+        time.sleep(0.01)
+    late = json.loads(J.server_submit("echo", "lg_rowconv", "{}"))
+    if late.get("ok") or late["error"].get("reason") != "draining":
+        fail(f"submit during drain was not refused typed: {late}")
+    slow_gate.set()
+    dr.join(60)
+    report = report_box.get("r") or {}
+    if report.get("state") != "drained" or report.get("completed", 0) < 1:
+        fail(f"drain report wrong: {report}")
+    if report.get("abandoned", 0) or report.get("cancelled", 0):
+        fail(f"drain should have finished in-flight work: {report}")
+    flush = report.get("flush") or {}
+    for name in ("journal.jsonl", "spans.jsonl", "metrics.json"):
+        if not os.path.isfile(os.path.join(flush.get("dir", ""),
+                                           name)):
+            fail(f"drain flush missing {name}: {flush}")
+    say(f"drain: {report['completed']} in-flight finished, typed "
+        f"'draining' refusal, journal/spans/metrics flushed")
+
+    # restart: same-bucket batch must be pure jit-cache hits
+    if bucket_rows(4096) != bucket_rows(3500):
+        fail("smoke misconfigured: probe rows not in the warm bucket")
+    compiles_before = CACHE.stats()["compiles"]
+    if not J.server_start(max_concurrency=2, max_queue=16):
+        fail("server_start after drain did not start a new server")
+    sub = json.loads(J.server_submit(
+        "echo", "lg_rowconv", json.dumps({"rows": 3500, "seed": 7})))
+    if not sub.get("ok"):
+        fail(f"post-restart submit refused: {sub}")
+    post = json.loads(J.server_poll(sub["query_id"], 300.0))
+    if post.get("state") != "done":
+        fail(f"post-restart query failed: {post}")
+    compiles_after = CACHE.stats()["compiles"]
+    if compiles_after != compiles_before:
+        fail(f"restart recompiled {compiles_after - compiles_before} "
+             f"executable(s); the jit cache should have stayed warm")
+    say("restart served the same-bucket batch with ZERO new "
+        "jit-cache compiles")
+
+    J.server_stop()
+    models.unregister_query("lg_poison")
+    models.unregister_query("lg_slow")
+    models.unregister_query("lg_rowconv")
+    rmm_spark.clear_event_handler()
+    obs.disable_flight_recorder()
+    obs.disable_tracing()
+    obs.disable()
+    os.environ.pop("SPARK_RAPIDS_TPU_SERVER_DRAIN_DIR", None)
+    print(f"lifeguard-smoke: OK ({time.monotonic() - t_start:.1f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -134,6 +134,31 @@ def task_done(task_id: int):
     return ret
 
 
+def force_release_task(task_id: int) -> dict:
+    """Lifeguard entry: forcibly unwind a hung task's associations
+    (``SparkResourceAdaptor.force_release_task``) and fold its
+    counters into the observability rollup like a normal
+    ``task_done`` would."""
+    adaptor = get_adaptor()
+    info = adaptor.force_release_task(task_id)
+    if _obs.is_enabled():
+        _obs.TASKS.fold_rmm_task(
+            task_id,
+            retry_oom=adaptor.get_and_reset_num_retry_throw(task_id),
+            split_retry_oom=adaptor.get_and_reset_num_split_retry_throw(
+                task_id),
+            blocked_time_ns=adaptor.get_and_reset_block_time(task_id),
+            lost_time_ns=adaptor.get_and_reset_compute_time_lost_to_retry(
+                task_id),
+            max_device_memory=adaptor.get_and_reset_gpu_max_memory_allocated(
+                task_id))
+        adaptor.remove_task_metrics(task_id)
+        _obs.JOURNAL.emit("task_force_released", task=task_id,
+                          threads=info.get("threads", []),
+                          held_bytes=info.get("held_bytes", 0))
+    return info
+
+
 def force_retry_oom(thread_id: int, num_ooms: int = 1,
                     oom_filter: str = GPU, skip_count: int = 0):
     get_adaptor().force_retry_oom(thread_id, num_ooms, oom_filter,

@@ -341,6 +341,35 @@ class SparkResourceAdaptor:
             _obs.record_task_leak(task_id, int(leaked), holders)
         return woke_any
 
+    def force_release_task(self, task_id: int) -> dict:
+        """Watchdog entry (query lifeguard, ISSUE 7): forcibly unwind
+        a HUNG task's thread associations so its held accounting and
+        blocked neighbors unblock without waiting for the wedged
+        thread to cooperate.  Semantically ``task_done`` — blocked
+        associated threads get ``THREAD_REMOVE_THROW`` (they raise
+        ``ThreadRemovedException`` if they ever wake), running ones
+        are disassociated, waiters are woken — plus a FORCE_RELEASE
+        row in the OOM-state log so the transition timeline shows the
+        eviction was deliberate.  Returns the affected thread ids and
+        the device bytes the task still held."""
+        with self._lock:
+            affected = []
+            held = 0
+            for t in self._threads.values():
+                if t.task_id == task_id or task_id in t.pool_task_ids:
+                    affected.append(t.thread_id)
+                    held += int(t.metrics.gpu_memory_active_footprint)
+            cp = self._checkpointed.get(task_id)
+            if cp is not None:
+                held += int(cp.gpu_memory_active_footprint)
+            self._log_status(
+                "FORCE_RELEASE", affected[0] if affected else -1,
+                task_id, "WATCHDOG",
+                notes=f"threads={len(affected)} held={held}")
+        woke = self.task_done(task_id)
+        return {"task": task_id, "threads": affected,
+                "held_bytes": held, "woke_any": woke}
+
     def _checkpoint_metrics(self, t: _ThreadState):
         """Merge a thread's metrics into its task-level checkpoints."""
         task_ids = ([t.task_id] if t.task_id is not None

@@ -20,7 +20,10 @@ wherever they like.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional
+
+from spark_rapids_tpu.robustness import lifeguard as _lifeguard
 
 
 class QueryCancelled(Exception):
@@ -28,23 +31,49 @@ class QueryCancelled(Exception):
     folds it into a 'cancelled' outcome, never an error)."""
 
 
-class QueryContext:
-    """Per-execution attribution + cooperative cancellation handle."""
+class QueryDeadlineExceeded(QueryCancelled):
+    """Raised by a cooperative checkpoint once the query's deadline
+    has passed (subclass of :class:`QueryCancelled` so existing
+    runners unwind unchanged; the server reports a distinct
+    ``deadline`` outcome)."""
 
-    __slots__ = ("query_id", "tenant", "_cancel")
+
+class QueryContext:
+    """Per-execution attribution + cooperative cancellation/deadline
+    handle.  Every ``check_cancel`` poll doubles as a lifeguard
+    heartbeat — a runner that checkpoints is "slow", never "hung"."""
+
+    __slots__ = ("query_id", "tenant", "_cancel", "deadline_ns")
 
     def __init__(self, query_id: str = "", tenant: str = "",
-                 cancel_event: Optional[threading.Event] = None):
+                 cancel_event: Optional[threading.Event] = None,
+                 deadline_ns: Optional[int] = None):
         self.query_id = query_id
         self.tenant = tenant
         self._cancel = cancel_event
+        self.deadline_ns = deadline_ns
 
     def cancelled(self) -> bool:
         return self._cancel is not None and self._cancel.is_set()
 
+    def remaining_s(self) -> Optional[float]:
+        """Seconds until the deadline (negative once past), or None
+        when the query has no deadline."""
+        if self.deadline_ns is None:
+            return None
+        return (self.deadline_ns - time.monotonic_ns()) / 1e9
+
     def check_cancel(self) -> None:
+        _lifeguard.beat(f"ctx:{self.query_id or 'query'}")
+        # an explicit cancel wins over the deadline: the server keys
+        # the outcome off its cancel_reason, so a user-cancelled job
+        # whose deadline ALSO lapsed reports "cancelled", not a bogus
+        # deadline failure (which would count as a quarantine death)
         if self.cancelled():
             raise QueryCancelled(self.query_id or "query")
+        if self.deadline_ns is not None \
+                and time.monotonic_ns() > self.deadline_ns:
+            raise QueryDeadlineExceeded(self.query_id or "query")
 
 
 class UnknownQueryError(KeyError):

@@ -27,7 +27,7 @@ from __future__ import annotations
 import os
 import threading
 import time
-from typing import Optional
+from typing import Callable, Optional
 
 from spark_rapids_tpu.observability import flight_recorder as _fr
 from spark_rapids_tpu.observability.dumpio import dump_via
@@ -242,6 +242,18 @@ SERVER_QUEUE_WAIT = METRICS.histogram(
     "Admission-to-dispatch queue wait per tenant",
     labels=("tenant",), buckets=DEFAULT_LATENCY_BUCKETS_NS,
     max_series=128)
+SERVER_WATCHDOG = METRICS.counter(
+    "srt_server_watchdog_total",
+    "Query-lifeguard watchdog interventions (deadline_cancel, "
+    "deadline_expired_queued, hang_release)", labels=("action",))
+SERVER_QUARANTINE = METRICS.counter(
+    "srt_server_quarantine_total",
+    "Poison-query circuit-breaker transitions (opened, reopened, "
+    "probe, closed, rejected)", labels=("event",))
+SERVER_DRAIN = METRICS.counter(
+    "srt_server_drain_total",
+    "Query-server graceful-drain lifecycle markers (begin, end)",
+    labels=("phase",))
 
 
 # ------------------------------------------------------------------ tracer
@@ -304,17 +316,42 @@ def trigger_incident(kind: str, cause: Optional[BaseException] = None,
     attribute read when the recorder is off."""
     if not FLIGHT.enabled:
         return None
-    return FLIGHT.trigger(kind, cause=cause, severity=severity,
-                          **detail)
+    # bundle dumps take real wall time on the calling thread — beat
+    # before and after so the hung-worker watchdog never mistakes a
+    # worker busy FREEZING an incident for the incident itself
+    hook = _HEARTBEAT_HOOK
+    if hook is not None:
+        hook(f"incident:{kind}")
+    try:
+        return FLIGHT.trigger(kind, cause=cause, severity=severity,
+                              **detail)
+    finally:
+        if hook is not None:
+            hook(f"incident:{kind}")
 
 
 # ------------------------------------------------------------ record helpers
 # Called from the instrumented layers.  Each starts with the switch
 # check so a disabled run pays one attribute read.
 
+# hung-worker heartbeat seam: the lifeguard (robustness/lifeguard.py)
+# installs a callback here so every finished op bracket counts as a
+# sign of life.  A separate hook — NOT the metrics switch — because
+# hang detection must work with metrics off, and the layering rule
+# forbids this package importing robustness.
+_HEARTBEAT_HOOK: Optional[Callable[[str], None]] = None
+
+
+def set_heartbeat_hook(fn: Optional[Callable[[str], None]]) -> None:
+    global _HEARTBEAT_HOOK
+    _HEARTBEAT_HOOK = fn
+
 
 def record_op(op: str, dur_ns: int) -> None:
     """utils/profiler.op_range close hook."""
+    hook = _HEARTBEAT_HOOK
+    if hook is not None:
+        hook(op)
     if not _SWITCH.enabled:
         return
     OP_LATENCY.observe(dur_ns, labels=(op,))
@@ -439,8 +476,15 @@ def record_kudo_corruption(reason: str, *, skipped_bytes: int = 0,
 def record_jit_cache(event: str, kernel: str, *,
                      compile_ns: int = 0) -> None:
     """Compile-cache hook (perf/jit_cache.py): event in
-    {'hit', 'miss', 'eviction'}.  Misses carry the lower+compile wall
-    time observed for the new executable."""
+    {'hit', 'miss', 'eviction', 'compile_begin'}.  Misses carry the
+    lower+compile wall time observed for the new executable;
+    ``compile_begin`` marks the start of a compile and exists purely
+    as a heartbeat edge (no counter)."""
+    hook = _HEARTBEAT_HOOK
+    if hook is not None:
+        # both edges of a compile are signs of life (a long lower+
+        # compile is the classic slow-but-alive window)
+        hook(f"jit:{kernel}")
     if not _SWITCH.enabled:
         return
     if event == "hit":
@@ -541,6 +585,38 @@ def record_server_complete(tenant: str, query: str, query_id: str,
     JOURNAL.emit("server_complete", tenant=tenant, query=query,
                  query_id=query_id, outcome=outcome, dur_ns=dur_ns,
                  wait_ns=wait_ns)
+
+
+def record_server_watchdog(action: str, tenant: str, query_id: str,
+                           **extra) -> None:
+    """Lifeguard watchdog intervention: ``deadline_cancel`` (the
+    cooperative flag was fired), ``deadline_expired_queued`` (a queued
+    job's deadline passed before dispatch), ``hang_release`` (a silent
+    worker's task was force-released and the worker orphaned)."""
+    if not _SWITCH.enabled:
+        return
+    SERVER_WATCHDOG.inc(labels=(action,))
+    JOURNAL.emit("server_watchdog", action=action, tenant=tenant,
+                 query_id=query_id, **extra)
+
+
+def record_server_quarantine(event: str, tenant: str, query: str,
+                             signature: str, **extra) -> None:
+    """Poison-query circuit-breaker transition: event in {'opened',
+    'reopened', 'probe', 'closed', 'rejected'}."""
+    if not _SWITCH.enabled:
+        return
+    SERVER_QUARANTINE.inc(labels=(event,))
+    JOURNAL.emit("server_quarantine", event=event, tenant=tenant,
+                 query=query, signature=signature, **extra)
+
+
+def record_server_drain(phase: str, **extra) -> None:
+    """Graceful-drain lifecycle marker: phase in {'begin', 'end'}."""
+    if not _SWITCH.enabled:
+        return
+    SERVER_DRAIN.inc(labels=(phase,))
+    JOURNAL.emit("server_drain", phase=phase, **extra)
 
 
 def set_server_tenant_gauges(queued: dict, running: dict,

@@ -176,6 +176,7 @@ def with_capacity_retry(make_step: Callable[[int], Callable],
             t0 = pol.clock()
             attempt = 0
             lost_ns = 0
+            prev_backoff = 0.0
             while True:
                 attempt_t0 = time.monotonic_ns()
                 out = _step_for(cap)(*args)
@@ -219,7 +220,10 @@ def with_capacity_retry(make_step: Callable[[int], Callable],
                         reason="over deadline" if deadline_hit
                         else "overflowed")
                 _obs.record_exchange_doubling(cap, cap * 2, attempt - 1)
-                backoff = pol.backoff_for(attempt)
+                # thread the previous pause through so jittered
+                # policies get true decorrelated backoff (retry.py)
+                backoff = pol.backoff_for(attempt, prev_backoff)
+                prev_backoff = backoff
                 if backoff > 0:
                     pol.sleep(backoff)
                 cap *= 2

@@ -205,6 +205,12 @@ class JitCache:
         # build outside the lock: compiles can take seconds and must
         # not serialize unrelated kernels.  A racing thread may build
         # the same entry twice; last insert wins (both are correct).
+        # compile_begin marks the START too: a multi-second
+        # lower+compile is the classic slow-but-alive window, and the
+        # lifeguard's heartbeat hook must see a sign of life on BOTH
+        # edges or a first-touch compile longer than the hang
+        # threshold reads as a hung worker
+        _obs.record_jit_cache("compile_begin", name)
         t0 = time.monotonic_ns()
         fn = build()
         dt = time.monotonic_ns() - t0

@@ -13,6 +13,8 @@ compute-only sections, and metric/span folding into the
 observability spine (docs/robustness.md).
 """
 
+from spark_rapids_tpu.robustness.lifeguard import (  # noqa: F401
+    QuarantineBreaker, Watchdog)
 from spark_rapids_tpu.robustness.retry import (  # noqa: F401
     Attempt, RetryExhausted, RetryPolicy, check_injected_oom,
     halve_batch, split_and_retry, with_retry, with_retry_no_split)

@@ -34,12 +34,14 @@ the steady-state hot path stays byte-identical to the unretried one.
 
 from __future__ import annotations
 
+import random
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 from spark_rapids_tpu import observability as _obs
 from spark_rapids_tpu.memory import exceptions as exc
+from spark_rapids_tpu.robustness import lifeguard as _lifeguard
 from spark_rapids_tpu.utils import fault_injection as _fi
 
 # what the drivers recover from (reference catch set: RetryOOM,
@@ -81,25 +83,48 @@ class RetryExhausted(Exception):
 
 @dataclass
 class RetryPolicy:
-    """Bounds one episode.  ``sleep`` and ``clock`` are injectable for
-    deterministic tests; backoff is exponential from
+    """Bounds one episode.  ``sleep``, ``clock``, and ``rng`` are
+    injectable for deterministic tests; backoff is exponential from
     ``base_backoff_s`` with a cap, deadline is wall-clock over the
-    WHOLE episode (splits included)."""
+    WHOLE episode (splits included).
+
+    ``jitter=True`` (the default) applies DECORRELATED jitter: each
+    pause is drawn uniformly from ``[base, 3 * previous_pause]`` and
+    capped at ``max_backoff_s``.  Deterministic exponential backoff
+    synchronizes retry storms — N tenants OOMing off the same pressure
+    spike all come back at exactly base*2^k and collide again; jitter
+    decorrelates the herd (the AWS "decorrelated jitter" scheme).
+    Callers that cannot thread the previous pause through still get
+    jitter around the deterministic schedule."""
 
     max_attempts: int = 8
     base_backoff_s: float = 0.001
     backoff_multiplier: float = 2.0
     max_backoff_s: float = 0.25
     deadline_s: Optional[float] = None
+    jitter: bool = True
     sleep: Callable[[float], None] = time.sleep
     clock: Callable[[], float] = time.monotonic
+    rng: Callable[[], float] = field(default=random.random)
 
-    def backoff_for(self, failed_attempts: int) -> float:
+    def backoff_for(self, failed_attempts: int,
+                    prev_backoff_s: Optional[float] = None) -> float:
         if failed_attempts <= 0 or self.base_backoff_s <= 0:
             return 0.0
-        return min(self.base_backoff_s
-                   * self.backoff_multiplier ** (failed_attempts - 1),
-                   self.max_backoff_s)
+        det = min(self.base_backoff_s
+                  * self.backoff_multiplier ** (failed_attempts - 1),
+                  self.max_backoff_s)
+        if not self.jitter:
+            return det
+        # decorrelated jitter: U(base, 3*prev), capped.  Stateless
+        # callers (no prev) jitter around the deterministic value for
+        # this attempt count instead.
+        prev = (prev_backoff_s
+                if prev_backoff_s is not None and prev_backoff_s > 0
+                else det)
+        lo = self.base_backoff_s
+        hi = max(lo, 3.0 * prev)
+        return min(self.max_backoff_s, lo + (hi - lo) * self.rng())
 
 
 DEFAULT_POLICY = RetryPolicy()
@@ -132,7 +157,7 @@ class _Episode:
 
     __slots__ = ("name", "policy", "t0_ns", "t0", "attempt_t0",
                  "attempts", "history", "max_split_depth", "span",
-                 "last_exc")
+                 "last_exc", "last_backoff")
 
     def __init__(self, name: str, policy: Optional[RetryPolicy]):
         self.name = name
@@ -144,6 +169,7 @@ class _Episode:
         self.history: List[Attempt] = []
         self.max_split_depth = 0
         self.last_exc: Optional[BaseException] = None
+        self.last_backoff = 0.0
         # attach=False: the episode span must never become the traced
         # work's parent (op/query trees keep their PR-2 shape); it is
         # simply DISCARDED (never ended) when no failure happened
@@ -155,6 +181,10 @@ class _Episode:
         as this attempt's failure."""
         self.attempts += 1
         self.attempt_t0 = time.monotonic_ns()
+        # sign of life for the hung-worker watchdog: every attempt
+        # start counts, so a query grinding through a long retry
+        # episode is "slow", never "hung"
+        _lifeguard.beat(f"retry:{self.name}")
         adaptor = _installed_adaptor()
         if adaptor is not None:
             block = getattr(adaptor, "block_thread_until_ready", None)
@@ -184,7 +214,9 @@ class _Episode:
             # path
             raise self.exhausted("deadline",
                                  self.last_exc) from self.last_exc
-        backoff = pol.backoff_for(len(self.history))
+        backoff = pol.backoff_for(len(self.history),
+                                  self.last_backoff)
+        self.last_backoff = backoff
         if backoff > 0:
             pol.sleep(backoff)
 

@@ -54,7 +54,11 @@ def ensure_server(config: Optional[ServerConfig] = None,
         path = socket_path or os.environ.get(
             "SPARK_RAPIDS_TPU_SERVER_SOCKET", "")
         if path and _DOOR is None:
-            _DOOR = SocketFrontDoor(_SERVER, path).start()
+            # the door's drain op must clear the process-global
+            # singleton too, or a post-drain server_start would hand
+            # back the drained husk instead of a fresh pool
+            _DOOR = SocketFrontDoor(_SERVER, path,
+                                    drain_fn=drain_server).start()
         return _SERVER, created
 
 
@@ -79,3 +83,31 @@ def stop_server(timeout_s: float = 30.0) -> None:
         door.stop()
     if server is not None:
         server.stop(timeout_s=timeout_s)
+
+
+def drain_server(deadline_s: Optional[float] = None,
+                 flush_dir: Optional[str] = None) -> dict:
+    """Gracefully drain and release the process-global server (ISSUE
+    7): refuse new submits typed (``draining``), finish in-flight
+    work under the drain deadline, flush journal/spans/metrics via
+    dumpio, stop the pool, and clear the singleton — a subsequent
+    :func:`start_server`/``server_start`` serves again with the
+    process-wide jit cache still warm.  Returns the drain report."""
+    global _SERVER, _DOOR
+    with _LOCK:
+        server = _SERVER
+    if server is None:
+        return {"state": "not_running"}
+    report = server.drain(deadline_s=deadline_s, flush_dir=flush_dir)
+    with _LOCK:
+        if _SERVER is server:
+            _SERVER = None
+        # only tear down the door that fronts the DRAINED server: a
+        # stop_server()+start_server() racing a slow drain may have
+        # installed a fresh server + door, which must keep serving
+        door = None
+        if _DOOR is not None and _DOOR.server is server:
+            door, _DOOR = _DOOR, None
+    if door is not None:
+        door.stop()
+    return report

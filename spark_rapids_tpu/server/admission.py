@@ -31,6 +31,11 @@ REASON_QUEUE_FULL = "queue_full"
 REASON_TENANT_INFLIGHT = "tenant_inflight"
 REASON_TENANT_BYTES = "tenant_bytes"
 REASON_SHUTDOWN = "shutdown"
+# lifeguard refusals (ISSUE 7): the server is healthy, but THIS
+# submission is refused — the signature is circuit-broken, or the
+# server is gracefully draining for restart
+REASON_QUARANTINED = "quarantined"
+REASON_DRAINING = "draining"
 
 
 class ServerOverloaded(Exception):

@@ -18,6 +18,9 @@ per line, one response per line:
     {"op": "stats"}
     -> {"ok": true, "stats": {...}}
 
+    {"op": "drain", "deadline_s": 30}
+    -> {"ok": true, "report": {"state": "drained", ...}}
+
 Backpressure crosses the wire typed: a refused submit answers
 ``{"ok": false, "error": {"type": "ServerOverloaded", "reason":
 "queue_full", "retry_after_s": ...}}`` so a remote client can
@@ -32,18 +35,44 @@ import json
 import os
 import socket
 import threading
-from typing import Optional
+from typing import Callable, Optional
 
 from spark_rapids_tpu.models import UnknownQueryError
 from spark_rapids_tpu.server.admission import ServerOverloaded
 
+IDLE_ENV = "SPARK_RAPIDS_TPU_SERVER_SOCKET_IDLE_S"
+DEFAULT_IDLE_S = 120.0
+
+
+def _idle_from_env() -> float:
+    try:
+        return float(os.environ.get(IDLE_ENV, "") or DEFAULT_IDLE_S)
+    except ValueError:
+        return DEFAULT_IDLE_S
+
 
 class SocketFrontDoor:
-    """Accept loop + per-connection request threads over AF_UNIX."""
+    """Accept loop + per-connection request threads over AF_UNIX.
 
-    def __init__(self, server, path: str):
+    Connections carry a read/idle timeout (``idle_s``, env
+    ``SPARK_RAPIDS_TPU_SERVER_SOCKET_IDLE_S``, 0 disables): a
+    half-open client holding the line without completing a request —
+    or parking forever between requests — gets a typed ``IdleTimeout``
+    error and a close instead of pinning a connection thread (and its
+    read buffer) on the resident server indefinitely.
+
+    ``drain_fn`` backs the ``drain`` op; the default drains the bound
+    server instance directly, the process-global wiring passes
+    ``server.drain_server`` so the singleton is cleared too."""
+
+    def __init__(self, server, path: str,
+                 idle_s: Optional[float] = None,
+                 drain_fn: Optional[Callable] = None):
         self.server = server
         self.path = path
+        self.idle_s = _idle_from_env() if idle_s is None \
+            else float(idle_s)
+        self._drain_fn = drain_fn
         self._sock: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
         self._stopping = False
@@ -112,7 +141,8 @@ class SocketFrontDoor:
                 continue               # re-check the stop flag
             except OSError:
                 return                 # closed under us: clean stop
-            conn.settimeout(None)      # connections block normally
+            # per-connection read/idle bound (0 = block forever)
+            conn.settimeout(self.idle_s if self.idle_s > 0 else None)
             threading.Thread(target=self._serve_connection,
                              args=(conn,), daemon=True).start()
 
@@ -125,7 +155,25 @@ class SocketFrontDoor:
         try:
             with conn, conn.makefile("rwb") as f:
                 while True:
-                    line = f.readline(self.MAX_LINE + 1)
+                    try:
+                        line = f.readline(self.MAX_LINE + 1)
+                    except socket.timeout:
+                        # idle/half-open client: answer typed, then
+                        # close — the read buffer may hold a partial
+                        # line, so the framing is unrecoverable anyway
+                        try:
+                            f.write(json.dumps({
+                                "ok": False,
+                                "error": {
+                                    "type": "IdleTimeout",
+                                    "message": "no complete request "
+                                               f"within {self.idle_s}"
+                                               "s; closing"}})
+                                .encode() + b"\n")
+                            f.flush()
+                        except (OSError, ValueError):
+                            pass
+                        break
                     if not line:
                         break          # EOF: client closed
                     if len(line) > self.MAX_LINE:
@@ -166,9 +214,13 @@ class SocketFrontDoor:
                 raise ValueError("request must be a JSON object")
             op = req.get("op")
             if op == "submit":
+                deadline = req.get("deadline_s")
                 qid = self.server.submit(str(req.get("tenant", "?")),
                                          str(req.get("query", "")),
-                                         req.get("params") or {})
+                                         req.get("params") or {},
+                                         deadline_s=float(deadline)
+                                         if deadline is not None
+                                         else None)
                 return {"ok": True, "query_id": qid}
             if op == "poll":
                 timeout = req.get("timeout_s")
@@ -182,6 +234,14 @@ class SocketFrontDoor:
                     str(req.get("query_id", "")))}
             if op == "stats":
                 return {"ok": True, "stats": self.server.stats()}
+            if op == "drain":
+                deadline = req.get("deadline_s")
+                kw = {"deadline_s": float(deadline)
+                      if deadline is not None else None,
+                      "flush_dir": str(req["flush_dir"])
+                      if req.get("flush_dir") else None}
+                fn = self._drain_fn or self.server.drain
+                return {"ok": True, "report": fn(**kw)}
             return {"ok": False,
                     "error": {"type": "BadRequest",
                               "message": f"unknown op {op!r}"}}

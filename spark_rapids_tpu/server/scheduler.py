@@ -57,6 +57,14 @@ class Job:
     error: Optional[dict] = None
     wait_ns: int = 0
     dur_ns: int = 0
+    # lifeguard fields (ISSUE 7)
+    deadline_ns: Optional[int] = None   # absolute monotonic deadline
+    signature: Optional[str] = None     # quarantine identity
+    probe: bool = False                 # half-open re-admission probe
+    cancel_reason: Optional[str] = None  # "user"|"deadline"|"drain"
+    worker_ident: Optional[int] = None  # executing thread (heartbeats)
+    run_start_ns: int = 0               # dispatch time (hang age base)
+    hung: bool = False                  # watchdog declared it wedged
     cancel_event: threading.Event = field(
         default_factory=threading.Event)
     done_event: threading.Event = field(
@@ -71,6 +79,10 @@ class Job:
             out["result"] = self.result
         if self.error is not None:
             out["error"] = self.error
+        if self.hung:
+            out["hung"] = True
+        if self.cancel_reason is not None:
+            out["cancel_reason"] = self.cancel_reason
         return out
 
 

@@ -29,9 +29,22 @@ with this repo's existing subsystems composed as the control plane:
     and an ``admission_stall`` flight-recorder trigger when a job's
     queue wait crosses the stall threshold.
 
+ISSUE 7 adds the **eviction** half (the query lifeguard,
+``robustness/lifeguard.py``): per-query deadlines (cooperative
+``QueryContext`` checkpoints + a watchdog that fires ``cancel_event``
+and escalates), a hung-worker watchdog (heartbeat-silent workers are
+orphaned, their RmmSpark task force-released so blocked neighbors
+unblock, and the pool replaced), a poison-query quarantine circuit
+breaker with half-open probe re-admission, and graceful
+``drain()``/restart.  See docs/server.md "Lifecycle & failure
+handling".
+
 Knobs (all ``SPARK_RAPIDS_TPU_SERVER_*`` env, overridable in code):
 ``MAX_CONCURRENCY``, ``MAX_QUEUE``, ``TENANT_MAX_INFLIGHT``,
-``TENANT_MAX_BYTES``, ``MAX_REQUEUES``, ``STALL_MS``.
+``TENANT_MAX_BYTES``, ``MAX_REQUEUES``, ``STALL_MS``,
+``DEFAULT_DEADLINE_S``, ``HANG_S``, ``WATCHDOG_MS``,
+``QUARANTINE_FAILURES``, ``QUARANTINE_COOLDOWN_S``,
+``DRAIN_DEADLINE_S``, ``DRAIN_DIR``, ``SOCKET_IDLE_S``.
 """
 
 from __future__ import annotations
@@ -48,10 +61,14 @@ from spark_rapids_tpu import observability as _obs
 from spark_rapids_tpu.memory import exceptions as exc
 from spark_rapids_tpu.memory import task_priority
 from spark_rapids_tpu.models import (QueryCancelled, QueryContext,
+                                     QueryDeadlineExceeded,
                                      UnknownQueryError, has_query,
                                      run_catalog_query)
+from spark_rapids_tpu.robustness import lifeguard
 from spark_rapids_tpu.robustness.retry import RetryExhausted
-from spark_rapids_tpu.server.admission import (REASON_SHUTDOWN,
+from spark_rapids_tpu.server.admission import (REASON_DRAINING,
+                                               REASON_QUARANTINED,
+                                               REASON_SHUTDOWN,
                                                AdmissionController,
                                                ServerOverloaded,
                                                TenantQuota)
@@ -75,6 +92,27 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+_DEADLINE_ERROR = {"type": "QueryDeadlineExceeded",
+                   "reason": "deadline"}
+
+
+def _cancel_verdict(job: Job):
+    """(state, outcome, error) for a job unwinding after its cancel
+    flag fired — the ONE place the deadline flavor maps to its typed
+    outcome, shared by every unwind path (early-cancel, the except
+    arms, and the racing-cancel recheck in finalize)."""
+    if job.cancel_reason == "deadline":
+        return STATE_FAILED, "deadline", _DEADLINE_ERROR.copy()
+    return STATE_CANCELLED, "cancelled", None
+
+
 @dataclass
 class ServerConfig:
     max_concurrency: int = 4
@@ -86,6 +124,13 @@ class ServerConfig:
     finished_keep: int = 1024          # finished jobs pollable before
     #                                    eviction (resident server:
     #                                    results must not accrete)
+    # ---- lifeguard knobs (ISSUE 7) ----
+    default_deadline_s: float = 0.0    # per-query deadline; 0=off
+    hang_s: float = 30.0               # silent-worker threshold; 0=off
+    watchdog_interval_s: float = 0.25  # lifeguard scan cadence
+    quarantine_failures: int = 3       # deaths before quarantine; 0=off
+    quarantine_cooldown_s: float = 30.0  # first open; doubles, cap 8x
+    drain_deadline_s: float = 30.0     # in-flight budget for drain()
 
     @classmethod
     def from_env(cls) -> "ServerConfig":
@@ -98,6 +143,16 @@ class ServerConfig:
             max_requeues=_env_int(p + "MAX_REQUEUES", 1),
             stall_ms=_env_int(p + "STALL_MS", 5000),
             finished_keep=_env_int(p + "FINISHED_KEEP", 1024),
+            default_deadline_s=_env_float(
+                p + "DEFAULT_DEADLINE_S", 0.0),
+            hang_s=_env_float(p + "HANG_S", 30.0),
+            watchdog_interval_s=max(
+                _env_int(p + "WATCHDOG_MS", 250), 10) / 1000.0,
+            quarantine_failures=_env_int(
+                p + "QUARANTINE_FAILURES", 3),
+            quarantine_cooldown_s=_env_float(
+                p + "QUARANTINE_COOLDOWN_S", 30.0),
+            drain_deadline_s=_env_float(p + "DRAIN_DEADLINE_S", 30.0),
         )
 
 
@@ -135,11 +190,24 @@ class QueryServer:
         self._workers: list = []
         self._started = False
         self._stopping = False
+        self._draining = False
+        self._drain_until = 0.0
         # bumped by stop(): a worker that outlives a timed-out join
         # (job longer than the stop timeout) sees a stale generation
         # and exits instead of rejoining a restarted pool as an
         # untracked extra thread
         self._generation = 0
+        # ---- lifeguard (ISSUE 7) ----
+        # thread idents the watchdog declared hung: the pool spawned a
+        # replacement, and if the orphan ever returns to the loop it
+        # must exit, not serve (the per-thread twin of _generation)
+        self._orphaned: set = set()
+        self._repl = itertools.count(1)   # replacement worker names
+        self._quarantine = lifeguard.QuarantineBreaker(
+            failures=self.config.quarantine_failures,
+            cooldown_s=self.config.quarantine_cooldown_s)
+        self._watchdog = lifeguard.Watchdog(
+            self._lifeguard_scan, self.config.watchdog_interval_s)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -149,17 +217,24 @@ class QueryServer:
                 return self
             self._started = True
             self._stopping = False
+            self._draining = False
         for i in range(self.config.max_concurrency):
             t = threading.Thread(target=self._worker_loop,
                                  args=(self._generation,),
                                  name=f"srt-server-{i}", daemon=True)
             t.start()
             self._workers.append(t)
+        # lifeguard: op-close heartbeats + the deadline/hang scanner
+        # (always on — per-submit deadlines need it even when the
+        # hang/default-deadline knobs are zeroed)
+        lifeguard.install_heartbeat_hook()
+        self._watchdog.start()
         return self
 
     def stop(self, timeout_s: float = 30.0) -> None:
         """Stop accepting work, cancel everything still queued, let
         running jobs finish, join the pool."""
+        self._watchdog.stop()
         with self._work:
             if not self._started:
                 return
@@ -177,8 +252,14 @@ class QueryServer:
             t.join(max(deadline - time.monotonic(), 0.1))
         with self._lock:
             self._generation += 1   # orphan any join-timeout survivor
+            self._orphaned.clear()
             self._workers = []
             self._started = False
+            self._draining = False
+        # symmetric with start(): the last stopped server removes the
+        # observability heartbeat hook (ref-counted, so a second live
+        # server keeps its hang detection)
+        lifeguard.release_heartbeat_hook()
 
     # ------------------------------------------------------------ admission
 
@@ -190,9 +271,15 @@ class QueryServer:
             max_device_bytes=max_device_bytes, weight=weight)
 
     def submit(self, tenant: str, query: str,
-               params: Optional[dict] = None) -> str:
+               params: Optional[dict] = None,
+               deadline_s: Optional[float] = None) -> str:
         """Admit a query; returns its query id or raises the typed
-        :class:`ServerOverloaded` backpressure response."""
+        :class:`ServerOverloaded` backpressure response.
+
+        ``deadline_s`` bounds the query's whole lifetime (queue wait
+        included): past it, the cooperative cancel flag fires and the
+        watchdog escalates; 0/None falls back to the server-wide
+        ``default_deadline_s`` (0 = no deadline)."""
         tenant = str(tenant)
         if self._runner is run_catalog_query \
                 and not has_query(str(query)):
@@ -200,15 +287,58 @@ class QueryServer:
             # door: a typo answers typed immediately instead of
             # burning a pool slot to fail at run time
             raise UnknownQueryError(str(query))
-        # the memory-ledger fold (adaptor lock, O(live tasks)) runs
-        # BEFORE the server lock is taken — _task_tenant is only
-        # point-read, so a slightly stale byte count is fine and the
-        # fold never serializes dispatch behind the adaptor
-        tenant_bytes = (self._tenant_device_bytes(tenant)
-                        if self._bytes_tracked(tenant) else None)
+        if deadline_s is None or deadline_s <= 0:
+            deadline_s = self.config.default_deadline_s
+        deadline_ns = (time.monotonic_ns() + int(deadline_s * 1e9)
+                       if deadline_s and deadline_s > 0 else None)
+        # poison-query circuit breaker: a quarantined signature
+        # answers typed BEFORE burning admission/scheduling work; the
+        # half-open probe verdict must be reported back (finalize, or
+        # the abort below when a downstream check bounces the probe)
+        sig = probe = None
+        if self._quarantine.enabled:
+            sig = lifeguard.signature(tenant, str(query), params)
+            verdict = self._quarantine.admit(sig)
+            if verdict["verdict"] == "refused":
+                _obs.record_server_quarantine(
+                    "rejected", tenant, str(query), sig,
+                    strikes=verdict.get("strikes", 0),
+                    retry_after_s=verdict["retry_after_s"])
+                e = ServerOverloaded(
+                    REASON_QUARANTINED, tenant,
+                    f"signature {sig} is quarantined "
+                    f"({verdict.get('strikes', 0)} recent deaths)",
+                    retry_after_s=verdict["retry_after_s"])
+                with self._lock:
+                    self._stat(tenant, "rejected")
+                _obs.record_server_reject(tenant, str(query),
+                                          e.reason, e.retry_after_s)
+                raise e
+            probe = verdict["verdict"] == "probe"
+            if probe:
+                _obs.record_server_quarantine(
+                    "probe", tenant, str(query), sig,
+                    strikes=verdict.get("strikes", 0))
         try:
+            # the memory-ledger fold (adaptor lock, O(live tasks))
+            # runs BEFORE the server lock is taken — _task_tenant is
+            # only point-read, so a slightly stale byte count is fine
+            # and the fold never serializes dispatch behind the
+            # adaptor.  Inside the try: ANY failure between the probe
+            # grant and the job's registration must re-arm the
+            # breaker (see the BaseException arm below).
+            tenant_bytes = (self._tenant_device_bytes(tenant)
+                            if self._bytes_tracked(tenant) else None)
             with self._work:
-                if not self._started or self._stopping:
+                if not self._started or self._stopping \
+                        or self._draining:
+                    if self._draining:
+                        raise ServerOverloaded(
+                            REASON_DRAINING, tenant,
+                            "server is draining for restart",
+                            retry_after_s=round(max(
+                                self._drain_until - time.monotonic(),
+                                1.0), 3))
                     raise ServerOverloaded(REASON_SHUTDOWN, tenant,
                                            "server is not accepting "
                                            "work")
@@ -229,7 +359,9 @@ class QueryServer:
                     params=dict(params or {}), seq=next(self._seq),
                     task_id=task_id,
                     priority=task_priority.get_task_priority(task_id),
-                    submit_ns=time.monotonic_ns())
+                    submit_ns=time.monotonic_ns(),
+                    deadline_ns=deadline_ns, signature=sig,
+                    probe=bool(probe))
                 self._jobs[job.query_id] = job
                 self._task_tenant[task_id] = tenant
                 self._sched.enqueue(job, self._running)
@@ -244,10 +376,24 @@ class QueryServer:
                 self._work.notify()
                 return job.query_id
         except ServerOverloaded as e:
+            if probe and sig is not None:
+                # the half-open probe bounced on a DOWNSTREAM check
+                # (queue full, quota): re-open the circuit with an
+                # expired cooldown so the next submit probes again —
+                # a stuck in-flight marker would quarantine forever
+                self._quarantine.abort_probe(sig)
             with self._lock:   # _tenant_stats writes stay serialized
                 self._stat(tenant, "rejected")
             _obs.record_server_reject(tenant, str(query), e.reason,
                                       e.retry_after_s)
+            raise
+        except BaseException:
+            # unexpected failure (a custom device_bytes_fn raising,
+            # adaptor torn down mid-fold): no job exists to finalize,
+            # so a granted probe would stay half-open forever — re-arm
+            # it before propagating
+            if probe and sig is not None:
+                self._quarantine.abort_probe(sig)
             raise
 
     # -------------------------------------------------------------- queries
@@ -260,14 +406,23 @@ class QueryServer:
         if timeout_s is not None:
             job.done_event.wait(timeout_s)
         with self._lock:
-            return job.status()
+            st = job.status()
+            # a wait that EXPIRED must be distinguishable from a job
+            # that is merely pending: the caller asked "done within
+            # timeout_s?" and the answer was no.  The done_event
+            # check runs under the lock (finalize sets it under the
+            # lock too), so a finish racing the wait's expiry reports
+            # the terminal state with no timed_out marker.
+            if timeout_s is not None and not job.done_event.is_set():
+                st["timed_out"] = True
+        return st
 
     def wait(self, query_id: str, timeout_s: float = 60.0) -> dict:
         """Poll that blocks until the job leaves the queue/run states
         (or the timeout passes)."""
         return self.poll(query_id, timeout_s=timeout_s)
 
-    def cancel(self, query_id: str) -> bool:
+    def cancel(self, query_id: str, reason: str = "user") -> bool:
         """Cancel a query: queued jobs unwind immediately; running
         jobs get their cooperative flag set (runners that poll it stop
         early; a non-cooperative runner's result is discarded)."""
@@ -275,6 +430,8 @@ class QueryServer:
             job = self._jobs.get(query_id)
             if job is None or job.done_event.is_set():
                 return False
+            if job.cancel_reason is None:
+                job.cancel_reason = reason
             job.cancel_event.set()
             if job.state == STATE_QUEUED and self._sched.remove(job):
                 self._finalize_locked(job, STATE_CANCELLED,
@@ -306,8 +463,19 @@ class QueryServer:
                     "max_queue": self.config.max_queue,
                     "max_requeues": self.config.max_requeues,
                     "stall_ms": self.config.stall_ms,
+                    "default_deadline_s":
+                        self.config.default_deadline_s,
+                    "hang_s": self.config.hang_s,
+                    "quarantine_failures":
+                        self.config.quarantine_failures,
                 },
                 "started": self._started,
+                "draining": self._draining,
+                "lifeguard": {
+                    "watchdog": self._watchdog.snapshot(),
+                    "quarantine": self._quarantine.snapshot(),
+                    "orphaned_workers": len(self._orphaned),
+                },
                 "queued_total": self._sched.queued_total(),
                 "running_total": sum(self._running.values()),
                 "jobs_total": len(self._jobs),
@@ -321,8 +489,15 @@ class QueryServer:
     # -------------------------------------------------------------- workers
 
     def _worker_loop(self, generation: int) -> None:
+        ident = threading.get_ident()
         while True:
             with self._work:
+                if ident in self._orphaned:
+                    # the watchdog declared this worker hung and the
+                    # pool already replaced it: a late return must
+                    # exit, never serve alongside its replacement
+                    self._orphaned.discard(ident)
+                    return
                 job = None
                 while not self._stopping \
                         and self._generation == generation:
@@ -334,7 +509,14 @@ class QueryServer:
                 if job is None:       # stopping/orphaned, queue drained
                     return
                 job.state = STATE_RUNNING
-                job.wait_ns = time.monotonic_ns() - job.submit_ns
+                # the attempt identity (who runs it, since when) is
+                # stamped HERE, atomically with the RUNNING
+                # transition: a watchdog tick between dispatch and
+                # _execute must never see this attempt wearing a
+                # previous attempt's worker/clock (stale evidence)
+                job.worker_ident = threading.get_ident()
+                job.run_start_ns = time.monotonic_ns()
+                job.wait_ns = job.run_start_ns - job.submit_ns
                 self._running[job.tenant] = \
                     self._running.get(job.tenant, 0) + 1
                 queue_depth = self._sched.queued_total()
@@ -366,12 +548,19 @@ class QueryServer:
                 # decrement would leave a phantom in-flight job that
                 # eventually wedges the tenant's admission quota
                 # (dur_ns is 0, so the vruntime charge is zero)
-                self._finalize_locked(job, STATE_CANCELLED,
-                                      outcome="cancelled",
-                                      charge=True)
+                state, outcome, error = _cancel_verdict(job)
+                self._finalize_locked(job, state, outcome=outcome,
+                                      error=error, charge=True)
             return
         self._register_rmm_task(job)
-        ctx = QueryContext(job.query_id, job.tenant, job.cancel_event)
+        # lifeguard bookkeeping: worker_ident/run_start_ns were
+        # stamped under the lock at dispatch (atomically with the
+        # RUNNING transition); the hang scan measures silence from
+        # max(run start, last heartbeat ≥ run start) so a beat from a
+        # PREVIOUS job on this thread can never vouch for this one
+        lifeguard.beat(f"job:{job.query_id}")
+        ctx = QueryContext(job.query_id, job.tenant, job.cancel_event,
+                           deadline_ns=job.deadline_ns)
         t0 = time.monotonic_ns()
         outcome, state, result, error = "success", STATE_DONE, None, None
         try:
@@ -382,14 +571,23 @@ class QueryServer:
                            "server_task_id": job.task_id,
                            "demotions": job.demotions}):
                 result = self._runner(job.query, job.params, ctx)
-        except QueryCancelled:
-            outcome, state = "cancelled", STATE_CANCELLED
+        except QueryCancelled as e:
+            if isinstance(e, QueryDeadlineExceeded) \
+                    and job.cancel_reason is None:
+                # a cooperative deadline checkpoint fired before any
+                # cancel flag existed: burn-the-budget verdict (an
+                # explicit user/drain cancel, had there been one,
+                # dominates — see QueryContext.check_cancel)
+                outcome, state = "deadline", STATE_FAILED
+                error = _DEADLINE_ERROR.copy()
+            else:
+                state, outcome, error = _cancel_verdict(job)
         except SHED_ERRORS as e:
             if job.cancel_event.is_set():
                 # cancel dominates: a cancelled job whose runner then
-                # tripped an OOM must report "cancelled", not a bogus
-                # quota-exhaustion failure
-                outcome, state = "cancelled", STATE_CANCELLED
+                # tripped an OOM must report "cancelled" (or its
+                # deadline), not a bogus quota-exhaustion failure
+                state, outcome, error = _cancel_verdict(job)
             elif job.demotions < cfg.max_requeues:
                 # the failed attempt's pool time still gets charged
                 # (in _requeue_demoted) — an OOM-ing tenant must not
@@ -406,15 +604,19 @@ class QueryServer:
         except BaseException as e:  # noqa: BLE001 — job isolation:
             # one tenant's bug must never take the pool thread down
             if job.cancel_event.is_set():
-                outcome, state = "cancelled", STATE_CANCELLED
+                state, outcome, error = _cancel_verdict(job)
             else:
                 outcome, state = "failed", STATE_FAILED
                 error = {"type": type(e).__name__,
                          "message": str(e)[:300]}
         job.dur_ns = time.monotonic_ns() - t0
         # (a cancel racing the finish is rechecked inside
-        # _finalize_locked, under the lock)
-        self._release_rmm_task(job)
+        # _finalize_locked, under the lock.)  A hung job's task was
+        # already force-released by the watchdog — a second task_done
+        # from the late-unwinding orphan would write a spurious
+        # "completed normally" journal event over the force-release
+        if not job.hung:
+            self._release_rmm_task(job)
         with self._work:
             self._finalize_locked(job, state, outcome=outcome,
                                   result=result, error=error,
@@ -428,6 +630,266 @@ class QueryServer:
                 {}, {}, {},
                 {job.tenant: self._tenant_device_bytes(job.tenant)})
 
+    # ------------------------------------------------------------ lifeguard
+
+    def _lifeguard_scan(self) -> None:
+        """One watchdog tick (robustness/lifeguard.Watchdog): expire
+        queued jobs past their deadline, fire the cooperative cancel
+        flag on running ones, and declare silent workers hung."""
+        cfg = self.config
+        now = time.monotonic_ns()
+        hang_ns = int(cfg.hang_s * 1e9)
+        expired, fired, running = [], [], []
+        with self._work:
+            for job in list(self._jobs.values()):
+                if job.done_event.is_set() or job.hung:
+                    continue
+                if job.state == STATE_QUEUED:
+                    if job.deadline_ns is not None \
+                            and now > job.deadline_ns \
+                            and self._sched.remove(job):
+                        expired.append(job)
+                    continue
+                if job.state != STATE_RUNNING:
+                    continue
+                if job.deadline_ns is not None \
+                        and now > job.deadline_ns \
+                        and not job.cancel_event.is_set():
+                    if job.cancel_reason is None:
+                        job.cancel_reason = "deadline"
+                    job.cancel_event.set()
+                    fired.append(job)
+                running.append(job)
+            for job in expired:
+                # queued past deadline: never dispatched, so no
+                # running-count to release (charge stays False)
+                self._finalize_locked(
+                    job, STATE_FAILED, outcome="deadline",
+                    error={"type": "QueryDeadlineExceeded",
+                           "reason": "deadline_expired_queued"})
+        for job in expired:
+            _obs.record_server_watchdog("deadline_expired_queued",
+                                        job.tenant, job.query_id,
+                                        query=job.query)
+        for job in fired:
+            _obs.record_server_watchdog("deadline_cancel", job.tenant,
+                                        job.query_id, query=job.query)
+        if hang_ns <= 0:
+            return
+        # hang evaluation OUTSIDE the server lock: the adaptor state
+        # probe takes the adaptor lock, which must never nest inside
+        # ours (the submit-path ledger-fold rule)
+        for job in running:
+            why = self._hang_check(job, now, hang_ns)
+            if why is not None:
+                self._handle_hung(job, *why)
+
+    def _hang_check(self, job: Job, now: int, hang_ns: int):
+        """(reason, silent_ns, last_label) when the job's worker is
+        presumed wedged, else None.  Silence is measured from
+        max(dispatch, last heartbeat ≥ dispatch) — a beat left by a
+        previous job on the same thread can never vouch for this one.
+        A thread parked in the OOM state machine is waiting, not
+        wedged (its stall is the deadlock-breaker's jurisdiction) —
+        unless the job has also blown through its deadline by a full
+        hang window (a cancel-ignoring runner must still be evicted)."""
+        ident = job.worker_ident
+        run_start = job.run_start_ns
+        if ident is None or run_start <= 0:
+            return None
+        last, label = run_start, "job_start"
+        b = lifeguard.last_beat(ident)
+        if b is not None and b[0] >= run_start:
+            last, label = b
+        silent_ns = now - last
+        if job.deadline_ns is not None \
+                and now > job.deadline_ns + hang_ns:
+            return ("deadline_escalation", silent_ns, label,
+                    run_start)
+        if silent_ns <= hang_ns:
+            return None
+        try:
+            from spark_rapids_tpu.memory import rmm_spark
+            from spark_rapids_tpu.memory import \
+                spark_resource_adaptor as sra
+            adaptor = rmm_spark.installed_adaptor()
+            if adaptor is not None and adaptor.get_state_of(ident) \
+                    in (sra.THREAD_BLOCKED, sra.THREAD_BUFN):
+                return None
+        except Exception:
+            pass
+        return ("heartbeat_silent", silent_ns, label, run_start)
+
+    def _handle_hung(self, job: Job, why: str, silent_ns: int,
+                     last_label: str, run_start_ns: int) -> None:
+        """Evict a wedged worker: orphan it, replace it, report the
+        death to the quarantine breaker, freeze a ``query_hang``
+        bundle (stacks + pre-release ledger), force-release the
+        job's RmmSpark task so blocked neighbors unblock, and
+        finalize the job as hung."""
+        with self._work:
+            if job.done_event.is_set() or job.hung:
+                return
+            if job.state != STATE_RUNNING \
+                    or job.run_start_ns != run_start_ns:
+                # the ATTEMPT the scan judged silent is over (the job
+                # OOM-requeued or was re-picked since the snapshot):
+                # whatever is running now is a different attempt with
+                # a fresh clock — never evict on stale evidence
+                return
+            job.hung = True
+            if job.cancel_reason is None:
+                job.cancel_reason = "hang"
+            job.cancel_event.set()   # a late waker should exit fast
+            ident = job.worker_ident
+            if ident is not None:
+                self._orphaned.add(ident)
+            # replacement first: pool capacity must not shrink while
+            # the orphan blocks a slot forever
+            repl = threading.Thread(
+                target=self._worker_loop, args=(self._generation,),
+                name=f"srt-server-repl-{next(self._repl)}",
+                daemon=True)
+            self._workers.append(repl)
+        repl.start()
+        # breaker BEFORE the bundle: the bundle's detail (and the
+        # journal frozen into it) must carry the post-death
+        # quarantine state, so srt-doctor can name the quarantined
+        # signature straight from the query_hang bundle
+        qinfo = {"quarantined": False, "strikes": 0}
+        if job.signature is not None and self._quarantine.enabled:
+            qinfo = self._quarantine.note_death(job.signature, "hung",
+                                                probe=job.probe)
+            if qinfo.get("opened"):
+                _obs.record_server_quarantine(
+                    "reopened" if job.probe else "opened",
+                    job.tenant, job.query, job.signature,
+                    strikes=qinfo["strikes"], reason="hung",
+                    retry_after_s=qinfo["retry_after_s"])
+        silent_ms = silent_ns // 1_000_000
+        _obs.record_server_watchdog(
+            "hang_release", job.tenant, job.query_id, query=job.query,
+            reason=why, silent_ms=silent_ms, last_op=last_label,
+            task_id=job.task_id)
+        # evidence freeze BEFORE the force-release: the bundle's
+        # memory ledger must still show the hung task's held bytes
+        _obs.trigger_incident(
+            "query_hang", severity="error", tenant=job.tenant,
+            query=job.query, query_id=job.query_id,
+            task_id=job.task_id, worker_ident=ident, reason=why,
+            silent_ms=silent_ms, last_op=last_label,
+            signature=job.signature, quarantine=qinfo,
+            stack=lifeguard.thread_stack(ident)[-8:])
+        try:
+            from spark_rapids_tpu.memory import rmm_spark
+            if rmm_spark.installed_adaptor() is not None:
+                rmm_spark.force_release_task(job.task_id)
+        except Exception:
+            pass   # adaptor torn down mid-flight: nothing to release
+        with self._work:
+            self._finalize_locked(
+                job, STATE_FAILED, outcome="hung",
+                error={"type": "QueryHung", "reason": why,
+                       "silent_ms": silent_ms,
+                       "last_op": last_label},
+                charge=True)
+
+    # ----------------------------------------------------------- draining
+
+    def drain(self, deadline_s: Optional[float] = None,
+              flush_dir: Optional[str] = None) -> dict:
+        """Graceful drain: stop admitting (typed ``draining``
+        refusals), let in-flight work finish under ``deadline_s``
+        (default ``drain_deadline_s``), cancel what remains, flush
+        journal/spans/metrics through dumpio, stop the pool, and
+        return a drain report.  A subsequent start (or a fresh
+        ``server_start`` through the shim) serves again — with the
+        process-wide jit cache still warm."""
+        cfg = self.config
+        t0 = time.monotonic()
+        if deadline_s is None or deadline_s <= 0:
+            deadline_s = cfg.drain_deadline_s
+        deadline = t0 + deadline_s
+        with self._work:
+            if not self._started:
+                return {"state": "stopped", "in_flight": 0,
+                        "completed": 0, "cancelled": 0,
+                        "abandoned": 0, "duration_s": 0.0,
+                        "flush": {}}
+            self._draining = True
+            self._drain_until = deadline
+            pending = [j for j in self._jobs.values()
+                       if not j.done_event.is_set()]
+        _obs.record_server_drain("begin", in_flight=len(pending),
+                                 deadline_s=deadline_s)
+        finished, leftover = [], []
+        for job in pending:
+            job.done_event.wait(max(deadline - time.monotonic(), 0.0))
+            (finished if job.done_event.is_set()
+             else leftover).append(job)
+        cancelled = [j for j in leftover
+                     if self.cancel(j.query_id, reason="drain")]
+        grace = time.monotonic() + min(2.0, deadline_s)
+        for job in cancelled:
+            job.done_event.wait(max(grace - time.monotonic(), 0.0))
+        abandoned = [j.query_id for j in leftover
+                     if not j.done_event.is_set()]
+        flush = self._flush_observability(flush_dir)
+        report = {
+            "state": "drained",
+            "in_flight": len(pending),
+            "completed": len(finished),
+            "cancelled": len(cancelled),
+            "abandoned": len(abandoned),
+            "abandoned_ids": abandoned[:32],
+            "outcomes": self._outcomes_of(finished + leftover),
+            "duration_s": round(time.monotonic() - t0, 3),
+            "flush": flush,
+        }
+        _obs.record_server_drain(
+            "end", in_flight=len(pending),
+            completed=len(finished), cancelled=len(cancelled),
+            abandoned=len(abandoned),
+            duration_s=report["duration_s"])
+        self.stop(timeout_s=5.0)
+        return report
+
+    def _outcomes_of(self, jobs) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        with self._lock:
+            for j in jobs:
+                out[j.state] = out.get(j.state, 0) + 1
+        return out
+
+    def _flush_observability(self, flush_dir: Optional[str]) -> dict:
+        """Drain-time flush: journal + spans + metrics snapshot
+        through the atomic dumpio path.  Opt-in by directory
+        (``SPARK_RAPIDS_TPU_SERVER_DRAIN_DIR`` or the ``flush_dir``
+        argument) — a drain must not litter the CWD uninvited."""
+        flush_dir = flush_dir or os.environ.get(
+            "SPARK_RAPIDS_TPU_SERVER_DRAIN_DIR", "")
+        if not flush_dir:
+            return {"skipped": "no drain dir configured"}
+        import json as _json
+
+        from spark_rapids_tpu.observability.dumpio import atomic_write
+        d = os.path.join(flush_dir,
+                         f"drain-{int(time.time() * 1000)}")
+        out: Dict[str, object] = {"dir": d}
+        try:
+            os.makedirs(d, exist_ok=True)
+            out["journal_records"] = _obs.dump_journal_jsonl(
+                os.path.join(d, "journal.jsonl"))
+            out["span_records"] = _obs.dump_spans_jsonl(
+                os.path.join(d, "spans.jsonl"))
+            snap = _json.dumps(_obs.snapshot(), sort_keys=True)
+            atomic_write(os.path.join(d, "metrics.json"),
+                         lambda f: f.write(snap))
+            out["metrics_bytes"] = len(snap)
+        except Exception as e:   # flush failure must not fail drain
+            out["error"] = f"{type(e).__name__}: {e}"
+        return out
+
     def _requeue_demoted(self, job: Job, cause: BaseException) -> None:
         """Load-shed: release the attempt's priority and re-register —
         the re-registered id gets a strictly LOWER priority (newer
@@ -437,6 +899,12 @@ class QueryServer:
         job.priority = task_priority.get_task_priority(job.task_id)
         job.state = STATE_QUEUED
         job.submit_ns = time.monotonic_ns()
+        # the burned attempt's identity must not survive into the
+        # queue: a watchdog tick around the NEXT dispatch would
+        # otherwise judge the fresh attempt by this one's worker and
+        # clock (and evict a healthy worker on stale evidence)
+        job.worker_ident = None
+        job.run_start_ns = 0
         _obs.record_server_requeue(job.tenant, job.query_id,
                                    type(cause).__name__, job.demotions)
         with self._work:
@@ -469,13 +937,30 @@ class QueryServer:
     def _finalize_locked(self, job: Job, state: str, *, outcome: str,
                          result=None, error=None,
                          charge: bool = False) -> None:
-        """Terminal transition; caller holds the lock."""
+        """Terminal transition; caller holds the lock.  Idempotent:
+        the watchdog can finalize a hung job while its orphaned
+        worker is still wedged inside the runner — whichever side
+        finishes second must be a no-op."""
+        if job.done_event.is_set():
+            return
+        if job.hung and outcome != "hung":
+            # the watchdog marked this job hung; whatever unwind path
+            # the (possibly force-released) worker took afterwards —
+            # ThreadRemovedException, a swallowed cancel, even a late
+            # success — the verdict stays "hung", whichever side
+            # reaches finalize first
+            state, result = STATE_FAILED, None
+            outcome = "hung"
+            if not (error and error.get("type") == "QueryHung"):
+                error = {"type": "QueryHung",
+                         "reason": job.cancel_reason or "hang"}
         if state == STATE_DONE and job.cancel_event.is_set():
             # the racing-cancel recheck must happen UNDER the lock:
             # cancel() returning True guarantees the result is
             # discarded, even when the flag landed between the
             # worker's last check and this finalize
-            state, outcome, result = STATE_CANCELLED, "cancelled", None
+            state, outcome, error = _cancel_verdict(job)
+            result = None
         if charge:
             self._dec_running(job.tenant)
             self._sched.charge(job.tenant, job.dur_ns / 1e9,
@@ -486,6 +971,7 @@ class QueryServer:
         self._task_tenant.pop(job.task_id, None)
         task_priority.task_done(job.task_id)
         self._stat(job.tenant, outcome)
+        self._note_quarantine(job, outcome)
         _obs.record_server_complete(job.tenant, job.query,
                                     job.query_id, outcome, job.dur_ns,
                                     job.wait_ns)
@@ -495,6 +981,38 @@ class QueryServer:
         while len(self._finished) > max(self.config.finished_keep, 1):
             self._jobs.pop(self._finished.popleft(), None)
         job.done_event.set()
+
+    def _note_quarantine(self, job: Job, outcome: str) -> None:
+        """Report a job's terminal outcome to the poison-query
+        breaker (leaf lock — safe under the server lock).  Hung jobs
+        are skipped: the hang handler reported their death BEFORE
+        freezing the ``query_hang`` bundle, so the bundle's detail
+        carries the post-transition quarantine state."""
+        sig = job.signature
+        if sig is None or not self._quarantine.enabled or job.hung:
+            return
+        if outcome == "deadline" and job.run_start_ns == 0:
+            # the deadline expired while the job was still QUEUED:
+            # that is queue congestion, not evidence the query is
+            # poison — neutral for the breaker (a probe re-arms)
+            self._quarantine.note_neutral(sig, probe=job.probe)
+            return
+        if outcome == "success":
+            info = self._quarantine.note_success(sig, probe=job.probe)
+            if info.get("closed"):
+                _obs.record_server_quarantine(
+                    "closed", job.tenant, job.query, sig)
+        elif outcome in lifeguard.DEATH_OUTCOMES:
+            info = self._quarantine.note_death(sig, outcome,
+                                               probe=job.probe)
+            if info.get("opened"):
+                _obs.record_server_quarantine(
+                    "reopened" if job.probe else "opened",
+                    job.tenant, job.query, sig,
+                    strikes=info["strikes"], reason=outcome,
+                    retry_after_s=info["retry_after_s"])
+        else:   # cancelled: neutral (a cancelled probe re-arms)
+            self._quarantine.note_neutral(sig, probe=job.probe)
 
     # ------------------------------------------------------- rmm plumbing
 
@@ -538,7 +1056,8 @@ class QueryServer:
             tenant = self._OTHER
         row = self._tenant_stats.setdefault(tenant, {
             "admitted": 0, "rejected": 0, "requeued": 0, "success": 0,
-            "failed": 0, "cancelled": 0, "shed": 0})
+            "failed": 0, "cancelled": 0, "shed": 0, "hung": 0,
+            "deadline": 0})
         row[key] = row.get(key, 0) + 1
 
     def _bytes_tracked(self, tenant: str) -> bool:

@@ -388,10 +388,14 @@ def server_set_tenant_quota(tenant: str, max_inflight: int = -1,
 
 
 def server_submit(tenant: str, query: str,
-                  params_json: str = "") -> str:
+                  params_json: str = "",
+                  deadline_s: float = -1.0) -> str:
     """Submit; returns JSON — {"ok": true, "query_id": ...} or the
     typed backpressure payload {"ok": false, "error": {...,
-    "reason": "queue_full"|...}}."""
+    "reason": "queue_full"|"quarantined"|"draining"|...}}.
+    ``deadline_s > 0`` bounds the query's whole lifetime (the
+    lifeguard cancels and escalates past it); <= 0 takes the
+    server-wide default."""
     import json
 
     from spark_rapids_tpu import server as srv
@@ -401,7 +405,9 @@ def server_submit(tenant: str, query: str,
         raise RuntimeError("query server is not running")
     params = json.loads(params_json) if params_json else {}
     try:
-        qid = s.submit(str(tenant), str(query), params)
+        qid = s.submit(str(tenant), str(query), params,
+                       deadline_s=float(deadline_s)
+                       if deadline_s > 0 else None)
         return json.dumps({"ok": True, "query_id": qid})
     except srv.ServerOverloaded as e:
         return json.dumps({"ok": False, "error": e.to_dict()})
@@ -443,6 +449,23 @@ def server_stats_json() -> str:
     if s is None:
         return json.dumps({"started": False})
     return json.dumps(s.stats(), sort_keys=True)
+
+
+def server_drain(deadline_s: float = -1.0,
+                 flush_dir: str = "") -> str:
+    """Gracefully drain the process-global server (ISSUE 7): refuse
+    new submits typed (``draining``), finish in-flight work under the
+    drain deadline, flush journal/spans/metrics via dumpio, stop the
+    pool, and clear the singleton — a later ``server_start`` serves
+    again with the jit cache warm.  Returns the drain report as
+    JSON (``{"state": "not_running"}`` when no server exists)."""
+    import json
+
+    from spark_rapids_tpu import server as srv
+    report = srv.drain_server(
+        deadline_s=float(deadline_s) if deadline_s > 0 else None,
+        flush_dir=str(flush_dir) or None)
+    return json.dumps(report, sort_keys=True, default=str)
 
 
 # ------------------------------------------------------------ kudo crc

@@ -854,10 +854,11 @@ def server_set_tenant_quota(tenant: str, max_inflight: int = -1,
 
 
 def server_submit(tenant: str, query: str,
-                  params_json: str = "") -> str:
+                  params_json: str = "",
+                  deadline_s: float = -1.0) -> str:
     from spark_rapids_tpu.shim import jni_api
     return jni_api.server_submit(str(tenant), str(query),
-                                 str(params_json))
+                                 str(params_json), float(deadline_s))
 
 
 def server_poll(query_id: str, timeout_s: float = -1.0) -> str:
@@ -873,6 +874,11 @@ def server_cancel(query_id: str) -> bool:
 def server_stats_json() -> str:
     from spark_rapids_tpu.shim import jni_api
     return jni_api.server_stats_json()
+
+
+def server_drain(deadline_s: float = -1.0, flush_dir: str = "") -> str:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.server_drain(float(deadline_s), str(flush_dir))
 
 
 # --------------------------------------------------------- HostTable

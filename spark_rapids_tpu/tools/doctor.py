@@ -247,6 +247,47 @@ def analyze(bundle: Bundle) -> List[dict]:
                         f">= threshold "
                         f"{_fmt_bytes(detail.get('threshold_bytes', 0))}"
                         f" for {detail.get('sustained_s')}s")})
+    elif kind == "query_hang":
+        tenant = detail.get("tenant", "?")
+        query = detail.get("query", "?")
+        ident = detail.get("worker_ident")
+        msg = (f"query server worker hung: tenant {tenant!r} query "
+               f"{query!r} ({detail.get('query_id')}) silent "
+               f"{detail.get('silent_ms', '?')} ms in op "
+               f"{detail.get('last_op', '?')!r} "
+               f"(worker thread {ident}, task "
+               f"{detail.get('task_id')}, {detail.get('reason')})")
+        findings.append({"severity": 92, "kind": "query_hang",
+                         "message": msg})
+        # where exactly it is stuck: the trigger's own stack capture,
+        # else the bundle-wide python stack dump for that ident
+        stack = detail.get("stack") or []
+        if not stack and ident is not None:
+            for t in (bundle.threads.get("python") or []):
+                if t.get("ident") == ident:
+                    stack = t.get("stack") or []
+                    break
+        if stack:
+            findings.append({
+                "severity": 74, "kind": "hung_stack",
+                "message": ("hung worker's last frame: "
+                            + str(stack[-1]).strip().splitlines()[0]
+                            .strip())})
+        q = detail.get("quarantine") or {}
+        sig = detail.get("signature")
+        if sig and q.get("quarantined"):
+            findings.append({
+                "severity": 88, "kind": "poison_query",
+                "message": (f"poison query quarantined: signature "
+                            f"{sig} after {q.get('strikes', '?')} "
+                            f"death(s), retry after "
+                            f"{q.get('retry_after_s', '?')}s")})
+        elif sig:
+            findings.append({
+                "severity": 55, "kind": "poison_query",
+                "message": (f"signature {sig} has "
+                            f"{q.get('strikes', 0)} recent death(s) "
+                            f"(quarantine not yet open)")})
     elif kind == "admission_stall":
         tenant = detail.get("tenant", "?")
         findings.append({
@@ -300,6 +341,31 @@ def analyze(bundle: Bundle) -> List[dict]:
                             f"holding "
                             f"{_fmt_bytes(r.get('leaked_bytes', 0))} "
                             f"device memory")})
+
+    # ---- lifeguard journal history ----------------------------------
+    opened = [r for r in bundle.journal
+              if r.get("kind") == "server_quarantine"
+              and r.get("event") in ("opened", "reopened")]
+    if opened and kind != "query_hang":
+        last = opened[-1]
+        findings.append({
+            "severity": 72, "kind": "poison_query",
+            "message": (f"poison query quarantined earlier: signature "
+                        f"{last.get('signature')} "
+                        f"({last.get('reason', '?')} x"
+                        f"{last.get('strikes', '?')})")})
+    watchdog = [r for r in bundle.journal
+                if r.get("kind") == "server_watchdog"]
+    hangs = [r for r in watchdog if r.get("action") == "hang_release"]
+    if hangs and kind != "query_hang":
+        last = hangs[-1]
+        findings.append({
+            "severity": 70, "kind": "query_hang",
+            "message": (f"{len(hangs)} hung worker(s) released by the "
+                        f"lifeguard (last: tenant "
+                        f"{last.get('tenant')!r} query "
+                        f"{last.get('query')!r} silent "
+                        f"{last.get('silent_ms', '?')} ms)")})
 
     # ---- blocked threads + held memory from the ledger --------------
     for tid, row in sorted(ledger_threads.items()):

@@ -281,7 +281,8 @@ def server_rows(events: List[dict],
         return agg.setdefault((tenant, query), {
             "tenant": tenant, "query": query, "admitted": 0,
             "rejected": 0, "requeued": 0, "success": 0, "failed": 0,
-            "cancelled": 0, "shed": 0, "dur_ns": 0, "wait_ns": 0})
+            "cancelled": 0, "shed": 0, "hung": 0, "deadline": 0,
+            "dur_ns": 0, "wait_ns": 0})
 
     for e in events:
         kind = e.get("kind")
@@ -341,8 +342,8 @@ def render_server_table(events: List[dict],
     w = max(len(f"{r['tenant']}:{r['query']}") for r in rows)
     hdr = (f"{'tenant:query':<{w}}  {'admit':>5}  {'rej':>4}  "
            f"{'requ':>4}  {'ok':>4}  {'fail':>4}  {'cncl':>4}  "
-           f"{'shed':>4}  {'run':>3}  {'p95_wait_ms':>11}  "
-           f"{'dev_bytes':>10}")
+           f"{'shed':>4}  {'hung':>4}  {'ddl':>3}  {'run':>3}  "
+           f"{'p95_wait_ms':>11}  {'dev_bytes':>10}")
     out.append(hdr)
     out.append("-" * len(hdr))
     for r in rows:
@@ -352,6 +353,7 @@ def render_server_table(events: List[dict],
             f"{name:<{w}}  {r['admitted']:>5}  {r['rejected']:>4}  "
             f"{r['requeued']:>4}  {r['success']:>4}  "
             f"{r['failed']:>4}  {r['cancelled']:>4}  {r['shed']:>4}  "
+            f"{r.get('hung', 0):>4}  {r.get('deadline', 0):>3}  "
             f"{r.get('running', 0):>3}  "
             f"{(p95 / 1e6 if p95 is not None else 0.0):>11.3f}  "
             f"{r.get('device_bytes', 0):>10}")

@@ -385,6 +385,138 @@ def test_tenant_stats_rows_bounded():
         s.stop()
 
 
+# ------------------------------------------------ poll/cancel regressions
+
+
+def test_poll_timeout_reports_timed_out_distinctly():
+    run, gate, started = gated_runner()
+    s = make_server(run, concurrency=1)
+    try:
+        qid = s.submit("a", "slow")
+        assert wait_for(lambda: started == ["slow"])
+        # an expired wait is NOT a plain pending status: the caller
+        # asked "done within timeout?" and the answer was no
+        r = s.poll(qid, timeout_s=0.05)
+        assert r["state"] == "running"
+        assert r["timed_out"] is True
+        # a poll WITHOUT a timeout never carries the marker
+        assert "timed_out" not in s.poll(qid)
+        gate.set()
+        done = s.poll(qid, timeout_s=20)
+        assert done["state"] == "done"
+        assert "timed_out" not in done
+        # polling a finished job with a timeout: no marker either
+        assert "timed_out" not in s.poll(qid, timeout_s=0.01)
+    finally:
+        gate.set()
+        s.stop()
+
+
+def test_poll_races_finish_reports_terminal_state():
+    """A job that finishes between the wait's expiry and the status
+    read must report its terminal state with no timed_out marker (the
+    done_event check runs under the same lock finalize sets it)."""
+    run, gate, started = gated_runner()
+    s = make_server(run, concurrency=1)
+    try:
+        qid = s.submit("a", "racer")
+        assert wait_for(lambda: started == ["racer"])
+        job = s._jobs[qid]
+        results = []
+
+        def poller():
+            results.append(s.poll(qid, timeout_s=0.2))
+
+        t = threading.Thread(target=poller)
+        t.start()
+        gate.set()                      # finish while the poll waits
+        t.join(10)
+        job.done_event.wait(10)
+        r = results[0]
+        if r["state"] == "done":        # finish won the race
+            assert "timed_out" not in r
+        else:                           # expiry won: marker required
+            assert r["timed_out"] is True
+    finally:
+        gate.set()
+        s.stop()
+
+
+def test_cancel_after_done_is_noop_and_keeps_result():
+    s = make_server(lambda q, p, c: ["kept"], concurrency=1)
+    try:
+        qid = s.submit("a", "q")
+        r = s.poll(qid, timeout_s=20)
+        assert r["state"] == "done"
+        # cancel-after-done: refused, and the result survives
+        assert not s.cancel(qid)
+        r2 = s.poll(qid)
+        assert r2["state"] == "done" and r2["result"] == ["kept"]
+        assert s.stats()["tenants"]["a"]["cancelled"] == 0
+    finally:
+        s.stop()
+
+
+# ---------------------------------------------------------- deadlines
+
+
+def test_deadline_expires_queued_job_before_dispatch():
+    run, gate, started = gated_runner()
+    cfg = ServerConfig(max_concurrency=1, max_queue=8, stall_ms=0,
+                       watchdog_interval_s=0.02)
+    s = QueryServer(cfg, runner=run).start()
+    try:
+        blocker = s.submit("a", "blocker")
+        assert wait_for(lambda: started == ["blocker"])
+        doomed = s.submit("a", "doomed", deadline_s=0.05)
+        r = s.poll(doomed, timeout_s=20)
+        assert r["state"] == "failed", r
+        assert r["error"]["type"] == "QueryDeadlineExceeded"
+        assert r["error"]["reason"] == "deadline_expired_queued"
+        assert s.stats()["tenants"]["a"]["deadline"] == 1
+        gate.set()
+        assert s.poll(blocker, timeout_s=20)["state"] == "done"
+    finally:
+        gate.set()
+        s.stop()
+
+
+def test_deadline_cancels_running_job_cooperatively():
+    cfg = ServerConfig(max_concurrency=1, max_queue=8, stall_ms=0,
+                       watchdog_interval_s=0.02)
+    run, gate, started = gated_runner()
+    s = QueryServer(cfg, runner=run).start()
+    try:
+        # the gated runner polls ctx.check_cancel, so the watchdog's
+        # fired flag (or the cooperative deadline check) unwinds it
+        qid = s.submit("a", "slow", deadline_s=0.1)
+        r = s.poll(qid, timeout_s=20)
+        assert r["state"] == "failed", r
+        assert r["error"]["type"] == "QueryDeadlineExceeded"
+        assert r.get("cancel_reason") in ("deadline", None)
+        # a comfortable deadline does not perturb the query at all
+        ok = s.submit("a", "fine", deadline_s=30.0)
+        gate.set()
+        assert s.poll(ok, timeout_s=20)["state"] == "done"
+    finally:
+        gate.set()
+        s.stop()
+
+
+def test_deadline_via_cooperative_context_without_watchdog():
+    from spark_rapids_tpu.models import (QueryContext,
+                                         QueryDeadlineExceeded)
+    ctx = QueryContext("q-x", "t",
+                       deadline_ns=time.monotonic_ns() - 1)
+    with pytest.raises(QueryDeadlineExceeded):
+        ctx.check_cancel()
+    assert ctx.remaining_s() < 0
+    # QueryDeadlineExceeded is a QueryCancelled: old runners unwind
+    # through existing handlers unchanged
+    from spark_rapids_tpu.models import QueryCancelled
+    assert issubclass(QueryDeadlineExceeded, QueryCancelled)
+
+
 # --------------------------------------------------------- load shedding
 
 
@@ -829,6 +961,21 @@ def test_shim_server_entries_roundtrip():
         assert not bad["ok"]
         assert bad["error"]["type"] == "UnknownQuery"
         assert not J.server_cancel("nonexistent")
+        # graceful drain through the shim: report + cleared singleton,
+        # and a fresh server_start serves again (warm-restart contract)
+        report = json.loads(J.server_drain(5.0))
+        assert report["state"] == "drained"
+        assert srv.get_server() is None
+        assert J.server_start(max_concurrency=1, max_queue=4)
+        resp2 = json.loads(J.server_submit(
+            "jvm", "t_shim_echo", json.dumps({"v": 1}),
+            30.0))                      # explicit per-query deadline
+        assert resp2["ok"], resp2
+        assert json.loads(J.server_poll(resp2["query_id"],
+                                        20.0))["result"] == 1
+        assert json.loads(J.server_drain())["state"] == "drained"
+        assert json.loads(J.server_drain()) == {"state":
+                                                "not_running"}
     finally:
         J.server_stop()
         models.unregister_query("t_shim_echo")

@@ -59,7 +59,8 @@ def test_with_retry_attempts_backoff_restore():
         return state["v"]
 
     pol = R.RetryPolicy(base_backoff_s=0.01, backoff_multiplier=2.0,
-                        max_backoff_s=0.025, sleep=sleeps.append)
+                        max_backoff_s=0.025, jitter=False,
+                        sleep=sleeps.append)
     out = R.with_retry(fn, checkpoint=lambda: dict(state),
                        restore=lambda s: (restores.append(1),
                                           state.update(s)),
@@ -69,8 +70,32 @@ def test_with_retry_attempts_backoff_restore():
     assert calls == [1, 1, 1, 1]
     assert out == 1
     assert len(restores) == 3
-    # exponential backoff with cap: 10ms, 20ms, 25ms
+    # exponential backoff with cap (jitter off): 10ms, 20ms, 25ms
     assert sleeps == [0.01, 0.02, 0.025]
+
+
+def test_backoff_decorrelated_jitter_deterministic_with_rng():
+    # injected rng keeps the jittered schedule deterministic: each
+    # pause is drawn from [base, 3*prev], capped
+    pol = R.RetryPolicy(base_backoff_s=0.01, max_backoff_s=1.0,
+                        rng=lambda: 0.5)
+    b1 = pol.backoff_for(1)                 # det=0.01 -> U(0.01, 0.03)
+    assert b1 == pytest.approx(0.02)
+    b2 = pol.backoff_for(2, b1)             # U(0.01, 0.06) at 0.5
+    assert b2 == pytest.approx(0.035)
+    # the cap always holds, whatever the rng says
+    hot = R.RetryPolicy(base_backoff_s=0.01, max_backoff_s=0.04,
+                        rng=lambda: 1.0)
+    assert hot.backoff_for(5, 10.0) == pytest.approx(0.04)
+    # rng spread actually decorrelates: different draws, different
+    # pauses (the synchronized-retry-storm fix)
+    lo = R.RetryPolicy(base_backoff_s=0.01, max_backoff_s=1.0,
+                       rng=lambda: 0.0)
+    hi = R.RetryPolicy(base_backoff_s=0.01, max_backoff_s=1.0,
+                       rng=lambda: 0.99)
+    assert lo.backoff_for(3, 0.05) < hi.backoff_for(3, 0.05)
+    # zero-base policies still sleep nothing
+    assert R.RetryPolicy(base_backoff_s=0.0).backoff_for(3) == 0.0
 
 
 def test_with_retry_exhausted_carries_history():
