@@ -6,7 +6,7 @@ PY ?= python
 .PHONY: test fuzz native sanitizers bench bench-all dryrun tpu-lower \
         jni-test kudo-bench metrics-smoke trace-smoke chaos-smoke \
         perf-smoke doctor-smoke server-smoke lifeguard-smoke \
-        nightly-artifacts ci ci-nightly clean
+        ingest-smoke nightly-artifacts ci ci-nightly clean
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -103,6 +103,16 @@ server-smoke:
 lifeguard-smoke:
 	$(PY) scripts/lifeguard_smoke.py
 
+# production-ingest gate: seeded parquet written once, a file-backed
+# q3 (footer prune -> page decode -> device columns -> shared cached
+# pipeline) must return bytes identical to the in-memory catalog
+# runner both standalone and through the query server, match pyarrow's
+# decode of the same file, light up io_read spans + srt_io_* bytes/s
+# evidence in the metrics journal, and hold the arrow_ingest zero-copy
+# pointer-identity contract through the shim
+ingest-smoke:
+	$(PY) scripts/ingest_smoke.py
+
 # NOTE: jax.config.update, not the env var — this image's sitecustomize
 # pre-imports jax with the axon backend, so JAX_PLATFORMS=cpu is too
 # late.  XLA_FLAGS still works (read at backend init, which happens
@@ -125,7 +135,7 @@ dryrun:
 # BENCH_FIGHT_SECONDS=1 for a quick local run.
 ci: test fuzz native sanitizers tpu-lower jni-test dryrun metrics-smoke \
     trace-smoke chaos-smoke perf-smoke doctor-smoke server-smoke \
-    lifeguard-smoke
+    lifeguard-smoke ingest-smoke
 	$(PY) bench.py
 	@echo "ci: all gates green"
 

@@ -1,0 +1,186 @@
+"""Zero-copy Arrow C-data-interface ingest: wrap the buffers of a
+pyarrow RecordBatch (or anything exporting ``__arrow_c_array__``) as
+device Columns WITHOUT copying — the "hand batches across the JVM
+boundary for free" door ("Zero-Cost, Arrow-Enabled Data Interface for
+Apache Spark", PAPERS.md).
+
+Zero-copy contract:
+
+  * fixed-width data buffers, string offsets, and string chars become
+    numpy views ALIASING the Arrow memory (pointer identity holds:
+    ``col.data.__array_interface__['data'][0] ==
+    buffer.address + offset * itemsize``).  float64 stays zero-copy —
+    the raw-bits convention is a dtype VIEW of the same memory;
+    decimal128 likewise reshapes the 16-byte limbs in place.
+  * only layout mismatches copy: Arrow's packed validity bitmaps and
+    bit-packed booleans expand to the engine's unpacked uint8 masks
+    (an O(rows/8 -> rows) expansion, never a value copy).
+  * lifetime is safe without the caller keeping the batch alive: every
+    numpy view holds a reference to its ``pyarrow.Buffer``, which owns
+    the allocation — freeing the RecordBatch (or its handle in the
+    shim registry) cannot pull memory out from under a column.
+  * the views are HOST residents; the first device op uploads them
+    exactly like any host-constructed column.  ``jnp``-level ops
+    consume them unchanged (numpy arrays are valid pytree leaves).
+
+Sliced batches (``batch.offset != 0``) stay zero-copy for fixed-width
+columns (a numpy slice is pointer arithmetic); sliced STRING columns
+would need re-based offsets, so they take one normalizing copy and
+are the documented exception.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.columns.dtypes import DType
+
+
+class ArrowIngestException(ValueError):
+    """Typed ingest refusal: not an Arrow batch, or a column type /
+    layout outside the zero-copy contract."""
+
+
+def _np_view(buf, np_dtype, offset_items: int, count: int):
+    """Zero-copy numpy view of ``count`` items of a pyarrow Buffer
+    starting ``offset_items`` in (slices of numpy views stay views)."""
+    return np.frombuffer(buf, dtype=np_dtype)[
+        offset_items:offset_items + count]
+
+
+def _unpack_bits(buf, offset: int, count: int) -> np.ndarray:
+    """Arrow packed LSB-first bits -> unpacked uint8 0/1 (the one
+    layout conversion that must copy)."""
+    nbytes = (offset + count + 7) // 8
+    bits = np.unpackbits(np.frombuffer(buf, np.uint8, nbytes),
+                         bitorder="little")
+    return bits[offset:offset + count]
+
+
+def _wrap_column(arr, pa) -> Column:
+    t = arr.type
+    n = len(arr)
+    off = arr.offset
+    bufs = arr.buffers()
+    validity = None
+    if arr.null_count:
+        validity = _unpack_bits(bufs[0], off, n)
+
+    if pa.types.is_boolean(t):
+        data = _unpack_bits(bufs[1], off, n)
+        return Column(dtypes.BOOL8, n, data=data, validity=validity)
+
+    if pa.types.is_timestamp(t):
+        if t.unit != "us":
+            raise ArrowIngestException(
+                f"timestamp unit {t.unit!r} unsupported (Spark "
+                f"timestamps are micros)")
+        data = _np_view(bufs[1], np.int64, off, n)
+        return Column(dtypes.TIMESTAMP_MICROS, n, data=data,
+                      validity=validity)
+
+    fixed = _FIXED_TYPES(pa).get(t.id)
+    if fixed is not None:
+        dt, np_dt = fixed
+        data = _np_view(bufs[1], np_dt, off, n)
+        if dt.kind == dtypes.Kind.FLOAT64:
+            data = data.view(np.uint64)   # raw-bits convention, no copy
+        return Column(dt, n, data=data, validity=validity)
+
+    if pa.types.is_decimal128(t):
+        limbs = np.frombuffer(bufs[1], np.int32).reshape(-1, 4)[
+            off:off + n]
+        return Column(dtypes.decimal128(-t.scale), n, data=limbs,
+                      validity=validity)
+
+    if pa.types.is_string(t) or pa.types.is_binary(t):
+        offs = (_np_view(bufs[1], np.int32, off, n + 1)
+                if bufs[1] is not None else np.zeros(1, np.int32))
+        chars = np.frombuffer(bufs[2], np.uint8) if bufs[2] is not None \
+            else np.empty(0, np.uint8)
+        if len(offs) and int(offs[0]) != 0:
+            # sliced string column: re-base offsets + trim chars (the
+            # documented copy exception — offsets must start at 0)
+            base = int(offs[0])
+            chars = chars[base:int(offs[-1])].copy()
+            offs = (offs - base).astype(np.int32)
+        return Column(dtypes.STRING, n, data=chars, validity=validity,
+                      offsets=offs if len(offs)
+                      else np.zeros(1, np.int32))
+
+    raise ArrowIngestException(
+        f"arrow type {t} is outside the zero-copy ingest contract "
+        f"(fixed-width, bool, decimal128, utf8/binary)")
+
+
+def _FIXED_TYPES(pa):
+    """pyarrow type id -> (DType, numpy view dtype).  Built lazily so
+    the module imports without pyarrow present."""
+    global _FIXED_CACHE
+    if _FIXED_CACHE is None:
+        _FIXED_CACHE = {
+            pa.int8().id: (dtypes.INT8, np.int8),
+            pa.int16().id: (dtypes.INT16, np.int16),
+            pa.int32().id: (dtypes.INT32, np.int32),
+            pa.int64().id: (dtypes.INT64, np.int64),
+            pa.uint8().id: (dtypes.UINT8, np.uint8),
+            pa.uint16().id: (dtypes.UINT16, np.uint16),
+            pa.uint32().id: (dtypes.UINT32, np.uint32),
+            pa.uint64().id: (dtypes.UINT64, np.uint64),
+            pa.float32().id: (dtypes.FLOAT32, np.float32),
+            pa.float64().id: (dtypes.FLOAT64, np.float64),
+            pa.date32().id: (dtypes.TIMESTAMP_DAYS, np.int32),
+        }
+    return _FIXED_CACHE
+
+
+_FIXED_CACHE = None
+
+
+def ingest(obj) -> Tuple[List[Column], List[str]]:
+    """Wrap an Arrow batch as device columns without copying.
+
+    Accepts a ``pyarrow.RecordBatch``, a single-chunk
+    ``pyarrow.Table``, or ANY object exporting the Arrow C data
+    interface (``__arrow_c_array__`` — the PyCapsule protocol a
+    JVM/Spark caller's FFI surface speaks); the C-interface import is
+    itself zero-copy.  Returns ``(columns, names)``."""
+    try:
+        import pyarrow as pa
+    except ImportError as e:  # pragma: no cover - image ships pyarrow
+        raise ArrowIngestException(
+            f"arrow ingest requires pyarrow: {e}") from e
+    if isinstance(obj, pa.Table):
+        # refuse BEFORE any chunk combining: combine_chunks() would
+        # deep-copy a multi-chunk table, silently breaking the
+        # pointer-identity contract this door exists to keep
+        if any(obj.column(i).num_chunks > 1
+               for i in range(obj.num_columns)):
+            raise ArrowIngestException(
+                "multi-chunk Table cannot ingest zero-copy; hand over "
+                "RecordBatches individually")
+        batches = obj.to_batches()
+        batch = batches[0] if batches else pa.record_batch(
+            [pa.array([], f.type) for f in obj.schema], obj.schema)
+    elif isinstance(obj, pa.RecordBatch):
+        batch = obj
+    elif hasattr(obj, "__arrow_c_array__"):
+        batch = pa.record_batch(obj)   # zero-copy C-interface import
+    else:
+        raise ArrowIngestException(
+            f"cannot ingest {type(obj).__name__}: expected a pyarrow "
+            f"RecordBatch/Table or an __arrow_c_array__ exporter")
+    cols = [_wrap_column(batch.column(i), pa)
+            for i in range(batch.num_columns)]
+    return cols, list(batch.schema.names)
+
+
+def ingest_table(obj):
+    """:func:`ingest` packaged as a named :class:`Table`."""
+    from spark_rapids_tpu.columns.table import Table
+    cols, names = ingest(obj)
+    return Table(cols, names=names)

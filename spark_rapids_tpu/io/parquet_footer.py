@@ -11,7 +11,7 @@ columns, exactly the trimmed-footer contract."""
 from __future__ import annotations
 
 import struct
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, NamedTuple, Optional, Tuple
 
 # thrift compact type ids
 _T_BOOL_TRUE = 1
@@ -28,6 +28,14 @@ _T_MAP = 11
 _T_STRUCT = 12
 
 PARQUET_MAGIC = b"PAR1"
+
+
+class ParquetFooterException(ValueError):
+    """Typed footer failure: truncated thrift bytes, missing ``PAR1``
+    magic, an impossible footer length, or a schema shape the flat
+    reader cannot consume.  Subclasses :class:`ValueError` so callers
+    that predate the type (and the reference's IllegalArgumentException
+    shape) keep catching it."""
 
 
 class _Reader:
@@ -198,8 +206,20 @@ def _sval(sv, fid, default=None):
 
 
 def parse_footer(data: bytes):
-    """Thrift bytes (without the trailing length+PAR1) -> generic tree."""
-    return _Reader(data).read_struct()
+    """Thrift bytes (without the trailing length+PAR1) -> generic tree.
+    Truncated or garbage buffers raise the typed
+    :class:`ParquetFooterException` instead of a bare IndexError /
+    struct.error bubbling out of the compact-protocol reader."""
+    try:
+        return _Reader(data).read_struct()
+    except (IndexError, struct.error, ValueError, OverflowError,
+            MemoryError) as e:
+        # ValueError covers _Reader's unsupported-thrift-type raise on
+        # garbage type nibbles (_Reader never raises the typed
+        # exception itself, so this cannot double-wrap)
+        raise ParquetFooterException(
+            f"truncated or corrupt parquet footer "
+            f"({len(data)} bytes): {e}") from e
 
 
 def serialize_footer(tree) -> bytes:
@@ -208,22 +228,119 @@ def serialize_footer(tree) -> bytes:
     return bytes(w.out)
 
 
+def footer_tail_length(size: int, tail: bytes) -> int:
+    """Validate a parquet file's 8-byte tail against its size and
+    return the footer length — the ONE tail validation shared by the
+    file-handle path below and the range-reading columnar reader.
+    Every malformed-tail shape (short file, missing PAR1 magic, footer
+    length pointing past the start of the file) raises the typed
+    :class:`ParquetFooterException`."""
+    if size < 12:  # magic + 4-byte length + leading magic
+        raise ParquetFooterException(
+            f"not a parquet file: {size} bytes is shorter than "
+            f"the minimal header+footer")
+    if tail[4:] != PARQUET_MAGIC:
+        raise ParquetFooterException(
+            "not a parquet file: missing PAR1 magic")
+    flen = struct.unpack("<I", tail[:4])[0]
+    if flen + 8 > size:
+        raise ParquetFooterException(
+            f"footer length {flen} exceeds file size {size}")
+    return flen
+
+
 def read_footer_from_file(path: str):
-    """Extract and parse the footer from a .parquet file."""
+    """Extract and parse the footer from a .parquet file (typed
+    failures per :func:`footer_tail_length` / :func:`parse_footer`)."""
     with open(path, "rb") as f:
         f.seek(0, 2)
         size = f.tell()
-        f.seek(size - 8)
-        tail = f.read(8)
-        if tail[4:] != PARQUET_MAGIC:
-            raise ValueError("not a parquet file")
-        flen = struct.unpack("<I", tail[:4])[0]
+        if size >= 8:
+            f.seek(size - 8)
+        flen = footer_tail_length(size, f.read(8) if size >= 8
+                                  else b"")
         f.seek(size - 8 - flen)
         return parse_footer(f.read(flen))
 
 
 def _schema_elements(tree) -> List:
     return _sval(tree, 2)[2]
+
+
+# parquet physical Type ids (parquet.thrift enum Type)
+PHYS_BOOLEAN = 0
+PHYS_INT32 = 1
+PHYS_INT64 = 2
+PHYS_INT96 = 3
+PHYS_FLOAT = 4
+PHYS_DOUBLE = 5
+PHYS_BYTE_ARRAY = 6
+PHYS_FIXED_LEN_BYTE_ARRAY = 7
+
+PHYSICAL_TYPE_NAMES = {
+    PHYS_BOOLEAN: "boolean", PHYS_INT32: "int32", PHYS_INT64: "int64",
+    PHYS_INT96: "int96", PHYS_FLOAT: "float", PHYS_DOUBLE: "double",
+    PHYS_BYTE_ARRAY: "byte_array",
+    PHYS_FIXED_LEN_BYTE_ARRAY: "fixed_len_byte_array",
+}
+
+
+class SchemaLeaf(NamedTuple):
+    """One flat schema column as the page reader consumes it: the
+    (name, physical type, max definition level) mapping of the pruned
+    footer, plus the logical-type hints needed to pick a column dtype.
+    Leaf order is chunk order within every row group."""
+
+    name: str
+    physical_type: int          # PHYS_* id
+    max_def_level: int          # 1 when OPTIONAL, 0 when REQUIRED
+    type_length: int            # FIXED_LEN_BYTE_ARRAY width
+    converted_type: Optional[int]   # legacy ConvertedType id
+    scale: int                  # DECIMAL scale (parquet sign)
+    logical: Optional[tuple]    # raw LogicalType thrift subtree
+
+
+def schema_leaves(tree) -> List[SchemaLeaf]:
+    """Flat-schema leaf mapping of a (possibly pruned) footer tree —
+    the projection contract between the footer pruner and
+    ``io/parquet_reader``.  Nested and repeated schemas raise the
+    typed :class:`ParquetFooterException` (the flat reader cannot
+    place their values)."""
+    try:
+        elems = _schema_elements(tree)
+        out: List[SchemaLeaf] = []
+        i = 1
+        while i < len(elems):
+            e = elems[i]
+            name = _sval(e, 4, b"")
+            name = name.decode("utf-8", "replace") \
+                if isinstance(name, bytes) else str(name)
+            if _sval(e, 5, 0):
+                raise ParquetFooterException(
+                    f"nested column {name!r}: flat schemas only")
+            rep = _sval(e, 3, 0)
+            if rep == 2:  # REPEATED
+                raise ParquetFooterException(
+                    f"repeated column {name!r}: flat schemas only")
+            phys = _sval(e, 1)
+            if phys is None:
+                raise ParquetFooterException(
+                    f"schema element {name!r} has no physical type")
+            out.append(SchemaLeaf(name, int(phys),
+                                  1 if rep == 1 else 0,
+                                  int(_sval(e, 2, 0) or 0),
+                                  _sval(e, 6),
+                                  int(_sval(e, 7, 0) or 0),
+                                  _sval(e, 10)))
+            i += 1
+        return out
+    except (TypeError, IndexError, KeyError, AttributeError) as e:
+        # corrupt-but-parseable thrift: field shapes the walk above
+        # assumes (ints, lists, structs) can be anything — fold into
+        # the typed contract instead of a bare TypeError (the typed
+        # raises above are ValueError subclasses, outside this tuple)
+        raise ParquetFooterException(
+            f"malformed footer schema: {e}") from e
 
 
 def schema_names(tree) -> List[str]:

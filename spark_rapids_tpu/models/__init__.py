@@ -246,8 +246,29 @@ def _run_q7(params: dict, ctx: QueryContext):
     return _rows(*q(d))
 
 
+# file-backed variants (models/filesource.py): same seeded data via a
+# parquet round trip through io/parquet_reader, same cached pipeline,
+# byte-identical rows — registered thin so pyarrow loads on first use
+def _run_q3_file(params: dict, ctx: QueryContext):
+    from spark_rapids_tpu.models import filesource
+    return filesource.run_q3_file(params, ctx)
+
+
+def _run_q7_file(params: dict, ctx: QueryContext):
+    from spark_rapids_tpu.models import filesource
+    return filesource.run_q7_file(params, ctx)
+
+
+def _run_q9_file(params: dict, ctx: QueryContext):
+    from spark_rapids_tpu.models import filesource
+    return filesource.run_q9_file(params, ctx)
+
+
 register_query("tpcds_q3", _run_q3)
 register_query("tpcds_q5", _run_q5)
 register_query("tpcds_q7", _run_q7)
 register_query("tpcds_q9", _run_q9)
 register_query("tpcds_q72", _run_q72)
+register_query("tpcds_q3_file", _run_q3_file)
+register_query("tpcds_q7_file", _run_q7_file)
+register_query("tpcds_q9_file", _run_q9_file)

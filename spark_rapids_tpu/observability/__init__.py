@@ -254,6 +254,22 @@ SERVER_DRAIN = METRICS.counter(
     "srt_server_drain_total",
     "Query-server graceful-drain lifecycle markers (begin, end)",
     labels=("phase",))
+IO_READ_BYTES = METRICS.counter(
+    "srt_io_read_bytes_total",
+    "Bytes fetched by storage range reads (io/fileio.read_range)")
+IO_READ_TIME = METRICS.histogram(
+    "srt_io_read_ns", "Storage range-read latency",
+    buckets=DEFAULT_LATENCY_BUCKETS_NS)
+IO_FILES = METRICS.counter(
+    "srt_io_files_total",
+    "Parquet files fully decoded by io/parquet_reader")
+IO_PAGES = METRICS.counter(
+    "srt_io_pages_total", "Parquet pages decoded")
+IO_ROWS = METRICS.counter(
+    "srt_io_rows_total", "Rows materialized from parquet files")
+IO_DECODE_TIME = METRICS.counter(
+    "srt_io_decode_ns_total",
+    "Wall time decoding parquet pages into device columns")
 
 
 # ------------------------------------------------------------------ tracer
@@ -533,6 +549,37 @@ def record_task_leak(task_id: int, leaked_bytes: int,
     JOURNAL.emit("memory_leak", task=task_id,
                  leaked_bytes=leaked_bytes,
                  holders=list(holders)[:8])
+
+
+# ------------------------------------------------------------- ingest hooks
+# (io/ calls these; per the layering rule io imports this package,
+# never the reverse)
+
+
+def record_io_read(source: str, nbytes: int, dur_ns: int) -> None:
+    """Range-read hook (io/fileio.read_range): bytes fetched from
+    storage and the fetch latency."""
+    if not _SWITCH.enabled:
+        return
+    IO_READ_BYTES.inc(nbytes)
+    IO_READ_TIME.observe(dur_ns)
+    JOURNAL.emit("io_read", source=str(source)[-120:], bytes=nbytes,
+                 dur_ns=dur_ns, thread=threading.get_ident())
+
+
+def record_io_file(source: str, *, columns: int, pages: int, rows: int,
+                   read_bytes: int, decode_ns: int) -> None:
+    """Whole-file decode hook (io/parquet_reader.read_table): one
+    journal record + the srt_io_* counters per materialized file."""
+    if not _SWITCH.enabled:
+        return
+    IO_FILES.inc()
+    IO_PAGES.inc(pages)
+    IO_ROWS.inc(rows)
+    IO_DECODE_TIME.inc(decode_ns)
+    JOURNAL.emit("io_file", source=str(source)[-120:], columns=columns,
+                 pages=pages, rows=rows, read_bytes=read_bytes,
+                 decode_ns=decode_ns, thread=threading.get_ident())
 
 
 # ------------------------------------------------------- query server hooks

@@ -49,6 +49,26 @@ from spark_rapids_tpu.utils import fault_injection as _fi
 RETRYABLE = (exc.RetryOOMBase, exc.CudfException)
 SPLITTABLE = (exc.SplitAndRetryOOMBase,)
 
+# exception types that are TERMINAL even if they land inside the
+# retryable catch set (e.g. a subclass of CudfException raised for
+# deterministic input corruption): layers register theirs at import —
+# io/page_decode.ParquetDecodeException is the canonical case, since
+# re-reading a corrupt page yields the same bytes forever.
+NON_RETRYABLE: Tuple[type, ...] = ()
+
+
+def register_non_retryable(*exc_types: type) -> None:
+    """Declare exception types the drivers must escalate immediately
+    (idempotent; isinstance-checked before every retry decision)."""
+    global NON_RETRYABLE
+    merged = dict.fromkeys(NON_RETRYABLE)
+    merged.update(dict.fromkeys(exc_types))
+    NON_RETRYABLE = tuple(merged)
+
+
+def _is_non_retryable(e: BaseException) -> bool:
+    return isinstance(e, NON_RETRYABLE)
+
 
 @dataclass
 class Attempt:
@@ -288,6 +308,11 @@ def with_retry(fn: Callable, *args, name: Optional[str] = None,
             ep.finish("success")
             return out
         except RETRYABLE as e:
+            if _is_non_retryable(e):
+                if ep.history:
+                    ep.note_failure(e, "escalate")
+                    ep.finish("error")
+                raise
             ep.note_failure(e, "retry")
             last = e
         except SPLITTABLE as e:
@@ -363,6 +388,11 @@ def split_and_retry(fn: Callable[[Sequence], Any], batch: Sequence, *,
             part_failures = 0
             continue
         except RETRYABLE as e:
+            if _is_non_retryable(e):
+                if ep.history:
+                    ep.note_failure(e, "escalate")
+                    ep.finish("error")
+                raise
             part_failures += 1
             ep.note_failure(e, "retry", split_depth=depth,
                             batch_size=len(part))

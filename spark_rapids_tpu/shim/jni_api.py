@@ -118,6 +118,35 @@ def sort_merge_inner_join(left_handles: Sequence[int],
         return [REGISTRY.register(lc), REGISTRY.register(rc)]
 
 
+# --------------------------------------------------------------- ingest
+# (the storage-side doors: zero-copy Arrow C-interface hand-off and the
+# columnar parquet reader — reference NativeParquetJni surface)
+
+
+def arrow_ingest(batch) -> List[int]:
+    """Wrap an Arrow RecordBatch (or any ``__arrow_c_array__``
+    exporter) as device columns WITHOUT copying; returns one handle
+    per column.  The registry entries keep the Arrow buffers alive —
+    the caller may free its batch immediately."""
+    maybe_inject("arrow_ingest")
+    with op_range("arrow_ingest"):
+        from spark_rapids_tpu.io.arrow_cabi import ingest
+        cols, _names = ingest(batch)
+        return [REGISTRY.register(c) for c in cols]
+
+
+def parquet_read_table(path: str, columns=None,
+                       case_sensitive: bool = True) -> List[int]:
+    """Columnar parquet read with footer-pruned projection pushdown;
+    returns one handle per (kept) column, in file schema order."""
+    maybe_inject("parquet_read_table")
+    with op_range("parquet_read_table"):
+        from spark_rapids_tpu.io.parquet_reader import read_table
+        table = read_table(path, columns=columns,
+                           case_sensitive=case_sensitive)
+        return [REGISTRY.register(c) for c in table.columns]
+
+
 # --------------------------------------------------------- observability
 # (reference: RmmSpark getAndReset* + Profiler control surface; here the
 # unified registry/journal is exported to the JVM as text/JSON blobs so

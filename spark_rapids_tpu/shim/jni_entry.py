@@ -573,6 +573,25 @@ def hllpp_estimate(handle: int, precision: int) -> int:
         REGISTRY.get(handle), precision))
 
 
+def arrow_ingest(batch) -> List[int]:
+    """Zero-copy Arrow ingest door (embedded-interpreter twin of
+    jni_api.arrow_ingest): the JVM hands over a PyCapsule-protocol
+    object (``__arrow_c_array__``) or a pyarrow RecordBatch it built
+    through its own Arrow FFI; buffers are wrapped, never copied."""
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.arrow_ingest(batch)
+
+
+def parquet_read_table(path: str, columns: Sequence[str] = (),
+                       case_sensitive: bool = True) -> List[int]:
+    """File->columns door: columnar parquet read with projection
+    pushdown; an empty ``columns`` list reads every column."""
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.parquet_read_table(
+        str(path), columns=list(columns) or None,
+        case_sensitive=bool(case_sensitive))
+
+
 def parquet_footer_read_and_filter(data: bytes,
                                    keep_names: Sequence[str],
                                    case_sensitive: bool) -> bytes:

@@ -360,6 +360,70 @@ def render_server_table(events: List[dict],
     return out
 
 
+def io_rows(events: List[dict],
+            registry: Optional[dict]) -> List[dict]:
+    """Per-source ingest accounting from ``io_file`` journal events
+    (files, pages, rows, bytes, decode throughput), with the
+    registry's ``srt_io_read_ns`` p95 on the total row.  A row with
+    source '*' is the whole-process rollup."""
+    agg: Dict[str, dict] = {}
+
+    def row(source: str) -> dict:
+        return agg.setdefault(source, {
+            "source": source, "files": 0, "pages": 0, "rows": 0,
+            "read_bytes": 0, "decode_ns": 0})
+
+    for e in events:
+        if e.get("kind") != "io_file":
+            continue
+        src = str(e.get("source", "?")).rsplit("/", 1)[-1]
+        for a in (row("*"), row(src)):
+            a["files"] += 1
+            a["pages"] += int(e.get("pages", 0))
+            a["rows"] += int(e.get("rows", 0))
+            a["read_bytes"] += int(e.get("read_bytes", 0))
+            a["decode_ns"] += int(e.get("decode_ns", 0))
+    reads = (registry or {}).get("srt_io_read_ns") or {}
+    for s in reads.get("series", []):
+        a = row("*")
+        a["p95_read_ns"] = histogram_quantile(
+            reads.get("buckets", []), s.get("bucket_counts", []), 0.95)
+        a["reads"] = s.get("count", 0)
+    # derived AFTER every row exists (the registry loop above can
+    # create the '*' rollup on its own when no io_file event landed)
+    for a in agg.values():
+        a["decode_mb_s"] = (a["read_bytes"] / 1e6
+                            / (a["decode_ns"] / 1e9)
+                            if a["decode_ns"] else 0.0)
+    return sorted(agg.values(),
+                  key=lambda a: (a["source"] != "*", a["source"]))
+
+
+def render_io_table(events: List[dict],
+                    registry: Optional[dict]) -> List[str]:
+    """Ingest table: what storage cost per source file (rollup row
+    '*') — files, pages, rows, bytes, read p95, decode throughput."""
+    rows = io_rows(events, registry)
+    out = ["", "io ingest (per source file)", ""]
+    if not rows:
+        out.append("(no io activity recorded)")
+        return out
+    w = max(len(r["source"]) for r in rows)
+    hdr = (f"{'source':<{w}}  {'files':>5}  {'pages':>5}  "
+           f"{'rows':>9}  {'MB':>8}  {'p95_read_ms':>11}  "
+           f"{'decode_MB/s':>11}")
+    out.append(hdr)
+    out.append("-" * len(hdr))
+    for r in rows:
+        p95 = r.get("p95_read_ns")
+        out.append(
+            f"{r['source']:<{w}}  {r['files']:>5}  {r['pages']:>5}  "
+            f"{r['rows']:>9}  {r['read_bytes'] / 1e6:>8.2f}  "
+            f"{(p95 / 1e6 if p95 is not None else 0.0):>11.3f}  "
+            f"{r['decode_mb_s']:>11.1f}")
+    return out
+
+
 def render_event_table(events: List[dict]) -> List[str]:
     counts: Dict[str, int] = {}
     for e in events:
@@ -401,6 +465,7 @@ def build_report(records: List[dict]) -> dict:
         "retry_episodes": retry_episode_rows(events),
         "jit_cache": jit_cache_rows(registry),
         "server": server_rows(events, registry),
+        "io": io_rows(events, registry),
     }
 
 
@@ -429,6 +494,8 @@ def main(argv=None) -> int:
     if any(e.get("kind", "").startswith("server_") for e in events) \
             or (registry or {}).get("srt_server_queue_wait_ns"):
         lines += render_server_table(events, registry)
+    if any(e.get("kind") == "io_file" for e in events):
+        lines += render_io_table(events, registry)
     if registry is not None:
         lines += render_jit_cache_table(registry)
         lines += render_histogram_table(registry)

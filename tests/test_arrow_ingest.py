@@ -1,0 +1,190 @@
+"""Zero-copy Arrow C-interface ingest (io/arrow_cabi + the shim's
+arrow_ingest door): pointer identity over the wrapped buffers, value
+fidelity, lifetime across batch free and handle-registry churn
+(ISSUE 8 satellite)."""
+
+import gc
+
+import numpy as np
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+
+from spark_rapids_tpu.io.arrow_cabi import (ArrowIngestException,
+                                            ingest, ingest_table)
+
+
+def addr(np_arr):
+    return np_arr.__array_interface__["data"][0]
+
+
+def sample_batch(n=199, seed=2):
+    rng = np.random.default_rng(seed)
+    return pa.record_batch({
+        "i64": pa.array(rng.integers(-2**40, 2**40, n)),
+        "i32": pa.array(rng.integers(-2**31, 2**31, n)
+                        .astype(np.int32)),
+        "f64": pa.array([None if i % 5 == 0 else float(i) * 0.25
+                         for i in range(n)]),
+        "f32": pa.array(rng.normal(size=n).astype(np.float32)),
+        "b": pa.array([None if i % 7 == 0 else bool(i % 2)
+                       for i in range(n)]),
+        "s": pa.array([None if i % 3 == 0 else f"v{i % 17}"
+                       for i in range(n)]),
+        "ts": pa.array(rng.integers(0, 2**40, n),
+                       pa.timestamp("us")),
+        "dec": pa.array([i - 50 for i in range(n)],
+                        pa.decimal128(20, 2)),
+    })
+
+
+def test_pointer_identity_zero_copy():
+    batch = sample_batch()
+    cols, names = ingest(batch)
+    assert names == batch.schema.names
+    # fixed-width data buffers alias the arrow memory exactly
+    for i, name in enumerate(["i64", "i32", "f32", "ts"]):
+        j = batch.schema.names.index(name)
+        assert addr(cols[j].data) == batch.column(j).buffers()[1].address, name
+    # float64 raw-bits view aliases too (a dtype view, not a copy)
+    j = batch.schema.names.index("f64")
+    assert cols[j].data.dtype == np.uint64
+    assert addr(cols[j].data) == batch.column(j).buffers()[1].address
+    # string offsets and chars alias
+    j = batch.schema.names.index("s")
+    assert addr(cols[j].offsets) == batch.column(j).buffers()[1].address
+    assert addr(cols[j].data) == batch.column(j).buffers()[2].address
+    # decimal128 limbs alias
+    j = batch.schema.names.index("dec")
+    assert addr(cols[j].data) == batch.column(j).buffers()[1].address
+
+
+def test_values_and_nulls_round_trip():
+    batch = sample_batch()
+    cols, _ = ingest(batch)
+    for j, name in enumerate(batch.schema.names):
+        got = cols[j].to_pylist()
+        ref = batch.column(j).cast(pa.int64()).to_pylist() \
+            if name == "ts" else batch.column(j).to_pylist()
+        if name == "dec":
+            ref = [None if v is None else int(v.scaled_value)
+                   if hasattr(v, "scaled_value")
+                   else int(round(float(v) * 100))
+                   for v in batch.column(j).to_pylist()]
+        assert got == ref, name
+
+
+def test_sliced_batch_fixed_width_stays_zero_copy():
+    b = pa.record_batch({"x": pa.array(np.arange(100,
+                                                 dtype=np.int64))})
+    s = b.slice(10, 50)
+    cols, _ = ingest(s)
+    assert cols[0].to_pylist() == list(range(10, 60))
+    assert addr(cols[0].data) == \
+        s.column(0).buffers()[1].address + 10 * 8
+
+
+def test_c_interface_protocol_exporter():
+    class Exporter:
+        """Anything speaking __arrow_c_array__ — the PyCapsule shape a
+        JVM FFI hands across."""
+
+        def __init__(self, b):
+            self._b = b
+
+        def __arrow_c_array__(self, requested_schema=None):
+            return self._b.__arrow_c_array__(requested_schema)
+
+    b = pa.record_batch({"y": pa.array([1.5, None, 2.5])})
+    cols, names = ingest(Exporter(b))
+    assert names == ["y"] and cols[0].to_pylist() == [1.5, None, 2.5]
+
+
+def test_survives_batch_free():
+    batch = sample_batch(64)
+    cols, _ = ingest(batch)
+    ref = [c.to_pylist() for c in cols]
+    del batch
+    gc.collect()
+    assert [c.to_pylist() for c in cols] == ref
+
+
+def test_shim_handle_registry_churn():
+    """arrow_ingest through the shim: handles live through registry
+    churn, survive the source batch being freed, and double-free stays
+    a clean error."""
+    from spark_rapids_tpu.shim import jni_api, jni_entry
+    from spark_rapids_tpu.shim.handles import REGISTRY
+    before = REGISTRY.live_count()
+    batch = sample_batch(128)
+    handles = jni_entry.arrow_ingest(batch)
+    assert len(handles) == batch.num_columns
+    ref = jni_api.column_to_host(handles[0])
+    del batch
+    gc.collect()
+    # churn: allocate and free other handles around the ingested ones
+    other = [jni_entry.from_longs(list(range(32))) for _ in range(8)]
+    for h in other:
+        jni_entry.free(h)
+    assert jni_api.column_to_host(handles[0]) == ref
+    # an op over an ingested handle works end to end
+    out = jni_api.murmur_hash3_32(42, [handles[0], handles[1]])
+    jni_entry.free(out)
+    for h in handles:
+        jni_entry.free(h)
+    with pytest.raises(ValueError):
+        jni_entry.free(handles[0])
+    assert REGISTRY.live_count() == before
+
+
+def test_ingest_table_and_empty_batch():
+    t = pa.table({"a": pa.array([], pa.int64()),
+                  "s": pa.array([], pa.string())})
+    table = ingest_table(t)
+    assert table.num_rows == 0 and table.names == ["a", "s"]
+    assert table.column("s").to_pylist() == []
+
+
+def test_typed_refusals():
+    with pytest.raises(ArrowIngestException, match="cannot ingest"):
+        ingest(42)
+    with pytest.raises(ArrowIngestException, match="unit"):
+        ingest(pa.record_batch({"t": pa.array([1],
+                                              pa.timestamp("ns"))}))
+    with pytest.raises(ArrowIngestException, match="contract"):
+        ingest(pa.record_batch(
+            {"l": pa.array([[1]], pa.list_(pa.int64()))}))
+    # a multi-chunk Table would have to be deep-copied to wrap —
+    # refused typed instead of silently breaking pointer identity
+    multi = pa.concat_tables([pa.table({"x": pa.array([1, 2])}),
+                              pa.table({"x": pa.array([3])})])
+    assert multi.column("x").num_chunks == 2
+    with pytest.raises(ArrowIngestException, match="multi-chunk"):
+        ingest(multi)
+    # a single-chunk Table ingests zero-copy like a batch
+    one = pa.table({"x": pa.array(np.arange(8, dtype=np.int64))})
+    cols, _ = ingest(one)
+    assert cols[0].to_pylist() == list(range(8))
+
+
+def test_ingest_feeds_kudo_shuffle():
+    """Ingested columns flow through the existing engine: kudo write
+    -> merge round trip of an Arrow-ingested table."""
+    import io as _io
+
+    from spark_rapids_tpu.shuffle import kudo
+    from spark_rapids_tpu.shuffle.schema import Field
+    batch = pa.record_batch({
+        "k": pa.array(np.arange(40, dtype=np.int64)),
+        "s": pa.array([None if i % 4 == 0 else f"r{i}"
+                       for i in range(40)]),
+    })
+    cols, _ = ingest(batch)
+    buf = _io.BytesIO()
+    kudo.write_to_stream(cols, buf, 0, 40)
+    buf.seek(0)
+    merged = kudo.merge_to_table(
+        kudo.read_tables(buf),
+        [Field(cols[0].dtype), Field(cols[1].dtype)])
+    assert merged.columns[0].to_pylist() == cols[0].to_pylist()
+    assert merged.columns[1].to_pylist() == cols[1].to_pylist()

@@ -1,0 +1,444 @@
+"""Golden round-trips of io/parquet_reader against pyarrow (the
+independent oracle): nullable fixed-width, plain + dictionary strings,
+empty row groups, a wide 212-column schema, projection pushdown,
+typed decode failures, and the fixed-width throughput contract
+(ISSUE 8 tentpole + acceptance)."""
+
+import time
+
+import numpy as np
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+pq = pytest.importorskip("pyarrow.parquet")
+
+from spark_rapids_tpu import observability as obs
+from spark_rapids_tpu.io.page_decode import ParquetDecodeException
+from spark_rapids_tpu.io.parquet_footer import (ParquetFooterException,
+                                                schema_leaves,
+                                                read_footer_from_file)
+from spark_rapids_tpu.io.parquet_reader import read_table
+
+
+def _ref_pylist(ref, name):
+    c = ref.column(name)
+    if pa.types.is_date32(c.type):
+        c = c.cast(pa.int32())
+    elif pa.types.is_timestamp(c.type):
+        c = c.cast(pa.int64())
+    return c.to_pylist()
+
+
+def assert_golden(path, columns=None):
+    """Our reader vs pyarrow's own decode of the same file."""
+    got = read_table(path, columns=columns)
+    ref = pq.read_table(path, columns=columns)
+    assert got.names == ref.schema.names
+    assert got.num_rows == ref.num_rows
+    for name in ref.schema.names:
+        g = got.column(name).to_pylist()
+        r = _ref_pylist(ref, name)
+        for i, (a, b) in enumerate(zip(g, r)):
+            if isinstance(b, float) and a is not None and b is not None:
+                assert a == b or (np.isnan(a) and np.isnan(b)), \
+                    (name, i, a, b)
+            else:
+                assert a == b, (name, i, a, b)
+    return got
+
+
+def mixed_table(n, seed=0, with_nulls=True):
+    rng = np.random.default_rng(seed)
+
+    def nullify(vals, k):
+        return [None if with_nulls and i % k == 0 else v
+                for i, v in enumerate(vals)]
+
+    return pa.table({
+        "i64": pa.array(nullify([int(v) for v in
+                                 rng.integers(-2**62, 2**62, n)], 7),
+                        pa.int64()),
+        "i32": pa.array(rng.integers(-2**31, 2**31, n)
+                        .astype(np.int32)),
+        "i16": pa.array(rng.integers(-2**15, 2**15, n)
+                        .astype(np.int16)),
+        "i8": pa.array(nullify([int(v) for v in
+                                rng.integers(-128, 128, n)], 5),
+                       pa.int8()),
+        "f64": pa.array(nullify([float(v) for v in
+                                 rng.normal(size=n)], 3),
+                        pa.float64()),
+        "f32": pa.array(rng.normal(size=n).astype(np.float32)),
+        "b": pa.array(nullify([bool(v) for v in
+                               rng.integers(0, 2, n)], 11),
+                      pa.bool_()),
+        "s": pa.array(nullify([f"s{i * 37 % 101}" for i in range(n)],
+                              4), pa.string()),
+        "d32": pa.array(rng.integers(0, 20000, n).astype(np.int32),
+                        pa.date32()),
+        "ts": pa.array(nullify([int(v) for v in
+                                rng.integers(0, 2**40, n)], 9),
+                       pa.timestamp("us")),
+    })
+
+
+@pytest.mark.parametrize("kw", [
+    dict(use_dictionary=False, compression="NONE"),
+    dict(use_dictionary=True, compression="NONE"),
+    dict(use_dictionary=True, compression="NONE",
+         data_page_version="2.0"),
+    dict(use_dictionary=True, compression="NONE", row_group_size=64),
+], ids=["plain", "dict", "v2", "multi_rg"])
+def test_golden_mixed(tmp_path, kw):
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(mixed_table(500), path, **kw)
+    assert_golden(path)
+
+
+def test_golden_snappy(tmp_path):
+    if not pa.Codec.is_available("snappy"):
+        pytest.skip("snappy codec unavailable")
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(mixed_table(500), path, compression="snappy")
+    assert_golden(path)
+
+
+def test_all_null_and_no_null_pages(tmp_path):
+    path = str(tmp_path / "t.parquet")
+    n = 200
+    t = pa.table({
+        "all_null": pa.array([None] * n, pa.int64()),
+        "none_null": pa.array(list(range(n)), pa.int64()),
+        "null_str": pa.array([None] * n, pa.string()),
+    })
+    pq.write_table(t, path, compression="NONE")
+    got = assert_golden(path)
+    assert got.column("all_null").null_count() == n
+    assert got.column("none_null").validity is None
+
+
+def test_empty_table_and_empty_strings(tmp_path):
+    path = str(tmp_path / "e.parquet")
+    pq.write_table(mixed_table(7).slice(0, 0), path,
+                   compression="NONE")
+    got = assert_golden(path)
+    assert got.num_rows == 0 and got.num_columns == 10
+    path2 = str(tmp_path / "s.parquet")
+    pq.write_table(pa.table({"s": pa.array(["", "", "x", ""]),
+                             "t": pa.array([None, "", None, "yy"])}),
+                   path2, compression="NONE")
+    assert_golden(path2)
+
+
+def test_plain_vs_dictionary_strings_identical(tmp_path):
+    vals = [None if i % 5 == 0 else f"v{i % 13}" for i in range(300)]
+    t = pa.table({"s": pa.array(vals, pa.string())})
+    p1, p2 = str(tmp_path / "p.parquet"), str(tmp_path / "d.parquet")
+    pq.write_table(t, p1, use_dictionary=False, compression="NONE")
+    pq.write_table(t, p2, use_dictionary=True, compression="NONE")
+    a = read_table(p1).column("s").to_pylist()
+    b = read_table(p2).column("s").to_pylist()
+    assert a == b == vals
+
+
+def test_wide_212_column_schema(tmp_path):
+    """The SF100-shaped wide schema from the acceptance criteria."""
+    rng = np.random.default_rng(212)
+    n = 64
+    cols = {}
+    for i in range(212):
+        kind = i % 5
+        if kind == 0:
+            arr = pa.array([None if j % 7 == i % 7 else int(v)
+                            for j, v in enumerate(
+                                rng.integers(-2**50, 2**50, n))],
+                           pa.int64())
+        elif kind == 1:
+            arr = pa.array(rng.integers(-2**31, 2**31, n)
+                           .astype(np.int32))
+        elif kind == 2:
+            arr = pa.array([None if j % 5 == i % 5 else float(v)
+                            for j, v in enumerate(rng.normal(size=n))],
+                           pa.float64())
+        elif kind == 3:
+            arr = pa.array([bool(v) for v in rng.integers(0, 2, n)],
+                           pa.bool_())
+        else:
+            arr = pa.array([None if j % 6 == i % 6 else
+                            f"c{i}_{j % 9}" for j in range(n)],
+                           pa.string())
+        cols[f"c{i:03d}"] = arr
+    path = str(tmp_path / "wide.parquet")
+    pq.write_table(pa.table(cols), path, compression="NONE")
+    got = assert_golden(path)
+    assert got.num_columns == 212
+
+
+def test_projection_pushdown_prunes_fetches(tmp_path):
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(mixed_table(300), path, compression="NONE")
+    obs.enable()
+    obs.reset()
+    try:
+        read_table(path)
+        all_bytes = obs.METRICS.snapshot()[
+            "srt_io_read_bytes_total"]["series"][0]["value"]
+        obs.reset()
+        got = assert_golden(path, columns=["i64", "s"])
+        proj_bytes = obs.METRICS.snapshot()[
+            "srt_io_read_bytes_total"]["series"][0]["value"]
+    finally:
+        obs.disable()
+    assert got.names == ["i64", "s"]
+    # pruned chunks are never fetched: the projected read moves less
+    assert proj_bytes < all_bytes
+    with pytest.raises(ParquetFooterException, match="nope"):
+        read_table(path, columns=["i64", "nope"])
+
+
+def test_io_metrics_and_span_surface(tmp_path):
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(mixed_table(200), path, compression="NONE")
+    obs.enable()
+    obs.enable_tracing()
+    obs.reset()
+    try:
+        read_table(path)
+        snap = obs.METRICS.snapshot()
+        for fam in ("srt_io_read_bytes_total", "srt_io_files_total",
+                    "srt_io_pages_total", "srt_io_rows_total",
+                    "srt_io_decode_ns_total"):
+            assert snap[fam]["series"][0]["value"] > 0, fam
+        kinds = obs.JOURNAL.counts_by_kind()
+        assert kinds.get("io_read", 0) > 0
+        assert kinds.get("io_file", 0) == 1
+        spans = [r for r in obs.TRACER.records()
+                 if r["name"] == "io_read"]
+        assert len(spans) == 1 and spans[0]["attrs"]["rows"] == 200
+        # metrics_report io table folds the journal
+        from spark_rapids_tpu.tools.metrics_report import (build_report,
+                                                           io_rows)
+        recs = obs.JOURNAL.records() + [
+            {"kind": "registry_snapshot", "registry": snap}]
+        rows = io_rows(recs, snap)
+        rollup = rows[0]
+        assert rollup["source"] == "*" and rollup["files"] == 1
+        assert rollup["read_bytes"] > 0 and rollup["rows"] == 200
+        assert rollup["decode_mb_s"] > 0
+        assert "io" in build_report(recs)
+    finally:
+        obs.disable()
+        obs.disable_tracing()
+        obs.reset()
+
+
+def test_schema_leaves_mapping(tmp_path):
+    path = str(tmp_path / "t.parquet")
+    t = pa.table({"a": pa.array([1], pa.int64()),
+                  "b": pa.array(["x"]),
+                  "c": pa.array([1.0], pa.float32())})
+    pq.write_table(t, path, compression="NONE")
+    leaves = schema_leaves(read_footer_from_file(path))
+    assert [(lf.name, lf.physical_type, lf.max_def_level)
+            for lf in leaves] == [("a", 2, 1), ("b", 6, 1),
+                                  ("c", 4, 1)]
+
+
+def test_footer_typed_exceptions(tmp_path):
+    from spark_rapids_tpu.io import parquet_footer as pf
+    bad = tmp_path / "x.parquet"
+    bad.write_bytes(b"PAR1 not really parquet PAR!")
+    with pytest.raises(ParquetFooterException, match="not a parquet"):
+        pf.read_footer_from_file(str(bad))
+    short = tmp_path / "s.parquet"
+    short.write_bytes(b"PAR1")
+    with pytest.raises(ParquetFooterException):
+        pf.read_footer_from_file(str(short))
+    # truncated thrift bytes raise typed, not IndexError
+    with pytest.raises(ParquetFooterException, match="truncated"):
+        pf.parse_footer(b"\x19\x4c\x15")
+    # garbage type nibble raises typed, not bare ValueError
+    with pytest.raises(ParquetFooterException):
+        pf.parse_footer(b"\x1d\x00")
+    # truncated double field raises typed, not struct.error
+    with pytest.raises(ParquetFooterException):
+        pf.parse_footer(b"\x17\x00\x00")
+    # footer length pointing past the file start
+    lying = tmp_path / "l.parquet"
+    lying.write_bytes(b"PAR1" + b"\x00" * 8
+                      + (2 ** 20).to_bytes(4, "little") + b"PAR1")
+    with pytest.raises(ParquetFooterException, match="exceeds"):
+        pf.read_footer_from_file(str(lying))
+
+
+def test_page_header_garbage_raises_typed():
+    from spark_rapids_tpu.io.parquet_reader import _parse_struct_at
+    for garbage in (b"\xff" * 8,        # runaway field deltas
+                    b"\x17\x00\x00",    # double field, 3 bytes left
+                    b"\x1d\x00",        # unsupported type nibble
+                    b"\x15"):           # truncated varint
+        with pytest.raises(ParquetDecodeException):
+            _parse_struct_at(garbage, 0)
+
+
+def test_truncated_chunk_raises_decode_exception(tmp_path):
+    src = tmp_path / "t.parquet"
+    pq.write_table(mixed_table(300, with_nulls=False), str(src),
+                   compression="NONE")
+    raw = src.read_bytes()
+    # garbage the FIRST PAGE HEADER (offset 4, right after the magic):
+    # the thrift parse either fails outright or yields impossible page
+    # sizes — both must surface as the typed decode exception
+    broken = tmp_path / "b.parquet"
+    broken.write_bytes(raw[:4] + b"\xff" * 24 + raw[28:])
+    with pytest.raises((ParquetDecodeException,
+                        ParquetFooterException)):
+        read_table(str(broken))
+
+
+def test_decode_exception_is_non_retryable():
+    from spark_rapids_tpu.memory.exceptions import CudfException
+    from spark_rapids_tpu.robustness import retry
+    # the exception is an ENGINE exception (inside the drivers'
+    # RETRYABLE catch set) — only the non-retryable registry stops a
+    # futile re-read of the same corrupt bytes
+    assert issubclass(ParquetDecodeException, CudfException)
+    assert issubclass(ParquetDecodeException, retry.RETRYABLE)
+    assert ParquetDecodeException in retry.NON_RETRYABLE
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise ParquetDecodeException("corrupt page")
+
+    with pytest.raises(ParquetDecodeException):
+        retry.with_retry(boom, name="ingest")
+    assert len(calls) == 1  # never re-attempted
+
+    with pytest.raises(ParquetDecodeException):
+        retry.split_and_retry(lambda part: boom(), [1, 2, 3, 4],
+                              name="ingest_batch")
+    assert len(calls) == 2  # no splits, no re-runs
+
+
+def test_io_report_rows_without_io_file_events():
+    """Registry-only input (every decode failed before record_io_file)
+    must still render: the '*' rollup row carries all derived keys."""
+    from spark_rapids_tpu.tools.metrics_report import (io_rows,
+                                                       render_io_table)
+    reg = {"srt_io_read_ns": {
+        "kind": "histogram", "buckets": [1000, 10000],
+        "series": [{"labels": [], "bucket_counts": [2, 1, 0],
+                    "sum": 5000, "count": 3}]}}
+    rows = io_rows([], reg)
+    assert rows[0]["source"] == "*"
+    assert rows[0]["decode_mb_s"] == 0.0
+    assert rows[0]["reads"] == 3
+    render_io_table([], reg)  # must not raise
+
+
+def test_non_micros_timestamp_refused_typed(tmp_path):
+    """timestamp[ns] (the pandas default) must refuse typed, not decode
+    raw nanos into an int64 that is silently 1000x off."""
+    path = str(tmp_path / "ns.parquet")
+    pq.write_table(pa.table({"t": pa.array([1577836800_000_000_000],
+                                           pa.timestamp("ns"))}),
+                   path, compression="NONE",
+                   coerce_timestamps=None)
+    with pytest.raises(ParquetDecodeException, match="micros"):
+        read_table(path)
+
+
+def test_duplicate_requested_columns_dedup(tmp_path):
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({"a": pa.array([1, 2]),
+                             "b": pa.array([3, 4])}), path,
+                   compression="NONE")
+    got = read_table(path, columns=["a", "a"])
+    assert got.names == ["a"] and got.column("a").to_pylist() == [1, 2]
+    # and a real miss still names the missing column, not []
+    with pytest.raises(ParquetFooterException, match="nope"):
+        read_table(path, columns=["a", "nope", "nope"])
+
+
+def test_chunk_outside_file_raises_typed(tmp_path):
+    """A footer whose chunk offsets point outside the file (here:
+    bytes removed from the data region) must fail typed, not as a
+    fileio EOFError/range ValueError."""
+    src = tmp_path / "t.parquet"
+    pq.write_table(mixed_table(400, with_nulls=False), str(src),
+                   compression="NONE")
+    raw = src.read_bytes()
+    shrunk = tmp_path / "s.parquet"
+    shrunk.write_bytes(raw[:64] + raw[1064:])  # footer intact
+    with pytest.raises((ParquetDecodeException,
+                        ParquetFooterException)):
+        read_table(str(shrunk))
+
+
+def test_malformed_footer_tree_raises_typed():
+    """Corrupt-but-parseable thrift (wrong field shapes) folds into
+    the typed contract, never a bare TypeError/NoneType error."""
+    with pytest.raises(ParquetFooterException):
+        schema_leaves(("struct", {}))          # no schema list at all
+    with pytest.raises(ParquetFooterException):
+        schema_leaves(("struct", {2: (9, ("list", 12, [
+            ("struct", {5: (5, 1)}),
+            ("struct", {1: (5, 1), 3: (5, 1),
+                        7: (12, ("struct", {}))}),  # scale = struct
+        ]))}))
+
+
+def test_nested_schema_refused_typed(tmp_path):
+    path = str(tmp_path / "n.parquet")
+    t = pa.table({"s": pa.array([{"a": 1}],
+                                pa.struct([("a", pa.int32())]))})
+    pq.write_table(t, path, compression="NONE")
+    with pytest.raises(ParquetFooterException, match="flat"):
+        read_table(path)
+
+
+def test_fixed_width_throughput_within_5x_of_pyarrow(tmp_path):
+    """Acceptance: 1e6-row fixed-width decode within 5x pyarrow (no
+    per-value python on the hot path).  A small absolute floor absorbs
+    shared-CI timer noise when pyarrow is very fast."""
+    rng = np.random.default_rng(5)
+    n = 1_000_000
+    path = str(tmp_path / "big.parquet")
+    pq.write_table(pa.table({
+        "a": pa.array(rng.integers(0, 2**60, n)),
+        "b": pa.array(rng.normal(size=n)),
+        "c": pa.array(rng.integers(0, 2**31, n).astype(np.int32)),
+    }), path, use_dictionary=False, compression="NONE")
+    import jax
+    # warm both paths once (imports, allocator)
+    jax.block_until_ready([c.data for c in read_table(path).columns])
+    pq.read_table(path)
+    t0 = time.perf_counter()
+    ours = read_table(path)
+    jax.block_until_ready([c.data for c in ours.columns])
+    t_ours = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    ref = pq.read_table(path)
+    t_pa = time.perf_counter() - t0
+    assert t_ours <= max(5 * t_pa, 0.75), \
+        f"decode {t_ours:.3f}s vs pyarrow {t_pa:.3f}s"
+    # and the bytes match exactly
+    assert np.array_equal(np.asarray(ours.column("a").data),
+                          ref.column("a").to_numpy())
+
+
+def test_file_backed_catalog_byte_identity(tmp_path, monkeypatch):
+    """models catalog: q3/q9 file-backed variants byte-identical to
+    the in-memory runners (the ingest-smoke property, in-tier)."""
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_INGEST_DIR", str(tmp_path))
+    from spark_rapids_tpu.models import filesource, run_catalog_query
+    filesource.reset_dir()
+    try:
+        params = {"rows": 512, "seed": 3}
+        assert run_catalog_query("tpcds_q3", params) == \
+            run_catalog_query("tpcds_q3_file", params)
+        assert run_catalog_query("tpcds_q9", {"rows": 512}) == \
+            run_catalog_query("tpcds_q9_file", {"rows": 512})
+    finally:
+        filesource.reset_dir()
