@@ -60,33 +60,22 @@ def _numpy_to_rows_reference(table, layout):
 
 
 def _calib_cache_path():
-    import os
-    import tempfile
-    return os.environ.get(
-        "SPARK_RAPIDS_TPU_CALIB_CACHE",
-        os.path.join(tempfile.gettempdir(), "srt_rowconv_calib.json"))
+    from spark_rapids_tpu.perf import calibrate
+    return calibrate.cache_path()
 
 
 def _calib_cache_get(key: str):
-    """Unexpired cached verdict string for ``key``, or None.  Every
-    verdict expires (SPARK_RAPIDS_TPU_CALIB_CACHE_TTL, default 1 day):
-    even a legitimate timing verdict should be re-earned occasionally,
-    and a budget-exceeded verdict must not pin the stack path forever."""
-    from bench_cache import env_float, fresh, load_json
-    d = load_json(_calib_cache_path()) or {}
-    rec = d.get(key)
-    if isinstance(rec, dict) and isinstance(rec.get("verdict"), str) and \
-            fresh(rec, env_float("SPARK_RAPIDS_TPU_CALIB_CACHE_TTL",
-                                 86400.0)):
-        return rec["verdict"]
-    return None
+    """Unexpired cached verdict string for ``key``, or None.  The
+    load/TTL/store logic moved to the generalized calibrator
+    (spark_rapids_tpu/perf/calibrate.py, ISSUE 9) — same file, same
+    record shape, shared with the join/JSON kernel-path verdicts."""
+    from spark_rapids_tpu.perf import calibrate
+    return calibrate.cached_verdict(key)
 
 
 def _calib_cache_store(key: str, verdict: str):
-    from bench_cache import load_json, store_json
-    d = load_json(_calib_cache_path()) or {}
-    d[key] = {"verdict": verdict, "t": time.time()}
-    store_json(_calib_cache_path(), d)
+    from spark_rapids_tpu.perf import calibrate
+    calibrate.store_verdict(key, verdict)
 
 
 def _calibrate_rowconv_path(table, layout):

@@ -151,8 +151,92 @@ def main() -> int:
     for line in render_jit_cache_table(snap):
         print(line)
 
+    # ---- ISSUE 9: calibrated join path + zero-recompile batches -----
+    import tempfile
+
+    from spark_rapids_tpu.columns.column import Column
+    from spark_rapids_tpu.columns.table import Table
+    from spark_rapids_tpu.ops import joins
+    from spark_rapids_tpu.perf import calibrate
+
+    calib_file = os.path.join(tempfile.mkdtemp(prefix="srt_smoke_"),
+                              "calib.json")
+    os.environ["SPARK_RAPIDS_TPU_CALIB_CACHE"] = calib_file
+    calibrate.forget()
+    rng = np.random.default_rng(17)
+    n_l, keyspace = 1_000_000, 100_000
+    lk = rng.integers(0, keyspace, n_l, dtype=np.int64)
+    left = Table([Column.from_numpy(lk)])
+    right = Table([Column.from_numpy(
+        np.arange(keyspace, dtype=np.int64))])
+
+    # (a) the 1e6-row join must EARN a measured, non-host-rank path
+    li, ri = joins.sort_merge_inner_join(left, right)
+    jax.block_until_ready((li, ri))
+    snap = obs.METRICS.snapshot()
+    jp = [tuple(s["labels"])
+          for s in snap.get("srt_kernel_path_total", {}).get("series",
+                                                             [])]
+    picked = [p for op, p in jp if op == "join.inner"]
+    if not picked:
+        fail("join.inner recorded no kernel path")
+    if picked[-1:] == ["host_rank"] and set(picked) == {"host_rank"}:
+        fail(f"1e6-row join stayed on the host rank path: {picked}")
+    if not os.path.exists(calib_file):
+        fail("join calibration verdict was not persisted")
+
+    # (b) device_hash second same-bucket batch: ZERO new executables
+    os.environ["SPARK_RAPIDS_TPU_PATH_JOIN_INNER"] = "device_hash"
+    try:
+        lj1, rj1 = joins.sort_merge_inner_join(left, right)
+        jax.block_until_ready((lj1, rj1))
+        s3 = CACHE.stats()
+        n_l2 = 950_000                      # same power-of-two bucket
+        from spark_rapids_tpu.perf.jit_cache import bucket_rows as _br
+        if _br(n_l2) != _br(n_l):
+            fail("join smoke misconfigured: batches in different "
+                 "buckets")
+        left2 = Table([Column.from_numpy(lk[:n_l2])])
+        lj2, rj2 = joins.sort_merge_inner_join(left2, right)
+        jax.block_until_ready((lj2, rj2))
+        s4 = CACHE.stats()
+        if s4["compiles"] != s3["compiles"]:
+            fail(f"second same-bucket join batch compiled "
+                 f"{s4['compiles'] - s3['compiles']} new executable(s)")
+        # byte-identity vs the host rank oracle
+        lo, ro = joins._sort_merge_inner_join_host(left2, right)
+        if not (np.array_equal(np.asarray(lj2), np.asarray(lo))
+                and np.array_equal(np.asarray(rj2), np.asarray(ro))):
+            fail("device_hash join differs from the host rank oracle")
+    finally:
+        os.environ.pop("SPARK_RAPIDS_TPU_PATH_JOIN_INNER", None)
+
+    # (c) tokenizer batches compile nothing (pure numpy engine)
+    from spark_rapids_tpu.ops import json_tokenizer as JT
+    docs = ['{"a": %d, "b": "x%d"}' % (i, i) for i in range(20_000)]
+    jcol = Column.from_strings(docs)
+    s5 = CACHE.stats()
+    out_a = JT.get_json_object_tokenized(jcol, "$.b")
+    out_b = JT.get_json_object_tokenized(
+        Column.from_strings(docs[:15_000]), "$.b")
+    if CACHE.stats()["compiles"] != s5["compiles"]:
+        fail("tokenizer batches must compile zero executables")
+    if out_a.to_pylist()[7] != "x7" or out_b.to_pylist()[7] != "x7":
+        fail("tokenizer smoke extraction wrong")
+
+    # (d) the kernel-path metric + report table light up
+    text = obs.expose_text()
+    if "srt_kernel_path_total" not in text:
+        fail("srt_kernel_path_total missing from exposition")
+    from spark_rapids_tpu.tools.metrics_report import \
+        render_kernel_path_table
+    for line in render_kernel_path_table(obs.METRICS.snapshot()):
+        print(line)
+
     print(f"perf-smoke: OK (batch1 {batch1_s:.2f}s with "
-          f"{s1['compiles']} compiles, batch2 {batch2_s:.2f}s with 0)")
+          f"{s1['compiles']} compiles, batch2 {batch2_s:.2f}s with 0; "
+          f"join path(s) {sorted(set(picked))}, second-bucket joins "
+          f"and tokenizer: 0 new executables)")
     return 0
 
 

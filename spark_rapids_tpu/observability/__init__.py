@@ -183,6 +183,11 @@ JIT_COMPILE_TIME = METRICS.histogram(
     "Kernel lower+compile wall time on compile-cache misses",
     labels=("kernel",), buckets=DEFAULT_LATENCY_BUCKETS_NS,
     max_series=128)
+KERNEL_PATH = METRICS.counter(
+    "srt_kernel_path_total",
+    "Executions per op by the kernel path actually taken "
+    "(calibrated join / JSON engines)", labels=("op", "path"),
+    max_series=128)
 INCIDENTS_TOTAL = METRICS.counter(
     "srt_incidents_total",
     "Flight-recorder incident bundles written, by trigger kind",
@@ -510,6 +515,18 @@ def record_jit_cache(event: str, kernel: str, *,
         JIT_COMPILE_TIME.observe(compile_ns, labels=(kernel,))
     elif event == "eviction":
         JIT_CACHE_EVICTIONS.inc(labels=(kernel,))
+
+
+def record_kernel_path(op: str, path: str, rows: int = 0) -> None:
+    """One execution of ``op`` took ``path`` (calibrated kernel
+    routing — joins, get_json_object, from_json, raw map).  Rows are
+    journal-only color; the counter is the contract surface the
+    metrics_report "kernel paths" table renders."""
+    if not _SWITCH.enabled:
+        return
+    KERNEL_PATH.inc(labels=(op, path))
+    JOURNAL.emit("kernel_path", op=op, path=path, rows=int(rows),
+                 thread=threading.get_ident())
 
 
 def record_exchange_doubling(from_capacity: int, to_capacity: int,

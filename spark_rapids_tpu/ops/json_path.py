@@ -392,18 +392,108 @@ def get_json_object_host(col: Column, path: str) -> Column:
 # stay host-side where compile cost would dominate (override via env)
 DEVICE_MIN_ROWS = int(os.environ.get("SPARK_RAPIDS_TPU_JSON_MIN_ROWS", 32))
 
+# rows at or above this count earn a measured engine pick (ISSUE 9);
+# below it the static default is cheaper than timing anything
+JSON_CALIBRATE_MIN_ROWS = 1 << 14
+
+# sampled rows each calibration candidate runs over
+JSON_SAMPLE_ROWS = 1 << 14
+
+
+class _EngineDeclined(RuntimeError):
+    """A decline-capable engine refused the calibration sample."""
+
+
+def route_json_engine(op: str, col: Column, engines, default: str,
+                      extra: str = "") -> str:
+    """Measured engine pick for a JSON string-column op (ISSUE 9).
+
+    ``engines`` maps path name -> fn(col); candidates time a sampled
+    slice of ``col`` under the shared calibrator
+    (perf/calibrate.pick_path), keyed by (op, doc-shape digest,
+    backend).  Every engine is byte-identical by contract (per-row host
+    fallback), so the pick is SPEED only.  Small columns return
+    ``default`` untimed; SPARK_RAPIDS_TPU_PATH_<OP> pins a path."""
+    from spark_rapids_tpu.perf import calibrate
+
+    pin = calibrate.pinned_path(op)
+    if pin is not None and pin in engines:
+        return pin
+    rows = col.length
+    if rows < JSON_CALIBRATE_MIN_ROWS or len(engines) <= 1:
+        return default
+    import numpy as np
+    nbytes = int(np.asarray(col.offsets)[-1]) if col.offsets is not None \
+        else 0
+    mean_len = max(nbytes // max(rows, 1), 1)
+    digest = (f"{extra}|rb{rows.bit_length()}"
+              f"|lb{mean_len.bit_length()}")
+    if rows > JSON_SAMPLE_ROWS:
+        from spark_rapids_tpu.ops.copying import slice_column
+        sub = slice_column(col, 0, JSON_SAMPLE_ROWS)
+    else:
+        sub = col
+
+    def _ran(fn):
+        # decline-capable device engines answer None for shapes they
+        # refuse; timing that as a near-instant success would crown a
+        # verdict whose production calls all fall back — surface the
+        # decline as a calibration error so the engine is excluded
+        out = fn(sub)
+        if out is None:
+            raise _EngineDeclined(f"engine declined {rows}-row sample")
+        return out
+
+    candidates = {name: (lambda fn=fn: _ran(fn))
+                  for name, fn in engines.items()}
+    path = calibrate.pick_path(op, digest, candidates, default=default)
+    return path if path in engines else default
+
 
 def get_json_object(col: Column, path: str) -> Column:
     """One strings column of extraction results (JSONUtils.getJsonObject).
 
-    Device-first: the vectorized scan in ops/json_device.py handles the
-    column, falling back to the host evaluator per flagged row."""
+    Engine choice is a measurement, not a backend gate (ISSUE 9): the
+    batch-parallel structural-index tokenizer (ops/json_tokenizer), the
+    per-row device scan (ops/json_device) and this host evaluator are
+    byte-identical candidates; the calibrator picks per (path shape,
+    doc shape, backend).  Wildcard paths stay on the scan/host pair
+    (multi-match rendering is out of the tokenizer's scope)."""
+    from spark_rapids_tpu import observability as _obs
+
     mode = os.environ.get("SPARK_RAPIDS_TPU_JSON", "auto")
-    if mode != "host" and (mode == "device"
-                           or col.length >= DEVICE_MIN_ROWS):
-        from spark_rapids_tpu.ops.json_device import get_json_object_device
-        return get_json_object_device(col, path)
-    return get_json_object_host(col, path)
+
+    def _device_scan(c):
+        from spark_rapids_tpu.ops.json_device import \
+            get_json_object_device
+        return get_json_object_device(c, path)
+
+    engines = {
+        "host": lambda c: get_json_object_host(c, path),
+        "device_scan": _device_scan,
+    }
+    if mode == "host" or (mode != "device"
+                          and col.length < DEVICE_MIN_ROWS):
+        engine = "host"
+    elif mode == "device":
+        engine = "device_scan"
+    else:
+        from spark_rapids_tpu.ops import json_tokenizer as JT
+        instructions = parse_path(path)
+        tok_ok = bool(instructions) and not any(
+            isinstance(i, Wildcard) for i in instructions)
+        if tok_ok:
+            engines["tokenizer"] = \
+                lambda c: JT.get_json_object_tokenized(c, path)
+        # static default below the calibration floor = the pre-ISSUE-9
+        # routing (device scan); above it the measurement decides
+        # tok_ok is part of the digest: wildcard and non-wildcard paths
+        # offer different candidate sets and must not share a verdict
+        engine = route_json_engine(
+            "json.get_object", col, engines, "device_scan",
+            extra=f"steps{len(instructions or ())}t{int(tok_ok)}")
+    _obs.record_kernel_path("get_json_object", engine, col.length)
+    return engines[engine](col)
 
 
 def get_json_object_multiple_paths(col: Column, paths: Sequence[str],
@@ -419,10 +509,37 @@ def get_json_object_multiple_paths(col: Column, paths: Sequence[str],
     mode = os.environ.get("SPARK_RAPIDS_TPU_JSON", "auto")
     if mode != "host" and (mode == "device"
                            or col.length >= DEVICE_MIN_ROWS):
+        from spark_rapids_tpu import observability as _obs
         from spark_rapids_tpu.ops.json_device import \
             get_json_object_multiple_paths_device
-        return get_json_object_multiple_paths_device(
-            col, paths, memory_budget_bytes, parallel_override)
+
+        engines = {
+            "device_scan": lambda c: \
+                get_json_object_multiple_paths_device(
+                    c, paths, memory_budget_bytes, parallel_override),
+        }
+        parsed = [parse_path(p) for p in paths]
+        tok_ok = mode != "device" and all(
+            p is None or (p and not any(isinstance(i, Wildcard)
+                                        for i in p))
+            for p in parsed) and any(p is not None for p in parsed)
+        if tok_ok:
+            from spark_rapids_tpu.ops import json_tokenizer as JT
+            engines["tokenizer"] = lambda c: \
+                JT.get_json_object_multiple_paths_tokenized(c, paths)
+        # the path SET is part of the digest, not just its size: two
+        # 2-path batches with very different step depths must not share
+        # a cached verdict for the file-cache TTL
+        import hashlib
+        ph = hashlib.md5("|".join(paths).encode()).hexdigest()[:8]
+        engine = route_json_engine(
+            "json.get_object", col, engines, "device_scan",
+            extra=f"multi{len(paths)}p{ph}t{int(tok_ok)}") \
+            if mode != "device" else "device_scan"
+        if engine not in engines:
+            engine = "device_scan"
+        _obs.record_kernel_path("get_json_object", engine, col.length)
+        return engines[engine](col)
     parsed_paths = [parse_path(p) for p in paths]
     vals = col.to_pylist()
     if parallel_override > 0:

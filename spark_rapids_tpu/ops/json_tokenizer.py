@@ -1,0 +1,1400 @@
+"""Batch-parallel JSON structural-index tokenizer (ISSUE 9 tentpole).
+
+The per-row ``lax.scan`` DFA in ops/json_device.py marches every
+document one character per scan step — fine on a VPU, catastrophic on
+the CPU backend, which is why ``from_json``/raw-map grew hard
+``jax.default_backend() != "cpu"`` gates and get_json_object crawled
+at 120k rows/s.  This module is the simdjson-shaped alternative: a
+handful of *whole-buffer* vectorized passes over the flat Arrow chars
+buffer build a structural index for every row simultaneously —
+
+  stage 1  escape parity (backslash run length before each byte, row
+           bounded) and in-string parity (cumsum of unescaped quotes);
+  stage 2  structural token extraction in one pass ({ } [ ] : , and
+           string-open quotes; a string is ONE token carrying its
+           close position, paired per row by quote ordinal);
+  stage 3  per-token container links: depth from a signed cumsum, and
+           for each nesting level a segmented running-max of open
+           positions — parent/match links in O(depth) passes, not
+           O(tokens);
+  stage 4  grammar validation as PURE LOCAL RULES over (previous
+           token, gap class, current token, container kind) — the
+           classic observation that, once brackets are matched by
+           level, JSON's grammar is regular in the token stream;
+  stage 5  primitive gaps (the byte runs between tokens) classified
+           and validated by a fixed-window vectorized number/literal
+           DFA, plus prefix sums for O(1) span-safety range queries
+           (whitespace outside strings, escapes, control chars, float
+           tokens) used by the verbatim renderers.
+
+Consumers (get_json_object, from_json struct fields, raw map) share
+one tokenize pass and emit byte spans into the ORIGINAL buffer;
+anything outside the proven-fast shape — single-quoted strings,
+documents deeper than MAX_DEPTH, overlong primitives, escape-bearing
+keys, >MAX_PAIRS raw-map objects, multi-match paths — flags its row to
+the host oracle (ops/json_path), never the whole column.  The host
+tree-builder remains the semantics oracle; the differential corpus in
+tests/test_device_join_paths.py pins byte-identical output.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+MAX_DEPTH = 16          # container nesting tracked; deeper rows -> host
+MAX_PAIRS = 64          # raw-map pairs handled natively per row
+_PRIM_W = 26            # primitive window; longer tokens -> host
+ROW_CHUNK = 1 << 15     # rows per tokenize pass: bounds temporaries
+#                         AND keeps each pass's working set inside the
+#                         cache hierarchy (measured ~25% over 2^17 on
+#                         the 2-core eval box), while giving the chunk
+#                         thread pool enough pieces to balance
+
+# token type codes
+T_OBJ, T_CLOSE_OBJ, T_ARR, T_CLOSE_ARR, T_COLON, T_COMMA, T_STR = \
+    range(7)
+
+_TYPE_LUT = np.full(256, -1, np.int8)
+_TYPE_LUT[ord("{")] = T_OBJ
+_TYPE_LUT[ord("}")] = T_CLOSE_OBJ
+_TYPE_LUT[ord("[")] = T_ARR
+_TYPE_LUT[ord("]")] = T_CLOSE_ARR
+_TYPE_LUT[ord(":")] = T_COLON
+_TYPE_LUT[ord(",")] = T_COMMA
+_TYPE_LUT[ord('"')] = T_STR
+
+_IS_WS = np.zeros(256, bool)
+for _c in (32, 9, 10, 13):
+    _IS_WS[_c] = True
+
+# structural token chars EXCLUDING the quote (stage 2 fuses the
+# type-lookup + quote-exclusion tests into one gather)
+_IS_STRUCT_NONQ = _TYPE_LUT >= 0
+_IS_STRUCT_NONQ[ord('"')] = False
+
+_ESC_OK = np.zeros(256, bool)
+for _c in b"\"'\\/bfnrtu":
+    _ESC_OK[_c] = True
+
+_IS_HEX = np.zeros(256, bool)
+for _c in b"0123456789abcdefABCDEF":
+    _IS_HEX[_c] = True
+
+
+class Tokens:
+    """Structural index for one row chunk (attribute bag)."""
+    __slots__ = (
+        "chars", "offs", "lens", "R", "N",
+        "host", "valid",
+        "tpos", "ttype", "tok_offs", "row_of", "str_end",
+        "depth_at", "parent", "close_of",
+        "gap_end", "gap_next",
+        "gap_runs", "gap_first", "gap_last",
+        "lead_runs", "lead_first", "lead_last",
+        "prim_ok", "prim_float", "prim_negz", "prim_lit",
+        "lead_ok", "lead_float", "lead_negz",
+        "wsout_cum", "esc_cum", "ctrlstr_cum",
+        "gapbad_cum", "_wsout_mask",
+    )
+
+
+def _build_grammar_lut() -> np.ndarray:
+    """Token-local grammar as ONE boolean lookup table over the packed
+    (token type, previous token, token before that, gap class, context)
+    code — the ~45 vectorized boolean passes this replaces were a
+    bandwidth bill at a million tokens per chunk.  gap class: 0 = empty
+    gap before token, 1 = exactly one valid primitive, 2 = anything
+    else.  context: 0 = object, 1 = array, 2 = root."""
+    lut = np.zeros(7 * 8 * 8 * 3 * 3, bool)
+    for tt in range(7):
+        for prev in range(-1, 7):
+            for pprev in range(-1, 7):
+                for gapc in range(3):
+                    for ctxc in range(3):
+                        ctx_obj, ctx_arr, ctx_root = (
+                            ctxc == 0, ctxc == 1, ctxc == 2)
+                        gb_e, gb_p = gapc == 0, gapc == 1
+                        is_value_prev = (
+                            (prev == T_STR
+                             and (pprev == T_COLON if ctx_obj else True)
+                             and (pprev == -1 if ctx_root else True))
+                            or prev in (T_CLOSE_OBJ, T_CLOSE_ARR))
+                        prim_pos_prev = (
+                            (prev == T_COLON and ctx_obj)
+                            or prev == T_ARR
+                            or (prev == T_COMMA and ctx_arr))
+                        if tt == T_COLON:
+                            ok = (gb_e and ctx_obj and prev == T_STR
+                                  and pprev in (T_OBJ, T_COMMA))
+                        elif tt == T_COMMA:
+                            ok = (ctx_obj or ctx_arr) and (
+                                (gb_e and is_value_prev)
+                                or (gb_p and prim_pos_prev))
+                        elif tt in (T_CLOSE_OBJ, T_CLOSE_ARR):
+                            match = prev == (T_OBJ if tt == T_CLOSE_OBJ
+                                             else T_ARR)
+                            ok = ((gb_e and match)
+                                  or (gb_e and is_value_prev)
+                                  or (gb_p and prim_pos_prev))
+                        elif tt == T_STR:
+                            ok = gb_e and (
+                                (ctx_obj and prev in (T_OBJ, T_COMMA,
+                                                      T_COLON))
+                                or (ctx_arr and prev in (T_ARR,
+                                                         T_COMMA))
+                                or (ctx_root and prev == -1))
+                        else:          # T_OBJ / T_ARR open
+                            ok = gb_e and (
+                                (ctx_obj and prev == T_COLON)
+                                or (ctx_arr and prev in (T_ARR,
+                                                         T_COMMA))
+                                or (ctx_root and prev == -1))
+                        lut[tt + 7 * (prev + 1) + 56 * (pprev + 1)
+                            + 448 * gapc + 1344 * ctxc] = ok
+    return lut
+
+
+_GRAMMAR_LUT = _build_grammar_lut()
+
+
+def _build_prim_table(allow_leading_zeros: bool) -> np.ndarray:
+    """(states, 256) DFA transition table for JSON primitives.  Number
+    states: 0 start, 1 '-', 2 zero, 3 int digits, 4 '.', 5 frac
+    digits, 6 e, 7 e-sign, 8 exp digits; literal spines 10..17
+    (t-rue / f-alse / n-ull share a padded track); 9 rejects.  The
+    tolerant host grammar allows an empty fraction ("12.", "12.e5"):
+    state 4 is accepting and may take the exponent."""
+    R = 9
+    tbl = np.full((23, 256), R, np.uint8)
+    dig = [ord(c) for c in "0123456789"]
+    tbl[0, ord("-")] = 1
+    for d in dig:
+        tbl[0, d] = tbl[1, d] = 3
+        tbl[3, d] = 3
+        tbl[4, d] = tbl[5, d] = 5
+        tbl[6, d] = tbl[7, d] = tbl[8, d] = 8
+    tbl[0, ord("0")] = tbl[1, ord("0")] = 2
+    if allow_leading_zeros:
+        for d in dig:
+            tbl[2, d] = 3
+    for s in (2, 3):
+        tbl[s, ord(".")] = 4
+    for s in (2, 3, 4, 5):
+        tbl[s, ord("e")] = tbl[s, ord("E")] = 6
+    tbl[6, ord("+")] = tbl[6, ord("-")] = 7
+    # literal spines: true -> 10..13(acc), false -> 14..18(acc),
+    # null -> 19..22(acc); final states have no outgoing edges
+    for word, base in ((b"true", 10), (b"false", 14), (b"null", 19)):
+        prev = 0
+        for i, b in enumerate(word):
+            tbl[prev, b] = base + i
+            prev = base + i
+    return tbl
+
+
+_PRIM_TBL = _build_prim_table(False)
+_PRIM_TBL_LZ = _build_prim_table(True)
+# accepting states: number-accepting + the three literal finals
+_PRIM_ACCEPT = np.zeros(23, bool)
+for _s in (2, 3, 4, 5, 8, 13, 18, 22):
+    _PRIM_ACCEPT[_s] = True
+_PRIM_IS_LIT = np.zeros(23, bool)
+for _s in (13, 18, 22):
+    _PRIM_IS_LIT[_s] = True
+
+
+def _prim_check(chars: np.ndarray, first: np.ndarray, last: np.ndarray,
+                sel: np.ndarray, allow_leading_zeros: bool):
+    """Vectorized primitive validation over [first, last] byte spans
+    (sel = which entries to check).  Returns (ok, is_float, is_negzero,
+    is_literal, overlong) — ``ok`` is True for true/false/null or a
+    strict JSON number (modulo the leading-zero knob).  Work is
+    COMPRESSED to the selected entries and the table-driven DFA runs
+    only to the longest selected span."""
+    n = len(first)
+    zeros = np.zeros(n, bool)
+    idxs = np.nonzero(sel)[0]
+    if len(idxs) == 0:
+        return zeros, zeros.copy(), zeros.copy(), zeros.copy(), \
+            zeros.copy()
+    f = first[idxs]
+    length = last[idxs] - f + 1
+    over_c = length > _PRIM_W
+    w = int(min(_PRIM_W, length.max() if len(length) else 0))
+    m = len(idxs)
+    win_idx = f[:, None] + np.arange(w, dtype=np.int64)[None, :]
+    np.clip(win_idx, 0, max(len(chars) - 1, 0), out=win_idx)
+    win = (chars[win_idx] if len(chars) else np.zeros((m, w), np.uint8))
+    inlen = np.arange(w)[None, :] < np.minimum(length, w)[:, None]
+    win = win * inlen
+    # fast path: plain digit runs (the overwhelmingly common case) —
+    # one all-digits test instead of w DFA steps
+    isdig = ((win >= ord("0")) & (win <= ord("9"))) | ~inlen
+    plain = isdig.all(axis=1) & ~over_c & (length >= 1)
+    if not allow_leading_zeros:
+        plain &= (length == 1) | (win[:, 0] != ord("0"))
+    slow = np.nonzero(~plain)[0]
+    st = np.zeros(m, np.uint8)
+    if len(slow):
+        tbl = _PRIM_TBL_LZ if allow_leading_zeros else _PRIM_TBL
+        ss = np.zeros(len(slow), np.uint8)
+        sw = win[slow]
+        sl = inlen[slow]
+        for i in range(w):
+            act = sl[:, i]
+            if not act.any():
+                break
+            ss = np.where(act, tbl[ss, sw[:, i]], ss)
+        st[slow] = ss
+    acc = (plain | _PRIM_ACCEPT[st]) & ~over_c
+    lit_c = acc & _PRIM_IS_LIT[st] & ~plain
+    num_ok = acc & ~(_PRIM_IS_LIT[st] & ~plain)
+    isf_c = num_ok & (((win == ord(".")) | (win == ord("e"))
+                       | (win == ord("E"))).any(axis=1))
+    negz_c = num_ok & (length == 2) & (win[:, 0] == ord("-")) \
+        & (win[:, 1] == ord("0")) if w >= 2 else num_ok & False
+
+    def scatter(vals):
+        out = zeros.copy()
+        out[idxs] = vals
+        return out
+
+    return (scatter(acc), scatter(isf_c), scatter(negz_c),
+            scatter(lit_c), scatter(over_c))
+
+
+def _cum(mask: np.ndarray) -> np.ndarray:
+    """Prefix-exclusive cumsum, length N+1: sum over [a, b) is
+    cum[b] - cum[a].  int32 when the total fits (these arrays are the
+    tokenizer's bandwidth bill — chunking keeps N < 2^31)."""
+    out = np.zeros(len(mask) + 1,
+                   np.int32 if len(mask) < 2**31 else np.int64)
+    np.cumsum(mask, out=out[1:])
+    return out
+
+
+def _cum_opt(mask: np.ndarray) -> Optional[np.ndarray]:
+    """_cum, or None when the mask is empty — the all-zero prefix sums
+    (escapes, control chars) are the common case and the consumers'
+    range queries short-circuit on None."""
+    return _cum(mask) if mask.any() else None
+
+
+def _rsum_pos(cum: Optional[np.ndarray], a: np.ndarray, b: np.ndarray
+              ) -> np.ndarray:
+    """cum[b] - cum[a] > 0 with the None (all-zero) short-circuit."""
+    if cum is None:
+        return np.zeros(np.shape(a), bool)
+    return cum[b] - cum[a] > 0
+
+
+def tokenize(chars: np.ndarray, offs: np.ndarray,
+             allow_leading_zeros: bool = False) -> Tokens:
+    """Build the structural index for rows offs[0]..offs[-1] of a flat
+    char buffer.  ``chars``/``offs`` are chunk-local (offs[0] == 0)."""
+    t = Tokens()
+    R = len(offs) - 1
+    N = int(offs[-1])
+    t.chars, t.offs, t.R, t.N = chars, offs, R, N
+    lens = np.diff(offs)
+    t.lens = lens
+    host = np.zeros(R, bool)
+    valid = np.ones(R, bool)
+
+    if N == 0:
+        t.host = host
+        t.valid = np.zeros(R, bool)      # all rows empty -> invalid
+        t.tpos = np.zeros(0, np.int64)
+        t.ttype = np.zeros(0, np.int8)
+        t.tok_offs = np.zeros(R + 1, np.int64)
+        t.row_of = np.zeros(0, np.int64)
+        t.str_end = np.zeros(0, np.int64)
+        t.depth_at = np.zeros(0, np.int64)
+        t.parent = np.zeros(0, np.int64)
+        t.close_of = np.zeros(0, np.int64)
+        t.gap_end = np.zeros(0, np.int64)
+        t.gap_next = np.zeros(0, np.int64)
+        for f in ("gap_runs", "gap_first", "gap_last"):
+            setattr(t, f, np.zeros(0, np.int64))
+        t.lead_runs = np.zeros(R, np.int64)
+        t.lead_first = np.zeros(R, np.int64)
+        t.lead_last = np.zeros(R, np.int64)
+        for f in ("prim_ok", "prim_float", "prim_negz", "prim_lit"):
+            setattr(t, f, np.zeros(0, bool))
+        for f in ("lead_ok", "lead_float", "lead_negz"):
+            setattr(t, f, np.zeros(R, bool))
+        t.wsout_cum = t.esc_cum = t.ctrlstr_cum = None
+        t.gapbad_cum = None
+        t._wsout_mask = None
+        return t
+
+    i32 = np.int32 if N < 2**31 else np.int64
+    offs_n = offs.astype(i32, copy=False)
+    idx = np.arange(N, dtype=i32)
+
+    # byte -> row map, built lazily: the unconditional consumer (quote
+    # pairing) uses the cheaper searchsorted form, so the full N-sized
+    # repeat only materializes for host-gated shapes (escapes, odd
+    # rows, control chars)
+    _rob = [None]
+
+    def row_of_b():
+        if _rob[0] is None:
+            _rob[0] = np.repeat(np.arange(R, dtype=i32), lens)
+        return _rob[0]
+
+    # ---- stage 1: escape parity + in-string parity -------------------
+    bs = chars == ord("\\")
+    has_bs = bool(bs.any())
+    if has_bs:
+        rstart = np.repeat(offs_n[:-1], lens)
+        non_bs_last = np.maximum.accumulate(np.where(~bs, idx, -1))
+        prev_non_bs = np.empty(N, i32)
+        prev_non_bs[0] = -1
+        prev_non_bs[1:] = non_bs_last[:-1]
+        run_before = idx - 1 - np.maximum(prev_non_bs, rstart - 1)
+        escaped = (run_before & 1) == 1
+        sq = (chars == ord('"')) & ~escaped
+    else:
+        escaped = None
+        sq = chars == ord('"')
+
+    # every consumer needs quote COUNTS only mod 2, so the prefix sum
+    # is a 1-byte XOR-accumulate, not an i32 cumsum (4x less traffic
+    # on the tokenizer's widest pass): qpar[i] = parity of unescaped
+    # quotes before byte i
+    qpar = np.zeros(N + 1, bool)
+    np.logical_xor.accumulate(sq, out=qpar[1:])
+
+    ws = _IS_WS[chars]
+
+    # row gates: odd quote count, single quote outside a string, or a
+    # backslash outside a string (tolerant grammar the parity pass
+    # cannot track) -> host oracle
+    odd_q = qpar[offs_n[1:]] ^ qpar[offs_n[:-1]]
+    host |= odd_q
+    # per-row parity rebase only matters once an odd row has shifted
+    # the global parity — the common all-even chunk skips the repeat
+    if bool(odd_q.any()):
+        in_str = qpar[:N] ^ np.repeat(qpar[offs_n[:-1]], lens)
+    else:
+        in_str = qpar[:N]
+
+    def any_per_row(mask: np.ndarray) -> np.ndarray:
+        if not mask.any():
+            return np.zeros(R, bool)
+        return np.bincount(row_of_b()[mask], minlength=R) > 0
+
+    nis = ~in_str
+    squote = chars == ord("'")
+    if squote.any():
+        host |= any_per_row(squote & nis)
+    # control chars outside strings that are not whitespace are invalid
+    ctrl = chars < 0x20
+    if ctrl.any():
+        valid &= ~any_per_row(ctrl & ~ws & nis)
+
+    # escape validity (tolerant set + \uXXXX needs 4 in-row hex)
+    intro = (bs & ~escaped & in_str) if has_bs else bs
+    if has_bs:
+        rend = np.repeat(offs_n[1:], lens)
+        host |= any_per_row(bs & ~in_str & ~escaped)
+        nxt = np.empty(N, np.uint8)
+        nxt[:-1] = chars[1:]
+        nxt[-1] = 0
+        bad = intro & (~_ESC_OK[nxt] | (idx + 1 >= rend))
+        isu = intro & (nxt == ord("u"))
+        if isu.any():
+            for k in range(2, 6):
+                pos = np.minimum(idx + k, N - 1)
+                bad |= isu & ((idx + k >= rend) | ~_IS_HEX[chars[pos]])
+        valid &= ~any_per_row(bad)
+
+    # ---- stage 2: token extraction ----------------------------------
+    open_q = sq & nis
+    tok_mask = (nis & _IS_STRUCT_NONQ[chars]) | open_q
+    # per-row token counts by segment reduction — tok_offs needs no
+    # full-buffer cumsum (reduceat quirk: empty segments echo the next
+    # element, zeroed after)
+    seg = np.minimum(offs_n[:-1], max(N - 1, 0))
+    ntok = np.add.reduceat(tok_mask, seg).astype(i32, copy=False)
+    ntok[lens == 0] = 0
+    tok_offs = np.zeros(R + 1, i32)
+    np.cumsum(ntok, out=tok_offs[1:])
+    T = int(tok_offs[-1])
+    tpos = np.nonzero(tok_mask)[0].astype(i32, copy=False)
+    ttype = _TYPE_LUT[chars[tpos]]
+    row_of = np.repeat(np.arange(R, dtype=i32), ntok)
+    t.tpos, t.ttype, t.tok_offs, t.row_of = tpos, ttype, tok_offs, \
+        row_of
+
+    # string close pairing: within a row unescaped quotes strictly
+    # alternate open/close (in-row ordinal parity), so an open's close
+    # is simply the NEXT quote of the same row — no per-side cumsums.
+    # Odd (host-gated) rows leave their last open unpaired (-1).
+    str_end = np.full(T, -1, i32)
+    is_str_tok = ttype == T_STR
+    qpos = np.nonzero(sq)[0].astype(i32, copy=False)
+    if len(qpos):
+        qrow = (np.searchsorted(offs_n, qpos, side="right")
+                .astype(i32, copy=False) - 1)
+        ends = np.full(len(qpos), -1, i32)
+        same = qrow[1:] == qrow[:-1]
+        ends[:-1][same] = qpos[1:][same]
+        # in-row quote ordinal parity == global ordinal parity XOR
+        # the parity of quotes before the row start
+        is_open_q = (((np.arange(len(qpos), dtype=i32) & 1) == 1)
+                     == qpar[offs_n[:-1]][qrow])
+        str_end[is_str_tok] = ends[is_open_q]
+    t.str_end = str_end
+
+    # ---- stage 3: depth + container links ---------------------------
+    is_open = (ttype == T_OBJ) | (ttype == T_ARR)
+    is_close = (ttype == T_CLOSE_OBJ) | (ttype == T_CLOSE_ARR)
+    delta = np.zeros(T, np.int8)
+    delta[is_open] = 1
+    delta[is_close] = -1
+    dcum = np.empty(T, i32)
+    np.cumsum(delta, out=dcum)
+    first_ti = tok_offs[:-1]
+    d_base_row = np.where(first_ti > 0,
+                          dcum[np.maximum(first_ti - 1, 0)], 0)
+    if d_base_row.any():     # some earlier row left depth unbalanced
+        depth_after = dcum - np.repeat(d_base_row, ntok)
+    else:                    # common case: every row closed at 0
+        depth_after = dcum
+    depth_at = depth_after - delta
+    t.depth_at = depth_at
+
+    valid &= ~any_per_row_tok(depth_at < 0, row_of, R)
+    has_tok = ntok > 0
+    if T:
+        last_idx = np.maximum(tok_offs[1:] - 1, 0)
+        valid &= ~(has_tok & (depth_after[last_idx] != 0))
+    maxd = int(depth_at.max()) + 1 if T else 0
+    if maxd > MAX_DEPTH:
+        host |= any_per_row_tok(depth_at >= MAX_DEPTH, row_of, R)
+        maxd = MAX_DEPTH
+
+    tok_idx = np.arange(T, dtype=i32)
+    parent = np.full(T, -1, i32)
+    open_of_close = np.full(T, -1, i32)
+    first_tok = np.repeat(first_ti, ntok)    # row's first token index
+    opos = np.nonzero(is_open)[0]
+    odepth = depth_at[opos]
+    for d in range(max(maxd, 1)):
+        md_idx = opos[odepth == d]
+        if len(md_idx) == 0:
+            continue         # no containers at this level -> no level
+        md = np.zeros(T, bool)
+        md[md_idx] = True
+        lastopen = np.maximum.accumulate(np.where(md, tok_idx, -1))
+        lastopen = np.where(lastopen >= first_tok, lastopen, -1)
+        # parent of tokens sitting INSIDE level d+1 containers
+        sel = depth_at == d + 1
+        parent[sel] = lastopen[sel]
+        # the container a close at depth_at d+1... closes: same link
+        selc = is_close & (depth_at == d + 1)
+        open_of_close[selc] = lastopen[selc]
+    # closes at depth_at >= 1 map via the loop above; a close token's
+    # own container is what it closes
+    t.parent = parent
+    close_of = np.full(T, -1, i32)
+    cpos = np.nonzero(is_close)[0]
+    if len(cpos):
+        oc = open_of_close[cpos]
+        bad_close = (oc < 0) | (ttype[np.maximum(oc, 0)]
+                                != np.where(ttype[cpos] == T_CLOSE_OBJ,
+                                            T_OBJ, T_ARR))
+        valid &= ~any_per_row_tok(bad_close, row_of[cpos], R)
+        okc = oc >= 0
+        close_of[oc[okc]] = cpos[okc].astype(i32)
+    t.close_of = close_of
+
+    # ---- stage 4/5: gaps, primitives, grammar -----------------------
+    # token span end (bytes): structural = pos+1, string = close+1
+    span_end = np.where(is_str_tok & (str_end >= 0), str_end,
+                        tpos) + 1
+    next_start = np.empty(T, i32)
+    if T:
+        next_start[:-1] = tpos[1:]
+        next_start[-1] = N
+        last_of_row = tok_offs[1:] - 1
+        next_start[last_of_row[has_tok]] = offs_n[1:][has_tok]
+    t.gap_end = span_end
+    t.gap_next = next_start
+
+    nws = ~ws
+    nws_prev = np.zeros(N, bool)
+    nws_prev[1:] = nws[:-1]
+    at_rstart = np.zeros(N, bool)
+    at_rstart[offs_n[:-1][lens > 0]] = True
+    # a non-ws RUN starts where non-ws follows ws or a row boundary;
+    # run starts right after a token byte are handled by gap_info's
+    # explicit nws[gs] term (the byte before a gap is always non-ws)
+    edge = nws & ~(nws_prev & ~at_rstart)
+    ecum = _cum(edge)
+
+    # prev non-ws byte position; a gap's first content byte resolves
+    # through the run-start positions (epos) with O(1) gathers
+    ln = np.maximum.accumulate(np.where(nws, idx, -1))
+    epos = np.nonzero(edge)[0].astype(i32, copy=False)
+
+    def gap_info(gs, ge):
+        """(runs, first, last) of non-ws content in [gs, ge) —
+        compressed to byte-nonempty, then content-bearing, gaps (most
+        gaps are empty or pure whitespace; the gathers only pay for
+        the rest).  ``first`` is the start of the run containing
+        ``last`` — identical to the gap's first content byte in the
+        only case consumers read it (runs == 1)."""
+        n_ = len(gs)
+        runs = np.zeros(n_, i32)
+        first = np.zeros(n_, i32)
+        last = np.full(n_, -1, i32)
+        nz = np.nonzero(ge > gs)[0]
+        if len(nz):
+            # content exists iff the last non-ws byte before the gap
+            # end falls inside the gap — ln answers it, no second
+            # full-buffer prefix sum
+            nz = nz[ln[ge[nz] - 1] >= gs[nz]]
+        if len(nz):
+            g0 = gs[nz]
+            g1 = ge[nz]
+            runs[nz] = ecum[g1] - ecum[np.minimum(g0 + 1, g1)] \
+                + nws[g0]
+            lst = ln[g1 - 1]
+            # run start of the run holding ``last``: g0 itself when the
+            # gap opens mid-run (the byte before a gap is a token, so
+            # no edge bit), else the (ecum[last+1] - 1)-th run start
+            # overall — O(1) gathers, no binary search
+            nxt = epos[np.maximum(ecum[lst + 1] - 1, 0)] \
+                if len(epos) else g0
+            first[nz] = np.where(nws[g0], g0, nxt)
+            last[nz] = lst
+        return runs, first, last
+
+    gap_runs, gap_first, gap_last = gap_info(span_end, next_start) \
+        if T else (np.zeros(0, i32),) * 3
+    t.gap_runs, t.gap_first, t.gap_last = gap_runs, gap_first, gap_last
+
+    if T:
+        first_pos = tpos[np.minimum(first_ti, T - 1)]
+        lead_end = np.where(has_tok, first_pos, offs_n[1:])
+    else:
+        lead_end = offs_n[1:].astype(i32, copy=False)
+    lead_runs, lead_first, lead_last = gap_info(
+        offs_n[:-1].astype(i32, copy=False), lead_end)
+    t.lead_runs, t.lead_first, t.lead_last = lead_runs, lead_first, \
+        lead_last
+
+    # primitive validation
+    psel = gap_runs == 1
+    p_ok, p_f, p_nz, p_lit, p_over = _prim_check(
+        chars, gap_first, gap_last, psel, allow_leading_zeros)
+    lsel = lead_runs == 1
+    l_ok, l_f, l_nz, _l_lit, l_over = _prim_check(
+        chars, lead_first, lead_last, lsel, allow_leading_zeros)
+    t.prim_ok, t.prim_float, t.prim_negz, t.prim_lit = p_ok, p_f, \
+        p_nz, p_lit
+    t.lead_ok, t.lead_float, t.lead_negz = l_ok, l_f, l_nz
+    host |= any_per_row_tok(p_over, row_of, R)
+    host |= l_over
+
+    # multiple runs in any gap, or an invalid single-run primitive,
+    # invalidate the row (the host parser would reject mid-document)
+    valid &= ~any_per_row_tok((gap_runs > 1) | (psel & ~p_ok),
+                              row_of, R)
+    valid &= ~((lead_runs > 1) | (lsel & ~l_ok & has_tok))
+    # zero-token rows: exactly one valid primitive run = root scalar
+    no_tok = ~has_tok
+    valid &= ~(no_tok & ~(l_ok & (lead_runs == 1)))
+
+    # ---- grammar local rules (packed-code LUT) ----------------------
+    if T:
+        prev_t = np.full(T, -1, np.int8)        # -1 = virtual row start
+        same_row = np.zeros(T, bool)
+        same_row[1:] = row_of[1:] == row_of[:-1]
+        prev_t[1:] = np.where(same_row[1:], ttype[:-1], np.int8(-1))
+        pprev_t = np.full(T, -1, np.int8)
+        if T > 2:
+            same2 = row_of[2:] == row_of[:-2]
+            pprev_t[2:] = np.where(same2, ttype[:-2], np.int8(-1))
+
+        # gap BEFORE each token: row-leading for first token, else the
+        # gap after the previous token (scatter fixes first tokens)
+        gap_b = np.empty(T, i32)
+        gap_b[0] = 0
+        gap_b[1:] = gap_runs[:-1]
+        gb_prim = np.zeros(T, bool)
+        gb_prim[1:] = p_ok[:-1]
+        ft = first_ti[has_tok]
+        gap_b[ft] = lead_runs[has_tok]
+        gb_prim[ft] = l_ok[has_tok]
+
+        ptype = np.full(T, -1, np.int8)
+        pp = parent >= 0
+        ptype[pp] = ttype[parent[pp]]
+
+        gapc = np.where(gap_b == 0, np.int16(0),
+                        np.where((gap_b == 1) & gb_prim, np.int16(1),
+                                 np.int16(2)))
+        ctxc = np.where(parent < 0, np.int16(2),
+                        np.where(ptype == T_OBJ, np.int16(0),
+                                 np.int16(1)))
+        code = (ttype.astype(np.int16)
+                + 7 * (prev_t.astype(np.int16) + 1)
+                + 56 * (pprev_t.astype(np.int16) + 1)
+                + 448 * gapc + 1344 * ctxc)
+        ok_tok = _GRAMMAR_LUT[code]
+        # unterminated string (no close in row)
+        ok_tok &= ~(is_str_tok & (str_end < 0))
+
+        valid &= ~any_per_row_tok(~ok_tok, row_of, R)
+
+        # trailing gap after the last token must be pure whitespace
+        last_idx = tok_offs[1:] - 1
+        trail_bad = np.zeros(R, bool)
+        trail_bad[has_tok] = gap_runs[last_idx[has_tok]] > 0
+        valid &= ~trail_bad
+
+    # ---- span-safety prefix sums ------------------------------------
+    # wsout is the expensive common one: defer until a consumer
+    # actually range-queries a container span
+    t._wsout_mask = ws & nis
+    t.wsout_cum = False
+    t.esc_cum = _cum_opt(intro) if has_bs else None
+    t.ctrlstr_cum = _cum_opt(ctrl & in_str) if ctrl.any() else None
+    # per-token cumsum of "render-unsafe primitive gap follows token"
+    gap_bad = (p_f | p_nz) if T else np.zeros(0, bool)
+    t.gapbad_cum = _cum_opt(gap_bad)
+
+    t.host = host
+    t.valid = valid & ~host
+    return t
+
+
+def any_per_row_tok(mask: np.ndarray, row_of: np.ndarray, R: int
+                    ) -> np.ndarray:
+    if len(mask) == 0 or not mask.any():
+        return np.zeros(R, bool)
+    return np.bincount(row_of[mask], minlength=R) > 0
+
+
+def _wsout(t: Tokens) -> Optional[np.ndarray]:
+    """Lazily-built whitespace-outside-strings prefix sum (None when
+    the chunk has none)."""
+    if t.wsout_cum is False:
+        t.wsout_cum = _cum_opt(t._wsout_mask)
+        t._wsout_mask = None
+    return t.wsout_cum
+
+
+# ======================================================================
+# Consumers: get_json_object / raw map / from_json structs over one
+# shared structural index.  Each returns per-row verbatim byte spans
+# into the ORIGINAL buffer; rows the index cannot render byte-exactly
+# (escapes to rewrite, floats to normalize, multi-match paths, the
+# tokenizer's own host gates) are flagged to the host oracle in
+# ops/json_path — per row, never whole-column.
+# ======================================================================
+
+# statistics from the most recent tokenizer-path evaluation
+last_stats = {"rows": 0, "fallback_rows": 0, "token_rows": 0}
+
+
+def _chunks(col):
+    """Yield (b0, b1, chars, offs) chunk-local views of a string
+    column: offs[0] == 0, chars is the chunk's slice of the flat
+    buffer."""
+    offs_all = np.asarray(col.offsets).astype(np.int64)
+    chars_all = (np.asarray(col.data) if col.data is not None
+                 else np.zeros(0, np.uint8))
+    for b0 in range(0, col.length, ROW_CHUNK):
+        b1 = min(col.length, b0 + ROW_CHUNK)
+        lo, hi = offs_all[b0], offs_all[b1]
+        yield b0, b1, chars_all[lo:hi], offs_all[b0:b1 + 1] - lo
+
+
+def _in_valid(col, b0, b1):
+    if col.validity is None:
+        return np.ones(b1 - b0, bool)
+    return np.asarray(col.validity).astype(bool)[b0:b1]
+
+
+def _tok_index(t: Tokens):
+    """Shared per-chunk derived arrays: key tokens, escaped-key rows,
+    and a row-indexed root token (-1 for token-less rows)."""
+    T = len(t.ttype)
+    nxt_same = np.zeros(T, bool)
+    if T:
+        nxt_same[:-1] = t.row_of[1:] == t.row_of[:-1]
+    is_key = np.zeros(T, bool)
+    if T:
+        is_key[:-1] = ((t.ttype[:-1] == T_STR) & nxt_same[:-1]
+                       & (t.ttype[1:] == T_COLON))
+    key_esc = np.zeros(T, bool)
+    if T and t.esc_cum is not None:
+        s0 = np.minimum(t.tpos + 1, t.N)
+        s1 = np.clip(t.str_end, 0, t.N)
+        key_esc = (t.ttype == T_STR) & (t.str_end >= 0) & \
+            _rsum_pos(t.esc_cum, s0, np.maximum(s1, s0))
+    has_tok = np.diff(t.tok_offs) > 0
+    root = np.where(has_tok, t.tok_offs[:-1], -1)
+    esc_key_row = any_per_row_tok(is_key & key_esc, t.row_of, t.R)
+    return is_key, key_esc, root, esc_key_row
+
+
+def _key_name_eq(t: Tokens, is_key: np.ndarray, key_esc: np.ndarray,
+                 name: bytes) -> np.ndarray:
+    """Per-token: an escape-free key whose raw bytes equal ``name``."""
+    L = len(name)
+    eq = np.zeros(len(t.ttype), bool)
+    cand = np.nonzero(is_key)[0]        # compressed: all tests run
+    if len(cand):                       # over the key tokens only
+        ok = ~key_esc[cand] & (t.str_end[cand] - t.tpos[cand] - 1 == L)
+        cand = cand[ok]
+    if len(cand) and t.N:
+        base = t.tpos[cand] + 1
+        keep = np.ones(len(cand), bool)
+        for k, b in enumerate(name):
+            keep &= t.chars[np.minimum(base + k, t.N - 1)] == b
+        cand = cand[keep]
+    eq[cand] = True
+    return eq
+
+
+def _value_after(t: Tokens, x: np.ndarray, have: np.ndarray):
+    """The JSON value following token ``x`` (a colon, '[' or comma):
+    (vtok, vgap) — vtok >= 0 when the value is the next token (string
+    or container open), vgap >= 0 when it is the primitive occupying
+    x's trailing gap (vgap == x).  Grammar-valid rows guarantee
+    exactly one of the two."""
+    T = len(t.ttype)
+    xs = np.clip(x, 0, max(T - 1, 0))
+    g = np.where(have & (T > 0), t.gap_runs[xs], 0)
+    vgap = np.where(have & (g == 1), x, -1)
+    nxt = np.clip(x + 1, 0, max(T - 1, 0))
+    tok_ok = have & (g == 0) & (x + 1 < T)
+    # '[' directly followed by ']' is an empty array, not an element
+    close_next = tok_ok & ((t.ttype[nxt] == T_CLOSE_OBJ)
+                           | (t.ttype[nxt] == T_CLOSE_ARR))
+    vtok = np.where(tok_ok & ~close_next, x + 1, -1)
+    return vtok, vgap
+
+
+def _span_unsafe(t: Tokens, a, b, sel, *, check_float: bool,
+                 tok_a=None, tok_b=None):
+    """Rows whose [a, b) byte span cannot be copied verbatim: any
+    whitespace outside strings, escape intro, or control char inside a
+    string — plus (get_json_object only) any float / negative-zero
+    primitive gap among tokens [tok_a, tok_b)."""
+    if not sel.any():
+        # nothing selected: skip the range queries AND the lazy wsout
+        # prefix-sum build (the common all-scalar-result chunk)
+        return np.zeros(np.shape(sel), bool)
+    a = np.clip(a, 0, t.N)
+    b = np.clip(b, 0, t.N)
+    bad = sel & (_rsum_pos(_wsout(t), a, b)
+                 | _rsum_pos(t.esc_cum, a, b)
+                 | _rsum_pos(t.ctrlstr_cum, a, b))
+    if check_float and tok_a is not None:
+        T = len(t.ttype)
+        ta = np.clip(tok_a, 0, T)
+        tb = np.clip(tok_b, 0, T)
+        bad |= sel & _rsum_pos(t.gapbad_cum, ta, tb)
+    return bad
+
+
+def _container_span(t: Tokens, vtok: np.ndarray, sel: np.ndarray):
+    """(start, end, close_tok) byte span of container tokens."""
+    T = len(t.ttype)
+    v = np.clip(vtok, 0, max(T - 1, 0))
+    close = np.where(sel, t.close_of[v], -1)
+    cc = np.clip(close, 0, max(T - 1, 0))
+    start = np.where(sel, t.tpos[v], 0)
+    end = np.where(sel & (close >= 0), t.tpos[cc] + 1, 0)
+    return start, end, close
+
+
+def _gather_bytes(chars: np.ndarray, starts: np.ndarray,
+                  lens: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """(flat bytes, int32 offsets) concatenating per-row spans of a
+    flat u8 buffer — one repeat + arange, no per-row loop."""
+    lens = np.maximum(lens, 0)
+    offs = np.zeros(len(lens) + 1, np.int64)
+    np.cumsum(lens, out=offs[1:])
+    total = int(offs[-1])
+    if not total:
+        return np.zeros(0, np.uint8), offs.astype(np.int32)
+    adj = starts - offs[:-1]
+    pos = np.repeat(adj, lens) + np.arange(total, dtype=np.int64)
+    return chars[np.clip(pos, 0, len(chars) - 1)], offs.astype(np.int32)
+
+
+def _eval_path_chunk(t: Tokens, instructions, in_valid: np.ndarray):
+    """Evaluate a wildcard-free JSON path over one chunk's structural
+    index.  Returns (to_host, starts, lens, validity): verbatim spans
+    for rows the index fully resolves, to_host for the rest."""
+    from spark_rapids_tpu.ops.json_path import Index, Named
+
+    R = t.R
+    T = len(t.ttype)
+    is_key, key_esc, root, esc_key_row = _tok_index(t)
+
+    to_host = t.host.copy()
+    alive = in_valid & t.valid & ~to_host
+    # current value: a token (cur >= 0) or the row-leading primitive
+    cur = np.where(alive, root, -1)
+    prim_gap = np.full(R, -1, np.int64)     # token whose gap holds it
+    # token-less root scalars need no tracking: every instruction on a
+    # scalar evaluates to no-match (the empty path is engine-gated)
+
+    for step in instructions:
+        if isinstance(step, Named):
+            ctype = t.ttype[np.clip(cur, 0, max(T - 1, 0))] \
+                if T else np.zeros(R, np.int8)
+            on_obj = (cur >= 0) & (ctype == T_OBJ)
+            # Named on an array implicitly flattens (multi-match) and
+            # escaped keys may unescape to the target — host decides
+            to_host |= (cur >= 0) & (ctype == T_ARR)
+            to_host |= on_obj & esc_key_row
+            eq = _key_name_eq(t, is_key, key_esc,
+                              step.name.encode("utf-8"))
+            sel_idx = np.nonzero(eq)[0]       # compressed: the parent
+            if len(sel_idx):                  # test touches only the
+                rows_s = t.row_of[sel_idx]    # name-matched keys
+                keep = t.parent[sel_idx] == cur[rows_s]
+                sel_idx = sel_idx[keep]
+                rows_s = rows_s[keep]
+            cnt = (np.bincount(rows_s, minlength=R)
+                   if len(sel_idx) else np.zeros(R, np.int64))
+            to_host |= on_obj & (cnt > 1)     # duplicate-key multi-match
+            hit = np.full(R, -1, np.int64)
+            if len(sel_idx):
+                hit[rows_s] = sel_idx
+            have = on_obj & ~to_host & (cnt == 1)
+            cur, prim_gap = _value_after(t, hit + 1, have)
+        elif isinstance(step, Index):
+            ctype = t.ttype[np.clip(cur, 0, max(T - 1, 0))] \
+                if T else np.zeros(R, np.int8)
+            on_arr = (cur >= 0) & (ctype == T_ARR)
+            if step.index == 0:
+                x = np.where(on_arr, cur, -1)
+            else:
+                x = np.full(R, -1, np.int64)
+                if T:
+                    cidx = np.nonzero(t.ttype == T_COMMA)[0]
+                    if len(cidx):
+                        rows_c = t.row_of[cidx]
+                        keep = t.parent[cidx] == cur[rows_c]
+                        cidx = cidx[keep]
+                        rows_c = rows_c[keep]
+                        # in-row rank of each kept comma (rows_c is
+                        # sorted; exclusive per-row counts rebase)
+                        cstart = np.zeros(R, np.int64)
+                        if len(rows_c):
+                            np.cumsum(np.bincount(
+                                rows_c, minlength=R)[:-1],
+                                out=cstart[1:])
+                        rank = (np.arange(len(cidx))
+                                - cstart[rows_c])
+                        pick = rank == step.index - 1
+                        x[rows_c[pick]] = cidx[pick]
+            have = on_arr & (x >= 0) & ~to_host
+            cur, prim_gap = _value_after(t, x, have)
+        else:                                 # Wildcard: caller gates
+            raise AssertionError("wildcard paths never reach the "
+                                 "tokenizer engine")
+
+    # ---- render the final value -------------------------------------
+    starts = np.zeros(R, np.int64)
+    lens = np.zeros(R, np.int64)
+    validity = np.zeros(R, bool)
+
+    vv = np.clip(cur, 0, max(T - 1, 0))
+    vt = t.ttype[vv] if T else np.zeros(R, np.int8)
+    is_str = (cur >= 0) & (vt == T_STR)
+    is_cont = (cur >= 0) & ((vt == T_OBJ) | (vt == T_ARR))
+
+    if T:
+        s0 = t.tpos[vv] + 1
+        s1 = np.clip(t.str_end[vv], 0, t.N)
+        to_host |= is_str & _rsum_pos(t.esc_cum, np.minimum(s0, t.N),
+                                      s1)
+        ok_str = is_str & ~to_host
+        starts = np.where(ok_str, s0, starts)
+        lens = np.where(ok_str, s1 - s0, lens)
+        validity |= ok_str
+
+        ca, cb, _cl = _container_span(t, cur, is_cont)
+        to_host |= _span_unsafe(
+            t, ca, cb, is_cont, check_float=True,
+            tok_a=cur, tok_b=np.where(is_cont, t.close_of[vv], 0))
+        ok_cont = is_cont & ~to_host
+        starts = np.where(ok_cont, ca, starts)
+        lens = np.where(ok_cont, cb - ca, lens)
+        validity |= ok_cont
+
+    # primitive result: verbatim only for exact ints / literals
+    # (floats take Java Double formatting, "-0" renders "0" — host)
+    sel = prim_gap >= 0
+    if sel.any():
+        g = np.clip(prim_gap, 0, max(T - 1, 0))
+        to_host |= sel & (t.prim_float[g] | t.prim_negz[g])
+        okp = sel & t.prim_ok[g] & ~to_host
+        starts = np.where(okp, t.gap_first[g], starts)
+        lens = np.where(okp, t.gap_last[g] - t.gap_first[g] + 1, lens)
+        validity |= okp
+
+    to_host &= in_valid
+    validity &= in_valid & ~to_host
+    return to_host, starts, lens, validity
+
+
+def get_json_object_tokenized(col, path: str):
+    """Structural-index get_json_object; None when the path shape is
+    out of the tokenizer's scope (wildcards, malformed, empty)."""
+    from spark_rapids_tpu.columns.column import Column
+    from spark_rapids_tpu.ops import json_path as JP
+
+    instructions = JP.parse_path(path)
+    if instructions is None:
+        return Column.from_strings([None] * col.length)
+    if not instructions or any(
+            isinstance(i, JP.Wildcard) for i in instructions):
+        return None
+    return _run_tokenized_paths(col, [instructions])[0]
+
+
+def get_json_object_multiple_paths_tokenized(col, paths):
+    """One output column per path over ONE shared tokenize pass; None
+    when any path needs a different engine (caller falls back whole)."""
+    from spark_rapids_tpu.columns.column import Column
+    from spark_rapids_tpu.ops import json_path as JP
+
+    parsed = [JP.parse_path(p) for p in paths]
+    todo = [p for p in parsed if p is not None]
+    if any(not p or any(isinstance(i, JP.Wildcard) for i in p)
+           for p in todo):
+        return None
+    outs = iter(_run_tokenized_paths(col, todo))
+    return [Column.from_strings([None] * col.length) if p is None
+            else next(outs) for p in parsed]
+
+
+def _pool_workers() -> int:
+    """Chunk-level parallelism: the tokenize passes are numpy C loops
+    that release the GIL, so a small thread pool scales near-linearly
+    on multi-core hosts.  SPARK_RAPIDS_TPU_JSON_TOKENIZER_THREADS=1
+    forces serial."""
+    import os
+    try:
+        w = int(os.environ.get(
+            "SPARK_RAPIDS_TPU_JSON_TOKENIZER_THREADS",
+            min(4, os.cpu_count() or 1)))
+    except ValueError:
+        w = 1
+    return max(1, w)
+
+
+def _map_chunks(col, work):
+    """[work(b0, b1, chars, offs) for each chunk], in chunk order,
+    fanned over the tokenizer thread pool when it pays."""
+    chunks = list(_chunks(col))
+    workers = _pool_workers()
+    if len(chunks) <= 1 or workers <= 1:
+        return [work(*c) for c in chunks]
+    from concurrent.futures import ThreadPoolExecutor
+    with ThreadPoolExecutor(max_workers=workers) as ex:
+        return list(ex.map(lambda c: work(*c), chunks))
+
+
+def _run_tokenized_paths(col, instruction_lists):
+    """Shared driver: tokenize each chunk once, evaluate every path,
+    patch host rows through the oracle, assemble string columns."""
+    from spark_rapids_tpu.columns.column import Column
+    from spark_rapids_tpu.columns.strbuild import build_string_column
+    from spark_rapids_tpu.ops import json_path as JP
+
+    P = len(instruction_lists)
+
+    def work(b0, b1, chars, offs):
+        t = tokenize(chars, offs)
+        iv = _in_valid(col, b0, b1)
+        host_docs: Dict[int, str] = {}
+        results = []
+        for ins in instruction_lists:
+            results.append(_eval_path_chunk(t, ins, iv))
+        host_rows = np.zeros(t.R, bool)
+        for to_host, _s, _l, _v in results:
+            host_rows |= to_host
+        if host_rows.any():
+            for i in np.nonzero(host_rows)[0]:
+                host_docs[int(i)] = bytes(
+                    chars[offs[i]:offs[i + 1]]).decode(
+                        "utf-8", errors="replace")
+        cols = []
+        n_tok = 0
+        for pi, (to_host, starts, lens, validity) in enumerate(results):
+            patch = {int(i): JP._run_one(host_docs[int(i)],
+                                         instruction_lists[pi])
+                     for i in np.nonzero(to_host)[0]}
+            n_tok += int(validity.sum())
+            cols.append(build_string_column(
+                chars, starts, lens, validity, patch))
+        return cols, len(host_docs), n_tok
+
+    parts: List[List[Column]] = [[] for _ in range(P)]
+    n_host = 0
+    n_tok = 0
+    for cols, h, k in _map_chunks(col, work):
+        for pi, c in enumerate(cols):
+            parts[pi].append(c)
+        n_host += h
+        n_tok += k
+    global last_stats
+    last_stats = {"rows": int(col.length), "fallback_rows": n_host,
+                  "token_rows": n_tok}
+    return [_concat_parts(p, col.length) for p in parts]
+
+
+def _concat_parts(parts, rows: int):
+    from spark_rapids_tpu.columns.column import Column
+    if not parts:
+        return Column.from_strings([None] * rows)
+    if len(parts) == 1:
+        return parts[0]
+    from spark_rapids_tpu.columns.table import Table
+    from spark_rapids_tpu.ops.copying import concat_tables
+    return concat_tables([Table([p]) for p in parts]).columns[0]
+
+
+# ======================================================================
+# from_json consumers: raw map + flat structs over the same index
+# ======================================================================
+
+def _top_level_keys(t: Tokens):
+    """(kidx, rows_k) of escape-free top-level object keys, plus the
+    per-row root-object mask and the escaped-top-key host gate."""
+    is_key, key_esc, root, _esc_row = _tok_index(t)
+    T = len(t.ttype)
+    has_tok = np.diff(t.tok_offs) > 0
+    root_c = np.clip(root, 0, max(T - 1, 0))
+    is_obj = has_tok & (t.ttype[root_c] == T_OBJ) if T \
+        else np.zeros(t.R, bool)
+    kidx = np.nonzero(is_key)[0]
+    rows_k = t.row_of[kidx] if len(kidx) else kidx
+    if len(kidx):
+        keep = t.parent[kidx] == t.tok_offs[:-1][rows_k]
+        kidx = kidx[keep]
+        rows_k = rows_k[keep]
+    esc_top = (any_per_row_tok(key_esc[kidx], rows_k, t.R)
+               if len(kidx) else np.zeros(t.R, bool))
+    return kidx, rows_k, is_obj, esc_top
+
+
+def _dup_key_rows(t: Tokens, kidx: np.ndarray, rows_k: np.ndarray
+                  ) -> np.ndarray:
+    """Rows whose top-level keys are not provably distinct.  A sampled
+    byte hash (length + first/middle/last chars) keeps this to a few
+    compressed gathers: identical keys always collide (detected), and
+    a false collision merely routes the row to the host oracle."""
+    if len(kidx) < 2:
+        return np.zeros(t.R, bool)
+    s0 = t.tpos[kidx] + 1
+    klen = t.str_end[kidx] - s0
+    cap = max(t.N - 1, 0)
+    h = (klen.astype(np.int64)
+         + 131 * t.chars[np.minimum(s0, cap)].astype(np.int64)
+         + 257 * t.chars[np.minimum(s0 + klen // 2,
+                                    cap)].astype(np.int64)
+         + 65537 * t.chars[np.minimum(s0 + np.maximum(klen - 1, 0),
+                                      cap)].astype(np.int64))
+    order = np.lexsort((h, rows_k))
+    ro = rows_k[order]
+    ho = h[order]
+    dup = (ro[1:] == ro[:-1]) & (ho[1:] == ho[:-1])
+    if not dup.any():
+        return np.zeros(t.R, bool)
+    bad = np.zeros(t.R, bool)
+    bad[ro[1:][dup]] = True
+    return bad
+
+
+def _value_spans(t: Tokens, x: np.ndarray, have: np.ndarray,
+                 *, null_is_none: bool):
+    """Verbatim (starts, lens, got, is_null, unsafe) for the value
+    following token ``x`` (a colon or comma): strings render their
+    unescaped content, containers their exact byte span, primitives
+    their gap bytes (numbers VERBATIM — the from_json family never
+    normalizes).  ``unsafe`` rows need the host oracle."""
+    T = len(t.ttype)
+    K = len(x)
+    vtok, vgap = _value_after(t, x, have)
+    starts = np.zeros(K, np.int64)
+    lens = np.zeros(K, np.int64)
+    got = np.zeros(K, bool)
+    is_null = np.zeros(K, bool)
+    unsafe = np.zeros(K, bool)
+
+    vv = np.clip(vtok, 0, max(T - 1, 0))
+    vt = t.ttype[vv] if T else np.zeros(K, np.int8)
+    is_str = (vtok >= 0) & (vt == T_STR)
+    is_cont = (vtok >= 0) & ((vt == T_OBJ) | (vt == T_ARR))
+    if T:
+        s0 = t.tpos[vv] + 1
+        s1 = np.clip(t.str_end[vv], 0, t.N)
+        unsafe |= is_str & _rsum_pos(t.esc_cum, np.minimum(s0, t.N),
+                                     np.maximum(s1, np.minimum(s0, t.N)))
+        ok_str = is_str & ~unsafe
+        starts = np.where(ok_str, s0, starts)
+        lens = np.where(ok_str, s1 - s0, lens)
+        got |= ok_str
+
+        ca, cb, _cl = _container_span(t, vtok, is_cont)
+        unsafe |= _span_unsafe(t, ca, cb, is_cont, check_float=False)
+        ok_cont = is_cont & ~unsafe
+        starts = np.where(ok_cont, ca, starts)
+        lens = np.where(ok_cont, cb - ca, lens)
+        got |= ok_cont
+
+    sel = vgap >= 0
+    if sel.any():
+        g = np.clip(vgap, 0, max(T - 1, 0))
+        gf = t.gap_first[g]
+        gl = t.gap_last[g]
+        okp = sel & t.prim_ok[g]
+        if null_is_none:
+            cap = max(t.N - 1, 0)
+            isn = okp & t.prim_lit[g] & (gl - gf == 3) & \
+                (t.chars[np.minimum(gf, cap)] == ord("n"))
+            is_null |= isn
+            okp = okp & ~isn
+        starts = np.where(okp, gf, starts)
+        lens = np.where(okp, gl - gf + 1, lens)
+        got |= okp
+    return starts, lens, got, is_null, unsafe
+
+
+def from_json_to_raw_map_tokenized(col, allow_leading_zeros=False):
+    """Structural-index from_json raw map: MAP<STRING,STRING> rows with
+    keys in first-seen order and values rendered exactly as the host
+    tree-builder would (string content unescaped, numbers and nested
+    containers verbatim).  Rows out of the proven shape (escaped or
+    duplicate top-level keys, >MAX_PAIRS, render-unsafe spans, the
+    tokenizer's own host gates) fall back to the host oracle per row."""
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.columns import dtypes
+    from spark_rapids_tpu.columns.column import Column
+    from spark_rapids_tpu.columns.strbuild import build_string_column
+    from spark_rapids_tpu.ops.json_utils import (_parse_rows,
+                                                 _value_as_raw_string)
+
+    def work(b0, b1, chars, offs):
+        t = tokenize(chars, offs, allow_leading_zeros)
+        iv = _in_valid(col, b0, b1)
+        R = t.R
+        kidx, rows_k, is_obj, esc_top = _top_level_keys(t)
+        to_host = t.host.copy()
+        to_host |= esc_top
+        to_host |= _dup_key_rows(t, kidx, rows_k)
+        pair_cnt = (np.bincount(rows_k, minlength=R)
+                    if len(kidx) else np.zeros(R, np.int64))
+        to_host |= pair_cnt > MAX_PAIRS
+
+        # values after each key's colon
+        have = np.ones(len(kidx), bool)
+        vs, vl, got, _nul, unsafe = _value_spans(
+            t, kidx + 1, have, null_is_none=False)
+        to_host |= any_per_row_tok(unsafe | ~got, rows_k, t.R) \
+            if len(kidx) else np.zeros(R, bool)
+        to_host &= iv
+
+        row_ok = iv & t.valid & is_obj & ~to_host
+        # host parses: row -> list[(key, value)] | None
+        host_pairs = {}
+        if to_host.any():
+            rows = [None] * R
+            for i in np.nonzero(to_host)[0]:
+                rows[i] = bytes(chars[offs[i]:offs[i + 1]]).decode(
+                    "utf-8", errors="replace")
+            sub = Column.from_strings(rows)
+            for i, tree in enumerate(_parse_rows(sub,
+                                                 allow_leading_zeros)):
+                if not to_host[i]:
+                    continue
+                if tree is None or tree[0] != "obj":
+                    host_pairs[i] = None
+                    continue
+                seen, order = {}, []
+                for k, v in tree[1]:
+                    if k not in seen:
+                        order.append(k)
+                    seen[k] = _value_as_raw_string(v)
+                host_pairs[i] = [(k, seen[k]) for k in order]
+
+        counts = np.zeros(R, np.int64)
+        keep_k = row_ok[rows_k] if len(kidx) else np.zeros(0, bool)
+        rows_kk = rows_k[keep_k]
+        counts[np.nonzero(row_ok)[0]] = pair_cnt[row_ok]
+        valid_row = row_ok.copy()
+        for i, pairs in host_pairs.items():
+            if pairs is None:
+                continue
+            counts[i] = len(pairs)
+            valid_row[i] = True
+        roffs = np.zeros(R + 1, np.int64)
+        np.cumsum(counts, out=roffs[1:])
+
+        # flat positions for tokenizer pairs (rows_kk sorted): in-row
+        # ordinal via exclusive per-row counts — no binary search
+        kstart = np.zeros(R, np.int64)
+        if len(rows_kk):
+            np.cumsum(np.bincount(rows_kk, minlength=R)[:-1],
+                      out=kstart[1:])
+        flat = roffs[rows_kk] + (np.arange(len(rows_kk))
+                                 - kstart[rows_kk])
+        total = int(roffs[-1])
+        kst = np.zeros(total, np.int64)
+        kln = np.zeros(total, np.int64)
+        vst = np.zeros(total, np.int64)
+        vln = np.zeros(total, np.int64)
+        kst[flat] = t.tpos[kidx[keep_k]] + 1
+        kln[flat] = t.str_end[kidx[keep_k]] - t.tpos[kidx[keep_k]] - 1
+        vst[flat] = vs[keep_k]
+        vln[flat] = vl[keep_k]
+        patch_k, patch_v = {}, {}
+        for i, pairs in host_pairs.items():
+            if pairs is None:
+                continue
+            base = int(roffs[i])
+            for j, (k, v) in enumerate(pairs):
+                patch_k[base + j] = k
+                patch_v[base + j] = v
+        kcol = build_string_column(chars, kst, kln, None, patch_k)
+        vcol = build_string_column(chars, vst, vln, None, patch_v)
+        return (counts, valid_row, kcol, vcol, int(to_host.sum()),
+                int(row_ok.sum()))
+
+    outs = _map_chunks(col, work)
+    rows = col.length
+    counts = np.concatenate([o[0] for o in outs]) if outs else \
+        np.zeros(0, np.int64)
+    valid_row = np.concatenate([o[1] for o in outs]) if outs else \
+        np.zeros(0, bool)
+    kcol = _concat_parts([o[2] for o in outs], 0)
+    vcol = _concat_parts([o[3] for o in outs], 0)
+    global last_stats
+    last_stats = {"rows": rows,
+                  "fallback_rows": sum(o[4] for o in outs),
+                  "token_rows": sum(o[5] for o in outs)}
+    offs = np.zeros(rows + 1, np.int32)
+    np.cumsum(counts, out=offs[1:])
+    st = Column.make_struct(int(offs[-1]), [kcol, vcol])
+    return Column(dtypes.LIST, rows,
+                  validity=None if valid_row.all() else
+                  jnp.asarray(valid_row.astype(np.uint8)),
+                  offsets=jnp.asarray(offs), children=(st,))
+
+
+def from_json_to_structs_tokenized(col, fields,
+                                   allow_leading_zeros=False):
+    """Structural-index from_json to a flat STRUCT: one shared tokenize
+    pass, per-field top-level key lookup (duplicate keys: LAST wins,
+    natively — dict semantics), values rendered verbatim and converted
+    through the same convert_from_strings the host path uses.  None
+    when the schema has non-leaf fields (caller falls back)."""
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.columns.column import Column
+    from spark_rapids_tpu.columns.dtypes import DType
+    from spark_rapids_tpu.columns.strbuild import build_string_column
+    from spark_rapids_tpu.ops.json_utils import (_parse_rows,
+                                                 _value_as_raw_string,
+                                                 convert_from_strings)
+
+    if not all(isinstance(spec, DType) for _n, spec in fields):
+        return None
+    F = len(fields)
+
+    def work(b0, b1, chars, offs):
+        t = tokenize(chars, offs, allow_leading_zeros)
+        iv = _in_valid(col, b0, b1)
+        R = t.R
+        kidx, rows_k, is_obj, esc_top = _top_level_keys(t)
+        is_key, key_esc, _root, _e = _tok_index(t)
+        to_host = t.host.copy()
+        to_host |= esc_top
+
+        field_spans = []
+        for name, _spec in fields:
+            nm = name.encode("utf-8")
+            eq = _key_name_eq(t, is_key, key_esc, nm)
+            sel_idx = np.nonzero(eq)[0]
+            if len(sel_idx):
+                rows_s = t.row_of[sel_idx]
+                keep = t.parent[sel_idx] == t.tok_offs[:-1][rows_s]
+                sel_idx = sel_idx[keep]
+                rows_s = rows_s[keep]
+            hit = np.full(R, -1, np.int64)
+            if len(sel_idx):
+                hit[rows_s] = sel_idx          # dup keys: last wins
+            have = hit >= 0
+            vs, vl, got, isn, unsafe = _value_spans(
+                t, hit + 1, have, null_is_none=True)
+            to_host |= have & unsafe
+            field_spans.append((vs, vl, got, isn, have))
+        to_host &= iv
+
+        row_ok = iv & t.valid & is_obj & ~to_host
+        host_trees = {}
+        if to_host.any():
+            rows = [None] * R
+            for i in np.nonzero(to_host)[0]:
+                rows[i] = bytes(chars[offs[i]:offs[i + 1]]).decode(
+                    "utf-8", errors="replace")
+            sub = Column.from_strings(rows)
+            for i, tree in enumerate(_parse_rows(sub,
+                                                 allow_leading_zeros)):
+                if to_host[i]:
+                    host_trees[i] = tree
+
+        valid_row = row_ok.copy()
+        for i, tree in host_trees.items():
+            valid_row[i] = tree is not None and tree[0] == "obj"
+
+        cols = []
+        for fi, (vs, vl, got, isn, have) in enumerate(field_spans):
+            fvalid = row_ok & got & ~isn
+            patch = {}
+            name = fields[fi][0]
+            for i, tree in host_trees.items():
+                if tree is None or tree[0] != "obj":
+                    continue
+                d = dict(tree[1])
+                v = d.get(name)
+                patch[i] = (None if v is None or v == ("lit", "null")
+                            else _value_as_raw_string(v))
+            cols.append(build_string_column(chars, vs, vl, fvalid,
+                                            patch))
+        return cols, valid_row, int(to_host.sum()), int(row_ok.sum())
+
+    outs = _map_chunks(col, work)
+    rows = col.length
+    valid_row = np.concatenate([o[1] for o in outs]) if outs else \
+        np.zeros(0, bool)
+    global last_stats
+    last_stats = {"rows": rows,
+                  "fallback_rows": sum(o[2] for o in outs),
+                  "token_rows": sum(o[3] for o in outs)}
+    children = []
+    for fi, (_name, spec) in enumerate(fields):
+        raw = _concat_parts([o[0][fi] for o in outs], rows)
+        children.append(convert_from_strings(raw, spec))
+    return Column.make_struct(
+        rows, children,
+        validity=None if valid_row.all()
+        else valid_row.astype(np.uint8))

@@ -45,27 +45,65 @@ def from_json_to_raw_map(col: Column,
     (JSONUtils.extractRawMapFromJsonString:159).  Non-object / invalid
     rows are null; duplicate keys keep the last value.
 
-    Columns above a size threshold route to the device multi-capture
-    scan (ops/raw_map_device.py, the from_json_to_raw_map.cu
-    counterpart); this host tree-builder stays the oracle and handles
-    the device scan's fallback rows."""
+    Engine choice is a measurement, not a backend gate (ISSUE 9): the
+    structural-index tokenizer (ops/json_tokenizer), the device
+    multi-capture scan (ops/raw_map_device.py) and this host
+    tree-builder are byte-identical candidates; the calibrator picks
+    per (doc shape, backend).  The tree-builder stays the oracle and
+    handles every engine's fallback rows."""
     import os
 
     import jax
 
+    from spark_rapids_tpu import observability as _obs
+    from spark_rapids_tpu.ops import json_tokenizer as JT
     from spark_rapids_tpu.ops import raw_map_device as RM
+    from spark_rapids_tpu.ops.json_path import route_json_engine
     min_rows = int(os.environ.get(
         "SPARK_RAPIDS_TPU_RAW_MAP_DEVICE_MIN", "256"))
     force = os.environ.get(
         "SPARK_RAPIDS_TPU_FORCE_DEVICE_RAW_MAP") == "1"
-    # accelerator-gated like the joins/groupby device paths: the
-    # multi-capture scan's one-hot register writes are VPU-shaped; on
-    # the 1-core CPU backend the host tree-builder measures ~4x faster
     on_accel = jax.default_backend() != "cpu"
-    if force or (on_accel and col.length >= min_rows):
+    if force:
+        engine = "device_scan"
+    elif col.length < min_rows:
+        engine = "host"
+    else:
+        engines = {
+            "host": lambda c: _raw_map_host(c, allow_leading_zeros),
+            "device_scan": lambda c:
+                RM.from_json_to_raw_map_device(c, allow_leading_zeros),
+            "tokenizer": lambda c:
+                JT.from_json_to_raw_map_tokenized(c,
+                                                  allow_leading_zeros),
+        }
+        # static default below the calibration floor = the pre-ISSUE-9
+        # routing (accel scan / host); above it the measurement decides
+        engine = route_json_engine(
+            "json.raw_map", col, engines,
+            "device_scan" if on_accel else "host")
+    # record the path AFTER fallback resolution: a device scan that
+    # declines the shape (returns None) really ran on the host, and the
+    # counter is sold as routing evidence
+    if engine == "tokenizer":
+        _obs.record_kernel_path("from_json_raw_map", "tokenizer",
+                                col.length)
+        return JT.from_json_to_raw_map_tokenized(col,
+                                                 allow_leading_zeros)
+    if engine == "device_scan":
         out = RM.from_json_to_raw_map_device(col, allow_leading_zeros)
         if out is not None:
+            _obs.record_kernel_path("from_json_raw_map", "device_scan",
+                                    col.length)
             return out
+    _obs.record_kernel_path("from_json_raw_map", "host", col.length)
+    return _raw_map_host(col, allow_leading_zeros)
+
+
+def _raw_map_host(col: Column,
+                  allow_leading_zeros: bool = False) -> Column:
+    """The host tree-builder — the oracle every raw-map engine falls
+    back to per row."""
     assert col.dtype.is_string
     rows = col.length
     keys: List[str] = []
@@ -235,9 +273,11 @@ def from_json_to_structs_nested(col: Column, schema,
 
     Nested schemas route to the device engine too (r5): struct fields
     compose scan paths, list nodes split elements vectorized and
-    recurse (ops/from_json_device.py).  Same accelerator gate as the
-    flat router; this host tree-builder stays the oracle and the
-    per-row fallback."""
+    recurse (ops/from_json_device.py).  Since ISSUE 9 the engine
+    choice is a measurement (host tree-builder / device scan / the
+    structural-index tokenizer for FLAT schemas), calibrated per
+    (schema shape, doc shape, backend); the tree-builder stays the
+    oracle and the per-row fallback."""
     assert col.dtype.is_string
     if not (isinstance(schema, tuple) and schema[0] == "struct"):
         raise ValueError("top-level schema must be a struct")
@@ -245,16 +285,55 @@ def from_json_to_structs_nested(col: Column, schema,
 
     import jax
 
+    from spark_rapids_tpu import observability as _obs
     from spark_rapids_tpu.ops import from_json_device as FJ
+    from spark_rapids_tpu.ops import json_tokenizer as JT
+    from spark_rapids_tpu.ops.json_path import route_json_engine
     min_rows = int(os.environ.get(
         "SPARK_RAPIDS_TPU_FROM_JSON_DEVICE_MIN", "256"))
     force = os.environ.get(
         "SPARK_RAPIDS_TPU_FORCE_DEVICE_FROM_JSON") == "1"
     on_accel = jax.default_backend() != "cpu"
-    if force or (on_accel and col.length >= min_rows):
-        out = FJ.from_json_to_structs_device(
-            col, list(schema[1]), allow_leading_zeros)
+    fields = list(schema[1])
+    flat = all(isinstance(spec, DType) for _n, spec in fields)
+
+    def _host(c):
+        return _build_json_column(
+            list(_parse_rows(c, allow_leading_zeros)), schema)
+
+    if force:
+        engine = "device_scan"
+    elif col.length < min_rows:
+        engine = "host"
+    else:
+        engines = {
+            "host": _host,
+            "device_scan": lambda c: FJ.from_json_to_structs_device(
+                c, fields, allow_leading_zeros),
+        }
+        if flat:
+            engines["tokenizer"] = \
+                lambda c: JT.from_json_to_structs_tokenized(
+                    c, fields, allow_leading_zeros)
+        engine = route_json_engine(
+            "json.from_json", col, engines,
+            "device_scan" if on_accel else "host",
+            extra=f"f{len(fields)}|flat{int(flat)}")
+    # record the path AFTER fallback resolution: an engine that
+    # declines the shape (returns None) really ran on the host
+    if engine == "tokenizer" and flat:
+        out = JT.from_json_to_structs_tokenized(col, fields,
+                                                allow_leading_zeros)
         if out is not None:
+            _obs.record_kernel_path("from_json_structs", "tokenizer",
+                                    col.length)
             return out
-    return _build_json_column(
-        list(_parse_rows(col, allow_leading_zeros)), schema)
+    if engine == "device_scan":
+        out = FJ.from_json_to_structs_device(
+            col, fields, allow_leading_zeros)
+        if out is not None:
+            _obs.record_kernel_path("from_json_structs", "device_scan",
+                                    col.length)
+            return out
+    _obs.record_kernel_path("from_json_structs", "host", col.length)
+    return _host(col)

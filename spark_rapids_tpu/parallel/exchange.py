@@ -36,6 +36,10 @@ from spark_rapids_tpu import observability as _obs
 
 _I32 = jnp.int32
 
+# counting-sort rank working-set cap: the (rows, n_parts) int32 cumsum
+# beyond this falls back to the stable-argsort layout
+_COUNTING_SORT_MAX_BYTES = 64 << 20
+
 
 def build_padded_sends(arrays: Sequence[jnp.ndarray], part: jnp.ndarray,
                        n_parts: int, capacity: int):
@@ -46,18 +50,38 @@ def build_padded_sends(arrays: Sequence[jnp.ndarray], part: jnp.ndarray,
     Returns (sends, counts): sends[i] has shape (n_parts, capacity, ...);
     counts is (n_parts,) true row counts (may exceed capacity — caller
     checks)."""
-    rows = part.shape[0]
-    order = jnp.argsort(part)
-    p_sorted = part[order]
-    counts = jnp.bincount(part, length=n_parts).astype(_I32)
-    starts = jnp.concatenate(
-        [jnp.zeros(1, _I32), jnp.cumsum(counts)[:-1].astype(_I32)])
-    rank = jnp.arange(rows, dtype=_I32) - starts[p_sorted]
+    # stable counting sort (ISSUE 9 satellite): partition ids are small
+    # ints, so the within-partition rank is one (rows, n_parts) one-hot
+    # cumsum — O(n * n_parts) elementwise work instead of the
+    # O(n log n) comparator sort jnp.argsort paid on every exchange.
+    # No explicit reorder is even needed: (partition, rank) slots are
+    # unique, so each row scatters straight to its padded slot, and the
+    # receive-side (src, slot) order is byte-identical to the old
+    # argsort layout (rank == stable sorted position within partition).
+    # The (rows, n_parts) int32 cumsum is the working set; past a
+    # budget it would dwarf the row data, so huge shards keep the
+    # argsort layout (identical (partition, rank) slots either way).
+    pi = part.astype(_I32)
+    rows = int(pi.shape[0])
+    if rows * max(n_parts, 1) * 4 <= _COUNTING_SORT_MAX_BYTES:
+        onehot = pi[:, None] == jnp.arange(n_parts, dtype=_I32)[None, :]
+        rank = jnp.take_along_axis(
+            jnp.cumsum(onehot.astype(_I32), axis=0),
+            jnp.clip(pi, 0, n_parts - 1)[:, None], axis=1)[:, 0] - 1
+        counts = jnp.sum(onehot, axis=0, dtype=_I32)
+    else:
+        order = jnp.argsort(pi)          # jnp.argsort is stable
+        p_sorted = pi[order]
+        counts = jnp.bincount(pi, length=n_parts).astype(_I32)
+        starts = jnp.concatenate(
+            [jnp.zeros(1, _I32), jnp.cumsum(counts)[:-1].astype(_I32)])
+        rank_sorted = jnp.arange(rows, dtype=_I32) - starts[p_sorted]
+        rank = jnp.zeros(rows, _I32).at[order].set(rank_sorted)
     slot = jnp.where(rank < capacity, rank, capacity)  # overflow -> dropped
     sends = []
     for a in arrays:
         buf = jnp.zeros((n_parts, capacity) + a.shape[1:], a.dtype)
-        sends.append(buf.at[p_sorted, slot].set(a[order], mode="drop"))
+        sends.append(buf.at[pi, slot].set(a, mode="drop"))
     return sends, counts
 
 

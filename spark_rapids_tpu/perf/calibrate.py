@@ -1,0 +1,248 @@
+"""Measured kernel-path calibration (ISSUE 9).
+
+The runtime used to hard-gate device kernels on the backend name
+(``jax.default_backend() != "cpu"``): joins took the host rank path on
+CPU, the JSON device scan was accelerator-only, and nobody ever
+measured whether that was still true.  This module makes the choice a
+*measurement*: the first large column of a given schema shape times
+each candidate path on a small sample and the winner is cached per
+``(op, digest, backend)`` — in-process for the steady state, and in a
+small JSON file (the same verdict-cache shape bench_impl.py grew for
+the Pallas row-conversion calibration) so repeated processes skip the
+timing entirely.
+
+Contract with callers:
+
+  * every candidate path MUST be byte-identical on the same input (the
+    fallback discipline each engine already enforces) — calibration
+    picks for SPEED only, never for correctness;
+  * candidates are thunks over a caller-built sample; a candidate that
+    raises is simply excluded (and remembered as ``error:<Type>`` in
+    the timing journal) — a missing/broken engine can never take down
+    the op;
+  * the whole calibration runs under a wall-clock budget
+    (``SPARK_RAPIDS_TPU_CALIB_BUDGET_S``): when the budget trips
+    mid-way the best candidate measured SO FAR wins (falling back to
+    the caller's default when nothing finished).
+
+Operators can pin a path per op with
+``SPARK_RAPIDS_TPU_PATH_<OP>=<path>`` (op uppercased, non-alnum ->
+``_``), bypassing measurement, and point the verdict file elsewhere
+with ``SPARK_RAPIDS_TPU_CALIB_CACHE`` (shared with the rowconv
+calibrator; empty string disables the file layer).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import tempfile
+import threading
+import time
+from typing import Callable, Dict, Mapping, Optional, Tuple
+
+_LOCK = threading.RLock()
+_PROC_CACHE: Dict[Tuple[str, str, str], str] = {}
+
+DEFAULT_TTL_S = 86400.0
+
+
+def _backend() -> str:
+    import jax
+    return jax.default_backend()
+
+
+def _synced(out):
+    """Fence async device work before the timer stops: an engine that
+    returns unsynced device arrays would otherwise be measured as
+    dispatch time only, and the too-fast verdict cached for a day.
+    Opaque (non-pytree) results pass through — their engines are host
+    code that already finished."""
+    try:
+        import jax
+        jax.block_until_ready(out)
+    except Exception:
+        pass
+    return out
+
+
+def cache_path() -> str:
+    """Verdict file (shared with bench_impl's rowconv calibrator).
+    Empty string disables the file layer (process cache still works)."""
+    return os.environ.get(
+        "SPARK_RAPIDS_TPU_CALIB_CACHE",
+        os.path.join(tempfile.gettempdir(), "srt_rowconv_calib.json"))
+
+
+def _load(path: str) -> dict:
+    if not path:
+        return {}
+    try:
+        with open(path) as f:
+            d = json.load(f)
+        return d if isinstance(d, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def _store(path: str, d: dict) -> None:
+    """Atomic tmp+replace write: a reader racing a plain truncate-write
+    would see torn JSON, _load would answer {}, and the next store
+    would persist that empty dict — wiping every cached verdict."""
+    if not path:
+        return
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(d, f)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def _ttl() -> float:
+    try:
+        return float(os.environ.get(
+            "SPARK_RAPIDS_TPU_CALIB_CACHE_TTL", DEFAULT_TTL_S))
+    except ValueError:
+        return DEFAULT_TTL_S
+
+
+def _budget() -> float:
+    try:
+        return float(os.environ.get(
+            "SPARK_RAPIDS_TPU_CALIB_BUDGET_S", "120"))
+    except ValueError:
+        return 120.0
+
+
+def cached_verdict(key: str) -> Optional[str]:
+    """Unexpired file-cache verdict for an opaque key (bench_impl's
+    rowconv calibration rides this same helper)."""
+    rec = _load(cache_path()).get(key)
+    if not isinstance(rec, dict):
+        return None
+    v = rec.get("verdict")
+    try:
+        fresh = time.time() - float(rec.get("t", 0)) < _ttl()
+    except (TypeError, ValueError):
+        fresh = False
+    return v if isinstance(v, str) and fresh else None
+
+
+def store_verdict(key: str, verdict: str) -> None:
+    with _LOCK:
+        path = cache_path()
+        d = _load(path)
+        d[key] = {"verdict": verdict, "t": time.time()}
+        _store(path, d)
+
+
+def pinned_path(op: str) -> Optional[str]:
+    env = "SPARK_RAPIDS_TPU_PATH_" + re.sub(r"[^A-Za-z0-9]", "_",
+                                            op).upper()
+    v = os.environ.get(env)
+    return v or None
+
+
+def forget(op: Optional[str] = None) -> None:
+    """Drop process-cache verdicts (tests / operator resets).  The file
+    layer keeps its entries — use SPARK_RAPIDS_TPU_CALIB_CACHE to point
+    tests at a throwaway file."""
+    with _LOCK:
+        if op is None:
+            _PROC_CACHE.clear()
+        else:
+            for k in [k for k in _PROC_CACHE if k[0] == op]:
+                del _PROC_CACHE[k]
+
+
+def pick_path(op: str, digest: str,
+              candidates: Mapping[str, Callable[[], object]],
+              default: str, *, repeats: int = 1) -> str:
+    """Name of the winning candidate for (op, digest, backend).
+
+    ``candidates`` maps path name -> thunk over a caller-built sample.
+    Measurement: one warm call (compiles / caches), then ``repeats``
+    timed calls, per candidate, under the calibration budget.  The
+    verdict is cached process-wide and in the verdict file; an env pin
+    (SPARK_RAPIDS_TPU_PATH_<OP>) short-circuits everything — even to a
+    path the caller did not offer (callers validate membership)."""
+    pin = pinned_path(op)
+    if pin is not None:
+        return pin
+    backend = _backend()
+    pkey = (op, digest, backend)
+    with _LOCK:
+        v = _PROC_CACHE.get(pkey)
+    if v is not None:
+        return v
+    fkey = f"{op}:{digest}@{backend}"
+    v = cached_verdict(fkey)
+    if v is not None and v in candidates:
+        with _LOCK:
+            _PROC_CACHE[pkey] = v
+        return v
+
+    budget = _budget()
+    t_start = time.perf_counter()
+    timings: Dict[str, float] = {}
+    errors: Dict[str, str] = {}
+    for name, thunk in candidates.items():
+        if time.perf_counter() - t_start > budget:
+            errors[name] = "budget_exceeded"
+            continue
+        try:
+            t_w = time.perf_counter()
+            _synced(thunk())             # warm: compile + caches
+            warm_s = time.perf_counter() - t_w
+            if time.perf_counter() - t_start > budget:
+                # the warm call alone tripped the budget: keep its wall
+                # time as the measurement (compile-biased, but a path
+                # this slow only needs to lose) and skip the repeats
+                timings[name] = warm_s
+                continue
+            t0 = time.perf_counter()
+            for _ in range(max(1, repeats)):
+                _synced(thunk())
+            timings[name] = (time.perf_counter() - t0) / max(1, repeats)
+        except Exception as e:  # noqa: BLE001 — a broken engine is a
+            # calibration datum, never an op failure
+            errors[name] = f"error:{type(e).__name__}"
+    if timings:
+        verdict = min(timings, key=timings.get)
+        if (timings[verdict] > budget
+                and errors.get(default) == "budget_exceeded"):
+            # every measured candidate alone blew the whole budget and
+            # the default never got a turn: a path that slow must not
+            # win just because it starved the competition — fall back
+            # to the static default instead of crowning the least-awful
+            # disaster (callers order expected-fast candidates first,
+            # so this only fires on pathological shapes)
+            verdict = default
+    else:
+        verdict = default
+    with _LOCK:
+        _PROC_CACHE[pkey] = verdict
+    store_verdict(fkey, verdict)
+    try:
+        from spark_rapids_tpu import observability as _obs
+        _obs.JOURNAL.emit(
+            "kernel_calibrated", op=op, digest=digest, backend=backend,
+            verdict=verdict,
+            timings_us={k: round(v * 1e6, 1)
+                        for k, v in sorted(timings.items())},
+            errors=errors or None)
+    except Exception:  # pragma: no cover - observability must not gate
+        pass
+    return verdict
+
+
+def last_verdict(op: str, digest: str) -> Optional[str]:
+    """Process-cache peek (bench labels / tests)."""
+    with _LOCK:
+        return _PROC_CACHE.get((op, digest, _backend()))

@@ -222,6 +222,39 @@ def render_jit_cache_table(registry: Optional[dict]) -> List[str]:
     return out
 
 
+def kernel_path_rows(registry: Optional[dict]) -> List[dict]:
+    """Per-op execution counts by the kernel path actually taken
+    (srt_kernel_path_total) — the calibrated join/JSON routing
+    evidence: an op stuck on ``host``/``host_rank`` at scale is the
+    "dead calibration" regression signal."""
+    rows: List[dict] = []
+    fam = (registry or {}).get("srt_kernel_path_total")
+    for s in (fam or {}).get("series", []):
+        labels = s.get("labels") or ("?", "?")
+        op = labels[0] if len(labels) > 0 else "?"
+        path = labels[1] if len(labels) > 1 else "?"
+        rows.append({"op": op, "path": path,
+                     "count": int(s.get("value", 0))})
+    return sorted(rows, key=lambda r: (r["op"], -r["count"], r["path"]))
+
+
+def render_kernel_path_table(registry: Optional[dict]) -> List[str]:
+    rows = kernel_path_rows(registry)
+    out = ["", "kernel paths (srt_kernel_path_total)", ""]
+    if not rows:
+        out.append("(no calibrated kernel-path activity recorded)")
+        return out
+    w_op = max(len(r["op"]) for r in rows)
+    w_p = max(max(len(r["path"]) for r in rows), len("path"))
+    hdr = f"{'op':<{w_op}}  {'path':<{w_p}}  {'count':>8}"
+    out.append(hdr)
+    out.append("-" * len(hdr))
+    for r in rows:
+        out.append(f"{r['op']:<{w_op}}  {r['path']:<{w_p}}  "
+                   f"{r['count']:>8}")
+    return out
+
+
 def retry_episode_rows(events: List[dict]) -> List[dict]:
     """Aggregate retry_episode journal events per driver name:
     episodes, attempts, splits, max split depth, time lost, and the
@@ -464,6 +497,7 @@ def build_report(records: List[dict]) -> dict:
         "histograms": histogram_rows(registry),
         "retry_episodes": retry_episode_rows(events),
         "jit_cache": jit_cache_rows(registry),
+        "kernel_paths": kernel_path_rows(registry),
         "server": server_rows(events, registry),
         "io": io_rows(events, registry),
     }
@@ -498,6 +532,8 @@ def main(argv=None) -> int:
         lines += render_io_table(events, registry)
     if registry is not None:
         lines += render_jit_cache_table(registry)
+        if (registry or {}).get("srt_kernel_path_total"):
+            lines += render_kernel_path_table(registry)
         lines += render_histogram_table(registry)
         lines.append("")
         lines.append(f"registry snapshot: {len(registry)} metric families")

@@ -1,0 +1,389 @@
+"""ISSUE 9 differential coverage: every join engine against the host
+rank oracle (nulls under both compare modes, NaN / -0.0 float keys,
+duplicate-key cross products, empty sides, overlong string keys,
+decimal128), the batch-parallel JSON tokenizer against the host
+tree-builder on an adversarial corpus, the vectorized _string_ranks
+fallback, the exchange counting sort, and the measured-path calibrator
+itself."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.columns.table import Table
+from spark_rapids_tpu.ops import joins
+from spark_rapids_tpu.ops import json_path as JP
+from spark_rapids_tpu.ops import json_tokenizer as JT
+from spark_rapids_tpu.ops import json_utils as JU
+from spark_rapids_tpu.perf import calibrate
+
+
+# ---------------------------------------------------------------- helpers
+
+def _pairs(out):
+    li, ri = out
+    return list(zip(np.asarray(li).tolist(), np.asarray(ri).tolist()))
+
+
+ENGINES = {
+    "host_rank": joins._sort_merge_inner_join_host,
+    "host_hash": joins._host_hash_inner_join,
+    "device_sort": joins._sort_merge_inner_join_device,
+    "device_hash": joins._device_hash_inner_join,
+}
+
+
+def assert_all_engines_match(left, right, compare_nulls=joins.NULL_EQUAL):
+    """Every engine must produce the oracle's exact pair sequence."""
+    want = _pairs(ENGINES["host_rank"](left, right, compare_nulls))
+    for name in ("host_hash", "device_sort", "device_hash"):
+        got = _pairs(ENGINES[name](left, right, compare_nulls))
+        assert got == want, f"{name} diverged from host oracle"
+    return want
+
+
+# ------------------------------------------------------------ join engines
+
+def test_join_int_keys_duplicates_cross_product():
+    rng = np.random.default_rng(7)
+    lk = rng.integers(0, 50, 400, dtype=np.int64)
+    rk = rng.integers(0, 50, 300, dtype=np.int64)
+    left = Table([Column.from_numpy(lk)])
+    right = Table([Column.from_numpy(rk)])
+    want = assert_all_engines_match(left, right)
+    # duplicate keys really fan out (cross product per key)
+    assert len(want) > 400
+
+
+def test_join_nulls_equal_and_unequal():
+    lk = np.array([1, 2, 3, 2, 7], np.int64)
+    rk = np.array([2, 3, 9, 2], np.int64)
+    lv = np.array([1, 0, 1, 1, 1], np.uint8)   # row 1 (key 2) null
+    rv = np.array([1, 1, 1, 0], np.uint8)      # row 3 (key 2) null
+    left = Table([Column.from_numpy(lk, validity=lv)])
+    right = Table([Column.from_numpy(rk, validity=rv)])
+    eq = assert_all_engines_match(left, right, joins.NULL_EQUAL)
+    uneq = assert_all_engines_match(left, right, joins.NULL_UNEQUAL)
+    # NULL_EQUAL pairs the two null rows; NULL_UNEQUAL drops them
+    assert (1, 3) in eq
+    assert all(p[0] != 1 and p[1] != 3 for p in uneq)
+
+
+def test_join_float_nan_negzero():
+    lk = np.array([1.0, np.nan, -0.0, 0.0, 2.5], np.float64)
+    rk = np.array([np.nan, 0.0, -0.0, 2.5], np.float64)
+    left = Table([Column.from_numpy(lk)])
+    right = Table([Column.from_numpy(rk)])
+    want = assert_all_engines_match(left, right)
+    # Spark total order: NaN == NaN, -0.0 != 0.0 (distinct bit patterns
+    # under the total-order key)
+    assert (1, 0) in want            # NaN joins NaN
+    assert (2, 2) in want and (3, 1) in want
+    assert (2, 1) not in want and (3, 2) not in want
+
+
+def test_join_empty_sides():
+    full = Table([Column.from_numpy(np.array([1, 2], np.int64))])
+    empty = Table([Column.from_numpy(np.zeros(0, np.int64))])
+    for l, r in ((full, empty), (empty, full), (empty, empty)):
+        assert assert_all_engines_match(l, r) == []
+
+
+def test_join_string_keys_and_multicolumn():
+    ls = Column.from_strings(["apple", "b", "", "apple", None, "cc"])
+    rs = Column.from_strings(["b", "apple", None, "", "zz"])
+    ln = Column.from_numpy(np.array([1, 2, 3, 1, 5, 6], np.int64))
+    rn = Column.from_numpy(np.array([2, 1, 5, 3, 9], np.int64))
+    left = Table([ls, ln])
+    right = Table([rs, rn])
+    assert_all_engines_match(left, right, joins.NULL_EQUAL)
+    assert_all_engines_match(left, right, joins.NULL_UNEQUAL)
+
+
+def test_join_decimal128_keys():
+    vals_l = [10**20, -(10**25), 7, 10**20, None]
+    vals_r = [7, 10**20, None, -(10**25)]
+    dt = dtypes.DType(dtypes.Kind.DECIMAL128, scale=2)
+    left = Table([Column.from_pylist(vals_l, dt)])
+    right = Table([Column.from_pylist(vals_r, dt)])
+    assert_all_engines_match(left, right, joins.NULL_EQUAL)
+    assert_all_engines_match(left, right, joins.NULL_UNEQUAL)
+
+
+def test_join_overlong_string_keys_route_host(monkeypatch):
+    """Strings past DEVICE_STR_KEY_MAX_LEN have no device encoding: the
+    router must take host_rank regardless of pins, and the result must
+    match a truncation-free oracle."""
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_PATH_JOIN_INNER", "device_hash")
+    long_a = "x" * (joins.DEVICE_STR_KEY_MAX_LEN + 5)
+    long_b = "x" * (joins.DEVICE_STR_KEY_MAX_LEN + 5) + "y"
+    left = Table([Column.from_strings([long_a, long_b, "s"])])
+    right = Table([Column.from_strings([long_b, "s", long_a])])
+    got = _pairs(joins.sort_merge_inner_join(left, right))
+    assert got == [(0, 2), (1, 0), (2, 1)]
+
+
+def test_join_router_env_pin(monkeypatch):
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_PATH_JOIN_INNER", "host_hash")
+    left = Table([Column.from_numpy(np.arange(100, dtype=np.int64))])
+    right = Table([Column.from_numpy(np.arange(50, dtype=np.int64))])
+    got = _pairs(joins.sort_merge_inner_join(left, right))
+    assert got == [(i, i) for i in range(50)]
+
+
+def test_join_random_differential():
+    rng = np.random.default_rng(42)
+    for _ in range(4):
+        nl, nr = rng.integers(1, 400, 2)
+        lk = rng.integers(-5, 30, nl, dtype=np.int64)
+        rk = rng.integers(-5, 30, nr, dtype=np.int64)
+        lv = (rng.random(nl) < 0.85).astype(np.uint8)
+        rv = (rng.random(nr) < 0.85).astype(np.uint8)
+        left = Table([Column.from_numpy(lk, validity=lv)])
+        right = Table([Column.from_numpy(rk, validity=rv)])
+        for mode in (joins.NULL_EQUAL, joins.NULL_UNEQUAL):
+            assert_all_engines_match(left, right, mode)
+
+
+# -------------------------------------------------------- string ranks
+
+def _rank_oracle(chars, offsets):
+    vals = np.array([chars[offsets[i]:offsets[i + 1]].tobytes()
+                     for i in range(len(offsets) - 1)], dtype=object)
+    _, inv = np.unique(vals, return_inverse=True)
+    return inv.astype(np.int64)
+
+
+def test_string_ranks_vectorized_matches_oracle():
+    rng = np.random.default_rng(3)
+    strs = []
+    for _ in range(500):
+        n = int(rng.integers(0, 30))
+        strs.append(bytes(rng.integers(0, 256, n, dtype=np.uint8)))
+    # adversarial: null-byte padding must not collide with shorter keys
+    strs += [b"a", b"a\x00", b"a\x00\x00", b"", b"\x00"]
+    offsets = np.zeros(len(strs) + 1, np.int64)
+    np.cumsum([len(s) for s in strs], out=offsets[1:])
+    chars = np.frombuffer(b"".join(strs), np.uint8)
+    got = joins._string_ranks(chars, offsets)
+    want = _rank_oracle(chars, offsets)
+    assert np.array_equal(got, want)
+
+
+def test_string_ranks_wide_budget_fallback(monkeypatch):
+    """Past the packed-word budget the exact per-row path must engage
+    and still match."""
+    monkeypatch.setattr(joins, "_STRING_RANK_WORDS_BUDGET", 64)
+    strs = [b"longish-string-%d" % (i % 7) for i in range(20)]
+    offsets = np.zeros(len(strs) + 1, np.int64)
+    np.cumsum([len(s) for s in strs], out=offsets[1:])
+    chars = np.frombuffer(b"".join(strs), np.uint8)
+    assert np.array_equal(joins._string_ranks(chars, offsets),
+                          _rank_oracle(chars, offsets))
+
+
+# ------------------------------------------------------ exchange sort
+
+def test_exchange_counting_sort_byte_identical():
+    """The counting-sort padded-send layout must equal the old argsort
+    layout exactly (receive-side order is a wire contract)."""
+    from spark_rapids_tpu.parallel.exchange import build_padded_sends
+    rng = np.random.default_rng(9)
+    rows, n_parts, cap = 257, 8, 64
+    part = jnp.asarray(rng.integers(0, n_parts, rows, dtype=np.int32))
+    a = jnp.asarray(rng.integers(0, 1000, rows, dtype=np.int64))
+    b = jnp.asarray(rng.normal(size=rows))
+    sends, counts = build_padded_sends([a, b], part, n_parts, cap)
+    # reference: the original argsort formulation
+    order = np.argsort(np.asarray(part), kind="stable")
+    p_sorted = np.asarray(part)[order]
+    counts_ref = np.bincount(np.asarray(part), minlength=n_parts)
+    starts = np.concatenate([[0], np.cumsum(counts_ref)[:-1]])
+    rank = np.arange(rows) - starts[p_sorted]
+    for arr, send in ((np.asarray(a), sends[0]), (np.asarray(b),
+                                                  sends[1])):
+        buf = np.zeros((n_parts, cap) + arr.shape[1:], arr.dtype)
+        ok = rank < cap
+        buf[p_sorted[ok], rank[ok]] = arr[order][ok]
+        assert np.array_equal(np.asarray(send), buf)
+    assert np.array_equal(np.asarray(counts), counts_ref)
+
+
+# --------------------------------------------------- tokenizer corpus
+
+ADVERSARIAL_DOCS = [
+    '{"a": 1, "b": "x"}',
+    '{"a": {"b": {"c": [1, 2, {"d": "deep"}]}}}',
+    '{"esc": "a\\"b\\\\c\\/d\\n\\t\\u0041"}',
+    '{"a\\u0062c": 1}',                      # escaped KEY
+    '{"dup": 1, "dup": 2}',
+    '{"dup": 1, "dup": 2, "dup": 3}',
+    '[1, 2, 3]',                             # non-object root
+    '"just a string"',
+    '42', '-0', '0.5', '1e10', '1.5E-3', '12.', 'true', 'false',
+    'null', '', '   ', None,
+    '{"n": -0.0, "m": 007}',                 # leading zeros (invalid)
+    '{"a": [', '{"a": }', '{broken', '{"a": 1,}', '[1 2]',
+    "{'single': 1}",                         # single quotes -> host
+    '{"unterminated": "x',
+    '{"ctrl": "a\tb"}',                      # raw control char in str
+    '{"nested": ' + '[' * 20 + '1' + ']' * 20 + '}',   # > MAX_DEPTH
+    '{' + ", ".join('"k%d": %d' % (i, i)
+                    for i in range(JT.MAX_PAIRS + 5)) + '}',
+    '{"ws" :  { "a" : [ 1 , 2 ] } }',        # whitespace everywhere
+    '{"num": 123456789012345678901234567890123}',    # overlong prim
+    '{"a": "\\ud83d\\ude00"}',               # surrogate pair escape
+    '{"b": "café 中文"}',       # raw multibyte UTF-8
+    '{"a": []}', '{"a": {}}', '{}',
+    '{"a": null}', '{"a": true}',
+    '  {"lead": 1}  ',
+]
+
+
+def _host_gjo(docs, path):
+    return JP.get_json_object_host(
+        Column.from_strings(docs), path).to_pylist()
+
+
+@pytest.mark.parametrize("path", ["$.a", "$.a.b", "$.a.b.c[1]",
+                                  "$.dup", "$.esc", "$.ws.a[0]",
+                                  "$.nested", "$.num", "$.b"])
+def test_tokenizer_get_json_object_differential(path):
+    col = Column.from_strings(ADVERSARIAL_DOCS)
+    got = JT.get_json_object_tokenized(col, path)
+    want = _host_gjo(ADVERSARIAL_DOCS, path)
+    assert got.to_pylist() == want
+
+
+def test_tokenizer_multiple_paths_shared_pass():
+    col = Column.from_strings(ADVERSARIAL_DOCS)
+    paths = ["$.a", "$.dup", "$.esc", "$.doesnotexist"]
+    outs = JT.get_json_object_multiple_paths_tokenized(col, paths)
+    for p, o in zip(paths, outs):
+        assert o.to_pylist() == _host_gjo(ADVERSARIAL_DOCS, p)
+
+
+def test_tokenizer_raw_map_differential():
+    col = Column.from_strings(ADVERSARIAL_DOCS)
+    got = JT.from_json_to_raw_map_tokenized(col)
+    want = JU._raw_map_host(col)
+    assert got.to_pylist() == want.to_pylist()
+
+
+def test_tokenizer_raw_map_leading_zeros():
+    docs = ['{"a": 007, "b": 1}', '{"a": 0.5}']
+    col = Column.from_strings(docs)
+    for lz in (False, True):
+        got = JT.from_json_to_raw_map_tokenized(col, lz)
+        want = JU._raw_map_host(col, lz)
+        assert got.to_pylist() == want.to_pylist()
+
+
+def test_tokenizer_structs_differential():
+    docs = ADVERSARIAL_DOCS + ['{"a": "str", "i": 42, "f": 2.5}',
+                               '{"i": "notanint", "f": true}']
+    col = Column.from_strings(docs)
+    fields = [("a", dtypes.STRING), ("i", dtypes.INT64),
+              ("f", dtypes.FLOAT64), ("dup", dtypes.STRING)]
+    got = JT.from_json_to_structs_tokenized(col, fields)
+    want = JU._build_json_column(
+        list(JU._parse_rows(col, False)), ("struct", fields))
+    assert got.to_pylist() == want.to_pylist()
+
+
+def test_tokenizer_chunked_and_validity():
+    """Row chunking and an input validity mask must not shift results."""
+    docs = (['{"a": %d}' % i for i in range(50)] + [None, '{"a": 1}'])
+    col = Column.from_strings(docs)
+    import unittest.mock as mock
+    with mock.patch.object(JT, "ROW_CHUNK", 16):
+        got = JT.get_json_object_tokenized(col, "$.a")
+    assert got.to_pylist() == _host_gjo(docs, "$.a")
+
+
+def test_tokenizer_fallback_stats():
+    docs = ['{"a": 1}'] * 10 + ["{'host': 1}"]
+    JT.get_json_object_tokenized(Column.from_strings(docs), "$.a")
+    assert JT.last_stats["rows"] == 11
+    assert JT.last_stats["fallback_rows"] == 1
+    assert JT.last_stats["token_rows"] == 10
+
+
+# ------------------------------------------------------- calibrator
+
+def test_calibrator_pick_cache_and_errors(tmp_path, monkeypatch):
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_CALIB_CACHE",
+                       str(tmp_path / "calib.json"))
+    calibrate.forget()
+    calls = {"fast": 0, "slow": 0}
+
+    def fast():
+        calls["fast"] += 1
+
+    def slow():
+        calls["slow"] += 1
+        import time
+        time.sleep(0.02)
+
+    def broken():
+        raise RuntimeError("no engine")
+
+    cands = {"fast": fast, "slow": slow, "broken": broken}
+    got = calibrate.pick_path("test.op", "d1", cands, default="slow")
+    assert got == "fast"
+    # process-cache hit: no re-timing
+    n = calls["fast"]
+    assert calibrate.pick_path("test.op", "d1", cands, "slow") == "fast"
+    assert calls["fast"] == n
+    # file-cache survives a process-cache reset
+    calibrate.forget("test.op")
+    assert calibrate.pick_path("test.op", "d1", cands, "slow") == "fast"
+    d = json.loads((tmp_path / "calib.json").read_text())
+    key = next(k for k in d if k.startswith("test.op:d1@"))
+    assert d[key]["verdict"] == "fast"
+
+
+def test_calibrator_env_pin(monkeypatch):
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_PATH_TEST_OP2", "pinned")
+    got = calibrate.pick_path("test.op2", "d", {"a": lambda: None},
+                              default="a")
+    assert got == "pinned"
+
+
+def test_calibrator_all_broken_falls_to_default(tmp_path, monkeypatch):
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_CALIB_CACHE",
+                       str(tmp_path / "calib.json"))
+    calibrate.forget()
+
+    def boom():
+        raise ValueError("x")
+
+    got = calibrate.pick_path("test.op3", "d", {"a": boom, "b": boom},
+                              default="b")
+    assert got == "b"
+
+
+def test_kernel_path_metric_records(monkeypatch):
+    from spark_rapids_tpu import observability as obs
+    obs.enable()
+    obs.reset()
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_PATH_JOIN_INNER", "host_hash")
+    left = Table([Column.from_numpy(np.arange(64, dtype=np.int64))])
+    right = Table([Column.from_numpy(np.arange(8, dtype=np.int64))])
+    joins.sort_merge_inner_join(left, right)
+    snap = obs.METRICS.snapshot()
+    fam = snap["srt_kernel_path_total"]["series"]
+    assert any(tuple(s["labels"]) == ("join.inner", "host_hash")
+               and s["value"] >= 1 for s in fam)
+    # the metrics_report kernel-path table renders it
+    from spark_rapids_tpu.tools import metrics_report as MR
+    rows = MR.kernel_path_rows(snap)
+    assert {"op": "join.inner", "path": "host_hash",
+            "count": rows[0]["count"]} == rows[0]
+    obs.disable()
