@@ -3,12 +3,19 @@
 
 PY ?= python
 
-.PHONY: test fuzz native sanitizers bench bench-all dryrun tpu-lower \
+.PHONY: test test-all fuzz native sanitizers bench bench-all dryrun \
+        tpu-lower \
         jni-test kudo-bench metrics-smoke trace-smoke chaos-smoke \
         perf-smoke doctor-smoke server-smoke lifeguard-smoke \
-        ingest-smoke nightly-artifacts ci ci-nightly clean
+        ingest-smoke dist-smoke nightly-artifacts ci ci-nightly clean
 
+# tier-1 set: slow-marked tests (the subprocess fleet twins of the
+# dist-smoke gate) are excluded here exactly like the driver's verify
+# command; `make test-all` runs everything
 test:
+	$(PY) -m pytest tests/ -q -m 'not slow'
+
+test-all:
 	$(PY) -m pytest tests/ -q
 
 fuzz:
@@ -113,6 +120,16 @@ lifeguard-smoke:
 ingest-smoke:
 	$(PY) scripts/ingest_smoke.py
 
+# distributed-shuffle gate: a 2-process CPU fleet runs q5 + q72 with
+# the kudo socket shuffle between ranks; shuffle bytes must cross the
+# process boundary (per-link srt_shuffle_link_* > 0 on both peers),
+# results must be byte-identical to the single-process pipelines, an
+# injected corrupt link must be NAK'd and healed by the link retry,
+# and every process's spans must stitch into ONE connected trace via
+# the KTRX header (one root, zero orphans, cross-process links)
+dist-smoke:
+	$(PY) scripts/dist_smoke.py
+
 # NOTE: jax.config.update, not the env var — this image's sitecustomize
 # pre-imports jax with the axon backend, so JAX_PLATFORMS=cpu is too
 # late.  XLA_FLAGS still works (read at backend init, which happens
@@ -135,7 +152,7 @@ dryrun:
 # BENCH_FIGHT_SECONDS=1 for a quick local run.
 ci: test fuzz native sanitizers tpu-lower jni-test dryrun metrics-smoke \
     trace-smoke chaos-smoke perf-smoke doctor-smoke server-smoke \
-    lifeguard-smoke ingest-smoke
+    lifeguard-smoke ingest-smoke dist-smoke
 	$(PY) bench.py
 	@echo "ci: all gates green"
 

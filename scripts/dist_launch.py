@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""CLI shim over spark_rapids_tpu.distributed.launcher: spawn an
+N-process CPU fleet running the distributed TPC-DS queries through the
+kudo socket shuffle.
+
+  python scripts/dist_launch.py --world 2 --ops q5,q72 --outdir /tmp/d
+  python scripts/dist_launch.py --world 3 --fault corrupt:0:101
+
+See docs/distributed.md for the topology and knobs."""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--world", type=int, default=2)
+    ap.add_argument("--ops", default="q5,q72")
+    ap.add_argument("--outdir", default=None)
+    ap.add_argument("--transport", choices=("unix", "tcp"),
+                    default="unix")
+    ap.add_argument("--fault", default=None,
+                    help="link fault spec, e.g. corrupt:0:101 or "
+                         "trunc:0:102 (armed on --fault-rank)")
+    ap.add_argument("--fault-rank", type=int, default=1)
+    ap.add_argument("--mesh", default="0",
+                    help="SPARK_RAPIDS_TPU_DIST_MESH for workers "
+                         "(0=harness, auto=attempt jax.distributed)")
+    ap.add_argument("--timeout-s", type=float, default=300.0)
+    ap.add_argument("--params", default="{}")
+    args = ap.parse_args(argv)
+
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    from spark_rapids_tpu.distributed import launcher
+
+    outdir = args.outdir or tempfile.mkdtemp(prefix="srt_dist_")
+    res = launcher.launch(
+        args.world, outdir, ops=tuple(args.ops.split(",")),
+        transport=args.transport, fault=args.fault,
+        fault_rank=args.fault_rank, mesh=args.mesh,
+        timeout_s=args.timeout_s, params=json.loads(args.params))
+    print(json.dumps(res, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,181 @@
+"""Distributed-shuffle smoke gate (`make dist-smoke`, ISSUE 10
+acceptance): an N>=2-process CPU fleet runs the distributed q5 AND q72
+through the kudo socket shuffle and the gate asserts the whole
+scale-out story —
+
+  * shuffle bytes demonstrably CROSS a process boundary: per-link
+    ``srt_shuffle_link_bytes_total`` (send AND recv) > 0 in every
+    worker's metrics dump;
+  * results byte-identical to the single-process pipelines (q5 and
+    q72, every output column, every rank's copy);
+  * one injected corrupt link mid-query (rank 1's first q5
+    reduce-scatter payload to rank 0 is bit-flipped after CRC) is
+    NAK'd by the receiving verifier and healed by a clean resend —
+    ``srt_shuffle_link_retries_total`` >= 1 on the faulted worker,
+    results STILL byte-identical;
+  * spans from the launcher and every worker stitch into ONE
+    connected trace via the KTRX header: a single trace_id, exactly
+    one root, zero orphans, and >= 1 cross-process span link, with a
+    loadable Perfetto export.
+
+With ``--write-artifact`` the measured run is recorded as
+MULTICHIP_r06.json (the multi-process successor of the r01-r05
+virtual-mesh artifacts).  Exits non-zero on the first missing signal."""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+WORLD = int(os.environ.get("DIST_SMOKE_WORLD", "2"))
+FAULT = "corrupt:0:101"  # rank1 -> rank0, q5 reduce-scatter op id
+
+
+def fail(msg: str) -> "NoReturn":  # noqa: F821
+    print(f"dist-smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def say(msg: str) -> None:
+    print(f"dist-smoke: {msg}")
+
+
+def main(argv=None) -> int:
+    import numpy as np
+
+    from spark_rapids_tpu.distributed import launcher, runner
+    from spark_rapids_tpu.tools import trace_export as TE
+
+    write_artifact = "--write-artifact" in (argv or sys.argv[1:])
+    t0 = time.monotonic()
+    outdir = tempfile.mkdtemp(prefix="dist_smoke_")
+    say(f"launching {WORLD}-process fleet (unix sockets, injected "
+        f"fault {FAULT} on rank 1) -> {outdir}")
+    res = launcher.launch(WORLD, outdir, ops=("q5", "q72"),
+                          fault=FAULT, fault_rank=1, timeout_s=240.0)
+
+    # ---- byte identity vs the single-process pipelines -------------
+    refs = {"q5": runner.single_q5({"world": WORLD}),
+            "q72": runner.single_q72({"world": WORLD})}
+    cols = {"q5": ("key", "sales", "rets", "profit"),
+            "q72": ("item", "week", "cnt")}
+    for op in ("q5", "q72"):
+        for r in range(WORLD):
+            got = dict(np.load(os.path.join(
+                outdir, f"result_{op}_rank{r}.npz")))
+            for c in cols[op]:
+                if got[c].tobytes() != refs[op][c].tobytes():
+                    fail(f"{op} column {c!r} differs on rank {r} "
+                         f"vs single-process")
+            if bool(got["overflow"]) != bool(refs[op]["overflow"]):
+                fail(f"{op} overflow flag differs on rank {r}")
+    say("q5 + q72 byte-identical to single-process on every rank")
+
+    # ---- per-link shuffle bytes on BOTH peers ----------------------
+    link_bytes = {}
+    retries_total = 0
+    for r in range(WORLD):
+        with open(os.path.join(outdir,
+                               f"metrics_rank{r}.json")) as f:
+            snap = json.load(f)
+        series = snap.get("srt_shuffle_link_bytes_total",
+                          {}).get("series", [])
+        sent = sum(s["value"] for s in series
+                   if s["labels"][0] == "send")
+        recv = sum(s["value"] for s in series
+                   if s["labels"][0] == "recv")
+        if sent <= 0 or recv <= 0:
+            fail(f"rank {r} shows no cross-process shuffle bytes "
+                 f"(send={sent} recv={recv})")
+        link_bytes[f"rank{r}"] = {"send": sent, "recv": recv}
+        # count NAK retries specifically: only a peer-side CRC refusal
+        # proves the corrupt bytes actually hit the wire (a mere
+        # reconnect retry would make this acceptance vacuous)
+        retries_total += sum(
+            s["value"] for s in snap.get(
+                "srt_shuffle_link_retries_total",
+                {}).get("series", [])
+            if s["labels"][1] == "nak")
+    say(f"per-link shuffle bytes: {link_bytes}")
+    if retries_total < 1:
+        fail("injected corrupt link produced no NAK retry in "
+             "srt_shuffle_link_retries_total")
+    say(f"injected corrupt link healed ({retries_total} NAK "
+        f"retries recorded)")
+
+    # ---- one connected cross-process trace -------------------------
+    files = launcher.span_files(outdir, WORLD)
+    if len(files) != WORLD + 1:
+        fail(f"expected {WORLD + 1} span dumps, found {files}")
+    loaded = TE.load_files(files)
+    spans = TE.spans_of([r for _, rr in loaded for r in rr])
+    tids = {s["trace_id"] for s in spans}
+    if len(tids) != 1:
+        fail(f"spans split across {len(tids)} trace ids: {tids}")
+    summ = TE.trace_summary(spans)[next(iter(tids))]
+    if summ["orphans"]:
+        fail(f"{summ['orphans']} orphan spans break the tree")
+    if summ["roots"] != ["dist_query"]:
+        fail(f"want exactly one 'dist_query' root, got "
+             f"{summ['roots']}")
+    by_file = {}
+    for p, rr in loaded:
+        for s in TE.spans_of(rr):
+            by_file[s["span_id"]] = p
+    cross = sum(
+        1 for s in spans for link in s.get("links", ())
+        if link["span_id"] in by_file
+        and by_file[link["span_id"]] != by_file[s["span_id"]])
+    if cross < 1:
+        fail("no cross-process span links (KTRX stitching broken)")
+    perfetto = TE.to_chrome_trace(loaded)
+    if not any(e.get("ph") == "s" for e in perfetto["traceEvents"]):
+        fail("Perfetto export has no flow arrows for shuffle links")
+    say(f"ONE connected trace: {summ['spans']} spans, 1 root, "
+        f"0 orphans, {cross} cross-process links")
+
+    wall = time.monotonic() - t0
+    if write_artifact:
+        art = {
+            "n_processes": WORLD,
+            "transport": "unix",
+            "mesh": res["summaries"][0]["mesh"]["mode"],
+            "queries": {
+                op: {"byte_identical": True,
+                     "rows": (runner.Q5_PARAMS["rows"]
+                              if op == "q5"
+                              else runner.Q72_PARAMS["cs_rows"])}
+                for op in ("q5", "q72")},
+            "shuffle_link_bytes": link_bytes,
+            "link_retries_healed": retries_total,
+            "trace": {"trace_ids": 1, "roots": 1, "orphans": 0,
+                      "spans": summ["spans"],
+                      "cross_process_links": cross},
+            "wall_s": round(wall, 2),
+            "rc": 0,
+            "ok": True,
+        }
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "MULTICHIP_r06.json")
+        with open(path, "w") as f:
+            json.dump(art, f, indent=1)
+            f.write("\n")
+        say(f"wrote {path}")
+
+    say(f"OK ({WORLD} processes, {summ['spans']} spans, "
+        f"{wall:.1f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

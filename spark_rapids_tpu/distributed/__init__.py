@@ -1,0 +1,22 @@
+"""Multi-process scale-out: a distributed shuffle service with kudo as
+the inter-host wire format (ISSUE 10).
+
+Modules:
+  * transport — framed kudo streams over TCP/unix sockets with
+    ACK/NAK delivery, dedup, and RetryPolicy-driven link retry;
+  * service   — :class:`ShuffleService`: rank-ordered all-to-all /
+    allgather / barrier; plugs into ``parallel.exchange`` as the
+    process's table transport;
+  * mesh      — jax.distributed mesh attempt with graceful
+    degradation to the process-per-shard harness;
+  * runner    — distributed q5/q72 workers (the per-query entry
+    points are importable for in-process tests);
+  * launcher  — spawn/babysit N worker processes, seed one trace.
+
+See docs/distributed.md for topology, the wire protocol, failure
+semantics, and knobs.
+"""
+
+from spark_rapids_tpu.distributed.service import ShuffleService  # noqa: F401
+from spark_rapids_tpu.distributed.transport import (  # noqa: F401
+    Inbox, Listener, PeerLink, clear_link_faults, set_link_fault)

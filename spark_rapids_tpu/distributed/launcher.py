@@ -1,0 +1,181 @@
+"""Multi-process launch harness (ISSUE 10): spawn N shuffle workers,
+hand out the port map, seed ONE trace context so every process's spans
+stitch into a single tree, babysit the processes, and collect results.
+
+The launcher is a library (scripts/dist_launch.py is the CLI shim) so
+the dist-smoke gate and the slow tests drive the same code path."""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def make_addresses(world: int, outdir: str,
+                   transport: str = "unix") -> List[str]:
+    """Per-rank listen addresses.  Unix sockets (default) live in the
+    run directory — no port allocation races; TCP mode binds throwaway
+    sockets to reserve free localhost ports (the map is then passed to
+    every worker, so all peers agree)."""
+    if transport == "unix":
+        return [f"unix:{os.path.join(outdir, f'shuffle_{r}.sock')}"
+                for r in range(world)]
+    # hold every probe socket open until the whole map is built: a
+    # closed never-listened port is immediately reusable, so closing
+    # per-iteration could hand the SAME ephemeral port to two ranks
+    probes = []
+    addrs = []
+    try:
+        for _ in range(world):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.bind(("127.0.0.1", 0))
+            probes.append(s)
+            addrs.append(f"127.0.0.1:{s.getsockname()[1]}")
+    finally:
+        for s in probes:
+            s.close()
+    return addrs
+
+
+def launch(world: int, outdir: str, *,
+           ops: Sequence[str] = ("q5", "q72"),
+           transport: str = "unix",
+           params: Optional[dict] = None,
+           fault: Optional[str] = None,
+           fault_rank: int = 1,
+           mesh: str = "0",
+           timeout_s: float = 300.0) -> Dict:
+    """Run ``world`` worker processes to completion.  Returns
+    ``{"summaries": [...], "addresses": [...], "trace_id": hex,
+    "outdir": ...}``.  ``fault`` is a transport fault spec (e.g.
+    ``"corrupt:0:101"``) armed on ``fault_rank``'s environment — the
+    injected corrupt/truncated link must be healed by the link retry
+    for the run to succeed at all (results are still compared
+    upstream)."""
+    from spark_rapids_tpu import observability as obs
+
+    os.makedirs(outdir, exist_ok=True)
+    addrs = make_addresses(world, outdir, transport)
+
+    # one trace for the whole fleet: the launcher owns the root span;
+    # workers parent their process spans under it via the env context
+    prior_tracing = obs.TRACER.enabled
+    obs.enable_tracing()
+    root = obs.TRACER.start_span(
+        "dist_query", kind="query",
+        attrs={"world": world, "ops": ",".join(ops),
+               "transport": transport})
+    trace_ctx = f"{root.trace_id:016x}:{root.span_id:016x}"
+
+    procs = []
+    logs = []
+    failed = True
+    try:
+        for r in range(world):
+            env = dict(os.environ)
+            env.update({
+                "JAX_PLATFORMS": "cpu",
+                "SPARK_RAPIDS_TPU_KUDO_CRC": "1",
+                "SPARK_RAPIDS_TPU_DIST_TRACE_CTX": trace_ctx,
+                "SPARK_RAPIDS_TPU_DIST_MESH": mesh,
+                "PYTHONPATH": _REPO_ROOT + os.pathsep
+                + env.get("PYTHONPATH", ""),
+            })
+            if fault and r == fault_rank:
+                env["SPARK_RAPIDS_TPU_DIST_FAULT"] = fault
+            cmd = [sys.executable, "-m",
+                   "spark_rapids_tpu.distributed.runner",
+                   "--rank", str(r), "--world", str(world),
+                   "--addresses", ",".join(addrs),
+                   "--ops", ",".join(ops),
+                   "--outdir", outdir,
+                   "--params", json.dumps(params or {})]
+            log = open(os.path.join(outdir, f"worker_rank{r}.log"),
+                       "w")
+            logs.append(log)
+            procs.append(subprocess.Popen(
+                cmd, cwd=_REPO_ROOT, env=env, stdout=log,
+                stderr=subprocess.STDOUT))
+        deadline = time.monotonic() + timeout_s
+        for r, proc in enumerate(procs):
+            left = deadline - time.monotonic()
+            try:
+                rc = proc.wait(timeout=max(left, 1.0))
+            except subprocess.TimeoutExpired:
+                raise RuntimeError(
+                    f"worker rank {r} timed out after {timeout_s}s "
+                    f"(log: {_tail(outdir, r)})")
+            if rc != 0:
+                raise RuntimeError(
+                    f"worker rank {r} exited rc={rc}: "
+                    f"{_tail(outdir, r)}")
+        failed = False
+    finally:
+        if failed:
+            # ANY error exit (spawn-loop failure included) must not
+            # leak live workers holding sockets and CPU
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except Exception:  # noqa: BLE001 — best-effort reap
+                    pass
+        for log in logs:
+            log.close()
+        root.end()
+        _dump_launcher_spans(outdir, f"{root.trace_id:016x}")
+        if not prior_tracing:
+            obs.disable_tracing()
+
+    summaries = []
+    for r in range(world):
+        with open(os.path.join(outdir,
+                               f"summary_rank{r}.json")) as f:
+            summaries.append(json.load(f))
+    return {"summaries": summaries, "addresses": addrs,
+            "trace_id": f"{root.trace_id:016x}", "outdir": outdir,
+            "world": world, "ops": list(ops)}
+
+
+def _dump_launcher_spans(outdir: str, trace_id: str) -> None:
+    """Write the launcher's OWN spans for this trace (the fleet root)
+    so the cross-process merge has the tree's apex."""
+    from spark_rapids_tpu import observability as obs
+    from spark_rapids_tpu.observability.dumpio import dump_via
+
+    recs = [r for r in obs.TRACER.records()
+            if r.get("trace_id") == trace_id]
+
+    def _write(f):
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+        return len(recs)
+
+    dump_via(os.path.join(outdir, "spans_launcher.jsonl"), _write)
+
+
+def _tail(outdir: str, rank: int, n: int = 2000) -> str:
+    try:
+        with open(os.path.join(outdir,
+                               f"worker_rank{rank}.log")) as f:
+            return f.read()[-n:]
+    except OSError:
+        return "<no log>"
+
+
+def span_files(outdir: str, world: int) -> List[str]:
+    """Every per-process span dump of a finished run, launcher first."""
+    paths = [os.path.join(outdir, "spans_launcher.jsonl")]
+    paths += [os.path.join(outdir, f"spans_rank{r}.jsonl")
+              for r in range(world)]
+    return [p for p in paths if os.path.exists(p)]

@@ -1,0 +1,359 @@
+"""Distributed TPC-DS worker: q5/q72 promoted to real processes
+(ISSUE 10 tentpole).
+
+Execution plan per rank (the process-per-shard harness; mesh.py may
+additionally form a jax.distributed mesh, but table movement ALWAYS
+rides the shuffle service — that is the contract under test):
+
+  1. scan      — every rank regenerates the seeded dataset and takes
+                 its row shard (deterministic, no data files needed);
+  2. partials  — the SHARED map-side kernels from models/tpcds
+                 (``_q5_partials`` / ``_q72_partials``) run as one
+                 local jit under ``exchange.with_capacity_retry``
+                 (overflow doubles the join budget, same as every
+                 other capacity-bounded pipeline);
+  3. reduce-scatter — the partial group table is sliced into
+                 rank-owned chunks, each chunk shipped to its owner as
+                 kudo tables over the socket shuffle
+                 (partition -> kudo write -> transport -> kudo merge);
+                 owners sum their received chunks (exact int64 — any
+                 arrival order is byte-identical);
+  4. allgather — owners re-share their summed chunks; every rank
+                 reassembles the GLOBAL group table;
+  5. finish    — the SHARED reduce-side kernels
+                 (``_q5_finish`` / ``_q72_finish``) order/limit the
+                 global table, so the output bytes are identical to
+                 the single-process pipeline's by construction.
+
+Run as a module (``python -m spark_rapids_tpu.distributed.runner``)
+by scripts/dist_launch.py; the per-query entry points are also
+importable for in-process tests against any table transport.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, Optional
+
+import numpy as np
+
+# default query shapes — the launcher AND the single-process reference
+# (smoke gate) import these so the comparison can never drift
+Q5_PARAMS = dict(rows=4096, stores=32, days=60,
+                 join_capacity=1 << 14)
+Q72_PARAMS = dict(cs_rows=4096, inv_rows=64, items=64, max_week=16,
+                  days=35, join_capacity=1 << 17, limit=100,
+                  week0=11_000 // 7)
+
+
+class OpIds:
+    """Centralized op-id allocation: one id per (query, stage) so
+    concurrent exchanges can never cross payloads."""
+
+    Q5_REDUCE_SCATTER = 101
+    Q5_ALLGATHER = 102
+    Q72_REDUCE_SCATTER = 111
+    Q72_ALLGATHER = 112
+    BARRIER = 900
+
+
+# ------------------------------------------------------------- helpers
+
+
+def _int64_table(arrays):
+    """Build an all-INT64 kudo-shuffleable Table from numpy vectors."""
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.columns import dtypes
+    from spark_rapids_tpu.columns.column import Column
+    from spark_rapids_tpu.columns.table import Table
+    cols = [Column(dtypes.INT64, len(a),
+                   data=jnp.asarray(np.asarray(a, dtype=np.int64)))
+            for a in arrays]
+    return Table(cols)
+
+
+def _pad_to(vec: np.ndarray, n: int) -> np.ndarray:
+    if len(vec) == n:
+        return vec
+    out = np.zeros(n, dtype=vec.dtype)
+    out[: len(vec)] = vec
+    return out
+
+
+def _reduce_scatter_allgather(transport, op_rs: int, op_ag: int,
+                              vecs, overflow: bool):
+    """Steps 3+4 for a dense partial group table: slice ``vecs`` (all
+    same length) into rank-owned chunks, shuffle chunks to owners,
+    sum, allgather the owned sums back, return the global vectors +
+    the OR of every rank's overflow flag.  The flag rides as one more
+    int64 column so it crosses the same wire as the data."""
+    world = transport.world
+    n = len(vecs[0])
+    chunk = -(-n // world)  # ceil: pad so every rank owns equal rows
+    padded = [_pad_to(np.asarray(v, dtype=np.int64), chunk * world)
+              for v in vecs]
+    ofv = np.full(chunk, int(bool(overflow)), dtype=np.int64)
+    parts = []
+    for d in range(world):
+        sl = slice(d * chunk, (d + 1) * chunk)
+        parts.append(_int64_table([v[sl] for v in padded] + [ofv]))
+    merged = transport.exchange(op_rs, parts)
+    # merged rows = world * chunk, source-rank order: sum per owner
+    stacked = [c.to_numpy().reshape(world, chunk)
+               for c in merged.columns]
+    owned = [s.sum(axis=0, dtype=np.int64) for s in stacked[:-1]]
+    of_owned = int(stacked[-1].max(initial=0) > 0)
+    gathered = transport.allgather(
+        op_ag, _int64_table(
+            owned + [np.full(chunk, of_owned, dtype=np.int64)]))
+    full = [c.to_numpy() for c in gathered.columns]
+    out = [v[:n] for v in full[:-1]]
+    return out, bool(full[-1].max(initial=0) > 0)
+
+
+def _shard(a, rank: int, world: int):
+    n = (len(a) // world) * world
+    per = n // world
+    return a[rank * per: (rank + 1) * per]
+
+
+# ------------------------------------------------------------------ q5
+
+
+def run_dist_q5(params: Optional[dict] = None, *, transport=None
+                ) -> Dict[str, np.ndarray]:
+    """Distributed q5 on this rank's shard.  Returns the FULL query
+    result (every rank converges to the same bytes) as numpy arrays:
+    key / sales / rets / profit / overflow."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu import observability as _obs
+    from spark_rapids_tpu.models import tpcds as T
+    from spark_rapids_tpu.parallel import exchange as X
+
+    p = dict(Q5_PARAMS, **(params or {}))
+    if transport is None:
+        transport = X.table_transport()
+    rank, world = transport.rank, transport.world
+    with _obs.TRACER.span("dist_q5", kind="query",
+                          attrs={"rank": rank, "world": world}):
+        rows = max(int(p["rows"]) // (8 * world), 1) * 8 * world
+        d = T.gen_q5(rows=rows, stores=p["stores"], days=p["days"])
+        shard_args = tuple(
+            _shard(a, rank, world)
+            for a in (d.s_date, d.s_store, d.s_price, d.s_profit,
+                      d.r_date, d.r_store, d.r_amt, d.r_loss)
+        ) + (d.d_date,)
+
+        def build(cap):
+            return jax.jit(T._q5_partials(p["stores"], cap))
+
+        outs, _cap = T.run_with_capacity_retry(
+            build, shard_args, p["join_capacity"])
+        sales, rets, profit, seen, of = outs
+        (sales, rets, profit, seen), of_any = \
+            _reduce_scatter_allgather(
+                transport, OpIds.Q5_REDUCE_SCATTER,
+                OpIds.Q5_ALLGATHER,
+                [np.asarray(sales), np.asarray(rets),
+                 np.asarray(profit), np.asarray(seen)],
+                bool(np.asarray(of)))
+        fin = jax.jit(T._q5_finish(p["stores"]))
+        key_s, sales_s, ret_s, profit_s = fin(
+            jnp.asarray(sales), jnp.asarray(rets),
+            jnp.asarray(profit), jnp.asarray(seen), d.st_id)
+        return {"key": np.asarray(key_s), "sales": np.asarray(sales_s),
+                "rets": np.asarray(ret_s),
+                "profit": np.asarray(profit_s),
+                "overflow": np.asarray(of_any)}
+
+
+def single_q5(params: Optional[dict] = None) -> Dict[str, np.ndarray]:
+    """The single-process reference with the SAME shapes the
+    distributed run uses (row count rounded identically)."""
+    from spark_rapids_tpu.models import tpcds as T
+
+    p = dict(Q5_PARAMS, **(params or {}))
+    world = int(p.get("world", 1))
+    rows = max(int(p["rows"]) // (8 * world), 1) * 8 * world
+    d = T.gen_q5(rows=rows, stores=p["stores"], days=p["days"])
+    run = T.make_q5(p["stores"], p["join_capacity"])
+    key_s, sales_s, ret_s, profit_s, of = run(d)
+    return {"key": np.asarray(key_s), "sales": np.asarray(sales_s),
+            "rets": np.asarray(ret_s), "profit": np.asarray(profit_s),
+            "overflow": np.asarray(bool(np.asarray(of)))}
+
+
+# ----------------------------------------------------------------- q72
+
+
+def run_dist_q72(params: Optional[dict] = None, *, transport=None
+                 ) -> Dict[str, np.ndarray]:
+    """Distributed q72: catalog_sales sharded row-parallel, inventory
+    + item dim replicated (the same plan as the mesh variant), counts
+    reduce-scattered/allgathered over the kudo shuffle."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu import observability as _obs
+    from spark_rapids_tpu.models import tpcds as T
+    from spark_rapids_tpu.parallel import exchange as X
+
+    p = dict(Q72_PARAMS, **(params or {}))
+    if transport is None:
+        transport = X.table_transport()
+    rank, world = transport.rank, transport.world
+    with _obs.TRACER.span("dist_q72", kind="query",
+                          attrs={"rank": rank, "world": world}):
+        cs_rows = max(int(p["cs_rows"]) // world, 1) * world
+        d = T.gen_q72(cs_rows=cs_rows, inv_rows=p["inv_rows"],
+                      items=p["items"], days=p["days"])
+        shard_args = (
+            _shard(d.cs_item, rank, world),
+            _shard(d.cs_date, rank, world),
+            _shard(d.cs_qty, rank, world),
+            d.inv_item, d.inv_date, d.inv_qty, d.item_id)
+
+        def build(cap):
+            return jax.jit(T._q72_partials(
+                p["items"], p["max_week"], cap, p["week0"]))
+
+        outs, _cap = T.run_with_capacity_retry(
+            build, shard_args, p["join_capacity"])
+        counts, of = outs
+        (counts,), of_any = _reduce_scatter_allgather(
+            transport, OpIds.Q72_REDUCE_SCATTER,
+            OpIds.Q72_ALLGATHER, [np.asarray(counts)],
+            bool(np.asarray(of)))
+        fin = jax.jit(T._q72_finish(
+            p["items"], p["max_week"], p["limit"], p["week0"]))
+        item, week, cnt = fin(jnp.asarray(counts))
+        return {"item": np.asarray(item), "week": np.asarray(week),
+                "cnt": np.asarray(cnt),
+                "overflow": np.asarray(of_any)}
+
+
+def single_q72(params: Optional[dict] = None) -> Dict[str, np.ndarray]:
+    from spark_rapids_tpu.models import tpcds as T
+
+    p = dict(Q72_PARAMS, **(params or {}))
+    world = int(p.get("world", 1))
+    cs_rows = max(int(p["cs_rows"]) // world, 1) * world
+    d = T.gen_q72(cs_rows=cs_rows, inv_rows=p["inv_rows"],
+                  items=p["items"], days=p["days"])
+    run = T.make_q72(p["items"], p["max_week"], p["join_capacity"],
+                     limit=p["limit"], week0=p["week0"])
+    item, week, cnt, of = run(d)
+    return {"item": np.asarray(item), "week": np.asarray(week),
+            "cnt": np.asarray(cnt),
+            "overflow": np.asarray(bool(np.asarray(of)))}
+
+
+DIST_QUERIES = {"q5": run_dist_q5, "q72": run_dist_q72}
+SINGLE_QUERIES = {"q5": single_q5, "q72": single_q72}
+
+
+# ---------------------------------------------------------- worker main
+
+
+def _parse_trace_ctx():
+    from spark_rapids_tpu.observability import SpanContext
+    spec = os.environ.get("SPARK_RAPIDS_TPU_DIST_TRACE_CTX", "")
+    if ":" not in spec:
+        return None
+    try:
+        tid, sid = spec.split(":")
+        return SpanContext(int(tid, 16), int(sid, 16))
+    except ValueError:
+        return None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="spark_rapids_tpu distributed shuffle worker")
+    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--world", type=int, required=True)
+    ap.add_argument("--addresses", required=True,
+                    help="comma-separated per-rank listen addresses "
+                         "(unix:/path or host:port)")
+    ap.add_argument("--ops", default="q5,q72")
+    ap.add_argument("--outdir", required=True)
+    ap.add_argument("--coordinator", default=None,
+                    help="jax.distributed coordinator (mesh attempt)")
+    ap.add_argument("--params", default="{}",
+                    help="JSON dict of per-query param overrides "
+                         "keyed by op name")
+    args = ap.parse_args(argv)
+
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+    from spark_rapids_tpu import observability as obs
+    from spark_rapids_tpu.distributed.mesh import try_form_mesh
+    from spark_rapids_tpu.distributed.service import ShuffleService
+    from spark_rapids_tpu.observability.dumpio import dump_via
+    from spark_rapids_tpu.shuffle import kudo
+
+    rank, world = args.rank, args.world
+    outdir = args.outdir
+    os.makedirs(outdir, exist_ok=True)
+    overrides = json.loads(args.params)
+
+    kudo.set_crc_enabled(True)
+    obs.enable()
+    obs.enable_tracing()
+
+    mesh_info = try_form_mesh(rank, world,
+                              coordinator=args.coordinator)
+    service = ShuffleService(
+        rank, world, args.addresses.split(",")).start().install()
+    parent = _parse_trace_ctx()
+    root = obs.TRACER.start_span(
+        "dist_worker", kind="process", parent=parent,
+        attrs={"rank": rank, "world": world,
+               "mesh": mesh_info["mode"]})
+    ops = [o for o in args.ops.split(",") if o]
+    rc = 0
+    try:
+        for op in ops:
+            result = DIST_QUERIES[op](overrides.get(op),
+                                      transport=service)
+            np.savez(os.path.join(
+                outdir, f"result_{op}_rank{rank}.npz"), **result)
+        service.barrier(OpIds.BARRIER)
+    except Exception as e:  # noqa: BLE001 — report, then nonzero exit
+        rc = 1
+        with open(os.path.join(outdir, f"error_rank{rank}.txt"),
+                  "w") as f:
+            f.write(f"{type(e).__name__}: {e}\n")
+        raise
+    finally:
+        root.end()
+        obs.TRACER.dump_jsonl(
+            os.path.join(outdir, f"spans_rank{rank}.jsonl"))
+        dump_via(os.path.join(outdir, f"metrics_rank{rank}.json"),
+                 lambda f: f.write(obs.METRICS.snapshot_json()))
+        summary = {
+            "rank": rank, "world": world, "ops": ops,
+            "mesh": mesh_info,
+            "trace_id": (f"{root.trace_id:016x}"
+                         if root.trace_id else None),
+            "rc": rc,
+        }
+        dump_via(os.path.join(outdir, f"summary_rank{rank}.json"),
+                 lambda f: f.write(json.dumps(summary, indent=1)))
+        service.uninstall()
+        service.stop()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

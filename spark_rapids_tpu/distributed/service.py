@@ -1,0 +1,215 @@
+"""The distributed shuffle service: partition -> kudo write -> socket
+-> kudo merge, rank-ordered and byte-deterministic (ISSUE 10 tentpole,
+layer 2 of 2 over transport.py).
+
+One :class:`ShuffleService` per worker process.  It implements the
+pluggable table-transport interface from ``parallel/exchange.py``
+(``exchange`` / ``allgather``), so pipeline code written against
+``exchange.exchange_tables`` runs unchanged on one process (loopback)
+or N (this service):
+
+  * ``exchange(op_id, tables_by_dest)`` — all-to-all: partition d goes
+    to rank d; returns the merge (in SOURCE-RANK ORDER — the
+    determinism the byte-identity gates lean on) of every partition
+    addressed to this rank.
+  * ``allgather(op_id, table)`` — every rank contributes one table,
+    every rank gets the rank-ordered concatenation.
+  * ``barrier(op_id)`` — an allgather of a 1-row sentinel; used to
+    keep listeners alive until every peer is done.
+
+The wire bytes are the existing kudo format end to end: the write side
+embeds the active span's context in the KTRX extension (so the
+receiving merge links/re-parents across the process boundary) and the
+KCRC trailer (the receiver's verify + NAK/resend loop needs it —
+construction fails fast if CRC mode is off), and the merge side is the
+stock ``merge_to_table_with_metrics``.
+
+``op_id`` discipline: each logical exchange in a query plan gets a
+distinct op id per (query, stage) — the service namespaces nothing.
+Collisions across CONCURRENT exchanges would cross payloads; the
+distributed runner allocates ids centrally (runner.OpIds).
+"""
+
+from __future__ import annotations
+
+import io
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from spark_rapids_tpu import observability as _obs
+from spark_rapids_tpu.parallel import exchange as _exchange
+from spark_rapids_tpu.robustness.retry import RetryPolicy
+from spark_rapids_tpu.shuffle import kudo as _kudo
+from spark_rapids_tpu.shuffle.schema import schema_of_table
+from spark_rapids_tpu.distributed.transport import (
+    Inbox, Listener, PeerLink)
+
+
+class ShuffleService:
+    """N-rank shuffle fabric over TCP/unix sockets."""
+
+    def __init__(self, rank: int, world: int,
+                 addresses: Sequence[str], *,
+                 policy: Optional[RetryPolicy] = None,
+                 recv_timeout_s: float = 120.0):
+        if len(addresses) != world:
+            raise ValueError(
+                f"need {world} addresses, got {len(addresses)}")
+        if not _kudo.crc_enabled():
+            raise RuntimeError(
+                "ShuffleService requires KCRC trailers "
+                "(kudo.set_crc_enabled(True) or "
+                "SPARK_RAPIDS_TPU_KUDO_CRC=1): the link NAK/resend "
+                "protocol verifies payloads by CRC")
+        self.rank = int(rank)
+        self.world = int(world)
+        self.addresses = list(addresses)
+        self.recv_timeout_s = recv_timeout_s
+        self.inbox = Inbox()
+        self.listener = Listener(self.rank,
+                                 self.addresses[self.rank], self.inbox)
+        self.links: Dict[int, PeerLink] = {
+            r: PeerLink(self.rank, r, addresses[r], policy=policy)
+            for r in range(world) if r != self.rank}
+        self._started = False
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------- lifecycle
+
+    def start(self) -> "ShuffleService":
+        with self._lock:
+            if not self._started:
+                self.listener.start()
+                self._started = True
+        return self
+
+    def stop(self) -> None:
+        with self._lock:
+            if not self._started:
+                return
+            self._started = False
+        for link in self.links.values():
+            link.close()
+        self.listener.stop()
+
+    def __enter__(self) -> "ShuffleService":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------- transport
+
+    def _serialize(self, table) -> bytes:
+        buf = io.BytesIO()
+        _kudo.write_to_stream_with_metrics(
+            table.columns, buf, 0, table.num_rows)
+        return buf.getvalue()
+
+    def exchange(self, op_id: int, tables_by_dest, fields=None):
+        """All-to-all one round: returns the Table merged from every
+        rank's partition addressed to this rank, sources concatenated
+        in rank order."""
+        if len(tables_by_dest) != self.world:
+            raise ValueError(
+                f"need {self.world} destination partitions, got "
+                f"{len(tables_by_dest)}")
+        if fields is None:
+            fields = schema_of_table(tables_by_dest[self.rank])
+        with _obs.TRACER.span("shuffle_exchange", kind="stage",
+                              attrs={"op": op_id,
+                                     "world": self.world}) as sp:
+            # serialize once per DISTINCT table: allgather passes the
+            # same object to every destination, so an N-rank gather
+            # pays one kudo write, not N identical ones
+            blob_cache: Dict[int, bytes] = {}
+            payloads = []
+            for t in tables_by_dest:
+                blob = blob_cache.get(id(t))
+                if blob is None:
+                    blob = blob_cache[id(t)] = self._serialize(t)
+                payloads.append(blob)
+            # local partition loops back through the same parsed form
+            # (read_tables verifies its CRC too — uniform path)
+            local = _kudo.read_tables(io.BytesIO(payloads[self.rank]))
+            sent = self._send_all(op_id, payloads)
+            others = [r for r in range(self.world) if r != self.rank]
+            received = self.inbox.wait(op_id, others,
+                                       self.recv_timeout_s) \
+                if others else {}
+            received[self.rank] = local
+            tables: List[_kudo.KudoTable] = []
+            for src in range(self.world):
+                tables.extend(received[src])
+            sp.set_attr("bytes_sent", sent)
+            return _kudo.merge_to_table_with_metrics(tables, fields)[0]
+
+    def _send_all(self, op_id: int, payloads) -> int:
+        """One send per peer link, all in flight CONCURRENTLY: every
+        send blocks for its peer's verify+ACK (or its retry budget),
+        so a sequential loop would serialize world-1 round trips and
+        let one slow or NAKing peer delay delivery to every
+        later-numbered one.  Joins all senders; the first failure
+        (after every thread settled) escalates."""
+        sent = [0] * self.world
+        errs: List[Optional[BaseException]] = [None] * self.world
+        # sender threads start with an EMPTY tracer context stack —
+        # adopt the caller's open span so each link's shuffle_send
+        # span parents under the exchange instead of rooting a new
+        # (orphan) trace
+        ctx = _obs.TRACER.current_context()
+
+        def one(dst: int) -> None:
+            holder = _obs.TRACER.activate(ctx)
+            try:
+                sent[dst] = self.links[dst].send(op_id, payloads[dst])
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                errs[dst] = e
+            finally:
+                holder.end()
+
+        workers = [threading.Thread(
+            target=one, args=(dst,),
+            name=f"srt-shuffle-send-{self.rank}-{dst}", daemon=True)
+            for dst in range(self.world) if dst != self.rank]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+        for e in errs:
+            if e is not None:
+                raise e
+        return sum(sent)
+
+    def allgather(self, op_id: int, table, fields=None):
+        """Every rank contributes ``table``; everyone receives the
+        rank-ordered concatenation."""
+        return self.exchange(op_id, [table] * self.world, fields)
+
+    def barrier(self, op_id: int) -> None:
+        """Block until every rank reached this op — an allgather of a
+        one-row sentinel.  Run before teardown so no peer's listener
+        disappears while another rank still owes/awaits payloads."""
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu.columns import dtypes
+        from spark_rapids_tpu.columns.column import Column
+        from spark_rapids_tpu.columns.table import Table
+        col = Column(dtypes.INT64, 1,
+                     data=jnp.asarray([self.rank], dtype=jnp.int64))
+        out = self.allgather(op_id, Table([col]))
+        if out.num_rows != self.world:
+            raise RuntimeError(
+                f"barrier saw {out.num_rows} ranks, want {self.world}")
+
+    # ---------------------------------------------------- installation
+
+    def install(self) -> "ShuffleService":
+        """Register as the process's table transport
+        (parallel/exchange.exchange_tables routes here)."""
+        _exchange.set_table_transport(self)
+        return self
+
+    def uninstall(self) -> None:
+        if _exchange._TABLE_TRANSPORT[0] is self:
+            _exchange.set_table_transport(None)

@@ -1,0 +1,448 @@
+"""Peer-to-peer shuffle transport: framed kudo streams over
+TCP/unix sockets (ISSUE 10 tentpole, layer 1 of 2 — the
+ShuffleService in service.py owns partitioning/merging; this module
+owns bytes on wires).
+
+Wire protocol (one directed link = one persistent connection from the
+sending rank to the receiving rank's listener):
+
+  frame:   "SRTS" | u8 kind | u32 src_rank | u32 op_id | u32 seq |
+           u64 payload_len   (big-endian, 25 bytes)
+  payload: a kudo table stream — the EXISTING inter-host wire format:
+           optional KTRX trace-context extension + KUD0 header/body +
+           KCRC integrity trailer per table.  The transport adds
+           nothing to the bytes the shuffle already knows how to
+           write, verify, and merge.
+
+Delivery contract (push + ack):
+
+  * DATA: sender transmits frame+payload, then blocks for a 1-byte
+    verdict: b"A" (payload parsed AND CRC-verified by the receiving
+    kudo reader) or b"N" (corrupt — the reader raised
+    KudoCorruptException).  Anything else — EOF, reset, timeout — is a
+    transient link failure.
+  * Retries ride :func:`robustness.links.with_link_retry` (the shared
+    RetryPolicy: bounded attempts, decorrelated-jitter backoff,
+    wall-clock deadline); a NAK or link error resends the sender's
+    INTACT copy of the payload over a fresh connection if needed.
+    Budget exhaustion raises PeerDiedException.
+  * Duplicates (an ACK lost in flight makes the sender resend a
+    payload the receiver already accepted) are deduplicated by
+    (src, op_id, seq) and re-ACKed without re-delivery.
+
+Fault injection for the chaos/dist gates: set
+``SPARK_RAPIDS_TPU_DIST_FAULT="corrupt:<dst>:<op>"`` (or
+``trunc:<dst>:<op>``) in a worker's environment and its FIRST send to
+that destination/op is corrupted (one payload byte XOR'd after CRC
+computation) or truncated mid-payload with a hard close — the receiver
+NAKs / the ack read fails, and the retry loop must recover with a
+clean resend.  Programmatic twin: :func:`set_link_fault`.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from spark_rapids_tpu import observability as _obs
+from spark_rapids_tpu.robustness.links import (
+    PeerDiedException, ShuffleLinkError, with_link_retry)
+from spark_rapids_tpu.robustness.retry import RetryPolicy
+from spark_rapids_tpu.shuffle import kudo as _kudo
+from spark_rapids_tpu.shuffle.socket_io import SocketStream
+
+FRAME_MAGIC = b"SRTS"
+FRAME_FMT = ">4sBIIIQ"
+FRAME_LEN = struct.calcsize(FRAME_FMT)  # 25
+KIND_DATA = 1
+ACK = b"A"
+NAK = b"N"
+MAX_PAYLOAD = 1 << 30  # sanity bound: refuse absurd frame lengths
+
+
+def _parse_addr(addr: str):
+    """'unix:/path' -> (AF_UNIX, path); 'host:port' -> (AF_INET, ...)."""
+    if addr.startswith("unix:"):
+        return socket.AF_UNIX, addr[5:]
+    host, _, port = addr.rpartition(":")
+    return socket.AF_INET, (host or "127.0.0.1", int(port))
+
+
+# ----------------------------------------------------- fault injection
+
+_FAULT_LOCK = threading.Lock()
+# {(mode, dst, op): remaining} — armed once from env or set_link_fault
+_FAULTS: Dict[Tuple[str, int, int], int] = {}
+
+
+def set_link_fault(mode: str, dst: int, op_id: int,
+                   times: int = 1) -> None:
+    """Arm a one-shot (default) send fault: ``mode`` 'corrupt' flips a
+    payload byte after serialization; 'trunc' sends half the payload
+    and hard-closes the connection."""
+    with _FAULT_LOCK:
+        _FAULTS[(mode, int(dst), int(op_id))] = int(times)
+
+
+def clear_link_faults() -> None:
+    with _FAULT_LOCK:
+        _FAULTS.clear()
+
+
+def _env_faults() -> None:
+    spec = os.environ.get("SPARK_RAPIDS_TPU_DIST_FAULT", "")
+    if not spec:
+        return
+    for one in spec.split(","):
+        try:
+            mode, dst, op = one.strip().split(":")
+            set_link_fault(mode, int(dst), int(op))
+        except ValueError:
+            pass  # garbled spec: ignore, like the fault injector does
+
+
+_env_faults()
+
+
+def _take_fault(dst: int, op_id: int) -> Optional[str]:
+    with _FAULT_LOCK:
+        for mode in ("corrupt", "trunc"):
+            key = (mode, dst, op_id)
+            left = _FAULTS.get(key, 0)
+            if left > 0:
+                _FAULTS[key] = left - 1
+                return mode
+    return None
+
+
+# -------------------------------------------------------------- inbox
+
+
+class Inbox:
+    """Received, CRC-verified payloads keyed by (op_id, src_rank).
+    ``wait`` blocks until every listed source delivered (or the
+    deadline lapses -> PeerDiedException naming the missing peers)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._slots: Dict[Tuple[int, int], List[_kudo.KudoTable]] = {}
+        # (op_id, src) keys whose round died in wait(): a handler
+        # thread that was mid-verify when the deadline lapsed may
+        # still put() AFTER the cleanup below — each tombstone absorbs
+        # exactly that one late delivery (one-shot, so a genuinely new
+        # round reusing the op id starts clean)
+        self._dead: Dict[Tuple[int, int], bool] = {}
+
+    def put(self, op_id: int, src: int,
+            tables: List[_kudo.KudoTable]) -> None:
+        with self._cv:
+            if self._dead.pop((op_id, src), None):
+                return  # late delivery for a timed-out round: drop
+            self._slots[(op_id, src)] = tables
+            self._cv.notify_all()
+
+    def wait(self, op_id: int, srcs, timeout_s: float
+             ) -> Dict[int, List[_kudo.KudoTable]]:
+        want = set(int(s) for s in srcs)
+        with self._cv:
+            ok = self._cv.wait_for(
+                lambda: all((op_id, s) in self._slots for s in want),
+                timeout=timeout_s)
+            if not ok:
+                missing = sorted(s for s in want
+                                 if (op_id, s) not in self._slots)
+                # the round is dead: discard what DID arrive for it,
+                # so a retried exchange reusing this op id can never
+                # merge a previous attempt's partitions (and failed
+                # ops don't accrete slots forever); missing peers get
+                # a tombstone so an in-flight late delivery is
+                # absorbed too (bounded: one entry per missing peer)
+                for s in want:
+                    if self._slots.pop((op_id, s), None) is None:
+                        self._dead[(op_id, s)] = True
+                        if len(self._dead) > 1024:
+                            self._dead.pop(next(iter(self._dead)))
+                raise PeerDiedException(
+                    ",".join(map(str, missing)), 0,
+                    detail=f"no payload for op {op_id} within "
+                           f"{timeout_s:.1f}s")
+            return {s: self._slots.pop((op_id, s)) for s in want}
+
+
+# ----------------------------------------------------------- listener
+
+
+class Listener:
+    """This rank's receive side: a bounded accept loop; one handler
+    thread per inbound connection reading DATA frames, verifying the
+    kudo payload (CRC included) and answering A/N.  Short payloads
+    (a truncated link) drop the partial bytes and close — the sender's
+    ack read fails and its retry resends."""
+
+    def __init__(self, rank: int, addr: str, inbox: Inbox):
+        self.rank = rank
+        self.addr = addr
+        self.inbox = inbox
+        self._sock: Optional[socket.socket] = None
+        self._threads: List[threading.Thread] = []
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        self._stop = threading.Event()
+        # (src, op, seq) already delivered — a resend after a lost ACK
+        # re-ACKs without re-inserting.  Recorded only AFTER a
+        # successful verify+deliver (a NAKed payload was never
+        # delivered, so its clean resend must not be deduped), which
+        # also keeps _seen and its eviction order in lockstep.
+        # Bounded: shuffle ops are short-lived, 4096 message ids
+        # dwarf any in-flight window.
+        self._seen: Dict[Tuple[int, int, int], bool] = {}
+        self._seen_order: List[Tuple[int, int, int]] = []
+        self._seen_lock = threading.Lock()
+
+    def start(self) -> "Listener":
+        fam, target = _parse_addr(self.addr)
+        if fam == socket.AF_UNIX:
+            try:
+                os.unlink(target)
+            except OSError:
+                pass
+        s = socket.socket(fam, socket.SOCK_STREAM)
+        if fam == socket.AF_INET:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(target)
+        s.listen(16)
+        s.settimeout(0.2)
+        self._sock = s
+        t = threading.Thread(target=self._accept_loop,
+                             name=f"srt-shuffle-accept-{self.rank}",
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        # close accepted connections so handler threads blocked in
+        # stream.read unwind immediately instead of riding out their
+        # 60s socket timeout past the join below
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=2.0)
+        fam, target = _parse_addr(self.addr)
+        if fam == socket.AF_UNIX:
+            try:
+                os.unlink(target)
+            except OSError:
+                pass
+
+    # ------------------------------------------------------- internals
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed
+            with self._conns_lock:
+                self._conns.add(conn)
+            t = threading.Thread(
+                target=self._serve, args=(conn,),
+                name=f"srt-shuffle-recv-{self.rank}", daemon=True)
+            t.start()
+            # prune finished handlers so a fault-heavy soak (every
+            # reconnect is a new connection) doesn't accrete dead
+            # Thread objects
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
+
+    def _already_delivered(self, key: Tuple[int, int, int]) -> bool:
+        with self._seen_lock:
+            return key in self._seen
+
+    def _mark_delivered(self, key: Tuple[int, int, int]) -> None:
+        with self._seen_lock:
+            if key in self._seen:
+                return
+            self._seen[key] = True
+            self._seen_order.append(key)
+            if len(self._seen_order) > 4096:
+                old = self._seen_order.pop(0)
+                self._seen.pop(old, None)
+
+    def _serve(self, conn: socket.socket) -> None:
+        conn.settimeout(60.0)
+        stream = SocketStream(conn)
+        try:
+            while not self._stop.is_set():
+                head = stream.read(FRAME_LEN)
+                if len(head) < FRAME_LEN:
+                    return  # clean close (or trailing garbage: drop)
+                magic, kind, src, op_id, seq, length = struct.unpack(
+                    FRAME_FMT, head)
+                if (magic != FRAME_MAGIC or kind != KIND_DATA
+                        or length > MAX_PAYLOAD):
+                    return  # protocol violation: drop the connection
+                payload = stream.read(length)
+                if len(payload) < length:
+                    # truncated link mid-payload: the partial bytes
+                    # are unusable — drop them, close, let the
+                    # sender's retry resend over a fresh connection
+                    _obs.record_kudo_corruption(
+                        "resync", skipped_bytes=len(payload),
+                        detail=f"truncated link from rank {src} "
+                               f"op {op_id}")
+                    return
+                self._answer(conn, src, op_id, seq, payload)
+        except OSError:
+            return
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _answer(self, conn, src: int, op_id: int, seq: int,
+                payload: bytes) -> None:
+        import io
+        key = (src, op_id, seq)
+        if self._already_delivered(key):
+            conn.sendall(ACK)  # duplicate after a lost ACK
+            return
+        try:
+            # the verify pass IS the normal kudo read: every KCRC
+            # trailer present is checked, impossible headers raise
+            tables = _kudo.read_tables(io.BytesIO(payload))
+        except (ValueError, EOFError):
+            # corrupt payload: NAK (corruption was already recorded at
+            # the kudo verify site); nothing was delivered, so nothing
+            # is remembered and the clean resend goes through
+            conn.sendall(NAK)
+            return
+        self.inbox.put(op_id, src, tables)
+        self._mark_delivered(key)
+        _obs.record_shuffle_link("recv", src, len(payload), op_id)
+        conn.sendall(ACK)
+
+
+# ---------------------------------------------------------- peer link
+
+
+class PeerLink:
+    """The sending half of one directed link.  Lazily connects (with
+    connect itself inside the retry loop so a slow-starting peer is a
+    transient, not an error) and keeps the connection for subsequent
+    sends."""
+
+    def __init__(self, my_rank: int, peer_rank: int, addr: str, *,
+                 policy: Optional[RetryPolicy] = None,
+                 ack_timeout_s: float = 30.0):
+        self.my_rank = my_rank
+        self.peer_rank = peer_rank
+        self.addr = addr
+        self.policy = policy
+        self.ack_timeout_s = ack_timeout_s
+        self._sock: Optional[socket.socket] = None
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------- plumbing
+
+    def _connect(self) -> socket.socket:
+        if self._sock is not None:
+            return self._sock
+        fam, target = _parse_addr(self.addr)
+        s = socket.socket(fam, socket.SOCK_STREAM)
+        s.settimeout(self.ack_timeout_s)
+        s.connect(target)
+        self._sock = s
+        return s
+
+    def _drop(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self) -> None:
+        with self._lock:
+            self._drop()
+
+    # ----------------------------------------------------------- send
+
+    def send(self, op_id: int, payload: bytes) -> int:
+        """Deliver one kudo payload; returns bytes sent.  Blocks until
+        the peer ACKs (payload verified) or the retry budget dies."""
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        head = struct.pack(FRAME_FMT, FRAME_MAGIC, KIND_DATA,
+                           self.my_rank, op_id, seq, len(payload))
+
+        def attempt() -> int:
+            with self._lock:
+                try:
+                    s = self._connect()
+                    # arm the injected fault only once a connection
+                    # exists: a transient connect failure must not
+                    # burn the one-shot injection before any faulty
+                    # byte could hit the wire (the chaos gate's
+                    # "corrupt link healed" signal would go vacuous)
+                    fault = _take_fault(self.peer_rank, op_id)
+                    if fault == "trunc":
+                        # inject a truncated link: half the payload,
+                        # then a hard close mid-message
+                        s.sendall(head + payload[: len(payload) // 2])
+                        self._drop()
+                        raise ShuffleLinkError(
+                            "injected truncated link", reason="link")
+                    wire = payload
+                    if fault == "corrupt":
+                        flip = len(payload) // 2
+                        wire = (payload[:flip]
+                                + bytes([payload[flip] ^ 0xFF])
+                                + payload[flip + 1:])
+                    s.sendall(head + wire)
+                    verdict = s.recv(1)
+                except OSError:
+                    self._drop()
+                    raise
+                if verdict == ACK:
+                    return len(payload)
+                self._drop()
+                if verdict == NAK:
+                    raise ShuffleLinkError(
+                        f"peer {self.peer_rank} NAKed op {op_id} "
+                        f"seq {seq}", reason="nak")
+                raise ShuffleLinkError(
+                    f"link to peer {self.peer_rank} closed before "
+                    f"verdict (op {op_id})", reason="link")
+
+        with _obs.TRACER.span("shuffle_send", kind="shuffle_send",
+                              attrs={"peer": self.peer_rank,
+                                     "op": op_id,
+                                     "bytes": len(payload)}):
+            n = with_link_retry(attempt, peer=self.peer_rank,
+                                policy=self.policy)
+        _obs.record_shuffle_link("send", self.peer_rank, n, op_id)
+        return n

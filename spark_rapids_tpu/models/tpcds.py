@@ -123,14 +123,16 @@ def gen_q5(rows: int = 50_000, stores: int = 32, days: int = 120,
     return Q5Data(*s, *r, d_date=d_date, st_id=jnp.asarray(perm))
 
 
-def _q5_kernel(stores: int, join_capacity: int, reduce_sum,
-               reduce_any):
-    """Shared per-shard q5 pipeline body (single-chip: identity
-    reduces; mesh: lax.psum reduces — ONE implementation so the two
-    variants cannot drift)."""
+def _q5_partials(stores: int, join_capacity: int):
+    """The map side of q5: per-shard partial group table (per-store
+    sales / returns / profit / seen) + overflow flag.  Shared by the
+    single-chip jit, the mesh shard bodies, AND the multi-process
+    distributed runner (distributed/runner.py) — the partial vectors
+    are exact int64 sums, so any reduction order (psum over ICI or a
+    kudo reduce-scatter over sockets) yields byte-identical totals."""
 
     def compute(s_date, s_store, s_price, s_profit,
-                r_date, r_store, r_amt, r_loss, d_date, st_id):
+                r_date, r_store, r_amt, r_loss, d_date):
         def channel(date, store, amt_a, amt_b):
             """fact JOIN date_window -> per-store (sum a, sum b)."""
             pairs = inner_join_device(date, d_date, join_capacity)
@@ -149,19 +151,53 @@ def _q5_kernel(stores: int, join_capacity: int, reduce_sum,
             s_date, s_store, s_price, s_profit)
         r_amt_s, r_loss_s, r_seen, of2 = channel(
             r_date, r_store, r_amt, r_loss)
-        # global group table (mesh: one psum rides ICI)
-        s_sales = reduce_sum(s_sales)
-        r_amt_s = reduce_sum(r_amt_s)
-        profit = reduce_sum(s_profit_s - r_loss_s)
-        seen = reduce_sum(s_seen + r_seen)
+        return (s_sales, r_amt_s, s_profit_s - r_loss_s,
+                s_seen + r_seen, of1 | of2)
+
+    return compute
+
+
+def _q5_finish(stores: int):
+    """The reduce side of q5: ORDER BY s_store_id over the GLOBAL
+    group table (post-reduction) — one implementation for every
+    execution mode, so the distributed run's presentation cannot
+    drift from the single-process one."""
+
+    def fin(sales, rets, profit, seen, st_id):
         # ORDER BY s_store_id: sort the group table by dictionary id
         # (store dim join is a dense-key index; a sparse dim would
         # ride the same inner join)
         sentinel = jnp.int32(2**31 - 1)
         key = jnp.where(seen > 0, st_id, sentinel)
         key_s, sales_s, ret_s, profit_s = lax.sort(
-            (key, s_sales, r_amt_s, profit), num_keys=1)
-        return key_s, sales_s, ret_s, profit_s, reduce_any(of1 | of2)
+            (key, sales, rets, profit), num_keys=1)
+        return key_s, sales_s, ret_s, profit_s
+
+    return fin
+
+
+def _q5_kernel(stores: int, join_capacity: int, reduce_sum,
+               reduce_any):
+    """Shared per-shard q5 pipeline body (single-chip: identity
+    reduces; mesh: lax.psum reduces — ONE implementation so the two
+    variants cannot drift).  Composed from _q5_partials (map side) and
+    _q5_finish (order-by) with the caller's reduction in between."""
+    partials = _q5_partials(stores, join_capacity)
+    fin = _q5_finish(stores)
+
+    def compute(s_date, s_store, s_price, s_profit,
+                r_date, r_store, r_amt, r_loss, d_date, st_id):
+        s_sales, r_amt_s, profit, seen, of = partials(
+            s_date, s_store, s_price, s_profit,
+            r_date, r_store, r_amt, r_loss, d_date)
+        # global group table (mesh: one psum rides ICI)
+        s_sales = reduce_sum(s_sales)
+        r_amt_s = reduce_sum(r_amt_s)
+        profit = reduce_sum(profit)
+        seen = reduce_sum(seen)
+        key_s, sales_s, ret_s, profit_s = fin(
+            s_sales, r_amt_s, profit, seen, st_id)
+        return key_s, sales_s, ret_s, profit_s, reduce_any(of)
 
     return compute
 
@@ -313,9 +349,11 @@ def gen_q72(cs_rows: int = 30_000, inv_rows: int = 30_000,
     )
 
 
-def _q72_kernel(items: int, max_week: int, join_capacity: int,
-                limit: int, week0: int, reduce_sum, reduce_any):
-    """Shared per-shard q72 pipeline body (see _q5_kernel)."""
+def _q72_partials(items: int, max_week: int, join_capacity: int,
+                  week0: int):
+    """Map side of q72: per-shard partial (item, week) count vector +
+    overflow flag (see _q5_partials — shared with the distributed
+    runner, exact int64 partials)."""
     n_groups = items * max_week
 
     def compute(cs_item, cs_date, cs_qty, inv_item, inv_date,
@@ -334,15 +372,41 @@ def _q72_kernel(items: int, max_week: int, join_capacity: int,
         # masked rows land on gid 0 but add 0 (the summand is `keep`)
         counts = jax.ops.segment_sum(keep.astype(jnp.int64), gid,
                                      num_segments=n_groups)
-        counts = reduce_sum(counts)
+        return counts, pairs.total > join_capacity
+
+    return compute
+
+
+def _q72_finish(items: int, max_week: int, limit: int, week0: int):
+    """Reduce side of q72: top-k over the GLOBAL count vector (see
+    _q5_finish)."""
+    n_groups = items * max_week
+
+    def fin(counts):
         # ORDER BY count DESC, item ASC LIMIT k over the group table
         gidx = jnp.arange(n_groups, dtype=jnp.int64)
         sort_key = jnp.where(counts > 0, -counts, jnp.int64(2**62))
         _k, gid_s, cnt_s = lax.sort((sort_key, gidx, counts),
                                     num_keys=2)
         return (gid_s[:limit] // max_week,
-                gid_s[:limit] % max_week + week0, cnt_s[:limit],
-                reduce_any(pairs.total > join_capacity))
+                gid_s[:limit] % max_week + week0, cnt_s[:limit])
+
+    return fin
+
+
+def _q72_kernel(items: int, max_week: int, join_capacity: int,
+                limit: int, week0: int, reduce_sum, reduce_any):
+    """Shared per-shard q72 pipeline body (see _q5_kernel)."""
+    partials = _q72_partials(items, max_week, join_capacity, week0)
+    fin = _q72_finish(items, max_week, limit, week0)
+
+    def compute(cs_item, cs_date, cs_qty, inv_item, inv_date,
+                inv_qty, item_id):
+        counts, of = partials(cs_item, cs_date, cs_qty, inv_item,
+                              inv_date, inv_qty, item_id)
+        counts = reduce_sum(counts)
+        item, week, cnt = fin(counts)
+        return item, week, cnt, reduce_any(of)
 
     return compute
 

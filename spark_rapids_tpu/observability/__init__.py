@@ -124,6 +124,20 @@ SHUFFLE_MERGE_ROWS = METRICS.counter(
 SHUFFLE_MERGE_TIME = METRICS.counter(
     "srt_shuffle_merge_time_ns_total",
     "Kudo merge parse+concat time")
+SHUFFLE_LINK_BYTES = METRICS.counter(
+    "srt_shuffle_link_bytes_total",
+    "Kudo shuffle bytes crossing a process-boundary link, by "
+    "direction (send/recv) and peer rank", labels=("direction", "peer"),
+    max_series=256)
+SHUFFLE_LINK_MSGS = METRICS.counter(
+    "srt_shuffle_link_msgs_total",
+    "Shuffle messages delivered per link (acked sends / verified "
+    "receives)", labels=("direction", "peer"), max_series=256)
+SHUFFLE_LINK_RETRIES = METRICS.counter(
+    "srt_shuffle_link_retries_total",
+    "Shuffle link send attempts retried (NAK from the peer verifier, "
+    "reconnects, ack timeouts)", labels=("peer", "reason"),
+    max_series=256)
 OOM_RETRY = METRICS.counter(
     "srt_oom_retry_total", "GpuRetryOOM/CpuRetryOOM throws",
     labels=("device",))
@@ -398,6 +412,33 @@ def record_shuffle_merge(rows: int, parse_ns: int, concat_ns: int,
     TASKS.note_shuffle_merge(rows, parse_ns + concat_ns)
     JOURNAL.emit("shuffle_merge", rows=rows, tables=tables,
                  parse_ns=parse_ns, concat_ns=concat_ns,
+                 thread=threading.get_ident())
+
+
+def record_shuffle_link(direction: str, peer: str, nbytes: int,
+                        op_id: int = 0) -> None:
+    """Distributed shuffle link hook (distributed/transport.py):
+    ``direction`` is 'send' (payload acked by the peer) or 'recv'
+    (payload received AND CRC-verified)."""
+    if not _SWITCH.enabled:
+        return
+    peer = str(peer)
+    SHUFFLE_LINK_BYTES.inc(nbytes, labels=(direction, peer))
+    SHUFFLE_LINK_MSGS.inc(labels=(direction, peer))
+    JOURNAL.emit("shuffle_link", direction=direction, peer=peer,
+                 bytes=nbytes, op=op_id,
+                 thread=threading.get_ident())
+
+
+def record_shuffle_link_retry(peer: str, reason: str) -> None:
+    """One failed shuffle-link send attempt about to be retried
+    (reason: 'nak' = peer's CRC verifier refused the payload,
+    'link' = connect/send/ack transport error)."""
+    if not _SWITCH.enabled:
+        return
+    peer = str(peer)
+    SHUFFLE_LINK_RETRIES.inc(labels=(peer, reason))
+    JOURNAL.emit("shuffle_link_retry", peer=peer, reason=reason,
                  thread=threading.get_ident())
 
 

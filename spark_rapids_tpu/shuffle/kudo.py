@@ -67,11 +67,16 @@ class KudoCorruptException(ValueError):
     """A kudo table failed integrity verification (CRC mismatch or a
     structurally impossible record).  Carries enough to drive a
     re-fetch or a resync: ``reason`` in {'crc', 'magic',
-    'truncated'}."""
+    'truncated'}.  ``deferred=True`` marks a NON-seekable stream's
+    late-trailer verification failure: the corrupt table was already
+    handed to the caller one read earlier, and the stream itself is
+    positioned cleanly at the next record (see read_one_table)."""
 
-    def __init__(self, msg: str, reason: str = "crc"):
+    def __init__(self, msg: str, reason: str = "crc",
+                 deferred: bool = False):
         super().__init__(msg)
         self.reason = reason
+        self.deferred = deferred
 
 
 def set_crc_enabled(enabled: bool) -> bool:
@@ -189,7 +194,7 @@ class KudoTableHeader:
                                f"{want:08x} got {pending:08x}")
                     raise KudoCorruptException(
                         f"kudo crc mismatch (want {want:08x} got "
-                        f"{pending:08x})")
+                        f"{pending:08x})", deferred=True)
             magic = _stream_read(stream, 4)
         if len(magic) == 0:
             return None  # clean EOF
@@ -488,17 +493,29 @@ def stream_has_crc_trailers(blob: bytes) -> bool:
     return False
 
 
+def _is_seekable(stream) -> bool:
+    """Mirror read_one_table's convention: a stream without a
+    ``seekable`` method is treated as seekable (plain BytesIO-likes)."""
+    probe = getattr(stream, "seekable", None)
+    return True if probe is None else bool(probe())
+
+
 def resync_to_magic(stream, chunk_size: int = 1 << 16) -> int:
     """Scan forward to the next table magic ("KUD0"/"KTRX"), leaving
     the stream positioned AT it; returns the bytes skipped.  At EOF
     the stream is left there (the caller's next read sees a clean
-    EOF).  Requires a seekable stream.  Chunked bytes.find scan (a
-    3-byte carry covers magics straddling chunk edges) — a multi-MB
-    corrupt partition resyncs at memchr speed, not per-byte Python."""
+    EOF).  On a seekable stream the scan rewinds with ``seek``; on a
+    NON-seekable one (a live socket wrapped by
+    shuffle/socket_io.SocketStream) the unconsumed tail is given back
+    through the pushback stash, so resync works mid-stream without
+    random access.  Chunked bytes.find scan (a 3-byte carry covers
+    magics straddling chunk edges) — a multi-MB corrupt partition
+    resyncs at memchr speed, not per-byte Python."""
+    can_seek = _is_seekable(stream)
     carry = b""
     consumed = 0          # bytes read from the stream by this scan
     while True:
-        chunk = stream.read(chunk_size)
+        chunk = _stream_read(stream, chunk_size)
         if not chunk:
             return consumed
         buf = carry + chunk
@@ -507,8 +524,11 @@ def resync_to_magic(stream, chunk_size: int = 1 << 16) -> int:
                 if p >= 0]
         if hits:
             pos = min(hits)
-            back = len(chunk) + len(carry) - pos
-            stream.seek(-back, 1)
+            back = len(buf) - pos
+            if can_seek:
+                stream.seek(-back, 1)
+            else:
+                _stream_unread(stream, buf[pos:])
             return consumed - back
         carry = buf[-3:]
 
@@ -521,11 +541,16 @@ def read_tables(stream, *, resync: bool = False) -> List[KudoTable]:
     payload bit-flips (the silent kind) need the CRC trailer.  With
     ``resync=True`` the reader skips to the next table magic after a
     corrupt record and keeps going — the multi-table salvage mode for
-    streams whose remaining tables are still good.  Resync requires a
-    seekable stream."""
+    streams whose remaining tables are still good.  Resync works on
+    seekable streams (rewind + scan) AND on non-seekable socket
+    streams: there a deferred late-trailer CRC failure drops the
+    PREVIOUS table (the one the stashed checksum covered — the stream
+    itself already sits cleanly at the next record), and a bad-magic
+    failure scans forward through the pushback stash."""
     tables: List[KudoTable] = []
+    can_seek = _is_seekable(stream)
     while True:
-        start = stream.tell() if resync else None
+        start = stream.tell() if (resync and can_seek) else None
         try:
             kt = read_one_table(stream)
         except (ValueError, EOFError) as e:
@@ -537,6 +562,35 @@ def read_tables(stream, *, resync: bool = False) -> List[KudoTable]:
                 reason = "truncated"
             else:
                 reason = "magic"
+            if getattr(e, "deferred", False):
+                # non-seekable late-trailer verification: the corrupt
+                # table is the LAST one handed back (its trailer
+                # immediately follows it on the wire); drop it — the
+                # stream needs no repositioning
+                skipped = 0
+                if tables:
+                    bad = tables.pop()
+                    skipped = (bad.header.serialized_size
+                               + bad.header.total_len + CRC_TRAILER_LEN
+                               + (20 if bad.header.trace_ctx is not None
+                                  else 0))
+                _obs.record_kudo_corruption(
+                    "resync", skipped_bytes=skipped,
+                    detail=f"{reason}(deferred): {e}")
+                continue
+            if not can_seek:
+                if isinstance(e, EOFError):
+                    # mid-record EOF on a live stream: nothing past it
+                    # to salvage — return what survived
+                    _obs.record_kudo_corruption(
+                        "resync", skipped_bytes=0,
+                        detail=f"{reason}: {e}")
+                    return tables
+                skipped = resync_to_magic(stream)
+                _obs.record_kudo_corruption(
+                    "resync", skipped_bytes=skipped,
+                    detail=f"{reason}: {e}")
+                continue
             if reason == "crc" and stream.tell() > start:
                 # the record's full extent is known (header, body, and
                 # trailer were all consumed before the mismatch):

@@ -32,6 +32,13 @@ from spark_rapids_tpu.utils.jax_compat import \
 ensure_partitionable_threefry()
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 run (-m 'not slow'); the "
+        "dist-smoke/CI gates cover these paths every run")
+
+
 def make_oom_adaptor(impl: str, limit: int = 1000):
     """Shared python-or-native adaptor factory for the differential OOM
     state-machine suites (skips when the native build is unavailable)."""
