@@ -6,8 +6,9 @@ PY ?= python
 .PHONY: test test-all fuzz native sanitizers bench bench-all dryrun \
         tpu-lower \
         jni-test kudo-bench metrics-smoke trace-smoke chaos-smoke \
-        perf-smoke doctor-smoke server-smoke lifeguard-smoke \
-        ingest-smoke dist-smoke nightly-artifacts ci ci-nightly clean
+        perf-smoke fusion-smoke doctor-smoke server-smoke \
+        lifeguard-smoke ingest-smoke dist-smoke nightly-artifacts \
+        ci ci-nightly clean
 
 # tier-1 set: slow-marked tests (the subprocess fleet twins of the
 # dist-smoke gate) are excluded here exactly like the driver's verify
@@ -83,6 +84,15 @@ chaos-smoke:
 perf-smoke:
 	$(PY) scripts/perf_smoke.py
 
+# whole-stage fusion gate: the fused q3/q5/q72 catalog pipelines must
+# be byte-identical to the hand-fused oracles, compile exactly ONE
+# executable per stage with ZERO recompiles on a second same-bucket
+# query, beat the op-by-op walk on this box, match the window (q89)
+# and rollup+rank (q67) numpy goldens, and light up
+# srt_stage_fusion_total + the metrics_report stages table
+fusion-smoke:
+	$(PY) scripts/fusion_smoke.py
+
 # flight-recorder gate: a chaos-injected retry exhaustion must freeze
 # exactly ONE rate-limited incident bundle under the byte budget, and
 # srt-doctor on that bundle must name the injected fault rule as root
@@ -151,8 +161,8 @@ dryrun:
 # (default 1500s) before emitting the CPU-fallback line — export
 # BENCH_FIGHT_SECONDS=1 for a quick local run.
 ci: test fuzz native sanitizers tpu-lower jni-test dryrun metrics-smoke \
-    trace-smoke chaos-smoke perf-smoke doctor-smoke server-smoke \
-    lifeguard-smoke ingest-smoke dist-smoke
+    trace-smoke chaos-smoke perf-smoke fusion-smoke doctor-smoke \
+    server-smoke lifeguard-smoke ingest-smoke dist-smoke
 	$(PY) bench.py
 	@echo "ci: all gates green"
 

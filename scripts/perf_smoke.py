@@ -224,7 +224,29 @@ def main() -> int:
     if out_a.to_pylist()[7] != "x7" or out_b.to_pylist()[7] != "x7":
         fail("tokenizer smoke extraction wrong")
 
-    # (d) the kernel-path metric + report table light up
+    # (e) ISSUE 11: second fused stage query compiles ZERO executables
+    os.environ["SPARK_RAPIDS_TPU_STAGE_FUSION"] = "1"
+    try:
+        from spark_rapids_tpu.models import tpcds as T
+        from spark_rapids_tpu.plan import catalog as PC
+        d1 = T.gen_q5(rows=4000, stores=16, days=60)
+        PC.run_q5(d1, 16, 1 << 13)
+        s_f = CACHE.stats()
+        d2 = T.gen_q5(rows=3600, stores=16, days=60, seed=8)
+        out_f2 = PC.run_q5(d2, 16, 1 << 13)   # same row bucket
+        if CACHE.stats()["compiles"] != s_f["compiles"]:
+            fail(f"second fused q5 compiled "
+                 f"{CACHE.stats()['compiles'] - s_f['compiles']} new "
+                 f"executable(s); whole-stage reuse is broken")
+        ref_f = T.make_q5(16, join_capacity=1 << 13)(d2)
+        for g, w in zip(out_f2, ref_f):
+            if np.asarray(g).tobytes() != np.asarray(w).tobytes():
+                fail("fused q5 bytes differ from the hand-fused "
+                     "oracle")
+    finally:
+        os.environ.pop("SPARK_RAPIDS_TPU_STAGE_FUSION", None)
+
+    # (f) the kernel-path metric + report table light up
     text = obs.expose_text()
     if "srt_kernel_path_total" not in text:
         fail("srt_kernel_path_total missing from exposition")
@@ -235,8 +257,8 @@ def main() -> int:
 
     print(f"perf-smoke: OK (batch1 {batch1_s:.2f}s with "
           f"{s1['compiles']} compiles, batch2 {batch2_s:.2f}s with 0; "
-          f"join path(s) {sorted(set(picked))}, second-bucket joins "
-          f"and tokenizer: 0 new executables)")
+          f"join path(s) {sorted(set(picked))}, second-bucket joins, "
+          f"tokenizer AND fused q5 stages: 0 new executables)")
     return 0
 
 

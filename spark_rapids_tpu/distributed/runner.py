@@ -7,11 +7,16 @@ rides the shuffle service — that is the contract under test):
 
   1. scan      — every rank regenerates the seeded dataset and takes
                  its row shard (deterministic, no data files needed);
-  2. partials  — the SHARED map-side kernels from models/tpcds
-                 (``_q5_partials`` / ``_q72_partials``) run as one
-                 local jit under ``exchange.with_capacity_retry``
-                 (overflow doubles the join budget, same as every
-                 other capacity-bounded pipeline);
+  2. partials  — the map side runs as ONE fused stage executable
+                 through the stage IR (plan/catalog — ISSUE 11), AOT
+                 in the process compile cache, under
+                 ``exchange.with_capacity_retry`` (overflow doubles
+                 the join budget, same as every other
+                 capacity-bounded pipeline).
+                 ``SPARK_RAPIDS_TPU_STAGE_FUSION=0`` falls back to
+                 the legacy per-op jit of the SHARED models/tpcds
+                 kernels (``_q5_partials`` / ``_q72_partials``) — the
+                 byte-identity oracle of the fused path;
   3. reduce-scatter — the partial group table is sliced into
                  rank-owned chunks, each chunk shipped to its owner as
                  kudo tables over the socket shuffle
@@ -20,10 +25,13 @@ rides the shuffle service — that is the contract under test):
                  arrival order is byte-identical);
   4. allgather — owners re-share their summed chunks; every rank
                  reassembles the GLOBAL group table;
-  5. finish    — the SHARED reduce-side kernels
-                 (``_q5_finish`` / ``_q72_finish``) order/limit the
-                 global table, so the output bytes are identical to
-                 the single-process pipeline's by construction.
+  5. finish    — the reduce side is the matching fused finish stage
+                 (ONE executable again — a rank runs exactly one
+                 program between kudo exchanges), or the SHARED
+                 ``_q5_finish`` / ``_q72_finish`` jits under the
+                 escape hatch; either way the output bytes are
+                 identical to the single-process pipeline's by
+                 construction.
 
 Run as a module (``python -m spark_rapids_tpu.distributed.runner``)
 by scripts/dist_launch.py; the per-query entry points are also
@@ -121,6 +129,14 @@ def _shard(a, rank: int, world: int):
     return a[rank * per: (rank + 1) * per]
 
 
+def _fused() -> bool:
+    """Stage fusion on for this rank?  (The env escape hatch —
+    SPARK_RAPIDS_TPU_STAGE_FUSION=0 — restores the legacy per-op jit
+    of the shared models/tpcds kernel halves.)"""
+    from spark_rapids_tpu.plan.compiler import fusion_mode
+    return fusion_mode() != "off"
+
+
 # ------------------------------------------------------------------ q5
 
 
@@ -150,11 +166,19 @@ def run_dist_q5(params: Optional[dict] = None, *, transport=None
                       d.r_date, d.r_store, d.r_amt, d.r_loss)
         ) + (d.d_date,)
 
-        def build(cap):
-            return jax.jit(T._q5_partials(p["stores"], cap))
+        # one read per query: a mid-query env flip must not leave the
+        # finish step without the partials step's import/engine
+        fused = _fused()
+        if fused:
+            from spark_rapids_tpu.plan import catalog as C
+            outs, _cap = C.run_q5_partials(
+                shard_args, p["stores"], p["join_capacity"])
+        else:
+            def build(cap):
+                return jax.jit(T._q5_partials(p["stores"], cap))
 
-        outs, _cap = T.run_with_capacity_retry(
-            build, shard_args, p["join_capacity"])
+            outs, _cap = T.run_with_capacity_retry(
+                build, shard_args, p["join_capacity"])
         sales, rets, profit, seen, of = outs
         (sales, rets, profit, seen), of_any = \
             _reduce_scatter_allgather(
@@ -163,10 +187,16 @@ def run_dist_q5(params: Optional[dict] = None, *, transport=None
                 [np.asarray(sales), np.asarray(rets),
                  np.asarray(profit), np.asarray(seen)],
                 bool(np.asarray(of)))
-        fin = jax.jit(T._q5_finish(p["stores"]))
-        key_s, sales_s, ret_s, profit_s = fin(
-            jnp.asarray(sales), jnp.asarray(rets),
-            jnp.asarray(profit), jnp.asarray(seen), d.st_id)
+        if fused:
+            key_s, sales_s, ret_s, profit_s, _of = C.run_q5_finish(
+                np.asarray(sales), np.asarray(rets),
+                np.asarray(profit), np.asarray(seen), of_any,
+                np.asarray(d.st_id), p["stores"])
+        else:
+            fin = jax.jit(T._q5_finish(p["stores"]))
+            key_s, sales_s, ret_s, profit_s = fin(
+                jnp.asarray(sales), jnp.asarray(rets),
+                jnp.asarray(profit), jnp.asarray(seen), d.st_id)
         return {"key": np.asarray(key_s), "sales": np.asarray(sales_s),
                 "rets": np.asarray(ret_s),
                 "profit": np.asarray(profit_s),
@@ -219,20 +249,32 @@ def run_dist_q72(params: Optional[dict] = None, *, transport=None
             _shard(d.cs_qty, rank, world),
             d.inv_item, d.inv_date, d.inv_qty, d.item_id)
 
-        def build(cap):
-            return jax.jit(T._q72_partials(
-                p["items"], p["max_week"], cap, p["week0"]))
+        fused = _fused()
+        if fused:
+            from spark_rapids_tpu.plan import catalog as C
+            outs, _cap = C.run_q72_partials(
+                shard_args, p["items"], p["max_week"],
+                p["join_capacity"], p["week0"])
+        else:
+            def build(cap):
+                return jax.jit(T._q72_partials(
+                    p["items"], p["max_week"], cap, p["week0"]))
 
-        outs, _cap = T.run_with_capacity_retry(
-            build, shard_args, p["join_capacity"])
+            outs, _cap = T.run_with_capacity_retry(
+                build, shard_args, p["join_capacity"])
         counts, of = outs
         (counts,), of_any = _reduce_scatter_allgather(
             transport, OpIds.Q72_REDUCE_SCATTER,
             OpIds.Q72_ALLGATHER, [np.asarray(counts)],
             bool(np.asarray(of)))
-        fin = jax.jit(T._q72_finish(
-            p["items"], p["max_week"], p["limit"], p["week0"]))
-        item, week, cnt = fin(jnp.asarray(counts))
+        if fused:
+            item, week, cnt, _of = C.run_q72_finish(
+                np.asarray(counts), of_any, p["items"],
+                p["max_week"], p["limit"], p["week0"])
+        else:
+            fin = jax.jit(T._q72_finish(
+                p["items"], p["max_week"], p["limit"], p["week0"]))
+            item, week, cnt = fin(jnp.asarray(counts))
         return {"item": np.asarray(item), "week": np.asarray(week),
                 "cnt": np.asarray(cnt),
                 "overflow": np.asarray(of_any)}

@@ -738,6 +738,100 @@ def oracle_q7(d: Q7Data, items: int, limit: int = 100):
     return out
 
 
+# ------------------------------------- q67 / q89 (stage-IR shapes)
+# These two shapes have NO hand-fused kernel: they exist because the
+# stage IR (plan/) makes new operators cheap — rollup/cube grouping
+# sets and window functions are IR nodes, and the pipelines live in
+# plan/catalog.py.  The seeded generators and numpy oracles below are
+# their golden contract.
+
+
+class Q67Data(NamedTuple):
+    cat: jnp.ndarray     # i32 category key
+    cls: jnp.ndarray     # i32 class key
+    sales: jnp.ndarray   # i64 decimal64(2) cents
+
+
+def gen_q67(rows: int = 20_000, ncat: int = 8, ncls: int = 16,
+            seed: int = 67) -> Q67Data:
+    rng = np.random.default_rng(seed)
+    return Q67Data(
+        jnp.asarray(rng.integers(0, ncat, rows).astype(np.int32)),
+        jnp.asarray(rng.integers(0, ncls, rows).astype(np.int32)),
+        jnp.asarray(rng.integers(100, 50_000, rows).astype(np.int64)),
+    )
+
+
+def oracle_q67(d: Q67Data, ncat: int, ncls: int):
+    """q67-shape oracle: finest-level rows as
+    [(cat, cls, sum, rank)] ordered by (cat, rank) — rank within
+    category by sum DESC, ties by (cat, cls) id ASC — plus the
+    per-category rollup sums and the grand total."""
+    h = Q67Data(*(np.asarray(x) for x in d))
+    agg: dict = {}
+    for i in range(len(h.cat)):
+        key = (int(h.cat[i]), int(h.cls[i]))
+        agg[key] = agg.get(key, 0) + int(h.sales[i])
+    rows = []
+    for cat in sorted({k[0] for k in agg}):
+        grp = sorted(((-s, cls) for (c, cls), s in agg.items()
+                      if c == cat))
+        for rank, (negs, cls) in enumerate(grp):
+            rows.append((cat, cls, -negs, rank))
+    sum1 = [sum(s for (c, _cls), s in agg.items() if c == cat)
+            for cat in range(ncat)]
+    return rows, sum1, sum(agg.values())
+
+
+def oracle_cube(d: Q67Data, ncat: int, ncls: int):
+    """All four grouping sets of CUBE(cat, cls) as dense vectors."""
+    h = Q67Data(*(np.asarray(x) for x in d))
+    sum0 = np.zeros(ncat * ncls, np.int64)
+    cnt0 = np.zeros(ncat * ncls, np.int64)
+    for i in range(len(h.cat)):
+        g = int(h.cat[i]) * ncls + int(h.cls[i])
+        sum0[g] += int(h.sales[i])
+        cnt0[g] += 1
+    s2 = sum0.reshape(ncat, ncls)
+    c2 = cnt0.reshape(ncat, ncls)
+    return (sum0, cnt0, s2.sum(axis=1), c2.sum(axis=1),
+            int(sum0.sum()), int(cnt0.sum()),
+            s2.sum(axis=0), c2.sum(axis=0))
+
+
+class Q89Data(NamedTuple):
+    store: jnp.ndarray   # i32 store key
+    item: jnp.ndarray    # i32 item key
+    sales: jnp.ndarray   # i64 decimal64(2) cents
+
+
+def gen_q89(rows: int = 20_000, stores: int = 8, items: int = 32,
+            seed: int = 89) -> Q89Data:
+    rng = np.random.default_rng(seed)
+    return Q89Data(
+        jnp.asarray(rng.integers(0, stores, rows).astype(np.int32)),
+        jnp.asarray(rng.integers(0, items, rows).astype(np.int32)),
+        jnp.asarray(rng.integers(100, 30_000, rows).astype(np.int64)),
+    )
+
+
+def oracle_q89(d: Q89Data, stores: int, items: int):
+    """q89-shape oracle: live (store, item) groups ordered by
+    (store, item) with each group's sales, its store's total (the
+    sum-over-partition window), and the group row count."""
+    h = Q89Data(*(np.asarray(x) for x in d))
+    agg: dict = {}
+    tot = [0] * stores
+    for i in range(len(h.store)):
+        key = (int(h.store[i]), int(h.item[i]))
+        e = agg.setdefault(key, [0, 0])
+        e[0] += int(h.sales[i])
+        e[1] += 1
+        tot[key[0]] += int(h.sales[i])
+    return [(st, it, s, tot[st], c)
+            for (st, it), (s, c) in sorted(agg.items())]
+
+
 # --------------------------------------------------- capacity retry
 
 

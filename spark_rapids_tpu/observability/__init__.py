@@ -202,6 +202,12 @@ KERNEL_PATH = METRICS.counter(
     "Executions per op by the kernel path actually taken "
     "(calibrated join / JSON engines)", labels=("op", "path"),
     max_series=128)
+STAGE_FUSION = METRICS.counter(
+    "srt_stage_fusion_total",
+    "Whole-stage executions by stage and outcome (fused = one AOT "
+    "executable, unfused = op-by-op walk, compile = a fused "
+    "executable was built this run)", labels=("stage", "outcome"),
+    max_series=128)
 INCIDENTS_TOTAL = METRICS.counter(
     "srt_incidents_total",
     "Flight-recorder incident bundles written, by trigger kind",
@@ -567,6 +573,26 @@ def record_kernel_path(op: str, path: str, rows: int = 0) -> None:
         return
     KERNEL_PATH.inc(labels=(op, path))
     JOURNAL.emit("kernel_path", op=op, path=path, rows=int(rows),
+                 thread=threading.get_ident())
+
+
+def record_stage_fusion(stage: str, outcome: str, *, digest: str = "",
+                        wall_ns: int = 0, nodes: int = 0,
+                        compiled: bool = False) -> None:
+    """Whole-stage fusion hook (plan/compiler.py): one execution of
+    ``stage`` took ``outcome`` ('fused' = one AOT executable,
+    'unfused' = the op-by-op walk).  ``compiled`` marks runs that
+    built a new fused executable (cache-hit runs don't); ``nodes`` is
+    the dispatch count the unfused walk would pay.  The journal event
+    feeds the metrics_report "stages" table."""
+    if not _SWITCH.enabled:
+        return
+    STAGE_FUSION.inc(labels=(stage, outcome))
+    if compiled:
+        STAGE_FUSION.inc(labels=(stage, "compile"))
+    JOURNAL.emit("stage_fusion", stage=stage, outcome=outcome,
+                 digest=digest, wall_ns=int(wall_ns), nodes=int(nodes),
+                 compiled=bool(compiled),
                  thread=threading.get_ident())
 
 

@@ -539,15 +539,20 @@ def sort_merge_inner_join(left_keys: Table, right_keys: Table,
             path = "device_sort" if on_accel else "host_rank"
         else:
             from spark_rapids_tpu.perf.jit_cache import schema_digest
-            # the build (right) side's size class is part of the
-            # verdict key: the winning engine flips with how much of
-            # the probe structure stays cache-resident
-            digest = schema_digest(
-                [c.dtype for c in left_keys.columns],
-                [lc.validity is not None or rc.validity is not None
-                 for lc, rc in zip(left_keys.columns,
-                                   right_keys.columns)],
-                extra=f"join:{compare_nulls}|rb{max(nr, 1).bit_length()}")
+            # BOTH sides' schemas and size classes key the verdict
+            # (calibrate.operands_digest): the winning engine flips
+            # with how much of the build side stays cache-resident,
+            # and a probe side that changed size class must not reuse
+            # a verdict measured at another scale
+            nulls = [lc.validity is not None or rc.validity is not None
+                     for lc, rc in zip(left_keys.columns,
+                                       right_keys.columns)]
+            digest = calibrate.operands_digest(
+                [(schema_digest([c.dtype for c in left_keys.columns],
+                                nulls), nl),
+                 (schema_digest([c.dtype for c in right_keys.columns],
+                                nulls), nr)],
+                extra=f"join:{compare_nulls}")
             # the build side is bounded too: its size CLASS stays in
             # the digest above, but timing 4 engines x 2 runs over an
             # unbounded build side would stall the first query for

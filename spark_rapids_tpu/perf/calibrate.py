@@ -142,6 +142,30 @@ def store_verdict(key: str, verdict: str) -> None:
         _store(path, d)
 
 
+def operands_digest(parts, extra: str = "") -> str:
+    """Stable digest folding EVERY operand of a multi-input op/stage:
+    each part is ``(layout, rows)`` — a layout string (schema digest,
+    dtype join, anything stable) plus a row count that folds as its
+    power-of-two bucket class (``rows <= 0`` means the layout string
+    already encodes the exact shape).
+
+    This is the fix for the multi-input keying bug: a verdict keyed on
+    ONE operand's digest could be reused for a stage whose OTHER side
+    changed size class — e.g. a join whose build side grew past cache
+    residency kept the probe-side verdict.  Folding all operands makes
+    that reuse impossible; the regression test lives in
+    tests/test_stage_fusion.py."""
+    import hashlib
+
+    from spark_rapids_tpu.perf.jit_cache import bucket_rows
+    items = []
+    for layout, rows in parts:
+        bucket = bucket_rows(int(rows)) if rows and rows > 0 else 0
+        items.append(f"{layout}@{bucket}")
+    s = "|".join(items) + f"|{extra}"
+    return hashlib.sha1(s.encode()).hexdigest()[:16]
+
+
 def pinned_path(op: str) -> Optional[str]:
     env = "SPARK_RAPIDS_TPU_PATH_" + re.sub(r"[^A-Za-z0-9]", "_",
                                             op).upper()
