@@ -7,8 +7,8 @@ PY ?= python
         tpu-lower \
         jni-test kudo-bench metrics-smoke trace-smoke chaos-smoke \
         perf-smoke fusion-smoke doctor-smoke server-smoke \
-        lifeguard-smoke ingest-smoke dist-smoke nightly-artifacts \
-        ci ci-nightly clean
+        lifeguard-smoke ingest-smoke dist-smoke analysis-smoke \
+        nightly-artifacts ci ci-nightly clean
 
 # tier-1 set: slow-marked tests (the subprocess fleet twins of the
 # dist-smoke gate) are excluded here exactly like the driver's verify
@@ -140,6 +140,17 @@ ingest-smoke:
 dist-smoke:
 	$(PY) scripts/dist_smoke.py
 
+# static-analysis gate: srt-lint must exit 0 on the tree (every
+# project invariant holds, catalog cross-checked against the docs,
+# pre-existing violations fixed or reason-suppressed), plan-verify
+# must accept every plan/catalog.py shape and reject a broken plan
+# with a typed PlanVerifyError naming the node, and lockdep must
+# report ZERO acquisition-order cycles under the server soak workload
+# while detecting the synthetic ABBA with counter/journal/bundle/
+# doctor evidence
+analysis-smoke:
+	$(PY) scripts/analysis_smoke.py
+
 # NOTE: jax.config.update, not the env var — this image's sitecustomize
 # pre-imports jax with the axon backend, so JAX_PLATFORMS=cpu is too
 # late.  XLA_FLAGS still works (read at backend init, which happens
@@ -162,7 +173,7 @@ dryrun:
 # BENCH_FIGHT_SECONDS=1 for a quick local run.
 ci: test fuzz native sanitizers tpu-lower jni-test dryrun metrics-smoke \
     trace-smoke chaos-smoke perf-smoke fusion-smoke doctor-smoke \
-    server-smoke lifeguard-smoke ingest-smoke dist-smoke
+    server-smoke lifeguard-smoke ingest-smoke dist-smoke analysis-smoke
 	$(PY) bench.py
 	@echo "ci: all gates green"
 

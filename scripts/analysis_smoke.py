@@ -1,0 +1,218 @@
+"""Static-analysis gate (`make analysis-smoke`, ISSUE 12 acceptance):
+
+  1. **srt-lint exits 0 on the tree** — every project invariant
+     (metric/knob catalog, typed shim raises, digest purity,
+     no-blocking-under-lock, lockdep adoption, reasoned suppressions)
+     holds, and the catalog cross-checks against the docs;
+  2. **plan-verify accepts every plan/catalog.py shape** and rejects
+     a deliberately-broken plan with a typed ``PlanVerifyError``
+     naming the offending node;
+  3. **lockdep reports ZERO acquisition-order cycles** under the
+     PR-6 server soak workload (4 tenants, 10 interleaved TPC-DS
+     queries, fault injection) with every adopted lock instrumented;
+  4. **lockdep detects the synthetic ABBA** (two threads,
+     deterministic event sequencing) with full evidence: the cycle in
+     ``report()``, ``srt_lockdep_cycles_total``, a ``lockdep``
+     journal event, a frozen ``lockdep_cycle`` incident bundle, and
+     an ``srt-doctor`` ranked finding naming the cycle — plus a
+     held-across-blocking synthetic through the real
+     ``fileio.RangeReader`` hook.
+
+Exits non-zero on the first missing signal.
+"""
+
+import os
+import sys
+import tempfile
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# lockdep instruments locks at CREATION time — arm it before anything
+# imports the adopted modules
+os.environ["SPARK_RAPIDS_TPU_LOCKDEP"] = "1"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def fail(msg: str):
+    print(f"analysis-smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def say(msg: str):
+    print(f"analysis-smoke: {msg}")
+
+
+def phase_lint():
+    from spark_rapids_tpu.analysis import lint
+    res = lint.lint_paths(ROOT)
+    if res.findings:
+        for f in res.findings[:20]:
+            print(f"  {f.path}:{f.line}: {f.rule} {f.message}",
+                  file=sys.stderr)
+        fail(f"srt-lint found {len(res.findings)} violation(s) on "
+             f"the tree")
+    say(f"srt-lint clean: {res.files} files, "
+        f"{res.suppressed} reasoned suppression(s)")
+
+
+def phase_plan_verify():
+    from spark_rapids_tpu.analysis import plan_verify
+    from spark_rapids_tpu.plan import ir
+    from spark_rapids_tpu.tools.srt_check import _catalog_plans
+    for name, build in _catalog_plans():
+        plan = build()
+        try:
+            if isinstance(plan, ir.Pipeline):
+                plan_verify.verify_pipeline(plan)
+            else:
+                plan_verify.verify_stage(plan)
+        except plan_verify.PlanVerifyError as e:
+            fail(f"catalog plan {name} rejected: {e}")
+    say(f"plan-verify accepted all {len(_catalog_plans())} catalog "
+        f"shapes")
+    # a broken plan must be refused TYPED, naming the node
+    broken = ir.StagePlan(
+        name="smoke_broken",
+        inputs=(ir.ScanBind("f", (ir.ColSpec("x"),)),),
+        nodes=(ir.Project("y", ir.Bin("add", ir.Col("x"),
+                                      ir.Col("nope"))),),
+        outputs=("y",))
+    try:
+        plan_verify.verify_stage(broken)
+    except plan_verify.PlanVerifyError as e:
+        if "nope" not in str(e) or "Project" not in e.node:
+            fail(f"PlanVerifyError does not name the offender: {e}")
+        say(f"plan-verify rejected the broken plan typed: "
+            f"node {e.node.split()[0]}, reason {e.reason!r}")
+    else:
+        fail("broken plan passed verification")
+
+
+def phase_soak_zero_cycles():
+    from spark_rapids_tpu.analysis import lockdep
+    if not lockdep.enabled():
+        fail("lockdep env did not arm")
+    lockdep.reset()
+    sys.path.insert(0, os.path.join(ROOT, "scripts"))
+    from server_soak import run_soak
+    digest, _report = run_soak(seed=6, verbose=False)
+    rep = lockdep.report()
+    if not rep["installed"] or not rep["classes"]:
+        fail("no instrumented locks were created under the soak")
+    if rep["acquires"] < 100:
+        fail(f"implausibly few acquisitions recorded "
+             f"({rep['acquires']}) — instrumentation not live")
+    if rep["cycles"]:
+        fail(f"lock-order cycles under the server soak: "
+             f"{[c['cycle'] for c in rep['cycles']]}")
+    say(f"server soak (digest {digest[:12]}) cycle-free: "
+        f"{len(rep['classes'])} lock classes, "
+        f"{rep['acquires']} acquires, {len(rep['edges'])} order "
+        f"edges, 0 cycles")
+
+
+def phase_synthetic_abba():
+    from spark_rapids_tpu import observability as obs
+    from spark_rapids_tpu.analysis import lockdep
+    from spark_rapids_tpu.tools import doctor
+
+    lockdep.reset()
+    obs.reset()
+    obs.enable()
+    out_dir = tempfile.mkdtemp(prefix="srt_analysis_smoke_")
+    obs.enable_flight_recorder(out_dir=out_dir, min_interval_s=0.0)
+
+    a = lockdep.make_lock("smoke.A")
+    b = lockdep.make_lock("smoke.B")
+    e1, e2 = threading.Event(), threading.Event()
+
+    def t1():
+        with a:
+            e1.set()
+            e2.wait(2)
+            if b.acquire(timeout=0.2):   # A held, wants B
+                b.release()
+
+    def t2():
+        e1.wait(2)
+        with b:
+            e2.set()
+            if a.acquire(timeout=0.2):   # B held, wants A -> cycle
+                a.release()
+
+    th1 = threading.Thread(target=t1, name="smoke-abba-1")
+    th2 = threading.Thread(target=t2, name="smoke-abba-2")
+    th1.start(); th2.start(); th1.join(5); th2.join(5)
+
+    rep = lockdep.report()
+    cycles = [c["cycle"] for c in rep["cycles"]]
+    if not any("smoke.A" in c and "smoke.B" in c for c in cycles):
+        fail(f"synthetic ABBA not detected (cycles: {cycles})")
+    snap = obs.METRICS.snapshot()
+    cyc_series = snap["srt_lockdep_cycles_total"]["series"]
+    if not cyc_series or cyc_series[0]["value"] < 1:
+        fail("srt_lockdep_cycles_total did not count the cycle")
+    journal = [r for r in obs.JOURNAL.records()
+               if r.get("kind") == "lockdep"
+               and r.get("event") == "cycle"]
+    if not journal:
+        fail("no lockdep journal event for the cycle")
+
+    # held-across-blocking through the REAL fileio hook
+    with tempfile.NamedTemporaryFile(dir=out_dir, delete=False) as f:
+        f.write(b"0123456789abcdef")
+        path = f.name
+    from spark_rapids_tpu.io.fileio import RangeReader
+    with a:
+        with RangeReader(path) as r:
+            r.read(0, 8)
+    rep = lockdep.report()
+    blocking = [ev for ev in rep["blocking"]
+                if ev["op"] == "fileio.read_range"
+                and "smoke.A" in ev["held"]]
+    if not blocking:
+        fail("held-across-blocking event not recorded through "
+             "fileio.read_range")
+    blk = obs.METRICS.snapshot()["srt_lockdep_blocking_total"]
+    if not any(s["value"] >= 1 for s in blk["series"]):
+        fail("srt_lockdep_blocking_total did not count")
+
+    # the incident bundle + doctor triage
+    bundles = doctor.find_bundles(out_dir)
+    if len(bundles) != 1:
+        fail(f"expected exactly one lockdep_cycle bundle, found "
+             f"{len(bundles)}")
+    bundle = doctor.Bundle(bundles[0])
+    if bundle.trigger.get("kind") != "lockdep_cycle":
+        fail(f"bundle trigger is {bundle.trigger.get('kind')!r}")
+    findings = doctor.analyze(bundle)
+    named = [f for f in findings if f["kind"] == "lockdep_cycle"
+             and "smoke.A" in f["message"]]
+    if not named:
+        fail(f"srt-doctor did not rank the cycle "
+             f"({[f['kind'] for f in findings]})")
+    obs.disable_flight_recorder()
+    obs.disable()
+    say(f"synthetic ABBA detected with full evidence: cycle "
+        f"{cycles[0]}, counter+journal, 1 bundle, doctor finding "
+        f"{named[0]['message'][:60]!r}...")
+
+
+def main():
+    phase_lint()
+    phase_plan_verify()
+    phase_soak_zero_cycles()
+    phase_synthetic_abba()
+    print("analysis-smoke: OK")
+
+
+if __name__ == "__main__":
+    main()

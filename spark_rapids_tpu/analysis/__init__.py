@@ -1,0 +1,23 @@
+"""Project-invariant static analysis + runtime race detection
+(ISSUE 12): the repo's conventions, promoted to checked rules.
+
+  lint.py         srt-lint AST rule framework (SRT000..SRT009)
+  catalog.py      the checked-in srt_* metrics / SPARK_RAPIDS_TPU_*
+                  knobs catalog the rules and docs cross-check
+  lockdep.py      opt-in instrumented locks: acquisition-order graph,
+                  ABBA cycle detection, lock-held-across-blocking
+  plan_verify.py  typed verifier over PR-11 stage plans, run before
+                  every lowering (PlanVerifyError instead of an XLA
+                  trace error)
+
+CLI: ``python -m spark_rapids_tpu.tools.srt_check`` (srt-check), gated
+in ``make analysis-smoke`` -> ``make ci`` + ci/premerge.yaml.
+
+Only :mod:`lockdep` is imported eagerly — it is adopted by the
+metrics registry and the server at lock-creation time and must stay
+stdlib-only; lint/plan_verify import on demand (plan_verify pulls
+jax through the plan package).
+"""
+
+from spark_rapids_tpu.analysis.lockdep import (  # noqa: F401
+    make_lock, make_rlock, note_blocking)

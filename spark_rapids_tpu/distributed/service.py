@@ -34,6 +34,8 @@ from __future__ import annotations
 
 import io
 import threading
+
+from spark_rapids_tpu.analysis.lockdep import make_lock
 from typing import Dict, List, Optional, Sequence
 
 from spark_rapids_tpu import observability as _obs
@@ -72,7 +74,7 @@ class ShuffleService:
             r: PeerLink(self.rank, r, addresses[r], policy=policy)
             for r in range(world) if r != self.rank}
         self._started = False
-        self._lock = threading.Lock()
+        self._lock = make_lock("dist.service")
 
     # ------------------------------------------------------- lifecycle
 
@@ -163,6 +165,7 @@ class ShuffleService:
             holder = _obs.TRACER.activate(ctx)
             try:
                 sent[dst] = self.links[dst].send(op_id, payloads[dst])
+            # srt-lint: disable=SRT007 captured into errs and re-raised by the collector after every worker joins
             except BaseException as e:  # noqa: BLE001 — re-raised below
                 errs[dst] = e
             finally:

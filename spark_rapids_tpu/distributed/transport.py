@@ -45,6 +45,9 @@ import os
 import socket
 import struct
 import threading
+
+from spark_rapids_tpu.analysis import lockdep
+from spark_rapids_tpu.analysis.lockdep import make_lock
 from typing import Dict, List, Optional, Tuple
 
 from spark_rapids_tpu import observability as _obs
@@ -73,7 +76,7 @@ def _parse_addr(addr: str):
 
 # ----------------------------------------------------- fault injection
 
-_FAULT_LOCK = threading.Lock()
+_FAULT_LOCK = make_lock("dist.fault")
 # {(mode, dst, op): remaining} — armed once from env or set_link_fault
 _FAULTS: Dict[Tuple[str, int, int], int] = {}
 
@@ -127,7 +130,7 @@ class Inbox:
     deadline lapses -> PeerDiedException naming the missing peers)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("dist.inbox")
         self._cv = threading.Condition(self._lock)
         self._slots: Dict[Tuple[int, int], List[_kudo.KudoTable]] = {}
         # (op_id, src) keys whose round died in wait(): a handler
@@ -190,7 +193,7 @@ class Listener:
         self._sock: Optional[socket.socket] = None
         self._threads: List[threading.Thread] = []
         self._conns: set = set()
-        self._conns_lock = threading.Lock()
+        self._conns_lock = make_lock("dist.listener.conns")
         self._stop = threading.Event()
         # (src, op, seq) already delivered — a resend after a lost ACK
         # re-ACKs without re-inserting.  Recorded only AFTER a
@@ -201,7 +204,7 @@ class Listener:
         # dwarf any in-flight window.
         self._seen: Dict[Tuple[int, int, int], bool] = {}
         self._seen_order: List[Tuple[int, int, int]] = []
-        self._seen_lock = threading.Lock()
+        self._seen_lock = make_lock("dist.listener.seen")
 
     def start(self) -> "Listener":
         fam, target = _parse_addr(self.addr)
@@ -362,7 +365,7 @@ class PeerLink:
         self.ack_timeout_s = ack_timeout_s
         self._sock: Optional[socket.socket] = None
         self._seq = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("dist.peer_link")
 
     # ------------------------------------------------------- plumbing
 
@@ -422,6 +425,11 @@ class PeerLink:
                         wire = (payload[:flip]
                                 + bytes([payload[flip] ^ 0xFF])
                                 + payload[flip + 1:])
+                    # lockdep marker: this link mutex is held across
+                    # the wire round-trip BY DESIGN (it serializes one
+                    # peer's protocol); the evidence lets an operator
+                    # see exactly how long-held it is
+                    lockdep.note_blocking("transport.send")
                     s.sendall(head + wire)
                     verdict = s.recv(1)
                 except OSError:

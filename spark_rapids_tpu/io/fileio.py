@@ -21,6 +21,7 @@ from dataclasses import dataclass
 from typing import List, Optional, Protocol
 
 from spark_rapids_tpu import observability as _obs
+from spark_rapids_tpu.analysis import lockdep
 
 
 @dataclass(frozen=True)
@@ -154,6 +155,10 @@ class RangeReader:
         if offset < 0 or length < 0:
             raise ValueError(
                 f"negative range: offset={offset} length={length}")
+        # lockdep blocking marker: a storage fetch under a held lock
+        # is the latency bug the analyzer hunts (one bool read when
+        # lockdep is off)
+        lockdep.note_blocking("fileio.read_range")
         t0 = time.perf_counter_ns()
         self._f.seek(offset)
         data = self._f.read(length)

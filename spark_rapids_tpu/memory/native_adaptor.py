@@ -41,72 +41,83 @@ def _load() -> Optional[ctypes.CDLL]:
     with _lock:
         if _tried:
             return _lib
-        _tried = True
-        try:
-            if not os.path.exists(_LIB_PATH) or (
-                    os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)):
-                tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
-                subprocess.run(
-                    ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                     "-pthread", "-o", tmp, _SRC],
-                    check=True, capture_output=True, timeout=180)
-                os.replace(tmp, _LIB_PATH)
-            lib = ctypes.CDLL(_LIB_PATH)
-            for name, res, args in [
-                ("sra_create", ctypes.c_long, [ctypes.c_long]),
-                ("sra_destroy", None, [ctypes.c_long]),
-                ("sra_start_dedicated_task_thread", ctypes.c_int,
-                 [ctypes.c_long] * 3),
-                ("sra_pool_thread_working_on_tasks", ctypes.c_int,
-                 [ctypes.c_long, ctypes.c_long, ctypes.c_int,
-                  ctypes.c_void_p, ctypes.c_long]),
-                ("sra_remove_thread_association", ctypes.c_int,
-                 [ctypes.c_long] * 3),
-                ("sra_task_done", ctypes.c_int, [ctypes.c_long] * 2),
-                ("sra_alloc", ctypes.c_int, [ctypes.c_long] * 3),
-                ("sra_dealloc", ctypes.c_int, [ctypes.c_long] * 3),
-                ("sra_cpu_prealloc", ctypes.c_int,
-                 [ctypes.c_long, ctypes.c_long, ctypes.c_int]),
-                ("sra_post_cpu_alloc_success", ctypes.c_int,
-                 [ctypes.c_long, ctypes.c_long, ctypes.c_long,
-                  ctypes.c_int]),
-                ("sra_post_cpu_alloc_failed", ctypes.c_int,
-                 [ctypes.c_long, ctypes.c_long, ctypes.c_int,
-                  ctypes.c_int, ctypes.c_int]),
-                ("sra_cpu_dealloc", ctypes.c_int, [ctypes.c_long] * 3),
-                ("sra_block_thread_until_ready", ctypes.c_int,
-                 [ctypes.c_long] * 2),
-                ("sra_force_retry_oom", ctypes.c_int,
-                 [ctypes.c_long, ctypes.c_long, ctypes.c_long,
-                  ctypes.c_int, ctypes.c_long]),
-                ("sra_force_split_and_retry_oom", ctypes.c_int,
-                 [ctypes.c_long, ctypes.c_long, ctypes.c_long,
-                  ctypes.c_int, ctypes.c_long]),
-                ("sra_force_cudf_exception", ctypes.c_int,
-                 [ctypes.c_long] * 3),
-                ("sra_get_state", ctypes.c_int, [ctypes.c_long] * 2),
-                ("sra_used", ctypes.c_long, [ctypes.c_long]),
-                ("sra_gpu_allocated", ctypes.c_long, [ctypes.c_long]),
-                ("sra_thread_waiting_on_pool", ctypes.c_int,
-                 [ctypes.c_long, ctypes.c_long, ctypes.c_int]),
-                ("sra_check_and_break_deadlocks", ctypes.c_int,
-                 [ctypes.c_long]),
-                ("sra_get_and_reset_metric", ctypes.c_long,
-                 [ctypes.c_long, ctypes.c_long, ctypes.c_int,
-                  ctypes.c_int]),
-                ("sra_remove_task_metrics", None,
-                 [ctypes.c_long] * 2),
-                ("sra_log_count", ctypes.c_long, [ctypes.c_long]),
-                ("sra_log_line", ctypes.c_long,
-                 [ctypes.c_long, ctypes.c_long, ctypes.c_char_p,
-                  ctypes.c_long]),
-            ]:
-                fn = getattr(lib, name)
-                fn.restype = res
-                fn.argtypes = args
-            _lib = lib
-        except (OSError, subprocess.SubprocessError):
-            _lib = None
+    # Build + bind OUTSIDE the lock (srt-lint SRT006): the g++ compile
+    # can run for minutes and a mutex held across it wedges every
+    # first-touch caller behind an invisible subprocess.  A rare
+    # concurrent first touch compiles twice into pid-unique tmp files;
+    # os.replace is atomic and both artifacts are identical, so the
+    # first publisher wins and the duplicate work is bounded.
+    lib: Optional[ctypes.CDLL] = None
+    try:
+        if not os.path.exists(_LIB_PATH) or (
+                os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)):
+            # pid+thread unique: two same-process first-touch
+            # threads must not share a tmp inode
+            tmp = (f"{_LIB_PATH}.{os.getpid()}"
+                   f".{threading.get_ident()}.tmp")
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                 "-pthread", "-o", tmp, _SRC],
+                check=True, capture_output=True, timeout=180)
+            os.replace(tmp, _LIB_PATH)
+        lib = ctypes.CDLL(_LIB_PATH)
+        for name, res, args in [
+            ("sra_create", ctypes.c_long, [ctypes.c_long]),
+            ("sra_destroy", None, [ctypes.c_long]),
+            ("sra_start_dedicated_task_thread", ctypes.c_int,
+             [ctypes.c_long] * 3),
+            ("sra_pool_thread_working_on_tasks", ctypes.c_int,
+             [ctypes.c_long, ctypes.c_long, ctypes.c_int,
+              ctypes.c_void_p, ctypes.c_long]),
+            ("sra_remove_thread_association", ctypes.c_int,
+             [ctypes.c_long] * 3),
+            ("sra_task_done", ctypes.c_int, [ctypes.c_long] * 2),
+            ("sra_alloc", ctypes.c_int, [ctypes.c_long] * 3),
+            ("sra_dealloc", ctypes.c_int, [ctypes.c_long] * 3),
+            ("sra_cpu_prealloc", ctypes.c_int,
+             [ctypes.c_long, ctypes.c_long, ctypes.c_int]),
+            ("sra_post_cpu_alloc_success", ctypes.c_int,
+             [ctypes.c_long, ctypes.c_long, ctypes.c_long,
+              ctypes.c_int]),
+            ("sra_post_cpu_alloc_failed", ctypes.c_int,
+             [ctypes.c_long, ctypes.c_long, ctypes.c_int,
+              ctypes.c_int, ctypes.c_int]),
+            ("sra_cpu_dealloc", ctypes.c_int, [ctypes.c_long] * 3),
+            ("sra_block_thread_until_ready", ctypes.c_int,
+             [ctypes.c_long] * 2),
+            ("sra_force_retry_oom", ctypes.c_int,
+             [ctypes.c_long, ctypes.c_long, ctypes.c_long,
+              ctypes.c_int, ctypes.c_long]),
+            ("sra_force_split_and_retry_oom", ctypes.c_int,
+             [ctypes.c_long, ctypes.c_long, ctypes.c_long,
+              ctypes.c_int, ctypes.c_long]),
+            ("sra_force_cudf_exception", ctypes.c_int,
+             [ctypes.c_long] * 3),
+            ("sra_get_state", ctypes.c_int, [ctypes.c_long] * 2),
+            ("sra_used", ctypes.c_long, [ctypes.c_long]),
+            ("sra_gpu_allocated", ctypes.c_long, [ctypes.c_long]),
+            ("sra_thread_waiting_on_pool", ctypes.c_int,
+             [ctypes.c_long, ctypes.c_long, ctypes.c_int]),
+            ("sra_check_and_break_deadlocks", ctypes.c_int,
+             [ctypes.c_long]),
+            ("sra_get_and_reset_metric", ctypes.c_long,
+             [ctypes.c_long, ctypes.c_long, ctypes.c_int,
+              ctypes.c_int]),
+            ("sra_remove_task_metrics", None,
+             [ctypes.c_long] * 2),
+            ("sra_log_count", ctypes.c_long, [ctypes.c_long]),
+            ("sra_log_line", ctypes.c_long,
+             [ctypes.c_long, ctypes.c_long, ctypes.c_char_p,
+              ctypes.c_long]),
+        ]:
+            fn = getattr(lib, name)
+            fn.restype = res
+            fn.argtypes = args
+    except (OSError, subprocess.SubprocessError):
+        lib = None
+    with _lock:
+        if not _tried:
+            _lib, _tried = lib, True
         return _lib
 
 

@@ -295,6 +295,14 @@ IO_ROWS = METRICS.counter(
 IO_DECODE_TIME = METRICS.counter(
     "srt_io_decode_ns_total",
     "Wall time decoding parquet pages into device columns")
+LOCKDEP_CYCLES = METRICS.counter(
+    "srt_lockdep_cycles_total",
+    "Lock-acquisition-order cycles detected by the lockdep runtime "
+    "(ABBA deadlock potential — the deadlock need not fire)")
+LOCKDEP_BLOCKING = METRICS.counter(
+    "srt_lockdep_blocking_total",
+    "Instrumented locks observed held across a known blocking call "
+    "(socket send/recv, storage range read)", labels=("op",))
 
 
 # ------------------------------------------------------------------ tracer
@@ -594,6 +602,32 @@ def record_stage_fusion(stage: str, outcome: str, *, digest: str = "",
                  digest=digest, wall_ns=int(wall_ns), nodes=int(nodes),
                  compiled=bool(compiled),
                  thread=threading.get_ident())
+
+
+def record_lockdep(kind: str, *, cycle=(), op: str = "", held=(),
+                   evidence: Optional[dict] = None) -> None:
+    """Lockdep evidence hook (analysis/lockdep.py): ``kind`` is
+    'cycle' (an acquisition-order cycle between lock classes — ABBA
+    deadlock potential) or 'blocking' (a lock held across a known
+    blocking call).  A cycle additionally freezes a ``lockdep_cycle``
+    incident bundle when the recorder is armed, carrying the
+    acquisition stacks of both directions — srt-doctor renders it as
+    a ranked finding."""
+    if kind == "cycle" and FLIGHT.enabled:
+        trigger_incident("lockdep_cycle", severity="warn",
+                         cycle=list(cycle),
+                         evidence=evidence or {})
+    if not _SWITCH.enabled:
+        return
+    if kind == "cycle":
+        LOCKDEP_CYCLES.inc()
+        JOURNAL.emit("lockdep", event="cycle", cycle=list(cycle),
+                     thread=threading.get_ident())
+    elif kind == "blocking":
+        LOCKDEP_BLOCKING.inc(labels=(op,))
+        JOURNAL.emit("lockdep", event="blocking", op=op,
+                     held=list(held),
+                     thread=threading.get_ident())
 
 
 def record_exchange_doubling(from_capacity: int, to_capacity: int,

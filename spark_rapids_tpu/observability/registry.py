@@ -27,6 +27,8 @@ from __future__ import annotations
 
 import json
 import threading
+
+from spark_rapids_tpu.analysis.lockdep import make_lock
 from typing import Dict, List, Optional, Sequence, Tuple
 
 # Latency buckets in nanoseconds: 1us .. 10s decades, the range host-side
@@ -57,7 +59,7 @@ class _Series:
     __slots__ = ("lock", "value", "bucket_counts", "sum", "count")
 
     def __init__(self, n_buckets: int = 0):
-        self.lock = threading.Lock()
+        self.lock = make_lock("metrics.series")
         self.value = 0
         if n_buckets:
             self.bucket_counts = [0] * (n_buckets + 1)  # +inf tail
@@ -78,7 +80,7 @@ class _Family:
         self.label_names = tuple(labels)
         self.max_series = max_series
         self.dropped_series = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.family")
         self._children: Dict[Tuple[str, ...], _Series] = {}
 
     # -- child management --------------------------------------------------
@@ -250,7 +252,7 @@ class MetricsRegistry:
     def __init__(self, enabled: bool = False, max_series: int = 64):
         self.enabled = enabled
         self.default_max_series = max_series
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.registry")
         self._families: Dict[str, _Family] = {}
 
     # -- family creation (idempotent: same name returns same family) ------

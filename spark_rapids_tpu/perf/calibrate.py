@@ -38,11 +38,12 @@ import json
 import os
 import re
 import tempfile
-import threading
 import time
 from typing import Callable, Dict, Mapping, Optional, Tuple
 
-_LOCK = threading.RLock()
+from spark_rapids_tpu.analysis.lockdep import make_rlock
+
+_LOCK = make_rlock("perf.calibrate")
 _PROC_CACHE: Dict[Tuple[str, str, str], str] = {}
 
 DEFAULT_TTL_S = 86400.0
@@ -128,6 +129,7 @@ def cached_verdict(key: str) -> Optional[str]:
         return None
     v = rec.get("verdict")
     try:
+        # srt-lint: disable=SRT005 wall-clock TTL of the on-disk verdict cache; expiry never folds into a digest or cache key
         fresh = time.time() - float(rec.get("t", 0)) < _ttl()
     except (TypeError, ValueError):
         fresh = False
@@ -138,6 +140,7 @@ def store_verdict(key: str, verdict: str) -> None:
     with _LOCK:
         path = cache_path()
         d = _load(path)
+        # srt-lint: disable=SRT005 wall-clock stamp read back only by the TTL check above; never part of a digest
         d[key] = {"verdict": verdict, "t": time.time()}
         _store(path, d)
 

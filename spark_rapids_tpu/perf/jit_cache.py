@@ -28,13 +28,14 @@ from __future__ import annotations
 
 import hashlib
 import os
-import threading
 import time
 from collections import OrderedDict
 from typing import Callable, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from spark_rapids_tpu.analysis.lockdep import make_rlock
 
 _MIN_BUCKET = 8
 
@@ -106,7 +107,7 @@ class JitCache:
 
     def __init__(self, max_entries: Optional[int] = None,
                  max_bytes: Optional[int] = None):
-        self._lock = threading.RLock()
+        self._lock = make_rlock("perf.jit_cache")
         self._entries: "OrderedDict[Tuple[str, str, int], _Entry]" = \
             OrderedDict()
         self._max_entries = max_entries

@@ -541,6 +541,32 @@ class CompiledStage:
                 time.monotonic_ns() - t0, digest)
 
 
+# plan-verify gate (ISSUE 12): every distinct plan digest is verified
+# ONCE before anything lowers — a malformed plan fails as a typed
+# PlanVerifyError naming the offending node instead of an XLA trace
+# error three layers down.  Memoized by digest so the hot path pays a
+# dict hit; SPARK_RAPIDS_TPU_PLAN_VERIFY=0 is the escape hatch.
+_VERIFIED: Dict[str, bool] = {}
+_VERIFIED_CAP = 4096
+
+
+def _verify_once(plan_or_pipeline) -> None:
+    if os.environ.get("SPARK_RAPIDS_TPU_PLAN_VERIFY", "") == "0":
+        return
+    digest = plan_or_pipeline.digest
+    if digest in _VERIFIED:
+        return
+    from spark_rapids_tpu.analysis import plan_verify
+    if isinstance(plan_or_pipeline, ir.Pipeline):
+        plan_verify.verify_pipeline(plan_or_pipeline)
+    else:
+        plan_verify.verify_stage(plan_or_pipeline)
+    if len(_VERIFIED) >= _VERIFIED_CAP:
+        for k in list(_VERIFIED)[:_VERIFIED_CAP // 2]:
+            del _VERIFIED[k]
+    _VERIFIED[digest] = True
+
+
 # one CompiledStage per plan digest, process-wide: catalog entry
 # points build plans per call, and per-instance state (the
 # jit-cache-disabled _nocache memo) must survive across calls or the
@@ -554,6 +580,7 @@ _STAGE_MEMO_CAP = 128
 def compile_stage(plan: ir.StagePlan) -> CompiledStage:
     cs = _STAGE_MEMO.get(plan.digest)
     if cs is None:
+        _verify_once(plan)
         cs = CompiledStage(plan)
         if len(_STAGE_MEMO) >= _STAGE_MEMO_CAP:
             for k in list(_STAGE_MEMO)[:_STAGE_MEMO_CAP // 2]:
@@ -572,6 +599,7 @@ class CompiledPipeline:
     the kudo socket shuffle)."""
 
     def __init__(self, pipeline: ir.Pipeline):
+        _verify_once(pipeline)      # seam checks on top of per-stage
         self.pipeline = pipeline
         self.stages = [compile_stage(s) for s in pipeline.stages]
 
@@ -603,6 +631,7 @@ def fused_pipeline_fn(pipeline: ir.Pipeline,
     columns flattened in declaration order; boundary-fed ScanBinds
     (every column already defined upstream) consume no args.  Returns
     (fn, n_args)."""
+    _verify_once(pipeline)
     defined = set()
     external = []
     for stage in pipeline.stages:

@@ -30,6 +30,8 @@ from __future__ import annotations
 import hashlib
 import json
 import threading
+
+from spark_rapids_tpu.analysis.lockdep import make_lock
 import time
 import traceback
 from typing import Callable, Dict, List, Optional, Tuple
@@ -40,7 +42,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 # are pruned once the table crosses _BEATS_MAX (a resident server must
 # not keep one row per worker thread that ever lived).
 _BEATS: Dict[int, Tuple[int, str]] = {}
-_BEATS_LOCK = threading.Lock()
+_BEATS_LOCK = make_lock("lifeguard.beats")
 _BEATS_MAX = 4096
 
 
@@ -73,7 +75,7 @@ def clear_beat(ident: int) -> None:
         _BEATS.pop(ident, None)
 
 
-_HOOK_LOCK = threading.Lock()
+_HOOK_LOCK = make_lock("lifeguard.hook")
 _HOOK_INSTALLS = 0
 
 
@@ -161,7 +163,7 @@ class QuarantineBreaker:
         self.failures = int(failures)
         self.cooldown_s = float(cooldown_s)
         self.clock = clock
-        self._lock = threading.Lock()
+        self._lock = make_lock("lifeguard.breaker")
         self._entries: Dict[str, dict] = {}
 
     @property

@@ -20,6 +20,8 @@ from __future__ import annotations
 
 import os
 import threading
+
+from spark_rapids_tpu.analysis.lockdep import make_lock
 from typing import Optional
 
 from spark_rapids_tpu.server.admission import (AdmissionController,  # noqa: F401
@@ -33,7 +35,7 @@ from spark_rapids_tpu.server.server import (QueryServer,  # noqa: F401
 
 _SERVER: Optional[QueryServer] = None
 _DOOR: Optional[SocketFrontDoor] = None
-_LOCK = threading.Lock()
+_LOCK = make_lock("server.singleton")
 
 
 def ensure_server(config: Optional[ServerConfig] = None,

@@ -24,6 +24,8 @@ also feeds the fair-share scheduler.
 from __future__ import annotations
 
 import threading
+
+from spark_rapids_tpu.analysis.lockdep import make_lock
 from dataclasses import dataclass
 from typing import Dict, Optional
 
@@ -88,7 +90,7 @@ class AdmissionController:
         self.max_queue = int(max_queue)
         self.default_quota = default_quota or TenantQuota()
         self._quotas: Dict[str, TenantQuota] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("server.admission")
 
     def set_quota(self, tenant: str, *, max_inflight: int = -1,
                   max_device_bytes: int = -1,

@@ -53,6 +53,8 @@ import collections
 import itertools
 import os
 import threading
+
+from spark_rapids_tpu.analysis.lockdep import make_lock
 import time
 from dataclasses import dataclass
 from typing import Callable, Dict, Optional
@@ -167,7 +169,7 @@ class QueryServer:
         self.config = config or ServerConfig.from_env()
         self._runner = runner or run_catalog_query
         self._device_bytes_fn = device_bytes_fn
-        self._lock = threading.Lock()
+        self._lock = make_lock("server.query_server")
         self._work = threading.Condition(self._lock)
         self._sched = FairShareScheduler()
         self._admission = AdmissionController(
@@ -601,6 +603,7 @@ class QueryServer:
                 error = {"type": type(e).__name__,
                          "message": str(e)[:300],
                          "reason": "oom_quota_exhausted"}
+        # srt-lint: disable=SRT007 job isolation: the error is folded into the job's typed outcome; the pool thread must survive any tenant bug
         except BaseException as e:  # noqa: BLE001 — job isolation:
             # one tenant's bug must never take the pool thread down
             if job.cancel_event.is_set():

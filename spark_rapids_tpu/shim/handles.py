@@ -19,6 +19,8 @@ from __future__ import annotations
 
 import itertools
 import threading
+
+from spark_rapids_tpu.analysis.lockdep import make_lock
 from typing import Any, Dict, Optional
 
 _MISSING = object()   # registered objects may legitimately be falsy
@@ -28,7 +30,7 @@ class HandleRegistry:
     def __init__(self):
         self._objects: Dict[int, Any] = {}
         self._next = itertools.count(1)
-        self._lock = threading.Lock()
+        self._lock = make_lock("shim.handles")
 
     def register(self, obj: Any) -> int:
         with self._lock:

@@ -18,8 +18,10 @@ scripts/gen_java_classes.py.
 
 from __future__ import annotations
 
-import threading
 from typing import List, Optional, Sequence
+
+from spark_rapids_tpu.analysis.lockdep import make_lock
+from spark_rapids_tpu.shim.errors import ShimArgumentError, ShimStateError
 
 _INITIALIZED = False
 
@@ -128,18 +130,18 @@ def from_strings_bulk(chars: bytes, offsets_le: bytes,
     from spark_rapids_tpu.shim.handles import REGISTRY
     offs = np.frombuffer(offsets_le, "<i4")
     if len(offs) == 0:
-        raise ValueError(
+        raise ShimArgumentError(
             "offsets must hold at least one entry (the leading 0)")
     rows = len(offs) - 1
     if offs[0] != 0 or (rows > 0 and (np.diff(offs) < 0).any()):
-        raise ValueError("offsets must start at 0 and be "
-                         "non-decreasing")
+        raise ShimArgumentError("offsets must start at 0 and be "
+                                "non-decreasing")
     if int(offs[-1]) > len(chars):
-        raise ValueError(
+        raise ShimArgumentError(
             f"last offset {int(offs[-1])} exceeds chars length "
             f"{len(chars)}")
     if validity is not None and len(validity) < (rows + 7) // 8:
-        raise ValueError("validity shorter than ceil(rows/8) bytes")
+        raise ShimArgumentError("validity shorter than ceil(rows/8) bytes")
     # no host-side .copy(): jnp.asarray copies the read-only views
     # into device buffers anyway; an extra memcpy on a multi-MB
     # payload is pure waste on the path this entry exists to speed up
@@ -552,8 +554,8 @@ def iceberg_datetime(handle: int, component: str) -> int:
     table = {"year": IB.year, "month": IB.month, "day": IB.day,
              "hour": IB.hour}
     if component not in table:
-        raise ValueError(f"unsupported component {component!r}: "
-                         f"expected year|month|day|hour")
+        raise ShimArgumentError(f"unsupported component {component!r}: "
+                                f"expected year|month|day|hour")
     return REGISTRY.register(table[component](REGISTRY.get(handle)))
 
 
@@ -908,7 +910,7 @@ def server_drain(deadline_s: float = -1.0, flush_dir: str = "") -> str:
 
 _HOST_TABLES = {}
 _HOST_TABLE_NEXT = [1]
-_HOST_TABLES_LOCK = threading.Lock()
+_HOST_TABLES_LOCK = make_lock("shim.host_tables")
 
 
 def _host_table_get(handle: int):
@@ -916,7 +918,7 @@ def _host_table_get(handle: int):
         try:
             return _HOST_TABLES[handle]
         except KeyError:
-            raise ValueError(
+            raise ShimArgumentError(
                 f"invalid or released host-table handle {handle}")
 
 
@@ -952,7 +954,7 @@ def host_table_free(handle: int) -> None:
     column registry's (HandleRegistry.release contract)."""
     with _HOST_TABLES_LOCK:
         if _HOST_TABLES.pop(handle, None) is None:
-            raise ValueError(
+            raise ShimArgumentError(
                 f"double free or invalid host-table handle {handle}")
 
 
@@ -970,7 +972,7 @@ def host_table_free(handle: int) -> None:
 # memo (free releases FIRST, so this liveness check is authoritative).
 _KUDO_WRITE_CACHE: dict = {}
 _KUDO_WRITE_CACHE_MAX = 4
-_KUDO_CACHE_LOCK = threading.Lock()
+_KUDO_CACHE_LOCK = make_lock("shim.kudo_cache")
 
 
 def _kudo_cache_purge(handle: int) -> None:
@@ -1168,7 +1170,7 @@ def flagship_q5_mesh(n_devices: int, rows: int,
     devs = _jax.devices()
     n = int(n_devices)
     if len(devs) < n:
-        raise RuntimeError(
+        raise ShimStateError(
             f"mesh wants {n} devices, backend has {len(devs)} "
             f"(set SPARK_RAPIDS_TPU_CPU_DEVICES before init)")
     mesh = Mesh(np.array(devs[:n]), ("data",))
@@ -1183,7 +1185,7 @@ def flagship_q5_mesh(n_devices: int, rows: int,
         d.s_date, d.s_store, d.s_price, d.s_profit, d.r_date,
         d.r_store, d.r_amt, d.r_loss, d.d_date, d.st_id)
     if bool(np.asarray(overflow)):
-        raise RuntimeError("q5 mesh overflow")
+        raise ShimStateError("q5 mesh overflow")
     key = np.asarray(key_s)
     live = key != 2**31 - 1
     out: List[int] = []
@@ -1209,7 +1211,7 @@ def flagship_q72_mesh(n_devices: int, cs_rows: int,
     devs = _jax.devices()
     n = int(n_devices)
     if len(devs) < n:
-        raise RuntimeError(
+        raise ShimStateError(
             f"mesh wants {n} devices, backend has {len(devs)}")
     mesh = Mesh(np.array(devs[:n]), ("data",))
     week0 = 11_000 // 7
@@ -1224,7 +1226,7 @@ def flagship_q72_mesh(n_devices: int, cs_rows: int,
     ti, tw, tc, ovf = step(d.cs_item, d.cs_date, d.cs_qty, d.inv_item,
                            d.inv_date, d.inv_qty, d.item_id)
     if bool(np.asarray(ovf)):
-        raise RuntimeError("q72 mesh overflow")
+        raise ShimStateError("q72 mesh overflow")
     cnts = np.asarray(tc)
     live = cnts > 0
     out: List[int] = []

@@ -325,6 +325,26 @@ def analyze(bundle: Bundle) -> List[dict]:
                                 f"device memory while {tenant!r} "
                                 f"admission stalls (no tenant map in "
                                 f"bundle)")})
+    elif kind == "lockdep_cycle":
+        cycle = detail.get("cycle") or []
+        findings.append({
+            "severity": 86, "kind": "lockdep_cycle",
+            "message": (f"lock-order cycle "
+                        f"{' -> '.join(str(c) for c in cycle)} "
+                        f"(ABBA deadlock potential — two threads "
+                        f"taking these lock classes in opposite "
+                        f"orders can wedge)")})
+        fwd = (detail.get("evidence") or {}).get("forward") or {}
+        stack = fwd.get("stack") or []
+        # bundles are untrusted JSON off disk: a truncated/blank stack
+        # entry must degrade to the top finding, not IndexError
+        frame_lines = (str(stack[-1]).strip().splitlines()
+                       if stack else [])
+        if frame_lines:
+            findings.append({
+                "severity": 60, "kind": "lockdep_cycle",
+                "message": ("reversing acquisition came from: "
+                            + frame_lines[0].strip())})
     elif kind == "manual":
         findings.append({
             "severity": 10, "kind": "manual",
@@ -393,6 +413,37 @@ def analyze(bundle: Bundle) -> List[dict]:
                         f"{_fmt_bytes(row.get('watermark_bytes', 0))}, "
                         f"{row.get('allocs', 0)} allocs / "
                         f"{row.get('frees', 0)} frees)")})
+
+    # ---- lockdep journal history ------------------------------------
+    ld_cycles = [r for r in bundle.journal
+                 if r.get("kind") == "lockdep"
+                 and r.get("event") == "cycle"]
+    if ld_cycles and kind != "lockdep_cycle":
+        last = ld_cycles[-1]
+        path = " -> ".join(str(c) for c in (last.get("cycle") or []))
+        findings.append({
+            "severity": 76, "kind": "lockdep_cycle",
+            "message": (f"{len(ld_cycles)} lock-order cycle(s) in the "
+                        f"journal (last: {path}) — ABBA deadlock "
+                        f"potential")})
+    ld_blocking = [r for r in bundle.journal
+                   if r.get("kind") == "lockdep"
+                   and r.get("event") == "blocking"]
+    if ld_blocking:
+        ops: Dict[str, int] = {}
+        for r in ld_blocking:
+            ops[str(r.get("op", "?"))] = \
+                ops.get(str(r.get("op", "?")), 0) + 1
+        summary = ", ".join(f"{op} x{n}"
+                            for op, n in sorted(ops.items()))
+        held = sorted({str(h) for r in ld_blocking
+                       for h in (r.get("held") or [])})
+        findings.append({
+            "severity": 55, "kind": "lockdep_blocking",
+            "message": (f"{len(ld_blocking)} lock-held-across-"
+                        f"blocking event(s) ({summary}; locks: "
+                        f"{', '.join(held[:4])}) — contending "
+                        f"threads stall behind I/O")})
 
     # ---- kudo corruption history ------------------------------------
     corrupt = [r for r in bundle.journal

@@ -37,6 +37,7 @@ def close_all(resources: Iterable) -> None:
             continue
         try:
             r.close()
+        # srt-lint: disable=SRT007 mirror of Arms.closeAll: the first failure is remembered and raised after every close was attempted
         except BaseException as e:  # noqa: BLE001 - mirror closeAll
             if first is None:
                 first = e
