@@ -8,7 +8,7 @@ PY ?= python
         jni-test kudo-bench metrics-smoke trace-smoke chaos-smoke \
         perf-smoke fusion-smoke doctor-smoke server-smoke \
         lifeguard-smoke ingest-smoke dist-smoke analysis-smoke \
-        nightly-artifacts ci ci-nightly clean
+        profile-smoke nightly-artifacts ci ci-nightly clean
 
 # tier-1 set: slow-marked tests (the subprocess fleet twins of the
 # dist-smoke gate) are excluded here exactly like the driver's verify
@@ -151,6 +151,17 @@ dist-smoke:
 analysis-smoke:
 	$(PY) scripts/analysis_smoke.py
 
+# query-profile gate: one profiled session over the fused q3/q5/q72
+# catalog pipelines must produce an EXPLAIN ANALYZE tree matching the
+# 5-executable stage count (pad-waste + compile evidence live); a
+# real 2-process q5 fleet with SPARK_RAPIDS_TPU_PROFILE=1 must merge
+# into ONE fleet profile whose per-rank shuffle-link bytes reconcile
+# exactly with each rank's metrics dump; srt-explain --diff must exit
+# nonzero on an injected slowdown; disabled-mode hooks must stay at
+# attribute-read cost
+profile-smoke:
+	$(PY) scripts/profile_smoke.py
+
 # NOTE: jax.config.update, not the env var — this image's sitecustomize
 # pre-imports jax with the axon backend, so JAX_PLATFORMS=cpu is too
 # late.  XLA_FLAGS still works (read at backend init, which happens
@@ -173,7 +184,8 @@ dryrun:
 # BENCH_FIGHT_SECONDS=1 for a quick local run.
 ci: test fuzz native sanitizers tpu-lower jni-test dryrun metrics-smoke \
     trace-smoke chaos-smoke perf-smoke fusion-smoke doctor-smoke \
-    server-smoke lifeguard-smoke ingest-smoke dist-smoke analysis-smoke
+    server-smoke lifeguard-smoke ingest-smoke dist-smoke analysis-smoke \
+    profile-smoke
 	$(PY) bench.py
 	@echo "ci: all gates green"
 

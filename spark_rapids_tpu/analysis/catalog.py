@@ -110,6 +110,13 @@ METRICS: Dict[str, Tuple[str, str]] = {
         "counter", "lock-order cycles detected (ABBA potential)"),
     "srt_lockdep_blocking_total": (
         "counter", "locks held across known blocking calls"),
+    # -- ISSUE 13: query profiles (EXPLAIN ANALYZE) --
+    "srt_profile_queries_total": (
+        "counter", "per-query profiles assembled at query end"),
+    "srt_profile_assembly_ns": (
+        "histogram", "wall time assembling one query profile"),
+    "srt_profile_dropped_total": (
+        "counter", "profile sessions dropped instead of assembled"),
 }
 
 # ----------------------------------------------------------------- knobs
@@ -224,6 +231,14 @@ KNOBS: Dict[str, str] = {
     "SPARK_RAPIDS_TPU_SERVER_SOCKET": "unix-socket front-door path",
     "SPARK_RAPIDS_TPU_SERVER_SOCKET_IDLE_S":
         "per-connection read/idle timeout",
+    # -- ISSUE 13: query profiles (EXPLAIN ANALYZE) --
+    "SPARK_RAPIDS_TPU_PROFILE":
+        "=1 enables per-query profile assembly (EXPLAIN ANALYZE)",
+    "SPARK_RAPIDS_TPU_PROFILE_KEEP":
+        "finished query profiles retained in the process ring "
+        "(0=off)",
+    "SPARK_RAPIDS_TPU_SERVER_PROFILE_KEEP":
+        "query profiles the server retains per tenant (0=off)",
 }
 
 # env families read with a COMPUTED suffix (pinned_path's

@@ -41,6 +41,7 @@ importable for in-process tests against any table transport.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import sys
@@ -137,6 +138,25 @@ def _fused() -> bool:
     return fusion_mode() != "off"
 
 
+@contextlib.contextmanager
+def _profiled(op: str, rank: int, world: int):
+    """Per-rank query-profile session (ISSUE 13): when
+    SPARK_RAPIDS_TPU_PROFILE is on, each rank assembles its own
+    EXPLAIN ANALYZE artifact — this process's registry scopes the
+    shuffle-link byte deltas, so a rank's profile carries exactly its
+    own per-peer traffic.  ``merge_profiles`` stitches the rank
+    artifacts into ONE fleet profile via the launcher-seeded trace
+    context.  One attribute read when profiling is off."""
+    from spark_rapids_tpu import observability as _obs
+
+    sess = _obs.PROFILER.begin(f"{op}-rank{rank}", query=f"dist_{op}",
+                               rank=rank, world=world)
+    try:
+        yield sess
+    finally:
+        _obs.PROFILER.end(sess)
+
+
 # ------------------------------------------------------------------ q5
 
 
@@ -157,7 +177,8 @@ def run_dist_q5(params: Optional[dict] = None, *, transport=None
         transport = X.table_transport()
     rank, world = transport.rank, transport.world
     with _obs.TRACER.span("dist_q5", kind="query",
-                          attrs={"rank": rank, "world": world}):
+                          attrs={"rank": rank, "world": world}), \
+            _profiled("q5", rank, world):
         rows = max(int(p["rows"]) // (8 * world), 1) * 8 * world
         d = T.gen_q5(rows=rows, stores=p["stores"], days=p["days"])
         shard_args = tuple(
@@ -239,7 +260,8 @@ def run_dist_q72(params: Optional[dict] = None, *, transport=None
         transport = X.table_transport()
     rank, world = transport.rank, transport.world
     with _obs.TRACER.span("dist_q72", kind="query",
-                          attrs={"rank": rank, "world": world}):
+                          attrs={"rank": rank, "world": world}), \
+            _profiled("q72", rank, world):
         cs_rows = max(int(p["cs_rows"]) // world, 1) * world
         d = T.gen_q72(cs_rows=cs_rows, inv_rows=p["inv_rows"],
                       items=p["items"], days=p["days"])
@@ -370,6 +392,26 @@ def main(argv=None) -> int:
                                       transport=service)
             np.savez(os.path.join(
                 outdir, f"result_{op}_rank{rank}.npz"), **result)
+            if obs.PROFILER.enabled:
+                prof = obs.PROFILER.last()
+                if prof is not None:
+                    dump_via(
+                        os.path.join(
+                            outdir,
+                            f"profile_{op}_rank{rank}.json"),
+                        lambda f, p=prof: f.write(
+                            json.dumps(p, sort_keys=True,
+                                       default=str)))
+                    # same-moment registry snapshot: the profile's
+                    # link-byte deltas reconcile exactly against
+                    # THIS dump (the final metrics_rank dump also
+                    # counts post-query barrier traffic)
+                    dump_via(
+                        os.path.join(
+                            outdir,
+                            f"metrics_{op}_rank{rank}.json"),
+                        lambda f: f.write(
+                            obs.METRICS.snapshot_json()))
         service.barrier(OpIds.BARRIER)
     except Exception as e:  # noqa: BLE001 — report, then nonzero exit
         rc = 1

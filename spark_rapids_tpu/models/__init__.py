@@ -246,6 +246,69 @@ def _run_q7(params: dict, ctx: QueryContext):
     return _rows(*q(d))
 
 
+# stage-IR variants (plan/catalog.py, ISSUE 13): the SAME queries
+# compiled through the whole-stage fusion compiler — byte-identical
+# to the hand-fused twins by the PR-11 contract, but every execution
+# reports typed per-stage records to the query profiler, so a server
+# tenant submitting these gets a real EXPLAIN ANALYZE plan tree.
+# (The hand-fused entries stay untouched as the byte-identity
+# oracles; the compiler memoizes CompiledStage per plan digest, so no
+# _pipeline cache layer is needed here.)
+
+
+def _run_q5_fused(params: dict, ctx: QueryContext):
+    import numpy as np
+
+    from spark_rapids_tpu.models import tpcds
+    from spark_rapids_tpu.plan import catalog as plan_catalog
+    ctx.check_cancel()
+    rows = int(params.get("rows", 2048))
+    stores = int(params.get("stores", 8))
+    seed = int(params.get("seed", 5))
+    cap = int(params.get("join_capacity", 1 << 12))
+    d = tpcds.gen_q5(rows=rows, stores=stores, days=60, seed=seed)
+    k, sales, rets, profit, of = plan_catalog.run_q5(d, stores, cap)
+    if bool(np.asarray(of)):
+        raise RuntimeError("q5 join capacity overflow")
+    return _rows(k, sales, rets, profit)
+
+
+def _run_q3_fused(params: dict, ctx: QueryContext):
+    from spark_rapids_tpu.models import tpcds
+    from spark_rapids_tpu.plan import catalog as plan_catalog
+    ctx.check_cancel()
+    rows = int(params.get("rows", 2048))
+    items = int(params.get("items", 128))
+    brands = int(params.get("brands", 16))
+    manufact = int(params.get("manufact", 3))
+    seed = int(params.get("seed", 3))
+    d = tpcds.gen_q3(rows=rows, items=items, days=730, brands=brands,
+                     seed=seed)
+    year, brand, sums, total = plan_catalog.run_q3(
+        d, 10_957, years=2, brands=brands, manufact=manufact)
+    return _rows(year, brand, sums) + [[int(total)]]
+
+
+def _run_q72_fused(params: dict, ctx: QueryContext):
+    import numpy as np
+
+    from spark_rapids_tpu.models import tpcds
+    from spark_rapids_tpu.plan import catalog as plan_catalog
+    ctx.check_cancel()
+    rows = int(params.get("rows", 2048))
+    items = int(params.get("items", 64))
+    max_week = int(params.get("max_week", 16))
+    seed = int(params.get("seed", 72))
+    cap = int(params.get("join_capacity", 1 << 17))
+    d = tpcds.gen_q72(cs_rows=rows, inv_rows=rows // 2, items=items,
+                      days=35, seed=seed)
+    i, w, c, of = plan_catalog.run_q72(d, items, max_week, cap,
+                                       week0=11_000 // 7)
+    if bool(np.asarray(of)):
+        raise RuntimeError("q72 join capacity overflow")
+    return _rows(i, w, c)
+
+
 # file-backed variants (models/filesource.py): same seeded data via a
 # parquet round trip through io/parquet_reader, same cached pipeline,
 # byte-identical rows — registered thin so pyarrow loads on first use
@@ -269,6 +332,9 @@ register_query("tpcds_q5", _run_q5)
 register_query("tpcds_q7", _run_q7)
 register_query("tpcds_q9", _run_q9)
 register_query("tpcds_q72", _run_q72)
+register_query("tpcds_q3_fused", _run_q3_fused)
+register_query("tpcds_q5_fused", _run_q5_fused)
+register_query("tpcds_q72_fused", _run_q72_fused)
 register_query("tpcds_q3_file", _run_q3_file)
 register_query("tpcds_q7_file", _run_q7_file)
 register_query("tpcds_q9_file", _run_q9_file)

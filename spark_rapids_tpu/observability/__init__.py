@@ -32,6 +32,8 @@ from typing import Callable, Optional
 from spark_rapids_tpu.observability import flight_recorder as _fr
 from spark_rapids_tpu.observability.dumpio import dump_via
 from spark_rapids_tpu.observability.journal import EventJournal
+from spark_rapids_tpu.observability.profile import (  # noqa: F401
+    QueryProfiler, diff_profiles, merge_profiles)
 from spark_rapids_tpu.observability.registry import (
     DEFAULT_LATENCY_BUCKETS_NS, MetricsRegistry)
 from spark_rapids_tpu.observability.task_metrics import (
@@ -106,6 +108,7 @@ def reset() -> None:
     with _BLOCK_SPANS_LOCK:
         _BLOCK_SPANS.clear()
     TRACER.reset()
+    PROFILER.reset()
 
 
 # --------------------------------------------------------------- instruments
@@ -303,6 +306,20 @@ LOCKDEP_BLOCKING = METRICS.counter(
     "srt_lockdep_blocking_total",
     "Instrumented locks observed held across a known blocking call "
     "(socket send/recv, storage range read)", labels=("op",))
+PROFILE_QUERIES = METRICS.counter(
+    "srt_profile_queries_total",
+    "Per-query profiles assembled at query end (EXPLAIN ANALYZE "
+    "artifacts), by tenant", labels=("tenant",), max_series=128)
+PROFILE_ASSEMBLY = METRICS.histogram(
+    "srt_profile_assembly_ns",
+    "Wall time spent assembling one query profile at query end "
+    "(the cost the profiling switch buys)",
+    buckets=DEFAULT_LATENCY_BUCKETS_NS)
+PROFILE_DROPPED = METRICS.counter(
+    "srt_profile_dropped_total",
+    "Profile sessions dropped instead of assembled (nested begin, "
+    "stage record with no session, assembly error)",
+    labels=("reason",))
 
 
 # ------------------------------------------------------------------ tracer
@@ -329,6 +346,55 @@ def _on_span_finish(rec: dict) -> None:
 TRACER = Tracer(capacity=65536,
                 task_lookup=lambda: TASKS.tasks_for(),
                 on_finish=_on_span_finish)
+
+
+# ------------------------------------------------------- query profiler
+# EXPLAIN ANALYZE for every query (ISSUE 13 tentpole): per-query
+# artifacts assembled at query end from the rings above.  Independent
+# switch with the tracer's noop discipline — profiling off costs one
+# attribute read per hook.
+
+
+def _on_profile(profile: dict, assembly_ns: int) -> None:
+    if not _SWITCH.enabled:
+        return
+    PROFILE_QUERIES.inc(labels=(profile.get("tenant") or "-",))
+    PROFILE_ASSEMBLY.observe(assembly_ns)
+    JOURNAL.emit("query_profile", query_id=profile.get("query_id"),
+                 tenant=profile.get("tenant"),
+                 query=profile.get("query"),
+                 wall_ns=profile.get("wall_ns"),
+                 stages=len(profile.get("stages") or ()),
+                 hot_stage=profile.get("hot_stage"))
+
+
+def _profile_keep() -> int:
+    try:
+        return int(os.environ.get("SPARK_RAPIDS_TPU_PROFILE_KEEP", "")
+                   or 16)
+    except ValueError:
+        return 16
+
+
+PROFILER = QueryProfiler(
+    journal=JOURNAL, tasks=TASKS, tracer=TRACER, registry=METRICS,
+    keep=_profile_keep(), on_profile=_on_profile,
+    on_drop=lambda reason: PROFILE_DROPPED.inc(labels=(reason,)))
+
+
+def enable_profiling() -> None:
+    """Turn on per-query profile assembly (independent of the metrics
+    and tracing switches; profile counters additionally require the
+    metrics switch, trace-scoped span stats require tracing)."""
+    PROFILER.enabled = True
+
+
+def disable_profiling() -> None:
+    PROFILER.enabled = False
+
+
+def is_profiling_enabled() -> bool:
+    return PROFILER.enabled
 
 
 # -------------------------------------------------------- flight recorder
@@ -837,6 +903,7 @@ def health() -> dict:
         "journal": {"events": len(JOURNAL), "dropped": JOURNAL.dropped},
         "spans": {"finished": len(TRACER), "dropped": TRACER.dropped},
         "flight_recorder": FLIGHT.stats(),
+        "profiler": PROFILER.stats(),
     }
     try:
         from spark_rapids_tpu.memory import rmm_spark
@@ -892,3 +959,5 @@ if os.environ.get("SPARK_RAPIDS_TPU_METRICS", "") not in ("", "0"):
     enable()
 if os.environ.get("SPARK_RAPIDS_TPU_TRACE", "") not in ("", "0"):
     enable_tracing()
+if os.environ.get("SPARK_RAPIDS_TPU_PROFILE", "") not in ("", "0"):
+    enable_profiling()

@@ -371,6 +371,18 @@ class FlightRecorder:
         except Exception:
             files["fault_rules.json"] = "[]"
 
+        # ISSUE 13: the most recent per-query profile rides every
+        # bundle so srt-doctor can name the slowest plan node, not
+        # just the slowest thread.  Only written when one exists —
+        # a profiler-off process keeps its bundle layout unchanged.
+        try:
+            prof = obs.PROFILER.last()
+            if prof is not None:
+                files["profile.json"] = json.dumps(
+                    prof, indent=2, sort_keys=True, default=str)
+        except Exception:
+            pass   # a malformed profile must not block the bundle
+
         files["env.json"] = json.dumps(self._env_fingerprint(),
                                        indent=2, sort_keys=True)
         return files

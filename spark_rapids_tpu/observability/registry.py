@@ -313,5 +313,13 @@ class MetricsRegistry:
             fams = sorted(self._families.items())
         return {name: f.snapshot() for name, f in fams}
 
+    def family_snapshot(self, name: str) -> Optional[dict]:
+        """One family's snapshot (None when unregistered) — consumers
+        that diff a handful of named families (the query profiler)
+        must not pay a whole-registry walk per read."""
+        with self._lock:
+            f = self._families.get(name)
+        return f.snapshot() if f is not None else None
+
     def snapshot_json(self) -> str:
         return json.dumps(self.snapshot(), sort_keys=True)

@@ -453,7 +453,49 @@ class CompiledStage:
             self.plan.name, outcome, digest=digest,
             wall_ns=wall_ns, nodes=self.dispatch_count,
             compiled=compiled)
+        # query-profile feed (ISSUE 13): one structured record per
+        # stage execution while the calling thread profiles a query.
+        # active() is one attribute read when profiling is off — the
+        # record dict (node descriptors, pad-waste) is never built
+        if _obs.PROFILER.active():
+            _obs.PROFILER.note_stage(self._profile_record(
+                inputs, digest=digest, engine=outcome,
+                wall_ns=wall_ns, compiled=compiled))
         return out
+
+    def _profile_record(self, inputs, *, digest: str, engine: str,
+                        wall_ns, compiled: bool) -> dict:
+        """The typed per-stage profile row: plan structure (node
+        kinds + outputs), per-input rows/bucket/pad-waste, engine,
+        wall, compile-vs-cache-hit, dispatch count."""
+        import numpy as np
+
+        from spark_rapids_tpu.perf.jit_cache import bucket_rows
+        ins = []
+        for inp in self.plan.inputs:
+            arrs = inputs.get(inp.name)
+            if not arrs:
+                continue
+            shape = np.shape(arrs[0])
+            rows = int(shape[0]) if shape else 0
+            bucket = bucket_rows(rows) if inp.bucket else rows
+            ins.append({"name": inp.name, "rows": rows,
+                        "bucket": bucket,
+                        "pad_rows": max(bucket - rows, 0)})
+        return {
+            "stage": self.plan.name,
+            "digest": digest,
+            "engine": ("unfused" if engine == "unfused" else "fused"),
+            "compiled": bool(compiled),
+            "wall_ns": int(wall_ns or 0),
+            "dispatches": (self.dispatch_count
+                           if engine == "unfused" else 1),
+            "nodes_total": self.dispatch_count,
+            "nodes": [{"kind": type(n).__name__,
+                       "outs": list(n.outs())}
+                      for n in self.plan.nodes],
+            "inputs": ins,
+        }
 
     def _calibration_sample(self, inputs):
         """Row-slice oversized bucketed inputs for the measurement

@@ -18,6 +18,9 @@ per line, one response per line:
     {"op": "stats"}
     -> {"ok": true, "stats": {...}}
 
+    {"op": "profile", "query_id": "q-000001"}
+    -> {"ok": true, "profile": {...}}     # EXPLAIN ANALYZE artifact
+
     {"op": "drain", "deadline_s": 30}
     -> {"ok": true, "report": {"state": "drained", ...}}
 
@@ -234,6 +237,18 @@ class SocketFrontDoor:
                     str(req.get("query_id", "")))}
             if op == "stats":
                 return {"ok": True, "stats": self.server.stats()}
+            if op == "profile":
+                qid = str(req.get("query_id", ""))
+                prof = self.server.profile(qid)
+                if prof is None:
+                    return {"ok": False,
+                            "error": {"type": "UnknownProfile",
+                                      "message": f"no retained "
+                                                 f"profile for "
+                                                 f"{qid!r} (never "
+                                                 "profiled, or "
+                                                 "evicted)"}}
+                return {"ok": True, "profile": prof}
             if op == "drain":
                 deadline = req.get("deadline_s")
                 kw = {"deadline_s": float(deadline)

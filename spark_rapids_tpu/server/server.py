@@ -133,6 +133,8 @@ class ServerConfig:
     quarantine_failures: int = 3       # deaths before quarantine; 0=off
     quarantine_cooldown_s: float = 30.0  # first open; doubles, cap 8x
     drain_deadline_s: float = 30.0     # in-flight budget for drain()
+    profile_keep: int = 8              # last-K query profiles retained
+    #                                    per tenant (0 = no retention)
 
     @classmethod
     def from_env(cls) -> "ServerConfig":
@@ -155,6 +157,7 @@ class ServerConfig:
             quarantine_cooldown_s=_env_float(
                 p + "QUARANTINE_COOLDOWN_S", 30.0),
             drain_deadline_s=_env_float(p + "DRAIN_DEADLINE_S", 30.0),
+            profile_keep=_env_int(p + "PROFILE_KEEP", 8),
         )
 
 
@@ -208,6 +211,15 @@ class QueryServer:
         self._quarantine = lifeguard.QuarantineBreaker(
             failures=self.config.quarantine_failures,
             cooldown_s=self.config.quarantine_cooldown_s)
+        # last-K query profiles per tenant (ISSUE 13): the EXPLAIN
+        # ANALYZE artifacts the profiler assembles at query end stay
+        # pollable by query id until their tenant's window evicts
+        # them.  Tenant COUNT is bounded too (LRU by last retain):
+        # a client looping fresh tenant strings must recycle whole
+        # tenant windows, not grow resident profile state forever
+        self._profiles: Dict[str, dict] = {}
+        self._profile_order: "collections.OrderedDict[str, collections.deque]" = \
+            collections.OrderedDict()
         self._watchdog = lifeguard.Watchdog(
             self._lifeguard_scan, self.config.watchdog_interval_s)
 
@@ -572,7 +584,20 @@ class QueryServer:
                            "query_id": job.query_id,
                            "server_task_id": job.task_id,
                            "demotions": job.demotions}):
-                result = self._runner(job.query, job.params, ctx)
+                # profile session INSIDE the query-root span (begin
+                # captures the root trace context) and around the
+                # runner only — queue wait is the server's story, the
+                # profile's wall is the execution.  One attribute
+                # read when SPARK_RAPIDS_TPU_PROFILE is off.
+                psess = _obs.PROFILER.begin(
+                    job.query_id, tenant=job.tenant, query=job.query)
+                try:
+                    result = self._runner(job.query, job.params, ctx)
+                finally:
+                    prof = _obs.PROFILER.end(psess)
+                    if prof is not None:
+                        self._retain_profile(job.tenant,
+                                             job.query_id, prof)
         except QueryCancelled as e:
             if isinstance(e, QueryDeadlineExceeded) \
                     and job.cancel_reason is None:
@@ -632,6 +657,45 @@ class QueryServer:
             _obs.set_server_tenant_gauges(
                 {}, {}, {},
                 {job.tenant: self._tenant_device_bytes(job.tenant)})
+
+    # ----------------------------------------------------- query profiles
+
+    def _retain_profile(self, tenant: str, query_id: str,
+                        profile: dict) -> None:
+        """Retain one finished query's profile under its tenant's
+        last-K window (oldest evicted; ``profile_keep=0`` disables
+        retention entirely).  Dict bookkeeping only — the lock never
+        covers profile assembly."""
+        keep = self.config.profile_keep
+        if keep <= 0:
+            return
+        with self._lock:
+            dq = self._profile_order.get(tenant)
+            if dq is None:
+                dq = self._profile_order[tenant] = collections.deque()
+            else:
+                self._profile_order.move_to_end(tenant)
+            dq.append(query_id)
+            self._profiles[query_id] = profile
+            while len(dq) > keep:
+                self._profiles.pop(dq.popleft(), None)
+            while len(self._profile_order) > self._MAX_TENANT_ROWS:
+                _t, old = self._profile_order.popitem(last=False)
+                for qid in old:
+                    self._profiles.pop(qid, None)
+
+    def profile(self, query_id: str) -> Optional[dict]:
+        """The retained EXPLAIN ANALYZE artifact for ``query_id``, or
+        None (never profiled, or evicted by its tenant's window)."""
+        with self._lock:
+            return self._profiles.get(str(query_id))
+
+    def profile_ids(self, tenant: str) -> list:
+        """Retained profile query-ids for one tenant, oldest first."""
+        with self._lock:
+            dq = self._profile_order.get(str(tenant))
+            return [q for q in dq if q in self._profiles] \
+                if dq else []
 
     # ------------------------------------------------------------ lifeguard
 

@@ -249,6 +249,57 @@ def tracing_reset() -> None:
     obs.TRACER.reset()
 
 
+# ------------------------------------------------------- query profiles
+# (EXPLAIN ANALYZE control surface: the JVM flips profiling around a
+# workload, then pulls per-query artifacts by id — reference analog:
+# the profiler sidecar's capture window + profile_converter pull)
+
+
+def profile_set_enabled(enabled: bool) -> bool:
+    """Flip per-query profile assembly; returns prior state."""
+    from spark_rapids_tpu import observability as obs
+    prior = obs.is_profiling_enabled()
+    (obs.enable_profiling if enabled else obs.disable_profiling)()
+    return prior
+
+
+def profile_enabled() -> bool:
+    from spark_rapids_tpu import observability as obs
+    return obs.is_profiling_enabled()
+
+
+def profile_last_json() -> str:
+    """Most recently assembled query profile as JSON ('' when none
+    has been assembled yet)."""
+    import json
+
+    from spark_rapids_tpu import observability as obs
+    prof = obs.PROFILER.last()
+    return json.dumps(prof, sort_keys=True, default=str) \
+        if prof is not None else ""
+
+
+def server_profile_json(query_id: str) -> str:
+    """The server-retained profile for one query id as JSON —
+    ``{"ok": true, "profile": {...}}`` or a typed miss (never
+    profiled / evicted by the tenant's last-K window)."""
+    import json
+
+    from spark_rapids_tpu import server as srv
+    s = srv.get_server()
+    if s is None:
+        raise RuntimeError("query server is not running")
+    prof = s.profile(str(query_id))
+    if prof is None:
+        return json.dumps({"ok": False,
+                           "error": {"type": "UnknownProfile",
+                                     "message": f"no retained "
+                                                f"profile for "
+                                                f"{query_id!r}"}})
+    return json.dumps({"ok": True, "profile": prof},
+                      sort_keys=True, default=str)
+
+
 # ------------------------------------------------------ flight recorder
 # (reference: the CUPTI profiler dump + RmmSpark state dump the JVM
 # pulls on failure; here the JVM arms the recorder, forces bundles,

@@ -774,6 +774,26 @@ def tracing_reset() -> None:
     jni_api.tracing_reset()
 
 
+def profile_set_enabled(enabled: bool) -> bool:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.profile_set_enabled(bool(enabled))
+
+
+def profile_enabled() -> bool:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.profile_enabled()
+
+
+def profile_last_json() -> str:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.profile_last_json()
+
+
+def server_profile_json(query_id: str) -> str:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.server_profile_json(str(query_id))
+
+
 def flight_recorder_set_enabled(enabled: bool) -> bool:
     from spark_rapids_tpu.shim import jni_api
     return jni_api.flight_recorder_set_enabled(bool(enabled))

@@ -30,18 +30,20 @@ def read_jsonl(path: str) -> List[dict]:
     return records
 
 # flight-recorder bundle layout (observability/flight_recorder.py)
-_BUNDLE_FILES = {"spans": "spans.jsonl", "journal": "journal.jsonl"}
+_BUNDLE_FILES = {"spans": "spans.jsonl", "journal": "journal.jsonl",
+                 "profile": "profile.json"}
 
 
 def expand_bundle_input(path: str, prefer: str) -> List[str]:
     """Let every JSONL-eating tool accept a flight-recorder incident
     bundle directory directly: a directory input resolves to the
-    bundle file matching ``prefer`` ("spans" or "journal").  Only the
-    spans consumer may fall back to journal.jsonl (span records also
-    ride the journal dump); the reverse would hand the metrics report
-    a spans-only file it silently renders empty, so a bundle without
-    its journal fails loudly instead.  Non-directory inputs pass
-    through untouched."""
+    bundle file matching ``prefer`` ("spans", "journal" or
+    "profile").  Only the spans consumer may fall back to
+    journal.jsonl (span records also ride the journal dump); the
+    reverse would hand the metrics report a spans-only file it
+    silently renders empty, and a bundle frozen before any query was
+    profiled has no profile.json at all — both fail loudly instead.
+    Non-directory inputs pass through untouched."""
     if not os.path.isdir(path):
         return [path]
     want = _BUNDLE_FILES[prefer]

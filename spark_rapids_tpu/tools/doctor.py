@@ -109,6 +109,11 @@ class Bundle:
         if not self.spans:  # fall back to span records in the journal
             self.spans = [r for r in self.journal
                           if r.get("kind") == "span"]
+        # ISSUE 13: the most recent per-query EXPLAIN ANALYZE
+        # artifact rides the bundle — the "slowest plan node"
+        # evidence plane (absent in profiler-off processes)
+        self.profile = _load_json(os.path.join(path, "profile.json"),
+                                  {})
 
 
 def is_bundle_dir(path: str) -> bool:
@@ -475,6 +480,34 @@ def analyze(bundle: Bundle) -> List[dict]:
                             f"({p99 / 1e6:.1f} ms vs "
                             f"{p50 / 1e6:.1f} ms over {len(xs)} "
                             f"spans)")})
+
+    # ---- slowest plan node from the frozen query profile ------------
+    prof = bundle.profile or {}
+    pstages = prof.get("stages") or []
+    if pstages:
+        hot = max(pstages, key=lambda s: int(s.get("wall_ns", 0)))
+        wall = int(prof.get("wall_ns", 0))
+        stage_ns = int(hot.get("wall_ns", 0))
+        pct = (f" ({100 * stage_ns // wall}% of the "
+               f"{wall / 1e6:.1f} ms query wall)" if wall else "")
+        heavy = ""
+        kinds = {}
+        for n in hot.get("nodes") or ():
+            k = str(n.get("kind", "?"))
+            kinds[k] = kinds.get(k, 0) + 1
+        if kinds:
+            top = sorted(kinds.items(), key=lambda kv: -kv[1])[:3]
+            heavy = ("; nodes: "
+                     + ", ".join(f"{k} x{v}" for k, v in top))
+        findings.append({
+            "severity": 58, "kind": "slow_plan_node",
+            "message": (f"slowest plan node: stage "
+                        f"{hot.get('stage')!r} "
+                        f"[{hot.get('engine', '?')}] "
+                        f"{stage_ns / 1e6:.1f} ms{pct} in query "
+                        f"{prof.get('query_id')!r} "
+                        f"({prof.get('query') or '?'})"
+                        f"{heavy}")})
 
     # ---- retry pressure short of the trigger ------------------------
     episodes = [r for r in bundle.journal

@@ -104,20 +104,36 @@ def histogram_rows(registry: Optional[dict]) -> List[dict]:
     return rows
 
 
+def empty_histogram_families(registry: Optional[dict]) -> List[str]:
+    """Histogram families present in the snapshot with NO counted
+    series (registered but never fired)."""
+    out = []
+    for name, fam in sorted((registry or {}).items()):
+        if fam.get("kind") != "histogram":
+            continue
+        if not any(s.get("count") for s in fam.get("series", [])):
+            out.append(name)
+    return out
+
+
 def render_histogram_table(registry: Optional[dict]) -> List[str]:
     """Latency-distribution table: one row per histogram series —
-    op-latency and the span-duration families both land here."""
+    op-latency and the span-duration families both land here.
+    Families that exist but never fired render as '-' rows instead of
+    vanishing, so a golden diff over two runs stays stable when a
+    family is registered in one and fired only in the other."""
     rows = histogram_rows(registry)
+    empty = empty_histogram_families(registry)
     out = ["", "latency histograms (p50/p95/p99 estimated from buckets)",
            ""]
-    if not rows:
+    if not rows and not empty:
         out.append("(no histogram series recorded)")
         return out
     names = ["{}{{{}}}".format(
         r["family"],
         ",".join(f"{k}={v}" for k, v in r["labels"].items()))
         if r["labels"] else r["family"] for r in rows]
-    w = max(len(n) for n in names)
+    w = max(len(n) for n in names + empty)
     out.append(f"{'series':<{w}}  {'count':>7}  {'p50_us':>9}  "
                f"{'p95_us':>9}  {'p99_us':>9}  {'total_ms':>10}")
     order = sorted(range(len(rows)),
@@ -129,6 +145,9 @@ def render_histogram_table(registry: Optional[dict]) -> List[str]:
                    f"{r['p95_ns'] / 1e3:>9.1f}  "
                    f"{r['p99_ns'] / 1e3:>9.1f}  "
                    f"{_ms(r['sum_ns']):>10}")
+    for name in empty:   # stable alphabetical tail after live rows
+        out.append(f"{name:<{w}}  {'-':>7}  {'-':>9}  {'-':>9}  "
+                   f"{'-':>9}  {'-':>10}")
     return out
 
 

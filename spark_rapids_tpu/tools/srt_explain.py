@@ -1,0 +1,297 @@
+"""``srt-explain``: render per-query EXPLAIN ANALYZE profiles
+(ISSUE 13 — the analyst-facing half of observability/profile.py; the
+reference's counterpart is the profiler sidecar's
+``profile_converter`` text mode).
+
+Input: one or more profile JSON files — written by the query server's
+``profile`` door, the distributed runner's ``profile_<op>_rank<r>.json``
+dumps, or frozen into a flight-recorder bundle as ``profile.json`` (a
+bundle directory is accepted directly).  MULTIPLE inputs merge into
+ONE fleet profile via :func:`observability.profile.merge_profiles`:
+per-stage wall is the max over ranks (the critical path) and the
+per-rank walls render as a skew table.
+
+Output: the plan tree with per-stage attribution — wall ns, engine
+(fused/unfused), compile-vs-cache-hit, dispatch count, per-input
+rows/bucket/pad-waste — with the hot path highlighted, plus the
+task-scoped op deltas, retry/OOM episodes, per-peer shuffle-link
+bytes and jit-cache activity the profiler folded in.
+
+``--diff BASELINE`` compares per-stage mean walls against a baseline
+profile and EXITS NONZERO when any stage regressed beyond
+``--threshold`` — the per-node guardrail the bench-trajectory BENCH_*
+files cannot give.
+
+Usage:
+    python -m spark_rapids_tpu.tools.srt_explain PROFILE.json \
+        [more_rank_profiles.json ...] [--nodes] [--json] \
+        [--diff BASELINE.profile.json] [--threshold 1.5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+from spark_rapids_tpu.observability.profile import (diff_profiles,
+                                                    merge_profiles)
+
+
+def load_profiles(paths) -> List[dict]:
+    """One profile dict per input path; a flight-recorder bundle
+    directory stands in for its ``profile.json``."""
+    from spark_rapids_tpu.tools import expand_bundle_input
+
+    out: List[dict] = []
+    for p0 in paths:
+        for p in expand_bundle_input(p0, "profile"):
+            with open(p) as f:
+                prof = json.load(f)
+            if not isinstance(prof, dict) or "stages" not in prof:
+                raise ValueError(f"{p}: not a query profile "
+                                 f"(no 'stages')")
+            out.append(prof)
+    return out
+
+
+# ---------------------------------------------------------------- render
+
+
+def _ms(ns) -> str:
+    return f"{(ns or 0) / 1e6:.3f}"
+
+
+def _kb(n) -> str:
+    n = int(n)
+    if n >= 1 << 20:
+        return f"{n / (1 << 20):.1f}MiB"
+    if n >= 1 << 10:
+        return f"{n / (1 << 10):.1f}KiB"
+    return f"{n}B"
+
+
+def _node_summary(nodes: List[dict]) -> str:
+    counts: Dict[str, int] = {}
+    for n in nodes or ():
+        k = str(n.get("kind", "?"))
+        counts[k] = counts.get(k, 0) + 1
+    return ", ".join(f"{k} x{v}" if v > 1 else k
+                     for k, v in sorted(counts.items()))
+
+
+def _input_summary(inputs: List[dict]) -> str:
+    parts = []
+    for i in inputs or ():
+        s = f"{i.get('name', '?')} rows={i.get('rows', 0)}"
+        pad = int(i.get("pad_rows", 0))
+        if pad:
+            s += f"/{i.get('bucket', 0)} pad={pad}"
+        parts.append(s)
+    return "; ".join(parts)
+
+
+def render_profile(profile: dict, *, nodes: bool = False
+                   ) -> List[str]:
+    """The EXPLAIN ANALYZE tree as text lines.  Purely
+    profile-derived (no "now" stamps): the same artifact always
+    renders the same text — the golden test holds the CLI to that."""
+    out: List[str] = []
+    fleet = bool(profile.get("fleet"))
+    head = (f"srt-explain: {profile.get('query') or '?'}"
+            f"  (query_id {profile.get('query_id') or '?'}"
+            + (f", tenant {profile['tenant']}"
+               if profile.get("tenant") else "")
+            + (f", trace {profile['trace_id']}"
+               if profile.get("trace_id") else "") + ")")
+    out.append(head)
+    if fleet:
+        out.append(
+            f"fleet: world={profile.get('world')} "
+            f"ranks={profile.get('ranks')}  trace "
+            + ("consistent" if profile.get("trace_consistent")
+               else "UNVERIFIED — inputs may be unrelated runs"))
+    stages = profile.get("stages") or []
+    out.append(f"wall {_ms(profile.get('wall_ns'))} ms"
+               + (" (max over ranks)" if fleet else "")
+               + f"   stages {len(stages)}"
+               + (f"   hot {profile['hot_stage']}"
+                  if profile.get("hot_stage") else ""))
+    wall = max(int(profile.get("wall_ns") or 0), 1)
+    hot = profile.get("hot_stage")
+    out.append("plan tree (stage-IR attribution):")
+    for s in stages:
+        tags = [str(s.get("engine", "?"))]
+        if s.get("compiled"):
+            tags.append("compiled")
+        else:
+            tags.append("cache-hit")
+        calls = int(s.get("calls", 1))
+        tags.append(f"{int(s.get('dispatches', 0))} dispatch / "
+                    f"{int(s.get('nodes_total', 0))} nodes")
+        if calls > 1:
+            tags.append(f"{calls} calls")
+        pct = min(100 * int(s.get("wall_ns", 0)) // wall, 100)
+        line = (f"  {s.get('stage', '?'):<16} "
+                f"[{', '.join(tags)}]  "
+                f"{_ms(s.get('wall_ns')):>9} ms  ({pct:>2}%)")
+        if hot and s.get("stage") == hot:
+            line += "  <-- HOT"
+        out.append(line)
+        ins = _input_summary(s.get("inputs"))
+        if ins:
+            out.append(f"      inputs: {ins}")
+        summary = _node_summary(s.get("nodes"))
+        if summary:
+            out.append(f"      nodes: {summary}")
+        if nodes:
+            for n in s.get("nodes") or ():
+                out.append(f"        {n.get('kind', '?'):<12} -> "
+                           + ",".join(n.get("outs") or ()))
+        prw = s.get("per_rank_wall_ns")
+        if prw:
+            ranks = " ".join(f"r{r}={_ms(w)}ms"
+                             for r, w in sorted(
+                                 prw.items(),
+                                 key=lambda kv: int(kv[0])))
+            out.append(f"      per-rank: {ranks}")
+    # ---- skew table (fleet merges only) ----------------------------
+    skew = profile.get("skew") or []
+    worst = [r for r in skew if r.get("skew_ratio")
+             and r["skew_ratio"] > 1.0]
+    if worst:
+        out.append("rank skew (max/min wall per stage):")
+        for r in sorted(worst, key=lambda r: -r["skew_ratio"]):
+            out.append(f"  {r.get('stage', '?'):<16} "
+                       f"x{r['skew_ratio']:.2f}  "
+                       f"(max {_ms(r.get('max_wall_ns'))} ms, "
+                       f"min {_ms(r.get('min_wall_ns'))} ms)")
+    # ---- cross-cutting sections ------------------------------------
+    links = profile.get("shuffle_links") or {}
+    if links.get("bytes"):
+        parts = []
+        for direction in ("send", "recv"):
+            for peer, n in sorted(
+                    (links["bytes"].get(direction) or {}).items()):
+                parts.append(f"{direction}[{peer}]={_kb(n)}")
+        if parts:
+            out.append("shuffle links: " + "  ".join(parts))
+    if links.get("per_rank"):
+        for rank, rl in sorted(links["per_rank"].items(),
+                               key=lambda kv: int(kv[0])):
+            parts = []
+            for direction in ("send", "recv"):
+                for peer, n in sorted(
+                        ((rl.get("bytes") or {}).get(direction)
+                         or {}).items()):
+                    parts.append(f"{direction}[{peer}]={_kb(n)}")
+            if parts:
+                out.append(f"shuffle links r{rank}: "
+                           + "  ".join(parts))
+    ops = profile.get("ops") or {}
+    if ops:
+        top = sorted(ops.items(),
+                     key=lambda kv: -kv[1].get("time_ns", 0))[:8]
+        out.append("task-scoped ops: " + "  ".join(
+            f"{op}={_ms(o.get('time_ns'))}ms/{o.get('calls', 0)}"
+            for op, o in top))
+    r = profile.get("retries") or {}
+    o = profile.get("oom") or {}
+    if r.get("episodes") or o.get("retry") or o.get("split_retry") \
+            or o.get("blocked_ns"):
+        out.append(
+            f"retries: {r.get('episodes', 0)} episodes "
+            f"({r.get('attempts', 0)} attempts, "
+            f"{r.get('splits', 0)} splits, "
+            f"{_ms(r.get('lost_ns'))} ms lost)   "
+            f"oom: {o.get('retry', 0)} retry / "
+            f"{o.get('split_retry', 0)} split, blocked "
+            f"{_ms(o.get('blocked_ns'))} ms")
+    kp = profile.get("kernel_paths") or {}
+    if kp:
+        out.append("kernel paths: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(kp.items())))
+    jit = profile.get("jit") or {}
+    if jit:
+        out.append("jit cache: " + "  ".join(
+            f"{k}(hits={d.get('hits', 0)},misses={d.get('misses', 0)})"
+            for k, d in sorted(jit.items())))
+    spans = profile.get("spans") or {}
+    if spans.get("count"):
+        kinds = " ".join(f"{k}={v}" for k, v in
+                         sorted(spans.get("by_kind", {}).items()))
+        out.append(f"trace-scoped spans: {spans['count']} ({kinds})")
+    return out
+
+
+def render_diff(findings: List[dict], threshold: float) -> List[str]:
+    out = []
+    if not findings:
+        out.append(f"diff: no per-stage regression beyond "
+                   f"x{threshold}")
+        return out
+    out.append(f"diff: {len(findings)} stage(s) regressed beyond "
+               f"x{threshold}:")
+    for f in findings:
+        out.append(f"  {f['stage']:<16} x{f['ratio']:.2f}  "
+                   f"({f['base_mean_ms']} ms -> "
+                   f"{f['cur_mean_ms']} ms)")
+    return out
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="srt-explain",
+        description="Render per-query EXPLAIN ANALYZE profiles "
+                    "(multiple rank profiles merge into one fleet "
+                    "profile)")
+    ap.add_argument("inputs", nargs="+",
+                    help="profile JSON files or flight-recorder "
+                         "bundle dirs")
+    ap.add_argument("--nodes", action="store_true",
+                    help="list every plan node under its stage")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the (merged) profile as JSON")
+    ap.add_argument("--diff", metavar="BASELINE", default=None,
+                    help="baseline profile (file or bundle dir); "
+                         "exits 1 on any per-stage regression")
+    ap.add_argument("--threshold", type=float, default=1.5,
+                    help="regression ratio threshold (default 1.5)")
+    ap.add_argument("--min-delta-ms", type=float, default=1.0,
+                    help="ignore regressions smaller than this "
+                         "absolute per-call delta (default 1 ms)")
+    args = ap.parse_args(argv)
+
+    try:
+        profiles = load_profiles(args.inputs)
+    except (OSError, ValueError) as e:
+        print(f"srt-explain: {e}", file=sys.stderr)
+        return 2
+    profile = merge_profiles(profiles)
+
+    if args.json:
+        print(json.dumps(profile, indent=2, sort_keys=True,
+                         default=str))
+    else:
+        print("\n".join(render_profile(profile, nodes=args.nodes)))
+
+    if args.diff:
+        try:
+            baseline = merge_profiles(load_profiles([args.diff]))
+        except (OSError, ValueError) as e:
+            print(f"srt-explain: --diff {e}", file=sys.stderr)
+            return 2
+        findings = diff_profiles(
+            baseline, profile, threshold=args.threshold,
+            min_delta_ns=int(args.min_delta_ms * 1e6))
+        print("\n".join(render_diff(findings, args.threshold)))
+        return 1 if findings else 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

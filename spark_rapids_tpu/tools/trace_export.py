@@ -87,6 +87,30 @@ def root_of(rec: dict, idx: Dict[str, dict],
     return rec
 
 
+def fusion_counts(files: List[Tuple[str, List[dict]]]
+                  ) -> Dict[str, Dict[str, int]]:
+    """Per-stage fused/unfused/compile dispatch counts read back from
+    ``srt_stage_fusion_total`` in any ``registry_snapshot`` record in
+    the inputs (journal dumps carry one; pure span dumps don't) —
+    a trace alone then shows whether fusion engaged.  Multiple input
+    files (one per process) sum."""
+    out: Dict[str, Dict[str, int]] = {}
+    for _path, records in files:
+        for r in records:
+            if r.get("kind") != "registry_snapshot":
+                continue
+            fam = (r.get("registry") or {}).get(
+                "srt_stage_fusion_total") or {}
+            for s in fam.get("series", []):
+                labels = s.get("labels") or ()
+                stage = labels[0] if len(labels) > 0 else "?"
+                outcome = labels[1] if len(labels) > 1 else "?"
+                row = out.setdefault(stage, {})
+                row[outcome] = row.get(outcome, 0) \
+                    + int(s.get("value", 0))
+    return out
+
+
 def trace_summary(span_records: List[dict]) -> Dict[str, dict]:
     """Per-trace_id rollup: span counts by kind, root names, orphan
     count — the --stats view and the smoke gate's assertion surface."""
@@ -206,6 +230,14 @@ def main(argv=None) -> int:
             roots = ",".join(t["roots"]) or "-"
             print(f"trace {tid_}: {t['spans']} spans  roots=[{roots}]  "
                   f"{kinds}  orphans={t['orphans']}")
+        fusion = fusion_counts(files)
+        if fusion:
+            print("stage fusion (srt_stage_fusion_total):")
+            for stage, row in sorted(fusion.items()):
+                cells = "  ".join(f"{k}={row[k]}"
+                                  for k in ("fused", "unfused",
+                                            "compile") if k in row)
+                print(f"  {stage}: {cells}")
         orphans = find_orphans(all_spans)
         if orphans:
             print(f"WARNING: {len(orphans)} orphan spans "
