@@ -8,7 +8,8 @@ PY ?= python
         jni-test kudo-bench metrics-smoke trace-smoke chaos-smoke \
         perf-smoke fusion-smoke doctor-smoke server-smoke \
         lifeguard-smoke ingest-smoke dist-smoke analysis-smoke \
-        profile-smoke nightly-artifacts ci ci-nightly clean
+        profile-smoke elastic-smoke nightly-artifacts ci ci-nightly \
+        clean
 
 # tier-1 set: slow-marked tests (the subprocess fleet twins of the
 # dist-smoke gate) are excluded here exactly like the driver's verify
@@ -162,6 +163,15 @@ analysis-smoke:
 profile-smoke:
 	$(PY) scripts/profile_smoke.py
 
+# elastic-fleet gate (ROADMAP item 3): 4-process q5 with one slow rank
+# (speculation must win) and one killed+respawned rank (survivors must
+# rebalance, the rejoined worker must converge by replay) — byte-
+# identical on every rank, evidence in metrics + journal, ONE stitched
+# trace, doctor naming the dead and slow ranks, plus the in-process
+# hot-partition re-split check
+elastic-smoke:
+	$(PY) scripts/elastic_smoke.py
+
 # NOTE: jax.config.update, not the env var — this image's sitecustomize
 # pre-imports jax with the axon backend, so JAX_PLATFORMS=cpu is too
 # late.  XLA_FLAGS still works (read at backend init, which happens
@@ -185,7 +195,7 @@ dryrun:
 ci: test fuzz native sanitizers tpu-lower jni-test dryrun metrics-smoke \
     trace-smoke chaos-smoke perf-smoke fusion-smoke doctor-smoke \
     server-smoke lifeguard-smoke ingest-smoke dist-smoke analysis-smoke \
-    profile-smoke
+    profile-smoke elastic-smoke
 	$(PY) bench.py
 	@echo "ci: all gates green"
 

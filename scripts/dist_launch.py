@@ -26,9 +26,19 @@ def main(argv=None) -> int:
     ap.add_argument("--transport", choices=("unix", "tcp"),
                     default="unix")
     ap.add_argument("--fault", default=None,
-                    help="link fault spec, e.g. corrupt:0:101 or "
-                         "trunc:0:102 (armed on --fault-rank)")
+                    help="link fault spec, e.g. corrupt:0:101, "
+                         "trunc:0:102, drop:0:121, slow:-1:2000 "
+                         "(armed on --fault-rank)")
     ap.add_argument("--fault-rank", type=int, default=1)
+    ap.add_argument("--die", default=None,
+                    help="injected worker death, e.g. q5:partials "
+                         "or boot (armed on --die-rank)")
+    ap.add_argument("--die-rank", type=int, default=2)
+    ap.add_argument("--elastic", action="store_true",
+                    help="elastic fleet protocol (rebalance/"
+                         "speculation/re-split)")
+    ap.add_argument("--respawn", action="store_true",
+                    help="respawn a dead rank once (elastic only)")
     ap.add_argument("--mesh", default="0",
                     help="SPARK_RAPIDS_TPU_DIST_MESH for workers "
                          "(0=harness, auto=attempt jax.distributed)")
@@ -44,11 +54,18 @@ def main(argv=None) -> int:
     from spark_rapids_tpu.distributed import launcher
 
     outdir = args.outdir or tempfile.mkdtemp(prefix="srt_dist_")
-    res = launcher.launch(
-        args.world, outdir, ops=tuple(args.ops.split(",")),
-        transport=args.transport, fault=args.fault,
-        fault_rank=args.fault_rank, mesh=args.mesh,
-        timeout_s=args.timeout_s, params=json.loads(args.params))
+    try:
+        res = launcher.launch(
+            args.world, outdir, ops=tuple(args.ops.split(",")),
+            transport=args.transport, fault=args.fault,
+            fault_rank=args.fault_rank, die=args.die,
+            die_rank=args.die_rank, elastic=args.elastic,
+            respawn=args.respawn, mesh=args.mesh,
+            timeout_s=args.timeout_s, params=json.loads(args.params))
+    except launcher.WorkerFailed as e:
+        # propagate the dead worker's OWN exit code immediately
+        print(f"dist_launch: {e}", file=sys.stderr)
+        return e.rc if e.rc else 1
     print(json.dumps(res, indent=1))
     return 0
 

@@ -1,0 +1,333 @@
+#!/usr/bin/env python
+"""Elastic-fleet smoke gate (`make elastic-smoke`, ISSUE 15
+acceptance — ROADMAP item-3 gate): a 4-process elastic q5 with one
+injected STRAGGLER (every frame from rank 1 delayed) and one injected
+DEATH (rank 2 exits after the scan, respawned by the launcher after a
+delay long enough that survivors OBSERVE the death) must finish
+
+  * byte-identical to the single-process answer on EVERY rank — the
+    respawned incarnation included (it rejoins, recomputes its own
+    shards, and catches up on the rest by CRC'd replay);
+  * with SPECULATION evidence: ``srt_fleet_speculations_total
+    {outcome="won"}`` >= 1 and ``fleet_speculation`` journal events;
+  * with REBALANCE evidence: ``srt_fleet_rebalances_total`` >= 1,
+    ``fleet_membership`` death events, and a ``fleet_inherit`` event
+    (the fleet-assigned inheritor recomputed the dead shard);
+  * with the duplicate-collapse contract visible:
+    ``srt_shuffle_dup_dropped_total`` >= 1 (speculation losers and
+    the respawned rank's replayed shards merged exactly once);
+  * in ONE stitched trace: a single trace id across the launcher and
+    every worker incarnation, exactly one ``dist_query`` root, zero
+    orphans — the respawned worker's spans land in the SAME tree;
+  * observable end to end: ``metrics_report --json`` exposes the
+    ``"fleet"`` table, and ``srt-doctor`` names the dead rank from
+    the real ``fleet_incident`` bundle and the slow rank from the
+    post-mortem journal merge.
+
+A second in-process section exercises the SKEW path: a hot partition
+re-splits into per-rank sub-frames and stitches back byte-identical,
+with ``srt_fleet_resplits_total`` evidence.  Exits non-zero on the
+first missing signal."""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+WORLD = 4
+SLOW_RANK = 1
+DIE_RANK = 2
+SLOW_MS = 2500
+SPEC_DELAY_S = "1.0"
+RESPAWN_DELAY_S = 20.0
+
+
+def fail(msg: str) -> "NoReturn":  # noqa: F821
+    print(f"elastic-smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def say(msg: str) -> None:
+    print(f"elastic-smoke: {msg}")
+
+
+def series_sum(snap, family, label=None):
+    total = 0
+    for s in snap.get(family, {}).get("series", []):
+        if label is None or label in s.get("labels", []):
+            total += s["value"]
+    return total
+
+
+def fleet_run(outdir: str) -> dict:
+    from spark_rapids_tpu.distributed import launcher
+
+    incidents = os.path.join(outdir, "incidents")
+    say(f"launching {WORLD}-process elastic fleet: rank {SLOW_RANK} "
+        f"slowed {SLOW_MS}ms/frame, rank {DIE_RANK} killed after "
+        f"scan (respawn in {RESPAWN_DELAY_S:.0f}s) -> {outdir}")
+    res = launcher.launch(
+        WORLD, outdir, ops=("q5",), elastic=True, respawn=True,
+        respawn_delay_s=RESPAWN_DELAY_S,
+        fault=f"slow:-1:{SLOW_MS}", fault_rank=SLOW_RANK,
+        die="q5:scan", die_rank=DIE_RANK,
+        worker_env={
+            "SPARK_RAPIDS_TPU_FLEET_SPEC_DELAY_S": SPEC_DELAY_S,
+            "SPARK_RAPIDS_TPU_FLIGHT_RECORDER": "1",
+            "SPARK_RAPIDS_TPU_FLIGHT_RECORDER_DIR": incidents,
+        },
+        timeout_s=330.0)
+    if [d["rank"] for d in res["deaths"]] != [DIE_RANK]:
+        fail(f"expected exactly one death of rank {DIE_RANK}, got "
+             f"{res['deaths']}")
+    if [r["rank"] for r in res["respawns"]] != [DIE_RANK]:
+        fail(f"expected one respawn of rank {DIE_RANK}, got "
+             f"{res['respawns']}")
+    say(f"rank {DIE_RANK} died rc={res['deaths'][0]['rc']} and was "
+        f"respawned into the same trace")
+    return res
+
+
+def check_byte_identity(outdir: str) -> None:
+    import numpy as np
+
+    from spark_rapids_tpu.distributed import runner
+    ref = runner.single_q5({"world": WORLD})
+    for r in range(WORLD):
+        got = dict(np.load(os.path.join(
+            outdir, f"result_q5_rank{r}.npz")))
+        for c in ("key", "sales", "rets", "profit"):
+            if got[c].tobytes() != ref[c].tobytes():
+                fail(f"q5 column {c!r} differs on rank {r} vs "
+                     f"single-process")
+        if bool(got["overflow"]) != bool(ref["overflow"]):
+            fail(f"q5 overflow flag differs on rank {r}")
+    say(f"q5 byte-identical to single-process on all {WORLD} ranks "
+        f"(respawned rank {DIE_RANK} included)")
+
+
+def check_evidence(outdir: str) -> dict:
+    tot = {"spec_won": 0, "rebalances": 0, "dup_dropped": 0,
+           "deaths": 0}
+    journal_kinds = {"fleet_speculation": 0, "fleet_membership": 0,
+                     "fleet_inherit": 0, "shuffle_dup_dropped": 0}
+    for r in range(WORLD):
+        with open(os.path.join(outdir,
+                               f"metrics_rank{r}.json")) as f:
+            snap = json.load(f)
+        tot["spec_won"] += series_sum(
+            snap, "srt_fleet_speculations_total", "won")
+        tot["rebalances"] += series_sum(
+            snap, "srt_fleet_rebalances_total")
+        tot["dup_dropped"] += series_sum(
+            snap, "srt_shuffle_dup_dropped_total")
+        tot["deaths"] += series_sum(snap, "srt_fleet_deaths_total")
+        with open(os.path.join(outdir,
+                               f"journal_rank{r}.jsonl")) as f:
+            for line in f:
+                k = json.loads(line).get("kind")
+                if k in journal_kinds:
+                    journal_kinds[k] += 1
+    if tot["spec_won"] < 1:
+        fail(f"no speculation won (straggler rank {SLOW_RANK} was "
+             f"never covered): {tot}")
+    if journal_kinds["fleet_speculation"] < 1:
+        fail("no fleet_speculation journal events")
+    if tot["rebalances"] < 1 or tot["deaths"] < 1:
+        fail(f"no rebalance evidence for the killed rank: {tot}")
+    if journal_kinds["fleet_membership"] < 1:
+        fail("no fleet_membership journal events")
+    if journal_kinds["fleet_inherit"] < 1:
+        fail("no fleet_inherit event (nobody recomputed the dead "
+             "rank's shard)")
+    if tot["dup_dropped"] < 1:
+        fail(f"no duplicate deliveries collapsed: {tot}")
+    say(f"evidence: speculations_won={tot['spec_won']} "
+        f"rebalances={tot['rebalances']} deaths={tot['deaths']} "
+        f"dup_dropped={tot['dup_dropped']} journal={journal_kinds}")
+    return tot
+
+
+def check_one_trace(outdir: str, trace_id: str) -> int:
+    from spark_rapids_tpu.distributed import launcher
+    from spark_rapids_tpu.tools import trace_export as TE
+
+    files = launcher.span_files(outdir, WORLD)
+    if len(files) != WORLD + 1:
+        fail(f"expected {WORLD + 1} span dumps, found {files}")
+    loaded = TE.load_files(files)
+    spans = TE.spans_of([r for _, rr in loaded for r in rr])
+    tids = {s["trace_id"] for s in spans}
+    if tids != {trace_id}:
+        fail(f"spans split across {len(tids)} trace ids "
+             f"(want ONE stitched tree): {sorted(tids)[:4]}")
+    summ = TE.trace_summary(spans)[trace_id]
+    if summ["orphans"]:
+        fail(f"{summ['orphans']} orphan spans break the tree")
+    if summ["roots"] != ["dist_query"]:
+        fail(f"want exactly one 'dist_query' root, got "
+             f"{summ['roots']}")
+    respawned = [s for s in spans
+                 if s.get("attrs", {}).get("respawned")]
+    if not respawned:
+        fail("respawned worker's spans missing from the stitched "
+             "trace")
+    say(f"ONE stitched trace: {summ['spans']} spans, 1 root, "
+        f"0 orphans, respawned worker present")
+    return summ["spans"]
+
+
+def check_report_and_doctor(outdir: str) -> None:
+    from spark_rapids_tpu.tools.doctor import (
+        Bundle, analyze, find_bundles)
+    from spark_rapids_tpu.tools.metrics_report import (
+        build_report, load_jsonl)
+
+    # one report PER RANK (split_records keeps a single registry
+    # snapshot, and the speculating/rebalancing rank is
+    # timing-dependent) — the gate sums the per-rank fleet tables
+    won = rebalances = 0
+    fleet = {}
+    for r in range(WORLD):
+        report = build_report(load_jsonl([
+            os.path.join(outdir, f"journal_rank{r}.jsonl"),
+            os.path.join(outdir, f"metrics_rank{r}.json")]))
+        f = report.get("fleet") or {}
+        won += f.get("speculations", {}).get("won", 0)
+        rebalances += f.get("rebalances", 0)
+        if f.get("rebalances", 0) or not fleet:
+            fleet = f
+    if won < 1 or rebalances < 1:
+        fail(f"metrics_report --json 'fleet' tables missing "
+             f"evidence: won={won} rebalances={rebalances}")
+    say(f"metrics_report fleet tables: epoch={fleet.get('epoch')} "
+        f"rebalances={rebalances} speculations_won={won} "
+        f"skew_ratio={fleet.get('skew_ratio')}")
+
+    bundles = find_bundles(os.path.join(outdir, "incidents"))
+    if not bundles:
+        fail("no fleet_incident bundle was frozen on the death")
+    named_dead = False
+    for b in bundles:
+        bundle = Bundle(b)
+        if bundle.trigger.get("kind") != "fleet_incident":
+            continue
+        top = analyze(bundle)[0]
+        if top["kind"] == "fleet_incident" \
+                and f"dead rank(s) [{DIE_RANK}]" in top["message"]:
+            named_dead = True
+            break
+    if not named_dead:
+        fail(f"srt-doctor did not name dead rank {DIE_RANK} from "
+             f"the fleet_incident bundle(s) {bundles}")
+    # post-mortem merge: the operator folds the fleet journals into
+    # the incident bundle; the doctor then names the SLOW rank too
+    merged = os.path.join(outdir, "postmortem")
+    shutil.copytree(bundles[0], merged)
+    with open(os.path.join(merged, "journal.jsonl"), "a") as out:
+        for r in range(WORLD):
+            with open(os.path.join(
+                    outdir, f"journal_rank{r}.jsonl")) as f:
+                out.write(f.read())
+    findings = analyze(Bundle(merged))
+    slow = [f for f in findings if f["kind"] == "fleet_straggler"]
+    if not slow or f"slow rank {SLOW_RANK}" not in slow[0]["message"]:
+        fail(f"srt-doctor did not name slow rank {SLOW_RANK}: "
+             f"{slow}")
+    say(f"srt-doctor named dead rank {DIE_RANK} (bundle) and slow "
+        f"rank {SLOW_RANK} (post-mortem merge)")
+
+
+def check_resplit_inprocess() -> None:
+    """Skew section: a hot partition re-splits into per-rank
+    sub-frames and stitches back byte-identical."""
+    import threading
+
+    from spark_rapids_tpu import observability as obs
+    from spark_rapids_tpu.columns import dtypes
+    from spark_rapids_tpu.columns.column import Column
+    from spark_rapids_tpu.columns.table import Table
+    from spark_rapids_tpu.distributed.service import ShuffleService
+    from spark_rapids_tpu.robustness.fleet import ElasticFleet
+    from spark_rapids_tpu.shuffle import kudo
+    from spark_rapids_tpu.shuffle.schema import schema_of_table
+    import jax.numpy as jnp
+    import numpy as np
+
+    kudo.set_crc_enabled(True)
+    obs.enable()
+    obs.reset()
+
+    def mk(v):
+        return Table([Column(dtypes.INT64, len(v),
+                             data=jnp.asarray(np.asarray(v,
+                                                         np.int64)))])
+
+    d = tempfile.mkdtemp(prefix="elastic_resplit_")
+    addrs = [f"unix:{os.path.join(d, f'r{r}.sock')}"
+             for r in range(2)]
+    fleets = [ElasticFleet(r, 2, skew_ratio=3.0) for r in range(2)]
+    svcs = [ShuffleService(r, 2, addrs, elastic=True,
+                           fleet=fleets[r]).start()
+            for r in range(2)]
+    hot = list(range(20000))
+    outs = [None, None]
+
+    def work(r):
+        if r == 0:
+            svcs[r].broadcast_part(400, 0, mk([1, 2]))
+            time.sleep(0.4)
+            svcs[r].broadcast_part(400, 2, mk(hot))
+        else:
+            svcs[r].broadcast_part(400, 1, mk([3, 4]))
+        got = svcs[r].gather_parts(
+            400, [0, 1, 2],
+            owner_of=lambda p: 0 if p in (0, 2) else 1,
+            deadline_s=30)
+        merged = kudo.merge_to_table(got[2],
+                                     schema_of_table(mk([0])))
+        outs[r] = merged.columns[0].to_numpy().tolist()
+
+    ts = [threading.Thread(target=work, args=(r,)) for r in range(2)]
+    [t.start() for t in ts]
+    [t.join(60) for t in ts]
+    snap = obs.METRICS.snapshot()
+    resplits = series_sum(snap, "srt_fleet_resplits_total")
+    for s in svcs:
+        s.stop()
+    obs.disable()
+    if outs[0] != hot or outs[1] != hot:
+        fail("re-split hot partition did not stitch byte-identical")
+    if resplits < 1:
+        fail("hot partition did not trigger a re-split")
+    say(f"skew: hot partition re-split ({resplits}x) and stitched "
+        f"byte-identical across the fleet")
+
+
+def main(argv=None) -> int:
+    t0 = time.monotonic()
+    outdir = tempfile.mkdtemp(prefix="elastic_smoke_")
+    res = fleet_run(outdir)
+    check_byte_identity(outdir)
+    check_evidence(outdir)
+    nspans = check_one_trace(outdir, res["trace_id"])
+    check_report_and_doctor(outdir)
+    check_resplit_inprocess()
+    say(f"OK ({WORLD} processes + 1 respawn, {nspans} spans, "
+        f"{time.monotonic() - t0:.1f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

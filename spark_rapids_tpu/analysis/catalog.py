@@ -45,6 +45,18 @@ METRICS: Dict[str, Tuple[str, str]] = {
         "counter", "shuffle messages delivered per link"),
     "srt_shuffle_link_retries_total": (
         "counter", "shuffle link send retries (NAK/reconnect)"),
+    "srt_fleet_epoch": ("gauge", "elastic-fleet membership epoch"),
+    "srt_fleet_rebalances_total": (
+        "counter", "membership changes that moved shard ownership"),
+    "srt_fleet_deaths_total": ("counter", "peer ranks observed dead"),
+    "srt_fleet_speculations_total": (
+        "counter", "speculative re-executions by outcome"),
+    "srt_fleet_resplits_total": (
+        "counter", "hot partitions re-split into sub-partitions"),
+    "srt_fleet_stale_naks_total": (
+        "counter", "elastic frames fenced for a stale epoch"),
+    "srt_shuffle_dup_dropped_total": (
+        "counter", "duplicate (op, partition) deliveries dropped"),
     "srt_oom_retry_total": ("counter", "retry-OOM throws"),
     "srt_oom_split_retry_total": ("counter", "split-and-retry throws"),
     "srt_thread_blocked_time_ns_total": (
@@ -151,9 +163,23 @@ KNOBS: Dict[str, str] = {
     "SPARK_RAPIDS_TPU_DIST_MESH":
         "0=process harness, auto=attempt jax.distributed mesh",
     "SPARK_RAPIDS_TPU_DIST_FAULT":
-        "inject corrupt|trunc:dst:op on a shuffle link",
+        "inject corrupt|trunc|drop:dst:op or slow:dst:ms on a "
+        "shuffle link",
     "SPARK_RAPIDS_TPU_DIST_TRACE_CTX":
         "launcher-seeded trace context for fleet trace stitching",
+    "SPARK_RAPIDS_TPU_DIST_DIE":
+        "inject a worker death (boot|q5:scan|q5:partials[:rc])",
+    "SPARK_RAPIDS_TPU_DIST_RESPAWN":
+        "=1 marks a respawned worker incarnation (rejoin + replay)",
+    "SPARK_RAPIDS_TPU_FLEET_SPEC_DELAY_S":
+        "speculation wall-clock floor for a missing partition",
+    "SPARK_RAPIDS_TPU_FLEET_SKEW_RATIO":
+        "payload-over-median ratio that re-splits a hot partition",
+    "SPARK_RAPIDS_TPU_FLEET_BARRIER_S":
+        "elastic-barrier deadline before departed ranks are dropped",
+    "SPARK_RAPIDS_TPU_FLEET_RESPAWN":
+        "=1: the elastic barrier awaits the full original world "
+        "(a dead rank is being respawned)",
     "SPARK_RAPIDS_TPU_INGEST_DIR": "seeded parquet dataset directory",
     "SPARK_RAPIDS_TPU_INGEST_COMPRESSION":
         "codec for seeded parquet datasets",

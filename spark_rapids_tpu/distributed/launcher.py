@@ -3,7 +3,17 @@ hand out the port map, seed ONE trace context so every process's spans
 stitch into a single tree, babysit the processes, and collect results.
 
 The launcher is a library (scripts/dist_launch.py is the CLI shim) so
-the dist-smoke gate and the slow tests drive the same code path."""
+the dist-smoke gate and the slow tests drive the same code path.
+
+ISSUE 15: the babysitter POLLS the whole fleet — a worker exiting
+nonzero kills the remaining ranks and propagates its exit code
+IMMEDIATELY (:class:`WorkerFailed` carries rank + rc) instead of
+leaving the survivors to ride out the full inbox deadline.  In
+``elastic=True`` runs a death is an EXPECTED event: the launcher
+respawns the dead rank once (``respawn=True``), with the SAME seeded
+trace context (so the respawned incarnation's spans land in the same
+stitched Perfetto tree) and without the injected-death env, and the
+rejoined worker converges by rebalance + replay."""
 
 from __future__ import annotations
 
@@ -13,10 +23,105 @@ import socket
 import subprocess
 import sys
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+
+class _DeferredSpawn:
+    """A respawn scheduled for the future, shaped like a Popen so the
+    babysitter polls it like any worker.  Real orchestrators take tens
+    of seconds to reschedule a dead pod — the delay keeps the death
+    window OBSERVABLE (survivors' sends must fail and trigger the
+    membership barrier before the endpoint is resurrected)."""
+
+    def __init__(self, delay_s: float, factory: Callable):
+        self._due = time.monotonic() + delay_s
+        self._factory = factory
+        self._proc = None
+
+    def _materialize(self):
+        if self._proc is None and time.monotonic() >= self._due:
+            self._proc = self._factory()
+        return self._proc
+
+    def poll(self):
+        p = self._materialize()
+        return None if p is None else p.poll()
+
+    def kill(self) -> None:
+        if self._proc is not None:
+            self._proc.kill()
+        self._due = float("inf")  # cancel a still-pending spawn
+
+    def wait(self, timeout=None):
+        if self._proc is not None:
+            return self._proc.wait(timeout=timeout)
+        return 0
+
+
+class WorkerFailed(RuntimeError):
+    """A worker exited nonzero (or the fleet timed out).  ``rank`` and
+    ``rc`` let the CLI propagate the worker's own exit code."""
+
+    def __init__(self, rank: int, rc: Optional[int], tail: str = ""):
+        self.rank = int(rank)
+        self.rc = rc
+        if rc is None:
+            msg = f"worker rank {rank} timed out ({tail})"
+        else:
+            msg = f"worker rank {rank} exited rc={rc}: {tail}"
+        super().__init__(msg)
+
+
+def babysit(procs: Dict[int, object], timeout_s: float, *,
+            on_death: Optional[Callable] = None,
+            poll_s: float = 0.2,
+            clock=time.monotonic, sleep=time.sleep) -> None:
+    """Poll every worker until all exit 0.  A nonzero exit consults
+    ``on_death(rank, rc)`` — return a replacement process to keep
+    going (elastic respawn), or None to fail the fleet NOW: every
+    surviving process is killed and :class:`WorkerFailed` carries the
+    dead rank's exit code out immediately (no waiting out the
+    survivors' inbox deadlines)."""
+    active = dict(procs)
+    deadline = clock() + timeout_s
+    try:
+        while active:
+            progressed = False
+            for r in sorted(active):
+                rc = active[r].poll()
+                if rc is None:
+                    continue
+                progressed = True
+                del active[r]
+                if rc == 0:
+                    continue
+                repl = on_death(r, rc) if on_death is not None \
+                    else None
+                if repl is None:
+                    raise WorkerFailed(r, rc)
+                active[r] = repl
+            if active:
+                if clock() >= deadline:
+                    raise WorkerFailed(min(active), None,
+                                       tail=f"after {timeout_s}s")
+                if not progressed:
+                    sleep(poll_s)
+    except WorkerFailed:
+        for p in active.values():
+            try:
+                if p.poll() is None:
+                    p.kill()
+            except Exception:  # noqa: BLE001 — best-effort reap
+                pass
+        for p in active.values():
+            try:
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001 — best-effort reap
+                pass
+        raise
 
 
 def make_addresses(world: int, outdir: str,
@@ -51,15 +156,27 @@ def launch(world: int, outdir: str, *,
            params: Optional[dict] = None,
            fault: Optional[str] = None,
            fault_rank: int = 1,
+           die: Optional[str] = None,
+           die_rank: int = 2,
            mesh: str = "0",
+           elastic: bool = False,
+           respawn: bool = False,
+           respawn_delay_s: float = 0.0,
+           worker_env: Optional[Dict[str, str]] = None,
            timeout_s: float = 300.0) -> Dict:
     """Run ``world`` worker processes to completion.  Returns
     ``{"summaries": [...], "addresses": [...], "trace_id": hex,
-    "outdir": ...}``.  ``fault`` is a transport fault spec (e.g.
-    ``"corrupt:0:101"``) armed on ``fault_rank``'s environment — the
-    injected corrupt/truncated link must be healed by the link retry
-    for the run to succeed at all (results are still compared
-    upstream)."""
+    "outdir": ..., "deaths": [...], "respawns": [...]}``.
+
+    ``fault`` is a transport fault spec (e.g. ``"corrupt:0:101"`` or
+    ``"slow:-1:2000"``) armed on ``fault_rank``'s environment;
+    ``die`` injects a worker death (``"q5:partials"`` — see
+    runner._die_spec) on ``die_rank``.  With ``elastic`` the workers
+    speak the elastic fleet protocol; ``respawn`` additionally
+    restarts a dead rank ONCE (same trace context, injected death
+    stripped) and tells workers to await it at the fleet barrier.  A
+    worker dying outside the respawn budget kills the remaining ranks
+    and raises :class:`WorkerFailed` with its exit code immediately."""
     from spark_rapids_tpu import observability as obs
 
     os.makedirs(outdir, exist_ok=True)
@@ -72,51 +189,91 @@ def launch(world: int, outdir: str, *,
     root = obs.TRACER.start_span(
         "dist_query", kind="query",
         attrs={"world": world, "ops": ",".join(ops),
-               "transport": transport})
+               "transport": transport, "elastic": elastic})
     trace_ctx = f"{root.trace_id:016x}:{root.span_id:016x}"
 
-    procs = []
+    def worker_cmd(r: int) -> List[str]:
+        cmd = [sys.executable, "-m",
+               "spark_rapids_tpu.distributed.runner",
+               "--rank", str(r), "--world", str(world),
+               "--addresses", ",".join(addrs),
+               "--ops", ",".join(ops),
+               "--outdir", outdir,
+               "--params", json.dumps(params or {})]
+        if elastic:
+            cmd.append("--elastic")
+        return cmd
+
+    def worker_environ(r: int, *, respawned: bool = False) -> dict:
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "SPARK_RAPIDS_TPU_KUDO_CRC": "1",
+            "SPARK_RAPIDS_TPU_DIST_TRACE_CTX": trace_ctx,
+            "SPARK_RAPIDS_TPU_DIST_MESH": mesh,
+            "PYTHONPATH": _REPO_ROOT + os.pathsep
+            + env.get("PYTHONPATH", ""),
+        })
+        env.pop("SPARK_RAPIDS_TPU_DIST_DIE", None)
+        env.pop("SPARK_RAPIDS_TPU_DIST_RESPAWN", None)
+        env.update(worker_env or {})
+        if elastic and respawn:
+            # workers' elastic barrier awaits the full original world
+            # (the dead rank is coming back)
+            env["SPARK_RAPIDS_TPU_FLEET_RESPAWN"] = "1"
+        if fault and r == fault_rank:
+            env["SPARK_RAPIDS_TPU_DIST_FAULT"] = fault
+        if die and r == die_rank and not respawned:
+            env["SPARK_RAPIDS_TPU_DIST_DIE"] = die
+        if respawned:
+            env["SPARK_RAPIDS_TPU_DIST_RESPAWN"] = "1"
+        return env
+
+    procs: List[subprocess.Popen] = []
     logs = []
+    deaths: List[dict] = []
+    respawns: List[dict] = []
     failed = True
+
+    def spawn(r: int, *, respawned: bool = False) -> subprocess.Popen:
+        suffix = "_respawn" if respawned else ""
+        log = open(os.path.join(
+            outdir, f"worker_rank{r}{suffix}.log"), "w")
+        logs.append(log)
+        p = subprocess.Popen(
+            worker_cmd(r), cwd=_REPO_ROOT,
+            env=worker_environ(r, respawned=respawned),
+            stdout=log, stderr=subprocess.STDOUT)
+        procs.append(p)
+        return p
+
+    def on_death(r: int, rc: int):
+        deaths.append({"rank": r, "rc": rc,
+                       "t_mono": time.monotonic()})
+        budget_left = elastic and respawn and not any(
+            x["rank"] == r for x in respawns)
+        if not budget_left:
+            raise WorkerFailed(r, rc, tail=_tail(outdir, r))
+        respawns.append({"rank": r, "t_mono": time.monotonic(),
+                         "delay_s": respawn_delay_s})
+        if respawn_delay_s > 0:
+            return _DeferredSpawn(
+                respawn_delay_s, lambda: spawn(r, respawned=True))
+        return spawn(r, respawned=True)
+
     try:
-        for r in range(world):
-            env = dict(os.environ)
-            env.update({
-                "JAX_PLATFORMS": "cpu",
-                "SPARK_RAPIDS_TPU_KUDO_CRC": "1",
-                "SPARK_RAPIDS_TPU_DIST_TRACE_CTX": trace_ctx,
-                "SPARK_RAPIDS_TPU_DIST_MESH": mesh,
-                "PYTHONPATH": _REPO_ROOT + os.pathsep
-                + env.get("PYTHONPATH", ""),
-            })
-            if fault and r == fault_rank:
-                env["SPARK_RAPIDS_TPU_DIST_FAULT"] = fault
-            cmd = [sys.executable, "-m",
-                   "spark_rapids_tpu.distributed.runner",
-                   "--rank", str(r), "--world", str(world),
-                   "--addresses", ",".join(addrs),
-                   "--ops", ",".join(ops),
-                   "--outdir", outdir,
-                   "--params", json.dumps(params or {})]
-            log = open(os.path.join(outdir, f"worker_rank{r}.log"),
-                       "w")
-            logs.append(log)
-            procs.append(subprocess.Popen(
-                cmd, cwd=_REPO_ROOT, env=env, stdout=log,
-                stderr=subprocess.STDOUT))
-        deadline = time.monotonic() + timeout_s
-        for r, proc in enumerate(procs):
-            left = deadline - time.monotonic()
-            try:
-                rc = proc.wait(timeout=max(left, 1.0))
-            except subprocess.TimeoutExpired:
-                raise RuntimeError(
-                    f"worker rank {r} timed out after {timeout_s}s "
-                    f"(log: {_tail(outdir, r)})")
-            if rc != 0:
-                raise RuntimeError(
-                    f"worker rank {r} exited rc={rc}: "
-                    f"{_tail(outdir, r)}")
+        active = {r: spawn(r) for r in range(world)}
+        try:
+            babysit(active, timeout_s, on_death=on_death)
+        except WorkerFailed as e:
+            if e.rc is None:
+                # re-raise the timeout with the hung worker's log
+                # tail (babysit is outdir-blind)
+                raise WorkerFailed(
+                    e.rank, None,
+                    tail=f"after {timeout_s}s; log: "
+                         f"{_tail(outdir, e.rank)}") from None
+            raise
         failed = False
     finally:
         if failed:
@@ -144,7 +301,8 @@ def launch(world: int, outdir: str, *,
             summaries.append(json.load(f))
     return {"summaries": summaries, "addresses": addrs,
             "trace_id": f"{root.trace_id:016x}", "outdir": outdir,
-            "world": world, "ops": list(ops)}
+            "world": world, "ops": list(ops),
+            "deaths": deaths, "respawns": respawns}
 
 
 def _dump_launcher_spans(outdir: str, trace_id: str) -> None:
@@ -165,12 +323,17 @@ def _dump_launcher_spans(outdir: str, trace_id: str) -> None:
 
 
 def _tail(outdir: str, rank: int, n: int = 2000) -> str:
-    try:
-        with open(os.path.join(outdir,
-                               f"worker_rank{rank}.log")) as f:
-            return f.read()[-n:]
-    except OSError:
-        return "<no log>"
+    # a respawned incarnation logs to its own file — when it exists,
+    # IT is the incarnation whose failure is being diagnosed (the
+    # base log ends at the first incarnation's injected/real death)
+    for suffix in ("_respawn", ""):
+        try:
+            with open(os.path.join(
+                    outdir, f"worker_rank{rank}{suffix}.log")) as f:
+                return f.read()[-n:]
+        except OSError:
+            continue
+    return "<no log>"
 
 
 def span_files(outdir: str, world: int) -> List[str]:

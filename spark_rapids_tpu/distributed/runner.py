@@ -45,6 +45,7 @@ import contextlib
 import json
 import os
 import sys
+import time
 from typing import Dict, Optional
 
 import numpy as np
@@ -66,7 +67,39 @@ class OpIds:
     Q5_ALLGATHER = 102
     Q72_REDUCE_SCATTER = 111
     Q72_ALLGATHER = 112
+    EQ5_PARTS = 121       # elastic q5: per-shard partial broadcast
     BARRIER = 900
+    ELASTIC_BARRIER = 901
+
+
+def _die_spec() -> Optional[tuple]:
+    """Injected worker death (chaos for the elastic gate):
+    ``SPARK_RAPIDS_TPU_DIST_DIE="<where>[:<rc>]"`` with ``where`` in
+    {'boot', 'q5:scan', 'q5:partials'} — boot exits immediately at
+    worker start (the launcher fast-fail path); q5:scan exits after
+    generating the dataset, BEFORE any partials exist (survivors'
+    sends fail -> membership barrier -> the inheritor recomputes the
+    dead shard); q5:partials exits AFTER computing this rank's
+    partials but BEFORE broadcasting them (work genuinely lost)."""
+    spec = os.environ.get("SPARK_RAPIDS_TPU_DIST_DIE", "")
+    if not spec:
+        return None
+    parts = spec.split(":")
+    if parts[-1].isdigit() and len(parts) > 1:
+        return ":".join(parts[:-1]), int(parts[-1])
+    return spec, 13
+
+
+_DIE_POINTS = ("boot", "q5:scan", "q5:partials")
+
+
+def _maybe_die(where: str) -> None:
+    spec = _die_spec()
+    if spec is not None and spec[0] == where:
+        sys.stderr.write(f"injected death at {where} "
+                         f"(rc={spec[1]})\n")
+        sys.stderr.flush()
+        os._exit(spec[1])
 
 
 # ------------------------------------------------------------- helpers
@@ -240,6 +273,118 @@ def single_q5(params: Optional[dict] = None) -> Dict[str, np.ndarray]:
             "overflow": np.asarray(bool(np.asarray(of)))}
 
 
+# ---------------------------------------------------------- elastic q5
+
+
+def run_elastic_q5(params: Optional[dict] = None, *, transport=None
+                   ) -> Dict[str, np.ndarray]:
+    """q5 on the ELASTIC fleet protocol (ISSUE 15): every shard's
+    partial group table is a logical PARTITION broadcast to all live
+    ranks; the global sums are local (exact int64, shard order).  A
+    dead rank's shards are recomputed by the fleet-assigned inheritor
+    (inputs are seeded-deterministic); a straggler's shard is
+    speculatively re-executed by the least-loaded survivor with the
+    first verified copy winning the (op, shard) dedup; a respawned
+    worker recomputes its own shards and catches up on the rest by
+    CRC'd replay — every rank, however it got here, converges to
+    bytes identical to ``single_q5``."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu import observability as _obs
+    from spark_rapids_tpu.models import tpcds as T
+    from spark_rapids_tpu.parallel import exchange as X
+    from spark_rapids_tpu.shuffle import kudo as _kudo
+    from spark_rapids_tpu.shuffle.schema import schema_of_table
+
+    p = dict(Q5_PARAMS, **(params or {}))
+    if transport is None:
+        transport = X.table_transport()
+    if getattr(transport, "fleet", None) is None:
+        # degenerate path: no elastic fabric installed — the classic
+        # reduce-scatter runner computes the same bytes
+        return run_dist_q5(params, transport=transport)
+    fleet = transport.fleet
+    rank, world0 = transport.rank, fleet.world0
+    with _obs.TRACER.span("elastic_q5", kind="query",
+                          attrs={"rank": rank, "world": world0}), \
+            _profiled("q5", rank, world0):
+        rows = max(int(p["rows"]) // (8 * world0), 1) * 8 * world0
+        d = T.gen_q5(rows=rows, stores=p["stores"], days=p["days"])
+        _maybe_die("q5:scan")
+        fused = _fused()
+
+        def compute_part(shard: int, ctx=None):
+            """Deterministic per-shard partials -> one int64 kudo
+            table.  Runs for our own shards, for INHERITED shards
+            after a rebalance, and (cancel-aware via ``ctx``) as a
+            speculative re-execution of a straggler's shard."""
+            t0 = time.monotonic_ns()
+            args = tuple(
+                _shard(a, shard, world0)
+                for a in (d.s_date, d.s_store, d.s_price, d.s_profit,
+                          d.r_date, d.r_store, d.r_amt, d.r_loss)
+            ) + (d.d_date,)
+            if fused:
+                from spark_rapids_tpu.plan import catalog as C
+                outs, _cap = C.run_q5_partials(
+                    args, p["stores"], p["join_capacity"], ctx=ctx)
+            else:
+                def build(cap):
+                    return jax.jit(T._q5_partials(p["stores"], cap))
+
+                if ctx is not None:
+                    ctx.check_cancel()
+                outs, _cap = T.run_with_capacity_retry(
+                    build, args, p["join_capacity"])
+                if ctx is not None:
+                    ctx.check_cancel()
+            sales, rets, profit, seen, of = (np.asarray(o)
+                                             for o in outs)
+            n = len(sales)
+            fleet.note_stage_wall("q5.partials",
+                                  time.monotonic_ns() - t0)
+            return _int64_table([
+                sales, rets, profit, seen,
+                np.full(n, int(bool(of)), dtype=np.int64)])
+
+        view = fleet.view()
+        for shard in view.shards_of(rank):
+            t = compute_part(shard)
+            _maybe_die("q5:partials")
+            transport.broadcast_part(OpIds.EQ5_PARTS, shard, t)
+        got = transport.gather_parts(
+            OpIds.EQ5_PARTS, range(world0), compute=compute_part,
+            deadline_s=transport.recv_timeout_s)
+        fields = schema_of_table(_int64_table([[0]] * 5))
+        vecs = None
+        of_any = False
+        for shard in range(world0):
+            merged = _kudo.merge_to_table(got[shard], fields)
+            cols = [c.to_numpy().astype(np.int64)
+                    for c in merged.columns]
+            of_any = of_any or bool(cols[-1].max(initial=0) > 0)
+            if vecs is None:
+                vecs = cols[:-1]
+            else:
+                vecs = [a + b for a, b in zip(vecs, cols[:-1])]
+        sales, rets, profit, seen = vecs
+        if fused:
+            from spark_rapids_tpu.plan import catalog as C
+            key_s, sales_s, ret_s, profit_s, _of = C.run_q5_finish(
+                sales, rets, profit, seen, of_any,
+                np.asarray(d.st_id), p["stores"])
+        else:
+            fin = jax.jit(T._q5_finish(p["stores"]))
+            key_s, sales_s, ret_s, profit_s = fin(
+                jnp.asarray(sales), jnp.asarray(rets),
+                jnp.asarray(profit), jnp.asarray(seen), d.st_id)
+        return {"key": np.asarray(key_s), "sales": np.asarray(sales_s),
+                "rets": np.asarray(ret_s),
+                "profit": np.asarray(profit_s),
+                "overflow": np.asarray(of_any)}
+
+
 # ----------------------------------------------------------------- q72
 
 
@@ -319,6 +464,7 @@ def single_q72(params: Optional[dict] = None) -> Dict[str, np.ndarray]:
 
 
 DIST_QUERIES = {"q5": run_dist_q5, "q72": run_dist_q72}
+ELASTIC_QUERIES = {"q5": run_elastic_q5, "q72": run_dist_q72}
 SINGLE_QUERIES = {"q5": single_q5, "q72": single_q72}
 
 
@@ -352,7 +498,12 @@ def main(argv=None) -> int:
     ap.add_argument("--params", default="{}",
                     help="JSON dict of per-query param overrides "
                          "keyed by op name")
+    ap.add_argument("--elastic", action="store_true",
+                    help="elastic fleet protocol: membership epoch, "
+                         "rebalance on peer death, speculation, "
+                         "skew re-split")
     args = ap.parse_args(argv)
+    _maybe_die("boot")
 
     import jax
     try:
@@ -378,18 +529,34 @@ def main(argv=None) -> int:
     mesh_info = try_form_mesh(rank, world,
                               coordinator=args.coordinator)
     service = ShuffleService(
-        rank, world, args.addresses.split(",")).start().install()
+        rank, world, args.addresses.split(","),
+        elastic=args.elastic).start().install()
+    respawned = os.environ.get(
+        "SPARK_RAPIDS_TPU_DIST_RESPAWN", "") == "1"
     parent = _parse_trace_ctx()
     root = obs.TRACER.start_span(
         "dist_worker", kind="process", parent=parent,
         attrs={"rank": rank, "world": world,
-               "mesh": mesh_info["mode"]})
+               "mesh": mesh_info["mode"],
+               "respawned": respawned})
+    from spark_rapids_tpu.observability import SpanContext
+    # control/replay daemon threads parent under this worker's
+    # process span so the fleet trace stays ONE connected tree
+    service.trace_ctx = SpanContext(root.trace_id, root.span_id)
+    if args.elastic and respawned:
+        # a respawned incarnation: announce ourselves so survivors
+        # waiting at the elastic barrier learn we are back, and learn
+        # their epoch/departed view before sending fenceable frames
+        # (after the root span, so the join sends stitch into the
+        # fleet trace instead of rooting orphans)
+        service.join_fleet()
+    queries = ELASTIC_QUERIES if args.elastic else DIST_QUERIES
     ops = [o for o in args.ops.split(",") if o]
     rc = 0
     try:
         for op in ops:
-            result = DIST_QUERIES[op](overrides.get(op),
-                                      transport=service)
+            result = queries[op](overrides.get(op),
+                                 transport=service)
             np.savez(os.path.join(
                 outdir, f"result_{op}_rank{rank}.npz"), **result)
             if obs.PROFILER.enabled:
@@ -412,7 +579,16 @@ def main(argv=None) -> int:
                             f"metrics_{op}_rank{rank}.json"),
                         lambda f: f.write(
                             obs.METRICS.snapshot_json()))
-        service.barrier(OpIds.BARRIER)
+        if args.elastic:
+            # membership-tolerant: survives peers leaving AND waits
+            # for a respawned peer when the launcher may send one
+            service.elastic_barrier(OpIds.ELASTIC_BARRIER)
+            # graceful leave: peers still gathering (a respawned
+            # straggler) drop us from their barrier wants instead of
+            # waiting out a death detection on our closed listener
+            service.leave_fleet()
+        else:
+            service.barrier(OpIds.BARRIER)
     except Exception as e:  # noqa: BLE001 — report, then nonzero exit
         rc = 1
         with open(os.path.join(outdir, f"error_rank{rank}.txt"),
@@ -425,9 +601,17 @@ def main(argv=None) -> int:
             os.path.join(outdir, f"spans_rank{rank}.jsonl"))
         dump_via(os.path.join(outdir, f"metrics_rank{rank}.json"),
                  lambda f: f.write(obs.METRICS.snapshot_json()))
+        # the journal carries the fleet evidence spine
+        # (fleet_membership / fleet_speculation / fleet_inherit /
+        # shuffle_dup_dropped) the elastic gate and srt-doctor read
+        obs.dump_journal_jsonl(
+            os.path.join(outdir, f"journal_rank{rank}.jsonl"))
         summary = {
             "rank": rank, "world": world, "ops": ops,
-            "mesh": mesh_info,
+            "mesh": mesh_info, "elastic": bool(args.elastic),
+            "respawned": respawned,
+            "epoch": (service.fleet.epoch
+                      if service.fleet is not None else 0),
             "trace_id": (f"{root.trace_id:016x}"
                          if root.trace_id else None),
             "rc": rc,

@@ -28,23 +28,57 @@ stock ``merge_to_table_with_metrics``.
 distinct op id per (query, stage) — the service namespaces nothing.
 Collisions across CONCURRENT exchanges would cross payloads; the
 distributed runner allocates ids centrally (runner.OpIds).
+
+Elastic mode (ISSUE 15): constructed with ``elastic=True`` the service
+additionally speaks the part-granular elastic protocol over the SAME
+links — ``broadcast_part`` / ``gather_parts`` / ``elastic_barrier`` —
+with an :class:`~spark_rapids_tpu.robustness.fleet.ElasticFleet`
+deciding membership and policy:
+
+  * a ``PeerDiedException`` on any link marks the peer departed, bumps
+    the membership epoch, gossips a death notice to every survivor
+    (the fleet-wide membership barrier: assignment is a pure function
+    of the departed set, so agreement on WHO died is agreement on who
+    inherits), and the inheritor recomputes the dead rank's partitions
+    from the seeded inputs;
+  * a partition still missing past the straggler signal is
+    speculatively re-executed by the least-loaded survivor — first
+    verified copy wins the (op, part) dedup, the loser's frames count
+    into ``srt_shuffle_dup_dropped_total`` and an original arriving
+    mid-speculation cancels the speculative task through the
+    cooperative QueryContext machinery;
+  * a hot partition (payload >> the op's median, cross-checked against
+    the live per-link byte counters) re-splits into per-rank
+    sub-frames stitched back in index order;
+  * every verified part payload is retained (bounded) as a REPLAY
+    store: a FETCH control message re-serves the original CRC'd bytes,
+    which is how a respawned worker catches up to a round that
+    finished without it.
 """
 
 from __future__ import annotations
 
 import io
+import json
+import os
+import struct
 import threading
+import time
 
 from spark_rapids_tpu.analysis.lockdep import make_lock
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Set
 
 from spark_rapids_tpu import observability as _obs
 from spark_rapids_tpu.parallel import exchange as _exchange
+from spark_rapids_tpu.robustness.fleet import (
+    ElasticFleet, StaleEpochError)
+from spark_rapids_tpu.robustness.links import PeerDiedException
 from spark_rapids_tpu.robustness.retry import RetryPolicy
 from spark_rapids_tpu.shuffle import kudo as _kudo
 from spark_rapids_tpu.shuffle.schema import schema_of_table
 from spark_rapids_tpu.distributed.transport import (
-    Inbox, Listener, PeerLink)
+    ACK, KIND_CTRL, KIND_EDATA, MAX_RESPLIT_SUBS, STALE, Inbox,
+    Listener, PartInbox, PeerLink, pack_resplit, unpack_resplit)
 
 
 class ShuffleService:
@@ -53,7 +87,9 @@ class ShuffleService:
     def __init__(self, rank: int, world: int,
                  addresses: Sequence[str], *,
                  policy: Optional[RetryPolicy] = None,
-                 recv_timeout_s: float = 120.0):
+                 recv_timeout_s: float = 120.0,
+                 elastic: bool = False,
+                 fleet: Optional[ElasticFleet] = None):
         if len(addresses) != world:
             raise ValueError(
                 f"need {world} addresses, got {len(addresses)}")
@@ -68,13 +104,26 @@ class ShuffleService:
         self.addresses = list(addresses)
         self.recv_timeout_s = recv_timeout_s
         self.inbox = Inbox()
-        self.listener = Listener(self.rank,
-                                 self.addresses[self.rank], self.inbox)
+        self.fleet = fleet or (ElasticFleet(rank, world)
+                               if elastic else None)
+        self.parts = PartInbox() if self.fleet is not None else None
+        self.listener = Listener(
+            self.rank, self.addresses[self.rank], self.inbox,
+            sink=self if self.fleet is not None else None)
         self.links: Dict[int, PeerLink] = {
             r: PeerLink(self.rank, r, addresses[r], policy=policy)
             for r in range(world) if r != self.rank}
         self._started = False
         self._lock = make_lock("dist.service")
+        # per-op first-touch monotonic ns: arrival gaps feed the
+        # straggler window relative to when THIS rank engaged the op
+        self._op_t0: Dict[int, int] = {}
+        self._op_t0_lock = make_lock("dist.service.op_t0")
+        # fallback trace context for control/replay daemon threads
+        # (they have no ambient span: without this every replayed
+        # shuffle_send would root a fresh orphan trace and break the
+        # one-stitched-tree invariant across a worker respawn)
+        self.trace_ctx = None
 
     # ------------------------------------------------------- lifecycle
 
@@ -204,6 +253,528 @@ class ShuffleService:
         if out.num_rows != self.world:
             raise RuntimeError(
                 f"barrier saw {out.num_rows} ranks, want {self.world}")
+
+    # ------------------------------------------------- elastic: sink
+    # (listener handler threads call these for EDATA/CTRL frames)
+
+    def _op_start(self, op_id: int) -> int:
+        with self._op_t0_lock:
+            t0 = self._op_t0.get(op_id)
+            if t0 is None:
+                t0 = self._op_t0[op_id] = time.monotonic_ns()
+                if len(self._op_t0) > 256:
+                    self._op_t0.pop(next(iter(self._op_t0)))
+            return t0
+
+    def on_edata(self, src: int, op_id: int, seq: int, epoch: int,
+                 part_field: int, payload: bytes) -> bytes:
+        """Verify + deliver one elastic data frame; returns the
+        verdict bytes.  Raises ValueError/EOFError on a corrupt
+        payload (the listener answers NAK)."""
+        fleet = self.fleet
+        if fleet.is_stale(epoch):
+            _obs.record_fleet_stale_nak(src, epoch, fleet.epoch)
+            return STALE + struct.pack(">I", fleet.epoch)
+        fleet.learn_epoch(epoch)
+        # NOTE: a current-epoch frame from a departed rank is merged
+        # (the data is fine) but does NOT resurrect its membership —
+        # a respawned worker announces itself with an explicit join
+        # CTRL (ordered before its data on the same link), while a
+        # late in-flight frame from a peer that gracefully LEFT must
+        # not pull it back into the live set and point fanouts at a
+        # closed listener.
+        tables = _kudo.read_tables(io.BytesIO(payload))
+        t0 = self._op_start(op_id)
+        sub = unpack_resplit(part_field)
+        if sub is None:
+            part = part_field
+            status = self.parts.put(op_id, part, tables, payload)
+        else:
+            part, k, nsub = sub
+            status = self.parts.put_sub(op_id, part, k, nsub, tables,
+                                        payload)
+        if status.startswith("dup"):
+            _obs.record_shuffle_dup_dropped(
+                src, op_id, part,
+                None if status == "dup_framing"
+                else status == "dup_identical")
+        elif status == "new":
+            fleet.note_arrival(op_id, part, src,
+                               time.monotonic_ns() - t0)
+            # received payloads feed the op's skew window too — the
+            # per-link byte counters this mirrors are the live signal
+            # the re-split decision reads
+            fleet.note_part_bytes(op_id, len(payload))
+        _obs.record_shuffle_link("recv", src, len(payload), op_id)
+        return ACK
+
+    def on_ctrl(self, src: int, epoch: int, payload: bytes) -> bytes:
+        """Control dispatch: death notices, joins, replay fetches,
+        membership-view answers.  Always ACKs (notices are
+        idempotent); malformed JSON raises ValueError -> NAK."""
+        obj = json.loads(payload.decode("utf-8"))
+        fleet = self.fleet
+        typ = obj.get("type")
+        if typ == "death":
+            if fleet.note_death(obj.get("dead", ()),
+                                epoch_hint=int(obj.get("epoch", 0))):
+                self.parts.wake()
+        elif typ == "join":
+            joiner = int(obj.get("rank", src))
+            fleet.note_join(joiner)
+            self.parts.wake()
+            # answer the joiner with our view so it fast-forwards its
+            # epoch + departed set without waiting to be fenced
+            self._spawn(self._send_view, joiner)
+        elif typ == "leave":
+            if fleet.note_leave(int(obj.get("rank", src))):
+                self.parts.wake()
+        elif typ == "view":
+            fleet.note_death(obj.get("departed", ()),
+                             epoch_hint=int(obj.get("epoch", 0)))
+            fleet.learn_epoch(int(obj.get("epoch", 0)))
+            self.parts.wake()
+        elif typ == "fetch":
+            # byte-safe replay: re-serve the retained CRC'd payloads
+            # for the op (off the handler thread — replay sends block
+            # for ACKs and must not stall this connection's reads)
+            self._spawn(self._replay, src, int(obj.get("op", -1)),
+                        obj.get("parts"))
+        else:
+            raise ValueError(f"unknown control type {typ!r}")
+        return ACK
+
+    def _spawn(self, fn, *args) -> None:
+        ctx = _obs.TRACER.current_context() or self.trace_ctx
+
+        def run() -> None:
+            holder = _obs.TRACER.activate(ctx)
+            try:
+                fn(*args)
+            finally:
+                holder.end()
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"srt-fleet-ctrl-{self.rank}").start()
+
+    # ----------------------------------------- elastic: send helpers
+
+    def _elastic_send(self, dst: int, op_id: int, part_field: int,
+                      payload: bytes, *, kind: int = KIND_EDATA
+                      ) -> int:
+        """One elastic send with stale-epoch fast-forward: a fence
+        verdict teaches us the peer's epoch and the frame replays
+        under it (bounded — a peer that keeps advancing mid-send is
+        still making progress, not failing)."""
+        for _ in range(3):
+            try:
+                return self.links[dst].send(
+                    op_id, payload, kind=kind,
+                    epoch=self.fleet.epoch, part=part_field)
+            except StaleEpochError as e:
+                self.fleet.learn_epoch(e.epoch)
+        return 0  # persistently fenced: the peer no longer needs us
+
+    def _send_ctrl(self, dst: int, obj: dict) -> None:
+        payload = json.dumps(obj, sort_keys=True).encode("utf-8")
+        self._elastic_send(dst, 0, 0, payload, kind=KIND_CTRL)
+
+    def _send_view(self, dst: int) -> None:
+        view = self.fleet.view()
+        try:
+            self._send_ctrl(dst, {
+                "type": "view", "epoch": view.epoch,
+                "departed": sorted(view.departed)})
+        except (PeerDiedException, OSError):
+            pass  # the joiner died again; its next join retries
+
+    def _replay(self, dst: int, op_id: int, parts=None) -> None:
+        blobs = self.parts.payloads(op_id)
+        want = None if parts is None else set(int(p) for p in parts)
+        for part, blob in sorted(blobs.items()):
+            if want is not None and part not in want:
+                continue
+            try:
+                self._elastic_send(dst, op_id, part, blob)
+            except (PeerDiedException, OSError):
+                return  # requester gone; nothing to do
+
+    def _report_death(self, dead_rank: int) -> None:
+        """A link to ``dead_rank`` exhausted its budget: fold the
+        death in and gossip the notice to every survivor — the
+        fleet-wide membership barrier.  Survivors that also failed to
+        reach the peer converge on the same (departed, epoch) facts;
+        assignment being a pure function of those facts IS the
+        agreement."""
+        fleet = self.fleet
+        pending = {int(dead_rank)}
+        while pending:
+            d = pending.pop()
+            if not fleet.note_death([d]):
+                continue
+            self.parts.wake()
+            view = fleet.view()
+            notice = {"type": "death", "dead": sorted(view.departed),
+                      "epoch": view.epoch}
+            for peer in sorted(view.live):
+                if peer == self.rank:
+                    continue
+                try:
+                    self._send_ctrl(peer, notice)
+                except (PeerDiedException, OSError):
+                    pending.add(peer)  # it died too: fold + re-gossip
+
+    # -------------------------------------------- elastic: broadcast
+
+    def broadcast_part(self, op_id: int, part: int, table, *,
+                       resplit: bool = True) -> int:
+        """Deliver one logical partition to EVERY live rank (self
+        included — the local copy seeds the replay store and wins the
+        dedup race for our own work).  A payload flagged hot by the
+        fleet's skew signal re-splits into per-rank sub-frames.  A
+        peer dying mid-fanout triggers the membership barrier and the
+        broadcast continues to the survivors — delivery to the dead
+        rank is the INHERITOR's problem now, not ours."""
+        if self.fleet is None:
+            raise RuntimeError("broadcast_part requires elastic=True")
+        self._op_start(op_id)
+        payload = self._serialize(table)
+        hot = self.fleet.hot_part(op_id, len(payload)) \
+            if resplit else None
+        self.fleet.note_part_bytes(op_id, len(payload))
+        if hot and table.num_rows >= 2:
+            return self._broadcast_resplit(op_id, part, table, hot)
+        status = self.parts.put(
+            op_id, part, _kudo.read_tables(io.BytesIO(payload)),
+            payload)
+        if status != "new":
+            return len(payload)  # a copy already won: spare the wire
+        self._fanout(op_id, [(part, payload)])
+        return len(payload)
+
+    def _broadcast_resplit(self, op_id: int, part: int, table,
+                           hot: dict) -> int:
+        """Second sub-partitioned exchange round for a hot partition:
+        row-sliced into one sub-frame per live rank, stitched back in
+        index order by every receiver (concatenation of row slices is
+        byte-identical to the unsplit table)."""
+        view = self.fleet.view()
+        rows = int(table.num_rows)
+        nsub = max(2, min(self.fleet.policy.resplit_factor(view),
+                          rows, MAX_RESPLIT_SUBS))
+        subs: List[tuple] = []
+        for k in range(nsub):
+            lo = k * rows // nsub
+            hi = (k + 1) * rows // nsub
+            buf = io.BytesIO()
+            _kudo.write_to_stream_with_metrics(
+                table.columns, buf, lo, hi - lo)
+            blob = buf.getvalue()
+            self.parts.put_sub(
+                op_id, part, k, nsub,
+                _kudo.read_tables(io.BytesIO(blob)), blob)
+            subs.append((pack_resplit(part, k, nsub), blob))
+        total = sum(len(b) for _, b in subs)
+        _obs.record_fleet_resplit(
+            op_id, part, nsub, total,
+            evidence=dict(hot, link_skew=self.fleet.link_skew()))
+        self._fanout(op_id, subs)
+        return total
+
+    def _fanout(self, op_id: int,
+                frames: List[tuple]) -> None:
+        """Send (part_field, payload) frames to every live peer
+        concurrently (one thread per peer, frames in order on each
+        link).  Peer deaths fold into the membership barrier instead
+        of failing the broadcast."""
+        view = self.fleet.view()
+        peers = [r for r in sorted(view.live) if r != self.rank]
+        if not peers:
+            return
+        dead: List[int] = []  # list.append is GIL-atomic
+        ctx = _obs.TRACER.current_context()
+
+        def one(dst: int) -> None:
+            holder = _obs.TRACER.activate(ctx)
+            try:
+                for part_field, payload in frames:
+                    self._elastic_send(dst, op_id, part_field,
+                                       payload)
+            except (PeerDiedException, OSError):
+                dead.append(dst)
+            finally:
+                holder.end()
+
+        workers = [threading.Thread(
+            target=one, args=(d,), daemon=True,
+            name=f"srt-fleet-bcast-{self.rank}-{d}") for d in peers]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+        for d in dead:
+            self._report_death(d)
+
+    # ----------------------------------------------- elastic: gather
+
+    def gather_parts(self, op_id: int, want,
+                     *,
+                     owner_of: Optional[Callable[[int], int]] = None,
+                     compute: Optional[Callable] = None,
+                     deadline_s: Optional[float] = None,
+                     fetch_after_s: Optional[float] = None,
+                     drop_departed: bool = False) -> Dict[int, list]:
+        """Collect logical partitions, elastically.
+
+        ``want``: part ids, or a callable ``view -> part ids`` (the
+        barrier's membership-sensitive want).  ``owner_of``: part ->
+        ORIGINAL owner rank (default: the fleet assignment, i.e.
+        part == shard).  ``compute``: ``(part, ctx) -> Table``
+        deterministic recompute — enables rebalance inheritance and
+        straggler speculation; ``ctx`` is a cancel-capable
+        QueryContext (None for non-speculative recomputes).
+        ``drop_departed``: on deadline, departed owners' parts are
+        dropped from the want set instead of failing (barrier
+        semantics).  Returns {part: [KudoTable...]}."""
+        if self.fleet is None:
+            raise RuntimeError("gather_parts requires elastic=True")
+        fleet = self.fleet
+        deadline = (deadline_s if deadline_s is not None
+                    else self.recv_timeout_s)
+        fetch_after = (fetch_after_s if fetch_after_s is not None
+                       else min(2.0, fleet.spec_delay_s))
+        t0 = time.monotonic()
+        self._op_start(op_id)
+        done: Set[int] = set()       # parts I computed/speculated
+        spec_seen: Set[int] = set()  # parts with a resolved decision
+        last_fetch = 0.0
+        fetch_rr = 0
+        with _obs.TRACER.span("elastic_gather", kind="stage",
+                              attrs={"op": op_id}) as sp:
+            while True:
+                view = fleet.view()
+                want_now = set(want(view) if callable(want) else want)
+                missing = sorted(want_now - self.parts.have(op_id))
+                if not missing:
+                    break
+                elapsed = time.monotonic() - t0
+                for p in missing:
+                    if owner_of is not None:
+                        orig = resp = owner_of(p)
+                    elif 0 <= p < view.world0:
+                        # shard gather: shard p started on rank p;
+                        # the CURRENT assignment names who answers
+                        # for it after any rebalance
+                        orig, resp = p, view.owner(p)
+                    else:
+                        orig = resp = p
+                    if compute is not None and resp == self.rank \
+                            and p not in done:
+                        # my part — mine originally, or inherited
+                        # from a departed rank at this epoch
+                        if orig != self.rank:
+                            _obs.JOURNAL.emit(
+                                "fleet_inherit", op=op_id, part=p,
+                                dead_owner=orig, epoch=view.epoch)
+                        done.add(p)
+                        self.broadcast_part(op_id, p,
+                                            compute(p, None))
+                        continue
+                    if compute is not None and p not in spec_seen \
+                            and resp != self.rank \
+                            and resp not in view.departed:
+                        ev = fleet.should_speculate(
+                            op_id, int(elapsed * 1e9))
+                        if ev:
+                            spec_seen.add(p)
+                            if fleet.policy.speculator(
+                                    view, resp) == self.rank:
+                                done.add(p)
+                                self._speculate(op_id, p, resp,
+                                                compute, ev)
+                if missing and elapsed - last_fetch >= fetch_after \
+                        and elapsed >= fetch_after:
+                    # replay fetch (periodic): covers silently-dropped
+                    # frames, late joiners catching up on a finished
+                    # round, and replays lost to a peer's death — a
+                    # failed fetch IS the death detection.  One peer
+                    # per interval, round-robin: every replayed part
+                    # arrives once instead of world-1 dup-dropped
+                    # copies on an already-degraded fleet (failover
+                    # is the next interval's rotation).
+                    last_fetch = elapsed
+                    fetch_peers = [p for p in sorted(view.live)
+                                   if p != self.rank]
+                    if fetch_peers:
+                        peer = fetch_peers[fetch_rr
+                                           % len(fetch_peers)]
+                        fetch_rr += 1
+                        self._spawn(self._fetch_from, peer,
+                                    op_id, list(missing))
+                if elapsed >= deadline:
+                    missing = sorted(
+                        set(want_now) - self.parts.have(op_id))
+                    if not missing:
+                        break
+                    if drop_departed:
+                        live_missing = [
+                            p for p in missing
+                            if (owner_of(p) if owner_of else p)
+                            not in view.departed]
+                        if not live_missing:
+                            break  # only ghosts missing: proceed
+                        missing = live_missing
+                    if compute is not None:
+                        # terminal fallback: every input is seeded +
+                        # deterministic, so local recompute always
+                        # converges (the fleet may be unreachable,
+                        # the answer is not)
+                        for p in missing:
+                            self.broadcast_part(op_id, p,
+                                                compute(p, None))
+                        continue
+                    raise PeerDiedException(
+                        ",".join(str(owner_of(p) if owner_of else p)
+                                 for p in missing),
+                        0, detail=f"elastic gather op {op_id}: parts "
+                                  f"{missing} missing after "
+                                  f"{deadline:.1f}s")
+                self.parts.wait_any(op_id, missing, 0.1)
+            have = self.parts.get(op_id)
+            want_final = set(want(fleet.view())
+                             if callable(want) else want)
+            sp.set_attr("parts", len(want_final))
+            sp.set_attr("epoch", fleet.epoch)
+            return {p: have[p] for p in want_final if p in have}
+
+    def _fetch_from(self, peer: int, op_id: int,
+                    parts=None) -> None:
+        try:
+            self._send_ctrl(peer, {"type": "fetch", "op": op_id,
+                                   "parts": parts})
+        except (PeerDiedException, OSError):
+            self._report_death(peer)
+
+    def _speculate(self, op_id: int, part: int, owner: int, compute,
+                   evidence: dict) -> None:
+        """Speculatively re-execute a straggler's partition.  First
+        byte-identical result wins the (op, part) dedup; if the
+        original arrives while we compute, the cancel event trips and
+        the speculative task unwinds through the cooperative
+        QueryContext machinery (outcome 'cancelled')."""
+        from spark_rapids_tpu.models import QueryCancelled, \
+            QueryContext
+        cancel = threading.Event()
+        done = threading.Event()
+
+        def watch() -> None:
+            # trip the cancel the moment the original lands
+            while not done.is_set():
+                if self.parts.wait_any(op_id, {part}, 0.2):
+                    cancel.set()
+                    return
+
+        watcher = threading.Thread(
+            target=watch, daemon=True,
+            name=f"srt-fleet-spec-watch-{self.rank}")
+        watcher.start()
+        ctx = QueryContext(query_id=f"spec:{op_id}:{part}",
+                           cancel_event=cancel)
+        try:
+            table = compute(part, ctx)
+        except QueryCancelled:
+            _obs.record_fleet_speculation(op_id, part, owner,
+                                          self.rank, "cancelled",
+                                          evidence)
+            return
+        finally:
+            done.set()
+        payload = self._serialize(table)
+        status = self.parts.put(
+            op_id, part, _kudo.read_tables(io.BytesIO(payload)),
+            payload)
+        if status == "new":
+            _obs.record_fleet_speculation(op_id, part, owner,
+                                          self.rank, "won", evidence)
+            self._fanout(op_id, [(part, payload)])
+        else:
+            _obs.record_fleet_speculation(op_id, part, owner,
+                                          self.rank, "lost", evidence)
+
+    # ---------------------------------------------- elastic: barrier
+
+    def elastic_barrier(self, op_id: int,
+                        deadline_s: Optional[float] = None) -> None:
+        """Membership-tolerant barrier: every rank broadcasts a
+        sentinel part keyed by its RANK and waits for the sentinels of
+        the ranks it owes waiting to — the live set, or (when the
+        launcher may respawn the dead: SPARK_RAPIDS_TPU_FLEET_RESPAWN)
+        the full original world, so a rejoining worker finds its peers
+        still listening and can catch up by replay.  Departed ranks
+        that never return are dropped at the deadline."""
+        import jax.numpy as jnp
+
+        from spark_rapids_tpu.columns import dtypes
+        from spark_rapids_tpu.columns.column import Column
+        from spark_rapids_tpu.columns.table import Table
+        if deadline_s is None:
+            try:
+                deadline_s = float(os.environ.get(
+                    "SPARK_RAPIDS_TPU_FLEET_BARRIER_S", "") or 120.0)
+            except ValueError:
+                deadline_s = 120.0
+        await_all = os.environ.get(
+            "SPARK_RAPIDS_TPU_FLEET_RESPAWN", "") == "1"
+        col = Column(dtypes.INT64, 1,
+                     data=jnp.asarray([self.rank], dtype=jnp.int64))
+        self.broadcast_part(op_id, self.rank, Table([col]),
+                            resplit=False)
+
+        def want(view):
+            return (set(range(view.world0)) if await_all
+                    else set(view.live))
+
+        self.gather_parts(op_id, want, owner_of=lambda p: p,
+                          deadline_s=deadline_s, drop_departed=True)
+
+    def leave_fleet(self) -> None:
+        """Graceful departure: tell every live peer we are leaving so
+        their barrier wants shrink NOW instead of waiting out a death
+        detection — the worker sends this after it passed its own
+        barrier, so a peer dropping us from its want set is provably
+        safe.  Best-effort: peers already gone are skipped."""
+        if self.fleet is None:
+            return
+        view = self.fleet.view()
+        for peer in sorted(view.live):
+            if peer == self.rank:
+                continue
+            try:
+                self._send_ctrl(peer, {"type": "leave",
+                                       "rank": self.rank})
+            except (PeerDiedException, OSError):
+                continue
+
+    def join_fleet(self, timeout_s: float = 10.0) -> None:
+        """(Re)join a running fleet: announce to every peer, then wait
+        briefly for a view answer so our epoch + departed set are
+        current before we start fencing/being fenced."""
+        if self.fleet is None:
+            raise RuntimeError("join_fleet requires elastic=True")
+        base = self.fleet.epoch
+        for peer in range(self.world):
+            if peer == self.rank:
+                continue
+            try:
+                self._send_ctrl(peer, {"type": "join",
+                                       "rank": self.rank})
+            except (PeerDiedException, OSError):
+                continue
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout_s:
+            if self.fleet.epoch > base:
+                return
+            time.sleep(0.05)
 
     # ---------------------------------------------------- installation
 

@@ -30,13 +30,33 @@ Delivery contract (push + ack):
     payload the receiver already accepted) are deduplicated by
     (src, op_id, seq) and re-ACKed without re-delivery.
 
-Fault injection for the chaos/dist gates: set
+Elastic extension (ISSUE 15): frames of kind EDATA carry an 8-byte
+extension — ``u32 epoch | u32 part`` — between the base header and the
+payload.  ``epoch`` is the sender's fleet-membership epoch
+(robustness/fleet.py): a receiver whose view is AHEAD answers the
+``E`` verdict (1 byte + its current u32 epoch) instead of merging, so
+a zombie rank cannot push partitions into a round that already
+rebalanced away from it.  ``part`` names the logical partition; the
+receive side dedups by (op, part) — the FIRST verified copy wins,
+later copies (speculation losers, rebalance replays) are byte-compared
+and dropped into ``srt_shuffle_dup_dropped_total``.  A re-split hot
+partition travels as sub-frames whose part field packs
+(part, sub-index, sub-count); the :class:`PartInbox` stitches them
+back in index order.  CTRL frames (same extension) carry small JSON
+control payloads: death notices, joins, replay fetches.
+
+Fault injection for the chaos/dist/elastic gates: set
 ``SPARK_RAPIDS_TPU_DIST_FAULT="corrupt:<dst>:<op>"`` (or
 ``trunc:<dst>:<op>``) in a worker's environment and its FIRST send to
 that destination/op is corrupted (one payload byte XOR'd after CRC
 computation) or truncated mid-payload with a hard close — the receiver
 NAKs / the ack read fails, and the retry loop must recover with a
-clean resend.  Programmatic twin: :func:`set_link_fault`.
+clean resend.  ``drop:<dst>:<op>`` silently drops the frame (the
+sender forges local success, the receiver never sees it — the
+speculation path's chaos mode), and ``slow:<dst>:<ms>`` injects a
+PERSISTENT per-frame delay of ``ms`` milliseconds on every send to
+``dst`` (the straggler chaos mode).  ``<dst>``/``<op>`` accept ``-1``
+as a wildcard.  Programmatic twin: :func:`set_link_fault`.
 """
 
 from __future__ import annotations
@@ -45,12 +65,14 @@ import os
 import socket
 import struct
 import threading
+import time
 
 from spark_rapids_tpu.analysis import lockdep
 from spark_rapids_tpu.analysis.lockdep import make_lock
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 from spark_rapids_tpu import observability as _obs
+from spark_rapids_tpu.robustness.fleet import StaleEpochError
 from spark_rapids_tpu.robustness.links import (
     PeerDiedException, ShuffleLinkError, with_link_retry)
 from spark_rapids_tpu.robustness.retry import RetryPolicy
@@ -60,10 +82,38 @@ from spark_rapids_tpu.shuffle.socket_io import SocketStream
 FRAME_MAGIC = b"SRTS"
 FRAME_FMT = ">4sBIIIQ"
 FRAME_LEN = struct.calcsize(FRAME_FMT)  # 25
+# elastic extension: u32 epoch | u32 part, between header and payload
+EXT_FMT = ">II"
+EXT_LEN = struct.calcsize(EXT_FMT)  # 8
 KIND_DATA = 1
+KIND_EDATA = 2   # elastic data: epoch-fenced, (op, part)-deduped
+KIND_CTRL = 3    # elastic control: JSON payload (death/join/fetch)
 ACK = b"A"
 NAK = b"N"
+STALE = b"E"     # stale-epoch fence; followed by the receiver's u32 epoch
 MAX_PAYLOAD = 1 << 30  # sanity bound: refuse absurd frame lengths
+
+# re-split part-field packing: flag | part(15b) | sub k (8b) | nsub (8b)
+RESPLIT_FLAG = 0x80000000
+MAX_RESPLIT_PART = (1 << 15) - 1
+MAX_RESPLIT_SUBS = (1 << 8) - 1
+
+
+def pack_resplit(part: int, k: int, nsub: int) -> int:
+    if not (0 <= part <= MAX_RESPLIT_PART
+            and 0 <= k < nsub <= MAX_RESPLIT_SUBS):
+        raise ValueError(f"resplit out of range: part={part} k={k} "
+                         f"nsub={nsub}")
+    return RESPLIT_FLAG | (part << 16) | (k << 8) | nsub
+
+
+def unpack_resplit(field: int) -> Optional[Tuple[int, int, int]]:
+    """(part, k, nsub) when ``field`` is a re-split sub-frame, else
+    None (a plain part id)."""
+    if not field & RESPLIT_FLAG:
+        return None
+    return (field >> 16) & MAX_RESPLIT_PART, (field >> 8) & 0xFF, \
+        field & 0xFF
 
 
 def _parse_addr(addr: str):
@@ -79,20 +129,31 @@ def _parse_addr(addr: str):
 _FAULT_LOCK = make_lock("dist.fault")
 # {(mode, dst, op): remaining} — armed once from env or set_link_fault
 _FAULTS: Dict[Tuple[str, int, int], int] = {}
+# {dst: delay_ms} — PERSISTENT per-frame injected delay (dst -1 = any)
+_SLOW: Dict[int, int] = {}
 
 
 def set_link_fault(mode: str, dst: int, op_id: int,
                    times: int = 1) -> None:
     """Arm a one-shot (default) send fault: ``mode`` 'corrupt' flips a
     payload byte after serialization; 'trunc' sends half the payload
-    and hard-closes the connection."""
+    and hard-closes the connection; 'drop' silently discards the frame
+    (the sender forges success — the receiver must recover by
+    speculation or rebalance, not resend).  ``mode`` 'slow' is
+    different: the third argument is a PER-FRAME delay in
+    milliseconds, applied to every send to ``dst`` until cleared (the
+    injected-straggler mode).  ``dst``/``op_id`` of -1 match any."""
     with _FAULT_LOCK:
-        _FAULTS[(mode, int(dst), int(op_id))] = int(times)
+        if mode == "slow":
+            _SLOW[int(dst)] = int(op_id)
+        else:
+            _FAULTS[(mode, int(dst), int(op_id))] = int(times)
 
 
 def clear_link_faults() -> None:
     with _FAULT_LOCK:
         _FAULTS.clear()
+        _SLOW.clear()
 
 
 def _env_faults() -> None:
@@ -112,13 +173,19 @@ _env_faults()
 
 def _take_fault(dst: int, op_id: int) -> Optional[str]:
     with _FAULT_LOCK:
-        for mode in ("corrupt", "trunc"):
-            key = (mode, dst, op_id)
-            left = _FAULTS.get(key, 0)
-            if left > 0:
-                _FAULTS[key] = left - 1
-                return mode
+        for mode in ("corrupt", "trunc", "drop"):
+            for key in ((mode, dst, op_id), (mode, -1, op_id),
+                        (mode, dst, -1), (mode, -1, -1)):
+                left = _FAULTS.get(key, 0)
+                if left > 0:
+                    _FAULTS[key] = left - 1
+                    return mode
     return None
+
+
+def _slow_ms(dst: int) -> int:
+    with _FAULT_LOCK:
+        return _SLOW.get(dst, _SLOW.get(-1, 0))
 
 
 # -------------------------------------------------------------- inbox
@@ -176,6 +243,136 @@ class Inbox:
             return {s: self._slots.pop((op_id, s)) for s in want}
 
 
+# --------------------------------------------------------- part inbox
+
+
+class PartInbox:
+    """Elastic receive state: verified tables keyed by (op, part),
+    FIRST verified copy wins.  Also stitches re-split sub-frames back
+    into whole parts (index order) and keeps the verified payload
+    bytes per part — the byte-safe replay store a FETCH control
+    message re-serves (kudo frames are CRC'd end to end, so a replayed
+    payload is provably the original bytes)."""
+
+    MAX_OPS = 32  # replay store bound: oldest op evicted past this
+
+    def __init__(self):
+        self._lock = make_lock("dist.part_inbox")
+        self._cv = threading.Condition(self._lock)
+        # op -> {part: [KudoTable]}; payloads keyed (op, part)
+        self._parts: Dict[int, Dict[int, list]] = {}
+        self._payloads: Dict[Tuple[int, int], bytes] = {}
+        # in-flight re-split assembly: (op, part) -> {k: (tables, payload)}
+        self._subs: Dict[Tuple[int, int], Dict[int, tuple]] = {}
+        # parts stitched from sub-frames: their stored payload is a
+        # sub-blob concatenation, NOT byte-comparable against a
+        # whole-table serialization of the same rows
+        self._assembled: Set[Tuple[int, int]] = set()
+        self._order: List[int] = []
+
+    def _op_slot(self, op_id: int) -> Dict[int, list]:
+        cur = self._parts.get(op_id)
+        if cur is None:
+            cur = self._parts[op_id] = {}
+            self._order.append(op_id)
+            while len(self._order) > self.MAX_OPS:
+                old = self._order.pop(0)
+                for p in self._parts.pop(old, {}):
+                    self._payloads.pop((old, p), None)
+                    self._assembled.discard((old, p))
+                for key in [k for k in self._subs if k[0] == old]:
+                    self._subs.pop(key, None)
+        return cur
+
+    def put(self, op_id: int, part: int, tables: list,
+            payload: bytes) -> str:
+        """Deliver one whole part.  Returns 'new' when this copy won,
+        'dup_identical' / 'dup_mismatch' when a copy already merged
+        (the byte compare is the speculative-winner contract:
+        deterministic recomputes MUST collide byte-identically), or
+        'dup_framing' when the winning copy was stitched from
+        re-split sub-frames — same rows, different framing, so the
+        byte compare is inapplicable (NOT corruption evidence)."""
+        with self._cv:
+            return self._put_locked(op_id, part, tables, payload)
+
+    def _put_locked(self, op_id: int, part: int, tables, payload,
+                    assembled: bool = False):
+        cur = self._op_slot(op_id)
+        if part in cur:
+            if assembled or (op_id, part) in self._assembled:
+                return "dup_framing"
+            same = payload == self._payloads.get((op_id, part))
+            return "dup_identical" if same else "dup_mismatch"
+        cur[part] = tables
+        self._payloads[(op_id, part)] = payload
+        if assembled:
+            self._assembled.add((op_id, part))
+        self._subs.pop((op_id, part), None)
+        self._cv.notify_all()
+        return "new"
+
+    def put_sub(self, op_id: int, part: int, k: int, nsub: int,
+                tables: list, payload: bytes) -> str:
+        """One re-split sub-frame.  When the last sub arrives the part
+        assembles in index order (row-slice concatenation — the merged
+        table is byte-identical to the unsplit original).  Returns
+        'sub' (still assembling), 'new' (assembled just now),
+        'dup_identical'/'dup_mismatch' for a duplicate sub-frame, or
+        'dup_framing' when the whole part already merged (a sub
+        colliding with a whole-table copy differs by framing alone)."""
+        with self._cv:
+            cur = self._op_slot(op_id)
+            if part in cur:
+                return "dup_framing"  # whole part already won
+            entry = self._subs.setdefault((op_id, part), {})
+            if k in entry:
+                return ("dup_identical" if payload == entry[k][1]
+                        else "dup_mismatch")
+            entry[k] = (tables, payload)
+            if len(entry) < nsub:
+                return "sub"
+            all_tables: list = []
+            blobs: List[bytes] = []
+            for i in range(nsub):
+                t, b = entry[i]
+                all_tables.extend(t)
+                blobs.append(b)
+            return self._put_locked(op_id, part, all_tables,
+                                    b"".join(blobs), assembled=True)
+
+    def have(self, op_id: int) -> Set[int]:
+        with self._cv:
+            return set(self._parts.get(op_id, ()))
+
+    def get(self, op_id: int) -> Dict[int, list]:
+        with self._cv:
+            return dict(self._parts.get(op_id, {}))
+
+    def payloads(self, op_id: int) -> Dict[int, bytes]:
+        """The replay store for one op (FETCH serves these)."""
+        with self._cv:
+            return {p: self._payloads[(op_id, p)]
+                    for p in self._parts.get(op_id, ())}
+
+    def wait_any(self, op_id: int, want, timeout_s: float) -> bool:
+        """Block until any part in ``want`` is present (or any
+        membership wake poke) — the gather loop re-evaluates policy on
+        every wake, so spurious wakes are cheap."""
+        want = set(want)
+        with self._cv:
+            return self._cv.wait_for(
+                lambda: bool(want & set(self._parts.get(op_id, ()))),
+                timeout=timeout_s)
+
+    def wake(self) -> None:
+        """Membership changed: poke every waiter so gather loops
+        re-read the fleet view immediately instead of riding out
+        their poll timeout."""
+        with self._cv:
+            self._cv.notify_all()
+
+
 # ----------------------------------------------------------- listener
 
 
@@ -186,10 +383,15 @@ class Listener:
     (a truncated link) drop the partial bytes and close — the sender's
     ack read fails and its retry resends."""
 
-    def __init__(self, rank: int, addr: str, inbox: Inbox):
+    def __init__(self, rank: int, addr: str, inbox: Inbox,
+                 sink=None):
         self.rank = rank
         self.addr = addr
         self.inbox = inbox
+        # elastic sink (the ShuffleService in elastic mode): receives
+        # EDATA/CTRL frames and returns the verdict bytes; without one
+        # those kinds are protocol violations (plain PR-10 fleets)
+        self.sink = sink
         self._sock: Optional[socket.socket] = None
         self._threads: List[threading.Thread] = []
         self._conns: set = set()
@@ -299,9 +501,18 @@ class Listener:
                     return  # clean close (or trailing garbage: drop)
                 magic, kind, src, op_id, seq, length = struct.unpack(
                     FRAME_FMT, head)
-                if (magic != FRAME_MAGIC or kind != KIND_DATA
-                        or length > MAX_PAYLOAD):
+                elastic = kind in (KIND_EDATA, KIND_CTRL)
+                if (magic != FRAME_MAGIC or length > MAX_PAYLOAD
+                        or not (kind == KIND_DATA
+                                or (elastic
+                                    and self.sink is not None))):
                     return  # protocol violation: drop the connection
+                epoch = part = 0
+                if elastic:
+                    ext = stream.read(EXT_LEN)
+                    if len(ext) < EXT_LEN:
+                        return
+                    epoch, part = struct.unpack(EXT_FMT, ext)
                 payload = stream.read(length)
                 if len(payload) < length:
                     # truncated link mid-payload: the partial bytes
@@ -312,7 +523,11 @@ class Listener:
                         detail=f"truncated link from rank {src} "
                                f"op {op_id}")
                     return
-                self._answer(conn, src, op_id, seq, payload)
+                if kind == KIND_DATA:
+                    self._answer(conn, src, op_id, seq, payload)
+                else:
+                    self._answer_elastic(conn, kind, src, op_id, seq,
+                                         epoch, part, payload)
         except OSError:
             return
         finally:
@@ -345,6 +560,31 @@ class Listener:
         _obs.record_shuffle_link("recv", src, len(payload), op_id)
         conn.sendall(ACK)
 
+    def _answer_elastic(self, conn, kind: int, src: int, op_id: int,
+                        seq: int, epoch: int, part: int,
+                        payload: bytes) -> None:
+        """EDATA/CTRL dispatch to the elastic sink.  The sink returns
+        the verdict bytes (ACK, NAK, or STALE + its current epoch);
+        the (src, op, seq) link-level dedup still short-circuits
+        exact resends after a lost ACK — logical (op, part) dedup of
+        DISTINCT copies (speculation, replay) is the sink's job."""
+        key = (src, op_id, seq)
+        if kind == KIND_EDATA and self._already_delivered(key):
+            conn.sendall(ACK)
+            return
+        try:
+            if kind == KIND_CTRL:
+                verdict = self.sink.on_ctrl(src, epoch, payload)
+            else:
+                verdict = self.sink.on_edata(src, op_id, seq, epoch,
+                                             part, payload)
+        except (ValueError, EOFError):
+            conn.sendall(NAK)  # corrupt payload: sender resends clean
+            return
+        if kind == KIND_EDATA and verdict[:1] == ACK:
+            self._mark_delivered(key)
+        conn.sendall(verdict)
+
 
 # ---------------------------------------------------------- peer link
 
@@ -364,7 +604,12 @@ class PeerLink:
         self.policy = policy
         self.ack_timeout_s = ack_timeout_s
         self._sock: Optional[socket.socket] = None
-        self._seq = 0
+        # seq namespace is per-INCARNATION: peers keep a persistent
+        # (src, op, seq) dedup table, so a respawned worker whose
+        # links restarted at 0 would collide with its predecessor's
+        # entries and have fresh frames falsely re-ACKed without
+        # delivery — the pid offset keeps incarnations disjoint
+        self._seq = (os.getpid() & 0x7FFF) << 16
         self._lock = make_lock("dist.peer_link")
 
     # ------------------------------------------------------- plumbing
@@ -393,14 +638,22 @@ class PeerLink:
 
     # ----------------------------------------------------------- send
 
-    def send(self, op_id: int, payload: bytes) -> int:
-        """Deliver one kudo payload; returns bytes sent.  Blocks until
-        the peer ACKs (payload verified) or the retry budget dies."""
+    def send(self, op_id: int, payload: bytes, *,
+             kind: int = KIND_DATA, epoch: int = 0,
+             part: int = 0) -> int:
+        """Deliver one payload; returns bytes sent.  Blocks until the
+        peer ACKs (payload verified) or the retry budget dies.  Kinds
+        EDATA/CTRL prepend the elastic (epoch, part) extension; a
+        peer whose membership view is ahead answers the stale-epoch
+        fence, surfaced as :class:`StaleEpochError` (NOT retried —
+        resending the same stale frame can never merge)."""
         with self._lock:
             self._seq += 1
             seq = self._seq
-        head = struct.pack(FRAME_FMT, FRAME_MAGIC, KIND_DATA,
+        head = struct.pack(FRAME_FMT, FRAME_MAGIC, kind,
                            self.my_rank, op_id, seq, len(payload))
+        if kind != KIND_DATA:
+            head += struct.pack(EXT_FMT, epoch, part)
 
         def attempt() -> int:
             with self._lock:
@@ -412,6 +665,11 @@ class PeerLink:
                     # byte could hit the wire (the chaos gate's
                     # "corrupt link healed" signal would go vacuous)
                     fault = _take_fault(self.peer_rank, op_id)
+                    if fault == "drop":
+                        # injected silent frame loss: forge local
+                        # success — the receiver never sees the frame
+                        # and must recover by speculation/rebalance
+                        return len(payload)
                     if fault == "trunc":
                         # inject a truncated link: half the payload,
                         # then a hard close mid-message
@@ -425,6 +683,10 @@ class PeerLink:
                         wire = (payload[:flip]
                                 + bytes([payload[flip] ^ 0xFF])
                                 + payload[flip + 1:])
+                    delay_ms = _slow_ms(self.peer_rank)
+                    if delay_ms > 0:
+                        # injected per-frame straggler delay
+                        time.sleep(delay_ms / 1000.0)
                     # lockdep marker: this link mutex is held across
                     # the wire round-trip BY DESIGN (it serializes one
                     # peer's protocol); the evidence lets an operator
@@ -432,11 +694,26 @@ class PeerLink:
                     lockdep.note_blocking("transport.send")
                     s.sendall(head + wire)
                     verdict = s.recv(1)
+                    peer_epoch = b""
+                    if verdict == STALE:
+                        while len(peer_epoch) < 4:
+                            chunk = s.recv(4 - len(peer_epoch))
+                            if not chunk:
+                                break
+                            peer_epoch += chunk
                 except OSError:
                     self._drop()
                     raise
                 if verdict == ACK:
                     return len(payload)
+                if verdict == STALE and len(peer_epoch) == 4:
+                    # the connection stays healthy: the peer answered
+                    # a complete fence verdict, it just refuses this
+                    # epoch — the ELASTIC layer fast-forwards and
+                    # replays, the link layer must not resend
+                    raise StaleEpochError(
+                        self.peer_rank, struct.unpack(">I",
+                                                      peer_epoch)[0])
                 self._drop()
                 if verdict == NAK:
                     raise ShuffleLinkError(

@@ -211,6 +211,38 @@ STAGE_FUSION = METRICS.counter(
     "executable, unfused = op-by-op walk, compile = a fused "
     "executable was built this run)", labels=("stage", "outcome"),
     max_series=128)
+FLEET_EPOCH = METRICS.gauge(
+    "srt_fleet_epoch",
+    "Elastic-fleet membership epoch on this worker (bumps on every "
+    "observed leave/join; stale-epoch frames are fenced)")
+FLEET_REBALANCES = METRICS.counter(
+    "srt_fleet_rebalances_total",
+    "Membership changes that moved shard ownership (peer death -> "
+    "survivors inherit)", labels=("change",))
+FLEET_DEATHS = METRICS.counter(
+    "srt_fleet_deaths_total",
+    "Peer ranks observed dead by this worker", labels=("peer",),
+    max_series=128)
+FLEET_SPECULATIONS = METRICS.counter(
+    "srt_fleet_speculations_total",
+    "Speculative re-executions of a straggler's partition, by "
+    "outcome (won = the speculated copy merged first, lost = the "
+    "original arrived first, cancelled = the original arrived "
+    "mid-compute and the speculative task was cancelled)",
+    labels=("outcome",))
+FLEET_RESPLITS = METRICS.counter(
+    "srt_fleet_resplits_total",
+    "Hot partitions re-split into per-rank sub-partitions for a "
+    "second exchange round")
+FLEET_STALE_NAKS = METRICS.counter(
+    "srt_fleet_stale_naks_total",
+    "Elastic frames fenced for carrying a stale membership epoch "
+    "(answered E, never merged)", labels=("peer",), max_series=128)
+SHUFFLE_DUP_DROPPED = METRICS.counter(
+    "srt_shuffle_dup_dropped_total",
+    "Duplicate (op, partition) deliveries dropped after the byte "
+    "compare (speculation losers, rebalance replays)",
+    labels=("peer",), max_series=128)
 INCIDENTS_TOTAL = METRICS.counter(
     "srt_incidents_total",
     "Flight-recorder incident bundles written, by trigger kind",
@@ -519,6 +551,101 @@ def record_shuffle_link_retry(peer: str, reason: str) -> None:
     peer = str(peer)
     SHUFFLE_LINK_RETRIES.inc(labels=(peer, reason))
     JOURNAL.emit("shuffle_link_retry", peer=peer, reason=reason,
+                 thread=threading.get_ident())
+
+
+def set_fleet_epoch(epoch: int) -> None:
+    """Elastic-fleet membership epoch on this worker
+    (robustness/fleet.py)."""
+    if not _SWITCH.enabled:
+        return
+    FLEET_EPOCH.set(int(epoch))
+
+
+def record_fleet_membership(change: str, *, dead, epoch: int, live,
+                            moved=None, joined=None) -> None:
+    """One membership transition: ``change`` 'death' (ranks left,
+    shards moved to survivors) or 'join' (a worker (re)joined the
+    live set).  The journal event is the rebalance evidence the
+    elastic-smoke gate and srt-doctor read."""
+    if not _SWITCH.enabled:
+        return
+    FLEET_EPOCH.set(int(epoch))
+    if moved:
+        FLEET_REBALANCES.inc(labels=(change,))
+    for r in dead or ():
+        FLEET_DEATHS.inc(labels=(str(r),))
+    JOURNAL.emit("fleet_membership", change=change,
+                 dead=[int(r) for r in dead or ()],
+                 joined=joined, epoch=int(epoch),
+                 live=[int(r) for r in live],
+                 moved={str(k): int(v)
+                        for k, v in (moved or {}).items()},
+                 thread=threading.get_ident())
+
+
+def record_fleet_speculation(op_id: int, part: int, owner: int,
+                             by: int, outcome: str,
+                             evidence: Optional[dict] = None) -> None:
+    """One speculative re-execution decision resolved: ``outcome`` in
+    {'won', 'lost', 'cancelled'} — won means the speculated copy
+    merged first (the straggling owner's late frames dedup-drop),
+    lost/cancelled mean the original beat the speculation."""
+    if not _SWITCH.enabled:
+        return
+    FLEET_SPECULATIONS.inc(labels=(outcome,))
+    JOURNAL.emit("fleet_speculation", op=int(op_id), part=int(part),
+                 owner=int(owner), by=int(by), outcome=outcome,
+                 evidence=evidence or {},
+                 thread=threading.get_ident())
+
+
+def record_fleet_resplit(op_id: int, part: int, nsub: int,
+                         nbytes: int,
+                         evidence: Optional[dict] = None) -> None:
+    """A hot partition re-split into ``nsub`` sub-partitions for a
+    second exchange round (skew evidence from the live link-byte
+    deltas rides in ``evidence``)."""
+    if not _SWITCH.enabled:
+        return
+    FLEET_RESPLITS.inc()
+    JOURNAL.emit("fleet_resplit", op=int(op_id), part=int(part),
+                 nsub=int(nsub), bytes=int(nbytes),
+                 evidence=evidence or {},
+                 thread=threading.get_ident())
+
+
+def record_fleet_stale_nak(peer, frame_epoch: int,
+                           local_epoch: int) -> None:
+    """An elastic frame arrived carrying an epoch older than this
+    worker's view: fenced with the E verdict, never merged."""
+    if not _SWITCH.enabled:
+        return
+    FLEET_STALE_NAKS.inc(labels=(str(peer),))
+    JOURNAL.emit("fleet_stale_nak", peer=str(peer),
+                 frame_epoch=int(frame_epoch),
+                 local_epoch=int(local_epoch),
+                 thread=threading.get_ident())
+
+
+def record_shuffle_dup_dropped(peer, op_id: int, part: int,
+                               identical: Optional[bool]) -> None:
+    """A duplicate (op, partition) delivery was dropped: the first
+    verified copy won; this one (a speculation loser or a rebalance
+    replay) is byte-compared and discarded.  ``identical=False`` is
+    recorded loudly — deterministic recomputes must produce the same
+    bytes, so a mismatch is corruption-grade evidence.
+    ``identical=None`` means the compare was inapplicable: the
+    winning copy was stitched from re-split sub-frames, so the same
+    rows carry different framing bytes."""
+    if not _SWITCH.enabled:
+        return
+    peer = str(peer)
+    SHUFFLE_DUP_DROPPED.inc(labels=(peer,))
+    JOURNAL.emit("shuffle_dup_dropped", peer=peer, op=int(op_id),
+                 part=int(part),
+                 identical=(None if identical is None
+                            else bool(identical)),
                  thread=threading.get_ident())
 
 

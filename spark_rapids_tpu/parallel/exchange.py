@@ -26,7 +26,7 @@ hand-roll the check.
 from __future__ import annotations
 
 import time
-from typing import Callable, Sequence
+from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -224,7 +224,8 @@ def with_capacity_retry(make_step: Callable[[int], Callable],
                         max_doublings: int = 6,
                         overflow_index: int = -1,
                         policy=None,
-                        counts_indicator: bool = False):
+                        counts_indicator: bool = False,
+                        check: Optional[Callable[[], None]] = None):
     """Centralized overflow retry for fixed-capacity SPMD programs.
 
     make_step(capacity) must return a callable whose output tuple
@@ -246,6 +247,12 @@ def with_capacity_retry(make_step: Callable[[int], Callable],
     (default ``max_doublings + 1``), applies its backoff between
     rebuilds, and its wall-clock deadline — a deadline hit raises
     CapacityExceeded early instead of compiling ever-larger programs.
+
+    ``check`` (optional) runs at the top of EVERY capacity attempt —
+    the elastic fleet passes ``QueryContext.check_cancel`` here so a
+    speculative re-execution whose original arrived mid-retry unwinds
+    through the cooperative cancel machinery instead of compiling the
+    next doubling for a result nobody wants.
 
     Returns run(*args) -> (outputs, capacity_used)."""
     from spark_rapids_tpu.perf import jit_cache as _jc
@@ -282,6 +289,8 @@ def with_capacity_retry(make_step: Callable[[int], Callable],
             lost_ns = 0
             prev_backoff = 0.0
             while True:
+                if check is not None:
+                    check()
                 attempt_t0 = time.monotonic_ns()
                 out = _step_for(cap)(*args)
                 indicator = np.asarray(out[overflow_index])

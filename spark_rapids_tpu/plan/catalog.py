@@ -162,10 +162,16 @@ def run_q5(d, stores: int, capacity: int):
     return outs
 
 
-def run_q5_partials(args, stores: int, capacity: int):
+def run_q5_partials(args, stores: int, capacity: int, *, ctx=None):
     """Distributed map side: ONE executable per rank before the kudo
     exchange.  ``args`` = 8 sharded fact columns + the replicated
-    d_date window; returns ((sales, rets, profit, seen, of), cap)."""
+    d_date window; returns ((sales, rets, profit, seen, of), cap).
+
+    ``ctx`` (optional QueryContext) makes the stage CANCELLABLE: the
+    elastic fleet's speculative re-executions pass their cancel-capable
+    context so a speculation whose original arrived mid-run unwinds
+    between capacity attempts through the lifeguard machinery instead
+    of finishing a result nobody will merge."""
     from spark_rapids_tpu.parallel.exchange import with_capacity_retry
 
     def build(cap):
@@ -173,7 +179,9 @@ def run_q5_partials(args, stores: int, capacity: int):
         return lambda *a: st.run({"s": a[0:4], "r": a[4:8],
                                   "d": (a[8],)})
 
-    return with_capacity_retry(build, capacity, max_doublings=16)(*args)
+    return with_capacity_retry(
+        build, capacity, max_doublings=16,
+        check=ctx.check_cancel if ctx is not None else None)(*args)
 
 
 def run_q5_finish(sales, rets, profit, seen, of, st_id, stores: int):

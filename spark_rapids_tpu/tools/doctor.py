@@ -252,6 +252,18 @@ def analyze(bundle: Bundle) -> List[dict]:
                         f">= threshold "
                         f"{_fmt_bytes(detail.get('threshold_bytes', 0))}"
                         f" for {detail.get('sustained_s')}s")})
+    elif kind == "fleet_incident":
+        dead = detail.get("dead", [])
+        moved = detail.get("shards_moved") or {}
+        heirs = sorted(set(moved.values()))
+        findings.append({
+            "severity": 84, "kind": "fleet_incident",
+            "message": (f"fleet membership change on rank "
+                        f"{detail.get('rank')}: dead rank(s) "
+                        f"{dead} at epoch {detail.get('epoch')}; "
+                        f"shard(s) {sorted(moved)} rebalanced to "
+                        f"rank(s) {heirs}; live={detail.get('live')}"
+                        )})
     elif kind == "query_hang":
         tenant = detail.get("tenant", "?")
         query = detail.get("query", "?")
@@ -460,6 +472,46 @@ def analyze(bundle: Bundle) -> List[dict]:
             "message": (f"{len(corrupt)} kudo corruption event(s) in "
                         f"the journal ({_fmt_bytes(skipped)} resync-"
                         f"skipped)")})
+
+    # ---- fleet journal history (dead / slow / hot) ------------------
+    deaths = [r for r in bundle.journal
+              if r.get("kind") == "fleet_membership"
+              and r.get("change") == "death"]
+    if deaths and kind != "fleet_incident":
+        last = deaths[-1]
+        findings.append({
+            "severity": 72, "kind": "fleet_incident",
+            "message": (f"{len(deaths)} fleet death event(s) in the "
+                        f"journal — dead rank(s) "
+                        f"{sorted({d for r in deaths for d in (r.get('dead') or [])})} "
+                        f"(last at epoch {last.get('epoch')}, moved "
+                        f"{last.get('moved') or {}})")})
+    specs = [r for r in bundle.journal
+             if r.get("kind") == "fleet_speculation"]
+    if specs:
+        by_owner: Dict[str, List[dict]] = {}
+        for r in specs:
+            by_owner.setdefault(str(r.get("owner")), []).append(r)
+        slowest = max(by_owner.items(), key=lambda kv: len(kv[1]))
+        won = sum(1 for r in specs if r.get("outcome") == "won")
+        findings.append({
+            "severity": 62, "kind": "fleet_straggler",
+            "message": (f"slow rank {slowest[0]}: "
+                        f"{len(slowest[1])} partition(s) "
+                        f"speculatively re-executed ({won} "
+                        f"speculation(s) won fleet-wide; evidence: "
+                        f"{slowest[1][-1].get('evidence', {})})")})
+    resplits = [r for r in bundle.journal
+                if r.get("kind") == "fleet_resplit"]
+    if resplits:
+        last = resplits[-1]
+        findings.append({
+            "severity": 48, "kind": "fleet_skew",
+            "message": (f"{len(resplits)} hot partition(s) re-split "
+                        f"(last: op {last.get('op')} part "
+                        f"{last.get('part')} -> {last.get('nsub')} "
+                        f"sub-partitions, {last.get('bytes', 0)} "
+                        f"bytes)")})
 
     # ---- stage stragglers from the span ring ------------------------
     stages: Dict[str, List[int]] = {}

@@ -556,6 +556,102 @@ def render_io_table(events: List[dict],
     return out
 
 
+def fleet_rows(events: List[dict],
+               registry: Optional[dict]) -> dict:
+    """Elastic-fleet accounting: per-peer link bytes (+ dup drops and
+    observed deaths), the fleet skew ratio (max/median of per-peer
+    recv bytes), speculation outcomes, rebalances, re-splits, and the
+    membership epoch — the ISSUE-15 evidence surface."""
+    reg = registry or {}
+
+    def series(name: str) -> List[dict]:
+        return (reg.get(name) or {}).get("series", [])
+
+    peers: Dict[str, dict] = {}
+
+    def peer(p: str) -> dict:
+        return peers.setdefault(p, {
+            "peer": p, "send_bytes": 0, "recv_bytes": 0,
+            "dup_dropped": 0, "deaths": 0, "stale_naks": 0})
+
+    for s in series("srt_shuffle_link_bytes_total"):
+        d, p = (list(s.get("labels", ())) + ["?", "?"])[:2]
+        key = "send_bytes" if d == "send" else "recv_bytes"
+        peer(p)[key] += int(s.get("value", 0))
+    for name, key in (("srt_shuffle_dup_dropped_total",
+                       "dup_dropped"),
+                      ("srt_fleet_deaths_total", "deaths"),
+                      ("srt_fleet_stale_naks_total", "stale_naks")):
+        for s in series(name):
+            p = (list(s.get("labels", ())) + ["?"])[0]
+            peer(p)[key] += int(s.get("value", 0))
+    recv = sorted(r["recv_bytes"] for r in peers.values()
+                  if r["recv_bytes"] > 0)
+    med = recv[(len(recv) - 1) // 2] if recv else 0  # lower median
+    skew = (round(recv[-1] / med, 2)
+            if len(recv) >= 2 and med > 0 else None)
+    spec = {"won": 0, "lost": 0, "cancelled": 0}
+    for s in series("srt_fleet_speculations_total"):
+        lab = (list(s.get("labels", ())) + ["?"])[0]
+        if lab in spec:
+            spec[lab] += int(s.get("value", 0))
+    epoch_series = series("srt_fleet_epoch")
+    epoch = int(epoch_series[0]["value"]) if epoch_series else 0
+    rebalances = sum(int(s.get("value", 0)) for s in
+                     series("srt_fleet_rebalances_total"))
+    resplits = sum(int(s.get("value", 0)) for s in
+                   series("srt_fleet_resplits_total"))
+    memberships = [
+        {"change": e.get("change"), "dead": e.get("dead"),
+         "joined": e.get("joined"), "epoch": e.get("epoch"),
+         "moved": e.get("moved")}
+        for e in events if e.get("kind") == "fleet_membership"]
+    return {
+        "peers": sorted(peers.values(), key=lambda r: r["peer"]),
+        "skew_ratio": skew,
+        "speculations": spec,
+        "rebalances": rebalances,
+        "resplits": resplits,
+        "epoch": epoch,
+        "memberships": memberships,
+    }
+
+
+def render_fleet_table(events: List[dict],
+                       registry: Optional[dict]) -> List[str]:
+    """Fleet table: per-peer wire bytes + dedup/death evidence, then
+    the one-line elasticity summary (epoch, rebalances, speculation
+    won/lost, re-splits, skew)."""
+    f = fleet_rows(events, registry)
+    out = ["", "fleet (elastic shuffle)", ""]
+    if not f["peers"] and not f["memberships"]:
+        out.append("(no fleet activity recorded)")
+        return out
+    hdr = (f"{'peer':>4}  {'send_MB':>8}  {'recv_MB':>8}  "
+           f"{'dup_drop':>8}  {'deaths':>6}  {'stale':>5}")
+    out.append(hdr)
+    out.append("-" * len(hdr))
+    for r in f["peers"]:
+        out.append(
+            f"{r['peer']:>4}  {r['send_bytes'] / 1e6:>8.2f}  "
+            f"{r['recv_bytes'] / 1e6:>8.2f}  {r['dup_dropped']:>8}  "
+            f"{r['deaths']:>6}  {r['stale_naks']:>5}")
+    spec = f["speculations"]
+    out.append("")
+    out.append(
+        f"epoch {f['epoch']}  rebalances {f['rebalances']}  "
+        f"speculations won/lost/cancelled "
+        f"{spec['won']}/{spec['lost']}/{spec['cancelled']}  "
+        f"resplits {f['resplits']}  "
+        f"skew_ratio {f['skew_ratio'] if f['skew_ratio'] else '-'}")
+    for m in f["memberships"][:8]:
+        what = (f"dead={m['dead']}" if m["change"] == "death"
+                else f"joined={m['joined']}")
+        out.append(f"  membership: {m['change']} {what} "
+                   f"epoch={m['epoch']} moved={m['moved'] or {}}")
+    return out
+
+
 def render_event_table(events: List[dict]) -> List[str]:
     counts: Dict[str, int] = {}
     for e in events:
@@ -600,6 +696,7 @@ def build_report(records: List[dict]) -> dict:
         "stages": stage_rows(events),
         "server": server_rows(events, registry),
         "io": io_rows(events, registry),
+        "fleet": fleet_rows(events, registry),
     }
 
 
@@ -630,6 +727,12 @@ def main(argv=None) -> int:
         lines += render_server_table(events, registry)
     if any(e.get("kind") == "io_file" for e in events):
         lines += render_io_table(events, registry)
+    if any(e.get("kind", "").startswith("fleet_") for e in events) \
+            or (registry or {}).get("srt_fleet_rebalances_total",
+                                    {}).get("series") \
+            or (registry or {}).get("srt_shuffle_dup_dropped_total",
+                                    {}).get("series"):
+        lines += render_fleet_table(events, registry)
     if any(e.get("kind") == "stage_fusion" for e in events):
         lines += render_stage_table(events)
     if registry is not None:

@@ -1,0 +1,757 @@
+"""Elastic fleet tests (ISSUE 15): membership policy + epoch fencing,
+(op, part) dedup of duplicated speculative winners, drop/slow chaos
+modes, death -> rebalance, straggler -> speculation (win AND cancel),
+skew -> re-split, the membership-tolerant barrier + graceful leave,
+the launcher babysitter (fast-fail + respawn), and the report/doctor
+evidence surfaces.  The full 4-process chaos run (kill + respawn +
+slow rank + one stitched trace) is `make elastic-smoke`."""
+
+import io
+import json
+import os
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import observability as obs
+from spark_rapids_tpu.columns import dtypes
+from spark_rapids_tpu.columns.column import Column
+from spark_rapids_tpu.columns.table import Table
+from spark_rapids_tpu.robustness.fleet import (
+    ElasticFleet, ElasticPolicy, StaleEpochError)
+from spark_rapids_tpu.robustness.retry import RetryPolicy
+from spark_rapids_tpu.shuffle import kudo
+from spark_rapids_tpu.shuffle.schema import schema_of_table
+
+
+@pytest.fixture
+def crc_on():
+    prior = kudo.set_crc_enabled(True)
+    yield
+    kudo.set_crc_enabled(prior)
+
+
+@pytest.fixture
+def metrics_on():
+    obs.enable()
+    obs.reset()
+    yield
+    obs.disable()
+
+
+FAST = RetryPolicy(max_attempts=2, base_backoff_s=0.01,
+                   max_backoff_s=0.05, deadline_s=5.0)
+
+
+def mk(vals):
+    import jax.numpy as jnp
+    return Table([Column(dtypes.INT64, len(vals),
+                         data=jnp.asarray(np.asarray(vals,
+                                                     np.int64)))])
+
+
+def col0(tables):
+    merged = kudo.merge_to_table(tables, schema_of_table(mk([0])))
+    return merged.columns[0].to_numpy().tolist()
+
+
+def _services(tmp_path, world, *, live=None, fleets=None, **kw):
+    from spark_rapids_tpu.distributed.service import ShuffleService
+    addrs = [f"unix:{os.path.join(str(tmp_path), f'e{r}.sock')}"
+             for r in range(world)]
+    svcs = []
+    for r in range(world):
+        fleet = fleets[r] if fleets else None
+        s = ShuffleService(r, world, addrs, elastic=True,
+                           policy=FAST, fleet=fleet, **kw)
+        if live is None or r in live:
+            s.start()
+        svcs.append(s)
+    return svcs
+
+
+# ------------------------------------------------------------- policy
+
+
+class TestElasticPolicy:
+
+    def test_assign_identity_when_all_live(self):
+        assert ElasticPolicy().assign(4, set()) == (0, 1, 2, 3)
+
+    def test_assign_dead_goes_to_least_loaded_lowest_rank(self):
+        p = ElasticPolicy()
+        assert p.assign(4, {1}) == (0, 0, 2, 3)
+        # second death spreads: rank 0 already carries shard 1
+        assert p.assign(4, {1, 2}) == (0, 0, 3, 3)
+        assert p.assign(4, {0, 2}) == (1, 1, 3, 3)
+
+    def test_assign_deterministic_across_callers(self):
+        p = ElasticPolicy()
+        for dead in ({2}, {0, 3}, {1, 2, 3}):
+            assert p.assign(6, dead) == p.assign(6, set(dead))
+
+    def test_speculator_least_loaded_excludes_owner(self):
+        fleet = ElasticFleet(0, 4)
+        view = fleet.view()
+        assert fleet.policy.speculator(view, 1) == 0
+        assert fleet.policy.speculator(view, 0) == 1
+        fleet.note_death([3])  # rank 0 inherits shard 3 (load 2)
+        view = fleet.view()
+        # owner 1 flagged: candidates 0 (load 2) and 2 (load 1)
+        assert fleet.policy.speculator(view, 1) == 2
+
+    def test_membership_epoch_and_moves(self, metrics_on):
+        fleet = ElasticFleet(0, 4)
+        assert fleet.epoch == 0
+        assert fleet.note_death([2])
+        assert fleet.epoch == 1
+        assert fleet.view().owner(2) == 0
+        assert not fleet.note_death([2])  # idempotent
+        assert fleet.note_join(2)
+        v = fleet.view()
+        assert 2 in v.live and v.owner(2) == 0  # no churn-back
+        ev = [r for r in obs.JOURNAL.records()
+              if r.get("kind") == "fleet_membership"]
+        assert [e["change"] for e in ev] == ["death", "join"]
+        assert ev[0]["moved"] == {"2": 0}
+
+    def test_never_marks_self_dead(self):
+        fleet = ElasticFleet(1, 3)
+        assert not fleet.note_death([1])
+        assert 1 in fleet.view().live
+
+    def test_leave_is_departure_without_incident(self, metrics_on):
+        fleet = ElasticFleet(0, 3)
+        assert fleet.note_leave(2)
+        assert 2 in fleet.view().departed
+        ev = [r for r in obs.JOURNAL.records()
+              if r.get("kind") == "fleet_membership"]
+        assert ev[-1]["change"] == "leave"
+
+    def test_learn_epoch_only_fast_forwards(self):
+        fleet = ElasticFleet(0, 2)
+        fleet.learn_epoch(5)
+        assert fleet.epoch == 5
+        fleet.learn_epoch(3)
+        assert fleet.epoch == 5
+        assert fleet.is_stale(4) and not fleet.is_stale(5)
+
+    def test_should_speculate_floor_and_z(self):
+        fleet = ElasticFleet(0, 4, spec_delay_s=1.0, min_arrivals=3)
+        assert fleet.should_speculate(9, int(0.5e9)) is None
+        ev = fleet.should_speculate(9, int(1.5e9))
+        assert ev and ev["reason"] == "delay_floor"
+        for src in range(3):
+            fleet.note_arrival(9, src, src, 10_000_000)  # 10ms each
+        ev = fleet.should_speculate(9, int(0.5e9))
+        assert ev and ev["reason"] == "robust_z"
+
+    def test_hot_part_needs_history(self):
+        fleet = ElasticFleet(0, 2, skew_ratio=3.0)
+        assert fleet.hot_part(7, 1 << 20) is None  # no history
+        fleet.note_part_bytes(7, 1000)
+        fleet.note_part_bytes(7, 1200)
+        hot = fleet.hot_part(7, 50_000)
+        assert hot and hot["ratio"] > 3.0
+        assert fleet.hot_part(7, 2000) is None
+
+
+# ------------------------------------------------- frames + part inbox
+
+
+class TestWire:
+
+    def test_resplit_field_roundtrip(self):
+        from spark_rapids_tpu.distributed.transport import (
+            pack_resplit, unpack_resplit)
+        f = pack_resplit(300, 2, 5)
+        assert unpack_resplit(f) == (300, 2, 5)
+        assert unpack_resplit(300) is None
+        with pytest.raises(ValueError):
+            pack_resplit(300, 5, 5)  # k must be < nsub
+
+    def test_part_inbox_first_copy_wins(self):
+        from spark_rapids_tpu.distributed.transport import PartInbox
+        pi = PartInbox()
+        assert pi.put(1, 0, ["t"], b"abc") == "new"
+        assert pi.put(1, 0, ["u"], b"abc") == "dup_identical"
+        assert pi.put(1, 0, ["v"], b"xyz") == "dup_mismatch"
+        assert pi.get(1) == {0: ["t"]}
+
+    def test_part_inbox_sub_assembly_in_order(self):
+        from spark_rapids_tpu.distributed.transport import PartInbox
+        pi = PartInbox()
+        assert pi.put_sub(1, 4, 1, 2, ["b"], b"B") == "sub"
+        assert pi.put_sub(1, 4, 1, 2, ["b"], b"B") == "dup_identical"
+        assert pi.put_sub(1, 4, 1, 2, ["x"], b"X") == "dup_mismatch"
+        assert pi.put_sub(1, 4, 0, 2, ["a"], b"A") == "new"
+        assert pi.get(1)[4] == ["a", "b"]
+        assert pi.payloads(1)[4] == b"AB"
+        # a whole-table copy of the SAME rows frames differently than
+        # the sub-blob concatenation: a framing dup, NOT corruption
+        assert pi.put(1, 4, ["ab"], b"whole") == "dup_framing"
+        assert pi.put_sub(1, 4, 0, 2, ["a"], b"A") == "dup_framing"
+
+    def test_part_inbox_bounds_ops(self):
+        from spark_rapids_tpu.distributed.transport import PartInbox
+        pi = PartInbox()
+        for op in range(PartInbox.MAX_OPS + 4):
+            pi.put(op, 0, ["t"], b"x")
+        assert pi.have(0) == set()          # oldest evicted
+        assert pi.have(PartInbox.MAX_OPS + 3) == {0}
+
+    def test_drop_fault_forges_success(self, tmp_path, crc_on,
+                                       metrics_on):
+        from spark_rapids_tpu.distributed import transport as TR
+        inbox = TR.Inbox()
+        listener = TR.Listener(
+            0, f"unix:{os.path.join(str(tmp_path), 'd.sock')}",
+            inbox).start()
+        link = TR.PeerLink(1, 0, listener.addr, policy=FAST)
+        try:
+            TR.set_link_fault("drop", 0, 33)
+            buf = io.BytesIO()
+            t = mk([1, 2, 3])
+            kudo.write_to_stream(t.columns, buf, 0, t.num_rows)
+            n = link.send(33, buf.getvalue())
+            assert n == len(buf.getvalue())  # sender believes it
+            from spark_rapids_tpu.robustness.links import \
+                PeerDiedException
+            with pytest.raises(PeerDiedException):
+                inbox.wait(33, [1], timeout_s=0.3)  # receiver never saw
+        finally:
+            TR.clear_link_faults()
+            link.close()
+            listener.stop()
+
+    def test_slow_fault_delays_each_frame(self, tmp_path, crc_on):
+        from spark_rapids_tpu.distributed import transport as TR
+        inbox = TR.Inbox()
+        listener = TR.Listener(
+            0, f"unix:{os.path.join(str(tmp_path), 's.sock')}",
+            inbox).start()
+        link = TR.PeerLink(1, 0, listener.addr, policy=FAST)
+        try:
+            TR.set_link_fault("slow", 0, 300)
+            buf = io.BytesIO()
+            t = mk([1])
+            kudo.write_to_stream(t.columns, buf, 0, t.num_rows)
+            t0 = time.monotonic()
+            link.send(44, buf.getvalue())
+            assert time.monotonic() - t0 >= 0.3
+        finally:
+            TR.clear_link_faults()
+            link.close()
+            listener.stop()
+
+    def test_stale_epoch_fenced_with_E(self, tmp_path, crc_on,
+                                       metrics_on):
+        """A frame carrying an old epoch is answered E + the
+        receiver's epoch, surfaced typed, and never merged."""
+        svcs = _services(tmp_path, 3, live={0})
+        try:
+            svcs[0].fleet.note_death([2])  # receiver is at epoch 1
+            from spark_rapids_tpu.distributed.transport import (
+                KIND_EDATA, PeerLink)
+            link = PeerLink(1, 0, svcs[0].addresses[0], policy=FAST)
+            buf = io.BytesIO()
+            t = mk([5])
+            kudo.write_to_stream(t.columns, buf, 0, t.num_rows)
+            with pytest.raises(StaleEpochError) as ei:
+                link.send(55, buf.getvalue(), kind=KIND_EDATA,
+                          epoch=0, part=0)
+            assert ei.value.epoch == 1
+            assert svcs[0].parts.have(55) == set()
+            snap = obs.METRICS.snapshot()
+            naks = snap["srt_fleet_stale_naks_total"]["series"]
+            assert sum(s["value"] for s in naks) == 1
+            link.close()
+        finally:
+            svcs[0].stop()
+
+
+# ------------------------------------------------- dedup (satellite 3)
+
+
+class TestSpeculativeWinnerDedup:
+
+    def test_two_ranks_same_part_merge_exactly_once(
+            self, tmp_path, crc_on, metrics_on):
+        """Two ranks push the SAME (op, partition) result (a
+        speculative winner and the straggling original): exactly one
+        table merges, byte-identical, with the loser's frame counted
+        in srt_shuffle_dup_dropped_total."""
+        from spark_rapids_tpu.distributed.transport import (
+            KIND_EDATA, PeerLink)
+        svcs = _services(tmp_path, 3, live={0})
+        try:
+            t = mk([7, 8, 9])
+            buf = io.BytesIO()
+            kudo.write_to_stream(t.columns, buf, 0, t.num_rows)
+            payload = buf.getvalue()
+            links = [PeerLink(src, 0, svcs[0].addresses[0],
+                              policy=FAST) for src in (1, 2)]
+            for link in links:
+                assert link.send(66, payload, kind=KIND_EDATA,
+                                 epoch=0, part=4) == len(payload)
+            got = svcs[0].parts.get(66)
+            assert set(got) == {4}
+            assert col0(got[4]) == [7, 8, 9]
+            snap = obs.METRICS.snapshot()
+            dups = {tuple(s["labels"]): s["value"] for s in
+                    snap["srt_shuffle_dup_dropped_total"]["series"]}
+            assert dups == {("2",): 1}  # the second sender lost
+            ev = [r for r in obs.JOURNAL.records()
+                  if r.get("kind") == "shuffle_dup_dropped"]
+            assert len(ev) == 1 and ev[0]["identical"] is True
+            for link in links:
+                link.close()
+        finally:
+            svcs[0].stop()
+
+    def test_link_level_resend_is_not_a_dup(self, tmp_path, crc_on,
+                                            metrics_on):
+        """An exact (src, op, seq) resend after a lost ACK re-ACKs
+        without touching the dup counter (that is link plumbing, not
+        a speculation loser)."""
+        from spark_rapids_tpu.distributed import transport as TR
+        svcs = _services(tmp_path, 2, live={0})
+        try:
+            t = mk([1, 2])
+            buf = io.BytesIO()
+            kudo.write_to_stream(t.columns, buf, 0, t.num_rows)
+            payload = buf.getvalue()
+            link = TR.PeerLink(1, 0, svcs[0].addresses[0],
+                               policy=FAST)
+            # hand-roll the same (src, op, seq, part) frame twice
+            head = struct.pack(TR.FRAME_FMT, TR.FRAME_MAGIC,
+                               TR.KIND_EDATA, 1, 77, 9, len(payload))
+            head += struct.pack(TR.EXT_FMT, 0, 0)
+            import socket as _socket
+            fam, target = TR._parse_addr(svcs[0].addresses[0])
+            s = _socket.socket(fam, _socket.SOCK_STREAM)
+            s.connect(target)
+            for _ in range(2):
+                s.sendall(head + payload)
+                assert s.recv(1) == TR.ACK
+            s.close()
+            link.close()
+            snap = obs.METRICS.snapshot()
+            assert "series" not in snap.get(
+                "srt_shuffle_dup_dropped_total", {}) or not snap[
+                "srt_shuffle_dup_dropped_total"]["series"]
+        finally:
+            svcs[0].stop()
+
+
+# --------------------------------------------- elastic exchange e2e
+
+
+class TestElasticExchange:
+
+    def test_broadcast_gather_converges(self, tmp_path, crc_on,
+                                        metrics_on):
+        svcs = _services(tmp_path, 2)
+        outs = [None, None]
+        try:
+            def work(r):
+                def compute(p, ctx):
+                    return mk([p * 10, p * 10 + 1])
+                svcs[r].broadcast_part(50, r, compute(r, None))
+                got = svcs[r].gather_parts(50, [0, 1],
+                                           compute=compute,
+                                           deadline_s=20)
+                outs[r] = {p: col0(t) for p, t in got.items()}
+
+            ts = [threading.Thread(target=work, args=(r,))
+                  for r in range(2)]
+            [t.start() for t in ts]
+            [t.join(30) for t in ts]
+        finally:
+            for s in svcs:
+                s.stop()
+        assert outs[0] == outs[1] == {0: [0, 1], 1: [10, 11]}
+
+    def test_dead_rank_rebalances_to_inheritor(self, tmp_path, crc_on,
+                                               metrics_on):
+        """Rank 2 never starts: survivors detect the death on their
+        failed sends, gossip the membership change, and the
+        fleet-assigned inheritor recomputes shard 2 — both survivors
+        converge, with rebalance + inherit evidence."""
+        svcs = _services(tmp_path, 3, live={0, 1})
+        outs = [None, None]
+        try:
+            def work(r):
+                def compute(p, ctx):
+                    return mk([p * 10, p * 10 + 1])
+                svcs[r].broadcast_part(60, r, compute(r, None))
+                got = svcs[r].gather_parts(60, [0, 1, 2],
+                                           compute=compute,
+                                           deadline_s=30)
+                outs[r] = {p: col0(t) for p, t in got.items()}
+
+            ts = [threading.Thread(target=work, args=(r,))
+                  for r in range(2)]
+            [t.start() for t in ts]
+            [t.join(60) for t in ts]
+        finally:
+            for s in svcs[:2]:
+                s.stop()
+        want = {0: [0, 1], 1: [10, 11], 2: [20, 21]}
+        assert outs[0] == outs[1] == want
+        assert svcs[0].fleet.view().departed == {2}
+        snap = obs.METRICS.snapshot()
+        reb = snap["srt_fleet_rebalances_total"]["series"]
+        assert sum(s["value"] for s in reb) >= 1
+        kinds = [r.get("kind") for r in obs.JOURNAL.records()]
+        assert "fleet_membership" in kinds
+        assert "fleet_inherit" in kinds
+
+    def test_straggler_speculation_wins_and_loser_dedups(
+            self, tmp_path, crc_on, metrics_on):
+        from spark_rapids_tpu.distributed import transport as TR
+        fleets = [ElasticFleet(r, 2, spec_delay_s=0.3)
+                  for r in range(2)]
+        svcs = _services(tmp_path, 2, fleets=fleets)
+        outs = [None, None]
+        try:
+            TR.set_link_fault("slow", 0, 1200)  # rank1 -> rank0 slow
+            def work(r):
+                def compute(p, ctx):
+                    return mk([p * 7, p * 7 + 1])
+                svcs[r].broadcast_part(70, r, compute(r, None))
+                got = svcs[r].gather_parts(70, [0, 1],
+                                           compute=compute,
+                                           deadline_s=20)
+                outs[r] = {p: col0(t) for p, t in got.items()}
+
+            ts = [threading.Thread(target=work, args=(r,))
+                  for r in range(2)]
+            [t.start() for t in ts]
+            [t.join(60) for t in ts]
+        finally:
+            TR.clear_link_faults()
+            for s in svcs:
+                s.stop()
+        assert outs[0] == outs[1] == {0: [0, 1], 1: [7, 8]}
+        snap = obs.METRICS.snapshot()
+        spec = {tuple(s["labels"]): s["value"] for s in
+                snap["srt_fleet_speculations_total"]["series"]}
+        assert spec.get(("won",), 0) >= 1
+        dups = snap["srt_shuffle_dup_dropped_total"]["series"]
+        assert sum(s["value"] for s in dups) >= 1
+        ev = [r for r in obs.JOURNAL.records()
+              if r.get("kind") == "fleet_speculation"]
+        assert ev and ev[0]["outcome"] == "won"
+
+    def test_speculation_cancelled_when_original_arrives(
+            self, tmp_path, crc_on, metrics_on):
+        """The original lands while the speculative task computes:
+        the watcher trips the cancel event and the task unwinds
+        through QueryContext (outcome 'cancelled')."""
+        svcs = _services(tmp_path, 1, live=set())
+        svc = svcs[0]
+
+        def compute(p, ctx):
+            for _ in range(100):
+                time.sleep(0.02)
+                ctx.check_cancel()
+            return mk([0])
+
+        t = mk([3, 4])
+        buf = io.BytesIO()
+        kudo.write_to_stream(t.columns, buf, 0, t.num_rows)
+
+        def land_original():
+            time.sleep(0.15)
+            svc.parts.put(80, 0, kudo.read_tables(
+                io.BytesIO(buf.getvalue())), buf.getvalue())
+
+        threading.Thread(target=land_original, daemon=True).start()
+        svc._speculate(80, 0, owner=9, compute=compute,
+                       evidence={"reason": "test"})
+        snap = obs.METRICS.snapshot()
+        spec = {tuple(s["labels"]): s["value"] for s in
+                snap["srt_fleet_speculations_total"]["series"]}
+        assert spec == {("cancelled",): 1}
+        assert col0(svc.parts.get(80)[0]) == [3, 4]
+
+    def test_hot_part_resplits_byte_identical(self, tmp_path, crc_on,
+                                              metrics_on):
+        fleets = [ElasticFleet(r, 2, skew_ratio=3.0)
+                  for r in range(2)]
+        svcs = _services(tmp_path, 2, fleets=fleets)
+        outs = [None, None]
+        try:
+            def work(r):
+                if r == 0:
+                    svcs[r].broadcast_part(81, 0, mk([1, 2]))
+                    time.sleep(0.4)  # let rank1's part seed the window
+                    svcs[r].broadcast_part(81, 2,
+                                           mk(list(range(4000))))
+                else:
+                    svcs[r].broadcast_part(81, 1, mk([3, 4]))
+                got = svcs[r].gather_parts(
+                    81, [0, 1, 2],
+                    owner_of=lambda p: 0 if p in (0, 2) else 1,
+                    deadline_s=20)
+                outs[r] = {p: col0(t) for p, t in got.items()}
+
+            ts = [threading.Thread(target=work, args=(r,))
+                  for r in range(2)]
+            [t.start() for t in ts]
+            [t.join(60) for t in ts]
+        finally:
+            for s in svcs:
+                s.stop()
+        assert outs[0] == outs[1]
+        assert outs[0][2] == list(range(4000))
+        snap = obs.METRICS.snapshot()
+        assert sum(s["value"] for s in snap[
+            "srt_fleet_resplits_total"]["series"]) >= 1
+        ev = [r for r in obs.JOURNAL.records()
+              if r.get("kind") == "fleet_resplit"]
+        assert ev and ev[0]["nsub"] >= 2
+        assert "link_skew" in ev[0]["evidence"]
+
+    def test_elastic_barrier_with_graceful_leave(self, tmp_path,
+                                                 crc_on, metrics_on):
+        """Rank 1 passes the barrier, leaves, and exits; rank 0
+        entering LATE still completes because the leave shrank its
+        want set (no death-detection wait)."""
+        svcs = _services(tmp_path, 2)
+        errs = []
+        try:
+            def late0():
+                try:
+                    time.sleep(0.3)
+                    svcs[0].elastic_barrier(901, deadline_s=15)
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+            def fast1():
+                try:
+                    svcs[1].elastic_barrier(901, deadline_s=15)
+                    svcs[1].leave_fleet()
+                    svcs[1].stop()
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+            ts = [threading.Thread(target=late0),
+                  threading.Thread(target=fast1)]
+            [t.start() for t in ts]
+            [t.join(30) for t in ts]
+            assert not errs, errs
+        finally:
+            svcs[0].stop()
+
+    def test_elastic_q5_loopback_degenerates(self, crc_on):
+        from spark_rapids_tpu.distributed import runner as R
+        from spark_rapids_tpu.parallel import exchange as X
+        X.set_table_transport(None)
+        params = dict(rows=512, join_capacity=1 << 11)
+        got = R.run_elastic_q5(params)
+        ref = R.single_q5(params)
+        for k in ("key", "sales", "rets", "profit"):
+            assert got[k].tobytes() == ref[k].tobytes(), k
+
+    @pytest.mark.slow  # elastic-smoke gates the subprocess version
+    def test_elastic_q5_two_ranks_byte_identical(self, tmp_path,
+                                                 crc_on):
+        from spark_rapids_tpu.distributed import runner as R
+        svcs = _services(tmp_path, 2)
+        params = dict(rows=1024, join_capacity=1 << 12)
+        outs = [None, None]
+        errs = [None, None]
+        try:
+            def work(r):
+                try:
+                    outs[r] = R.run_elastic_q5(params,
+                                               transport=svcs[r])
+                except Exception as e:  # noqa: BLE001
+                    errs[r] = e
+
+            ts = [threading.Thread(target=work, args=(r,))
+                  for r in range(2)]
+            [t.start() for t in ts]
+            [t.join(180) for t in ts]
+        finally:
+            for s in svcs:
+                s.stop()
+        assert errs == [None, None], errs
+        ref = R.single_q5(dict(params, world=2))
+        for r in range(2):
+            for k in ("key", "sales", "rets", "profit"):
+                assert outs[r][k].tobytes() == ref[k].tobytes(), \
+                    (r, k)
+
+
+# ----------------------------------------------------- launcher logic
+
+
+class _StubProc:
+    def __init__(self, exits_after=0.0, rc=0, clock=None):
+        self._t0 = time.monotonic()
+        self._exits_after = exits_after
+        self._rc = rc
+        self.killed = False
+
+    def poll(self):
+        if self.killed:
+            return -9
+        if time.monotonic() - self._t0 >= self._exits_after:
+            return self._rc
+        return None
+
+    def kill(self):
+        self.killed = True
+
+    def wait(self, timeout=None):
+        return self.poll() if self.poll() is not None else 0
+
+
+class TestBabysitter:
+
+    def test_nonzero_exit_kills_fleet_and_propagates_immediately(
+            self):
+        from spark_rapids_tpu.distributed.launcher import (
+            WorkerFailed, babysit)
+        bad = _StubProc(exits_after=0.0, rc=7)
+        slow = _StubProc(exits_after=60.0, rc=0)
+        t0 = time.monotonic()
+        with pytest.raises(WorkerFailed) as ei:
+            babysit({0: slow, 1: bad}, timeout_s=30.0, poll_s=0.01)
+        assert ei.value.rank == 1 and ei.value.rc == 7
+        assert time.monotonic() - t0 < 5.0  # no deadline ride-out
+        assert slow.killed  # survivors are reaped
+
+    def test_on_death_respawn_keeps_fleet_alive(self):
+        from spark_rapids_tpu.distributed.launcher import babysit
+        bad = _StubProc(exits_after=0.0, rc=13)
+        ok = _StubProc(exits_after=0.1, rc=0)
+        seen = []
+
+        def on_death(rank, rc):
+            seen.append((rank, rc))
+            return _StubProc(exits_after=0.05, rc=0)
+
+        babysit({0: ok, 1: bad}, timeout_s=10.0, poll_s=0.01,
+                on_death=on_death)
+        assert seen == [(1, 13)]
+
+    def test_timeout_kills_and_raises(self):
+        from spark_rapids_tpu.distributed.launcher import (
+            WorkerFailed, babysit)
+        hung = _StubProc(exits_after=60.0, rc=0)
+        with pytest.raises(WorkerFailed) as ei:
+            babysit({0: hung}, timeout_s=0.1, poll_s=0.01)
+        assert ei.value.rc is None
+        assert hung.killed
+
+    def test_deferred_spawn_materializes_after_delay(self):
+        from spark_rapids_tpu.distributed.launcher import \
+            _DeferredSpawn
+        made = []
+
+        def factory():
+            made.append(1)
+            return _StubProc(exits_after=0.0, rc=0)
+
+        d = _DeferredSpawn(0.1, factory)
+        assert d.poll() is None and not made
+        time.sleep(0.12)
+        assert d.poll() == 0 and made == [1]
+
+    def test_deferred_spawn_kill_cancels_pending(self):
+        from spark_rapids_tpu.distributed.launcher import \
+            _DeferredSpawn
+        d = _DeferredSpawn(0.05, lambda: _StubProc())
+        d.kill()
+        time.sleep(0.1)
+        assert d.poll() is None  # never materialized
+
+
+# ------------------------------------------------- evidence surfaces
+
+
+class TestEvidenceSurfaces:
+
+    def _fleet_records(self):
+        obs.enable()
+        obs.reset()
+        obs.record_fleet_membership(
+            "death", dead=[2], epoch=1, live=[0, 1, 3],
+            moved={2: 0})
+        obs.record_fleet_speculation(
+            121, 1, owner=1, by=0, outcome="won",
+            evidence={"reason": "delay_floor"})
+        obs.record_fleet_resplit(121, 2, 4, 50_000,
+                                 evidence={"ratio": 6.0})
+        obs.record_shuffle_dup_dropped(1, 121, 1, True)
+        obs.record_shuffle_link("send", 1, 1000, 121)
+        obs.record_shuffle_link("recv", 1, 9000, 121)
+        obs.record_shuffle_link("recv", 3, 1000, 121)
+        events = obs.JOURNAL.records()
+        registry = obs.METRICS.snapshot()
+        obs.disable()
+        return events, registry
+
+    def test_metrics_report_fleet_rows_and_json(self):
+        from spark_rapids_tpu.tools.metrics_report import (
+            build_report, fleet_rows, render_fleet_table)
+        events, registry = self._fleet_records()
+        f = fleet_rows(events, registry)
+        assert f["epoch"] == 1
+        assert f["rebalances"] == 1
+        assert f["speculations"]["won"] == 1
+        assert f["resplits"] == 1
+        assert f["skew_ratio"] == 9.0  # 9000 / 1000 recv bytes
+        peers = {r["peer"]: r for r in f["peers"]}
+        assert peers["1"]["dup_dropped"] == 1
+        assert peers["2"]["deaths"] == 1
+        assert f["memberships"][0]["dead"] == [2]
+        lines = "\n".join(render_fleet_table(events, registry))
+        assert "epoch 1" in lines and "rebalances 1" in lines
+        report = build_report(
+            [dict(e) for e in events]
+            + [{"kind": "registry_snapshot", "registry": registry}])
+        assert report["fleet"]["speculations"]["won"] == 1
+
+    def test_doctor_names_dead_and_slow_rank(self, tmp_path):
+        from spark_rapids_tpu.tools.doctor import Bundle, analyze
+        bundle_dir = os.path.join(str(tmp_path), "bundle")
+        os.makedirs(bundle_dir)
+        with open(os.path.join(bundle_dir, "trigger.json"),
+                  "w") as f:
+            json.dump({
+                "kind": "fleet_incident", "severity": "warn",
+                "detail": {"rank": 0, "change": "death",
+                           "dead": [2], "epoch": 1,
+                           "shards_moved": {"2": 0},
+                           "live": [0, 1, 3]}}, f)
+        with open(os.path.join(bundle_dir, "journal.jsonl"),
+                  "w") as f:
+            for rec in (
+                {"kind": "fleet_membership", "change": "death",
+                 "dead": [2], "epoch": 1, "moved": {"2": 0}},
+                {"kind": "fleet_speculation", "op": 121, "part": 1,
+                 "owner": 1, "by": 0, "outcome": "won",
+                 "evidence": {"reason": "delay_floor"}},
+                {"kind": "fleet_resplit", "op": 121, "part": 2,
+                 "nsub": 4, "bytes": 50_000},
+            ):
+                f.write(json.dumps(rec) + "\n")
+        findings = analyze(Bundle(bundle_dir))
+        kinds = {f["kind"] for f in findings}
+        assert "fleet_incident" in kinds
+        assert "fleet_straggler" in kinds
+        assert "fleet_skew" in kinds
+        top = findings[0]
+        assert top["kind"] == "fleet_incident"
+        assert "dead rank(s) [2]" in top["message"]
+        slow = next(f for f in findings
+                    if f["kind"] == "fleet_straggler")
+        assert "slow rank 1" in slow["message"]
