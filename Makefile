@@ -8,8 +8,8 @@ PY ?= python
         jni-test kudo-bench metrics-smoke trace-smoke chaos-smoke \
         perf-smoke fusion-smoke doctor-smoke server-smoke \
         lifeguard-smoke ingest-smoke dist-smoke analysis-smoke \
-        profile-smoke elastic-smoke nightly-artifacts ci ci-nightly \
-        clean
+        profile-smoke elastic-smoke slo-smoke serve-bench \
+        nightly-artifacts ci ci-nightly clean
 
 # tier-1 set: slow-marked tests (the subprocess fleet twins of the
 # dist-smoke gate) are excluded here exactly like the driver's verify
@@ -172,6 +172,21 @@ profile-smoke:
 elastic-smoke:
 	$(PY) scripts/elastic_smoke.py
 
+# telemetry-plane gate (ISSUE 16): disabled sampler at attribute-read
+# cost, window-ring delta conservation + fresh windowed percentiles,
+# an injected slow tenant tripping EXACTLY ONE slo_burn bundle that
+# srt-doctor attributes to that tenant (healthy neighbor at/above its
+# objective), a 2-process elastic fleet whose rank-0 merged timeseries
+# reconciles EXACTLY with each rank's own registry dump, and a
+# deterministic `srt-top --once --json` digest
+slo-smoke:
+	$(PY) scripts/slo_smoke.py
+
+# zipf-skewed multi-tenant serving replay -> BENCH_serve_r01.json
+# (per-tenant p50/p99 admission-to-result, throughput, SLO attainment)
+serve-bench:
+	$(PY) scripts/serve_bench.py
+
 # NOTE: jax.config.update, not the env var — this image's sitecustomize
 # pre-imports jax with the axon backend, so JAX_PLATFORMS=cpu is too
 # late.  XLA_FLAGS still works (read at backend init, which happens
@@ -195,7 +210,7 @@ dryrun:
 ci: test fuzz native sanitizers tpu-lower jni-test dryrun metrics-smoke \
     trace-smoke chaos-smoke perf-smoke fusion-smoke doctor-smoke \
     server-smoke lifeguard-smoke ingest-smoke dist-smoke analysis-smoke \
-    profile-smoke elastic-smoke
+    profile-smoke elastic-smoke slo-smoke
 	$(PY) bench.py
 	@echo "ci: all gates green"
 

@@ -1,0 +1,278 @@
+"""Telemetry-plane gate (`make slo-smoke`, ISSUE 16 acceptance):
+
+  * with the sampler DISABLED, ``TIMESERIES.maybe_tick()`` — the hook
+    the Monitor thread drives every period — must stay under 50 us
+    per call (one attribute read, the noop discipline every other
+    switch obeys);
+  * the window ring must CONSERVE: the sum of per-window counter
+    deltas over the whole ring equals the cumulative registry value,
+    and windowed percentiles must reflect the RECENT window, not the
+    since-boot distribution (the p99-staleness fix);
+  * an injected slow tenant must trip the fast+slow burn-rate alert
+    and freeze EXACTLY ONE ``slo_burn`` flight-recorder bundle (the
+    cooldown suppresses the second evaluation), ``srt-doctor`` must
+    attribute it to that tenant, and the healthy tenant's attainment
+    must stay at/above its objective;
+  * a REAL 2-process elastic q5 fleet with
+    ``SPARK_RAPIDS_TPU_TIMESERIES=1`` must publish windowed snapshots
+    to rank 0 over the CTRL path, and rank 0's merged fleet
+    timeseries must reconcile EXACTLY with each rank's own registry
+    dump for quiescent counter families;
+  * ``srt-top --once --json`` over the fleet dump must be
+    deterministic (two runs, identical bytes).
+
+Exits non-zero on the first missing signal."""
+
+import contextlib
+import hashlib
+import io
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+WORLD = 2
+
+# counter families that are QUIESCENT by the time the runner takes its
+# pre-barrier dump pair: all shuffle traffic finished with the query.
+# (srt_timeseries_merge_total and the link families keep moving on
+# rank 0 while peers publish, so they cannot be reconciliation
+# oracles.)
+RECONCILE_FAMILIES = ("srt_shuffle_write_bytes_total",
+                      "srt_shuffle_merge_rows_total")
+
+
+def fail(msg: str) -> "NoReturn":  # noqa: F821
+    print(f"slo-smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def say(msg: str) -> None:
+    print(f"slo-smoke: {msg}")
+
+
+def registry_series(metrics: dict, family: str) -> dict:
+    """{joined-label-key: int(value)} for one counter family of a
+    registry snapshot dump (the same key scheme the window records
+    use)."""
+    fam = metrics.get(family) or {}
+    out = {}
+    for s in fam.get("series", []):
+        if s.get("value"):
+            out["|".join(str(x) for x in s.get("labels", ()))] = \
+                int(s["value"])
+    return out
+
+
+def main() -> int:
+    t_start = time.monotonic()
+    from spark_rapids_tpu import observability as obs
+    from spark_rapids_tpu.observability import timeseries as ts_mod
+    from spark_rapids_tpu.tools import doctor as D
+    from spark_rapids_tpu.tools import srt_top as TOP
+
+    # ---- disabled-mode overhead gate -------------------------------
+    obs.disable_timeseries()
+    obs.disable_slo()
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        obs.TIMESERIES.maybe_tick()
+    per_call_us = (time.perf_counter() - t0) / n * 1e6
+    if per_call_us > 50.0:
+        fail(f"disabled sampler costs {per_call_us:.2f} us per "
+             f"maybe_tick (budget 50 us) — the one-attribute-read "
+             f"fast path regressed")
+    if obs.TIMESERIES.windows():
+        fail("maybe_tick produced windows while disabled")
+    say(f"disabled-mode OK: {per_call_us:.3f} us per maybe_tick, "
+        f"zero windows")
+
+    # ---- ring conservation + windowed percentiles ------------------
+    obs.enable()
+    obs.reset()
+    obs.enable_timeseries(window_s=0.01)
+    for i in range(3):
+        obs.record_server_complete("acme", "q3", f"a{i}", "success",
+                                   1_000_000, 50_000)
+    obs.TIMESERIES.tick()
+    for i in range(2):
+        obs.record_server_complete("acme", "q3", f"b{i}", "success",
+                                   1_000_000, 50_000)
+    obs.record_server_complete("beta", "q5", "c0", "failed",
+                               9_000_000, 70_000)
+    obs.TIMESERIES.tick()
+    windows = obs.TIMESERIES.windows()
+    if len(windows) < 2:
+        fail(f"two explicit ticks produced {len(windows)} window(s)")
+    got = ts_mod.sum_counter_windows(windows,
+                                     "srt_server_completed_total")
+    want = registry_series(obs.METRICS.snapshot(),
+                           "srt_server_completed_total")
+    want = {k: float(v) for k, v in want.items()}
+    if got != want:
+        fail(f"window deltas {got} do not conserve the registry "
+             f"cumulative {want}")
+    # windowed percentile freshness: an old fast population must not
+    # drag the RECENT window's p50 down (the since-boot staleness the
+    # ring exists to fix)
+    for _ in range(100):
+        obs.TIMESERIES_TICK.observe(1_000)           # 1 us era
+    obs.TIMESERIES.tick()
+    for _ in range(10):
+        obs.TIMESERIES_TICK.observe(50_000_000)      # 50 ms era
+    obs.TIMESERIES.tick()
+    recent = obs.TIMESERIES.recent_histogram("srt_timeseries_tick_ns",
+                                             n=1)
+    if recent is None:
+        fail("recent_histogram found no srt_timeseries_tick_ns "
+             "window series")
+    buckets, counts, _, count = recent
+    # the flush tick records its OWN duration after snapshotting, so
+    # the last window holds the 10 slow samples plus at most that one
+    # stray fast tick
+    if not 10 <= count <= 11:
+        fail(f"last window holds {count} tick observations, want "
+             f"the 10 slow ones (+ at most the flush tick itself)")
+    p50_recent = ts_mod.histogram_quantile(buckets, counts, 0.50)
+    fam = obs.METRICS.snapshot()["srt_timeseries_tick_ns"]
+    cum = fam["series"][0]
+    p50_boot = ts_mod.histogram_quantile(fam["buckets"],
+                                         cum["bucket_counts"], 0.50)
+    if p50_recent < 1e6:
+        fail(f"windowed p50 {p50_recent:.0f} ns still reflects the "
+             f"old 1 us era — percentile staleness not fixed")
+    if p50_boot > 1e6:
+        fail(f"since-boot p50 {p50_boot:.0f} ns unexpectedly high — "
+             f"bad test premise")
+    say(f"ring OK: deltas conserve ({got}), windowed p50 "
+        f"{p50_recent / 1e6:.1f} ms vs since-boot {p50_boot:.0f} ns")
+
+    # ---- slow tenant -> ONE slo_burn bundle -> doctor --------------
+    incident_dir = tempfile.mkdtemp(prefix="slo_smoke_incidents_")
+    obs.FLIGHT.configure(out_dir=incident_dir)
+    obs.enable_flight_recorder()
+    obs.enable_slo()
+    obs.SLO.reset()
+    for i in range(40):
+        # slow tenant: every completion blows the 250 ms default
+        # target end to end
+        obs.record_server_complete("tenant-slow", "q5", f"s{i}",
+                                   "success", 400_000_000, 50_000_000)
+    for i in range(60):
+        obs.record_server_complete("tenant-healthy", "q5", f"h{i}",
+                                   "success", 2_000_000, 100_000)
+    fired = obs.evaluate_slo()
+    if len(fired) != 1 or fired[0]["tenant"] != "tenant-slow":
+        fail(f"expected exactly one alert for tenant-slow, got "
+             f"{fired}")
+    if obs.evaluate_slo():
+        fail("second evaluation re-fired inside the cooldown")
+    st = obs.SLO.status()
+    if st["tenant-healthy"]["attainment"] \
+            < st["tenant-healthy"]["objective"]:
+        fail(f"healthy tenant attainment "
+             f"{st['tenant-healthy']['attainment']} fell below its "
+             f"objective {st['tenant-healthy']['objective']}")
+    if st["tenant-slow"]["burn_fast"] < obs.SLO.threshold:
+        fail(f"slow tenant fast burn {st['tenant-slow']['burn_fast']} "
+             f"below threshold yet the alert fired?")
+    bundles = D.find_bundles(incident_dir)
+    burn_bundles = []
+    for b in bundles:
+        trig = json.load(open(os.path.join(b, "trigger.json")))
+        if trig.get("kind") == "slo_burn":
+            burn_bundles.append(b)
+    if len(burn_bundles) != 1:
+        fail(f"expected exactly ONE slo_burn bundle, found "
+             f"{len(burn_bundles)} in {incident_dir}")
+    findings = D.analyze(D.Bundle(burn_bundles[0]))
+    top = [f for f in findings if f["kind"] == "slo_burn"]
+    if not top or "tenant-slow" not in top[0]["message"]:
+        fail(f"doctor did not attribute the burn to tenant-slow: "
+             f"{[f['message'] for f in findings][:3]}")
+    say(f"slo_burn OK: one bundle, doctor says: {top[0]['message']}")
+    obs.disable_slo()
+    obs.disable_flight_recorder()
+    obs.disable_timeseries()
+    shutil.rmtree(incident_dir, ignore_errors=True)
+
+    # ---- 2-process fleet: rank-0 merge reconciles exactly ----------
+    from spark_rapids_tpu.distributed import launcher
+    outdir = tempfile.mkdtemp(prefix="slo_smoke_fleet_")
+    say(f"launching {WORLD}-process elastic q5 fleet with the "
+        f"sampler on -> {outdir}")
+    launcher.launch(WORLD, outdir, ops=("q5",), elastic=True,
+                    worker_env={
+                        "SPARK_RAPIDS_TPU_TIMESERIES": "1",
+                        "SPARK_RAPIDS_TPU_TIMESERIES_WINDOW_S": "0.2",
+                    },
+                    timeout_s=240.0)
+    fleet_path = os.path.join(outdir, "fleet_timeseries.json")
+    if not os.path.isfile(fleet_path):
+        fail("rank 0 dumped no fleet_timeseries.json")
+    merged = json.load(open(fleet_path))
+    if sorted(merged.get("ranks", {})) != [str(r)
+                                           for r in range(WORLD)]:
+        fail(f"merged fleet covers ranks "
+             f"{sorted(merged.get('ranks', {}))}, want all of "
+             f"0..{WORLD - 1} (CTRL publish path broken)")
+    for r in range(WORLD):
+        metrics = json.load(open(os.path.join(
+            outdir, f"metrics_ts_rank{r}.json")))
+        rank_windows = merged["ranks"][str(r)]["windows"]
+        if not rank_windows:
+            fail(f"rank {r} published zero windows")
+        for famname in RECONCILE_FAMILIES:
+            got = {k: int(v) for k, v in ts_mod.sum_counter_windows(
+                rank_windows, famname).items()}
+            want = registry_series(metrics, famname)
+            if not want:
+                fail(f"rank {r} registry has no {famname} series — "
+                     f"q5 produced no shuffle?")
+            if got != want:
+                fail(f"rank {r} {famname}: merged window totals "
+                     f"{got} != registry dump {want}")
+    say(f"fleet OK: rank 0's merged timeseries reconciles exactly "
+        f"with both ranks' registries over {RECONCILE_FAMILIES}")
+
+    # ---- srt-top --once --json determinism -------------------------
+    digests = []
+    for _ in range(2):
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = TOP.main(["--dump-dir", outdir, "--once", "--json"])
+        if rc != 0:
+            fail(f"srt-top --once --json exited {rc}")
+        digests.append(hashlib.sha256(
+            buf.getvalue().encode()).hexdigest())
+    if digests[0] != digests[1]:
+        fail("srt-top --once --json is not deterministic across runs")
+    frame = json.loads(buf.getvalue())
+    if len(frame.get("ranks", {})) != WORLD:
+        fail(f"srt-top frame shows {len(frame.get('ranks', {}))} "
+             f"rank(s), want {WORLD}")
+    say(f"srt-top OK: deterministic digest {digests[0][:12]}..., "
+        f"{WORLD} ranks in frame")
+    shutil.rmtree(outdir, ignore_errors=True)
+
+    say(f"OK ({time.monotonic() - t_start:.1f}s): noop-when-off, "
+        f"ring conservation + fresh percentiles, one attributed "
+        f"slo_burn bundle, exact fleet reconciliation, "
+        f"deterministic srt-top")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

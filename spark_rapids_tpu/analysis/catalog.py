@@ -129,6 +129,21 @@ METRICS: Dict[str, Tuple[str, str]] = {
         "histogram", "wall time assembling one query profile"),
     "srt_profile_dropped_total": (
         "counter", "profile sessions dropped instead of assembled"),
+    # -- ISSUE 16: telemetry plane & SLOs --
+    "srt_timeseries_windows_total": (
+        "counter", "time-series windows sampled since boot"),
+    "srt_timeseries_tick_ns": (
+        "histogram", "wall time taking one window snapshot"),
+    "srt_timeseries_merge_total": (
+        "counter", "fleet window-snapshot merges by outcome"),
+    "srt_monitor_last_sample_age_s": (
+        "gauge", "seconds since the Monitor thread last sampled"),
+    "srt_slo_burn_rate": (
+        "gauge", "per-tenant error-budget burn rate per window"),
+    "srt_slo_attainment_ratio": (
+        "gauge", "per-tenant since-boot SLO attainment"),
+    "srt_slo_breaches_total": (
+        "counter", "slo_burn alerts fired per tenant"),
 }
 
 # ----------------------------------------------------------------- knobs
@@ -265,6 +280,21 @@ KNOBS: Dict[str, str] = {
         "(0=off)",
     "SPARK_RAPIDS_TPU_SERVER_PROFILE_KEEP":
         "query profiles the server retains per tenant (0=off)",
+    # -- ISSUE 16: telemetry plane & SLOs --
+    "SPARK_RAPIDS_TPU_TIMESERIES":
+        "=1 enables the windowed time-series sampler at import",
+    "SPARK_RAPIDS_TPU_TIMESERIES_WINDOW_S":
+        "time-series window length seconds",
+    "SPARK_RAPIDS_TPU_TIMESERIES_CAPACITY":
+        "window-ring depth (windows retained)",
+    "SPARK_RAPIDS_TPU_SLO":
+        "=1 arms per-tenant SLO burn-rate monitoring at import",
+    "SPARK_RAPIDS_TPU_SLO_CONFIG":
+        "per-tenant SLO spec: inline JSON or @path",
+    "SPARK_RAPIDS_TPU_SLO_FAST_S": "fast burn-rate window seconds",
+    "SPARK_RAPIDS_TPU_SLO_SLOW_S": "slow burn-rate window seconds",
+    "SPARK_RAPIDS_TPU_SLO_BURN_THRESHOLD":
+        "burn rate both windows must reach to fire slo_burn",
 }
 
 # env families read with a COMPUTED suffix (pinned_path's

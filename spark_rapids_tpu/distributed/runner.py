@@ -579,6 +579,28 @@ def main(argv=None) -> int:
                             f"metrics_{op}_rank{rank}.json"),
                         lambda f: f.write(
                             obs.METRICS.snapshot_json()))
+        if obs.TIMESERIES.enabled:
+            # close the final window NOW and dump the same-moment pair
+            # (ring + registry): the ring's summed counter deltas equal
+            # the registry's cumulative values at this instant exactly,
+            # which is the fleet-reconciliation gate's oracle (the
+            # post-barrier metrics_rank dump also counts barrier
+            # traffic, so it cannot be the comparison point)
+            obs.TIMESERIES.tick()
+            ts_snap = obs.timeseries_snapshot(
+                rank=rank, epoch=(service.fleet.epoch
+                                  if service.fleet is not None else 0))
+            dump_via(os.path.join(outdir,
+                                  f"timeseries_rank{rank}.json"),
+                     lambda f: f.write(json.dumps(ts_snap,
+                                                  sort_keys=True)))
+            dump_via(os.path.join(outdir,
+                                  f"metrics_ts_rank{rank}.json"),
+                     lambda f: f.write(obs.METRICS.snapshot_json()))
+            # publish to rank 0 while the links are still up: the send
+            # blocks for the ACK, so after the barrier below rank 0
+            # holds every rank's windows
+            service.publish_timeseries(ts_snap)
         if args.elastic:
             # membership-tolerant: survives peers leaving AND waits
             # for a respawned peer when the launcher may send one
@@ -606,6 +628,14 @@ def main(argv=None) -> int:
         # shuffle_dup_dropped) the elastic gate and srt-doctor read
         obs.dump_journal_jsonl(
             os.path.join(outdir, f"journal_rank{rank}.jsonl"))
+        if rank == 0 and obs.TIMESERIES.enabled:
+            # rank 0's merged fleet timeseries (self + every publish
+            # folded pre-barrier) — the srt-top file tier and the
+            # reconciliation gate read this
+            dump_via(os.path.join(outdir, "fleet_timeseries.json"),
+                     lambda f: f.write(json.dumps(
+                         service.fleet_timeseries.merged(),
+                         sort_keys=True)))
         summary = {
             "rank": rank, "world": world, "ops": ops,
             "mesh": mesh_info, "elastic": bool(args.elastic),

@@ -69,6 +69,7 @@ from spark_rapids_tpu.analysis.lockdep import make_lock
 from typing import Callable, Dict, List, Optional, Sequence, Set
 
 from spark_rapids_tpu import observability as _obs
+from spark_rapids_tpu.observability.timeseries import FleetTimeseries
 from spark_rapids_tpu.parallel import exchange as _exchange
 from spark_rapids_tpu.robustness.fleet import (
     ElasticFleet, StaleEpochError)
@@ -114,6 +115,11 @@ class ShuffleService:
             r: PeerLink(self.rank, r, addresses[r], policy=policy)
             for r in range(world) if r != self.rank}
         self._started = False
+        # fleet telemetry merger (ISSUE 16): every rank holds one
+        # (cheap), but only rank 0 receives publishes — workers ship
+        # their windowed snapshots here over the CTRL path and the
+        # merged view becomes the srt-top fleet feed
+        self.fleet_timeseries = FleetTimeseries()
         self._lock = make_lock("dist.service")
         # per-op first-touch monotonic ns: arrival gaps feed the
         # straggler window relative to when THIS rank engaged the op
@@ -340,6 +346,16 @@ class ShuffleService:
             # for ACKs and must not stall this connection's reads)
             self._spawn(self._replay, src, int(obj.get("op", -1)),
                         obj.get("parts"))
+        elif typ == "timeseries":
+            # windowed telemetry publish (ISSUE 16): fold into the
+            # fleet merger — dup windows dedup by sequence, snapshots
+            # from a stale membership epoch are fenced outright (the
+            # frame-level epoch fence already rejected older CARRIER
+            # epochs; this guards the snapshot's own claimed epoch)
+            snap = obj.get("snap") or {}
+            outcome = self.fleet_timeseries.offer(snap)
+            _obs.record_timeseries_merge(
+                outcome, int(snap.get("rank", src)))
         else:
             raise ValueError(f"unknown control type {typ!r}")
         return ACK
@@ -387,6 +403,31 @@ class ShuffleService:
                 "departed": sorted(view.departed)})
         except (PeerDiedException, OSError):
             pass  # the joiner died again; its next join retries
+
+    def publish_timeseries(self, snap: Optional[dict] = None
+                           ) -> Optional[str]:
+        """Ship this rank's windowed telemetry snapshot to rank 0's
+        fleet merger over the CTRL path (rank 0 folds locally).  The
+        send blocks for the ACK, so a completed publish IS merged —
+        callers sequencing publish-then-barrier get a fully folded
+        rank-0 view after the barrier.  Returns the merge outcome
+        ('merged'/'dup'/'stale_epoch') on rank 0, 'sent' elsewhere,
+        None when there is no elastic fabric (the launcher dump-dir
+        tier covers that case offline)."""
+        if self.fleet is None:
+            return None
+        if snap is None:
+            snap = _obs.timeseries_snapshot(rank=self.rank,
+                                            epoch=self.fleet.epoch)
+        if self.rank == 0:
+            outcome = self.fleet_timeseries.offer(snap)
+            _obs.record_timeseries_merge(outcome, self.rank)
+            return outcome
+        try:
+            self._send_ctrl(0, {"type": "timeseries", "snap": snap})
+            return "sent"
+        except (PeerDiedException, OSError):
+            return None  # rank 0 is gone; nothing to publish to
 
     def _replay(self, dst: int, op_id: int, parts=None) -> None:
         blobs = self.parts.payloads(op_id)

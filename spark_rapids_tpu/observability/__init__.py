@@ -30,6 +30,8 @@ import time
 from typing import Callable, Optional
 
 from spark_rapids_tpu.observability import flight_recorder as _fr
+from spark_rapids_tpu.observability import slo as _slo
+from spark_rapids_tpu.observability import timeseries as _ts
 from spark_rapids_tpu.observability.dumpio import dump_via
 from spark_rapids_tpu.observability.journal import EventJournal
 from spark_rapids_tpu.observability.profile import (  # noqa: F401
@@ -109,6 +111,8 @@ def reset() -> None:
         _BLOCK_SPANS.clear()
     TRACER.reset()
     PROFILER.reset()
+    TIMESERIES.reset()
+    SLO.reset()
 
 
 # --------------------------------------------------------------- instruments
@@ -352,6 +356,39 @@ PROFILE_DROPPED = METRICS.counter(
     "Profile sessions dropped instead of assembled (nested begin, "
     "stage record with no session, assembly error)",
     labels=("reason",))
+TIMESERIES_WINDOWS = METRICS.counter(
+    "srt_timeseries_windows_total",
+    "Telemetry windows appended to the timeseries ring")
+TIMESERIES_TICK = METRICS.histogram(
+    "srt_timeseries_tick_ns",
+    "Wall time of one timeseries tick (registry snapshot + delta "
+    "fold) — the cost the sampler switch buys",
+    buckets=DEFAULT_LATENCY_BUCKETS_NS)
+TIMESERIES_MERGE = METRICS.counter(
+    "srt_timeseries_merge_total",
+    "Per-rank timeseries snapshots offered to the fleet merger, by "
+    "outcome (merged, dup = no new windows, stale_epoch = fenced)",
+    labels=("outcome",))
+MONITOR_SAMPLE_AGE = METRICS.gauge(
+    "srt_monitor_last_sample_age_s",
+    "Seconds since the Monitor thread last sampled — computed at "
+    "exposition time, so a dead sampler shows a growing age instead "
+    "of a frozen healthy-looking gauge")
+SLO_BURN_RATE = METRICS.gauge(
+    "srt_slo_burn_rate",
+    "Per-tenant error-budget burn rate (bad fraction / budget) over "
+    "the fast and slow windows; 1.0 = spending exactly as "
+    "provisioned", labels=("tenant", "window"), max_series=256)
+SLO_ATTAINMENT = METRICS.gauge(
+    "srt_slo_attainment_ratio",
+    "Per-tenant lifetime fraction of budget-consuming completions "
+    "that met the SLO (success within the latency target)",
+    labels=("tenant",), max_series=128)
+SLO_BREACHES = METRICS.counter(
+    "srt_slo_breaches_total",
+    "slo_burn alerts fired (both burn windows over threshold, "
+    "cooldown-filtered), by tenant", labels=("tenant",),
+    max_series=128)
 
 
 # ------------------------------------------------------------------ tracer
@@ -475,6 +512,166 @@ def trigger_incident(kind: str, cause: Optional[BaseException] = None,
     finally:
         if hook is not None:
             hook(f"incident:{kind}")
+
+
+# ------------------------------------------------------- telemetry plane
+# Windowed time-series + per-tenant SLO burn monitoring (ISSUE 16
+# tentpole).  Independent switches with the usual noop discipline:
+# the Monitor thread calls record_monitor_sample() every period, and
+# with both switches off that costs two attribute reads.
+
+
+def _on_timeseries_tick(elapsed_ns: int) -> None:
+    if not _SWITCH.enabled:
+        return
+    TIMESERIES_WINDOWS.inc()
+    TIMESERIES_TICK.observe(elapsed_ns)
+
+
+def _env_num(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+TIMESERIES = _ts.TimeseriesSampler(
+    METRICS,
+    window_s=_env_num("SPARK_RAPIDS_TPU_TIMESERIES_WINDOW_S", 5.0),
+    capacity=int(_env_num("SPARK_RAPIDS_TPU_TIMESERIES_CAPACITY", 120)),
+    on_tick=_on_timeseries_tick)
+
+
+def _on_slo_burn(tenant: str, alert: dict) -> None:
+    """One multi-window burn alert: journal + breach counter, then the
+    slo_burn incident bundle freezing the ring tail + the offending
+    tenant's SLO snapshot next to the usual evidence (PR-13's last
+    profile rides along via the recorder's own bundle assembly)."""
+    detail = {k: v for k, v in alert.items() if k != "tenant"}
+    if _SWITCH.enabled:
+        SLO_BREACHES.inc(labels=(tenant,))
+        JOURNAL.emit("slo_burn", tenant=tenant, **detail)
+    trigger_incident(
+        "slo_burn", severity="error", tenant=tenant, **detail,
+        tenant_slo=SLO.status().get(tenant, {}),
+        timeseries_tail=TIMESERIES.windows(4))
+
+
+try:
+    SLO = _slo.SloMonitor.from_env(on_burn=_on_slo_burn)
+except Exception as _e:  # malformed SLO_CONFIG: warn loudly, run bare
+    import sys as _sys
+    print(f"spark_rapids_tpu: ignoring bad SPARK_RAPIDS_TPU_SLO_* "
+          f"config: {_e}", file=_sys.stderr)
+    SLO = _slo.SloMonitor(on_burn=_on_slo_burn)
+
+# last Monitor sample, monotonic — the liveness source behind
+# srt_monitor_last_sample_age_s (set at exposition, never by the
+# sampler itself: a dead thread must show a GROWING age)
+_LAST_MONITOR_SAMPLE: Optional[float] = None
+
+
+def enable_timeseries(window_s: Optional[float] = None,
+                      capacity: Optional[int] = None) -> None:
+    """Arm the windowed sampler (independent switch; pair with the
+    metrics switch — with the registry disabled every delta is
+    zero)."""
+    if window_s is not None:
+        TIMESERIES.window_s = float(window_s)
+    if capacity is not None:
+        from collections import deque as _dq
+        TIMESERIES.capacity = int(capacity)
+        TIMESERIES._windows = _dq(TIMESERIES._windows,
+                                  maxlen=int(capacity))
+    TIMESERIES.enabled = True
+
+
+def disable_timeseries() -> None:
+    TIMESERIES.enabled = False
+
+
+def is_timeseries_enabled() -> bool:
+    return TIMESERIES.enabled
+
+
+def enable_slo() -> None:
+    SLO.enabled = True
+
+
+def disable_slo() -> None:
+    SLO.enabled = False
+
+
+def is_slo_enabled() -> bool:
+    return SLO.enabled
+
+
+def _apply_slo_gauges() -> None:
+    if not _SWITCH.enabled:
+        return
+    for tenant, st in SLO.status().items():
+        SLO_BURN_RATE.set(st["burn_fast"], labels=(tenant, "fast"))
+        SLO_BURN_RATE.set(st["burn_slow"], labels=(tenant, "slow"))
+        SLO_ATTAINMENT.set(st["attainment"], labels=(tenant,))
+
+
+def evaluate_slo(now: Optional[float] = None) -> list:
+    """Force one burn-rate evaluation + gauge refresh; returns the
+    alerts that fired (each already routed through the slo_burn
+    incident path).  Tests and the smoke drive this with synthetic
+    clocks; production rides record_monitor_sample."""
+    fired = SLO.evaluate(now)
+    _apply_slo_gauges()
+    return fired
+
+
+def record_monitor_sample(now: Optional[float] = None) -> None:
+    """utils/telemetry.Monitor loop hook: stamps sampler liveness and
+    drives the telemetry plane at window granularity (maybe_tick /
+    maybe_evaluate are no-ops until a window has elapsed)."""
+    global _LAST_MONITOR_SAMPLE
+    _LAST_MONITOR_SAMPLE = time.monotonic() if now is None else now
+    if TIMESERIES.enabled:
+        TIMESERIES.maybe_tick()
+    if SLO.enabled:
+        fired = SLO.maybe_evaluate()
+        if fired is not None:
+            _apply_slo_gauges()
+
+
+def _refresh_liveness(now: Optional[float] = None) -> None:
+    """Exposition-time liveness: every snapshot/health/expose path
+    recomputes the sampler age so a stalled Monitor thread cannot
+    freeze a healthy-looking value into dumps and bundles."""
+    if not _SWITCH.enabled or _LAST_MONITOR_SAMPLE is None:
+        return
+    now = time.monotonic() if now is None else now
+    MONITOR_SAMPLE_AGE.set(
+        round(max(0.0, now - _LAST_MONITOR_SAMPLE), 3))
+
+
+def timeseries_snapshot(rank: int = 0, epoch: int = 0) -> dict:
+    """One publishable per-rank snapshot: the ring dump tagged with
+    fleet identity (+ the SLO status when armed) — the unit workers
+    send over CTRL frames / dump to ``timeseries_rank{r}.json`` and
+    ``FleetTimeseries.offer`` merges."""
+    snap = TIMESERIES.snapshot()
+    snap["rank"] = int(rank)
+    snap["epoch"] = int(epoch)
+    if SLO.enabled:
+        snap["slo"] = SLO.status()
+    return snap
+
+
+def record_timeseries_merge(outcome: str, rank: int) -> None:
+    """Rank 0's fleet-merge hook: one offered per-rank snapshot, by
+    outcome ('merged', 'dup', 'stale_epoch')."""
+    if not _SWITCH.enabled:
+        return
+    TIMESERIES_MERGE.inc(labels=(outcome,))
+    JOURNAL.emit("timeseries_merge", outcome=outcome, rank=int(rank),
+                 thread=threading.get_ident())
 
 
 # ------------------------------------------------------------ record helpers
@@ -937,6 +1134,10 @@ def record_server_requeue(tenant: str, query_id: str, reason: str,
 def record_server_complete(tenant: str, query: str, query_id: str,
                            outcome: str, dur_ns: int,
                            wait_ns: int) -> None:
+    # SLO feed first (independent switch): one SLI event per
+    # completion, latency = what the caller experienced end to end
+    if SLO.enabled:
+        SLO.observe(tenant, outcome, int(wait_ns) + int(dur_ns))
     if not _SWITCH.enabled:
         return
     SERVER_COMPLETED.inc(labels=(tenant, outcome))
@@ -998,6 +1199,7 @@ def set_server_tenant_gauges(queued: dict, running: dict,
 
 def expose_text() -> str:
     """Prometheus text exposition of the process registry."""
+    _refresh_liveness()
     return METRICS.expose_text()
 
 
@@ -1005,6 +1207,7 @@ def snapshot() -> dict:
     """JSON-able state: registry + per-task rollup + journal stats.
     Wall-clock anchored (``snapshot_unix_ms`` + ``uptime_s``): offline
     consumers place the per-process monotonic stamps in real time."""
+    _refresh_liveness()
     return {
         "snapshot_unix_ms": int(time.time() * 1000),
         "uptime_s": round(time.monotonic() - _START_MONO, 3),
@@ -1020,6 +1223,7 @@ def health() -> dict:
     """One-call process health rollup for the JVM shim's
     ``health_json``: switches, ring fill/drops, recorder stats, and a
     memory-ledger summary when the OOM runtime is installed."""
+    _refresh_liveness()
     h = {
         "snapshot_unix_ms": int(time.time() * 1000),
         "start_unix_ms": int(_START_UNIX * 1000),
@@ -1031,6 +1235,15 @@ def health() -> dict:
         "spans": {"finished": len(TRACER), "dropped": TRACER.dropped},
         "flight_recorder": FLIGHT.stats(),
         "profiler": PROFILER.stats(),
+        "monitor": {
+            "last_sample_age_s": (
+                None if _LAST_MONITOR_SAMPLE is None else
+                round(max(0.0,
+                          time.monotonic() - _LAST_MONITOR_SAMPLE), 3)),
+            "timeseries_enabled": TIMESERIES.enabled,
+            "timeseries_windows": len(TIMESERIES.windows()),
+            "slo_enabled": SLO.enabled,
+        },
     }
     try:
         from spark_rapids_tpu.memory import rmm_spark
@@ -1077,7 +1290,18 @@ def dump_journal_jsonl(path_or_file) -> int:
             n += 1
         f.write(_json.dumps({"kind": "registry_snapshot",
                              "registry": METRICS.snapshot()}) + "\n")
-        return n + 1
+        n += 1
+        # the telemetry plane rides the same dump: the metrics report's
+        # --window mode and srt-top's dump-dir tier read these records
+        if TIMESERIES.enabled:
+            f.write(_json.dumps({"kind": "timeseries_snapshot",
+                                 **timeseries_snapshot()}) + "\n")
+            n += 1
+        if SLO.enabled:
+            f.write(_json.dumps({"kind": "slo_status",
+                                 "slo": SLO.status()}) + "\n")
+            n += 1
+        return n
 
     return dump_via(path_or_file, _write)
 
@@ -1088,3 +1312,7 @@ if os.environ.get("SPARK_RAPIDS_TPU_TRACE", "") not in ("", "0"):
     enable_tracing()
 if os.environ.get("SPARK_RAPIDS_TPU_PROFILE", "") not in ("", "0"):
     enable_profiling()
+if os.environ.get("SPARK_RAPIDS_TPU_TIMESERIES", "") not in ("", "0"):
+    enable_timeseries()
+if os.environ.get("SPARK_RAPIDS_TPU_SLO", "") not in ("", "0"):
+    enable_slo()

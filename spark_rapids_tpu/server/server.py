@@ -498,6 +498,11 @@ class QueryServer:
                 # fair-share evidence satellite: the priority
                 # registry's live view rides the stats endpoint
                 "task_priority": task_priority.stats(),
+                # per-tenant SLO view (ISSUE 16): burn rates +
+                # attainment when the monitor is armed, else None —
+                # callers distinguish "no SLOs" from "all green"
+                "slo": (_obs.SLO.status()
+                        if _obs.SLO.enabled else None),
             }
 
     # -------------------------------------------------------------- workers

@@ -360,6 +360,68 @@ def health_json() -> str:
     return json.dumps(obs.health(), sort_keys=True, default=str)
 
 
+# ------------------------------------------------------ telemetry plane
+# (windowed time-series + per-tenant SLO control surface: the JVM
+# flips the sampler/monitor around a workload, pulls the window ring
+# for its own dashboards, and polls burn-rate status between stages)
+
+
+def timeseries_set_enabled(enabled: bool) -> bool:
+    """Flip the windowed time-series sampler; returns prior state."""
+    from spark_rapids_tpu import observability as obs
+    prior = obs.is_timeseries_enabled()
+    (obs.enable_timeseries if enabled else obs.disable_timeseries)()
+    return prior
+
+
+def timeseries_enabled() -> bool:
+    from spark_rapids_tpu import observability as obs
+    return obs.is_timeseries_enabled()
+
+
+def timeseries_snapshot_json() -> str:
+    """The window ring (per-window counter deltas, gauge last-values,
+    windowed histogram buckets) plus SLO status when the monitor is
+    armed, as JSON — the same shape the fleet publishes to rank 0."""
+    import json
+
+    from spark_rapids_tpu import observability as obs
+    return json.dumps(obs.timeseries_snapshot(), sort_keys=True)
+
+
+def slo_set_enabled(enabled: bool) -> bool:
+    """Arm/disarm per-tenant SLO burn-rate monitoring; returns prior
+    state."""
+    from spark_rapids_tpu import observability as obs
+    prior = obs.is_slo_enabled()
+    (obs.enable_slo if enabled else obs.disable_slo)()
+    return prior
+
+
+def slo_enabled() -> bool:
+    from spark_rapids_tpu import observability as obs
+    return obs.is_slo_enabled()
+
+
+def slo_status_json() -> str:
+    """Per-tenant SLO status (target, objective, attainment, fast/slow
+    burn rates, breach count) as JSON."""
+    import json
+
+    from spark_rapids_tpu import observability as obs
+    return json.dumps(obs.SLO.status(), sort_keys=True)
+
+
+def slo_evaluate_json() -> str:
+    """Force a burn-rate evaluation NOW (bypasses the throttle the
+    Monitor thread uses) and return any fired alerts as a JSON list —
+    each alert also routed through the normal slo_burn incident path."""
+    import json
+
+    from spark_rapids_tpu import observability as obs
+    return json.dumps(obs.evaluate_slo(), sort_keys=True)
+
+
 # ------------------------------------------------------ fault injection
 # (reference: libcufaultinj loaded via CUDA_INJECTION64_PATH with a
 # FAULT_INJECTOR_CONFIG_PATH JSON; here the JVM drives the same

@@ -826,6 +826,41 @@ def health_json() -> str:
     return jni_api.health_json()
 
 
+def timeseries_set_enabled(enabled: bool) -> bool:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.timeseries_set_enabled(bool(enabled))
+
+
+def timeseries_enabled() -> bool:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.timeseries_enabled()
+
+
+def timeseries_snapshot_json() -> str:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.timeseries_snapshot_json()
+
+
+def slo_set_enabled(enabled: bool) -> bool:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.slo_set_enabled(bool(enabled))
+
+
+def slo_enabled() -> bool:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.slo_enabled()
+
+
+def slo_status_json() -> str:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.slo_status_json()
+
+
+def slo_evaluate_json() -> str:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.slo_evaluate_json()
+
+
 def fault_injection_install(config_path: str = "", watch: bool = True,
                             interval_ms: int = 0) -> int:
     from spark_rapids_tpu.shim import jni_api

@@ -41,6 +41,9 @@ MANIFEST = "MANIFEST.json"
 STORM_THRESHOLD = 10
 STRAGGLER_RATIO = 5.0
 STRAGGLER_MIN_SAMPLES = 8
+# Monitor-thread sample age (s) at which the telemetry plane is
+# declared stalled rather than idle
+STALLED_SAMPLER_S = 15.0
 
 
 def _fmt_bytes(n) -> str:
@@ -362,6 +365,43 @@ def analyze(bundle: Bundle) -> List[dict]:
                 "severity": 60, "kind": "lockdep_cycle",
                 "message": ("reversing acquisition came from: "
                             + frame_lines[0].strip())})
+    elif kind == "slo_burn":
+        tenant = detail.get("tenant", "?")
+        findings.append({
+            "severity": 87, "kind": "slo_burn",
+            "message": (f"tenant {tenant!r} is burning its error "
+                        f"budget: burn {detail.get('burn_fast', '?')}x "
+                        f"over the fast {detail.get('fast_window_s', '?')}s "
+                        f"window and {detail.get('burn_slow', '?')}x "
+                        f"over the slow "
+                        f"{detail.get('slow_window_s', '?')}s window "
+                        f"(threshold {detail.get('threshold', '?')}x; "
+                        f"objective {detail.get('objective', '?')} at "
+                        f"{detail.get('latency_target_ms', '?')} ms; "
+                        f"attainment "
+                        f"{detail.get('attainment', '?')})")})
+        # the hot stage behind the burn: the profile frozen into this
+        # bundle is the offending tenant's most recent EXPLAIN ANALYZE
+        prof = bundle.profile or {}
+        pstages = prof.get("stages") or []
+        if pstages:
+            hot = max(pstages, key=lambda s: int(s.get("wall_ns", 0)))
+            findings.append({
+                "severity": 70, "kind": "slo_hot_stage",
+                "message": (f"hot stage behind the burn: "
+                            f"{hot.get('stage')!r} "
+                            f"[{hot.get('engine', '?')}] "
+                            f"{int(hot.get('wall_ns', 0)) / 1e6:.1f} "
+                            f"ms in query {prof.get('query_id')!r} "
+                            f"(tenant {prof.get('tenant') or '?'})")})
+        tail = detail.get("timeseries_tail") or []
+        if tail:
+            findings.append({
+                "severity": 30, "kind": "slo_burn",
+                "message": (f"ring tail frozen: {len(tail)} recent "
+                            f"window(s) of telemetry in trigger.json "
+                            f"(last window seq "
+                            f"{tail[-1].get('window', '?')})")})
     elif kind == "manual":
         findings.append({
             "severity": 10, "kind": "manual",
@@ -571,6 +611,24 @@ def analyze(bundle: Bundle) -> List[dict]:
             "message": (f"{len(episodes)} failed retry episodes in "
                         f"the journal window (sections: "
                         f"{', '.join(sections[:6])})")})
+
+    # ---- monitor sampler liveness -----------------------------------
+    # srt_monitor_last_sample_age_s is recomputed at exposition time
+    # (bundle freeze included), so a dead/stalled Monitor thread shows
+    # a GROWING age here — stale gauges must not masquerade as a
+    # healthy-but-idle system.  No series at all means no Monitor ran,
+    # which is not itself a fault.
+    reg = (bundle.metrics or {}).get("registry") or {}
+    age_fam = reg.get("srt_monitor_last_sample_age_s") or {}
+    for s in age_fam.get("series", []):
+        age = float(s.get("value", 0.0))
+        if age >= STALLED_SAMPLER_S:
+            findings.append({
+                "severity": 68, "kind": "stalled_sampler",
+                "message": (f"telemetry sampler stalled: the Monitor "
+                            f"thread last sampled {age:.1f}s before "
+                            f"this freeze — every gauge and window "
+                            f"after that is stale, not calm")})
 
     # ---- evidence-quality notes -------------------------------------
     jstats = (bundle.metrics or {}).get("journal") or {}

@@ -46,9 +46,29 @@ def split_records(records: List[dict]):
             rollups[int(r.get("task", -1))] = r
         elif kind == "registry_snapshot":
             registry = r.get("registry")
+        elif kind in ("timeseries_snapshot", "slo_status"):
+            pass  # telemetry-plane records: extract_telemetry reads them
         else:
             events.append(r)
     return rollups, registry, events
+
+
+def extract_telemetry(records: List[dict]):
+    """(timeseries_snapshot, slo_status) from a journal dump — the
+    ISSUE-16 records dump_journal_jsonl appends when the telemetry
+    plane is armed.  Either may be None; the slo status embedded in a
+    timeseries snapshot is honored when no standalone record exists."""
+    timeseries = None
+    slo = None
+    for r in records:
+        kind = r.get("kind")
+        if kind == "timeseries_snapshot":
+            timeseries = r
+            if slo is None and r.get("slo"):
+                slo = r["slo"]
+        elif kind == "slo_status":
+            slo = r.get("slo")
+    return timeseries, slo
 
 
 def _ms(ns: int) -> str:
@@ -677,9 +697,131 @@ def render_event_table(events: List[dict]) -> List[str]:
     return out
 
 
+def window_rows(timeseries: Optional[dict],
+                registry: Optional[dict],
+                n: int = 12) -> List[dict]:
+    """Recent-rate rows: for every counter family that moved in the
+    last ``n`` windows, the windowed delta + per-second rate NEXT TO
+    the since-boot total (the distinction this PR exists to surface).
+    Histogram families get windowed p50/p99 alongside the cumulative
+    estimates — recent percentiles from per-window buckets, never the
+    diluted since-boot distribution."""
+    if timeseries is None:
+        return []
+    windows = (timeseries.get("windows") or [])[-n:]
+    if not windows:
+        return []
+    dur = max(sum(w.get("dur_s", 0.0) for w in windows), 1e-9)
+    counters: Dict[str, float] = {}
+    hists: Dict[str, dict] = {}
+    for w in windows:
+        for fam, vals in w.get("counters", {}).items():
+            counters[fam] = counters.get(fam, 0) + sum(vals.values())
+        for fam, h in w.get("histograms", {}).items():
+            acc = hists.setdefault(fam, {
+                "buckets": h["buckets"],
+                "bucket_counts": [0] * (len(h["buckets"]) + 1),
+                "sum": 0, "count": 0})
+            for s in h["series"].values():
+                for i, c in enumerate(s["bucket_counts"]):
+                    acc["bucket_counts"][i] += c
+                acc["sum"] += s["sum"]
+                acc["count"] += s["count"]
+    rows: List[dict] = []
+    for fam in sorted(counters):
+        total = None
+        f = (registry or {}).get(fam)
+        if f and f.get("kind") == "counter":
+            total = sum(s.get("value", 0)
+                        for s in f.get("series", []))
+        rows.append({"family": fam, "kind": "counter",
+                     "recent": counters[fam],
+                     "rate_s": round(counters[fam] / dur, 3),
+                     "since_boot": total})
+    for fam in sorted(hists):
+        h = hists[fam]
+        cum_p99 = None
+        f = (registry or {}).get(fam)
+        if f and f.get("kind") == "histogram":
+            bc = [0] * (len(f.get("buckets", [])) + 1)
+            for s in f.get("series", []):
+                for i, c in enumerate(s.get("bucket_counts", [])):
+                    bc[i] += c
+            if sum(bc):
+                cum_p99 = histogram_quantile(f.get("buckets", []),
+                                             bc, 0.99)
+        rows.append({
+            "family": fam, "kind": "histogram",
+            "recent": h["count"],
+            "recent_p50_ns": histogram_quantile(
+                h["buckets"], h["bucket_counts"], 0.50),
+            "recent_p99_ns": histogram_quantile(
+                h["buckets"], h["bucket_counts"], 0.99),
+            "since_boot_p99_ns": cum_p99})
+    return rows
+
+
+def render_window_table(timeseries: Optional[dict],
+                        registry: Optional[dict],
+                        n: int = 12) -> List[str]:
+    rows = window_rows(timeseries, registry, n)
+    out = ["", f"recent window (last {n} windows of the timeseries "
+               "ring; rates are per second)", ""]
+    if not rows:
+        out.append("(no timeseries_snapshot record in input — run "
+                   "with SPARK_RAPIDS_TPU_TIMESERIES=1)")
+        return out
+    w = max(len(r["family"]) for r in rows)
+    out.append(f"{'family':<{w}}  {'recent':>10}  {'rate/s':>10}  "
+               f"{'since_boot':>12}  {'w_p50_us':>9}  {'w_p99_us':>9}  "
+               f"{'boot_p99_us':>11}")
+    for r in rows:
+        if r["kind"] == "counter":
+            boot = "-" if r["since_boot"] is None \
+                else f"{r['since_boot']}"
+            out.append(f"{r['family']:<{w}}  {r['recent']:>10}  "
+                       f"{r['rate_s']:>10.2f}  {boot:>12}  "
+                       f"{'-':>9}  {'-':>9}  {'-':>11}")
+        else:
+            boot99 = "-" if r["since_boot_p99_ns"] is None \
+                else f"{r['since_boot_p99_ns'] / 1e3:.1f}"
+            out.append(f"{r['family']:<{w}}  {r['recent']:>10}  "
+                       f"{'-':>10}  {'-':>12}  "
+                       f"{r['recent_p50_ns'] / 1e3:>9.1f}  "
+                       f"{r['recent_p99_ns'] / 1e3:>9.1f}  "
+                       f"{boot99:>11}")
+    return out
+
+
+def render_slo_table(slo: Optional[dict]) -> List[str]:
+    out = ["", "per-tenant SLO (burn = bad fraction / error budget; "
+               "fires when fast AND slow exceed threshold)", ""]
+    if not slo:
+        out.append("(no SLO status in input — run with "
+                   "SPARK_RAPIDS_TPU_SLO=1)")
+        return out
+    w = max(max(len(t) for t in slo), len("tenant"))
+    hdr = (f"{'tenant':<{w}}  {'target_ms':>9}  {'objective':>9}  "
+           f"{'events':>7}  {'attainment':>10}  {'burn_fast':>9}  "
+           f"{'burn_slow':>9}  {'breaches':>8}")
+    out.append(hdr)
+    out.append("-" * len(hdr))
+    for t in sorted(slo):
+        r = slo[t]
+        out.append(f"{t:<{w}}  {r.get('latency_target_ms', 0):>9.1f}  "
+                   f"{r.get('objective', 0):>9.3f}  "
+                   f"{r.get('events', 0):>7}  "
+                   f"{r.get('attainment', 0):>10.4f}  "
+                   f"{r.get('burn_fast', 0):>9.2f}  "
+                   f"{r.get('burn_slow', 0):>9.2f}  "
+                   f"{r.get('breaches', 0):>8}")
+    return out
+
+
 def build_report(records: List[dict]) -> dict:
     """Machine-readable report (the --json output)."""
     rollups, registry, events = split_records(records)
+    timeseries, slo = extract_telemetry(records)
     counts: Dict[str, int] = {}
     for e in events:
         k = e.get("kind", "?")
@@ -697,6 +839,8 @@ def build_report(records: List[dict]) -> dict:
         "server": server_rows(events, registry),
         "io": io_rows(events, registry),
         "fleet": fleet_rows(events, registry),
+        "slo": slo,
+        "window": window_rows(timeseries, registry),
     }
 
 
@@ -707,6 +851,11 @@ def main(argv=None) -> int:
     ap.add_argument("inputs", nargs="+", help="journal JSONL files")
     ap.add_argument("--json", action="store_true",
                     help="emit the report as JSON instead of tables")
+    ap.add_argument("--window", type=int, nargs="?", const=12,
+                    default=None, metavar="N",
+                    help="render recent-rate/windowed-percentile "
+                         "columns from the timeseries ring (last N "
+                         "windows, default 12)")
     args = ap.parse_args(argv)
 
     records = load_jsonl(args.inputs)
@@ -714,6 +863,7 @@ def main(argv=None) -> int:
         print(json.dumps(build_report(records), indent=2, sort_keys=True))
         return 0
     rollups, registry, events = split_records(records)
+    timeseries, slo = extract_telemetry(records)
     lines: List[str] = []
     if rollups:
         lines += render_task_table(rollups)
@@ -735,6 +885,11 @@ def main(argv=None) -> int:
         lines += render_fleet_table(events, registry)
     if any(e.get("kind") == "stage_fusion" for e in events):
         lines += render_stage_table(events)
+    if args.window is not None:
+        lines += render_window_table(timeseries, registry,
+                                     args.window)
+    if slo is not None or args.window is not None:
+        lines += render_slo_table(slo)
     if registry is not None:
         lines += render_jit_cache_table(registry)
         if (registry or {}).get("srt_kernel_path_total"):

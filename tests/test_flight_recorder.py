@@ -199,9 +199,10 @@ def test_byte_budget_counts_existing_bundles(tmp_path):
     # budget must comfortably fit ONE bundle (the registry snapshot
     # inside metrics.json grows as instrument families are added —
     # the PR-6 srt_server_* families pushed a polluted-ring bundle
-    # past the old 16 KiB), while the restart below shrinks it to
+    # past the old 16 KiB, and the PR-15 srt_timeseries_*/srt_slo_*
+    # families past 32), while the restart below shrinks it to
     # exactly the first bundle's size to prove cross-restart counting
-    rec, clock, _ = make_recorder(tmp_path, max_bytes=32 << 10)
+    rec, clock, _ = make_recorder(tmp_path, max_bytes=64 << 10)
     first = rec.trigger("a")
     assert first is not None
     used = json.load(open(os.path.join(
