@@ -8,7 +8,8 @@ PY ?= python
         jni-test kudo-bench metrics-smoke trace-smoke chaos-smoke \
         perf-smoke fusion-smoke doctor-smoke server-smoke \
         lifeguard-smoke ingest-smoke dist-smoke analysis-smoke \
-        profile-smoke elastic-smoke slo-smoke serve-bench \
+        profile-smoke elastic-smoke slo-smoke attribution-smoke \
+        serve-bench \
         nightly-artifacts ci ci-nightly clean
 
 # tier-1 set: slow-marked tests (the subprocess fleet twins of the
@@ -182,6 +183,17 @@ elastic-smoke:
 slo-smoke:
 	$(PY) scripts/slo_smoke.py
 
+# time-attribution gate (ISSUE 17): a clean profiled q5's ledger must
+# conserve (buckets sum to the wall), an injected retry burn must stay
+# conserved with dominant_overhead naming the cause, a 2-process fleet
+# under a slow:dst:ms link fault must return byte-identical results
+# while the cross-rank critical path names the slowed exchange edge
+# with zero clamped (negative) edges, srt-explain --diff must exit
+# nonzero attributing the delta to a shuffle bucket, --json outputs
+# must be digest-stable, and disabled hooks at attribute-read cost
+attribution-smoke:
+	$(PY) scripts/attribution_smoke.py
+
 # zipf-skewed multi-tenant serving replay -> BENCH_serve_r01.json
 # (per-tenant p50/p99 admission-to-result, throughput, SLO attainment)
 serve-bench:
@@ -210,7 +222,7 @@ dryrun:
 ci: test fuzz native sanitizers tpu-lower jni-test dryrun metrics-smoke \
     trace-smoke chaos-smoke perf-smoke fusion-smoke doctor-smoke \
     server-smoke lifeguard-smoke ingest-smoke dist-smoke analysis-smoke \
-    profile-smoke elastic-smoke slo-smoke
+    profile-smoke elastic-smoke slo-smoke attribution-smoke
 	$(PY) bench.py
 	@echo "ci: all gates green"
 

@@ -144,6 +144,15 @@ METRICS: Dict[str, Tuple[str, str]] = {
         "gauge", "per-tenant since-boot SLO attainment"),
     "srt_slo_breaches_total": (
         "counter", "slo_burn alerts fired per tenant"),
+    # -- ISSUE 17: time attribution & critical path --
+    "srt_shuffle_wire_ns_total": (
+        "counter", "exchange serialize+send wall time"),
+    "srt_shuffle_wait_ns_total": (
+        "counter", "exchange inbox/gather idle time by cause"),
+    "srt_attribution_ns_total": (
+        "counter", "attributed wall ns per tenant and bucket"),
+    "srt_attribution_queries_total": (
+        "counter", "attribution ledgers built by conservation verdict"),
 }
 
 # ----------------------------------------------------------------- knobs
@@ -295,6 +304,11 @@ KNOBS: Dict[str, str] = {
     "SPARK_RAPIDS_TPU_SLO_SLOW_S": "slow burn-rate window seconds",
     "SPARK_RAPIDS_TPU_SLO_BURN_THRESHOLD":
         "burn rate both windows must reach to fire slo_burn",
+    # -- ISSUE 17: time attribution & critical path --
+    "SPARK_RAPIDS_TPU_ATTRIBUTION":
+        "=1 builds a time-attribution ledger per profiled query",
+    "SPARK_RAPIDS_TPU_ATTRIBUTION_TOLERANCE":
+        "overcount fraction of wall before conservation is broken",
 }
 
 # env families read with a COMPUTED suffix (pinned_path's

@@ -179,6 +179,7 @@ class ShuffleService:
             # serialize once per DISTINCT table: allgather passes the
             # same object to every destination, so an N-rank gather
             # pays one kudo write, not N identical ones
+            t_wire = time.monotonic_ns()
             blob_cache: Dict[int, bytes] = {}
             payloads = []
             for t in tables_by_dest:
@@ -189,11 +190,19 @@ class ShuffleService:
             # local partition loops back through the same parsed form
             # (read_tables verifies its CRC too — uniform path)
             local = _kudo.read_tables(io.BytesIO(payloads[self.rank]))
+            # wire vs wait are sequential, non-overlapping segments on
+            # this thread (_send_all joins every sender before the
+            # inbox wait starts) — the attribution ledger's
+            # shuffle_wire / shuffle_wait split hangs off exactly that
             sent = self._send_all(op_id, payloads)
+            t_wait = time.monotonic_ns()
+            _obs.record_shuffle_wire(op_id, t_wait - t_wire)
             others = [r for r in range(self.world) if r != self.rank]
             received = self.inbox.wait(op_id, others,
                                        self.recv_timeout_s) \
                 if others else {}
+            _obs.record_shuffle_wait(
+                op_id, time.monotonic_ns() - t_wait)
             received[self.rank] = local
             tables: List[_kudo.KudoTable] = []
             for src in range(self.world):
@@ -532,6 +541,7 @@ class ShuffleService:
         peers = [r for r in sorted(view.live) if r != self.rank]
         if not peers:
             return
+        t_wire = time.monotonic_ns()
         dead: List[int] = []  # list.append is GIL-atomic
         ctx = _obs.TRACER.current_context()
 
@@ -553,6 +563,8 @@ class ShuffleService:
             w.start()
         for w in workers:
             w.join()
+        _obs.record_shuffle_wire(op_id,
+                                 time.monotonic_ns() - t_wire)
         for d in dead:
             self._report_death(d)
 
@@ -590,6 +602,11 @@ class ShuffleService:
         spec_seen: Set[int] = set()  # parts with a resolved decision
         last_fetch = 0.0
         fetch_rr = 0
+        # gather idle, split by cause: waits while any missing part is
+        # under a live speculation decision are a straggler's story
+        # (speculation_wait), the rest ordinary inbox idle
+        wait_ns = 0
+        spec_wait_ns = 0
         with _obs.TRACER.span("elastic_gather", kind="stage",
                               attrs={"op": op_id}) as sp:
             while True:
@@ -680,7 +697,14 @@ class ShuffleService:
                         0, detail=f"elastic gather op {op_id}: parts "
                                   f"{missing} missing after "
                                   f"{deadline:.1f}s")
+                t_w = time.monotonic_ns()
                 self.parts.wait_any(op_id, missing, 0.1)
+                dt = time.monotonic_ns() - t_w
+                if spec_seen.intersection(missing):
+                    spec_wait_ns += dt
+                else:
+                    wait_ns += dt
+            _obs.record_shuffle_wait(op_id, wait_ns, spec_wait_ns)
             have = self.parts.get(op_id)
             want_final = set(want(fleet.view())
                              if callable(want) else want)

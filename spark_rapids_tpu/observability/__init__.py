@@ -104,6 +104,8 @@ def reset() -> None:
     Parked OOM block-episode spans are discarded too: a stale span
     ended by a post-reset unblock would otherwise record a pre-reset
     trace_id and a bogus multi-run duration into the fresh ring."""
+    global _LAST_ATTRIBUTION
+    _LAST_ATTRIBUTION = None
     METRICS.reset()
     JOURNAL.clear()
     TASKS.reset()
@@ -389,6 +391,27 @@ SLO_BREACHES = METRICS.counter(
     "slo_burn alerts fired (both burn windows over threshold, "
     "cooldown-filtered), by tenant", labels=("tenant",),
     max_series=128)
+SHUFFLE_WIRE_TIME = METRICS.counter(
+    "srt_shuffle_wire_ns_total",
+    "Query-thread wall spent serializing and sending shuffle frames "
+    "(the wire half of an exchange; peers' ACKs included)")
+SHUFFLE_WAIT_TIME = METRICS.counter(
+    "srt_shuffle_wait_ns_total",
+    "Query-thread wall spent idle waiting on peers' shuffle frames, "
+    "by cause (inbox = ordinary exchange wait, speculation = gather "
+    "idle attributable to parts with a live speculation decision)",
+    labels=("cause",))
+ATTRIBUTION_TIME = METRICS.counter(
+    "srt_attribution_ns_total",
+    "Per-query wall nanoseconds classified by attribution bucket "
+    "(queue_wait/compile/compute_*/shuffle_*/oom_blocked/retry_lost/"
+    "other), by tenant — fed at query end when attribution is armed",
+    labels=("tenant", "bucket"), max_series=512)
+ATTRIBUTION_QUERIES = METRICS.counter(
+    "srt_attribution_queries_total",
+    "Attribution ledgers built at query end, by conservation verdict "
+    "(true = buckets summed to the wall within tolerance)",
+    labels=("conserved",))
 
 
 # ------------------------------------------------------------------ tracer
@@ -425,6 +448,11 @@ TRACER = Tracer(capacity=65536,
 
 
 def _on_profile(profile: dict, assembly_ns: int) -> None:
+    # attribution rides the profile-end hook (its own switch): the
+    # ledger lands INSIDE the artifact, so retention, bundles and
+    # srt-explain all carry it without new plumbing
+    if ATTRIBUTION.enabled:
+        _note_attribution(profile)
     if not _SWITCH.enabled:
         return
     PROFILE_QUERIES.inc(labels=(profile.get("tenant") or "-",))
@@ -464,6 +492,67 @@ def disable_profiling() -> None:
 
 def is_profiling_enabled() -> bool:
     return PROFILER.enabled
+
+
+# ----------------------------------------------------- time attribution
+# Where did the time go (ISSUE 17 tentpole): at profile end the wall is
+# classified into exhaustive non-overlapping buckets with a
+# conservation contract.  Independent switch; with it off the only
+# cost is ONE attribute read inside the profile-end hook (and nothing
+# at all when profiling itself is off).
+
+ATTRIBUTION = _Switch()
+_LAST_ATTRIBUTION: Optional[dict] = None
+
+
+def _attribution_tolerance() -> float:
+    try:
+        return float(os.environ.get(
+            "SPARK_RAPIDS_TPU_ATTRIBUTION_TOLERANCE", "") or 0.25)
+    except ValueError:
+        return 0.25
+
+
+def _note_attribution(profile: dict) -> None:
+    global _LAST_ATTRIBUTION
+    try:
+        from spark_rapids_tpu.observability.attribution import (
+            attribute_profile)
+        ledger = attribute_profile(
+            profile, tolerance=_attribution_tolerance())
+    except Exception:
+        return  # a ledger must never fail the query it describes
+    profile["attribution"] = ledger
+    _LAST_ATTRIBUTION = ledger
+    if not _SWITCH.enabled:
+        return
+    tenant = ledger.get("tenant") or "-"
+    for bucket, ns in ledger.get("buckets", {}).items():
+        if ns > 0:
+            ATTRIBUTION_TIME.inc(int(ns), labels=(tenant, bucket))
+    ATTRIBUTION_QUERIES.inc(
+        labels=("true" if ledger.get("conserved") else "false",))
+
+
+def enable_attribution() -> None:
+    """Turn on per-query time-attribution ledgers (rides the profiler:
+    arming attribution without profiling yields no ledgers; counters
+    additionally require the metrics switch)."""
+    ATTRIBUTION.enabled = True
+
+
+def disable_attribution() -> None:
+    ATTRIBUTION.enabled = False
+
+
+def is_attribution_enabled() -> bool:
+    return ATTRIBUTION.enabled
+
+
+def attribution_last() -> Optional[dict]:
+    """The most recently built ledger (what a flight-recorder bundle
+    freezes as ``attribution.json``)."""
+    return _LAST_ATTRIBUTION
 
 
 # -------------------------------------------------------- flight recorder
@@ -749,6 +838,35 @@ def record_shuffle_link_retry(peer: str, reason: str) -> None:
     SHUFFLE_LINK_RETRIES.inc(labels=(peer, reason))
     JOURNAL.emit("shuffle_link_retry", peer=peer, reason=reason,
                  thread=threading.get_ident())
+
+
+def record_shuffle_wire(op_id: int, wire_ns: int) -> None:
+    """The wire half of one exchange on the query thread: serialize +
+    concurrent per-peer sends, ACKs included (distributed/service.py).
+    Thread-stamped so the per-query profile claims it."""
+    if not _SWITCH.enabled:
+        return
+    wire_ns = int(wire_ns)
+    SHUFFLE_WIRE_TIME.inc(wire_ns)
+    JOURNAL.emit("shuffle_wire", op=int(op_id), wire_ns=wire_ns,
+                 thread=threading.get_ident())
+
+
+def record_shuffle_wait(op_id: int, wait_ns: int,
+                        spec_ns: int = 0) -> None:
+    """The idle half of one exchange/gather: blocked on peers' frames
+    (``wait_ns``), with the slice attributable to parts under a live
+    speculation decision split out as ``spec_ns`` — a straggler's
+    story, not the wire's."""
+    if not _SWITCH.enabled:
+        return
+    wait_ns, spec_ns = int(wait_ns), int(spec_ns)
+    if wait_ns > 0:
+        SHUFFLE_WAIT_TIME.inc(wait_ns, labels=("inbox",))
+    if spec_ns > 0:
+        SHUFFLE_WAIT_TIME.inc(spec_ns, labels=("speculation",))
+    JOURNAL.emit("shuffle_wait", op=int(op_id), wait_ns=wait_ns,
+                 spec_ns=spec_ns, thread=threading.get_ident())
 
 
 def set_fleet_epoch(epoch: int) -> None:
@@ -1243,6 +1361,7 @@ def health() -> dict:
             "timeseries_enabled": TIMESERIES.enabled,
             "timeseries_windows": len(TIMESERIES.windows()),
             "slo_enabled": SLO.enabled,
+            "attribution_enabled": ATTRIBUTION.enabled,
         },
     }
     try:
@@ -1316,3 +1435,5 @@ if os.environ.get("SPARK_RAPIDS_TPU_TIMESERIES", "") not in ("", "0"):
     enable_timeseries()
 if os.environ.get("SPARK_RAPIDS_TPU_SLO", "") not in ("", "0"):
     enable_slo()
+if os.environ.get("SPARK_RAPIDS_TPU_ATTRIBUTION", "") not in ("", "0"):
+    enable_attribution()

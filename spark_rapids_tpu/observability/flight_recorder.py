@@ -383,6 +383,18 @@ class FlightRecorder:
         except Exception:
             pass   # a malformed profile must not block the bundle
 
+        # ISSUE 17: the last query's time-attribution ledger freezes
+        # alongside the profile so srt-doctor can name the dominant
+        # bucket at incident time.  Attribution-off processes keep
+        # their bundle layout unchanged.
+        try:
+            led = obs.attribution_last()
+            if led is not None:
+                files["attribution.json"] = json.dumps(
+                    led, indent=2, sort_keys=True, default=str)
+        except Exception:
+            pass   # a torn ledger must not block the bundle
+
         files["env.json"] = json.dumps(self._env_fingerprint(),
                                        indent=2, sort_keys=True)
         return files

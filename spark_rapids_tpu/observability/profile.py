@@ -65,7 +65,8 @@ _DELTA_FAMILIES = (
 # journal kinds folded into the artifact when their ``thread`` (or
 # ``task``) attribution matches the session
 _THREAD_KINDS = ("retry_episode", "kernel_path", "oom_retry",
-                 "oom_split_retry", "thread_unblocked")
+                 "oom_split_retry", "thread_unblocked",
+                 "shuffle_wire", "shuffle_wait")
 
 # the TaskMetricsTable's shared fallback row (threads with no RmmSpark
 # binding).  It is process-wide, so its deltas are only trustworthy
@@ -111,19 +112,21 @@ class ProfileSession:
     snapshot the assembly diffs against."""
 
     __slots__ = ("query_id", "tenant", "query", "rank", "world",
-                 "thread", "t0_ns", "t0_unix_ms", "seq0", "trace_id",
-                 "task_ids", "task_base", "registry_base",
-                 "stage_records", "shared")
+                 "queue_wait_ns", "thread", "t0_ns", "t0_unix_ms",
+                 "seq0", "trace_id", "task_ids", "task_base",
+                 "registry_base", "stage_records", "shared")
 
     def __init__(self, query_id: str, tenant: str, query: str,
                  rank: int, world: int, *, thread: int, seq0: int,
                  trace_id: Optional[str], task_ids: List[int],
-                 task_base: Dict[int, dict], registry_base: dict):
+                 task_base: Dict[int, dict], registry_base: dict,
+                 queue_wait_ns: int = 0):
         self.query_id = query_id
         self.tenant = tenant
         self.query = query
         self.rank = rank
         self.world = world
+        self.queue_wait_ns = queue_wait_ns
         self.thread = thread
         self.t0_ns = time.monotonic_ns()
         self.t0_unix_ms = int(time.time() * 1000)
@@ -193,12 +196,16 @@ class QueryProfiler:
     # ------------------------------------------------------------ begin
 
     def begin(self, query_id: str, tenant: str = "", query: str = "",
-              rank: int = 0, world: int = 1
+              rank: int = 0, world: int = 1, queue_wait_ns: int = 0
               ) -> Optional[ProfileSession]:
         """Open a session bound to the CALLING thread (the thread the
         stage executions will run on).  Returns None when disabled, or
         when the thread already profiles a query (the outer session
-        wins; the nested begin is counted dropped)."""
+        wins; the nested begin is counted dropped).  ``queue_wait_ns``
+        is the server's admission-to-dispatch wait: the profile's own
+        wall starts at begin, so the pre-dispatch story must be handed
+        in for the attribution ledger to see the whole
+        admission-to-result wall."""
         if not self.enabled:
             return None
         thread = threading.get_ident()
@@ -240,7 +247,8 @@ class QueryProfiler:
                 seq0=(self.journal.total_emitted
                       if self.journal is not None else 0),
                 trace_id=trace_id, task_ids=task_ids,
-                task_base=task_base, registry_base=registry_base)
+                task_base=task_base, registry_base=registry_base,
+                queue_wait_ns=max(int(queue_wait_ns), 0))
         except Exception:
             with self._lock:
                 if self._sessions.get(thread) is None:
@@ -321,6 +329,7 @@ class QueryProfiler:
             "trace_id": sess.trace_id,
             "t_unix_ms": sess.t0_unix_ms,
             "wall_ns": t_end_ns - sess.t0_ns,
+            "queue_wait_ns": sess.queue_wait_ns,
             "stages": stages,
             "hot_stage": hot["stage"] if hot else None,
         }
@@ -345,17 +354,23 @@ class QueryProfiler:
                 a["calls"] = 0
                 a["wall_ns"] = 0
                 a["compiled"] = False
+                a["compile_ns"] = 0
                 agg[key] = a
                 order.append(key)
             a["calls"] += 1
             a["wall_ns"] += int(r.get("wall_ns", 0))
             a["compiled"] = a["compiled"] or bool(r.get("compiled"))
+            a["compile_ns"] += int(r.get("compile_ns", 0))
+            # the dispatch window widens to cover every execution
+            if "t_end_ns" in r:
+                a["t_end_ns"] = max(int(a.get("t_end_ns", 0)),
+                                    int(r["t_end_ns"]))
         return [agg[k] for k in order]
 
     def _fold_journal(self, sess: ProfileSession) -> dict:
         if self.journal is None:
             return {"retries": {}, "oom": {}, "kernel_paths": {},
-                    "events": {}}
+                    "events": {}, "shuffle": {}}
         window = [r for r in self.journal.records()
                   if r.get("seq", 0) > sess.seq0]
         tasks = set(sess.task_ids)
@@ -371,6 +386,7 @@ class QueryProfiler:
         retries = {"episodes": 0, "attempts": 0, "splits": 0,
                    "lost_ns": 0, "outcomes": {}}
         oom = {"retry": 0, "split_retry": 0, "blocked_ns": 0}
+        shuffle = {"wire_ns": 0, "wait_ns": 0, "spec_wait_ns": 0}
         kernel_paths: Dict[str, int] = {}
         events: Dict[str, int] = {}
         for r in window:
@@ -400,7 +416,12 @@ class QueryProfiler:
             elif kind == "kernel_path":
                 k = f"{r.get('op', '?')}:{r.get('path', '?')}"
                 kernel_paths[k] = kernel_paths.get(k, 0) + 1
-        return {"retries": retries, "oom": oom,
+            elif kind == "shuffle_wire":
+                shuffle["wire_ns"] += int(r.get("wire_ns", 0))
+            elif kind == "shuffle_wait":
+                shuffle["wait_ns"] += int(r.get("wait_ns", 0))
+                shuffle["spec_wait_ns"] += int(r.get("spec_ns", 0))
+        return {"retries": retries, "oom": oom, "shuffle": shuffle,
                 "kernel_paths": kernel_paths, "events": events}
 
     def _fold_tasks(self, sess: ProfileSession) -> dict:
@@ -620,6 +641,8 @@ def merge_profiles(profiles: List[dict]) -> dict:
         "t_unix_ms": min(int(p.get("t_unix_ms", 0))
                          for p in profiles),
         "wall_ns": max(int(p.get("wall_ns", 0)) for p in profiles),
+        "queue_wait_ns": max(int(p.get("queue_wait_ns", 0) or 0)
+                             for p in profiles),
         "per_rank_wall_ns": {str(r): int(p.get("wall_ns", 0))
                              for r, p in zip(ranks, profiles)},
         "stages": stages,
@@ -631,6 +654,8 @@ def merge_profiles(profiles: List[dict]) -> dict:
         "retries": {k: int(v) for k, v in
                     _sum_field("retries").items()},
         "oom": {k: int(v) for k, v in _sum_field("oom").items()},
+        "shuffle": {k: int(v) for k, v in
+                    _sum_field("shuffle").items()},
         "kernel_paths": {k: int(v) for k, v in
                          _sum_field("kernel_paths").items()},
     }
@@ -647,8 +672,12 @@ def diff_profiles(baseline: dict, current: dict, *,
     per call grew past ``threshold`` x the baseline AND by more than
     ``min_delta_ns`` (the floor keeps micro-stage jitter out).
     Stages are matched by NAME (a re-tuned plan changes its digest but
-    remains the same logical stage).  Returns regression findings,
-    most-regressed first; empty = no regression."""
+    remains the same logical stage).  Stages present ONLY in the
+    baseline — dropped by a re-plan — are reported as ``removed`` rows
+    (a vanished stage is a plan change worth seeing, not a silent
+    no-op), after the regressions.  Returns findings, most-regressed
+    first; regressions carry ``kind == "regression"``; an output with
+    only ``removed`` rows means no wall regression."""
 
     def per_stage(p: dict) -> Dict[str, dict]:
         out: Dict[str, dict] = {}
@@ -674,9 +703,18 @@ def diff_profiles(baseline: dict, current: dict, *,
                 and c["mean_ns"] - b["mean_ns"] >= min_delta_ns:
             findings.append({
                 "stage": stage,
+                "kind": "regression",
                 "base_mean_ms": round(b["mean_ns"] / 1e6, 3),
                 "cur_mean_ms": round(c["mean_ns"] / 1e6, 3),
                 "ratio": round(ratio, 2),
             })
     findings.sort(key=lambda f: -f["ratio"])
+    for stage in sorted(set(base) - set(cur)):
+        b = base[stage]
+        findings.append({
+            "stage": stage,
+            "kind": "removed",
+            "base_mean_ms": round(b["mean_ns"] / 1e6, 3),
+            "base_calls": b["calls"],
+        })
     return findings

@@ -364,7 +364,11 @@ class CompiledStage:
                    ) -> tuple:
         """ONE AOT executable through the process compile cache,
         keyed by (stage-plan digest, all-operand schema digest, row
-        bucket).  Returns (outputs, compiled_now, run_digest)."""
+        bucket).  Returns (outputs, compile_ns, run_digest) —
+        ``compile_ns`` is the lower+compile wall when THIS call built
+        the executable, 0 on a cache hit (truthiness keeps the old
+        compiled-now contract; the attribution ledger carves the
+        nanoseconds out of the stage's compute)."""
         from spark_rapids_tpu import observability as _obs
         from spark_rapids_tpu.perf import jit_cache as _jc
 
@@ -374,13 +378,14 @@ class CompiledStage:
         compiled_now = []
 
         def build():
+            t0 = time.monotonic_ns()
             with _obs.TRACER.span(
                     "stage_compile", kind="compile",
                     attrs={"stage": self.plan.name, "digest": digest,
                            "bucket": bucket,
                            "nodes": self.dispatch_count}):
                 ex = jax.jit(fn).lower(*args).compile()
-            compiled_now.append(True)
+            compiled_now.append(time.monotonic_ns() - t0)
             return ex
 
         if _jc.CACHE.enabled():
@@ -398,7 +403,7 @@ class CompiledStage:
                 jf = self._nocache.setdefault((digest, bucket),
                                               jax.jit(fn))
             out = jf(*args)
-        return out, bool(compiled_now), digest
+        return out, (compiled_now[0] if compiled_now else 0), digest
 
     def run_unfused(self, inputs) -> tuple:
         """Op-by-op eager walk on unpadded inputs: every node pays its
@@ -430,13 +435,14 @@ class CompiledStage:
 
         mode = fusion_mode()
         compiled = False
+        compile_ns = 0
         wall_ns = None
         # the event digest is the full RUN key (plan | operand
         # shapes): the stages table must not average walls across row
         # buckets, or a small escape-hatch run would skew the ratio a
         # large fused workload reads as its regression signal
         if mode == "auto":
-            out, compiled, outcome, wall_ns, digest = \
+            out, compiled, outcome, wall_ns, digest, compile_ns = \
                 self._run_calibrated(inputs)
         else:
             t0 = time.monotonic_ns()
@@ -445,7 +451,8 @@ class CompiledStage:
                 digest = self._run_digest(
                     self._shape_parts(inputs)[0])
             else:
-                out, compiled, digest = self._run_fused(inputs)
+                out, compile_ns, digest = self._run_fused(inputs)
+                compiled = bool(compile_ns)
                 outcome = "fused"
             jax.block_until_ready(out)
             wall_ns = time.monotonic_ns() - t0
@@ -460,14 +467,18 @@ class CompiledStage:
         if _obs.PROFILER.active():
             _obs.PROFILER.note_stage(self._profile_record(
                 inputs, digest=digest, engine=outcome,
-                wall_ns=wall_ns, compiled=compiled))
+                wall_ns=wall_ns, compiled=compiled,
+                compile_ns=compile_ns))
         return out
 
     def _profile_record(self, inputs, *, digest: str, engine: str,
-                        wall_ns, compiled: bool) -> dict:
+                        wall_ns, compiled: bool,
+                        compile_ns: int = 0) -> dict:
         """The typed per-stage profile row: plan structure (node
         kinds + outputs), per-input rows/bucket/pad-waste, engine,
-        wall, compile-vs-cache-hit, dispatch count."""
+        wall, compile-vs-cache-hit (plus the build's own wall, for
+        the attribution ledger's compile bucket), dispatch count, and
+        the monotonic dispatch window the critical path orders by."""
         import numpy as np
 
         from spark_rapids_tpu.perf.jit_cache import bucket_rows
@@ -482,12 +493,16 @@ class CompiledStage:
             ins.append({"name": inp.name, "rows": rows,
                         "bucket": bucket,
                         "pad_rows": max(bucket - rows, 0)})
+        t_end_ns = time.monotonic_ns()
         return {
             "stage": self.plan.name,
             "digest": digest,
             "engine": ("unfused" if engine == "unfused" else "fused"),
             "compiled": bool(compiled),
+            "compile_ns": int(compile_ns),
             "wall_ns": int(wall_ns or 0),
+            "t_start_ns": t_end_ns - int(wall_ns or 0),
+            "t_end_ns": t_end_ns,
             "dispatches": (self.dispatch_count
                            if engine == "unfused" else 1),
             "nodes_total": self.dispatch_count,
@@ -521,9 +536,9 @@ class CompiledStage:
         — and every later one takes the cached winner.  Both engines
         are byte-identical, so calibration is a speed choice only (the
         PR-9 contract, promoted from per-op to per-stage).  Returns
-        (outputs, compiled, outcome, wall_ns, run_digest) with the
-        wall of the winning engine's OWN execution (measurement runs
-        excluded)."""
+        (outputs, compiled, outcome, wall_ns, run_digest, compile_ns)
+        with the wall of the winning engine's OWN execution
+        (measurement runs excluded)."""
         from spark_rapids_tpu.perf import calibrate
 
         parts, _bucket = self._shape_parts(inputs)
@@ -549,7 +564,7 @@ class CompiledStage:
             out, c, _d = self._run_fused(
                 calib_inputs, run_digest=None if sampled else digest)
             if c:
-                compiled.append(True)
+                compiled.append(c)
             return out
 
         path = calibrate.pick_path(
@@ -570,17 +585,17 @@ class CompiledStage:
             # reuse its outputs and its measured wall instead of
             # paying a third execution
             return (last[path], bool(compiled), outcome, walls[path],
-                    digest)
+                    digest, sum(compiled))
         t0 = time.monotonic_ns()
         if path == "op_by_op":
             out = self.run_unfused(inputs)
         else:
             out, c, _d = self._run_fused(inputs, run_digest=digest)
             if c:
-                compiled.append(True)
+                compiled.append(c)
         jax.block_until_ready(out)
         return (out, bool(compiled), outcome,
-                time.monotonic_ns() - t0, digest)
+                time.monotonic_ns() - t0, digest, sum(compiled))
 
 
 # plan-verify gate (ISSUE 12): every distinct plan digest is verified

@@ -470,6 +470,13 @@ class QueryServer:
                 row["quota"] = {"max_inflight": q.max_inflight,
                                 "max_device_bytes": q.max_device_bytes,
                                 "weight": q.weight}
+                # per-tenant wall-clock split (ISSUE 17): summed
+                # attribution buckets over the tenant's retained
+                # profiles — only present when attribution is armed,
+                # so older consumers see an unchanged shape
+                if _obs.is_attribution_enabled():
+                    row["attribution"] = \
+                        self._tenant_attribution_locked(tenant)
                 tenants[tenant] = row
             return {
                 "config": {
@@ -594,8 +601,12 @@ class QueryServer:
                 # runner only — queue wait is the server's story, the
                 # profile's wall is the execution.  One attribute
                 # read when SPARK_RAPIDS_TPU_PROFILE is off.
+                # ... but the attribution ledger DOES want the whole
+                # admission-to-result wall, so the measured queue wait
+                # rides into the session as a stamp
                 psess = _obs.PROFILER.begin(
-                    job.query_id, tenant=job.tenant, query=job.query)
+                    job.query_id, tenant=job.tenant, query=job.query,
+                    queue_wait_ns=job.wait_ns)
                 try:
                     result = self._runner(job.query, job.params, ctx)
                 finally:
@@ -664,6 +675,28 @@ class QueryServer:
                 {job.tenant: self._tenant_device_bytes(job.tenant)})
 
     # ----------------------------------------------------- query profiles
+
+    def _tenant_attribution_locked(self, tenant: str
+                                   ) -> Optional[dict]:
+        """Summed attribution buckets over a tenant's retained
+        profiles (caller holds ``self._lock``).  None until at least
+        one ledger-carrying profile is retained — callers distinguish
+        'not armed yet' from 'all zeros'."""
+        buckets: Dict[str, int] = {}
+        n = 0
+        for qid in self._profile_order.get(tenant, ()):
+            led = (self._profiles.get(qid) or {}).get("attribution")
+            if not led:
+                continue
+            n += 1
+            for b, v in (led.get("buckets") or {}).items():
+                buckets[b] = buckets.get(b, 0) + int(v)
+        if n == 0:
+            return None
+        nonzero = {b: v for b, v in buckets.items() if v > 0}
+        return {"queries": n, "buckets": buckets,
+                "dominant": (max(nonzero, key=nonzero.get)
+                             if nonzero else None)}
 
     def _retain_profile(self, tenant: str, query_id: str,
                         profile: dict) -> None:

@@ -422,6 +422,38 @@ def slo_evaluate_json() -> str:
     return json.dumps(obs.evaluate_slo(), sort_keys=True)
 
 
+# ----------------------------------------------------- time attribution
+# (the "where did the time go" ledger: the JVM arms it around a
+# workload and pulls the last query's bucket waterfall for its own
+# p99-miss triage)
+
+
+def attribution_set_enabled(enabled: bool) -> bool:
+    """Flip per-query time-attribution ledgers; returns prior state."""
+    from spark_rapids_tpu import observability as obs
+    prior = obs.is_attribution_enabled()
+    (obs.enable_attribution if enabled
+     else obs.disable_attribution)()
+    return prior
+
+
+def attribution_enabled() -> bool:
+    from spark_rapids_tpu import observability as obs
+    return obs.is_attribution_enabled()
+
+
+def attribution_last_json() -> str:
+    """Most recent query's time-attribution ledger (bucket ns,
+    fractions, dominant bucket, conservation verdict) as JSON
+    ('' when no profiled query has completed with the switch on)."""
+    import json
+
+    from spark_rapids_tpu import observability as obs
+    led = obs.attribution_last()
+    return json.dumps(led, sort_keys=True, default=str) \
+        if led is not None else ""
+
+
 # ------------------------------------------------------ fault injection
 # (reference: libcufaultinj loaded via CUDA_INJECTION64_PATH with a
 # FAULT_INJECTOR_CONFIG_PATH JSON; here the JVM drives the same

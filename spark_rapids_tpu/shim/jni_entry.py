@@ -861,6 +861,21 @@ def slo_evaluate_json() -> str:
     return jni_api.slo_evaluate_json()
 
 
+def attribution_set_enabled(enabled: bool) -> bool:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.attribution_set_enabled(bool(enabled))
+
+
+def attribution_enabled() -> bool:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.attribution_enabled()
+
+
+def attribution_last_json() -> str:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.attribution_last_json()
+
+
 def fault_injection_install(config_path: str = "", watch: bool = True,
                             interval_ms: int = 0) -> int:
     from spark_rapids_tpu.shim import jni_api

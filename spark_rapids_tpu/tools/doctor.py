@@ -117,6 +117,11 @@ class Bundle:
         # evidence plane (absent in profiler-off processes)
         self.profile = _load_json(os.path.join(path, "profile.json"),
                                   {})
+        # ISSUE 17: the last query's time-attribution ledger (absent
+        # in attribution-off processes — findings built from it only
+        # appear when the bundle carries it)
+        self.attribution = _load_json(
+            os.path.join(path, "attribution.json"), {})
 
 
 def is_bundle_dir(path: str) -> bool:
@@ -408,6 +413,33 @@ def analyze(bundle: Bundle) -> List[dict]:
             "message": (f"manual dump "
                         f"({detail.get('reason', 'no reason given')}) "
                         f"— no failure trigger")})
+
+    # ---- time attribution (ISSUE 17) --------------------------------
+    # on the latency-shaped triggers, name the dominant wall-clock
+    # bucket of the last profiled query: "where the time went" is the
+    # first question an operator asks a slo_burn/query_hang bundle
+    if kind in ("slo_burn", "query_hang", "admission_stall") \
+            and bundle.attribution:
+        led = bundle.attribution
+        buckets = {b: int(v) for b, v in
+                   (led.get("buckets") or {}).items() if int(v) > 0}
+        dom = led.get("dominant")
+        if dom and buckets:
+            wall = max(int(led.get("wall_ns", 0)), 1)
+            top = sorted(buckets.items(), key=lambda kv: -kv[1])[:3]
+            split = ", ".join(
+                f"{b} {v / 1e6:.1f} ms ({100 * v / wall:.0f}%)"
+                for b, v in top)
+            msg = (f"where the wall went (query "
+                   f"{led.get('query_id', '?')!r}, tenant "
+                   f"{led.get('tenant', '?')!r}): dominant bucket "
+                   f"{dom} — {split}")
+            if not led.get("conserved", True):
+                msg += (f"; CONSERVATION BROKEN (overcount "
+                        f"{int(led.get('overcount_ns', 0)) / 1e6:.1f}"
+                        f" ms) — bucket seams double-counted")
+            findings.append({"severity": 71, "kind": "attribution",
+                             "message": msg})
 
     # ---- memory-leak journal history --------------------------------
     for r in bundle.journal:

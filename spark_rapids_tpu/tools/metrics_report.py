@@ -705,7 +705,11 @@ def window_rows(timeseries: Optional[dict],
     the since-boot total (the distinction this PR exists to surface).
     Histogram families get windowed p50/p99 alongside the cumulative
     estimates — recent percentiles from per-window buckets, never the
-    diluted since-boot distribution."""
+    diluted since-boot distribution.  A registry histogram family with
+    ZERO samples in the window still gets a row, with ``None``
+    percentiles (rendered ``-``): quiet-right-now is a reading, and
+    substituting the since-boot distribution would claim recency the
+    data does not have."""
     if timeseries is None:
         return []
     windows = (timeseries.get("windows") or [])[-n:]
@@ -738,8 +742,12 @@ def window_rows(timeseries: Optional[dict],
                      "recent": counters[fam],
                      "rate_s": round(counters[fam] / dur, 3),
                      "since_boot": total})
-    for fam in sorted(hists):
-        h = hists[fam]
+    hist_fams = set(hists)
+    for fam, f in (registry or {}).items():
+        if f.get("kind") == "histogram":
+            hist_fams.add(fam)
+    for fam in sorted(hist_fams):
+        h = hists.get(fam)
         cum_p99 = None
         f = (registry or {}).get(fam)
         if f and f.get("kind") == "histogram":
@@ -750,13 +758,17 @@ def window_rows(timeseries: Optional[dict],
             if sum(bc):
                 cum_p99 = histogram_quantile(f.get("buckets", []),
                                              bc, 0.99)
+        count = h["count"] if h else 0
         rows.append({
             "family": fam, "kind": "histogram",
-            "recent": h["count"],
-            "recent_p50_ns": histogram_quantile(
-                h["buckets"], h["bucket_counts"], 0.50),
-            "recent_p99_ns": histogram_quantile(
-                h["buckets"], h["bucket_counts"], 0.99),
+            "recent": count,
+            # zero window samples -> None, NOT a since-boot stand-in
+            "recent_p50_ns": (histogram_quantile(
+                h["buckets"], h["bucket_counts"], 0.50)
+                if h and count else None),
+            "recent_p99_ns": (histogram_quantile(
+                h["buckets"], h["bucket_counts"], 0.99)
+                if h and count else None),
             "since_boot_p99_ns": cum_p99})
     return rows
 
@@ -785,10 +797,14 @@ def render_window_table(timeseries: Optional[dict],
         else:
             boot99 = "-" if r["since_boot_p99_ns"] is None \
                 else f"{r['since_boot_p99_ns'] / 1e3:.1f}"
+            p50 = "-" if r["recent_p50_ns"] is None \
+                else f"{r['recent_p50_ns'] / 1e3:.1f}"
+            p99 = "-" if r["recent_p99_ns"] is None \
+                else f"{r['recent_p99_ns'] / 1e3:.1f}"
             out.append(f"{r['family']:<{w}}  {r['recent']:>10}  "
                        f"{'-':>10}  {'-':>12}  "
-                       f"{r['recent_p50_ns'] / 1e3:>9.1f}  "
-                       f"{r['recent_p99_ns'] / 1e3:>9.1f}  "
+                       f"{p50:>9}  "
+                       f"{p99:>9}  "
                        f"{boot99:>11}")
     return out
 

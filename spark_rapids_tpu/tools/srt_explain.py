@@ -20,12 +20,26 @@ bytes and jit-cache activity the profiler folded in.
 ``--diff BASELINE`` compares per-stage mean walls against a baseline
 profile and EXITS NONZERO when any stage regressed beyond
 ``--threshold`` — the per-node guardrail the bench-trajectory BENCH_*
-files cannot give.
+files cannot give.  Stages present in the baseline but absent from the
+current profile render as ``removed`` rows (informational: a vanished
+stage is a plan change, not a regression, so it never fails the gate).
+When both sides carry time-attribution ledgers the diff ALSO names
+which bucket absorbed the extra wall (``diff_attribution``).
+
+``--where`` (ISSUE 17) renders the time-attribution waterfall: the
+admission-to-result wall split into the exhaustive bucket set from
+``observability/attribution.py``, with the conservation verdict.
+``--critical-path`` switches the inputs to per-rank span JSONL dumps
+(or bundle dirs) and renders the cross-rank critical path — the chain
+of spans the wall actually waited on — plus the exchange-edge
+leaderboard with the hot link flagged.
 
 Usage:
     python -m spark_rapids_tpu.tools.srt_explain PROFILE.json \
-        [more_rank_profiles.json ...] [--nodes] [--json] \
+        [more_rank_profiles.json ...] [--nodes] [--json] [--where] \
         [--diff BASELINE.profile.json] [--threshold 1.5]
+    python -m spark_rapids_tpu.tools.srt_explain --critical-path \
+        spans_rank0.jsonl spans_rank1.jsonl [--json]
 """
 
 from __future__ import annotations
@@ -35,6 +49,9 @@ import json
 import sys
 from typing import Dict, List
 
+from spark_rapids_tpu.observability.attribution import (
+    OVERHEAD_BUCKETS, attribute_many, diff_attribution, hot_rank)
+from spark_rapids_tpu.observability.critical_path import critical_path
 from spark_rapids_tpu.observability.profile import (diff_profiles,
                                                     merge_profiles)
 
@@ -54,6 +71,27 @@ def load_profiles(paths) -> List[dict]:
                                  f"(no 'stages')")
             out.append(prof)
     return out
+
+
+def load_spans(paths) -> Dict[int, List[dict]]:
+    """rank -> span records for ``--critical-path``.  Each input is a
+    tracer/journal JSONL dump (or a bundle dir standing in for its
+    spans.jsonl); the rank comes from the records themselves when
+    stamped, else from the input ordinal — so both the distributed
+    runner's ``spans_rank<r>.jsonl`` layout and anonymous dumps work."""
+    from spark_rapids_tpu.tools import expand_bundle_input, read_jsonl
+
+    by_rank: Dict[int, List[dict]] = {}
+    for ordinal, p0 in enumerate(paths):
+        for p in expand_bundle_input(p0, "spans"):
+            records = read_jsonl(p)
+            rank = ordinal
+            for r in records:
+                if isinstance(r.get("rank"), int):
+                    rank = r["rank"]
+                    break
+            by_rank.setdefault(rank, []).extend(records)
+    return by_rank
 
 
 # ---------------------------------------------------------------- render
@@ -225,18 +263,127 @@ def render_profile(profile: dict, *, nodes: bool = False
     return out
 
 
-def render_diff(findings: List[dict], threshold: float) -> List[str]:
+def render_where(ledger: dict) -> List[str]:
+    """The time-attribution waterfall as text lines.  Like the plan
+    tree, purely ledger-derived — same ledger, same text."""
+    out: List[str] = []
+    wall = max(int(ledger.get("wall_ns") or 0), 1)
+    out.append(f"where did the time go: "
+               f"{ledger.get('query') or '?'}"
+               f"  (query_id {ledger.get('query_id') or '?'}"
+               + (f", tenant {ledger['tenant']}"
+                  if ledger.get("tenant") else "")
+               + f", wall {_ms(ledger.get('wall_ns'))} ms"
+               + (" over "
+                  f"{len(ledger.get('per_rank') or ())} ranks"
+                  if ledger.get("fleet") else "") + ")")
+    buckets = ledger.get("buckets") or {}
+    dom = ledger.get("dominant")
+    for b, v in sorted(buckets.items(), key=lambda kv: -kv[1]):
+        if v <= 0:
+            continue
+        pct = min(100 * int(v) // max(sum(buckets.values()), 1), 100)
+        line = f"  {b:<16} {_ms(v):>10} ms  ({pct:>2}%)"
+        if b == dom:
+            line += "  <-- dominant"
+        out.append(line)
+    dov = ledger.get("dominant_overhead")
+    if dov:
+        hr = hot_rank(ledger, dov)
+        out.append(f"  dominant overhead: {dov}"
+                   + (f" (hot rank {hr})" if hr is not None else ""))
+    if ledger.get("conserved"):
+        out.append("  conservation: OK")
+    else:
+        oc = ledger.get("overcount_ns")
+        if oc is None:  # fleet rollup: find the broken rank(s)
+            oc = max((led.get("overcount_ns", 0) for led in
+                      (ledger.get("per_rank") or {}).values()),
+                     default=0)
+        out.append(f"  conservation: BROKEN — buckets overcount the "
+                   f"wall by {_ms(oc)} ms (double-counted seams)")
+    return out
+
+
+def render_critical_path(result: dict) -> List[str]:
+    """The cross-rank critical path + exchange-edge leaderboard.  The
+    hottest segment (largest dur + inbound gap) and the hottest
+    exchange edge carry ``<-- HOT`` markers."""
+    out: List[str] = []
+    path = result.get("path") or []
+    out.append(f"critical path: {len(path)} segment(s), "
+               f"{_ms(result.get('total_ns'))} ms covered"
+               + (f", {result['clamped_edges']} edge(s) clamped"
+                  if result.get("clamped_edges") else ""))
+    offs = result.get("clock_offsets") or {}
+    if any(int(v) for v in offs.values()):
+        out.append("  clock offsets: " + "  ".join(
+            f"r{r}={int(v)}ns" for r, v in
+            sorted(offs.items(), key=lambda kv: int(kv[0]))))
+    for rk in result.get("truncated_ranks") or ():
+        out.append(f"  WARNING: rank {rk} span dump truncated — "
+                   f"path may be partial")
+    hot_i = max(range(len(path)),
+                key=lambda i: path[i]["dur_ns"] + path[i]["gap_in_ns"],
+                default=None) if path else None
+    for i, seg in enumerate(path):
+        if seg["edge_in"] == "exchange":
+            out.append(f"    ~~> exchange hop "
+                       f"(wire+wait {_ms(seg['gap_in_ns'])} ms)")
+        elif seg["gap_in_ns"] > 0:
+            out.append(f"    ... lane idle {_ms(seg['gap_in_ns'])} ms")
+        line = (f"  r{seg['rank']} {seg['name']:<24} "
+                f"[{seg['span_kind']}/{seg['bucket']}]  "
+                f"{_ms(seg['dur_ns']):>10} ms")
+        if i == hot_i:
+            line += "  <-- HOT"
+        out.append(line)
+    edges = result.get("exchange_edges") or []
+    if edges:
+        out.append("exchange edges (largest gap first):")
+        for j, e in enumerate(edges):
+            line = (f"  r{e['from_rank']}:{e['from']} -> "
+                    f"r{e['to_rank']}:{e['to']}  "
+                    f"gap {_ms(e['gap_ns'])} ms"
+                    + ("  [on path]" if e.get("on_path") else ""))
+            if j == 0:
+                line += "  <-- HOT"
+            out.append(line)
+    return out
+
+
+def render_diff(findings: List[dict], threshold: float,
+                attribution_rows: List[dict] = None,
+                hot: str = None) -> List[str]:
     out = []
-    if not findings:
+    regressed = [f for f in findings
+                 if f.get("kind", "regression") != "removed"]
+    removed = [f for f in findings if f.get("kind") == "removed"]
+    if not regressed:
         out.append(f"diff: no per-stage regression beyond "
                    f"x{threshold}")
-        return out
-    out.append(f"diff: {len(findings)} stage(s) regressed beyond "
-               f"x{threshold}:")
-    for f in findings:
-        out.append(f"  {f['stage']:<16} x{f['ratio']:.2f}  "
-                   f"({f['base_mean_ms']} ms -> "
-                   f"{f['cur_mean_ms']} ms)")
+    else:
+        out.append(f"diff: {len(regressed)} stage(s) regressed "
+                   f"beyond x{threshold}:")
+        for f in regressed:
+            out.append(f"  {f['stage']:<16} x{f['ratio']:.2f}  "
+                       f"({f['base_mean_ms']} ms -> "
+                       f"{f['cur_mean_ms']} ms)")
+    for f in removed:
+        out.append(f"  {f['stage']:<16} removed  "
+                   f"(was {f['base_mean_ms']} ms x"
+                   f"{f['base_calls']} calls in baseline)")
+    if attribution_rows:
+        out.append("where the delta went (per bucket):")
+        for r in attribution_rows:
+            share = (f"  ({r['share_of_delta'] * 100:.0f}% of "
+                     f"wall delta)"
+                     if r.get("share_of_delta") is not None else "")
+            out.append(f"  {r['bucket']:<16} "
+                       f"{r['base_ms']} ms -> {r['cur_ms']} ms  "
+                       f"({r['delta_ms']:+} ms){share}")
+        if hot is not None:
+            out.append(f"  hot rank: {hot}")
     return out
 
 
@@ -256,9 +403,16 @@ def main(argv=None) -> int:
                     help="list every plan node under its stage")
     ap.add_argument("--json", action="store_true",
                     help="emit the (merged) profile as JSON")
+    ap.add_argument("--where", action="store_true",
+                    help="render the time-attribution waterfall "
+                         "(where the admission-to-result wall went)")
+    ap.add_argument("--critical-path", action="store_true",
+                    help="treat inputs as per-rank span JSONL dumps "
+                         "and solve the cross-rank critical path")
     ap.add_argument("--diff", metavar="BASELINE", default=None,
                     help="baseline profile (file or bundle dir); "
-                         "exits 1 on any per-stage regression")
+                         "exits 1 on any per-stage, whole-wall, or "
+                         "overhead-bucket regression")
     ap.add_argument("--threshold", type=float, default=1.5,
                     help="regression ratio threshold (default 1.5)")
     ap.add_argument("--min-delta-ms", type=float, default=1.0,
@@ -266,14 +420,40 @@ def main(argv=None) -> int:
                          "absolute per-call delta (default 1 ms)")
     args = ap.parse_args(argv)
 
+    if args.critical_path:
+        try:
+            spans_by_rank = load_spans(args.inputs)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"srt-explain: {e}", file=sys.stderr)
+            return 2
+        result = critical_path(spans_by_rank)
+        if args.json:
+            print(json.dumps(result, indent=2, sort_keys=True,
+                             default=str))
+        else:
+            print("\n".join(render_critical_path(result)))
+        return 0
+
     try:
         profiles = load_profiles(args.inputs)
     except (OSError, ValueError) as e:
         print(f"srt-explain: {e}", file=sys.stderr)
         return 2
     profile = merge_profiles(profiles)
+    ledger = None
+    if args.where or args.diff:
+        # recomputing from the artifact matches any embedded ledger
+        # (attribution is a pure function of the profile), and also
+        # serves profiles captured with the switch off
+        ledger = attribute_many(profiles)
 
-    if args.json:
+    if args.where:
+        if args.json:
+            print(json.dumps(ledger, indent=2, sort_keys=True,
+                             default=str))
+        else:
+            print("\n".join(render_where(ledger)))
+    elif args.json:
         print(json.dumps(profile, indent=2, sort_keys=True,
                          default=str))
     else:
@@ -281,15 +461,55 @@ def main(argv=None) -> int:
 
     if args.diff:
         try:
-            baseline = merge_profiles(load_profiles([args.diff]))
+            base_profiles = load_profiles([args.diff])
         except (OSError, ValueError) as e:
             print(f"srt-explain: --diff {e}", file=sys.stderr)
             return 2
+        baseline = merge_profiles(base_profiles)
+        min_delta_ns = int(args.min_delta_ms * 1e6)
         findings = diff_profiles(
             baseline, profile, threshold=args.threshold,
-            min_delta_ns=int(args.min_delta_ms * 1e6))
-        print("\n".join(render_diff(findings, args.threshold)))
-        return 1 if findings else 0
+            min_delta_ns=min_delta_ns)
+        base_ledger = attribute_many(base_profiles)
+        rows = diff_attribution(base_ledger, ledger,
+                                min_delta_ns=min_delta_ns)
+        # regressions with no single guilty stage are still
+        # regressions: time lost BETWEEN stages (exchange wire/wait,
+        # retries, admission) lands in the overhead buckets, and a
+        # compile-jitter swing in the wall can HIDE it — so the gate
+        # also fails when the whole wall or any overhead bucket grows
+        # past the threshold
+        findings = list(findings)
+        base_wall = int(base_ledger.get("wall_ns", 0))
+        cur_wall = int(ledger.get("wall_ns", 0))
+        if (base_wall > 0 and cur_wall >= base_wall * args.threshold
+                and cur_wall - base_wall >= min_delta_ns):
+            findings.append({
+                "kind": "wall_regression", "stage": "(wall)",
+                "ratio": round(cur_wall / base_wall, 3),
+                "base_mean_ms": round(base_wall / 1e6, 3),
+                "cur_mean_ms": round(cur_wall / 1e6, 3),
+            })
+        base_b = base_ledger.get("buckets") or {}
+        cur_b = ledger.get("buckets") or {}
+        for bucket in OVERHEAD_BUCKETS:
+            bv = int(base_b.get(bucket, 0))
+            cv = int(cur_b.get(bucket, 0))
+            if cv >= bv * args.threshold and cv - bv >= min_delta_ns:
+                findings.append({
+                    "kind": "overhead_regression",
+                    "stage": f"({bucket})",
+                    "ratio": round(cv / max(bv, 1), 3),
+                    "base_mean_ms": round(bv / 1e6, 3),
+                    "cur_mean_ms": round(cv / 1e6, 3),
+                })
+        print("\n".join(render_diff(
+            findings, args.threshold, attribution_rows=rows,
+            hot=hot_rank(ledger) if ledger.get("fleet") else None)))
+        # removed stages are informational (a plan change, not a
+        # regression) — only true regressions fail the gate
+        return 1 if any(f.get("kind", "regression") != "removed"
+                        for f in findings) else 0
     return 0
 
 

@@ -52,6 +52,7 @@ _DEATHS = "srt_fleet_deaths_total"
 _EPOCH = "srt_fleet_epoch"
 _SPECULATIONS = "srt_fleet_speculations_total"
 _RETRIES = "srt_retry_episodes_total"
+_ATTR_TIME = "srt_attribution_ns_total"
 
 
 # ------------------------------------------------------------- loading
@@ -163,6 +164,8 @@ def build_frame(fleet: FleetTimeseries, windows: int = 12) -> dict:
         if qw:
             tenant_names.update(k.split("|")[0]
                                 for k in qw["series"])
+        attr = counters.get(_ATTR_TIME) or {}
+        tenant_names.update(k.split("|")[0] for k in attr)
         tenant_names.update(slo)
         for t in tenant_names:
             row = tenants.setdefault(t, {
@@ -170,7 +173,7 @@ def build_frame(fleet: FleetTimeseries, windows: int = 12) -> dict:
                 "completed_s": 0.0, "requeued_s": 0.0,
                 "retry_s": 0.0, "recent_p50_ms": None,
                 "recent_p99_ms": None, "recent_events": 0,
-                "slo": None})
+                "slo": None, "where": {}, "where_dominant": None})
             row["queued"] += int(
                 (gauges.get(_QUEUED) or {}).get(t, 0))
             row["running"] += int(
@@ -196,6 +199,16 @@ def build_frame(fleet: FleetTimeseries, windows: int = 12) -> dict:
                     qw["buckets"], bc, 0.50) / 1e6, 3)
                 row["recent_p99_ms"] = round(histogram_quantile(
                     qw["buckets"], bc, 0.99) / 1e6, 3)
+            for key, v in attr.items():
+                parts = key.split("|")
+                if parts[0] != t or len(parts) < 2:
+                    continue
+                bucket = parts[1]
+                row["where"][bucket] = int(
+                    row["where"].get(bucket, 0) + v)
+            if row["where"]:
+                row["where_dominant"] = max(row["where"],
+                                            key=row["where"].get)
             if t in slo:
                 row["slo"] = slo[t]
     return {"epoch": merged["epoch"],
@@ -215,7 +228,7 @@ def render_frame(frame: dict) -> List[str]:
     hdr = (f"{'tenant':<12}  {'run':>3}  {'qd':>3}  {'p50_ms':>8}  "
            f"{'p99_ms':>8}  {'cmpl/s':>7}  {'rq/s':>5}  "
            f"{'dev_MB':>7}  {'burn_f':>6}  {'burn_s':>6}  "
-           f"{'attain':>6}")
+           f"{'attain':>6}  {'where':<15}")
     out.append(hdr)
     out.append("-" * len(hdr))
     if not tenants:
@@ -234,7 +247,8 @@ def render_frame(frame: dict) -> List[str]:
             f"{r['device_bytes'] / 1e6:>7.1f}  "
             f"{_n(slo.get('burn_fast'), '{:.2f}'):>6}  "
             f"{_n(slo.get('burn_slow'), '{:.2f}'):>6}  "
-            f"{_n(slo.get('attainment'), '{:.4f}'):>6}")
+            f"{_n(slo.get('attainment'), '{:.4f}'):>6}  "
+            f"{(r.get('where_dominant') or '-')[:15]:<15}")
     out.append("")
     out.append("fleet ranks")
     hdr = (f"{'rank':>4}  {'epoch':>5}  {'windows':>7}  "

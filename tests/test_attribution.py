@@ -1,0 +1,341 @@
+"""Time attribution + critical path (ISSUE 17): ledger conservation
+on clean and overcounting profiles, the compute carve for
+OOM-blocked/retry-lost nanoseconds, fleet rollup semantics, per-bucket
+diff rows, and the cross-rank critical-path solver — including the
+headline skew property: a ±5 s clock skew between ranks must yield
+the SAME critical path with ZERO negative (clamped) edges."""
+
+import copy
+import io
+import contextlib
+import json
+
+from spark_rapids_tpu.observability.attribution import (
+    BUCKETS, attribute_many, attribute_profile, diff_attribution,
+    hot_rank)
+from spark_rapids_tpu.observability.critical_path import (
+    critical_path, normalize_clocks)
+
+
+# --------------------------------------------------------------- helpers
+
+
+def synth_profile(*, queue_wait=0, fused=0, unfused=0, compile_ns=0,
+                  wire=0, wait=0, spec=0, blocked=0, lost=0,
+                  rank=0, extra_wall=0):
+    """A minimal profile artifact with one fused + one unfused stage.
+    ``extra_wall`` widens the wall beyond the stage sum (the honest
+    'other' residual)."""
+    stages = []
+    if fused:
+        stages.append({"stage": "s_fused", "engine": "fused",
+                       "wall_ns": fused, "compile_ns": compile_ns,
+                       "calls": 1})
+    if unfused:
+        stages.append({"stage": "s_unfused", "engine": "unfused",
+                       "wall_ns": unfused, "compile_ns": 0,
+                       "calls": 1})
+    return {
+        "query_id": f"q-{rank}", "query": "q5", "tenant": "acme",
+        "rank": rank, "world": 2,
+        "wall_ns": fused + unfused + wire + wait + spec + extra_wall,
+        "queue_wait_ns": queue_wait,
+        "stages": stages,
+        "shuffle": {"wire_ns": wire, "wait_ns": wait,
+                    "spec_wait_ns": spec},
+        "oom": {"blocked_ns": blocked},
+        "retries": {"lost_ns": lost},
+    }
+
+
+# ---------------------------------------------------------------- ledger
+
+
+class TestLedger:
+
+    def test_every_bucket_always_present(self):
+        led = attribute_profile(synth_profile(fused=100))
+        assert set(led["buckets"]) == set(BUCKETS)
+
+    def test_clean_profile_conserves_exactly(self):
+        led = attribute_profile(synth_profile(
+            queue_wait=50, fused=100, unfused=40, compile_ns=30,
+            wire=20, wait=10, spec=5, extra_wall=15))
+        b = led["buckets"]
+        assert led["wall_ns"] == 50 + 100 + 40 + 20 + 10 + 5 + 15
+        assert sum(b.values()) == led["wall_ns"]
+        assert led["conserved"] and led["overcount_ns"] == 0
+        assert b["queue_wait"] == 50
+        assert b["compile"] == 30
+        assert b["compute_fused"] == 70       # 100 - compile 30
+        assert b["compute_unfused"] == 40
+        assert b["other"] == 15
+
+    def test_blocked_and_lost_carved_from_compute(self):
+        led = attribute_profile(synth_profile(
+            fused=100, unfused=100, blocked=40, lost=20))
+        b = led["buckets"]
+        assert b["oom_blocked"] == 40 and b["retry_lost"] == 20
+        # the 60 carved ns left compute; the sum still conserves
+        assert b["compute_fused"] + b["compute_unfused"] == 140
+        assert sum(b.values()) == led["wall_ns"]
+        assert led["conserved"]
+
+    def test_overcount_breaks_conservation(self):
+        # shuffle segments claim 4x the wall: an impossible ledger
+        # must say so, not hide the excess in a clamped bucket
+        p = synth_profile(fused=100, wire=400)
+        p["wall_ns"] = 120
+        led = attribute_profile(p)
+        assert not led["conserved"]
+        assert led["overcount_ns"] >= 380
+        assert led["buckets"]["other"] == 0
+
+    def test_tolerance_forgives_seam_jitter(self):
+        p = synth_profile(fused=1000)
+        p["wall_ns"] = 990                     # 1% seam overcount
+        led = attribute_profile(p, tolerance=0.05)
+        assert led["conserved"] and led["overcount_ns"] == 10
+
+    def test_dominant_vs_dominant_overhead(self):
+        led = attribute_profile(synth_profile(
+            fused=1000, wait=300, wire=100))
+        assert led["dominant"] == "compute_fused"
+        assert led["dominant_overhead"] == "shuffle_wait"
+
+    def test_fleet_rollup_and_hot_rank(self):
+        p0 = synth_profile(fused=100, wait=10, rank=0)
+        p1 = synth_profile(fused=100, wait=500, rank=1)
+        led = attribute_many([p0, p1])
+        assert led["fleet"]
+        assert set(led["per_rank"]) == {"0", "1"}
+        assert led["conserved"]
+        assert led["buckets"]["shuffle_wait"] == 510
+        assert hot_rank(led, "shuffle_wait") == "1"
+
+    def test_rank_collision_reindexed(self):
+        led = attribute_many([synth_profile(fused=10, rank=0),
+                              synth_profile(fused=10, rank=0)])
+        assert set(led["per_rank"]) == {"0", "1"}
+
+    def test_diff_attribution_names_the_bucket(self):
+        base = attribute_profile(synth_profile(fused=100_000_000))
+        cur = attribute_profile(synth_profile(
+            fused=100_000_000, wait=80_000_000))
+        rows = diff_attribution(base, cur)
+        assert rows and rows[0]["bucket"] == "shuffle_wait"
+        assert rows[0]["delta_ms"] == 80.0
+        assert rows[0]["share_of_delta"] == 1.0
+
+    def test_diff_min_delta_floor(self):
+        base = attribute_profile(synth_profile(fused=100_000_000))
+        cur = attribute_profile(synth_profile(
+            fused=100_000_000, wait=500))
+        assert diff_attribution(base, cur) == []
+
+
+# --------------------------------------------------------- critical path
+
+
+def span(rank, name, kind, t_us, dur_us, *, span_id=None,
+         thread=1, links=()):
+    return {"kind": "span", "rank": rank, "name": name,
+            "span_kind": kind, "span_id": span_id,
+            "thread": thread, "t_ns": t_us * 1000,
+            "dur_ns": dur_us * 1000,
+            "links": [{"span_id": s} for s in links]}
+
+
+def two_rank_trace(skew_ns=0):
+    """A symmetric 2-rank exchange: each rank computes, writes for the
+    peer, then merges the peer's frame (the merge links the peer's
+    write — both directions, so the midpoint rule applies).  Rank 1's
+    clock is shifted by ``skew_ns``."""
+    r0 = [
+        span(0, "q", "query", 0, 500, span_id="q0", thread=1),
+        span(0, "scan0", "op", 0, 100, span_id="a0", thread=1),
+        span(0, "write0", "shuffle_write", 100, 50,
+             span_id="w0", thread=1),
+        span(0, "merge0", "shuffle_merge", 200, 40, span_id="m0",
+             thread=1, links=("w1",)),
+        span(0, "finish0", "op", 240, 60, span_id="f0", thread=1),
+    ]
+    r1 = [
+        span(1, "scan1", "op", 0, 120, span_id="a1", thread=1),
+        span(1, "write1", "shuffle_write", 120, 60,
+             span_id="w1", thread=1),
+        span(1, "merge1", "shuffle_merge", 210, 30, span_id="m1",
+             thread=1, links=("w0",)),
+        span(1, "finish1", "op", 240, 20, span_id="f1", thread=1),
+    ]
+    for s in r1:
+        s["t_ns"] += skew_ns
+    return {0: r0, 1: r1}
+
+
+class TestCriticalPath:
+
+    def test_containers_dropped_leaves_chain(self):
+        result = critical_path(two_rank_trace())
+        names = [seg["name"] for seg in result["path"]]
+        assert "q" not in names                # query span is a container
+        assert result["clamped_edges"] == 0
+        assert result["total_ns"] > 0
+
+    def test_exchange_edges_ranked_and_flagged(self):
+        result = critical_path(two_rank_trace())
+        edges = result["exchange_edges"]
+        assert len(edges) == 2
+        assert edges[0]["gap_ns"] >= edges[1]["gap_ns"]
+        assert all(e["kind"] == "exchange_edge" for e in edges)
+
+    def test_skew_invariance_pm_5s(self):
+        """The headline property: ±5 s of clock skew between ranks
+        must not change the path and must fabricate zero negative
+        edges."""
+        base = critical_path(two_rank_trace())
+        base_names = [(s["rank"], s["name"]) for s in base["path"]]
+        for skew in (5_000_000_000, -5_000_000_000):
+            skewed = critical_path(two_rank_trace(skew_ns=skew))
+            assert [(s["rank"], s["name"])
+                    for s in skewed["path"]] == base_names
+            assert skewed["clamped_edges"] == 0
+            assert skewed["total_ns"] == base["total_ns"]
+            # the offset table absorbed (most of) the injected skew
+            off = skewed["clock_offsets"]
+            assert abs(int(off["1"]) - int(off["0"]) + skew) \
+                <= abs(skew) // 1000
+
+    def test_normalize_clocks_midpoint_cancels(self):
+        trace = two_rank_trace(skew_ns=5_000_000_000)
+        rows = {r: [s for s in recs if s["kind"] == "span"]
+                for r, recs in trace.items()}
+        from spark_rapids_tpu.observability.critical_path import (
+            _link_edges, _span_rows)
+        spans = []
+        for r, recs in rows.items():
+            spans.extend(_span_rows(recs, r))
+        offsets = normalize_clocks(
+            {r: _span_rows(recs, r) for r, recs in trace.items()},
+            _link_edges(spans))
+        assert offsets[0] == 0
+        assert abs(offsets[1] + 5_000_000_000) <= 5_000_000
+
+    def test_slow_link_edge_ranks_first(self):
+        trace = two_rank_trace()
+        # rank 0's merge of rank 1's frame starts 300 us late: the
+        # w1 -> m0 exchange edge must lead the leaderboard
+        for s in trace[0]:
+            if s["name"] in ("merge0", "finish0"):
+                s["t_ns"] += 300_000
+        result = critical_path(trace)
+        top = result["exchange_edges"][0]
+        assert (top["from"], top["to"]) == ("write1", "merge0")
+
+    def test_empty_and_garbage_tolerated(self):
+        assert critical_path({})["path"] == []
+        result = critical_path(
+            {0: [{"kind": "span", "t_ns": "bogus"},
+                 {"kind": "journal_other"}]})
+        assert result["path"] == []
+
+
+# ------------------------------------------------------------------ CLI
+
+
+class TestSrtExplainSurfaces:
+
+    def _write_profiles(self, tmp_path, profiles, stem="p"):
+        paths = []
+        for i, p in enumerate(profiles):
+            fp = tmp_path / f"{stem}{i}.json"
+            fp.write_text(json.dumps(p))
+            paths.append(str(fp))
+        return paths
+
+    def _full_profile(self, **kw):
+        p = synth_profile(**kw)
+        p.setdefault("hot_stage", "s_fused")
+        p["ops"] = {}
+        p["shuffle_links"] = {"bytes": {}}
+        return p
+
+    def test_where_renders_waterfall(self, tmp_path, capsys):
+        from spark_rapids_tpu.tools import srt_explain
+        paths = self._write_profiles(tmp_path, [self._full_profile(
+            queue_wait=50_000_000, fused=100_000_000,
+            wait=20_000_000)])
+        assert srt_explain.main(paths + ["--where"]) == 0
+        out = capsys.readouterr().out
+        assert "where did the time go" in out
+        assert "queue_wait" in out and "<-- dominant" in out
+        assert "conservation: OK" in out
+
+    def test_where_json_is_the_ledger(self, tmp_path, capsys):
+        from spark_rapids_tpu.tools import srt_explain
+        paths = self._write_profiles(
+            tmp_path, [self._full_profile(fused=100_000_000)])
+        assert srt_explain.main(paths + ["--where", "--json"]) == 0
+        led = json.loads(capsys.readouterr().out)
+        assert led["conserved"] is True
+        assert led["buckets"]["compute_fused"] == 100_000_000
+
+    def test_diff_removed_stage_informational_rc0(self, tmp_path,
+                                                  capsys):
+        from spark_rapids_tpu.tools import srt_explain
+        base = self._full_profile(fused=100_000_000,
+                                  unfused=50_000_000)
+        cur = self._full_profile(fused=100_000_000)
+        [bp] = self._write_profiles(tmp_path, [base], stem="base")
+        [cp] = self._write_profiles(tmp_path, [cur], stem="cur")
+        rc = srt_explain.main([cp, "--diff", bp])
+        out = capsys.readouterr().out
+        assert rc == 0                      # removed != regressed
+        assert "removed" in out and "s_unfused" in out
+
+    def test_diff_regression_attributed_to_bucket(self, tmp_path,
+                                                  capsys):
+        from spark_rapids_tpu.tools import srt_explain
+        base = self._full_profile(fused=100_000_000)
+        cur = self._full_profile(fused=100_000_000,
+                                 wait=400_000_000)
+        cur["stages"][0]["wall_ns"] = 400_000_000
+        [bp] = self._write_profiles(tmp_path, [base], stem="base")
+        [cp] = self._write_profiles(tmp_path, [cur], stem="cur")
+        rc = srt_explain.main([cp, "--diff", bp])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "shuffle_wait" in out
+
+    def test_critical_path_cli(self, tmp_path, capsys):
+        from spark_rapids_tpu.tools import srt_explain
+        trace = two_rank_trace()
+        paths = []
+        for r, recs in trace.items():
+            fp = tmp_path / f"spans_rank{r}.jsonl"
+            fp.write_text("\n".join(json.dumps(s) for s in recs))
+            paths.append(str(fp))
+        assert srt_explain.main(paths + ["--critical-path"]) == 0
+        out = capsys.readouterr().out
+        assert "critical path:" in out and "<-- HOT" in out
+        assert "exchange edges" in out
+
+    def test_critical_path_json_deterministic(self, tmp_path,
+                                              capsys):
+        from spark_rapids_tpu.tools import srt_explain
+        trace = two_rank_trace()
+        paths = []
+        for r, recs in trace.items():
+            fp = tmp_path / f"spans_rank{r}.jsonl"
+            fp.write_text("\n".join(json.dumps(s) for s in recs))
+            paths.append(str(fp))
+        outs = []
+        for _ in range(2):
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                assert srt_explain.main(
+                    paths + ["--critical-path", "--json"]) == 0
+            outs.append(buf.getvalue())
+        assert outs[0] == outs[1]
+        parsed = json.loads(outs[0])
+        assert parsed["clamped_edges"] == 0
