@@ -9,6 +9,7 @@ PY ?= python
         perf-smoke fusion-smoke doctor-smoke server-smoke \
         lifeguard-smoke ingest-smoke dist-smoke analysis-smoke \
         profile-smoke elastic-smoke slo-smoke attribution-smoke \
+        spill-smoke \
         serve-bench \
         nightly-artifacts ci ci-nightly clean
 
@@ -194,6 +195,15 @@ slo-smoke:
 attribution-smoke:
 	$(PY) scripts/attribution_smoke.py
 
+# tiered spill store gate: a 4x-over-budget join must complete
+# out-of-core BYTE-identical to the in-memory answer, a chaos
+# OOM must be rescued by ensure_headroom (spill, not shed), a corrupt
+# spill file must recompute from source, srt-explain --where must
+# render a nonzero spill_wait bucket, the doctor must name the
+# spilling task + tier, and the disabled path must stay <1us/call
+spill-smoke:
+	$(PY) scripts/spill_smoke.py
+
 # zipf-skewed multi-tenant serving replay -> BENCH_serve_r01.json
 # (per-tenant p50/p99 admission-to-result, throughput, SLO attainment)
 serve-bench:
@@ -222,7 +232,7 @@ dryrun:
 ci: test fuzz native sanitizers tpu-lower jni-test dryrun metrics-smoke \
     trace-smoke chaos-smoke perf-smoke fusion-smoke doctor-smoke \
     server-smoke lifeguard-smoke ingest-smoke dist-smoke analysis-smoke \
-    profile-smoke elastic-smoke slo-smoke attribution-smoke
+    profile-smoke elastic-smoke slo-smoke attribution-smoke spill-smoke
 	$(PY) bench.py
 	@echo "ci: all gates green"
 

@@ -153,6 +153,15 @@ METRICS: Dict[str, Tuple[str, str]] = {
         "counter", "attributed wall ns per tenant and bucket"),
     "srt_attribution_queries_total": (
         "counter", "attribution ledgers built by conservation verdict"),
+    # -- ISSUE 18: tiered spill store & out-of-core operators --
+    "srt_spill_bytes_total": (
+        "counter", "device bytes spilled down-tier by stage and tier"),
+    "srt_spill_restores_total": (
+        "counter", "spilled batches streamed back by stage and tier"),
+    "srt_spill_ns_total": (
+        "counter", "spill-store wall ns by stage and direction"),
+    "srt_spill_corrupt_total": (
+        "counter", "corrupt spill payloads on read-back by outcome"),
 }
 
 # ----------------------------------------------------------------- knobs
@@ -309,6 +318,15 @@ KNOBS: Dict[str, str] = {
         "=1 builds a time-attribution ledger per profiled query",
     "SPARK_RAPIDS_TPU_ATTRIBUTION_TOLERANCE":
         "overcount fraction of wall before conservation is broken",
+    # -- ISSUE 18: tiered spill store & out-of-core operators --
+    "SPARK_RAPIDS_TPU_DEVICE_BUDGET_BYTES":
+        "build-side device budget past which join/agg run out-of-core "
+        "(unset=unlimited, the disabled path)",
+    "SPARK_RAPIDS_TPU_SPILL_DIR": "disk-tier kudo spill directory",
+    "SPARK_RAPIDS_TPU_SPILL_HOST_LIMIT_BYTES":
+        "host-tier byte budget before spills demote to disk",
+    "SPARK_RAPIDS_TPU_SPILL_PARTITIONS":
+        "out-of-core hash partition count override (power of two)",
 }
 
 # env families read with a COMPUTED suffix (pinned_path's

@@ -187,6 +187,10 @@ class SparkResourceAdaptor:
         # ends (SparkResourceAdaptorJni.cpp:66-80); set this to the
         # registry's remove_thread to mirror that shape
         self.on_thread_removed = None
+        # spill hook (memory/spill.py SpillStore): ensure_headroom(n)
+        # frees device bytes synchronously; spillable_bytes() is the
+        # cheap probe the deadlock breaker consults before BUFN
+        self._spill_hook = None
         self._log("time,op,current thread,op thread,op task,from state,"
                   "to state,notes", raw=True)
 
@@ -573,6 +577,33 @@ class SparkResourceAdaptor:
             if t is not None:
                 t.pool_blocked = False
 
+    def set_spill_hook(self, hook):
+        """Install (or clear, with None) the spill store hook.  The
+        hook must expose ``ensure_headroom(nbytes) -> freed_bytes``
+        (synchronous, may call back into allocate/deallocate — so it
+        is ALWAYS invoked outside the adaptor lock) and
+        ``spillable_bytes() -> int`` (lock-cheap probe, safe under the
+        adaptor lock)."""
+        with self._lock:
+            self._spill_hook = hook
+
+    def _spill_for_headroom(self, num_bytes: int) -> int:
+        """Run the spill hook for a failed allocation.  Called WITHOUT
+        the adaptor lock: the store calls deallocate() per victim,
+        which needs the lock to wake blocked threads.  The release
+        side runs inside spill_range_start/done (the store brackets
+        it), so the recursive-allocation path recognizes the work as
+        spill-side and keeps task footprints honest."""
+        hook = self._spill_hook
+        if hook is None:
+            return 0
+        try:
+            return int(hook.ensure_headroom(num_bytes))
+        except Exception:
+            # a broken spill hook must never turn an OOM into a crash;
+            # the state machine's BUFN/split ladder still applies
+            return 0
+
     def spill_range_start(self):
         with self._lock:
             t = self._threads.get(threading.get_ident())
@@ -765,9 +796,20 @@ class SparkResourceAdaptor:
                 if to_bufn is None or t.priority() < to_bufn.priority():
                     to_bufn = t
         if to_bufn is not None:
-            if blocked_count == 1:
+            spillable = 0
+            if self._spill_hook is not None:
+                try:
+                    spillable = int(self._spill_hook.spillable_bytes())
+                except Exception:
+                    spillable = 0
+            if blocked_count == 1 or spillable > 0:
                 # last blocked thread: retry the alloc once before BUFN —
-                # spillable data may have been freed already (:1962)
+                # spillable data may have been freed already (:1962).
+                # Same wake when the spill store still holds device
+                # bytes: the woken thread's alloc-failure path runs
+                # ensure_headroom synchronously (outside the lock)
+                # BEFORE any BUFN/retry-split escalation, so registered
+                # batches spill instead of the query rolling back.
                 to_bufn.is_retry_alloc_before_bufn = True
                 self._transition(to_bufn, THREAD_RUNNING)
             else:
@@ -986,6 +1028,20 @@ class SparkResourceAdaptor:
                 record_alloc("alloc", num_bytes)
                 return num_bytes
             except AllocationFailed:
+                # synchronous spill BEFORE escalation: free registered
+                # spillable batches and retry cleanly (no BLOCKED/BUFN
+                # transition) while the store still has device bytes.
+                # Runs outside the lock — the store deallocates per
+                # victim, bracketed by spill_range_start/done.
+                freed = self._spill_for_headroom(num_bytes)
+                if freed > 0:
+                    with self._lock:
+                        t = self._threads.get(tid)
+                        if t is not None:
+                            t.is_retry_alloc_before_bufn = False
+                        self._post_alloc_failed_core(
+                            tid, False, True, False, likely_spill)
+                    continue
                 with self._lock:
                     retry = self._post_alloc_failed_core(
                         tid, False, True, True, likely_spill)

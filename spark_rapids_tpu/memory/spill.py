@@ -1,0 +1,648 @@
+"""Tiered spill store (ISSUE 18 tentpole): run THROUGH memory
+pressure instead of around it.
+
+The OOM state machine (memory/spark_resource_adaptor.py) can today
+only roll a blocked thread back (BUFN -> GpuRetryOOM) or make it
+split its input toward a one-element floor.  The reference's L3b
+design pairs that machinery with a spill framework: device buffers
+registered as SPILLABLE move down a tier ladder under pressure and
+stream back on demand, so an over-memory join completes out-of-core
+instead of shedding.  This module is that framework:
+
+  device tier   the registered column batch, resident; its bytes are
+                reserved through the installed SparkResourceAdaptor
+  host tier     the batch serialized as ONE kudo table (KTRX trace
+                context + a FORCED KCRC trailer — spilled bytes are
+                corruption-checked and trace-carrying on read-back)
+                held in host memory, device reservation released
+  disk tier     the same kudo bytes in a file under
+                ``SPARK_RAPIDS_TPU_SPILL_DIR``, demoted when host
+                bytes exceed ``SPARK_RAPIDS_TPU_SPILL_HOST_LIMIT_BYTES``
+
+Victim selection is driven by the PR-5 memory ledger: candidates are
+ranked (lowest task priority first, largest resident-task bytes
+first, largest handle first) — the same ordering the adaptor's
+deadlock breaker uses to pick who rolls back, so the store spills
+exactly the data whose owner would otherwise be BUFN'd.
+
+``ensure_headroom(bytes)`` is the synchronous hook the state machine
+calls BEFORE escalating a blocked thread to BUFN/retry-split (see
+SparkResourceAdaptor.allocate / _check_and_update_for_bufn).  All
+device-side releases/re-acquisitions run inside
+``spill_range_start/done`` so the adaptor's existing recursive-
+allocation path recognizes them as spill-side work and keeps task
+footprints honest.
+
+A corrupt spill file (CRC mismatch on read-back) surfaces *file path
++ spill generation* in :class:`KudoCorruptException` and — when the
+handle registered a ``recompute`` callback — triggers recompute-from-
+source instead of query failure, counted ``srt_spill_corrupt_total``.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import tempfile
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from spark_rapids_tpu import observability as _obs
+
+TIER_DEVICE = "device"
+TIER_HOST = "host"
+TIER_DISK = "disk"
+TIER_FREED = "freed"
+
+_MAX_PRIORITY = 2**63 - 1
+
+
+def task_priority(task_id: Optional[int]) -> int:
+    """The adaptor's thread-priority formula (larger = higher
+    priority = spilled LAST): pool/shuffle data (no task) outranks
+    every task; among tasks, lower task ids are older and keep their
+    memory longer."""
+    if task_id is None:
+        return _MAX_PRIORITY
+    return _MAX_PRIORITY - (int(task_id) + 1)
+
+
+def _env_int(name: str) -> Optional[int]:
+    v = os.environ.get(name, "")
+    if not v:
+        return None
+    try:
+        return int(v)
+    except ValueError:
+        return None
+
+
+# the disabled path (no budget configured) sits on every out-of-core
+# entry and is gated <1us by scripts/spill_smoke.py: on CPython/posix
+# read the env through its raw backing dict (~0.07us vs ~1us for
+# os.environ.get's per-call key encode) — it IS os.environ's store,
+# so putenv/delenv stay visible — and cache the int parse on the raw
+# value
+_BUDGET_KEY = b"SPARK_RAPIDS_TPU_DEVICE_BUDGET_BYTES"
+_ENV_DATA = getattr(os.environ, "_data", None) if os.name == "posix" \
+    else None
+_budget_parse: tuple = (None, None)       # (raw bytes, parsed int)
+
+
+def device_budget_bytes() -> Optional[int]:
+    """``SPARK_RAPIDS_TPU_DEVICE_BUDGET_BYTES`` — the build-side
+    budget past which ops/out_of_core partitions and spills (None =
+    unlimited, the disabled path).  Dynamic read, one dict hit."""
+    global _budget_parse
+    if _ENV_DATA is not None:
+        raw = _ENV_DATA.get(_BUDGET_KEY)
+    else:
+        s = os.environ.get("SPARK_RAPIDS_TPU_DEVICE_BUDGET_BYTES")
+        raw = s.encode() if s is not None else None
+    if not raw:
+        return None
+    cached_raw, parsed = _budget_parse
+    if raw != cached_raw:
+        try:
+            parsed = int(raw)
+        except ValueError:
+            parsed = None
+        _budget_parse = (raw, parsed)
+    return parsed
+
+
+def columns_nbytes(columns: Sequence) -> int:
+    """Conservative byte estimate for a column batch (data + validity
+    + offsets + children), used as the handle's device reservation
+    size when the caller doesn't pass one."""
+    import numpy as np
+    total = 0
+    for c in columns:
+        for buf in (getattr(c, "data", None), getattr(c, "validity", None),
+                    getattr(c, "offsets", None)):
+            if buf is not None:
+                total += int(np.asarray(buf).nbytes)
+        total += columns_nbytes(getattr(c, "children", ()))
+    return total
+
+
+class SpillHandle:
+    """One registered spillable column batch.  State transitions are
+    owned by the store; callers hold the handle and use :meth:`get`
+    (restore-on-demand) and :meth:`close`."""
+
+    __slots__ = ("store", "handle_id", "name", "task_id", "stage",
+                 "device_bytes", "columns", "fields", "payload", "path",
+                 "tier", "generation", "closed", "busy", "recompute",
+                 "_priority", "spill_seq")
+
+    def __init__(self, store: "SpillStore", handle_id: int, name: str,
+                 columns, device_bytes: int, task_id: Optional[int],
+                 stage: str, priority: Optional[int],
+                 recompute: Optional[Callable[[], Sequence]]):
+        self.store = store
+        self.handle_id = handle_id
+        self.name = name
+        self.task_id = task_id
+        self.stage = stage
+        self.device_bytes = int(device_bytes)
+        self.columns = list(columns)
+        self.fields = None          # captured at first spill
+        self.payload: Optional[bytes] = None
+        self.path: Optional[str] = None
+        self.tier = TIER_DEVICE
+        self.generation = 0         # bumps on every device->host spill
+        self.closed = False
+        self.busy = False           # a restore is in flight
+        self.recompute = recompute
+        self._priority = priority
+        self.spill_seq = 0          # FIFO order for host->disk demotion
+
+    @property
+    def priority(self) -> int:
+        return (self._priority if self._priority is not None
+                else task_priority(self.task_id))
+
+    def get(self):
+        """The batch's columns, restoring from host/disk when spilled.
+        Synchronous; the restore-side device reservation runs inside a
+        spill range so the OOM machinery sees it as spill-path work."""
+        return self.store._materialize(self)
+
+    def spill(self) -> int:
+        """Force this handle down one tier (device->host, host->disk);
+        returns device bytes freed (0 if it wasn't resident)."""
+        return self.store._spill_handle(self)
+
+    def close(self) -> None:
+        self.store._close_handle(self)
+
+
+class SpillStore:
+    """Registry of spillable handles + the tier ladder + the
+    ``ensure_headroom`` hook.  Thread-safe; the only blocking call
+    (restore's device re-acquisition) runs OUTSIDE the store lock so
+    a blocked restore can never wedge a concurrent spill."""
+
+    def __init__(self, *, spill_dir: Optional[str] = None,
+                 host_limit_bytes: Optional[int] = None):
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        self._handles: Dict[int, SpillHandle] = {}
+        self._next_id = 1
+        self._spill_seq = 1
+        self._dir = spill_dir
+        self._host_limit = host_limit_bytes
+        self._host_bytes = 0
+        self._disk_bytes = 0
+        self.spill_count = {TIER_HOST: 0, TIER_DISK: 0}
+        self.restore_count = 0
+        self.corrupt_count = 0
+        self.recompute_count = 0
+
+    # ------------------------------------------------------------- config
+
+    def spill_dir(self) -> str:
+        d = self._dir or os.environ.get("SPARK_RAPIDS_TPU_SPILL_DIR", "")
+        if not d:
+            d = os.path.join(tempfile.gettempdir(),
+                             f"srt_spill_{os.getpid()}")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def host_limit_bytes(self) -> Optional[int]:
+        if self._host_limit is not None:
+            return self._host_limit
+        return _env_int("SPARK_RAPIDS_TPU_SPILL_HOST_LIMIT_BYTES")
+
+    # ----------------------------------------------------------- registry
+
+    def register(self, columns, *, device_bytes: Optional[int] = None,
+                 name: str = "", task_id: Optional[int] = None,
+                 stage: str = "", priority: Optional[int] = None,
+                 recompute: Optional[Callable[[], Sequence]] = None
+                 ) -> SpillHandle:
+        """Register a resident device column batch as spillable.  The
+        caller already holds the device reservation; the store releases
+        it on spill and re-acquires it on restore (both through the
+        installed adaptor, inside a spill range)."""
+        nbytes = (int(device_bytes) if device_bytes is not None
+                  else columns_nbytes(columns))
+        with self._lock:
+            hid = self._next_id
+            self._next_id += 1
+            h = SpillHandle(self, hid, name or f"spill-{hid}", columns,
+                            nbytes, task_id, stage, priority, recompute)
+            self._handles[hid] = h
+            return h
+
+    def _close_handle(self, h: SpillHandle) -> None:
+        with self._lock:
+            if h.closed:
+                return
+            h.closed = True
+            self._handles.pop(h.handle_id, None)
+            if h.busy:
+                # an in-flight restore/demotion owns the payload and
+                # file right now; it observes ``closed`` at commit and
+                # performs this cleanup itself (nothing leaks, and the
+                # racing reader still gets its columns)
+                return
+            h.columns = None
+            if h.payload is not None:
+                self._host_bytes -= len(h.payload)
+                h.payload = None
+            path, h.path = h.path, None
+            h.tier = TIER_FREED
+        if path:
+            try:
+                self._disk_bytes -= os.path.getsize(path)
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        """Drop every handle and its spill files."""
+        with self._lock:
+            handles = list(self._handles.values())
+        for h in handles:
+            self._close_handle(h)
+
+    # -------------------------------------------------------- adaptor glue
+
+    def _adaptor(self):
+        from spark_rapids_tpu.memory import rmm_spark
+        return rmm_spark.installed_adaptor()
+
+    def _release_device(self, nbytes: int) -> None:
+        ad = self._adaptor()
+        if ad is None or nbytes <= 0:
+            return
+        ad.spill_range_start()
+        try:
+            ad.deallocate(nbytes)
+        finally:
+            ad.spill_range_done()
+
+    def _acquire_device(self, nbytes: int) -> None:
+        ad = self._adaptor()
+        if ad is None or nbytes <= 0:
+            return
+        ad.spill_range_start()
+        try:
+            ad.allocate(nbytes)
+        finally:
+            ad.spill_range_done()
+
+    # ------------------------------------------------------------ spilling
+
+    def spillable_bytes(self) -> int:
+        """Device bytes the store could free right now — the OOM state
+        machine's pre-BUFN probe."""
+        with self._lock:
+            return sum(h.device_bytes for h in self._handles.values()
+                       if h.tier == TIER_DEVICE and not h.busy)
+
+    def _victims(self) -> List[SpillHandle]:
+        """Device-tier handles in spill order: lowest task priority
+        first, then largest resident-task bytes (the PR-5 ledger),
+        then largest handle."""
+        resident: Dict[Optional[int], int] = {}
+        ad = self._adaptor()
+        if ad is not None:
+            try:
+                for tid, row in (ad.memory_ledger(timeline=0)
+                                 .get("tasks") or {}).items():
+                    resident[int(tid)] = int(row.get("active_bytes", 0))
+            except Exception:
+                resident = {}
+        with self._lock:
+            cands = [h for h in self._handles.values()
+                     if h.tier == TIER_DEVICE and not h.busy]
+        cands.sort(key=lambda h: (h.priority,
+                                  -resident.get(h.task_id, 0),
+                                  -h.device_bytes, h.handle_id))
+        return cands
+
+    def ensure_headroom(self, nbytes: int) -> int:
+        """Synchronously spill victims until ``nbytes`` of device
+        memory have been freed (or nothing spillable remains);
+        returns the bytes actually freed.  Called by the adaptor's
+        alloc-failure path BEFORE a blocked thread escalates to
+        BUFN/retry-split, and by the server's shed path as a last
+        try before demoting a job."""
+        t0 = time.monotonic_ns()
+        freed = 0
+        for h in self._victims():
+            if freed >= nbytes:
+                break
+            freed += self._spill_handle(h)
+        if freed > 0:
+            _obs.record_spill_wait(time.monotonic_ns() - t0,
+                                   stage="ensure_headroom")
+        return freed
+
+    def _serialize(self, h: SpillHandle) -> bytes:
+        from spark_rapids_tpu.columns.table import Table
+        from spark_rapids_tpu.shuffle import kudo
+        from spark_rapids_tpu.shuffle.schema import schema_of_table
+        cols = list(h.columns)
+        if h.fields is None:
+            h.fields = schema_of_table(Table(cols))
+        buf = io.BytesIO()
+        rows = int(cols[0].length) if cols else 0
+        # CRC forced ON per table: spilled bytes are always
+        # corruption-checked on read-back, whatever the wire default
+        kudo.write_to_stream(cols, buf, 0, rows, crc=True)
+        return buf.getvalue()
+
+    def _spill_handle(self, h: SpillHandle) -> int:
+        """device->host (and maybe host->disk under the host budget).
+        Returns device bytes freed."""
+        t0 = time.monotonic_ns()
+        with self._lock:
+            if h.closed or h.busy or h.tier != TIER_DEVICE:
+                return 0
+            payload = self._serialize(h)
+            h.payload = payload
+            h.columns = None
+            h.tier = TIER_HOST
+            h.generation += 1
+            h.spill_seq = self._spill_seq
+            self._spill_seq += 1
+            self._host_bytes += len(payload)
+            self.spill_count[TIER_HOST] += 1
+        # release OUTSIDE the lock: deallocation wakes blocked threads
+        self._release_device(h.device_bytes)
+        _obs.record_spill(stage=h.stage, tier=TIER_HOST,
+                          nbytes=h.device_bytes,
+                          ns=time.monotonic_ns() - t0, task=h.task_id,
+                          name=h.name, generation=h.generation)
+        self._enforce_host_limit()
+        return h.device_bytes
+
+    def _enforce_host_limit(self) -> None:
+        limit = self.host_limit_bytes()
+        if limit is None:
+            return
+        while True:
+            with self._lock:
+                if self._host_bytes <= limit:
+                    return
+                hosted = [h for h in self._handles.values()
+                          if h.tier == TIER_HOST and not h.busy]
+                if not hosted:
+                    return
+                h = min(hosted, key=lambda x: x.spill_seq)  # oldest
+            self._demote_to_disk(h)
+
+    def _demote_to_disk(self, h: SpillHandle) -> None:
+        t0 = time.monotonic_ns()
+        with self._lock:
+            if h.closed or h.busy or h.tier != TIER_HOST:
+                return
+            payload = h.payload
+            path = os.path.join(
+                self.spill_dir(),
+                f"{h.name}.g{h.generation}.kudo")
+            h.busy = True
+        try:
+            with open(path, "wb") as f:
+                f.write(payload)
+        except OSError:
+            with self._cv:
+                h.busy = False
+                self._cv.notify_all()
+            return
+        with self._cv:
+            h.busy = False
+            self._cv.notify_all()
+            if h.closed:
+                # closed while the file write was in flight: finish
+                # the deferred cleanup close() left to us
+                if h.payload is not None:
+                    self._host_bytes -= len(h.payload)
+                    h.payload = None
+                h.columns = None
+                h.tier = TIER_FREED
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                return
+            self._host_bytes -= len(payload)
+            self._disk_bytes += len(payload)
+            h.payload = None
+            h.path = path
+            h.tier = TIER_DISK
+            self.spill_count[TIER_DISK] += 1
+        _obs.record_spill(stage=h.stage, tier=TIER_DISK,
+                          nbytes=len(payload),
+                          ns=time.monotonic_ns() - t0, task=h.task_id,
+                          name=h.name, generation=h.generation)
+
+    # ------------------------------------------------------------- restore
+
+    def _materialize(self, h: SpillHandle):
+        with self._cv:
+            while h.busy:
+                self._cv.wait()
+            if h.closed:
+                raise ValueError(
+                    f"spill handle {h.name!r} is closed")
+            if h.tier == TIER_DEVICE:
+                return h.columns
+            h.busy = True
+            src_tier = h.tier
+            payload = h.payload
+            path = h.path
+            gen = h.generation
+            fields = h.fields
+        t0 = time.monotonic_ns()
+        acquired = False
+        try:
+            # blocking device re-acquisition OUTSIDE the lock (it may
+            # itself trigger ensure_headroom on other handles)
+            self._acquire_device(h.device_bytes)
+            acquired = True
+            cols = self._deserialize(h, src_tier, payload, path, gen,
+                                     fields)
+            ns = time.monotonic_ns() - t0
+            with self._cv:
+                h.busy = False
+                self._cv.notify_all()
+                if h.closed:
+                    # restore-under-concurrent-free race: the caller
+                    # still gets its data; the reservation and the
+                    # handle's tiers are released, nothing leaks.
+                    # close() deferred payload/file cleanup to us.
+                    if h.payload is not None:
+                        self._host_bytes -= len(h.payload)
+                        h.payload = None
+                    if h.path:
+                        try:
+                            self._disk_bytes -= os.path.getsize(h.path)
+                            os.unlink(h.path)
+                        except OSError:
+                            pass
+                        h.path = None
+                    h.columns = None
+                    h.tier = TIER_FREED
+                    acquired = False
+                    self._release_device(h.device_bytes)
+                    return cols
+                if src_tier == TIER_HOST and h.payload is not None:
+                    self._host_bytes -= len(h.payload)
+                h.payload = None
+                if h.path:
+                    try:
+                        self._disk_bytes -= os.path.getsize(h.path)
+                        os.unlink(h.path)
+                    except OSError:
+                        pass
+                    h.path = None
+                h.columns = list(cols)
+                h.tier = TIER_DEVICE
+                self.restore_count += 1
+            _obs.record_spill_restore(stage=h.stage, tier=src_tier,
+                                      nbytes=h.device_bytes, ns=ns,
+                                      task=h.task_id, name=h.name)
+            _obs.record_spill_wait(ns, stage=h.stage or "restore")
+            return cols
+        except BaseException:
+            with self._cv:
+                h.busy = False
+                self._cv.notify_all()
+                if h.closed:
+                    # deferred close cleanup (see _close_handle)
+                    if h.payload is not None:
+                        self._host_bytes -= len(h.payload)
+                        h.payload = None
+                    if h.path:
+                        try:
+                            self._disk_bytes -= os.path.getsize(h.path)
+                            os.unlink(h.path)
+                        except OSError:
+                            pass
+                        h.path = None
+                    h.columns = None
+                    h.tier = TIER_FREED
+            if acquired:
+                self._release_device(h.device_bytes)
+            raise
+
+    def _deserialize(self, h: SpillHandle, src_tier: str,
+                     payload: Optional[bytes], path: Optional[str],
+                     generation: int, fields):
+        from spark_rapids_tpu.shuffle import kudo
+        if src_tier == TIER_DISK:
+            try:
+                with open(path, "rb") as f:
+                    payload = f.read()
+            except OSError as e:
+                return self._corrupt(h, kudo.KudoCorruptException(
+                    f"unreadable spill file: {e}", reason="truncated",
+                    path=path, generation=generation), path, generation)
+        try:
+            kts = kudo.read_tables(io.BytesIO(payload))
+            table = kudo.merge_to_table(kts, fields)
+            return list(table.columns)
+        except (kudo.KudoCorruptException, EOFError, ValueError) as e:
+            if not isinstance(e, kudo.KudoCorruptException):
+                e = kudo.KudoCorruptException(str(e), reason="truncated")
+            if e.path is None and path is not None:
+                e = kudo.annotate_spill_corruption(e, path, generation)
+            return self._corrupt(h, e, path, generation)
+
+    def _corrupt(self, h: SpillHandle, err, path, generation):
+        """A spill payload failed verification on read-back.  With a
+        ``recompute`` callback the batch is rebuilt from source
+        (counted srt_spill_corrupt_total{outcome=recomputed}) instead
+        of failing the query; without one the annotated error (file
+        path + spill generation) escalates."""
+        self.corrupt_count += 1
+        if h.recompute is not None:
+            _obs.record_spill_corrupt(
+                "recomputed", path=path or "", generation=generation,
+                name=h.name, stage=h.stage, task=h.task_id)
+            self.recompute_count += 1
+            return list(h.recompute())
+        _obs.record_spill_corrupt(
+            "failed", path=path or "", generation=generation,
+            name=h.name, stage=h.stage, task=h.task_id)
+        raise err
+
+    # --------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        with self._lock:
+            tiers: Dict[str, dict] = {
+                TIER_DEVICE: {"handles": 0, "bytes": 0},
+                TIER_HOST: {"handles": 0,
+                            "bytes": int(self._host_bytes)},
+                TIER_DISK: {"handles": 0,
+                            "bytes": int(self._disk_bytes)},
+            }
+            for h in self._handles.values():
+                row = tiers.get(h.tier)
+                if row is not None:
+                    row["handles"] += 1
+                    if h.tier == TIER_DEVICE:
+                        row["bytes"] += h.device_bytes
+            return {
+                "handles": len(self._handles),
+                "tiers": tiers,
+                "spills_host": self.spill_count[TIER_HOST],
+                "spills_disk": self.spill_count[TIER_DISK],
+                "restores": self.restore_count,
+                "corrupt": self.corrupt_count,
+                "recomputes": self.recompute_count,
+                "spillable_bytes": sum(
+                    h.device_bytes for h in self._handles.values()
+                    if h.tier == TIER_DEVICE and not h.busy),
+            }
+
+
+# ------------------------------------------------------- global install
+
+_store: Optional[SpillStore] = None
+_install_lock = threading.Lock()
+
+
+def install(store: Optional[SpillStore] = None) -> SpillStore:
+    """Install the process spill store and wire it into the installed
+    adaptor's OOM state machine (idempotent; a fresh store replaces
+    the prior one)."""
+    global _store
+    with _install_lock:
+        if store is None:
+            store = SpillStore()
+        _store = store
+        from spark_rapids_tpu.memory import rmm_spark
+        ad = rmm_spark.installed_adaptor()
+        if ad is not None:
+            ad.set_spill_hook(store)
+        return store
+
+
+def uninstall() -> None:
+    global _store
+    with _install_lock:
+        store, _store = _store, None
+        from spark_rapids_tpu.memory import rmm_spark
+        ad = rmm_spark.installed_adaptor()
+        if ad is not None:
+            ad.set_spill_hook(None)
+        if store is not None:
+            store.close()
+
+
+def installed_store() -> Optional[SpillStore]:
+    return _store
+
+
+def ensure_store() -> SpillStore:
+    """The installed store, installing a default one on first use
+    (the out-of-core operators' entry)."""
+    return _store if _store is not None else install()

@@ -190,6 +190,25 @@ KUDO_RESYNC_BYTES = METRICS.counter(
     "srt_kudo_resync_skipped_bytes_total",
     "Bytes skipped while resyncing corrupted kudo streams to the "
     "next magic")
+SPILL_BYTES = METRICS.counter(
+    "srt_spill_bytes_total",
+    "Device bytes spilled through the tiered store (memory/spill.py) "
+    "by stage and destination tier",
+    labels=("stage", "tier"), max_series=256)
+SPILL_RESTORES = METRICS.counter(
+    "srt_spill_restores_total",
+    "Spilled batches streamed back to the device by stage and source "
+    "tier", labels=("stage", "tier"), max_series=256)
+SPILL_TIME = METRICS.counter(
+    "srt_spill_ns_total",
+    "Wall nanoseconds inside spill-store work by stage and direction "
+    "(spill = serialize+release, restore = re-acquire+deserialize)",
+    labels=("stage", "dir"), max_series=256)
+SPILL_CORRUPT = METRICS.counter(
+    "srt_spill_corrupt_total",
+    "Spill payloads failing CRC/parse on read-back (recomputed = "
+    "rebuilt from source, failed = escalated)",
+    labels=("outcome",))
 JIT_CACHE_HITS = METRICS.counter(
     "srt_jit_cache_hits_total",
     "Kernel compile-cache hits (perf/jit_cache.py)", labels=("kernel",))
@@ -1055,6 +1074,57 @@ def record_kudo_corruption(reason: str, *, skipped_bytes: int = 0,
     JOURNAL.emit("kudo_corrupt", reason=reason,
                  skipped_bytes=skipped_bytes, detail=detail[:200],
                  thread=threading.get_ident())
+
+
+def record_spill(*, stage: str, tier: str, nbytes: int, ns: int,
+                 task=None, name: str = "", generation: int = 0) -> None:
+    """Tiered-store spill hook (memory/spill.py): one registered
+    batch moved DOWN a tier (device->host or host->disk), freeing
+    ``nbytes`` of the source tier."""
+    if not _SWITCH.enabled:
+        return
+    st = stage or "-"
+    SPILL_BYTES.inc(nbytes, labels=(st, tier))
+    SPILL_TIME.inc(ns, labels=(st, "spill"))
+    JOURNAL.emit("spill", stage=st, tier=tier, bytes=nbytes, ns=ns,
+                 task=task, name=name, generation=generation,
+                 thread=threading.get_ident())
+
+
+def record_spill_restore(*, stage: str, tier: str, nbytes: int,
+                         ns: int, task=None, name: str = "") -> None:
+    """A spilled batch streamed back to the device from ``tier``."""
+    if not _SWITCH.enabled:
+        return
+    st = stage or "-"
+    SPILL_RESTORES.inc(labels=(st, tier))
+    SPILL_TIME.inc(ns, labels=(st, "restore"))
+    JOURNAL.emit("spill_restore", stage=st, tier=tier, bytes=nbytes,
+                 ns=ns, task=task, name=name,
+                 thread=threading.get_ident())
+
+
+def record_spill_wait(ns: int, *, stage: str = "") -> None:
+    """Synchronous wall time a query thread spent waiting on spill-
+    store work (ensure_headroom victims, restore round trips) — the
+    PR-16 ``spill_wait`` attribution bucket's journal source."""
+    if not _SWITCH.enabled or ns <= 0:
+        return
+    JOURNAL.emit("spill_wait", stage=stage or "-", ns=ns,
+                 thread=threading.get_ident())
+
+
+def record_spill_corrupt(outcome: str, *, path: str = "",
+                         generation: int = 0, name: str = "",
+                         stage: str = "", task=None) -> None:
+    """A spill payload failed CRC/parse verification on read-back:
+    outcome 'recomputed' (rebuilt from source) or 'failed'."""
+    if not _SWITCH.enabled:
+        return
+    SPILL_CORRUPT.inc(labels=(outcome,))
+    JOURNAL.emit("spill_corrupt", outcome=outcome, path=path[:200],
+                 generation=generation, name=name, stage=stage or "-",
+                 task=task, thread=threading.get_ident())
 
 
 def record_jit_cache(event: str, kernel: str, *,

@@ -21,6 +21,9 @@ exhaustive, non-overlapping bucket set:
   shuffle_wait      inbox idle: blocked waiting on peers' frames
   speculation_wait  gather idle attributable to parts with a live
                     speculation decision (PR 14 stragglers)
+  spill_wait        synchronous tiered-store work on this thread:
+                    ensure_headroom victim spills + restore round
+                    trips (memory/spill.py)
   oom_blocked       BUFN time (``thread_unblocked`` blocked_ns)
   retry_lost        failed retry attempts' wall (episodes' lost_ns)
   other             the residual — reported, never silently dropped
@@ -62,6 +65,7 @@ BUCKETS = (
     "shuffle_wire",
     "shuffle_wait",
     "speculation_wait",
+    "spill_wait",
     "oom_blocked",
     "retry_lost",
     "other",
@@ -75,6 +79,7 @@ OVERHEAD_BUCKETS = (
     "shuffle_wire",
     "shuffle_wait",
     "speculation_wait",
+    "spill_wait",
     "oom_blocked",
     "retry_lost",
 )
@@ -153,12 +158,15 @@ def attribute_profile(profile: dict, *,
 
     oom_blocked = int((profile.get("oom") or {}).get("blocked_ns", 0))
     retry_lost = int((profile.get("retries") or {}).get("lost_ns", 0))
-    # blocked/lost time happened inside stage walls on this thread:
-    # carve it out of compute so the buckets stay non-overlapping
-    uncarved = _carve(buckets, oom_blocked + retry_lost,
+    spill_wait = int((profile.get("spill") or {}).get("wait_ns", 0))
+    # blocked/lost/spill time happened inside stage walls on this
+    # thread: carve it out of compute so the buckets stay
+    # non-overlapping
+    uncarved = _carve(buckets, oom_blocked + retry_lost + spill_wait,
                       ("compute_unfused", "compute_fused"))
     buckets["oom_blocked"] = oom_blocked
     buckets["retry_lost"] = retry_lost
+    buckets["spill_wait"] = spill_wait
 
     known = sum(buckets[b] for b in BUCKETS if b != "other")
     overcount = max(known - wall, 0) if wall > 0 else max(known, 0)

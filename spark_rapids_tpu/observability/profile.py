@@ -66,7 +66,9 @@ _DELTA_FAMILIES = (
 # ``task``) attribution matches the session
 _THREAD_KINDS = ("retry_episode", "kernel_path", "oom_retry",
                  "oom_split_retry", "thread_unblocked",
-                 "shuffle_wire", "shuffle_wait")
+                 "shuffle_wire", "shuffle_wait",
+                 "spill", "spill_restore", "spill_wait",
+                 "spill_corrupt")
 
 # the TaskMetricsTable's shared fallback row (threads with no RmmSpark
 # binding).  It is process-wide, so its deltas are only trustworthy
@@ -370,7 +372,7 @@ class QueryProfiler:
     def _fold_journal(self, sess: ProfileSession) -> dict:
         if self.journal is None:
             return {"retries": {}, "oom": {}, "kernel_paths": {},
-                    "events": {}, "shuffle": {}}
+                    "events": {}, "shuffle": {}, "spill": {}}
         window = [r for r in self.journal.records()
                   if r.get("seq", 0) > sess.seq0]
         tasks = set(sess.task_ids)
@@ -387,6 +389,8 @@ class QueryProfiler:
                    "lost_ns": 0, "outcomes": {}}
         oom = {"retry": 0, "split_retry": 0, "blocked_ns": 0}
         shuffle = {"wire_ns": 0, "wait_ns": 0, "spec_wait_ns": 0}
+        spill = {"bytes": 0, "spills": 0, "restores": 0, "ns": 0,
+                 "wait_ns": 0, "corrupt": 0, "tiers": {}}
         kernel_paths: Dict[str, int] = {}
         events: Dict[str, int] = {}
         for r in window:
@@ -421,8 +425,22 @@ class QueryProfiler:
             elif kind == "shuffle_wait":
                 shuffle["wait_ns"] += int(r.get("wait_ns", 0))
                 shuffle["spec_wait_ns"] += int(r.get("spec_ns", 0))
+            elif kind == "spill":
+                spill["spills"] += 1
+                spill["bytes"] += int(r.get("bytes", 0))
+                spill["ns"] += int(r.get("ns", 0))
+                tier = str(r.get("tier", "?"))
+                spill["tiers"][tier] = spill["tiers"].get(tier, 0) + 1
+            elif kind == "spill_restore":
+                spill["restores"] += 1
+                spill["ns"] += int(r.get("ns", 0))
+            elif kind == "spill_wait":
+                spill["wait_ns"] += int(r.get("ns", 0))
+            elif kind == "spill_corrupt":
+                spill["corrupt"] += 1
         return {"retries": retries, "oom": oom, "shuffle": shuffle,
-                "kernel_paths": kernel_paths, "events": events}
+                "spill": spill, "kernel_paths": kernel_paths,
+                "events": events}
 
     def _fold_tasks(self, sess: ProfileSession) -> dict:
         """Per-task metric deltas for the session's RmmSpark-bound
@@ -656,6 +674,8 @@ def merge_profiles(profiles: List[dict]) -> dict:
         "oom": {k: int(v) for k, v in _sum_field("oom").items()},
         "shuffle": {k: int(v) for k, v in
                     _sum_field("shuffle").items()},
+        "spill": {k: int(v) for k, v in
+                  _sum_field("spill").items()},
         "kernel_paths": {k: int(v) for k, v in
                          _sum_field("kernel_paths").items()},
     }

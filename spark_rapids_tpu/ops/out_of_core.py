@@ -1,0 +1,231 @@
+"""Out-of-core hash join and group-by (ISSUE 18 tentpole b): when the
+build side exceeds ``SPARK_RAPIDS_TPU_DEVICE_BUDGET_BYTES``, partition
+both sides by the existing xxhash64 join group ids
+(ops/hash_join.key_hashes over the join word encoding), spill build
+partitions through the tiered store (memory/spill.py), and stream
+them back one partition at a time — each partition running through
+the UNCHANGED in-memory kernels, so the result is byte-identical to
+the single-pass answer.
+
+Why partitioning preserves bit-exactness (the contracts these wrappers
+lean on, both asserted by tests/test_spill.py):
+
+* join — ``hash_inner_join`` returns pairs grouped by left index
+  ascending, right ascending within a left row.  A key hashes to ONE
+  partition, so every match of a left row lives in that row's
+  partition; concatenating per-partition pairs (mapped back to global
+  indices) and re-sorting by (left, right) reproduces the oracle
+  order exactly, and the pair SET is trivially equal.
+* group-by — same-key rows land in the same partition, so every group
+  is COMPLETE within its partition: per-partition aggregates are the
+  FINAL aggregates, computed by ``groupby_aggregate`` over the same
+  rows in the same relative order (stable mask partitioning), hence
+  bit-identical — including float sums, whose accumulation sequence
+  is unchanged.  Output rows are re-ordered to the in-memory group
+  order (sorted-key order, the position-independent contract of
+  ``_group_ids``) by running the group-id machinery once over the
+  merged one-row-per-group output keys.
+
+The DISABLED path — no device budget configured — is one cached env
+read and a direct call into the in-memory operator (<1us, gated by
+scripts/spill_smoke.py).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columns.table import Table
+from spark_rapids_tpu.memory import spill as spill_mod
+from spark_rapids_tpu.ops import joins
+from spark_rapids_tpu.ops.copying import gather_table
+
+_MAX_PARTS = 64
+
+
+def _partition_count(build_bytes: int, budget: int,
+                     parts: Optional[int]) -> int:
+    """Power-of-two partition count sized so one build partition fits
+    the budget (expectation under a uniform hash), clamped to
+    [2, 64]; ``SPARK_RAPIDS_TPU_SPILL_PARTITIONS`` / ``parts``
+    overrides."""
+    if parts is None:
+        parts = spill_mod._env_int("SPARK_RAPIDS_TPU_SPILL_PARTITIONS")
+    if parts is not None and parts > 0:
+        n = 1 << max(int(parts) - 1, 0).bit_length()
+        return max(2, min(_MAX_PARTS, n))
+    need = max(2, -(-build_bytes // max(budget, 1)))
+    return min(_MAX_PARTS, 1 << (need - 1).bit_length())
+
+
+def _partition_ids(words, nparts: int) -> np.ndarray:
+    """Per-row partition id from the SAME xxhash64 group ids the join
+    engines key on — both join sides therefore agree by
+    construction."""
+    from spark_rapids_tpu.ops.hash_join import key_hashes
+    if not words:
+        return np.zeros(0, np.int64)
+    h = np.asarray(key_hashes(words))
+    return (h.view(np.uint64) & np.uint64(nparts - 1)).astype(np.int64)
+
+
+def _spill_partitions(store, tables: List[Table], stage: str,
+                      task_id=None) -> List:
+    """Register every partition as spillable and push them all down a
+    tier: the caller is ABOUT to exceed its device budget, and the
+    streamed-back working set re-enters one partition at a time."""
+    handles = []
+    for i, t in enumerate(tables):
+        src = t  # recompute-from-source: the gathered partition table
+        h = store.register(
+            list(t.columns), name=f"{stage}-p{i}", task_id=task_id,
+            stage=stage, recompute=lambda t=src: list(t.columns))
+        handles.append(h)
+    for h in handles:
+        h.spill()
+    return handles
+
+
+def out_of_core_hash_join(left_keys: Table, right_keys: Table,
+                          compare_nulls: str = joins.NULL_EQUAL, *,
+                          budget: Optional[int] = None,
+                          parts: Optional[int] = None,
+                          store: Optional[spill_mod.SpillStore] = None,
+                          task_id: Optional[int] = None
+                          ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """``joins.hash_inner_join`` that degrades to partitioned
+    out-of-core execution (never to shedding) when the build side
+    exceeds the device budget.  Same return contract: (left_indices,
+    right_indices) grouped by left ascending, right ascending
+    within."""
+    from spark_rapids_tpu.ops.hash_join import join_key_words
+    if budget is None:
+        budget = spill_mod.device_budget_bytes()
+    if budget is None:
+        return joins.hash_inner_join(left_keys, right_keys,
+                                     compare_nulls)
+    build_bytes = spill_mod.columns_nbytes(right_keys.columns)
+    if build_bytes <= budget:
+        return joins.hash_inner_join(left_keys, right_keys,
+                                     compare_nulls)
+    try:
+        lwords, rwords, _vl, _vr, _extra = join_key_words(
+            left_keys, right_keys, compare_nulls)
+    except ValueError:
+        # no device word encoding for these keys -> no hash to
+        # partition on; the host rank path runs in one pass
+        return joins.hash_inner_join(left_keys, right_keys,
+                                     compare_nulls)
+    nparts = _partition_count(build_bytes, budget, parts)
+    lpid = _partition_ids(lwords, nparts)
+    rpid = _partition_ids(rwords, nparts)
+
+    # global row indices per partition (stable: original order kept)
+    lidx = [np.nonzero(lpid == p)[0] for p in range(nparts)]
+    ridx = [np.nonzero(rpid == p)[0] for p in range(nparts)]
+    rparts = [gather_table(right_keys,
+                           jnp.asarray(ri.astype(np.int32)))
+              for ri in ridx]
+    if store is None:
+        store = spill_mod.ensure_store()
+    handles = _spill_partitions(store, rparts, "ooc_join", task_id)
+
+    out_l: List[np.ndarray] = []
+    out_r: List[np.ndarray] = []
+    try:
+        for p in range(nparts):
+            if len(lidx[p]) == 0 or len(ridx[p]) == 0:
+                continue
+            lpart = gather_table(
+                left_keys, jnp.asarray(lidx[p].astype(np.int32)))
+            rpart = Table(handles[p].get(), right_keys.names)
+            # the UNCHANGED in-memory kernel, per partition
+            li, ri = joins.hash_inner_join(lpart, rpart, compare_nulls)
+            out_l.append(lidx[p][np.asarray(li)])
+            out_r.append(ridx[p][np.asarray(ri)])
+            handles[p].close()
+    finally:
+        for h in handles:
+            h.close()
+    if not out_l:
+        return jnp.zeros(0, jnp.int32), jnp.zeros(0, jnp.int32)
+    gl = np.concatenate(out_l)
+    gr = np.concatenate(out_r)
+    order = np.lexsort((gr, gl))
+    return (jnp.asarray(gl[order].astype(np.int32)),
+            jnp.asarray(gr[order].astype(np.int32)))
+
+
+def out_of_core_groupby(keys: Table, values: Sequence, aggs: Sequence[str],
+                        *, budget: Optional[int] = None,
+                        parts: Optional[int] = None,
+                        store: Optional[spill_mod.SpillStore] = None,
+                        task_id: Optional[int] = None) -> Table:
+    """``groupby.groupby_aggregate`` that partitions by the key hash
+    and streams partitions through the spill store when the input
+    exceeds the device budget.  Groups are complete per partition, so
+    per-partition aggregates are final and bit-identical; rows are
+    re-ordered to the in-memory (sorted-key) group order."""
+    from spark_rapids_tpu.ops import groupby
+    from spark_rapids_tpu.ops.hash_join import join_key_words
+    if budget is None:
+        budget = spill_mod.device_budget_bytes()
+    input_cols = list(keys.columns) + list(values)
+    if budget is None:
+        return groupby.groupby_aggregate(keys, values, aggs)
+    total_bytes = spill_mod.columns_nbytes(input_cols)
+    if total_bytes <= budget:
+        return groupby.groupby_aggregate(keys, values, aggs)
+    try:
+        kwords, _rw, _vl, _vr, _extra = join_key_words(
+            keys, keys, joins.NULL_EQUAL)
+    except ValueError:
+        return groupby.groupby_aggregate(keys, values, aggs)
+    nparts = _partition_count(total_bytes, budget, parts)
+    pid = _partition_ids(kwords, nparts)
+    nkeys = len(keys.columns)
+
+    whole = Table(input_cols)
+    pidx = [np.nonzero(pid == p)[0] for p in range(nparts)]
+    ptables = [gather_table(whole, jnp.asarray(ix.astype(np.int32)))
+               for ix in pidx if len(ix)]
+    if store is None:
+        store = spill_mod.ensure_store()
+    handles = _spill_partitions(store, ptables, "ooc_agg", task_id)
+
+    partials: List[Table] = []
+    try:
+        for h in handles:
+            cols = h.get()
+            pkeys = Table(cols[:nkeys], keys.names)
+            pvals = cols[nkeys:]
+            # the UNCHANGED in-memory kernel, per partition
+            partials.append(
+                groupby.groupby_aggregate(pkeys, pvals, aggs))
+            h.close()
+    finally:
+        for h in handles:
+            h.close()
+    if not partials:
+        return groupby.groupby_aggregate(keys, values, aggs)
+    if len(partials) == 1:
+        merged = partials[0]
+    else:
+        from spark_rapids_tpu.ops.copying import concat_tables
+        merged = concat_tables(partials)
+    # one row per group across all partials; the group-id machinery
+    # (position-independent, sorted-key order) yields each row's
+    # global position in the in-memory output
+    out_keys = Table(list(merged.columns)[:nkeys], keys.names)
+    ids, _first, ngroups = groupby._group_ids(out_keys)
+    order = np.argsort(np.asarray(ids), kind="stable")
+    out = gather_table(merged, jnp.asarray(order.astype(np.int32)))
+    names = None
+    if keys.names is not None:
+        names = list(keys.names) + [f"agg{i}"
+                                    for i in range(len(values))]
+    return Table(list(out.columns), names)

@@ -471,6 +471,40 @@ class CompiledStage:
                 compile_ns=compile_ns))
         return out
 
+    def run_spilled(self, partitions: Sequence[Mapping[str, object]]
+                    ) -> list:
+        """ISSUE 18 seam: a ShuffleBoundary is also a SPILL boundary.
+        Run this stage once per hash partition of spilled inputs —
+        WITHOUT unfusing: every partition goes through the ordinary
+        :meth:`run` entry, so same-bucket partitions share ONE fused
+        executable (the second partition is a jit-cache hit, asserted
+        by tests/test_spill.py and scripts/spill_smoke.py).
+
+        Each element of ``partitions`` maps input name -> either a
+        plain column sequence or a memory/spill.SpillHandle, whose
+        batch is streamed back (recording ``srt_spill_restores_total``
+        and ``spill_wait``) just-in-time for its partition and stays
+        registered — still spillable — afterwards; the CALLER owns
+        handle close().  Returns the per-partition output tuples in
+        partition order (correctness requires hash-partitioned,
+        per-partition-complete inputs — the ops/out_of_core
+        contract)."""
+        from spark_rapids_tpu.columns.column import Column
+        from spark_rapids_tpu.memory.spill import SpillHandle
+        outs = []
+        for part in partitions:
+            stage_inputs = {}
+            for name, v in part.items():
+                cols = v.get() if isinstance(v, SpillHandle) else v
+                # the store serializes Column batches; stages consume
+                # raw arrays — unwrap through the logical-dtype host
+                # view (the from_numpy inverse)
+                stage_inputs[name] = tuple(
+                    c.to_numpy() if isinstance(c, Column) else c
+                    for c in cols)
+            outs.append(self.run(stage_inputs))
+        return outs
+
     def _profile_record(self, inputs, *, digest: str, engine: str,
                         wall_ns, compiled: bool,
                         compile_ns: int = 0) -> dict:

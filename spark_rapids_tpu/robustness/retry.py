@@ -101,6 +101,39 @@ class RetryExhausted(Exception):
             f"{len(self.attempts)} failed attempts [..{errs}]")
 
 
+class SplitFloorReached(RetryExhausted):
+    """Terminal at the ONE-ELEMENT split floor specifically: the batch
+    cannot shrink further, so more splitting is pointless — a
+    different failure from a spent attempt/deadline budget, and
+    doctor/server treat it differently (the fix is spilling or a
+    bigger device, not more retries).  Carries the resident-bytes
+    evidence snapshot (per-task active bytes from the memory ledger at
+    raise time) so the bundle shows WHO was holding device memory when
+    the floor was hit."""
+
+    def __init__(self, name: str, attempts: List[Attempt],
+                 last: Optional[BaseException] = None,
+                 resident_bytes: Optional[dict] = None):
+        super().__init__(name, "split_floor", attempts, last)
+        self.resident_bytes = dict(resident_bytes or {})
+
+    @staticmethod
+    def ledger_snapshot() -> dict:
+        """{task_id(str): active_bytes} plus ``__total__`` from the
+        installed adaptor's ledger; empty with no memory runtime."""
+        adaptor = _installed_adaptor()
+        if adaptor is None:
+            return {}
+        try:
+            led = adaptor.memory_ledger(timeline=0)
+        except Exception:
+            return {}
+        out = {str(tid): int(row.get("active_bytes", 0))
+               for tid, row in (led.get("tasks") or {}).items()}
+        out["__total__"] = int(led.get("allocated_bytes", 0))
+        return out
+
+
 @dataclass
 class RetryPolicy:
     """Bounds one episode.  ``sleep``, ``clock``, and ``rng`` are
@@ -242,7 +275,15 @@ class _Episode:
 
     def exhausted(self, reason: str,
                   last: Optional[BaseException] = None) -> RetryExhausted:
-        ex = RetryExhausted(self.name, reason, self.history, last)
+        if reason == "split_floor":
+            # distinct type + resident-bytes evidence: "can't split
+            # further" is actionable (spill / bigger device), "budget
+            # exhausted" is not the same story
+            ex: RetryExhausted = SplitFloorReached(
+                self.name, self.history, last,
+                resident_bytes=SplitFloorReached.ledger_snapshot())
+        else:
+            ex = RetryExhausted(self.name, reason, self.history, last)
         if last is not None and ex.__cause__ is None:
             # the driver raises `ex from last`, but the flight
             # recorder serializes the chain BEFORE that binding —

@@ -52,6 +52,7 @@ class Job:
     priority: int            # task_priority value at (re-)admission
     submit_ns: int
     demotions: int = 0       # load-shed requeues so far
+    spill_rescued: bool = False  # one-shot spill-store rescue used
     state: str = STATE_QUEUED
     result: Any = None
     error: Optional[dict] = None

@@ -631,6 +631,15 @@ class QueryServer:
                 # tripped an OOM must report "cancelled" (or its
                 # deadline), not a bogus quota-exhaustion failure
                 state, outcome, error = _cancel_verdict(job)
+            elif self._try_spill_rescue(job, e):
+                # the tiered store freed real device bytes — retry at
+                # the SAME demotion level instead of burning one: the
+                # OOM was pressure the spill ladder can absorb, not a
+                # quota problem (ISSUE 18 satellite)
+                job.dur_ns = time.monotonic_ns() - t0
+                self._release_rmm_task(job)
+                self._requeue_demoted(job, e, charge_demotion=False)
+                return
             elif job.demotions < cfg.max_requeues:
                 # the failed attempt's pool time still gets charged
                 # (in _requeue_demoted) — an OOM-ing tenant must not
@@ -995,12 +1004,35 @@ class QueryServer:
             out["error"] = f"{type(e).__name__}: {e}"
         return out
 
-    def _requeue_demoted(self, job: Job, cause: BaseException) -> None:
+    def _try_spill_rescue(self, job: Job, cause: BaseException) -> bool:
+        """One spill-store rescue per job BEFORE a demotion is burned:
+        ask the installed tiered store (memory/spill.py) to free
+        device headroom synchronously.  True when real bytes were
+        freed — the job re-queues at the same demotion level and the
+        retry runs against a lighter device."""
+        if job.spill_rescued:
+            return False
+        from spark_rapids_tpu.memory import spill as spill_mod
+        store = spill_mod.installed_store()
+        if store is None:
+            return False
+        job.spill_rescued = True
+        try:
+            freed = store.ensure_headroom(1 << 62)
+        except Exception:
+            return False
+        return freed > 0
+
+    def _requeue_demoted(self, job: Job, cause: BaseException,
+                         charge_demotion: bool = True) -> None:
         """Load-shed: release the attempt's priority and re-register —
         the re-registered id gets a strictly LOWER priority (newer
-        value, see task_priority.py docs) — then back of the queue."""
+        value, see task_priority.py docs) — then back of the queue.
+        A spill rescue re-queues WITHOUT burning a demotion (the
+        pressure was absorbed by the store, not the job's quota)."""
         task_priority.task_done(job.task_id)
-        job.demotions += 1
+        if charge_demotion:
+            job.demotions += 1
         job.priority = task_priority.get_task_priority(job.task_id)
         job.state = STATE_QUEUED
         job.submit_ns = time.monotonic_ns()

@@ -70,13 +70,39 @@ class KudoCorruptException(ValueError):
     'truncated'}.  ``deferred=True`` marks a NON-seekable stream's
     late-trailer verification failure: the corrupt table was already
     handed to the caller one read earlier, and the stream itself is
-    positioned cleanly at the next record (see read_one_table)."""
+    positioned cleanly at the next record (see read_one_table).
+
+    For SPILL FILES (memory/spill.py) a stream offset alone is
+    useless triage — the operator needs to know WHICH file on disk
+    went bad and which spill generation wrote it, so re-reads of
+    kudo spill files carry ``path`` + ``generation`` (None for wire
+    streams, where the link peer/offset is the address)."""
 
     def __init__(self, msg: str, reason: str = "crc",
-                 deferred: bool = False):
+                 deferred: bool = False,
+                 path: Optional[str] = None,
+                 generation: Optional[int] = None):
+        if path is not None:
+            msg = (f"{msg} [spill file {path}"
+                   + (f", generation {generation}"
+                      if generation is not None else "") + "]")
         super().__init__(msg)
         self.reason = reason
         self.deferred = deferred
+        self.path = path
+        self.generation = generation
+
+
+def annotate_spill_corruption(e: "KudoCorruptException", path: str,
+                              generation: Optional[int] = None
+                              ) -> "KudoCorruptException":
+    """Rebuild a corruption error with the spill-file address (file
+    path + spill generation) folded into the message — the read path
+    only knows stream offsets; the spill store knows the file."""
+    return KudoCorruptException(
+        str(e.args[0]) if e.args else "kudo corruption",
+        reason=e.reason, deferred=e.deferred, path=path,
+        generation=generation)
 
 
 def set_crc_enabled(enabled: bool) -> bool:
@@ -308,10 +334,14 @@ def _walk_columns(cols: Sequence[HostColumnView], root: _Slice, visit):
 
 
 def write_to_stream(columns: Sequence[Column], out, row_offset: int,
-                    num_rows: int) -> int:
+                    num_rows: int, *,
+                    crc: Optional[bool] = None) -> int:
     """Serialize rows [row_offset, row_offset+num_rows) of the columns as
     one kudo table (KudoSerializer.writeToStreamWithMetrics:249).  Returns
-    bytes written (header + body)."""
+    bytes written (header + body).  ``crc`` overrides the process CRC
+    setting for THIS table (the spill store forces trailers on so
+    spilled bytes are always corruption-checked on read-back, without
+    racing the global flag against concurrent shuffle writers)."""
     if num_rows < 0 or row_offset < 0:
         raise ValueError("row_offset/num_rows must be non-negative")
     ntrace = _write_trace_extension(out)
@@ -376,14 +406,15 @@ def write_to_stream(columns: Sequence[Column], out, row_offset: int,
     for part in body:
         out.write(part)
     n = ntrace + header.serialized_size + header.total_len
-    return n + _write_crc_trailer(out, hb, body)
+    return n + _write_crc_trailer(out, hb, body, crc=crc)
 
 
-def _write_crc_trailer(out, header_bytes: bytes, body_parts) -> int:
+def _write_crc_trailer(out, header_bytes: bytes, body_parts, *,
+                       crc: Optional[bool] = None) -> int:
     """Append the KCRC trailer when CRC mode is on; returns the bytes
     written (0 when off — the stream stays reference
-    byte-compatible)."""
-    if not _CRC_ENABLED[0]:
+    byte-compatible).  ``crc`` overrides the process flag per table."""
+    if not (_CRC_ENABLED[0] if crc is None else crc):
         return 0
     crc = zlib.crc32(header_bytes)
     for part in body_parts:

@@ -207,6 +207,14 @@ def analyze(bundle: Bundle) -> List[dict]:
             msg += (f"; thread {tid} held "
                     f"{_fmt_bytes(row['active_bytes'])} at incident "
                     f"time")
+        if detail.get("reason") == "split_floor":
+            # ISSUE 18: the one-element split floor is a DIFFERENT
+            # failure from a spent budget — the batch cannot shrink
+            # further, so the fix is spilling / a bigger device, not
+            # more retries
+            msg += ("; SPLIT FLOOR: the batch is down to one element "
+                    "and still does not fit — register the build side "
+                    "with the spill store or raise the device budget")
         findings.append({"severity": 90, "kind": "retry_exhausted",
                          "message": msg})
         injected = [r for r in bundle.fault_rules
@@ -533,6 +541,44 @@ def analyze(bundle: Bundle) -> List[dict]:
                         f"blocking event(s) ({summary}; locks: "
                         f"{', '.join(held[:4])}) — contending "
                         f"threads stall behind I/O")})
+
+    # ---- spill-store history (ISSUE 18) -----------------------------
+    spills = [r for r in bundle.journal if r.get("kind") == "spill"]
+    if spills:
+        by_task: Dict[str, int] = {}
+        tiers: Dict[str, int] = {}
+        for r in spills:
+            by_task[str(r.get("task"))] = \
+                by_task.get(str(r.get("task")), 0) + \
+                int(r.get("bytes", 0))
+            tiers[str(r.get("tier", "?"))] = \
+                tiers.get(str(r.get("tier", "?")), 0) + 1
+        top_task, top_bytes = max(by_task.items(), key=lambda kv: kv[1])
+        restores = sum(1 for r in bundle.journal
+                       if r.get("kind") == "spill_restore")
+        tier_s = ", ".join(f"{t} x{n}" for t, n in sorted(tiers.items()))
+        findings.append({
+            "severity": 60, "kind": "spill_pressure",
+            "message": (f"{len(spills)} spill(s) through the tiered "
+                        f"store ({tier_s}; {restores} restore(s)) — "
+                        f"top spiller task {top_task} pushed "
+                        f"{_fmt_bytes(top_bytes)} down-tier; the query "
+                        f"ran THROUGH memory pressure (out-of-core), "
+                        f"raise SPARK_RAPIDS_TPU_DEVICE_BUDGET_BYTES "
+                        f"or add device memory to run in-core")})
+    spill_corrupt = [r for r in bundle.journal
+                     if r.get("kind") == "spill_corrupt"]
+    if spill_corrupt:
+        last = spill_corrupt[-1]
+        findings.append({
+            "severity": 78, "kind": "spill_corrupt",
+            "message": (f"{len(spill_corrupt)} corrupt spill "
+                        f"payload(s) on read-back (last: "
+                        f"{last.get('path') or last.get('name', '?')} "
+                        f"generation {last.get('generation', '?')}, "
+                        f"outcome {last.get('outcome', '?')}) — "
+                        f"recomputed from source when possible; check "
+                        f"the spill volume for failing media")})
 
     # ---- kudo corruption history ------------------------------------
     corrupt = [r for r in bundle.journal
