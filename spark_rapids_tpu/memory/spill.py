@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import io
 import os
+import sys
 import tempfile
 import threading
 import time
@@ -83,10 +84,16 @@ def _env_int(name: str) -> Optional[int]:
 # read the env through its raw backing dict (~0.07us vs ~1us for
 # os.environ.get's per-call key encode) — it IS os.environ's store,
 # so putenv/delenv stay visible — and cache the int parse on the raw
-# value
+# value.  ``_data`` is a CPython implementation detail (bytes-keyed on
+# posix), so any other interpreter takes the portable os.environ.get
+# path.
 _BUDGET_KEY = b"SPARK_RAPIDS_TPU_DEVICE_BUDGET_BYTES"
-_ENV_DATA = getattr(os.environ, "_data", None) if os.name == "posix" \
-    else None
+_ENV_DATA = (getattr(os.environ, "_data", None)
+             if os.name == "posix"
+             and sys.implementation.name == "cpython"
+             else None)
+if not isinstance(_ENV_DATA, dict):
+    _ENV_DATA = None
 _budget_parse: tuple = (None, None)       # (raw bytes, parsed int)
 
 
@@ -134,8 +141,8 @@ class SpillHandle:
 
     __slots__ = ("store", "handle_id", "name", "task_id", "stage",
                  "device_bytes", "columns", "fields", "payload", "path",
-                 "tier", "generation", "closed", "busy", "recompute",
-                 "_priority", "spill_seq")
+                 "tier", "generation", "closed", "busy", "pins",
+                 "recompute", "_priority", "spill_seq", "disk_nbytes")
 
     def __init__(self, store: "SpillStore", handle_id: int, name: str,
                  columns, device_bytes: int, task_id: Optional[int],
@@ -154,10 +161,12 @@ class SpillHandle:
         self.tier = TIER_DEVICE
         self.generation = 0         # bumps on every device->host spill
         self.closed = False
-        self.busy = False           # a restore is in flight
+        self.busy = False           # a restore/demotion is in flight
+        self.pins = 0               # callers computing on the columns
         self.recompute = recompute
         self._priority = priority
         self.spill_seq = 0          # FIFO order for host->disk demotion
+        self.disk_nbytes = 0        # bytes on disk (accounting, locked)
 
     @property
     def priority(self) -> int:
@@ -167,8 +176,25 @@ class SpillHandle:
     def get(self):
         """The batch's columns, restoring from host/disk when spilled.
         Synchronous; the restore-side device reservation runs inside a
-        spill range so the OOM machinery sees it as spill-path work."""
+        spill range so the OOM machinery sees it as spill-path work.
+
+        NOTE: the returned columns are NOT protected from a concurrent
+        ``ensure_headroom`` — the handle stays victim-eligible and its
+        device reservation may be released while the caller computes.
+        Callers that hold the columns across further allocations must
+        use :meth:`pin` instead."""
         return self.store._materialize(self)
+
+    def pin(self) -> "_Pin":
+        """Context manager: materialize AND pin.  While entered, the
+        handle is excluded from victim selection (``ensure_headroom``
+        will not spill it), so its device reservation is guaranteed to
+        cover the returned columns for the caller's whole compute:
+
+            with handle.pin() as cols:
+                ...  # cols stay resident here
+        """
+        return _Pin(self)
 
     def spill(self) -> int:
         """Force this handle down one tier (device->host, host->disk);
@@ -179,11 +205,34 @@ class SpillHandle:
         self.store._close_handle(self)
 
 
+class _Pin:
+    """Materialize-and-pin guard (see :meth:`SpillHandle.pin`): the
+    pin count is taken under the store lock at restore commit, so from
+    the moment ``__enter__`` returns until ``__exit__`` the handle is
+    invisible to ``_victims``/``spillable_bytes`` and its reservation
+    stays backing the returned columns."""
+
+    __slots__ = ("handle",)
+
+    def __init__(self, handle: SpillHandle):
+        self.handle = handle
+
+    def __enter__(self):
+        return self.handle.store._materialize(self.handle, pin=True)
+
+    def __exit__(self, *exc) -> None:
+        self.handle.store._unpin(self.handle)
+
+
 class SpillStore:
     """Registry of spillable handles + the tier ladder + the
-    ``ensure_headroom`` hook.  Thread-safe; the only blocking call
-    (restore's device re-acquisition) runs OUTSIDE the store lock so
-    a blocked restore can never wedge a concurrent spill."""
+    ``ensure_headroom`` hook.  Thread-safe; blocking or slow calls —
+    restore's device re-acquisition, spill's kudo serialization, the
+    adaptor-side release — run OUTSIDE the store lock, so a blocked
+    restore can never wedge a concurrent spill and an adaptor-lock
+    holder probing ``spillable_bytes()`` never waits on store I/O
+    (the lock-order discipline that prevents an ABBA deadlock with
+    ``SparkResourceAdaptor._check_and_update_for_bufn``)."""
 
     def __init__(self, *, spill_dir: Optional[str] = None,
                  host_limit_bytes: Optional[int] = None):
@@ -254,10 +303,11 @@ class SpillStore:
                 self._host_bytes -= len(h.payload)
                 h.payload = None
             path, h.path = h.path, None
+            self._disk_bytes -= h.disk_nbytes   # accounting under the
+            h.disk_nbytes = 0                   # lock; unlink outside
             h.tier = TIER_FREED
         if path:
             try:
-                self._disk_bytes -= os.path.getsize(path)
                 os.unlink(path)
             except OSError:
                 pass
@@ -297,17 +347,26 @@ class SpillStore:
 
     # ------------------------------------------------------------ spilling
 
+    def _unpin(self, h: SpillHandle) -> None:
+        with self._lock:
+            if h.pins > 0:
+                h.pins -= 1
+
     def spillable_bytes(self) -> int:
         """Device bytes the store could free right now — the OOM state
-        machine's pre-BUFN probe."""
+        machine's pre-BUFN probe.  Lock-cheap: no I/O or adaptor calls
+        happen under the store lock, so this is safe to call while
+        holding the adaptor lock."""
         with self._lock:
             return sum(h.device_bytes for h in self._handles.values()
-                       if h.tier == TIER_DEVICE and not h.busy)
+                       if h.tier == TIER_DEVICE and not h.busy
+                       and h.pins == 0)
 
     def _victims(self) -> List[SpillHandle]:
         """Device-tier handles in spill order: lowest task priority
         first, then largest resident-task bytes (the PR-5 ledger),
-        then largest handle."""
+        then largest handle.  Pinned handles (a caller is computing on
+        their columns) are not candidates."""
         resident: Dict[Optional[int], int] = {}
         ad = self._adaptor()
         if ad is not None:
@@ -319,7 +378,8 @@ class SpillStore:
                 resident = {}
         with self._lock:
             cands = [h for h in self._handles.values()
-                     if h.tier == TIER_DEVICE and not h.busy]
+                     if h.tier == TIER_DEVICE and not h.busy
+                     and h.pins == 0]
         cands.sort(key=lambda h: (h.priority,
                                   -resident.get(h.task_id, 0),
                                   -h.device_bytes, h.handle_id))
@@ -343,11 +403,11 @@ class SpillStore:
                                    stage="ensure_headroom")
         return freed
 
-    def _serialize(self, h: SpillHandle) -> bytes:
+    def _serialize(self, h: SpillHandle, cols: Sequence) -> bytes:
         from spark_rapids_tpu.columns.table import Table
         from spark_rapids_tpu.shuffle import kudo
         from spark_rapids_tpu.shuffle.schema import schema_of_table
-        cols = list(h.columns)
+        cols = list(cols)
         if h.fields is None:
             h.fields = schema_of_table(Table(cols))
         buf = io.BytesIO()
@@ -362,9 +422,33 @@ class SpillStore:
         Returns device bytes freed."""
         t0 = time.monotonic_ns()
         with self._lock:
-            if h.closed or h.busy or h.tier != TIER_DEVICE:
+            if (h.closed or h.busy or h.pins > 0
+                    or h.tier != TIER_DEVICE):
                 return 0
-            payload = self._serialize(h)
+            h.busy = True
+            cols = h.columns
+        # serialize OUTSIDE the store lock: a long kudo write must not
+        # stall spillable_bytes() probes, which run under the adaptor
+        # lock (ABBA otherwise); ``busy`` keeps the handle ours
+        try:
+            payload = self._serialize(h, cols)
+        except BaseException:
+            with self._cv:
+                h.busy = False
+                self._cv.notify_all()
+                if h.closed:
+                    h.columns = None
+                    h.tier = TIER_FREED
+            raise
+        with self._cv:
+            h.busy = False
+            self._cv.notify_all()
+            if h.closed:
+                # closed while serializing: drop the payload, finish
+                # the deferred cleanup close() left to the busy owner
+                h.columns = None
+                h.tier = TIER_FREED
+                return 0
             h.payload = payload
             h.columns = None
             h.tier = TIER_HOST
@@ -414,7 +498,21 @@ class SpillStore:
             with self._cv:
                 h.busy = False
                 self._cv.notify_all()
+                if h.closed:
+                    # closed while the failed write was in flight:
+                    # same deferred cleanup as the success path, or
+                    # the host payload leaks with tier still HOST
+                    if h.payload is not None:
+                        self._host_bytes -= len(h.payload)
+                        h.payload = None
+                    h.columns = None
+                    h.tier = TIER_FREED
+            try:
+                os.unlink(path)            # any partial write
+            except OSError:
+                pass
             return
+        closed = False
         with self._cv:
             h.busy = False
             self._cv.notify_all()
@@ -426,17 +524,21 @@ class SpillStore:
                     h.payload = None
                 h.columns = None
                 h.tier = TIER_FREED
-                try:
-                    os.unlink(path)
-                except OSError:
-                    pass
-                return
-            self._host_bytes -= len(payload)
-            self._disk_bytes += len(payload)
-            h.payload = None
-            h.path = path
-            h.tier = TIER_DISK
-            self.spill_count[TIER_DISK] += 1
+                closed = True
+            else:
+                self._host_bytes -= len(payload)
+                self._disk_bytes += len(payload)
+                h.payload = None
+                h.path = path
+                h.disk_nbytes = len(payload)
+                h.tier = TIER_DISK
+                self.spill_count[TIER_DISK] += 1
+        if closed:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return
         _obs.record_spill(stage=h.stage, tier=TIER_DISK,
                           nbytes=len(payload),
                           ns=time.monotonic_ns() - t0, task=h.task_id,
@@ -444,7 +546,23 @@ class SpillStore:
 
     # ------------------------------------------------------------- restore
 
-    def _materialize(self, h: SpillHandle):
+    def _drop_spilled_payload_locked(self, h: SpillHandle,
+                                     charge_host: bool = True
+                                     ) -> Optional[str]:
+        """Drop a handle's host payload and disk accounting (caller
+        holds the store lock); returns the file path the CALLER must
+        unlink AFTER releasing the lock (filesystem work never runs
+        under the store lock)."""
+        if h.payload is not None:
+            if charge_host:
+                self._host_bytes -= len(h.payload)
+            h.payload = None
+        self._disk_bytes -= h.disk_nbytes
+        h.disk_nbytes = 0
+        path, h.path = h.path, None
+        return path
+
+    def _materialize(self, h: SpillHandle, pin: bool = False):
         with self._cv:
             while h.busy:
                 self._cv.wait()
@@ -452,6 +570,8 @@ class SpillStore:
                 raise ValueError(
                     f"spill handle {h.name!r} is closed")
             if h.tier == TIER_DEVICE:
+                if pin:
+                    h.pins += 1
                 return h.columns
             h.busy = True
             src_tier = h.tier
@@ -469,6 +589,8 @@ class SpillStore:
             cols = self._deserialize(h, src_tier, payload, path, gen,
                                      fields)
             ns = time.monotonic_ns() - t0
+            release_owed = 0
+            unlink_path = None
             with self._cv:
                 h.busy = False
                 self._cv.notify_all()
@@ -477,57 +599,50 @@ class SpillStore:
                     # still gets its data; the reservation and the
                     # handle's tiers are released, nothing leaks.
                     # close() deferred payload/file cleanup to us.
-                    if h.payload is not None:
-                        self._host_bytes -= len(h.payload)
-                        h.payload = None
-                    if h.path:
-                        try:
-                            self._disk_bytes -= os.path.getsize(h.path)
-                            os.unlink(h.path)
-                        except OSError:
-                            pass
-                        h.path = None
+                    unlink_path = self._drop_spilled_payload_locked(h)
                     h.columns = None
                     h.tier = TIER_FREED
-                    acquired = False
-                    self._release_device(h.device_bytes)
-                    return cols
-                if src_tier == TIER_HOST and h.payload is not None:
-                    self._host_bytes -= len(h.payload)
-                h.payload = None
-                if h.path:
-                    try:
-                        self._disk_bytes -= os.path.getsize(h.path)
-                        os.unlink(h.path)
-                    except OSError:
-                        pass
-                    h.path = None
-                h.columns = list(cols)
-                h.tier = TIER_DEVICE
-                self.restore_count += 1
+                    # the release runs AFTER the lock is dropped:
+                    # deallocate takes the adaptor lock, whose holder
+                    # may be probing our spillable_bytes() (ABBA
+                    # deadlock if we called it here)
+                    release_owed = h.device_bytes
+                else:
+                    unlink_path = self._drop_spilled_payload_locked(
+                        h, charge_host=(src_tier == TIER_HOST))
+                    h.columns = list(cols)
+                    h.tier = TIER_DEVICE
+                    if pin:
+                        h.pins += 1
+                    self.restore_count += 1
+            if unlink_path:
+                try:
+                    os.unlink(unlink_path)
+                except OSError:
+                    pass
+            if release_owed:
+                self._release_device(release_owed)
+                return cols
             _obs.record_spill_restore(stage=h.stage, tier=src_tier,
                                       nbytes=h.device_bytes, ns=ns,
                                       task=h.task_id, name=h.name)
             _obs.record_spill_wait(ns, stage=h.stage or "restore")
             return cols
         except BaseException:
+            unlink_path = None
             with self._cv:
                 h.busy = False
                 self._cv.notify_all()
                 if h.closed:
                     # deferred close cleanup (see _close_handle)
-                    if h.payload is not None:
-                        self._host_bytes -= len(h.payload)
-                        h.payload = None
-                    if h.path:
-                        try:
-                            self._disk_bytes -= os.path.getsize(h.path)
-                            os.unlink(h.path)
-                        except OSError:
-                            pass
-                        h.path = None
+                    unlink_path = self._drop_spilled_payload_locked(h)
                     h.columns = None
                     h.tier = TIER_FREED
+            if unlink_path:
+                try:
+                    os.unlink(unlink_path)
+                except OSError:
+                    pass
             if acquired:
                 self._release_device(h.device_bytes)
             raise
@@ -600,7 +715,8 @@ class SpillStore:
                 "recomputes": self.recompute_count,
                 "spillable_bytes": sum(
                     h.device_bytes for h in self._handles.values()
-                    if h.tier == TIER_DEVICE and not h.busy),
+                    if h.tier == TIER_DEVICE and not h.busy
+                    and h.pins == 0),
             }
 
 

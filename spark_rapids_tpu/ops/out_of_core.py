@@ -142,11 +142,16 @@ def out_of_core_hash_join(left_keys: Table, right_keys: Table,
                 continue
             lpart = gather_table(
                 left_keys, jnp.asarray(lidx[p].astype(np.int32)))
-            rpart = Table(handles[p].get(), right_keys.names)
-            # the UNCHANGED in-memory kernel, per partition
-            li, ri = joins.hash_inner_join(lpart, rpart, compare_nulls)
-            out_l.append(lidx[p][np.asarray(li)])
-            out_r.append(ridx[p][np.asarray(ri)])
+            # pinned while the kernel runs: a concurrent
+            # ensure_headroom must not re-spill the partition out
+            # from under the join
+            with handles[p].pin() as rcols:
+                rpart = Table(rcols, right_keys.names)
+                # the UNCHANGED in-memory kernel, per partition
+                li, ri = joins.hash_inner_join(lpart, rpart,
+                                               compare_nulls)
+                out_l.append(lidx[p][np.asarray(li)])
+                out_r.append(ridx[p][np.asarray(ri)])
             handles[p].close()
     finally:
         for h in handles:
@@ -200,12 +205,13 @@ def out_of_core_groupby(keys: Table, values: Sequence, aggs: Sequence[str],
     partials: List[Table] = []
     try:
         for h in handles:
-            cols = h.get()
-            pkeys = Table(cols[:nkeys], keys.names)
-            pvals = cols[nkeys:]
-            # the UNCHANGED in-memory kernel, per partition
-            partials.append(
-                groupby.groupby_aggregate(pkeys, pvals, aggs))
+            # pinned while the kernel runs (see out_of_core_hash_join)
+            with h.pin() as cols:
+                pkeys = Table(cols[:nkeys], keys.names)
+                pvals = cols[nkeys:]
+                # the UNCHANGED in-memory kernel, per partition
+                partials.append(
+                    groupby.groupby_aggregate(pkeys, pvals, aggs))
             h.close()
     finally:
         for h in handles:

@@ -483,26 +483,31 @@ class CompiledStage:
         Each element of ``partitions`` maps input name -> either a
         plain column sequence or a memory/spill.SpillHandle, whose
         batch is streamed back (recording ``srt_spill_restores_total``
-        and ``spill_wait``) just-in-time for its partition and stays
-        registered — still spillable — afterwards; the CALLER owns
+        and ``spill_wait``) just-in-time for its partition, PINNED
+        (victim-ineligible) while the partition runs, and stays
+        registered — spillable again — afterwards; the CALLER owns
         handle close().  Returns the per-partition output tuples in
         partition order (correctness requires hash-partitioned,
         per-partition-complete inputs — the ops/out_of_core
         contract)."""
+        import contextlib
+
         from spark_rapids_tpu.columns.column import Column
         from spark_rapids_tpu.memory.spill import SpillHandle
         outs = []
         for part in partitions:
-            stage_inputs = {}
-            for name, v in part.items():
-                cols = v.get() if isinstance(v, SpillHandle) else v
-                # the store serializes Column batches; stages consume
-                # raw arrays — unwrap through the logical-dtype host
-                # view (the from_numpy inverse)
-                stage_inputs[name] = tuple(
-                    c.to_numpy() if isinstance(c, Column) else c
-                    for c in cols)
-            outs.append(self.run(stage_inputs))
+            with contextlib.ExitStack() as pins:
+                stage_inputs = {}
+                for name, v in part.items():
+                    cols = (pins.enter_context(v.pin())
+                            if isinstance(v, SpillHandle) else v)
+                    # the store serializes Column batches; stages
+                    # consume raw arrays — unwrap through the
+                    # logical-dtype host view (the from_numpy inverse)
+                    stage_inputs[name] = tuple(
+                        c.to_numpy() if isinstance(c, Column) else c
+                        for c in cols)
+                outs.append(self.run(stage_inputs))
         return outs
 
     def _profile_record(self, inputs, *, digest: str, engine: str,

@@ -122,6 +122,49 @@ class TestVictimOrdering:
         store.close()
 
 
+# ------------------------------------------------------------- pinning
+
+
+class TestPinning:
+
+    def test_pinned_handles_are_not_victims(self, tmp_path):
+        store = SpillStore(spill_dir=str(tmp_path))
+        stub = _StubAdaptor({2: 500})
+        store._adaptor = lambda: stub
+        h1 = store.register(_small_cols(), device_bytes=100, name="a",
+                            task_id=2)
+        h2 = store.register(_small_cols(), device_bytes=50, name="b",
+                            task_id=2)
+        with h1.pin() as cols:
+            assert cols is h1.columns
+            # while an operator computes on h1, headroom passes must
+            # not release its reservation out from under it
+            assert [h.name for h in store._victims()] == ["b"]
+            assert store.spillable_bytes() == 50
+            assert store.stats()["spillable_bytes"] == 50
+            assert store.ensure_headroom(1 << 40) == 50
+            assert h1.tier == TIER_DEVICE and h2.tier == TIER_HOST
+            assert h1.spill() == 0            # direct spill refused too
+        # pin released -> victim-eligible again
+        assert h1.pins == 0
+        assert store.ensure_headroom(1 << 40) == 100
+        assert h1.tier == TIER_HOST
+        store.close()
+
+    def test_pin_restores_spilled_batch(self, tmp_path):
+        store = SpillStore(spill_dir=str(tmp_path))
+        h = store.register(_small_cols(4), name="p")
+        h.spill()
+        assert h.tier == TIER_HOST
+        with h.pin() as cols:
+            _assert_cols_identical(cols, _small_cols(4))
+            assert h.tier == TIER_DEVICE and h.pins == 1
+            assert store.spillable_bytes() == 0
+        assert h.pins == 0 and store.spillable_bytes() > 0
+        h.close()
+        store.close()
+
+
 # ------------------------------------------------- host->disk demotion
 
 
@@ -439,6 +482,77 @@ class TestRestoreCloseRace:
         assert store._handles == {}
         assert store._host_bytes == 0 and store._disk_bytes == 0
         assert not os.path.exists(path)
+        store.close()
+
+    def test_deferred_release_runs_outside_store_lock(self, tmp_path):
+        """Regression (REVIEW 18): the closed-during-restore device
+        release must run AFTER the store lock is dropped.  deallocate
+        takes the adaptor lock, and an adaptor-lock holder (the BUFN
+        deadlock probe) concurrently takes the store lock via
+        spillable_bytes() — releasing under the store lock is an ABBA
+        deadlock.  The stub adaptor proves the store lock is free from
+        ANOTHER thread (the RLock would lie for our own) on every
+        deallocate."""
+        store = SpillStore(spill_dir=str(tmp_path),
+                           host_limit_bytes=0)
+        lock_free = []
+
+        class _Ad:
+            def spill_range_start(self):
+                pass
+
+            def spill_range_done(self):
+                pass
+
+            def allocate(self, n):
+                pass
+
+            def deallocate(self, n):
+                got = {}
+
+                def probe():
+                    got["ok"] = store._lock.acquire(timeout=5)
+                    if got["ok"]:
+                        store._lock.release()
+
+                t = threading.Thread(target=probe)
+                t.start()
+                t.join()
+                lock_free.append(bool(got.get("ok")))
+
+        stub = _Ad()
+        store._adaptor = lambda: stub
+        h = store.register(_small_cols(3), name="raced2")
+        h.spill()
+
+        in_restore = threading.Event()
+        orig = store._deserialize
+
+        def slow_deserialize(*a, **kw):
+            in_restore.set()
+            time.sleep(0.05)
+            return orig(*a, **kw)
+
+        store._deserialize = slow_deserialize
+        out = {}
+
+        def reader():
+            try:
+                out["cols"] = h.get()
+            except BaseException as e:       # pragma: no cover
+                out["error"] = e
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        assert in_restore.wait(timeout=10)
+        h.close()                            # free while restoring
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert "error" not in out, out.get("error")
+        # the spill's release + the deferred closed-during-restore
+        # release both observed a free store lock
+        assert len(lock_free) == 2 and all(lock_free)
+        assert h.tier == TIER_FREED
         store.close()
 
 
