@@ -9,7 +9,7 @@ PY ?= python
         perf-smoke fusion-smoke doctor-smoke server-smoke \
         lifeguard-smoke ingest-smoke dist-smoke analysis-smoke \
         profile-smoke elastic-smoke slo-smoke attribution-smoke \
-        spill-smoke \
+        spill-smoke cache-smoke \
         serve-bench \
         nightly-artifacts ci ci-nightly clean
 
@@ -204,6 +204,13 @@ attribution-smoke:
 spill-smoke:
 	$(PY) scripts/spill_smoke.py
 
+# 100-query two-tenant replay over 10 ingest batches: warm repeats
+# must come back cache_hit, byte-identical, >=10x faster; incremental
+# q5 must fold one batch per epoch and match a cache-off full
+# recompute; a repeat submit must compile ZERO new executables
+cache-smoke:
+	$(PY) scripts/cache_smoke.py
+
 # zipf-skewed multi-tenant serving replay -> BENCH_serve_r01.json
 # (per-tenant p50/p99 admission-to-result, throughput, SLO attainment)
 serve-bench:
@@ -232,7 +239,8 @@ dryrun:
 ci: test fuzz native sanitizers tpu-lower jni-test dryrun metrics-smoke \
     trace-smoke chaos-smoke perf-smoke fusion-smoke doctor-smoke \
     server-smoke lifeguard-smoke ingest-smoke dist-smoke analysis-smoke \
-    profile-smoke elastic-smoke slo-smoke attribution-smoke spill-smoke
+    profile-smoke elastic-smoke slo-smoke attribution-smoke spill-smoke \
+    cache-smoke
 	$(PY) bench.py
 	@echo "ci: all gates green"
 

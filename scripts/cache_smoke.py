@@ -1,0 +1,232 @@
+"""Result-cache gate (`make cache-smoke`, ISSUE 19 acceptance): prove
+repeated traffic is served in O(new data) —
+
+  * a 100-query replay (two tenants, shared catalog queries plus the
+    ``tpcds_q5_incremental`` stream) over 10 ingest batches: every
+    repeat of an identical binding comes back with the distinct
+    ``cache_hit`` outcome, BYTE-identical to its cold answer, and the
+    warm median is >=10x faster than the cold median;
+  * the incremental q5 folds exactly one new batch per ingest epoch
+    (``srt_result_cache_incremental_folds_total`` lit) and its final
+    answer is byte-identical to a cache-off full recompute over all
+    10 batches;
+  * a second identical submit after the replay compiles ZERO new
+    executables (jit_cache compile counter unchanged) and its
+    retained warm-hit profile carries the ``cache`` section;
+  * per-tenant ``srt_result_cache_hits_total`` series exist for both
+    tenants and the metrics_report ``cache`` table renders from a
+    journal dump.
+
+Exits non-zero on the first missing signal."""
+
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+SOURCE = "cache_smoke_q5_stream"
+BATCHES = 10
+TENANTS = ("alpha", "bravo")
+
+
+def fail(msg: str) -> "NoReturn":  # noqa: F821
+    print(f"cache-smoke: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def say(msg: str) -> None:
+    print(f"cache-smoke: {msg}")
+
+
+def _canon(result) -> bytes:
+    return json.dumps(result, sort_keys=True, default=str).encode()
+
+
+def main() -> int:
+    t_start = time.monotonic()
+    os.environ["SPARK_RAPIDS_TPU_RESULT_CACHE"] = "1"
+
+    from spark_rapids_tpu import models
+    from spark_rapids_tpu import observability as obs
+    from spark_rapids_tpu.perf import result_cache as rc
+    from spark_rapids_tpu.perf.jit_cache import CACHE as JIT
+    from spark_rapids_tpu.server import QueryServer, ServerConfig
+    from spark_rapids_tpu.tools import metrics_report
+
+    rc.CACHE.clear(reset_stats=True)
+    rc.reset_ingest_epochs()
+    obs.enable()
+    obs.enable_profiling()
+    obs.reset()
+
+    q5p = {"rows": 256, "stores": 8, "seed": 5, "source": SOURCE}
+    # one batch = 10 submissions; x10 ingest batches = the 100-query
+    # replay.  q3/q9 bindings never change (pure repeats after the
+    # first batch); q5_incremental misses once per new epoch and folds
+    # the single new batch, then repeats warm.
+    batch_mix = []
+    for t in TENANTS:
+        batch_mix += [
+            (t, "tpcds_q3", {"rows": 1024, "seed": 31}),
+            (t, "tpcds_q3", {"rows": 1024, "seed": 31}),
+            (t, "tpcds_q9", {"rows": 2048, "seed": 1}),
+            (t, "tpcds_q5_incremental", dict(q5p)),
+            (t, "tpcds_q5_incremental", dict(q5p)),
+        ]
+
+    server = QueryServer(ServerConfig(
+        max_concurrency=2, max_queue=128, stall_ms=0)).start()
+    runs = []          # (key, wall_s, outcome, result, query_id)
+    try:
+        for b in range(BATCHES):
+            if b:
+                rc.bump_ingest_epoch(SOURCE)
+            for tenant, q, p in batch_mix:
+                t0 = time.perf_counter()
+                qid = server.submit(tenant, q, dict(p))
+                r = server.poll(qid, timeout_s=600)
+                wall = time.perf_counter() - t0
+                if r["state"] != "done":
+                    fail(f"{q} for {tenant} ended {r['state']}: "
+                         f"{r.get('error')}")
+                key = (q, json.dumps(p, sort_keys=True), b
+                       if q == "tpcds_q5_incremental" else None)
+                runs.append((key, wall, r.get("outcome"),
+                             r["result"], qid))
+
+        # ---- warm repeats: cache_hit outcome + byte identity --------
+        first = {}
+        colds, warms = [], []
+        for key, wall, outcome, result, _qid in runs:
+            if key not in first:
+                first[key] = _canon(result)
+                colds.append(wall)
+                if outcome == "cache_hit":
+                    fail(f"first run of {key} claims cache_hit")
+            else:
+                warms.append(wall)
+                if outcome != "cache_hit":
+                    fail(f"repeat of {key} outcome={outcome!r}, "
+                         f"want cache_hit")
+                if _canon(result) != first[key]:
+                    fail(f"warm result for {key} is not "
+                         f"byte-identical to its cold answer")
+        if len(runs) != BATCHES * len(batch_mix):
+            fail(f"replay ran {len(runs)} queries, want "
+                 f"{BATCHES * len(batch_mix)}")
+        if len(warms) < 60:
+            fail(f"only {len(warms)} warm hits in the replay")
+        cold_med = statistics.median(colds)
+        warm_med = statistics.median(warms)
+        if cold_med < warm_med * 10:
+            fail(f"warm median {warm_med * 1e3:.2f} ms is not >=10x "
+                 f"faster than cold median {cold_med * 1e3:.2f} ms")
+        say(f"replay: {len(runs)} queries, {len(colds)} cold / "
+            f"{len(warms)} warm; cold median {cold_med * 1e3:.1f} ms "
+            f"vs warm {warm_med * 1e3:.3f} ms "
+            f"({cold_med / warm_med:.0f}x)")
+
+        # ---- incremental folds lit ----------------------------------
+        # one fold per new ingest epoch: bravo's submits hit the
+        # shared result entry, so only one compute folds the delta
+        st = rc.CACHE.stats()
+        if st["folds"] < BATCHES - 1:
+            fail(f"expected >= {BATCHES - 1} incremental folds, "
+                 f"got {st['folds']}")
+        say(f"incremental q5 folded {st['folds']} batches across "
+            f"{BATCHES} ingest epochs")
+
+        # ---- second identical submit: ZERO new executables ----------
+        compiles_before = JIT.stats()["compiles"]
+        t0 = time.perf_counter()
+        qid = server.submit("alpha", "tpcds_q3",
+                            {"rows": 1024, "seed": 31})
+        r = server.poll(qid, timeout_s=60)
+        if r.get("outcome") != "cache_hit":
+            fail(f"post-replay identical submit outcome="
+                 f"{r.get('outcome')!r}, want cache_hit")
+        if JIT.stats()["compiles"] != compiles_before:
+            fail(f"identical submit compiled "
+                 f"{JIT.stats()['compiles'] - compiles_before} new "
+                 f"executables, want zero")
+        say(f"second identical submit: cache_hit in "
+            f"{(time.perf_counter() - t0) * 1e3:.3f} ms, zero new "
+            f"executables ({compiles_before} compiles total)")
+
+        # ---- warm-hit profile carries the cache section -------------
+        prof = server.profile(qid)
+        if prof is None:
+            fail("warm hit retained no profile artifact")
+        cache_sec = prof.get("cache") or {}
+        if cache_sec.get("hits", 0) < 1 or "lookup_ns" not in cache_sec:
+            fail(f"warm profile cache section too thin: {cache_sec}")
+        say(f"warm profile cache section OK "
+            f"(lookup {cache_sec['lookup_ns'] / 1e3:.1f} us)")
+    finally:
+        server.stop()
+
+    # ---- per-tenant hit metrics + exposition ------------------------
+    hit_tenants = {s["labels"][1]
+                   for s in obs.RESULT_CACHE_HITS.snapshot()["series"]}
+    for t in TENANTS:
+        if t not in hit_tenants:
+            fail(f"no srt_result_cache_hits_total series for tenant "
+                 f"{t!r} (saw {sorted(hit_tenants)})")
+    text = obs.expose_text()
+    for needle in ("srt_result_cache_hits_total",
+                   "srt_result_cache_misses_total",
+                   "srt_result_cache_bytes_total",
+                   "srt_result_cache_incremental_folds_total"):
+        if needle not in text:
+            fail(f"exposition missing {needle!r}")
+    say(f"per-tenant hit series present: {sorted(hit_tenants)}")
+
+    # ---- metrics_report cache table from a journal dump -------------
+    tmp = tempfile.mkdtemp(prefix="cache_smoke_")
+    path = os.path.join(tmp, "journal.jsonl")
+    obs.dump_journal_jsonl(path)
+    with open(path) as f:
+        records = [json.loads(line) for line in f if line.strip()]
+    report = metrics_report.build_report(records)
+    rows = report.get("cache") or []
+    row_tenants = {r.get("tenant") for r in rows}
+    if not rows or not all(t in row_tenants for t in TENANTS):
+        fail(f"metrics_report cache table thin: {rows}")
+    say(f"metrics_report cache table: {len(rows)} rows")
+
+    # ---- differential: incremental answer == cache-off recompute ----
+    obs.disable_profiling()
+    obs.disable()
+    warm_q5 = next(res for key, _w, _o, res, _q in reversed(runs)
+                   if key[0] == "tpcds_q5_incremental")
+    os.environ["SPARK_RAPIDS_TPU_RESULT_CACHE"] = "0"
+    try:
+        full = models.run_catalog_query("tpcds_q5_incremental",
+                                        dict(q5p))
+    finally:
+        os.environ["SPARK_RAPIDS_TPU_RESULT_CACHE"] = "1"
+    if _canon(full) != _canon(warm_q5):
+        fail("incremental q5 diverges from the cache-off full "
+             "recompute over the same 10 batches")
+    say("incremental q5 byte-identical to cache-off full recompute")
+
+    say(f"OK ({time.monotonic() - t_start:.1f}s): 100-query replay "
+        f"warm>=10x and byte-identical, incremental folds lit, zero "
+        f"new executables on repeat, per-tenant hit metrics + report "
+        f"table, incremental==full differential")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,8 +28,17 @@ per-tenant attainment/p99 trajectory — where the knee is, not just
 whether one burst survived.  Ramp output defaults to
 ``BENCH_serve_r02.json`` so the burst artifact keeps its name.
 
+``--cache-soak`` (ISSUE 19) replays the SAME zipf-skewed repeated
+traffic twice — semantic result cache off, then on — across 10 ingest
+epochs of the ``tpcds_q5_incremental`` stream.  The artifact
+(``BENCH_serve_r03.json``) reports the warm/cold latency split, the
+cache-on vs cache-off throughput, the result-scope hit ratio, and the
+incremental-fold count: the O(new data) evidence for serving repeated
+traffic.
+
 Usage:  python scripts/serve_bench.py [--out BENCH_serve_r01.json]
         python scripts/serve_bench.py --ramp 1:8:4
+        python scripts/serve_bench.py --cache-soak
 """
 
 import argparse
@@ -221,6 +230,174 @@ def run_ramp(args, qps_steps, out_path: str) -> int:
     return 0
 
 
+CACHE_SOAK_BATCHES = 10
+CACHE_SOAK_SOURCE = "serve_bench_q5_stream"
+
+# the repeated-traffic pool: a handful of bindings the tenants keep
+# re-asking, plus the incremental q5 stream that grows one batch per
+# ingest epoch
+CACHE_SOAK_QUERIES = [
+    ("tpcds_q9", {"rows": 2048, "seed": 1}),
+    ("tpcds_q3", {"rows": 1024, "seed": 31}),
+    ("tpcds_q5_incremental", {"rows": 512, "stores": 8, "seed": 5,
+                              "source": CACHE_SOAK_SOURCE}),
+    ("tpcds_q3", {"rows": 1024, "seed": 32}),
+]
+
+
+def run_cache_soak(args, out_path: str) -> int:
+    """Cache-on vs cache-off soak (ISSUE 19): the identical replay —
+    zipf tenant skew, a small repeated binding pool, 10 ingest epochs
+    of the q5 stream — run twice.  Closed-loop client walls so both
+    runs charge the same end-to-end path; the delta IS the cache."""
+    import statistics
+
+    from spark_rapids_tpu import models
+    from spark_rapids_tpu import observability as obs
+    from spark_rapids_tpu.perf import result_cache as rc
+    from spark_rapids_tpu.server import QueryServer, ServerConfig
+
+    # deterministic mix, shared by both runs
+    rng = random.Random(SEED)
+    weights = zipf_weights(len(TENANTS), ZIPF_S)
+    # floor of 10/batch keeps the soak a ~100-query replay even at
+    # the burst default of --requests 32
+    per_batch = max(args.requests // CACHE_SOAK_BATCHES, 10)
+    mix = [[(rng.choices(TENANTS, weights=weights)[0],) +
+            CACHE_SOAK_QUERIES[i % len(CACHE_SOAK_QUERIES)]
+            for i in range(per_batch)]
+           for _b in range(CACHE_SOAK_BATCHES)]
+    total = per_batch * CACHE_SOAK_BATCHES
+
+    # warm the jit cache outside the measured runs (cache off):
+    # the soak measures serving latency, not first-compile cost
+    os.environ["SPARK_RAPIDS_TPU_RESULT_CACHE"] = "0"
+    for q, p in CACHE_SOAK_QUERIES:
+        models.run_catalog_query(q, dict(p))
+
+    def one_run(cache_on: bool):
+        os.environ["SPARK_RAPIDS_TPU_RESULT_CACHE"] = \
+            "1" if cache_on else "0"
+        rc.CACHE.clear(reset_stats=True)
+        rc.reset_ingest_epochs()
+        server = QueryServer(ServerConfig(
+            max_concurrency=2, max_queue=4 * per_batch,
+            stall_ms=0)).start()
+        lats = []                 # (tenant, wall_ms, outcome)
+        t0 = time.monotonic()
+        try:
+            for b, batch in enumerate(mix):
+                if b:
+                    rc.bump_ingest_epoch(CACHE_SOAK_SOURCE)
+                for tenant, q, p in batch:
+                    t1 = time.perf_counter()
+                    qid = server.submit(tenant, q, dict(p))
+                    r = server.poll(qid, timeout_s=600)
+                    if r["state"] != "done":
+                        raise RuntimeError(
+                            f"{q} for {tenant} finished {r['state']}: "
+                            f"{r.get('error')}")
+                    lats.append((tenant,
+                                 (time.perf_counter() - t1) * 1e3,
+                                 r.get("outcome")))
+        finally:
+            server.stop()
+        return lats, time.monotonic() - t0, rc.CACHE.stats()
+
+    obs.enable()
+    obs.reset()
+    try:
+        off_lats, off_wall, _ = one_run(cache_on=False)
+        on_lats, on_wall, on_stats = one_run(cache_on=True)
+    except RuntimeError as e:
+        print(f"serve-bench: FAIL: {e}", file=sys.stderr)
+        return 1
+    finally:
+        os.environ.pop("SPARK_RAPIDS_TPU_RESULT_CACHE", None)
+
+    warm = sorted(ms for _t, ms, o in on_lats if o == "cache_hit")
+    cold = sorted(ms for _t, ms, _o in off_lats)
+    on_all = sorted(ms for _t, ms, _o in on_lats)
+    hits = on_stats.get("hits", 0)
+    misses = on_stats.get("misses", 0)
+    hit_ratio = hits / (hits + misses) if hits + misses else 0.0
+
+    def per_tenant(lats):
+        out = {}
+        for t in TENANTS:
+            vals = sorted(ms for tt, ms, _o in lats if tt == t)
+            out[t] = {"requests": len(vals),
+                      "p50_ms": round(percentile(vals, 0.50), 3),
+                      "p99_ms": round(percentile(vals, 0.99), 3)}
+        return out
+
+    warm_med = statistics.median(warm) if warm else None
+    cold_med = statistics.median(cold) if cold else None
+    speedup = (round(cold_med / warm_med, 1)
+               if warm_med and cold_med else None)
+    parsed = {
+        "backend": jax.default_backend(),
+        "measured": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                  time.gmtime()),
+        "note": ("result-cache soak (ISSUE 19): the identical "
+                 "zipf(1.1) repeated-traffic replay run twice — "
+                 "semantic result cache off, then on — over "
+                 f"{CACHE_SOAK_BATCHES} ingest epochs of the "
+                 "tpcds_q5_incremental stream.  Cache-off re-executes "
+                 "every repeat AND recomputes the whole q5 stream "
+                 "each epoch (O(total)); cache-on answers repeats "
+                 "from the semantic cache before admission and folds "
+                 "only the newly-arrived batch (O(new data)).  "
+                 "Closed-loop client submit-to-done walls, jit cache "
+                 "pre-warmed so neither run pays first-compile cost; "
+                 "walls move with the shared box's throttle phase — "
+                 "the warm/cold ratio and hit/fold counts are the "
+                 "stable signal (make cache-smoke gates >=10x + "
+                 "byte-identity every CI run)."),
+        "requests_per_run": total,
+        "ingest_batches": CACHE_SOAK_BATCHES,
+        "concurrency": 2,
+        "zipf_s": ZIPF_S,
+        "cache_off": {"wall_s": round(off_wall, 3),
+                      "qps": round(total / off_wall, 2),
+                      "p50_ms": round(percentile(cold, 0.50), 3),
+                      "p99_ms": round(percentile(cold, 0.99), 3),
+                      "tenants": per_tenant(off_lats)},
+        "cache_on": {"wall_s": round(on_wall, 3),
+                     "qps": round(total / on_wall, 2),
+                     "p50_ms": round(percentile(on_all, 0.50), 3),
+                     "p99_ms": round(percentile(on_all, 0.99), 3),
+                     "tenants": per_tenant(on_lats),
+                     "warm_hits": len(warm),
+                     "warm_p50_ms": round(percentile(warm, 0.50), 3)
+                     if warm else None,
+                     "hit_ratio": round(hit_ratio, 4),
+                     "incremental_folds": on_stats.get("folds", 0),
+                     "evictions": on_stats.get("evictions", 0)},
+        "warm_vs_cold_median_speedup": speedup,
+    }
+    tail = (f"serve-bench cache-soak: {total} req x2 runs, "
+            f"{CACHE_SOAK_BATCHES} ingest epochs; cache-off "
+            f"{parsed['cache_off']['qps']} q/s vs cache-on "
+            f"{parsed['cache_on']['qps']} q/s; warm median "
+            f"{parsed['cache_on']['warm_p50_ms']} ms vs cold "
+            f"{round(cold_med, 3) if cold_med else None} ms "
+            f"({speedup}x), hit ratio {round(hit_ratio, 3)}, "
+            f"{on_stats.get('folds', 0)} incremental folds")
+    artifact = {
+        "cmd": "python scripts/serve_bench.py --cache-soak",
+        "rc": 0,
+        "tail": tail,
+        "parsed": parsed,
+    }
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(tail)
+    print(f"serve-bench: wrote {out_path}")
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None,
@@ -231,6 +408,9 @@ def main() -> int:
     ap.add_argument("--ramp", default=None, metavar="QPS0:QPS1:STEPS",
                     help="paced-arrival sweep: offered QPS from QPS0 "
                          "to QPS1 over STEPS steps")
+    ap.add_argument("--cache-soak", action="store_true",
+                    help="cache-on vs cache-off repeated-traffic soak "
+                         "-> BENCH_serve_r03.json")
     args = ap.parse_args()
     try:
         ramp_steps = parse_ramp(args.ramp) if args.ramp else None
@@ -239,8 +419,12 @@ def main() -> int:
         return 2
     out_path = args.out or os.path.join(
         _REPO,
+        "BENCH_serve_r03.json" if args.cache_soak else
         "BENCH_serve_r02.json" if ramp_steps else
         "BENCH_serve_r01.json")
+
+    if args.cache_soak:
+        return run_cache_soak(args, out_path)
 
     from spark_rapids_tpu import models
     from spark_rapids_tpu import observability as obs

@@ -162,6 +162,17 @@ METRICS: Dict[str, Tuple[str, str]] = {
         "counter", "spill-store wall ns by stage and direction"),
     "srt_spill_corrupt_total": (
         "counter", "corrupt spill payloads on read-back by outcome"),
+    # -- ISSUE 19: semantic result/subplan cache --
+    "srt_result_cache_hits_total": (
+        "counter", "semantic-cache hits by scope and tenant"),
+    "srt_result_cache_misses_total": (
+        "counter", "semantic-cache misses by scope and tenant"),
+    "srt_result_cache_evictions_total": (
+        "counter", "semantic-cache LRU evictions by scope"),
+    "srt_result_cache_bytes_total": (
+        "counter", "payload bytes admitted into the cache by scope"),
+    "srt_result_cache_incremental_folds_total": (
+        "counter", "batches folded into resident partial states"),
 }
 
 # ----------------------------------------------------------------- knobs
@@ -327,6 +338,13 @@ KNOBS: Dict[str, str] = {
         "host-tier byte budget before spills demote to disk",
     "SPARK_RAPIDS_TPU_SPILL_PARTITIONS":
         "out-of-core hash partition count override (power of two)",
+    # -- ISSUE 19: semantic result/subplan cache --
+    "SPARK_RAPIDS_TPU_RESULT_CACHE":
+        "=1 arms the semantic result/subplan cache (off by default)",
+    "SPARK_RAPIDS_TPU_RESULT_CACHE_ENTRIES":
+        "result-cache entry budget",
+    "SPARK_RAPIDS_TPU_RESULT_CACHE_BYTES":
+        "result-cache payload byte budget",
 }
 
 # env families read with a COMPUTED suffix (pinned_path's

@@ -573,4 +573,14 @@ def read_table(path: str, columns: Optional[Sequence[str]] = None,
         _obs.record_io_file(path, columns=len(leaves),
                             pages=pages_total, rows=num_rows,
                             read_bytes=read_bytes, decode_ns=decode_ns)
+        # ingest-epoch door (ISSUE 19): a successful read notes the
+        # file with a size+mtime fingerprint — the result cache's
+        # epoch for this source bumps only when the bytes CHANGED, so
+        # re-reading an unchanged file keeps warm results warm
+        try:
+            from spark_rapids_tpu.perf.result_cache import note_ingest
+            st = os.stat(path)
+            note_ingest(path, f"{st.st_size}:{st.st_mtime_ns}")
+        except Exception:
+            pass   # epoch accounting must never fail a read
         return Table(out_cols, names=[lf.name for lf in leaves])

@@ -55,6 +55,19 @@ from spark_rapids_tpu import observability as _obs
 from spark_rapids_tpu.ops.device_join import inner_join_device
 
 
+def _note_gen(source: str, **args) -> None:
+    """Catalog data generators feed the result-cache ingest-epoch
+    registry (ISSUE 19): a regeneration with CHANGED arguments is new
+    data over that source (the epoch bumps and stale cached results
+    miss); an identical regeneration is not an ingest."""
+    try:
+        from spark_rapids_tpu.perf.result_cache import note_ingest
+        note_ingest(source, ",".join(
+            f"{k}={v}" for k, v in sorted(args.items())))
+    except Exception:
+        pass
+
+
 def _traced_query(name: str, fn):
     """Wrap a pipeline's jitted run fn in a query-root span AND the
     task-level retry driver: every eager op bracket, shuffle span, and
@@ -101,6 +114,8 @@ class Q5Data(NamedTuple):
 
 def gen_q5(rows: int = 50_000, stores: int = 32, days: int = 120,
            seed: int = 5) -> Q5Data:
+    _note_gen("tpcds:gen_q5", rows=rows, stores=stores, days=days,
+              seed=seed)
     rng = np.random.default_rng(seed)
     base = 11_000  # ~2000-02-14 in days-since-epoch
     win0 = base + 40
@@ -242,6 +257,7 @@ def oracle_q5(d: Q5Data, stores: int):
 
 
 def gen_q9(rows: int = 100_000, seed: int = 9):
+    _note_gen("tpcds:gen_q9", rows=rows, seed=seed)
     rng = np.random.default_rng(seed)
     return (jnp.asarray(rng.integers(1, 101, rows).astype(np.int32)),
             jnp.asarray(rng.integers(100, 30_000, rows)
@@ -334,6 +350,8 @@ class Q72Data(NamedTuple):
 def gen_q72(cs_rows: int = 30_000, inv_rows: int = 30_000,
             items: int = 512, days: int = 70, seed: int = 72
             ) -> Q72Data:
+    _note_gen("tpcds:gen_q72", cs_rows=cs_rows, inv_rows=inv_rows,
+              items=items, days=days, seed=seed)
     rng = np.random.default_rng(seed)
     base = 11_000
     return Q72Data(
@@ -521,6 +539,8 @@ class Q3Data(NamedTuple):
 
 def gen_q3(rows: int = 50_000, items: int = 256, days: int = 730,
            brands: int = 32, seed: int = 3) -> Q3Data:
+    _note_gen("tpcds:gen_q3", rows=rows, items=items, days=days,
+              brands=brands, seed=seed)
     rng = np.random.default_rng(seed)
     base = 10_957  # 2000-01-01
     day_idx = np.arange(days)

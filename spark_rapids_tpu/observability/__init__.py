@@ -225,6 +225,29 @@ JIT_COMPILE_TIME = METRICS.histogram(
     "Kernel lower+compile wall time on compile-cache misses",
     labels=("kernel",), buckets=DEFAULT_LATENCY_BUCKETS_NS,
     max_series=128)
+RESULT_CACHE_HITS = METRICS.counter(
+    "srt_result_cache_hits_total",
+    "Semantic result/subplan cache hits (perf/result_cache.py) by "
+    "scope (result/stage/subplan) and tenant (result scope only)",
+    labels=("scope", "tenant"), max_series=256)
+RESULT_CACHE_MISSES = METRICS.counter(
+    "srt_result_cache_misses_total",
+    "Semantic result/subplan cache misses by scope and tenant",
+    labels=("scope", "tenant"), max_series=256)
+RESULT_CACHE_EVICTIONS = METRICS.counter(
+    "srt_result_cache_evictions_total",
+    "Result-cache LRU evictions (entry/byte budget; SpillStore "
+    "pressure demotions are spill metrics, not evictions)",
+    labels=("scope",))
+RESULT_CACHE_BYTES = METRICS.counter(
+    "srt_result_cache_bytes_total",
+    "Payload bytes admitted into the result cache by scope",
+    labels=("scope",))
+RESULT_CACHE_FOLDS = METRICS.counter(
+    "srt_result_cache_incremental_folds_total",
+    "Arriving batches folded into resident partial-aggregate states "
+    "(the O(delta) increments) by query", labels=("query",),
+    max_series=128)
 KERNEL_PATH = METRICS.counter(
     "srt_kernel_path_total",
     "Executions per op by the kernel path actually taken "
@@ -511,6 +534,36 @@ def disable_profiling() -> None:
 
 def is_profiling_enabled() -> bool:
     return PROFILER.enabled
+
+
+def cache_hit_profile(tenant: str, query: str, query_id: str,
+                      lookup_ns: int) -> Optional[dict]:
+    """Assemble + retain the profile artifact for a warm result-cache
+    hit (ISSUE 19).  A hit never executes, so there is no session to
+    fold — the artifact is the lookup itself: wall == cache.lookup_ns,
+    no stages, a ``cache`` section with the one hit.  Returns None
+    when profiling is off."""
+    if not PROFILER.enabled:
+        return None
+    from spark_rapids_tpu.observability.profile import PROFILE_VERSION
+    profile = {
+        "profile_version": PROFILE_VERSION,
+        "query_id": query_id,
+        "tenant": tenant,
+        "query": query,
+        "rank": 0,
+        "world": 1,
+        "trace_id": None,
+        "t_unix_ms": int(time.time() * 1000),
+        "wall_ns": int(lookup_ns),
+        "queue_wait_ns": 0,
+        "stages": [],
+        "hot_stage": None,
+        "cache": {"hits": 1, "misses": 0, "puts": 0, "evictions": 0,
+                  "folds": 0, "lookup_ns": int(lookup_ns),
+                  "bytes": 0},
+    }
+    return PROFILER.note_external(profile)
 
 
 # ----------------------------------------------------- time attribution
@@ -1148,6 +1201,31 @@ def record_jit_cache(event: str, kernel: str, *,
         JIT_COMPILE_TIME.observe(compile_ns, labels=(kernel,))
     elif event == "eviction":
         JIT_CACHE_EVICTIONS.inc(labels=(kernel,))
+
+
+def record_result_cache(event: str, scope: str, *, tenant: str = "",
+                        query: str = "", nbytes: int = 0,
+                        ns: int = 0) -> None:
+    """Semantic-cache hook (perf/result_cache.py): event in
+    {'hit', 'miss', 'eviction', 'put', 'fold'}.  Result-scope events
+    carry the tenant (per-tenant hit attribution); folds carry the
+    query whose resident state absorbed an arriving batch."""
+    if not _SWITCH.enabled:
+        return
+    tn = tenant or "-"
+    if event == "hit":
+        RESULT_CACHE_HITS.inc(labels=(scope, tn))
+    elif event == "miss":
+        RESULT_CACHE_MISSES.inc(labels=(scope, tn))
+    elif event == "eviction":
+        RESULT_CACHE_EVICTIONS.inc(labels=(scope,))
+    elif event == "put":
+        RESULT_CACHE_BYTES.inc(nbytes, labels=(scope,))
+    elif event == "fold":
+        RESULT_CACHE_FOLDS.inc(labels=(query or "-",))
+    JOURNAL.emit("result_cache", event=event, scope=scope, tenant=tn,
+                 query=query, bytes=nbytes, ns=ns,
+                 thread=threading.get_ident())
 
 
 def record_kernel_path(op: str, path: str, rows: int = 0) -> None:

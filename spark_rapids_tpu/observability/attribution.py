@@ -24,6 +24,11 @@ exhaustive, non-overlapping bucket set:
   spill_wait        synchronous tiered-store work on this thread:
                     ensure_headroom victim spills + restore round
                     trips (memory/spill.py)
+  cache_lookup      semantic result/subplan cache consults
+                    (perf/result_cache.py): a warm hit's whole wall
+                    IS this bucket; stage/subplan consults happen
+                    outside the timed stage walls, so the bucket is
+                    counted directly, never carved from compute
   oom_blocked       BUFN time (``thread_unblocked`` blocked_ns)
   retry_lost        failed retry attempts' wall (episodes' lost_ns)
   other             the residual — reported, never silently dropped
@@ -66,6 +71,7 @@ BUCKETS = (
     "shuffle_wait",
     "speculation_wait",
     "spill_wait",
+    "cache_lookup",
     "oom_blocked",
     "retry_lost",
     "other",
@@ -80,6 +86,7 @@ OVERHEAD_BUCKETS = (
     "shuffle_wait",
     "speculation_wait",
     "spill_wait",
+    "cache_lookup",
     "oom_blocked",
     "retry_lost",
 )
@@ -99,6 +106,11 @@ def _stage_split(stages: List[dict]) -> Dict[str, int]:
     fused = 0
     unfused = 0
     for s in stages or ():
+        if str(s.get("engine", "")) == "cached":
+            # a cache-hit stage's "wall" is its lookup, already owned
+            # by the cache_lookup bucket — counting it here would
+            # double-attribute those nanoseconds
+            continue
         wall = int(s.get("wall_ns", 0))
         c = min(int(s.get("compile_ns", 0)), wall)
         compile_ns += c
@@ -159,6 +171,12 @@ def attribute_profile(profile: dict, *,
     oom_blocked = int((profile.get("oom") or {}).get("blocked_ns", 0))
     retry_lost = int((profile.get("retries") or {}).get("lost_ns", 0))
     spill_wait = int((profile.get("spill") or {}).get("wait_ns", 0))
+    # cache consults run OUTSIDE the timed stage walls (and a warm
+    # hit has no stages at all), so the bucket counts directly —
+    # carving it from compute would break conservation exactly on the
+    # warm-hit profiles it exists to explain
+    buckets["cache_lookup"] = int(
+        (profile.get("cache") or {}).get("lookup_ns", 0))
     # blocked/lost/spill time happened inside stage walls on this
     # thread: carve it out of compute so the buckets stay
     # non-overlapping

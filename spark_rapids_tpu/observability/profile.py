@@ -68,7 +68,7 @@ _THREAD_KINDS = ("retry_episode", "kernel_path", "oom_retry",
                  "oom_split_retry", "thread_unblocked",
                  "shuffle_wire", "shuffle_wait",
                  "spill", "spill_restore", "spill_wait",
-                 "spill_corrupt")
+                 "spill_corrupt", "result_cache")
 
 # the TaskMetricsTable's shared fallback row (threads with no RmmSpark
 # binding).  It is process-wide, so its deltas are only trustworthy
@@ -316,6 +316,25 @@ class QueryProfiler:
                 pass
         return profile
 
+    def note_external(self, profile: dict) -> Optional[dict]:
+        """Retain an externally-assembled profile (a warm cache hit
+        never opens a session — there is no execution to observe —
+        but its artifact must still land in the last-K ring and fire
+        the profile-end hook so attribution and retention see it)."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            if self._keep > 0:
+                self._retained.append(profile)
+            self._assembled += 1
+        hook = self.on_profile
+        if hook is not None:
+            try:
+                hook(profile, 0)
+            except Exception:
+                pass
+        return profile
+
     # -------------------------------------------------------- assembly
 
     def _assemble(self, sess: ProfileSession, t_end_ns: int) -> dict:
@@ -372,7 +391,8 @@ class QueryProfiler:
     def _fold_journal(self, sess: ProfileSession) -> dict:
         if self.journal is None:
             return {"retries": {}, "oom": {}, "kernel_paths": {},
-                    "events": {}, "shuffle": {}, "spill": {}}
+                    "events": {}, "shuffle": {}, "spill": {},
+                    "cache": {}}
         window = [r for r in self.journal.records()
                   if r.get("seq", 0) > sess.seq0]
         tasks = set(sess.task_ids)
@@ -391,6 +411,8 @@ class QueryProfiler:
         shuffle = {"wire_ns": 0, "wait_ns": 0, "spec_wait_ns": 0}
         spill = {"bytes": 0, "spills": 0, "restores": 0, "ns": 0,
                  "wait_ns": 0, "corrupt": 0, "tiers": {}}
+        cache = {"hits": 0, "misses": 0, "puts": 0, "evictions": 0,
+                 "folds": 0, "lookup_ns": 0, "bytes": 0}
         kernel_paths: Dict[str, int] = {}
         events: Dict[str, int] = {}
         for r in window:
@@ -438,9 +460,24 @@ class QueryProfiler:
                 spill["wait_ns"] += int(r.get("ns", 0))
             elif kind == "spill_corrupt":
                 spill["corrupt"] += 1
+            elif kind == "result_cache":
+                ev = str(r.get("event", "?"))
+                if ev == "hit":
+                    cache["hits"] += 1
+                    cache["lookup_ns"] += int(r.get("ns", 0))
+                elif ev == "miss":
+                    cache["misses"] += 1
+                    cache["lookup_ns"] += int(r.get("ns", 0))
+                elif ev == "put":
+                    cache["puts"] += 1
+                    cache["bytes"] += int(r.get("bytes", 0))
+                elif ev == "eviction":
+                    cache["evictions"] += 1
+                elif ev == "fold":
+                    cache["folds"] += 1
         return {"retries": retries, "oom": oom, "shuffle": shuffle,
                 "spill": spill, "kernel_paths": kernel_paths,
-                "events": events}
+                "events": events, "cache": cache}
 
     def _fold_tasks(self, sess: ProfileSession) -> dict:
         """Per-task metric deltas for the session's RmmSpark-bound
@@ -676,6 +713,8 @@ def merge_profiles(profiles: List[dict]) -> dict:
                     _sum_field("shuffle").items()},
         "spill": {k: int(v) for k, v in
                   _sum_field("spill").items()},
+        "cache": {k: int(v) for k, v in
+                  _sum_field("cache").items()},
         "kernel_paths": {k: int(v) for k, v in
                          _sum_field("kernel_paths").items()},
     }

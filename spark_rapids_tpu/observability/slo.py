@@ -58,9 +58,12 @@ DEFAULT_BURN_THRESHOLD = 4.0
 # outcomes that do not consume error budget: the tenant asked for the
 # cancel, and a shed/rejected query never ran — admission-control
 # pushback is reported by the server stats, not double-counted as an
-# SLO miss (deadline/failed/hung DO burn budget)
+# SLO miss (deadline/failed/hung DO burn budget).  cache_hit is
+# neutral in BOTH directions: a free warm answer must not count as a
+# latency win either, or a cache-heavy replay would mask a burning
+# tenant (ISSUE 19)
 _NEUTRAL_OUTCOMES = frozenset({"cancelled", "rejected", "shed",
-                               "requeued", "admitted"})
+                               "requeued", "admitted", "cache_hit"})
 
 
 @dataclass(frozen=True)

@@ -700,6 +700,14 @@ class CompiledPipeline:
         self.stages = [compile_stage(s) for s in pipeline.stages]
 
     def run(self, inputs: Mapping[str, Sequence]) -> tuple:
+        # semantic stage cache (ISSUE 19): with the result cache
+        # armed, each stage consults a content-keyed entry (plan
+        # digest + input bytes) before executing — an unchanged
+        # upstream stage short-circuits and only the delta recomputes
+        cache = None
+        from spark_rapids_tpu.perf import result_cache as _rc
+        if _rc.cache_enabled():
+            cache = _rc.CACHE
         feed: Dict[str, object] = {}
         out: Tuple = ()
         for cs in self.stages:
@@ -710,7 +718,10 @@ class CompiledPipeline:
                         feed[c.name] for c in inp.columns)
                 else:
                     stage_inputs[inp.name] = inputs[inp.name]
-            out = cs.run(stage_inputs)
+            if cache is not None:
+                out = cache.stage_run(cs, stage_inputs)
+            else:
+                out = cs.run(stage_inputs)
             feed.update(zip(cs.plan.outputs, out))
         return out
 

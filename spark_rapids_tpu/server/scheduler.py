@@ -58,6 +58,9 @@ class Job:
     error: Optional[dict] = None
     wait_ns: int = 0
     dur_ns: int = 0
+    outcome: Optional[str] = None  # terminal verdict (finalize stamps
+    #                                it; cache_hit is DISTINCT from a
+    #                                zero-duration success, ISSUE 19)
     # lifeguard fields (ISSUE 7)
     deadline_ns: Optional[int] = None   # absolute monotonic deadline
     signature: Optional[str] = None     # quarantine identity
@@ -76,6 +79,8 @@ class Job:
                "query": self.query, "state": self.state,
                "demotions": self.demotions, "wait_ns": self.wait_ns,
                "dur_ns": self.dur_ns}
+        if self.outcome is not None:
+            out["outcome"] = self.outcome
         if self.state == STATE_DONE:
             out["result"] = self.result
         if self.error is not None:

@@ -66,6 +66,7 @@ from spark_rapids_tpu.models import (QueryCancelled, QueryContext,
                                      QueryDeadlineExceeded,
                                      UnknownQueryError, has_query,
                                      run_catalog_query)
+from spark_rapids_tpu.perf import result_cache as _result_cache
 from spark_rapids_tpu.robustness import lifeguard
 from spark_rapids_tpu.robustness.retry import RetryExhausted
 from spark_rapids_tpu.server.admission import (REASON_DRAINING,
@@ -333,6 +334,48 @@ class QueryServer:
                 _obs.record_server_quarantine(
                     "probe", tenant, str(query), sig,
                     strikes=verdict.get("strikes", 0))
+        # semantic result cache (ISSUE 19): a warm hit answers BEFORE
+        # admission — no pool slot, no queue, no scheduler charge —
+        # with the DISTINCT cache_hit outcome (SLO-neutral; a free
+        # answer is not a latency win).  The lookup itself runs
+        # outside the server lock; only job registration + finalize
+        # go under it.
+        if self._runner is run_catalog_query \
+                and _result_cache.cache_enabled():
+            cached, lookup_ns = _result_cache.CACHE.lookup_result(
+                tenant, str(query), params)
+            if cached is not None:
+                warm = None
+                with self._work:
+                    if self._started and not self._stopping \
+                            and not self._draining:
+                        task_id = next(self._task_ids)
+                        warm = Job(
+                            query_id=f"q-{next(self._qid):06d}",
+                            tenant=tenant, query=str(query),
+                            params=dict(params or {}),
+                            seq=next(self._seq), task_id=task_id,
+                            priority=task_priority
+                            .get_task_priority(task_id),
+                            submit_ns=time.monotonic_ns(),
+                            deadline_ns=deadline_ns, signature=sig,
+                            probe=bool(probe))
+                        warm.dur_ns = lookup_ns
+                        self._jobs[warm.query_id] = warm
+                        self._finalize_locked(warm, STATE_DONE,
+                                              outcome="cache_hit",
+                                              result=cached)
+                if warm is not None:
+                    # the profile artifact is assembled OUTSIDE the
+                    # lock (retention takes self._lock itself)
+                    prof = _obs.cache_hit_profile(
+                        tenant, str(query), warm.query_id, lookup_ns)
+                    if prof is not None:
+                        self._retain_profile(tenant, warm.query_id,
+                                             prof)
+                    return warm.query_id
+                # draining/stopped: fall through to the admission
+                # path below, which raises the typed backpressure
         try:
             # the memory-ledger fold (adaptor lock, O(live tasks))
             # runs BEFORE the server lock is taken — _task_tenant is
@@ -670,6 +713,21 @@ class QueryServer:
         # "completed normally" journal event over the force-release
         if not job.hung:
             self._release_rmm_task(job)
+        # cold-path fill (ISSUE 19): a successful catalog result goes
+        # into the semantic cache BEFORE finalize sets done_event — a
+        # waiter that resubmits the instant poll() returns must find
+        # the entry warm.  Runners are pure functions of their
+        # binding, so the entry stays valid even if the racing-cancel
+        # recheck inside finalize discards THIS job's answer
+        if state == STATE_DONE and result is not None \
+                and not job.hung \
+                and self._runner is run_catalog_query \
+                and _result_cache.cache_enabled():
+            try:
+                _result_cache.CACHE.store_result(
+                    job.tenant, job.query, job.params, result)
+            except Exception:
+                pass   # caching is best-effort, never a failure path
         with self._work:
             self._finalize_locked(job, state, outcome=outcome,
                                   result=result, error=error,
@@ -1105,6 +1163,7 @@ class QueryServer:
         job.state = state
         job.result = result
         job.error = error
+        job.outcome = outcome
         self._task_tenant.pop(job.task_id, None)
         task_priority.task_done(job.task_id)
         self._stat(job.tenant, outcome)
@@ -1194,7 +1253,7 @@ class QueryServer:
         row = self._tenant_stats.setdefault(tenant, {
             "admitted": 0, "rejected": 0, "requeued": 0, "success": 0,
             "failed": 0, "cancelled": 0, "shed": 0, "hung": 0,
-            "deadline": 0})
+            "deadline": 0, "cache_hit": 0})
         row[key] = row.get(key, 0) + 1
 
     def _bytes_tracked(self, tenant: str) -> bool:

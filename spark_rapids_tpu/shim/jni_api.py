@@ -132,7 +132,17 @@ def arrow_ingest(batch) -> List[int]:
     with op_range("arrow_ingest"):
         from spark_rapids_tpu.io.arrow_cabi import ingest
         cols, _names = ingest(batch)
-        return [REGISTRY.register(c) for c in cols]
+        handles = [REGISTRY.register(c) for c in cols]
+        # ingest-epoch door (ISSUE 19): an Arrow batch has no stable
+        # file identity, so every hand-off is new data — results
+        # keyed over the "arrow" source go stale unconditionally
+        try:
+            from spark_rapids_tpu.perf.result_cache import \
+                bump_ingest_epoch
+            bump_ingest_epoch("arrow")
+        except Exception:
+            pass
+        return handles
 
 
 def parquet_read_table(path: str, columns=None,
@@ -517,6 +527,37 @@ def jit_cache_clear(reset_stats: bool = False) -> int:
     ``reset_stats`` additionally zeroes the cumulative counters."""
     from spark_rapids_tpu.perf import jit_cache
     return jit_cache.CACHE.clear(reset_stats=bool(reset_stats))
+
+
+# -------------------------------------------------------- result cache
+# (semantic result/subplan cache control surface, ISSUE 19: the JVM
+# polls hit rates, clears around catalog reloads, and bumps a source's
+# ingest epoch when ITS ingest path — not ours — landed new data)
+
+
+def result_cache_stats() -> str:
+    """JSON stats of the semantic result/subplan cache
+    (perf/result_cache): entries/bytes, hit/miss/eviction/put/fold
+    totals, per-scope entry counts."""
+    import json
+
+    from spark_rapids_tpu.perf import result_cache
+    return json.dumps(result_cache.CACHE.stats(), sort_keys=True)
+
+
+def result_cache_clear(reset_stats: bool = False) -> int:
+    """Drop every cached result/subplan entry; returns the number
+    dropped.  ``reset_stats`` additionally zeroes the counters."""
+    from spark_rapids_tpu.perf import result_cache
+    return result_cache.CACHE.clear(reset_stats=bool(reset_stats))
+
+
+def result_cache_bump_epoch(source: str) -> int:
+    """Advance ``source``'s ingest epoch (externally-landed data):
+    every cached result keyed over it goes stale; returns the new
+    epoch."""
+    from spark_rapids_tpu.perf import result_cache
+    return result_cache.bump_ingest_epoch(str(source))
 
 
 # --------------------------------------------------------- query server

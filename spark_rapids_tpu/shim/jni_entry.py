@@ -909,6 +909,21 @@ def jit_cache_clear(reset_stats: bool = False) -> int:
     return jni_api.jit_cache_clear(bool(reset_stats))
 
 
+def result_cache_stats() -> str:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.result_cache_stats()
+
+
+def result_cache_clear(reset_stats: bool = False) -> int:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.result_cache_clear(bool(reset_stats))
+
+
+def result_cache_bump_epoch(source: str) -> int:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.result_cache_bump_epoch(str(source))
+
+
 def kudo_set_crc_enabled(enabled: bool) -> bool:
     from spark_rapids_tpu.shim import jni_api
     return jni_api.kudo_set_crc_enabled(bool(enabled))

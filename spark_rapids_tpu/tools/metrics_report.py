@@ -261,6 +261,78 @@ def render_jit_cache_table(registry: Optional[dict]) -> List[str]:
     return out
 
 
+def result_cache_rows(registry: Optional[dict]) -> List[dict]:
+    """Per-(scope, tenant) semantic-cache counters
+    (srt_result_cache_*) from a registry snapshot, busiest row first,
+    with a derived hit rate.  Result-scope rows carry real tenants
+    (the per-tenant warm-hit attribution the soak gate reads);
+    stage/subplan rows aggregate under '-'."""
+    agg: Dict[tuple, dict] = {}
+    for metric, field in (("srt_result_cache_hits_total", "hits"),
+                          ("srt_result_cache_misses_total", "misses")):
+        fam = (registry or {}).get(metric)
+        if not fam:
+            continue
+        for s in fam.get("series", []):
+            labels = s.get("labels") or ("?", "?")
+            scope = labels[0] if len(labels) > 0 else "?"
+            tenant = labels[1] if len(labels) > 1 else "-"
+            a = agg.setdefault((scope, tenant),
+                               {"scope": scope, "tenant": tenant,
+                                "hits": 0, "misses": 0})
+            a[field] = int(s.get("value", 0))
+    rows = []
+    for a in agg.values():
+        total = a["hits"] + a["misses"]
+        a["hit_rate"] = a["hits"] / total if total else 0.0
+        rows.append(a)
+    rows.sort(key=lambda a: -(a["hits"] + a["misses"]))
+    # cache-wide totals ride along so --json consumers see folds and
+    # evictions without re-deriving them from other families
+    folds = sum(int(s.get("value", 0)) for s in
+                ((registry or {}).get(
+                    "srt_result_cache_incremental_folds_total")
+                 or {}).get("series", []))
+    evictions = sum(int(s.get("value", 0)) for s in
+                    ((registry or {}).get(
+                        "srt_result_cache_evictions_total")
+                     or {}).get("series", []))
+    if rows or folds or evictions:
+        rows.append({"scope": "(total)", "tenant": "-",
+                     "hits": sum(r["hits"] for r in rows),
+                     "misses": sum(r["misses"] for r in rows),
+                     "hit_rate": 0.0, "folds": folds,
+                     "evictions": evictions})
+        t = rows[-1]
+        tot = t["hits"] + t["misses"]
+        t["hit_rate"] = t["hits"] / tot if tot else 0.0
+    return rows
+
+
+def render_result_cache_table(registry: Optional[dict]) -> List[str]:
+    """Semantic result/subplan cache summary: per-tenant warm-hit
+    rates plus the incremental-fold and eviction totals."""
+    rows = result_cache_rows(registry)
+    out = ["", "result cache (srt_result_cache_*)", ""]
+    if not rows:
+        out.append("(no result-cache activity recorded)")
+        return out
+    w = max(len(f"{r['scope']}/{r['tenant']}") for r in rows)
+    hdr = (f"{'scope/tenant':<{w}}  {'hits':>7}  {'misses':>7}  "
+           f"{'hit_rate':>8}")
+    out.append(hdr)
+    out.append("-" * len(hdr))
+    for r in rows:
+        name = f"{r['scope']}/{r['tenant']}"
+        out.append(f"{name:<{w}}  {r['hits']:>7}  {r['misses']:>7}  "
+                   f"{r['hit_rate']:>8.2f}")
+    total = rows[-1]
+    if "folds" in total:
+        out.append(f"incremental folds: {total['folds']}  "
+                   f"evictions: {total['evictions']}")
+    return out
+
+
 def kernel_path_rows(registry: Optional[dict]) -> List[dict]:
     """Per-op execution counts by the kernel path actually taken
     (srt_kernel_path_total) — the calibrated join/JSON routing
@@ -850,6 +922,7 @@ def build_report(records: List[dict]) -> dict:
         "histograms": histogram_rows(registry),
         "retry_episodes": retry_episode_rows(events),
         "jit_cache": jit_cache_rows(registry),
+        "cache": result_cache_rows(registry),
         "kernel_paths": kernel_path_rows(registry),
         "stages": stage_rows(events),
         "server": server_rows(events, registry),
@@ -908,6 +981,10 @@ def main(argv=None) -> int:
         lines += render_slo_table(slo)
     if registry is not None:
         lines += render_jit_cache_table(registry)
+        if (registry or {}).get("srt_result_cache_hits_total") \
+                or (registry or {}).get(
+                    "srt_result_cache_misses_total"):
+            lines += render_result_cache_table(registry)
         if (registry or {}).get("srt_kernel_path_total"):
             lines += render_kernel_path_table(registry)
         lines += render_histogram_table(registry)

@@ -255,6 +255,13 @@ def render_profile(profile: dict, *, nodes: bool = False
         out.append("jit cache: " + "  ".join(
             f"{k}(hits={d.get('hits', 0)},misses={d.get('misses', 0)})"
             for k, d in sorted(jit.items())))
+    rc = profile.get("cache") or {}
+    if any(rc.get(k) for k in ("hits", "misses", "puts", "folds")):
+        out.append(
+            f"result cache: hits={rc.get('hits', 0)} "
+            f"misses={rc.get('misses', 0)} puts={rc.get('puts', 0)} "
+            f"folds={rc.get('folds', 0)} "
+            f"lookup={_ms(rc.get('lookup_ns'))}ms")
     spans = profile.get("spans") or {}
     if spans.get("count"):
         kinds = " ".join(f"{k}={v}" for k, v in

@@ -1,0 +1,297 @@
+"""Semantic result/subplan cache suite (ISSUE 19): warm/cold byte
+identity through the server, incremental-fold vs full-recompute
+differentials for q5/q72, eviction-then-disk-restore round trips,
+cache-before-queries eviction priority, the cross-tenant safety gate,
+SLO neutrality of free answers, and warm-hit attribution
+conservation."""
+
+import json
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import models
+from spark_rapids_tpu import observability as obs
+from spark_rapids_tpu.memory import spill as spill_mod
+from spark_rapids_tpu.observability import attribution
+from spark_rapids_tpu.observability import slo as slo_mod
+from spark_rapids_tpu.perf import result_cache as rc
+from spark_rapids_tpu.server import QueryServer, ServerConfig
+
+
+@pytest.fixture(autouse=True)
+def _armed_cache(monkeypatch):
+    """Every test runs with the cache armed and a clean slate; the
+    module-level epoch registry and singleton survive across tests
+    otherwise."""
+    monkeypatch.setenv("SPARK_RAPIDS_TPU_RESULT_CACHE", "1")
+    rc.CACHE.clear(reset_stats=True)
+    rc.reset_ingest_epochs()
+    yield
+    rc.CACHE.clear(reset_stats=True)
+    rc.reset_ingest_epochs()
+
+
+def canon(value) -> bytes:
+    return json.dumps(value, sort_keys=True, default=str).encode()
+
+
+# ---------------------------------------------------- epoch registry
+
+
+def test_ingest_epoch_fingerprint_semantics():
+    src = "t_epoch_src"
+    assert rc.ingest_epoch(src) == 0
+    assert rc.note_ingest(src, "a") == 1      # first sighting bumps
+    assert rc.note_ingest(src, "a") == 1      # unchanged fp: no bump
+    assert rc.note_ingest(src, "b") == 2      # changed fp bumps
+    assert rc.note_ingest(src) == 3           # no fp: always bumps
+    assert rc.bump_ingest_epoch(src) == 4
+    rc.reset_ingest_epochs()
+    assert rc.ingest_epoch(src) == 0
+
+
+def test_epoch_bump_invalidates_result_key():
+    src = "t_epoch_inval"
+    rc.register_cache_spec("q_epoch", shared=True, sources=(src,))
+    try:
+        rc.CACHE.store_result("a", "q_epoch", {"x": 1}, [1, 2, 3])
+        got, _ns = rc.CACHE.lookup_result("a", "q_epoch", {"x": 1})
+        assert got == [1, 2, 3]
+        rc.bump_ingest_epoch(src)
+        got, _ns = rc.CACHE.lookup_result("a", "q_epoch", {"x": 1})
+        assert got is None                    # stale epoch: miss
+    finally:
+        rc.unregister_cache_spec("q_epoch")
+
+
+# ------------------------------------------- warm/cold byte identity
+
+
+def test_server_warm_hit_byte_identical_and_counted():
+    server = QueryServer(ServerConfig(
+        max_concurrency=2, stall_ms=0)).start()
+    try:
+        p = {"rows": 512, "seed": 19}
+        cold_id = server.submit("alpha", "tpcds_q3", dict(p))
+        cold = server.poll(cold_id, timeout_s=120)
+        assert cold["state"] == "done"
+        assert cold.get("outcome") != "cache_hit"
+
+        warm_id = server.submit("alpha", "tpcds_q3", dict(p))
+        warm = server.poll(warm_id, timeout_s=120)
+        assert warm["state"] == "done"
+        assert warm.get("outcome") == "cache_hit"
+        assert canon(warm["result"]) == canon(cold["result"])
+
+        # shared spec: another tenant gets the same shared entry
+        other_id = server.submit("bravo", "tpcds_q3", dict(p))
+        other = server.poll(other_id, timeout_s=120)
+        assert other.get("outcome") == "cache_hit"
+        assert canon(other["result"]) == canon(cold["result"])
+
+        # a different binding misses
+        miss_id = server.submit("alpha", "tpcds_q3",
+                                {"rows": 512, "seed": 20})
+        miss = server.poll(miss_id, timeout_s=120)
+        assert miss["state"] == "done"
+        assert miss.get("outcome") != "cache_hit"
+
+        stats = server.stats()
+        assert stats["tenants"]["alpha"]["cache_hit"] == 1
+        assert stats["tenants"]["bravo"]["cache_hit"] == 1
+    finally:
+        server.stop()
+
+
+# --------------------------------- incremental vs full recompute
+
+
+def _differential_incremental(query, params, source, monkeypatch,
+                              epochs=10):
+    """Run ``query`` incrementally across ``epochs`` ingest batches
+    and, at every epoch, compare against a cache-off full recompute
+    over the same batches."""
+    folds_before = rc.CACHE.stats()["folds"]
+    for e in range(epochs):
+        if e:
+            rc.bump_ingest_epoch(source)
+        inc = models.run_catalog_query(query, dict(params))
+        monkeypatch.setenv("SPARK_RAPIDS_TPU_RESULT_CACHE", "0")
+        try:
+            full = models.run_catalog_query(query, dict(params))
+        finally:
+            monkeypatch.setenv("SPARK_RAPIDS_TPU_RESULT_CACHE", "1")
+        assert canon(inc) == canon(full), f"diverged at epoch {e}"
+    # each new epoch folded exactly one delta batch into the state
+    assert rc.CACHE.stats()["folds"] - folds_before == epochs - 1
+
+
+def test_q5_incremental_matches_full_recompute(monkeypatch):
+    src = "t_q5_diff_stream"
+    _differential_incremental(
+        "tpcds_q5_incremental",
+        {"rows": 256, "stores": 8, "seed": 5, "source": src},
+        src, monkeypatch)
+
+
+def test_q72_incremental_matches_full_recompute(monkeypatch):
+    src = "t_q72_diff_stream"
+    _differential_incremental(
+        "tpcds_q72_incremental",
+        {"rows": 256, "items": 32, "max_week": 8, "seed": 72,
+         "source": src},
+        src, monkeypatch)
+
+
+# ------------------------------------- spill-store residency
+
+
+def test_eviction_to_disk_restores_byte_identical():
+    """A cache payload demoted device->host->disk restores bit-exact,
+    including a BOOL8-backed bool array (whose dtype does not survive
+    a Column round trip on its own)."""
+    tmp = tempfile.mkdtemp(prefix="rc_disk_")
+    store = spill_mod.install(spill_mod.SpillStore(
+        spill_dir=tmp, host_limit_bytes=0))
+    try:
+        arrays = [np.arange(64, dtype=np.int64),
+                  np.array([True, False, True]),
+                  np.linspace(0.0, 1.0, 17)]
+        key = ("t_disk", 1)
+        rc.CACHE.put_subplan(key, arrays, {"upto": 3})
+        # host_limit 0: ensure_headroom sends the payload straight
+        # to the disk tier
+        assert store.ensure_headroom(1 << 30) > 0
+        assert store.stats()["spills_disk"] >= 1
+        got = rc.CACHE.get_subplan(key)
+        assert got is not None
+        meta, back = got
+        assert meta["upto"] == 3
+        for a, b in zip(arrays, back):
+            assert a.dtype == b.dtype
+            assert a.shape == b.shape
+            assert a.tobytes() == b.tobytes()
+    finally:
+        spill_mod.uninstall()
+        store.close()
+
+
+def test_pressure_evicts_cache_before_query_batches():
+    """The ledger-asserted acceptance: under headroom pressure the
+    priority-0 cache resident is victimized while a live task's batch
+    stays on device."""
+    from spark_rapids_tpu.columns.column import Column
+
+    tmp = tempfile.mkdtemp(prefix="rc_prio_")
+    store = spill_mod.install(spill_mod.SpillStore(spill_dir=tmp))
+    try:
+        query_h = store.register(
+            [Column.from_numpy(np.arange(256, dtype=np.int64))],
+            device_bytes=2048, name="query_batch", task_id=7,
+            stage="q5_join")
+        key = ("t_prio", 1)
+        rc.CACHE.put_subplan(key, [np.arange(256, dtype=np.int64)],
+                             {})
+        cache_h = rc.CACHE._entries[
+            (rc.SCOPE_SUBPLAN,) + key].handle
+        assert cache_h is not None
+        assert cache_h.priority == rc.CACHE_PRIORITY == 0
+        assert cache_h.priority < query_h.priority
+
+        # ask for exactly the cache payload's worth of headroom
+        freed = store.ensure_headroom(cache_h.device_bytes)
+        assert freed >= cache_h.device_bytes
+        assert cache_h.tier != spill_mod.TIER_DEVICE
+        assert query_h.tier == spill_mod.TIER_DEVICE
+
+        # second life: the demoted entry still serves, byte-identical
+        got = rc.CACHE.get_subplan(key)
+        assert got is not None
+        assert got[1][0].tobytes() == \
+            np.arange(256, dtype=np.int64).tobytes()
+        query_h.close()
+    finally:
+        spill_mod.uninstall()
+        store.close()
+
+
+# --------------------------------------- cross-tenant safety gate
+
+
+def test_private_binding_never_serves_another_tenant():
+    rc.register_cache_spec("q_private", shared=False)
+    try:
+        rc.CACHE.store_result("alice", "q_private", {"k": 1},
+                              ["alice-secret"])
+        got, _ns = rc.CACHE.lookup_result("bob", "q_private", {"k": 1})
+        assert got is None
+        got, _ns = rc.CACHE.lookup_result("alice", "q_private",
+                                          {"k": 1})
+        assert got == ["alice-secret"]
+    finally:
+        rc.unregister_cache_spec("q_private")
+
+
+def test_unregistered_query_is_uncacheable():
+    assert rc.cache_spec("no_such_query") is None
+    rc.CACHE.store_result("a", "no_such_query", {}, [1])
+    got, _ns = rc.CACHE.lookup_result("a", "no_such_query", {})
+    assert got is None
+    # the _file queries are deliberately unregistered: their inputs
+    # live outside the binding, so a digest match proves nothing
+    assert rc.cache_spec("tpcds_q7_file") is None
+
+
+def test_stage_scope_keys_by_content_digest():
+    a = [np.arange(8, dtype=np.int64)]
+    b = [np.arange(8, dtype=np.int64) + 1]       # same shape/dtype
+    assert rc.data_digest(a) != rc.data_digest(b)
+    assert rc.data_digest(a) == rc.data_digest(
+        [np.arange(8, dtype=np.int64)])
+
+
+# --------------------------------------------- SLO neutrality
+
+
+def test_cache_hit_is_slo_neutral():
+    assert "cache_hit" in slo_mod._NEUTRAL_OUTCOMES
+    mon = slo_mod.SloMonitor()
+    mon.enabled = True
+    mon.observe("t", "success", 1_000)
+    mon.observe("t", "cache_hit", 1)      # free answer: no budget move
+    mon.observe("t", "failed", 1_000)
+    st = mon._tenants["t"]
+    assert st.good_total == 1
+    assert st.bad_total == 1
+
+
+# --------------------------------- warm-hit profile + attribution
+
+
+def test_warm_hit_attribution_conserved():
+    obs.enable()
+    obs.enable_profiling()
+    obs.reset()
+    server = QueryServer(ServerConfig(
+        max_concurrency=2, stall_ms=0)).start()
+    try:
+        p = {"rows": 512, "seed": 21}
+        qid = server.submit("alpha", "tpcds_q3", dict(p))
+        assert server.poll(qid, timeout_s=120)["state"] == "done"
+        warm_id = server.submit("alpha", "tpcds_q3", dict(p))
+        warm = server.poll(warm_id, timeout_s=120)
+        assert warm.get("outcome") == "cache_hit"
+        prof = server.profile(warm_id)
+        assert prof is not None
+        assert prof["cache"]["hits"] == 1
+        assert prof["cache"]["lookup_ns"] > 0
+        led = attribution.attribute_profile(prof)
+        assert led["conserved"]
+        assert led["buckets"]["cache_lookup"] == prof["wall_ns"]
+    finally:
+        server.stop()
+        obs.disable_profiling()
+        obs.disable()
