@@ -9,7 +9,7 @@ PY ?= python
         perf-smoke fusion-smoke doctor-smoke server-smoke \
         lifeguard-smoke ingest-smoke dist-smoke analysis-smoke \
         profile-smoke elastic-smoke slo-smoke attribution-smoke \
-        spill-smoke cache-smoke \
+        spill-smoke cache-smoke stats-smoke \
         serve-bench \
         nightly-artifacts ci ci-nightly clean
 
@@ -211,6 +211,14 @@ spill-smoke:
 cache-smoke:
 	$(PY) scripts/cache_smoke.py
 
+# fused q5+q72 with the stats plane armed: per-node actuals reconcile
+# EXACTLY with numpy recomputation (byte-identical outputs, zero
+# extra executables on repeat); a seeded 100x misestimate fires
+# exactly one cardinality_misestimate bundle and srt-doctor names
+# the node; the disabled hook stays at attribute-read cost
+stats-smoke:
+	$(PY) scripts/stats_smoke.py
+
 # zipf-skewed multi-tenant serving replay -> BENCH_serve_r01.json
 # (per-tenant p50/p99 admission-to-result, throughput, SLO attainment)
 serve-bench:
@@ -240,7 +248,7 @@ ci: test fuzz native sanitizers tpu-lower jni-test dryrun metrics-smoke \
     trace-smoke chaos-smoke perf-smoke fusion-smoke doctor-smoke \
     server-smoke lifeguard-smoke ingest-smoke dist-smoke analysis-smoke \
     profile-smoke elastic-smoke slo-smoke attribution-smoke spill-smoke \
-    cache-smoke
+    cache-smoke stats-smoke
 	$(PY) bench.py
 	@echo "ci: all gates green"
 

@@ -173,6 +173,15 @@ METRICS: Dict[str, Tuple[str, str]] = {
         "counter", "payload bytes admitted into the cache by scope"),
     "srt_result_cache_incremental_folds_total": (
         "counter", "batches folded into resident partial states"),
+    # -- ISSUE 20: per-node cardinality & statistics observatory --
+    "srt_stats_observations_total": (
+        "counter", "per-node row-count observations folded by stage"),
+    "srt_stats_misestimate_total": (
+        "counter", "cardinality misestimates by stage and plan node"),
+    "srt_stats_rows_total": (
+        "counter", "result rows returned to tenants by completed jobs"),
+    "srt_stats_sketch_ns": (
+        "histogram", "wall ns of one memoized column sketch pass"),
 }
 
 # ----------------------------------------------------------------- knobs
@@ -345,6 +354,19 @@ KNOBS: Dict[str, str] = {
         "result-cache entry budget",
     "SPARK_RAPIDS_TPU_RESULT_CACHE_BYTES":
         "result-cache payload byte budget",
+    # -- ISSUE 20: per-node cardinality & statistics observatory --
+    "SPARK_RAPIDS_TPU_STATS":
+        "=1 arms the per-node statistics collector (off by default)",
+    "SPARK_RAPIDS_TPU_STATS_MISEST_RATIO":
+        "actual/estimate divergence ratio that fires the misestimate "
+        "sentinel",
+    "SPARK_RAPIDS_TPU_STATS_STORE":
+        "persistent stats-store file (empty string disables the file "
+        "layer)",
+    "SPARK_RAPIDS_TPU_STATS_STORE_TTL":
+        "seconds before persisted per-node actuals expire",
+    "SPARK_RAPIDS_TPU_STATS_SKETCH_ROWS":
+        "rows one column sketch pass will look at (head slice)",
 }
 
 # env families read with a COMPUTED suffix (pinned_path's

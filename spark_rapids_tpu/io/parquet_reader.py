@@ -583,4 +583,10 @@ def read_table(path: str, columns: Optional[Sequence[str]] = None,
             note_ingest(path, f"{st.st_size}:{st.st_mtime_ns}")
         except Exception:
             pass   # epoch accounting must never fail a read
+        # footer row count -> the stats plane's estimate side
+        # (ISSUE 20): a plan scanning this source inherits the footer
+        # count as its input-cardinality estimate
+        if _obs.STATS.enabled:
+            _obs.STATS.note_source_rows(path, num_rows,
+                                        origin="parquet_footer")
         return Table(out_cols, names=[lf.name for lf in leaves])

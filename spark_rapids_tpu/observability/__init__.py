@@ -31,6 +31,7 @@ from typing import Callable, Optional
 
 from spark_rapids_tpu.observability import flight_recorder as _fr
 from spark_rapids_tpu.observability import slo as _slo
+from spark_rapids_tpu.observability import stats as _stats
 from spark_rapids_tpu.observability import timeseries as _ts
 from spark_rapids_tpu.observability.dumpio import dump_via
 from spark_rapids_tpu.observability.journal import EventJournal
@@ -115,6 +116,7 @@ def reset() -> None:
     PROFILER.reset()
     TIMESERIES.reset()
     SLO.reset()
+    STATS.reset()
 
 
 # --------------------------------------------------------------- instruments
@@ -454,6 +456,25 @@ ATTRIBUTION_QUERIES = METRICS.counter(
     "Attribution ledgers built at query end, by conservation verdict "
     "(true = buckets summed to the wall within tolerance)",
     labels=("conserved",))
+STATS_OBSERVATIONS = METRICS.counter(
+    "srt_stats_observations_total",
+    "Per-node row-count observations folded into the data-statistics "
+    "plane (observability/stats.py), by stage", labels=("stage",),
+    max_series=128)
+STATS_MISESTIMATE = METRICS.counter(
+    "srt_stats_misestimate_total",
+    "Cardinality misestimates detected (actual vs estimate divergence "
+    "past SPARK_RAPIDS_TPU_STATS_MISEST_RATIO), by stage and plan "
+    "node", labels=("stage", "node"), max_series=512)
+STATS_ROWS = METRICS.counter(
+    "srt_stats_rows_total",
+    "Result rows returned to tenants by completed server jobs (the "
+    "rows/s feed behind srt-top)", labels=("tenant",), max_series=128)
+STATS_SKETCH_NS = METRICS.histogram(
+    "srt_stats_sketch_ns",
+    "Wall time of one column sketch pass (KMV + heavy hitters + "
+    "histogram; memoized per stage/input/ingest-epoch)",
+    buckets=DEFAULT_LATENCY_BUCKETS_NS)
 
 
 # ------------------------------------------------------------------ tracer
@@ -775,6 +796,77 @@ def _apply_slo_gauges() -> None:
         SLO_BURN_RATE.set(st["burn_fast"], labels=(tenant, "fast"))
         SLO_BURN_RATE.set(st["burn_slow"], labels=(tenant, "slow"))
         SLO_ATTAINMENT.set(st["attainment"], labels=(tenant,))
+
+
+# --------------------------------------------------------- data statistics
+# The cardinality & statistics observatory (ISSUE 20 tentpole): per-node
+# observed row counts tapped out of fused stages, column sketches, and
+# the est-vs-actual misestimate sentinel.  Independent switch with the
+# usual discipline — stats off costs the compiler ONE attribute read
+# (``STATS.enabled``) per stage run.
+
+
+def _on_stats_observation(stage: str, nodes: list,
+                          misestimates: list) -> None:
+    if not _SWITCH.enabled:
+        return
+    STATS_OBSERVATIONS.inc(len(nodes), labels=(stage,))
+    JOURNAL.emit("node_stats", stage=stage, nodes=len(nodes),
+                 misestimates=len(misestimates),
+                 thread=threading.get_ident())
+
+
+def _on_stats_misestimate(stage: str, node: str, est: int, actual: int,
+                          ratio: float, first: bool) -> None:
+    """One detected cardinality misestimate: metric + journal on every
+    detection, the flight-recorder bundle only on the FIRST detection
+    of a (stage, node) pair — a misestimate repeats on every run of
+    the stage and one bundle is the evidence, fifty are noise."""
+    if _SWITCH.enabled:
+        STATS_MISESTIMATE.inc(labels=(stage, node))
+        JOURNAL.emit("cardinality_misestimate", stage=stage, node=node,
+                     est=int(est), actual=int(actual),
+                     ratio=float(ratio),
+                     thread=threading.get_ident())
+    if first:
+        trigger_incident(
+            "cardinality_misestimate", severity="warn", stage=stage,
+            node=node, est=int(est), actual=int(actual),
+            ratio=float(ratio), stage_stats=STATS.last(stage))
+
+
+def _on_stats_sketch(ns: int) -> None:
+    if not _SWITCH.enabled:
+        return
+    STATS_SKETCH_NS.observe(int(ns))
+
+
+STATS = _stats.StatsCollector(
+    on_observation=_on_stats_observation,
+    on_misestimate=_on_stats_misestimate,
+    on_sketch=_on_stats_sketch)
+
+
+def enable_stats() -> None:
+    """Arm the data-statistics plane (independent switch; the
+    srt_stats_* counters additionally require the metrics switch)."""
+    STATS.enabled = True
+
+
+def disable_stats() -> None:
+    STATS.enabled = False
+
+
+def is_stats_enabled() -> bool:
+    return STATS.enabled
+
+
+def record_tenant_rows(tenant: str, rows: int) -> None:
+    """Server job-completion hook: result rows delivered to one
+    tenant (the rows/s column in srt-top)."""
+    if not _SWITCH.enabled:
+        return
+    STATS_ROWS.inc(int(rows), labels=(str(tenant) or "-",))
 
 
 def evaluate_slo(now: Optional[float] = None) -> list:
@@ -1585,3 +1677,5 @@ if os.environ.get("SPARK_RAPIDS_TPU_SLO", "") not in ("", "0"):
     enable_slo()
 if os.environ.get("SPARK_RAPIDS_TPU_ATTRIBUTION", "") not in ("", "0"):
     enable_attribution()
+if os.environ.get("SPARK_RAPIDS_TPU_STATS", "") not in ("", "0"):
+    enable_stats()

@@ -386,6 +386,10 @@ class QueryProfiler:
             if "t_end_ns" in r:
                 a["t_end_ns"] = max(int(a.get("t_end_ns", 0)),
                                     int(r["t_end_ns"]))
+            # per-node data statistics (ISSUE 20): last execution
+            # wins — counts describe one run, not a sum over retries
+            if r.get("stats") is not None:
+                a["stats"] = r["stats"]
         return [agg[k] for k in order]
 
     def _fold_journal(self, sess: ProfileSession) -> dict:
@@ -655,6 +659,38 @@ def merge_profiles(profiles: List[dict]) -> dict:
             engines = {s.get("engine"), a.get("engine")}
             if len(engines - {None}) > 1:
                 a["engine"] = "mixed"
+            # per-node data statistics (ISSUE 20): rows SUM across
+            # ranks (each rank saw its shard), every rank's own count
+            # survives in per_rank_rows, and a misestimate flagged by
+            # ANY rank stays flagged
+            st = s.get("stats")
+            if st is not None:
+                ms = a.get("stats")
+                if ms is None or "_idx" not in ms:
+                    ms = {"version": st.get("version"),
+                          "epochs": st.get("epochs"),
+                          "rows_in": 0, "rows_out": None,
+                          "nodes": [], "_idx": {}}
+                    a["stats"] = ms
+                ms["rows_in"] += int(st.get("rows_in") or 0)
+                if st.get("rows_out") is not None:
+                    ms["rows_out"] = ((ms["rows_out"] or 0)
+                                      + int(st["rows_out"]))
+                for n in st.get("nodes", []):
+                    mn = ms["_idx"].get(n["node"])
+                    if mn is None:
+                        mn = dict(n)
+                        mn["rows"] = 0
+                        mn["per_rank_rows"] = {}
+                        ms["_idx"][n["node"]] = mn
+                        ms["nodes"].append(mn)
+                    mn["rows"] += int(n.get("rows", 0))
+                    mn["per_rank_rows"][str(rank)] = \
+                        int(n.get("rows", 0))
+                    if n.get("misestimate"):
+                        mn["misestimate"] = True
+                        mn["ratio"] = max(float(n.get("ratio", 0)),
+                                          float(mn.get("ratio", 0)))
     skew = []
     for key in order:
         a = agg[key]
@@ -668,6 +704,9 @@ def merge_profiles(profiles: List[dict]) -> dict:
                              if lo > 0 else None)
         skew.append(row)
     stages = [agg[k] for k in order]
+    for s in stages:
+        if isinstance(s.get("stats"), dict):
+            s["stats"].pop("_idx", None)
     hot = max(stages, key=lambda s: s["wall_ns"], default=None)
 
     def _sum_field(field: str, sub: Optional[str] = None) -> dict:

@@ -146,10 +146,25 @@ def q5_pipeline(stores: int, join_capacity: int) -> Pipeline:
     )
 
 
+def _note_estimates(stage: str, rows_by_input) -> None:
+    """Register generator-size row estimates for a stage's scan
+    inputs (the est side of ISSUE 20's est-vs-actual feedback loop).
+    One attribute read when the stats plane is off."""
+    from spark_rapids_tpu import observability as _obs
+    if not _obs.STATS.enabled:
+        return
+    _obs.STATS.register_input_estimates(
+        stage, {k: len(v) for k, v in rows_by_input.items()},
+        origin="catalog")
+
+
 def run_q5(d, stores: int, capacity: int):
     """Fused q5 under the centralized capacity-retry driver.  Returns
     the same tuple as models.tpcds.make_q5(...)(d)."""
     from spark_rapids_tpu.parallel.exchange import with_capacity_retry
+
+    _note_estimates("q5_partials", {"s": d.s_date, "r": d.r_date,
+                                    "d": d.d_date})
 
     def build(cap):
         pipe = compile_pipeline(q5_pipeline(stores, cap))
@@ -288,6 +303,10 @@ def run_q72(d, items: int, max_week: int, capacity: int,
     """Fused q72 under capacity retry — same tuple as make_q72."""
     from spark_rapids_tpu.parallel.exchange import with_capacity_retry
 
+    _note_estimates("q72_partials", {"cs": d.cs_item,
+                                     "inv": d.inv_item,
+                                     "dim": d.item_id})
+
     def build(cap):
         pipe = compile_pipeline(
             q72_pipeline(items, max_week, cap, limit, week0))
@@ -394,6 +413,7 @@ def q3_plan(base: int, years: int, brands: int, manufact: int,
 
 def run_q3(d, base: int, years: int, brands: int, manufact: int,
            month: int = 11, limit: int = 100):
+    _note_estimates("q3", {"s": d.s_date, "dims": d.d_moy})
     st = compile_stage(q3_plan(base, years, brands, manufact, month,
                                limit))
     return st.run({"s": (d.s_date, d.s_item, d.s_price),

@@ -137,6 +137,78 @@ def _eval(e, env):
     raise TypeError(f"unknown expr {type(e).__name__}")
 
 
+def _expr_is_bool(e, bool_names=frozenset()) -> bool:
+    """Statically decide whether an expression evaluates to a boolean
+    (a predicate/mask) from the IR alone — the tap planner must pick
+    its nodes BEFORE tracing, and the choice must be a pure function
+    of the plan so tapped executables key on digest alone.
+    ``bool_names`` carries the names already known boolean upstream
+    (JoinProbe ``.valid`` outputs, earlier predicate Projects), so a
+    conjunction like ``j.valid AND qty < limit`` still taps."""
+    if isinstance(e, ir.Bin):
+        if e.op in ("and", "or"):
+            return (_expr_is_bool(e.a, bool_names)
+                    and _expr_is_bool(e.b, bool_names))
+        return e.op in ("eq", "ne", "lt", "le", "gt", "ge")
+    if isinstance(e, ir.Un):
+        if e.op == "not":
+            return _expr_is_bool(e.a, bool_names)
+        return e.op == "b"
+    if isinstance(e, ir.Where):
+        return (_expr_is_bool(e.a, bool_names)
+                and _expr_is_bool(e.b, bool_names))
+    if isinstance(e, ir.Mask):
+        return True
+    if isinstance(e, ir.Idx):
+        return _expr_is_bool(e.src, bool_names)
+    if isinstance(e, ir.Sl):
+        return _expr_is_bool(e.a, bool_names)
+    if isinstance(e, ir.Lit):
+        return isinstance(e.value, bool)
+    if isinstance(e, ir.Col):
+        return e.name in bool_names
+    return False
+
+
+def _tap_spec(plan: ir.StagePlan) -> list:
+    """The per-node row-count taps this plan admits, in node order:
+    ``(node_id, kind, env_key)`` triples.  Only DATA-DEPENDENT
+    cardinalities are tapped — JoinProbe match totals (already
+    computed by the probe) and boolean Project predicates (one
+    popcount each); every other node's output size is statically
+    known from its inputs, so observing it would buy nothing."""
+    taps = []
+    bool_names = set()
+    for node in plan.nodes:
+        if isinstance(node, ir.JoinProbe):
+            bool_names.add(f"{node.prefix}.valid")
+            taps.append((node.prefix, "JoinProbe",
+                         f"{node.prefix}.total"))
+        elif isinstance(node, ir.Project) and \
+                _expr_is_bool(node.expr, bool_names):
+            bool_names.add(node.out)
+            taps.append((node.out, "Project", node.out))
+    return taps
+
+
+def _tap_counts(plan: ir.StagePlan, env) -> list:
+    """Scalar int32 observed-row counts for every tap, evaluated from
+    the node outputs ALREADY in ``env`` (shared by the fused trace and
+    the eager walk — same expressions, so the two engines observe
+    identical counts).  A predicate popcount is one reduction over a
+    value the program computed anyway; traced, it fuses into the same
+    executable."""
+    vals = []
+    for _nid, kind, key in _tap_spec(plan):
+        v = jnp.asarray(env[key])
+        if kind == "JoinProbe":
+            vals.append(v.astype(jnp.int32))
+        else:
+            vals.append(jnp.sum(v.astype(jnp.int32))
+                        .astype(jnp.int32))
+    return vals
+
+
 def _eval_node(node, env, reduce_axis: Optional[str]) -> None:
     """Evaluate one node into ``env`` (shared by the fused trace and
     the op-by-op walk — one evaluator, so the two engines cannot
@@ -296,10 +368,15 @@ class CompiledStage:
                 cols.extend(arrs)
         return cols + nvalids, parts, max_bucket
 
-    def _fused_callable(self):
+    def _fused_callable(self, taps: bool = False):
         """The generic evaluator as a pure fn(*args) for jit: binds
         the flat arg list back to named columns + row masks, then
-        walks the nodes — XLA sees ONE program."""
+        walks the nodes — XLA sees ONE program.  With ``taps`` the
+        program additionally returns one stacked int32 vector of
+        per-node observed row counts (ISSUE 20): the values already
+        exist inside the trace (JoinProbe totals, predicate masks),
+        so the same single executable carries them out — zero extra
+        dispatches."""
         plan = self.plan
 
         def fn(*args):
@@ -325,7 +402,13 @@ class CompiledStage:
                         rows, jnp.bool_)
             for node in plan.nodes:
                 _eval_node(node, env, None)
-            return tuple(env[o] for o in plan.outputs)
+            outs = tuple(env[o] for o in plan.outputs)
+            if taps:
+                vals = _tap_counts(plan, env)
+                counts = (jnp.stack(vals) if vals
+                          else jnp.zeros(0, jnp.int32))
+                return outs + (counts,)
+            return outs
 
         return fn
 
@@ -360,21 +443,27 @@ class CompiledStage:
         from spark_rapids_tpu.perf.calibrate import operands_digest
         return f"{self.plan.digest}|{operands_digest(parts)}"
 
-    def _run_fused(self, inputs, run_digest: Optional[str] = None
-                   ) -> tuple:
+    def _run_fused(self, inputs, run_digest: Optional[str] = None,
+                   taps: bool = False) -> tuple:
         """ONE AOT executable through the process compile cache,
         keyed by (stage-plan digest, all-operand schema digest, row
-        bucket).  Returns (outputs, compile_ns, run_digest) —
+        bucket).  Returns (outputs, compile_ns, run_digest, counts) —
         ``compile_ns`` is the lower+compile wall when THIS call built
         the executable, 0 on a cache hit (truthiness keeps the old
         compiled-now contract; the attribution ledger carves the
-        nanoseconds out of the stage's compute)."""
+        nanoseconds out of the stage's compute).  ``counts`` is the
+        tapped per-node row-count vector (None without ``taps``); a
+        tapped program is a DIFFERENT executable, so the compile-cache
+        key gets a ``|taps`` suffix while the reported run digest
+        stays the base one — journal/profile/calibration rows fold
+        together whichever way the stats switch points."""
         from spark_rapids_tpu import observability as _obs
         from spark_rapids_tpu.perf import jit_cache as _jc
 
         args, parts, bucket = self._bind_args(inputs)
         digest = run_digest or self._run_digest(parts)
-        fn = self._fused_callable()
+        key_digest = f"{digest}|taps" if taps else digest
+        fn = self._fused_callable(taps=taps)
         compiled_now = []
 
         def build():
@@ -390,7 +479,7 @@ class CompiledStage:
 
         if _jc.CACHE.enabled():
             ex = _jc.CACHE.get_or_build(
-                f"stage.{self.plan.name}", digest, bucket, build,
+                f"stage.{self.plan.name}", key_digest, bucket, build,
                 cost_bytes=_jc._tree_nbytes(args))
             out = ex(*args)
         else:
@@ -398,18 +487,22 @@ class CompiledStage:
             # jit's trace cache still reuses the traced program — a
             # fresh wrapper per call would retrace+recompile every
             # query (the exchange._step_for discipline)
-            jf = self._nocache.get((digest, bucket))
+            jf = self._nocache.get((digest, bucket, taps))
             if jf is None:
-                jf = self._nocache.setdefault((digest, bucket),
-                                              jax.jit(fn))
+                jf = self._nocache.setdefault(
+                    (digest, bucket, taps), jax.jit(fn))
             out = jf(*args)
-        return out, (compiled_now[0] if compiled_now else 0), digest
+        counts = None
+        if taps:
+            counts, out = out[-1], out[:-1]
+        return out, (compiled_now[0] if compiled_now else 0), \
+            digest, counts
 
-    def run_unfused(self, inputs) -> tuple:
-        """Op-by-op eager walk on unpadded inputs: every node pays its
-        own dispatch + HBM round trip.  Byte-identical to the fused
-        program (same evaluator, exact int aggregates) — the escape
-        hatch, the calibration rival, and the bench baseline."""
+    def _walk_env(self, inputs) -> Dict[str, object]:
+        """The eager op-by-op walk's full environment (every node
+        output by name) — run_unfused projects the plan outputs out
+        of it, the stats tap reads the same count expressions the
+        fused program stacks."""
         env: Dict[str, object] = {}
         for inp in self.plan.inputs:
             arrs = [jnp.asarray(a) for a in inputs[inp.name]]
@@ -420,6 +513,18 @@ class CompiledStage:
             env[f"__mask__{inp.name}"] = jnp.ones(rows, jnp.bool_)
         for node in self.plan.nodes:
             _eval_node(node, env, None)
+        return env
+
+    def _host_counts(self, env) -> list:
+        """Tapped counts off an eager walk's env, as python ints."""
+        return [int(v) for v in _tap_counts(self.plan, env)]
+
+    def run_unfused(self, inputs) -> tuple:
+        """Op-by-op eager walk on unpadded inputs: every node pays its
+        own dispatch + HBM round trip.  Byte-identical to the fused
+        program (same evaluator, exact int aggregates) — the escape
+        hatch, the calibration rival, and the bench baseline."""
+        env = self._walk_env(inputs)
         return tuple(env[o] for o in self.plan.outputs)
 
     # -------------------------------------------------------------- entry
@@ -434,24 +539,36 @@ class CompiledStage:
         from spark_rapids_tpu import observability as _obs
 
         mode = fusion_mode()
+        # data-statistics tap (ISSUE 20): ONE attribute read when the
+        # stats plane is off — no observation dict, no extra outputs,
+        # the exact executable PR 11 shipped
+        taps = _obs.STATS.enabled
         compiled = False
         compile_ns = 0
         wall_ns = None
+        counts = None
         # the event digest is the full RUN key (plan | operand
         # shapes): the stages table must not average walls across row
         # buckets, or a small escape-hatch run would skew the ratio a
         # large fused workload reads as its regression signal
         if mode == "auto":
-            out, compiled, outcome, wall_ns, digest, compile_ns = \
-                self._run_calibrated(inputs)
+            out, compiled, outcome, wall_ns, digest, compile_ns, \
+                counts = self._run_calibrated(inputs, taps=taps)
         else:
             t0 = time.monotonic_ns()
             if mode == "off":
-                out, outcome = self.run_unfused(inputs), "unfused"
+                if taps:
+                    env = self._walk_env(inputs)
+                    out = tuple(env[o] for o in self.plan.outputs)
+                    counts = self._host_counts(env)
+                else:
+                    out = self.run_unfused(inputs)
+                outcome = "unfused"
                 digest = self._run_digest(
                     self._shape_parts(inputs)[0])
             else:
-                out, compile_ns, digest = self._run_fused(inputs)
+                out, compile_ns, digest, counts = self._run_fused(
+                    inputs, taps=taps)
                 compiled = bool(compile_ns)
                 outcome = "fused"
             jax.block_until_ready(out)
@@ -460,6 +577,8 @@ class CompiledStage:
             self.plan.name, outcome, digest=digest,
             wall_ns=wall_ns, nodes=self.dispatch_count,
             compiled=compiled)
+        stats = (self._note_stats(inputs, digest, counts)
+                 if taps else None)
         # query-profile feed (ISSUE 13): one structured record per
         # stage execution while the calling thread profiles a query.
         # active() is one attribute read when profiling is off — the
@@ -468,8 +587,41 @@ class CompiledStage:
             _obs.PROFILER.note_stage(self._profile_record(
                 inputs, digest=digest, engine=outcome,
                 wall_ns=wall_ns, compiled=compiled,
-                compile_ns=compile_ns))
+                compile_ns=compile_ns, stats=stats))
         return out
+
+    def _note_stats(self, inputs, digest: str, counts) -> Optional[dict]:
+        """Fold one execution's observation into the stats plane and
+        return the profile's per-stage ``stats`` section.  Input row
+        counts are host-known (the n_valid scalars the binder already
+        computed); tapped counts arrive as the executable's int32
+        vector (fused) or python ints (eager walk) — np.asarray is
+        the only device sync and it reads values the program computed
+        anyway."""
+        import numpy as np
+
+        from spark_rapids_tpu import observability as _obs
+        spec = _tap_spec(self.plan)
+        vals = []
+        if counts is not None:
+            vals = [int(x) for x in
+                    np.asarray(counts).reshape(-1)[:len(spec)]]
+        nodes = [{"node": nid, "kind": kind, "rows": v}
+                 for (nid, kind, _key), v in zip(spec, vals)]
+        ins, cols = [], {}
+        for inp in self.plan.inputs:
+            arrs = inputs.get(inp.name)
+            if not arrs:
+                continue
+            shape = np.shape(arrs[0])
+            ins.append({"name": inp.name,
+                        "rows": int(shape[0]) if shape else 0})
+            cols[inp.name] = arrs[0]
+        return _obs.STATS.note_stage(
+            {"stage": self.plan.name,
+             "plan_digest": self.plan.digest,
+             "run_digest": digest, "inputs": ins, "nodes": nodes},
+            columns=cols)
 
     def run_spilled(self, partitions: Sequence[Mapping[str, object]]
                     ) -> list:
@@ -512,7 +664,8 @@ class CompiledStage:
 
     def _profile_record(self, inputs, *, digest: str, engine: str,
                         wall_ns, compiled: bool,
-                        compile_ns: int = 0) -> dict:
+                        compile_ns: int = 0,
+                        stats: Optional[dict] = None) -> dict:
         """The typed per-stage profile row: plan structure (node
         kinds + outputs), per-input rows/bucket/pad-waste, engine,
         wall, compile-vs-cache-hit (plus the build's own wall, for
@@ -533,7 +686,7 @@ class CompiledStage:
                         "bucket": bucket,
                         "pad_rows": max(bucket - rows, 0)})
         t_end_ns = time.monotonic_ns()
-        return {
+        rec = {
             "stage": self.plan.name,
             "digest": digest,
             "engine": ("unfused" if engine == "unfused" else "fused"),
@@ -550,6 +703,9 @@ class CompiledStage:
                       for n in self.plan.nodes],
             "inputs": ins,
         }
+        if stats is not None:
+            rec["stats"] = stats
+        return rec
 
     def _calibration_sample(self, inputs):
         """Row-slice oversized bucketed inputs for the measurement
@@ -567,7 +723,7 @@ class CompiledStage:
             out[inp.name] = arrs
         return out, sampled
 
-    def _run_calibrated(self, inputs):
+    def _run_calibrated(self, inputs, taps: bool = False):
         """Stage-granularity engine verdict: the first stage of a
         given (plan digest, operand shapes, backend) measures fused vs
         op-by-op — on row-sliced samples past _STAGE_CALIB_MAX_ROWS,
@@ -575,9 +731,12 @@ class CompiledStage:
         — and every later one takes the cached winner.  Both engines
         are byte-identical, so calibration is a speed choice only (the
         PR-9 contract, promoted from per-op to per-stage).  Returns
-        (outputs, compiled, outcome, wall_ns, run_digest, compile_ns)
-        with the wall of the winning engine's OWN execution
-        (measurement runs excluded)."""
+        (outputs, compiled, outcome, wall_ns, run_digest, compile_ns,
+        counts) with the wall of the winning engine's OWN execution
+        (measurement runs excluded); ``counts`` is the winner's
+        tapped row-count vector (None without ``taps``, and None when
+        a sampled measurement won on sliced inputs — sliced counts
+        would reconcile against nothing)."""
         from spark_rapids_tpu.perf import calibrate
 
         parts, _bucket = self._shape_parts(inputs)
@@ -585,6 +744,7 @@ class CompiledStage:
         compiled = []
         last: Dict[str, tuple] = {}
         walls: Dict[str, int] = {}
+        tap_cell: Dict[str, object] = {}
         calib_inputs, sampled = self._calibration_sample(inputs)
 
         def timed(tag, fn):
@@ -600,18 +760,26 @@ class CompiledStage:
         def fused_body():
             # sampled inputs key their own (smaller) executable; the
             # full-size digest stays the verdict key
-            out, c, _d = self._run_fused(
-                calib_inputs, run_digest=None if sampled else digest)
+            out, c, _d, cts = self._run_fused(
+                calib_inputs, run_digest=None if sampled else digest,
+                taps=taps)
             if c:
                 compiled.append(c)
+            if cts is not None:
+                tap_cell["fused"] = cts
             return out
+
+        def unfused_body():
+            if not taps:
+                return self.run_unfused(calib_inputs)
+            env = self._walk_env(calib_inputs)
+            tap_cell["op_by_op"] = self._host_counts(env)
+            return tuple(env[o] for o in self.plan.outputs)
 
         path = calibrate.pick_path(
             f"stage:{self.plan.name}", digest,
             {"fused": timed("fused", fused_body),
-             "op_by_op": timed("op_by_op",
-                               lambda: self.run_unfused(
-                                   calib_inputs))},
+             "op_by_op": timed("op_by_op", unfused_body)},
             default="fused")
         if path not in ("fused", "op_by_op"):
             # pick_path returns env pins verbatim — callers validate
@@ -624,17 +792,25 @@ class CompiledStage:
             # reuse its outputs and its measured wall instead of
             # paying a third execution
             return (last[path], bool(compiled), outcome, walls[path],
-                    digest, sum(compiled))
+                    digest, sum(compiled), tap_cell.get(path))
         t0 = time.monotonic_ns()
+        counts = None
         if path == "op_by_op":
-            out = self.run_unfused(inputs)
+            if taps:
+                env = self._walk_env(inputs)
+                out = tuple(env[o] for o in self.plan.outputs)
+                counts = self._host_counts(env)
+            else:
+                out = self.run_unfused(inputs)
         else:
-            out, c, _d = self._run_fused(inputs, run_digest=digest)
+            out, c, _d, counts = self._run_fused(
+                inputs, run_digest=digest, taps=taps)
             if c:
                 compiled.append(c)
         jax.block_until_ready(out)
         return (out, bool(compiled), outcome,
-                time.monotonic_ns() - t0, digest, sum(compiled))
+                time.monotonic_ns() - t0, digest, sum(compiled),
+                counts)
 
 
 # plan-verify gate (ISSUE 12): every distinct plan digest is verified

@@ -116,6 +116,21 @@ def _cancel_verdict(job: Job):
     return STATE_CANCELLED, "cancelled", None
 
 
+def _result_rows(result) -> int:
+    """Rows in a completed job's result — the leading dimension of
+    the first output column (catalog results are column tuples); a
+    scalar result counts as one row.  Best-effort: the rows/s feed
+    must never fail a job that just succeeded."""
+    import numpy as np
+    try:
+        first = (result[0] if isinstance(result, (tuple, list))
+                 and result else result)
+        shape = np.shape(first)
+        return int(shape[0]) if shape else 1
+    except Exception:
+        return 0
+
+
 @dataclass
 class ServerConfig:
     max_concurrency: int = 4
@@ -728,7 +743,17 @@ class QueryServer:
                     job.tenant, job.query, job.params, result)
             except Exception:
                 pass   # caching is best-effort, never a failure path
+        # per-tenant rows delivered (ISSUE 20): the rows/s feed
+        # behind srt-top + the stats() endpoint's per-tenant fold
+        rows_done = 0
+        if state == STATE_DONE and result is not None \
+                and not job.hung:
+            rows_done = _result_rows(result)
+            if _obs.is_enabled():
+                _obs.record_tenant_rows(job.tenant, rows_done)
         with self._work:
+            if rows_done:
+                self._stat_add(job.tenant, "rows", rows_done)
             self._finalize_locked(job, state, outcome=outcome,
                                   result=result, error=error,
                                   charge=True)
@@ -1247,14 +1272,17 @@ class QueryServer:
     _OTHER = "__other__"
 
     def _stat(self, tenant: str, key: str) -> None:
+        self._stat_add(tenant, key, 1)
+
+    def _stat_add(self, tenant: str, key: str, n: int) -> None:
         if tenant not in self._tenant_stats \
                 and len(self._tenant_stats) >= self._MAX_TENANT_ROWS:
             tenant = self._OTHER
         row = self._tenant_stats.setdefault(tenant, {
             "admitted": 0, "rejected": 0, "requeued": 0, "success": 0,
             "failed": 0, "cancelled": 0, "shed": 0, "hung": 0,
-            "deadline": 0, "cache_hit": 0})
-        row[key] = row.get(key, 0) + 1
+            "deadline": 0, "cache_hit": 0, "rows": 0})
+        row[key] = row.get(key, 0) + n
 
     def _bytes_tracked(self, tenant: str) -> bool:
         """Whether anyone pays attention to this tenant's device

@@ -560,6 +560,46 @@ def result_cache_bump_epoch(source: str) -> int:
     return result_cache.bump_ingest_epoch(str(source))
 
 
+# ----------------------------------------------------------- data stats
+# (per-node cardinality observatory, ISSUE 20: the JVM arms the
+# collector during plan-quality investigations, then pulls one JSON
+# snapshot of est-vs-actual rows per stage/node; disabled it costs one
+# attribute read per stage run)
+
+
+def stats_set_enabled(enabled: bool) -> bool:
+    """Arm/disarm the per-node statistics collector; returns the new
+    state."""
+    from spark_rapids_tpu import observability as obs
+    if enabled:
+        obs.enable_stats()
+    else:
+        obs.disable_stats()
+    return obs.is_stats_enabled()
+
+
+def stats_enabled() -> bool:
+    from spark_rapids_tpu import observability as obs
+    return obs.is_stats_enabled()
+
+
+def stats_snapshot_json() -> str:
+    """JSON snapshot of the statistics collector: observation and
+    misestimate totals, registered estimates and source row counts,
+    and the latest per-node section per stage."""
+    import json
+
+    from spark_rapids_tpu import observability as obs
+    return json.dumps(obs.STATS.snapshot(), sort_keys=True,
+                      default=str)
+
+
+def stats_store_clear() -> None:
+    """Drop the persistent StatsStore (process map and file layer)."""
+    from spark_rapids_tpu import observability as obs
+    obs.STATS.store.clear()
+
+
 # --------------------------------------------------------- query server
 # (the resident multi-tenant front door, server/: the JVM starts the
 # pool once per executor, then every Spark task thread submits through

@@ -924,6 +924,26 @@ def result_cache_bump_epoch(source: str) -> int:
     return jni_api.result_cache_bump_epoch(str(source))
 
 
+def stats_set_enabled(enabled: bool) -> bool:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.stats_set_enabled(bool(enabled))
+
+
+def stats_enabled() -> bool:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.stats_enabled()
+
+
+def stats_snapshot_json() -> str:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.stats_snapshot_json()
+
+
+def stats_store_clear() -> None:
+    from spark_rapids_tpu.shim import jni_api
+    return jni_api.stats_store_clear()
+
+
 def kudo_set_crc_enabled(enabled: bool) -> bool:
     from spark_rapids_tpu.shim import jni_api
     return jni_api.kudo_set_crc_enabled(bool(enabled))

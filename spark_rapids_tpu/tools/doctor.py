@@ -415,6 +415,31 @@ def analyze(bundle: Bundle) -> List[dict]:
                             f"window(s) of telemetry in trigger.json "
                             f"(last window seq "
                             f"{tail[-1].get('window', '?')})")})
+    elif kind == "cardinality_misestimate":
+        node = detail.get("node", "?")
+        stage = detail.get("stage", "?")
+        findings.append({
+            "severity": 55, "kind": "cardinality_misestimate",
+            "message": (f"cardinality misestimate at node {node!r} of "
+                        f"stage {stage!r}: estimated "
+                        f"{detail.get('est', '?')} rows, observed "
+                        f"{detail.get('actual', '?')} "
+                        f"(x{detail.get('ratio', '?')} off; threshold "
+                        f"SPARK_RAPIDS_TPU_STATS_MISEST_RATIO) — "
+                        f"refresh the estimate source or re-plan: a "
+                        f"cost-based choice keyed on this estimate is "
+                        f"operating on wrong data")})
+        ss = detail.get("stage_stats") or {}
+        nodes = [n for n in (ss.get("nodes") or ())
+                 if n.get("est") is not None]
+        if nodes:
+            split = ", ".join(
+                f"{n['node']} est={n['est']} actual={n.get('rows')}"
+                for n in nodes[:6])
+            findings.append({
+                "severity": 25, "kind": "cardinality_misestimate",
+                "message": (f"stage {stage!r} est-vs-actual at "
+                            f"trigger time: {split}")})
     elif kind == "manual":
         findings.append({
             "severity": 10, "kind": "manual",

@@ -881,6 +881,59 @@ def render_window_table(timeseries: Optional[dict],
     return out
 
 
+def stats_rows(events: List[dict],
+               registry: Optional[dict]) -> dict:
+    """Data-statistics plane fold (ISSUE 20): per-(stage, node)
+    misestimates (latest journal event wins) + per-tenant delivered
+    rows from the registry."""
+    latest: Dict[tuple, dict] = {}
+    for e in events:
+        if e.get("kind") != "cardinality_misestimate":
+            continue
+        latest[(str(e.get("stage", "?")), str(e.get("node", "?")))] = {
+            "est": e.get("est"), "actual": e.get("actual"),
+            "ratio": e.get("ratio")}
+    fam = (registry or {}).get("srt_stats_rows_total") or {}
+    tenant_rows = {s["labels"][0]: s.get("value", 0)
+                   for s in fam.get("series", []) if s.get("labels")}
+    return {
+        "observations": sum(1 for e in events
+                            if e.get("kind") == "node_stats"),
+        "misestimates": [
+            {"stage": k[0], "node": k[1], **v}
+            for k, v in sorted(latest.items())],
+        "tenant_rows": tenant_rows,
+    }
+
+
+def render_stats_table(events: List[dict],
+                       registry: Optional[dict]) -> List[str]:
+    d = stats_rows(events, registry)
+    out = ["", "data statistics (cardinality est vs actual; rows past "
+               "SPARK_RAPIDS_TPU_STATS_MISEST_RATIO are misestimates)",
+           ""]
+    mis = d["misestimates"]
+    if mis:
+        w = max(max(len(m["stage"]) for m in mis), len("stage"))
+        wn = max(max(len(m["node"]) for m in mis), len("node"))
+        hdr = (f"{'stage':<{w}}  {'node':<{wn}}  {'est':>12}  "
+               f"{'actual':>12}  {'ratio':>8}")
+        out.append(hdr)
+        out.append("-" * len(hdr))
+        for m in mis:
+            out.append(f"{m['stage']:<{w}}  {m['node']:<{wn}}  "
+                       f"{m.get('est', 0):>12}  "
+                       f"{m.get('actual', 0):>12}  "
+                       f"x{m.get('ratio', 0):>7}")
+    else:
+        out.append(f"(no misestimates; {d['observations']} "
+                   f"node_stats observation event(s))")
+    if d["tenant_rows"]:
+        out.append("rows delivered: " + "  ".join(
+            f"{t}={v}" for t, v in sorted(d["tenant_rows"].items())))
+    return out
+
+
 def render_slo_table(slo: Optional[dict]) -> List[str]:
     out = ["", "per-tenant SLO (burn = bad fraction / error budget; "
                "fires when fast AND slow exceed threshold)", ""]
@@ -928,6 +981,7 @@ def build_report(records: List[dict]) -> dict:
         "server": server_rows(events, registry),
         "io": io_rows(events, registry),
         "fleet": fleet_rows(events, registry),
+        "stats": stats_rows(events, registry),
         "slo": slo,
         "window": window_rows(timeseries, registry),
     }
@@ -974,6 +1028,11 @@ def main(argv=None) -> int:
         lines += render_fleet_table(events, registry)
     if any(e.get("kind") == "stage_fusion" for e in events):
         lines += render_stage_table(events)
+    if any(e.get("kind") in ("node_stats", "cardinality_misestimate")
+           for e in events) \
+            or (registry or {}).get("srt_stats_rows_total",
+                                    {}).get("series"):
+        lines += render_stats_table(events, registry)
     if args.window is not None:
         lines += render_window_table(timeseries, registry,
                                      args.window)

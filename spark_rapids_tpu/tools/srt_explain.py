@@ -194,6 +194,36 @@ def render_profile(profile: dict, *, nodes: bool = False
                                  prw.items(),
                                  key=lambda kv: int(kv[0])))
             out.append(f"      per-rank: {ranks}")
+        # per-node cardinalities (ISSUE 20): est vs actual, sketch
+        # NDV, selectivity, and the misestimate highlight
+        st = s.get("stats")
+        if st and st.get("nodes"):
+            out.append("      stats (est vs actual rows):")
+            for n in st["nodes"]:
+                if n.get("est") is not None:
+                    bits = [f"rows est={n['est']} "
+                            f"actual={n.get('rows')}"]
+                    if n.get("ratio"):
+                        bits.append(f"(x{n['ratio']:g} off)")
+                else:
+                    bits = [f"rows actual={n.get('rows')}"]
+                if n.get("selectivity") is not None:
+                    bits.append(f"sel={n['selectivity']:.4f}")
+                if n.get("ndv") is not None:
+                    bits.append(f"ndv={n['ndv']}")
+                if n.get("null_frac"):
+                    bits.append(f"null={n['null_frac']:.3f}")
+                prr = n.get("per_rank_rows")
+                if prr:
+                    bits.append("per-rank " + " ".join(
+                        f"r{r}={v}" for r, v in sorted(
+                            prr.items(),
+                            key=lambda kv: int(kv[0]))))
+                line = (f"        {n.get('node', '?'):<14} "
+                        + "  ".join(bits))
+                if n.get("misestimate"):
+                    line += "  <-- MISESTIMATE"
+                out.append(line)
     # ---- skew table (fleet merges only) ----------------------------
     skew = profile.get("skew") or []
     worst = [r for r in skew if r.get("skew_ratio")

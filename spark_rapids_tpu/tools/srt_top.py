@@ -53,6 +53,9 @@ _EPOCH = "srt_fleet_epoch"
 _SPECULATIONS = "srt_fleet_speculations_total"
 _RETRIES = "srt_retry_episodes_total"
 _ATTR_TIME = "srt_attribution_ns_total"
+_STATS_ROWS = "srt_stats_rows_total"
+_RC_HITS = "srt_result_cache_hits_total"
+_RC_MISSES = "srt_result_cache_misses_total"
 
 
 # ------------------------------------------------------------- loading
@@ -166,14 +169,23 @@ def build_frame(fleet: FleetTimeseries, windows: int = 12) -> dict:
                                 for k in qw["series"])
         attr = counters.get(_ATTR_TIME) or {}
         tenant_names.update(k.split("|")[0] for k in attr)
+        tenant_names.update(counters.get(_STATS_ROWS) or {})
+        # result-cache label order is (scope, tenant)
+        for fam in (_RC_HITS, _RC_MISSES):
+            for key in (counters.get(fam) or {}):
+                parts = key.split("|")
+                if len(parts) > 1 and parts[1] not in ("", "-"):
+                    tenant_names.add(parts[1])
         tenant_names.update(slo)
         for t in tenant_names:
             row = tenants.setdefault(t, {
                 "queued": 0, "running": 0, "device_bytes": 0,
                 "completed_s": 0.0, "requeued_s": 0.0,
-                "retry_s": 0.0, "recent_p50_ms": None,
+                "retry_s": 0.0, "rows_s": 0.0,
+                "cache_hit_ratio": None, "recent_p50_ms": None,
                 "recent_p99_ms": None, "recent_events": 0,
-                "slo": None, "where": {}, "where_dominant": None})
+                "slo": None, "where": {}, "where_dominant": None,
+                "_rc_hits": 0, "_rc_misses": 0})
             row["queued"] += int(
                 (gauges.get(_QUEUED) or {}).get(t, 0))
             row["running"] += int(
@@ -191,6 +203,17 @@ def build_frame(fleet: FleetTimeseries, windows: int = 12) -> dict:
             row["requeued_s"] = round(
                 row["requeued_s"] + req / dur, 3)
             row["retry_s"] = round(row["retry_s"] + retry / dur, 3)
+            # data-plane satellites (ISSUE 20): rows/s delivered +
+            # result-cache hit ratio — both already in the registry,
+            # now rendered
+            rows = (counters.get(_STATS_ROWS) or {}).get(t, 0)
+            row["rows_s"] = round(row["rows_s"] + rows / dur, 1)
+            for fam, slot in ((_RC_HITS, "_rc_hits"),
+                              (_RC_MISSES, "_rc_misses")):
+                row[slot] += sum(
+                    v for k, v in (counters.get(fam) or {}).items()
+                    if len(k.split("|")) > 1
+                    and k.split("|")[1] == t)
             if qw and t in qw["series"]:
                 s = qw["series"][t]
                 bc = s["bucket_counts"]
@@ -211,6 +234,10 @@ def build_frame(fleet: FleetTimeseries, windows: int = 12) -> dict:
                                             key=row["where"].get)
             if t in slo:
                 row["slo"] = slo[t]
+    for row in tenants.values():
+        hits, misses = row.pop("_rc_hits"), row.pop("_rc_misses")
+        if hits + misses > 0:
+            row["cache_hit_ratio"] = round(hits / (hits + misses), 4)
     return {"epoch": merged["epoch"],
             "ranks": {k: ranks[k] for k in sorted(ranks)},
             "tenants": {k: tenants[k] for k in sorted(tenants)}}
@@ -226,7 +253,8 @@ def render_frame(frame: dict) -> List[str]:
     tenants = frame["tenants"]
     out.append("tenants (recent percentiles from windowed buckets)")
     hdr = (f"{'tenant':<12}  {'run':>3}  {'qd':>3}  {'p50_ms':>8}  "
-           f"{'p99_ms':>8}  {'cmpl/s':>7}  {'rq/s':>5}  "
+           f"{'p99_ms':>8}  {'cmpl/s':>7}  {'rows/s':>8}  "
+           f"{'hit%':>5}  {'rq/s':>5}  "
            f"{'dev_MB':>7}  {'burn_f':>6}  {'burn_s':>6}  "
            f"{'attain':>6}  {'where':<15}")
     out.append(hdr)
@@ -239,11 +267,16 @@ def render_frame(frame: dict) -> List[str]:
         def _n(v, fmt="{:.3f}"):
             return "-" if v is None else fmt.format(v)
 
+        def _hit(v):
+            return "-" if v is None else f"{100.0 * v:.1f}"
+
         out.append(
             f"{t[:12]:<12}  {r['running']:>3}  {r['queued']:>3}  "
             f"{_n(r['recent_p50_ms']):>8}  "
             f"{_n(r['recent_p99_ms']):>8}  "
-            f"{r['completed_s']:>7.2f}  {r['requeued_s']:>5.2f}  "
+            f"{r['completed_s']:>7.2f}  {r['rows_s']:>8.1f}  "
+            f"{_hit(r.get('cache_hit_ratio')):>5}  "
+            f"{r['requeued_s']:>5.2f}  "
             f"{r['device_bytes'] / 1e6:>7.1f}  "
             f"{_n(slo.get('burn_fast'), '{:.2f}'):>6}  "
             f"{_n(slo.get('burn_slow'), '{:.2f}'):>6}  "

@@ -1,0 +1,102 @@
+"""bench-trend (tools/bench_trend.py, ISSUE 20 satellite): extractor
+coverage over the real BENCH round schemas, delta/regression logic,
+and golden-stable --json output."""
+
+import json
+import os
+
+import pytest
+
+from spark_rapids_tpu.tools import bench_trend as BT
+
+
+def _write(tmp_path, name, parsed):
+    p = tmp_path / name
+    p.write_text(json.dumps({"parsed": parsed}))
+    return str(p)
+
+
+class TestExtractors:
+
+    def test_rowconv_rounds(self, tmp_path):
+        p = _write(tmp_path, "BENCH_r01.json",
+                   {"metric": "jcudf_to_rows", "value": 0.7,
+                    "unit": "GB/s", "vs_baseline": 2.5})
+        rows = BT.collect([p])
+        assert rows[0]["round"] == "r01"
+        assert rows[0]["metric"] == "rowconv_GBps"
+        assert rows[0]["value"] == 0.7
+
+    def test_fusion_round(self, tmp_path):
+        p = _write(tmp_path, "BENCH_r07.json", {
+            "stage_fusion": {"q5": {"speedup": 3.26},
+                             "q3": {"speedup": 7.3}},
+            "executables": {"second_same_bucket_query_compiles": 0}})
+        row = BT.collect([p])[0]
+        assert row["metric"] == "fused_q5_speedup"
+        assert row["value"] == 3.26
+        assert "0 recompiles warm" in row["detail"]
+
+    def test_unknown_schema_degrades(self, tmp_path):
+        p = _write(tmp_path, "BENCH_r99.json", {"novel": 1})
+        row = BT.collect([p])[0]
+        assert row["error"] == "no extractor" and "value" not in row
+        q = tmp_path / "BENCH_r98.json"
+        q.write_text("{torn")
+        assert BT.collect([str(q)])[0]["error"] == "unreadable"
+
+
+class TestTrend:
+
+    def _rows(self, values):
+        return [{"round": f"r{i}", "metric": "m", "unit": "u",
+                 "value": v} for i, v in enumerate(values)]
+
+    def test_delta_and_regression_flag(self):
+        rows = self._rows([1.0, 1.1, 1.0])
+        BT.annotate(rows, tolerance=0.05)
+        assert "delta_pct" not in rows[0]
+        assert rows[1]["delta_pct"] == 10.0
+        assert rows[1]["regression"] is False
+        assert rows[2]["delta_pct"] == -9.1
+        assert rows[2]["regression"] is True
+
+    def test_series_do_not_cross_metrics(self):
+        rows = self._rows([100.0])
+        rows.append({"round": "r1", "metric": "other", "unit": "u",
+                     "value": 1.0})
+        BT.annotate(rows)
+        assert "delta_pct" not in rows[1]   # new series, no fake delta
+
+    def test_repo_bench_files_fold_clean(self):
+        """The real repo-root BENCH files all extract (no silent
+        schema drift) and render."""
+        paths = BT._default_paths(BT.repo_root())
+        if not paths:
+            pytest.skip("no BENCH files in this checkout")
+        rows = BT.collect(paths)
+        assert all("value" in r for r in rows), [
+            r for r in rows if "value" not in r]
+        BT.annotate(rows)
+        out = BT.render(rows)
+        assert "bench trend" in out and "rounds" in out
+
+
+class TestGoldenJson:
+
+    def test_json_mode_deterministic(self, tmp_path, capsys):
+        files = [
+            _write(tmp_path, "BENCH_r01.json",
+                   {"metric": "m", "value": 1.0, "unit": "GB/s"}),
+            _write(tmp_path, "BENCH_r02.json",
+                   {"metric": "m", "value": 0.5, "unit": "GB/s"}),
+        ]
+        outs = []
+        for _ in range(2):
+            rc = BT.main([*files, "--json"])
+            outs.append(capsys.readouterr().out)
+            assert rc == 1   # the 50% drop flags a regression
+        assert outs[0] == outs[1]
+        d = json.loads(outs[0])
+        assert d["regressions"] == 1
+        assert d["rounds"][1]["regression"] is True
